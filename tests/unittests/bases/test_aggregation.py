@@ -1,0 +1,83 @@
+"""Aggregation metric tests (reference ``tests/unittests/bases/test_aggregation.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "np_reduce"),
+    [(MaxMetric, np.max), (MinMetric, np.min), (SumMetric, np.sum), (MeanMetric, np.mean)],
+)
+def test_aggregation_matches_numpy(metric_cls, np_reduce):
+    rng = np.random.RandomState(7)
+    values = rng.randn(4, 10).astype(np.float32)
+    m = metric_cls()
+    for row in values:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), np_reduce(values), rtol=1e-5)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, 3.0]))
+    np.testing.assert_allclose(float(m.compute()), (1 * 1 + 3 * 3) / 4)
+
+
+@pytest.mark.parametrize("metric_cls", [MaxMetric, MinMetric, SumMetric, MeanMetric])
+def test_nan_error(metric_cls):
+    m = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encountered `nan`"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_ignore():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(m.compute()) == 3.0
+    m2 = MeanMetric(nan_strategy="ignore")
+    m2.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    assert float(m2.compute()) == 2.0
+
+
+def test_nan_impute():
+    m = SumMetric(nan_strategy=5.0)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    assert float(m.compute()) == 6.0
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        SumMetric(nan_strategy="whatever")
+
+
+def test_forward_running_value():
+    m = MeanMetric()
+    assert float(m(jnp.asarray([2.0, 4.0]))) == 3.0
+    assert float(m(jnp.asarray([0.0]))) == 0.0
+    assert float(m.compute()) == 2.0
+
+
+def test_cat_nan_ignore_filters_under_default_path():
+    m = CatMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0])
+
+
+def test_min_max_empty_update_is_noop():
+    mx = MaxMetric()
+    mx.update(jnp.zeros((0,)))
+    mx.update(jnp.asarray([3.0]))
+    assert float(mx.compute()) == 3.0
+    mn = MinMetric()
+    mn.update(jnp.zeros((0,)))
+    mn.update(jnp.asarray([-2.0]))
+    assert float(mn.compute()) == -2.0

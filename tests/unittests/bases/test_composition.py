@@ -1,0 +1,92 @@
+"""Compositional (operator) metric tests (reference ``tests/unittests/bases/test_composition.py``)."""
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.metric import CompositionalMetric
+
+
+class Summer(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"x": state["x"] + jnp.sum(x)}
+
+    def _compute(self, state):
+        return state["x"]
+
+
+@pytest.mark.parametrize(
+    ("op", "expected"),
+    [
+        (lambda a, b: a + b, 5.0),
+        (lambda a, b: a - b, 1.0),
+        (lambda a, b: a * b, 6.0),
+        (lambda a, b: a / b, 1.5),
+        (lambda a, b: a**b, 9.0),
+        (lambda a, b: a % b, 1.0),
+        (lambda a, b: a // b, 1.0),
+    ],
+)
+def test_metric_metric_ops(op, expected):
+    a, b = Summer(), Summer()
+    comp = op(a, b)
+    assert isinstance(comp, CompositionalMetric)
+    a.update(jnp.asarray(3.0))
+    b.update(jnp.asarray(2.0))
+    assert abs(float(comp.compute()) - expected) < 1e-4
+
+
+def test_metric_scalar_ops():
+    a = Summer()
+    comp = a + 10.0
+    a.update(jnp.asarray(5.0))
+    assert float(comp.compute()) == 15.0
+    comp2 = 2.0 * a
+    assert float(comp2.compute()) == 10.0
+
+
+def test_comparison_ops():
+    a, b = Summer(), Summer()
+    a.update(jnp.asarray(3.0))
+    b.update(jnp.asarray(2.0))
+    assert bool((a > b).compute())
+    assert not bool((a < b).compute())
+    assert not bool((a == b).compute())
+
+
+def test_unary_ops():
+    a = Summer()
+    a.update(jnp.asarray(-3.0))
+    assert float(abs(a).compute()) == 3.0
+    assert float((-a).compute()) == -3.0
+    assert float((+a).compute()) == 3.0
+
+
+def test_getitem():
+    class Vec(Summer):
+        def _update(self, state, x):
+            return {"x": state["x"] + x}
+
+        def __init__(self, **kw):
+            super(Summer, self).__init__(**kw)
+            self.add_state("x", jnp.zeros(3), dist_reduce_fx="sum")
+
+    v = Vec()
+    comp = v[1]
+    v.update(jnp.asarray([1.0, 2.0, 3.0]))
+    assert float(comp.compute()) == 2.0
+
+
+def test_compositional_update_and_forward():
+    a, b = Summer(), Summer()
+    comp = a + b
+    comp.update(jnp.asarray(1.0))  # updates both operands
+    assert float(comp.compute()) == 2.0
+    val = comp(jnp.asarray(2.0))
+    assert float(val) == 4.0  # forward composes the operands' batch-local values
+    assert float(comp.compute()) == 6.0  # accumulated state composes to 3 + 3
+    comp.reset()
+    assert float(a.x) == 0.0

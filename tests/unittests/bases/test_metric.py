@@ -1,0 +1,180 @@
+"""Core engine lifecycle tests (reference ``tests/unittests/bases/test_metric.py``)."""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+class DummyMetric(Metric):
+    """Accumulates a sum (reference DummyMetricSum, testers.py:560-634)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"x": state["x"] + jnp.sum(x)}
+
+    def _compute(self, state):
+        return state["x"]
+
+
+class DummyListMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def _update(self, state, x):
+        return {"x": x}
+
+    def _compute(self, state):
+        x = state["x"]
+        return jnp.sum(x) if not isinstance(x, list) else jnp.zeros(())
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable"):
+        m.add_state("bad", jnp.zeros(()), dist_reduce_fx="xyz")
+    with pytest.raises(ValueError, match="state variable must be"):
+        m.add_state("bad", [1, 2], dist_reduce_fx="cat")
+
+
+def test_update_compute_reset():
+    m = DummyMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    assert m.update_count == 2
+    assert float(m.compute()) == 6.0
+    m.reset()
+    assert m.update_count == 0
+    assert float(m.x) == 0.0
+
+
+def test_compute_cache():
+    m = DummyMetric()
+    m.update(jnp.asarray(1.0))
+    v1 = m.compute()
+    # mutate state without update: cache should still be returned
+    assert m.compute() is v1
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 2.0
+
+    m_nc = DummyMetric(compute_with_cache=False)
+    m_nc.update(jnp.asarray(1.0))
+    assert float(m_nc.compute()) == 1.0
+    assert m_nc._computed is None
+
+
+def test_forward_returns_batch_value():
+    m = DummyMetric()
+    assert float(m(jnp.asarray([1.0, 2.0]))) == 3.0
+    assert float(m(jnp.asarray([5.0]))) == 5.0
+    assert float(m.compute()) == 8.0
+
+
+def test_forward_full_state_update_path():
+    class FullState(DummyMetric):
+        full_state_update = True
+
+    m = FullState()
+    assert float(m(jnp.asarray(2.0))) == 2.0
+    assert float(m(jnp.asarray(3.0))) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_list_state_forward_and_compute():
+    m = DummyListMetric()
+    assert float(m(jnp.asarray([1.0, 2.0]))) == 3.0
+    m.update(jnp.asarray([4.0]))
+    assert float(m.compute()) == 7.0
+    m.reset()
+    assert m.x == []
+
+
+def test_compute_before_update_warns():
+    m = DummyMetric()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        m.compute()
+
+
+def test_sync_context_errors():
+    m = DummyMetric()
+    m.update(jnp.asarray(1.0))
+    with pytest.raises(TorchMetricsUserError, match="has already been un-synced"):
+        m.unsync()
+    m.sync(dist_sync_fn=lambda v, g: [v, v], distributed_available=lambda: True)
+    assert float(m.x) == 2.0  # sum-reduced over fake world of 2
+    with pytest.raises(TorchMetricsUserError, match="already been synced"):
+        m.sync(dist_sync_fn=lambda v, g: [v, v], distributed_available=lambda: True)
+    with pytest.raises(TorchMetricsUserError):
+        m.forward(jnp.asarray(1.0))
+    m.unsync()
+    assert float(m.x) == 1.0
+
+
+def test_state_dict_persistence():
+    m = DummyMetric()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(jnp.asarray(3.0))
+    sd = m.state_dict()
+    assert float(sd["x"]) == 3.0
+    m2 = DummyMetric()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 3.0
+
+
+def test_pickle_roundtrip():
+    m = DummyMetric()
+    m.update(jnp.asarray(2.5))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 2.5
+
+
+def test_set_dtype():
+    m = DummyMetric()
+    m.update(jnp.asarray(1.0))
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+    # float()/half()/double() are deliberate no-ops
+    m.float()
+    assert m.x.dtype == jnp.bfloat16
+
+
+def test_metric_state_property():
+    m = DummyMetric()
+    m.update(jnp.asarray(4.0))
+    assert float(m.metric_state["x"]) == 4.0
+
+
+def test_hashable_and_repr():
+    m = DummyMetric()
+    assert isinstance(hash(m), int)
+    assert "DummyMetric" in repr(m)
+
+
+def test_filter_kwargs():
+    class KwMetric(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, preds, target):
+            return {"x": state["x"] + jnp.sum(preds) + jnp.sum(target)}
+
+        def _compute(self, state):
+            return state["x"]
+
+    m = KwMetric()
+    filtered = m._filter_kwargs(preds=1, target=2, other=3)
+    assert set(filtered) == {"preds", "target"}

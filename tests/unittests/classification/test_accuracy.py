@@ -1,0 +1,112 @@
+"""Accuracy vs sklearn (reference ``tests/unittests/classification/test_accuracy.py``)."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from tests.unittests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+)
+
+NB, BS, C, L = 4, 64, 5, 4
+rng = np.random.RandomState(42)
+BIN_PREDS = rng.rand(NB, BS).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NB, BS))
+MC_LOGITS = rng.randn(NB, BS, C).astype(np.float32)
+MC_TARGET = rng.randint(0, C, (NB, BS))
+ML_PREDS = rng.rand(NB, BS, L).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NB, BS, L))
+
+
+def _sk_binary(preds, target):
+    return skm.accuracy_score(target, (preds > 0.5).astype(int))
+
+
+class TestBinaryAccuracy(MetricTester):
+    def test_class(self):
+        self.run_class_metric_test(BIN_PREDS, BIN_TARGET, BinaryAccuracy, _sk_binary)
+
+    def test_functional(self):
+        self.run_functional_metric_test(BIN_PREDS, BIN_TARGET, binary_accuracy, _sk_binary)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestMulticlassAccuracy(MetricTester):
+    def _ref(self, average):
+        def _sk(preds, target):
+            labels = preds.argmax(-1)
+            if average == "micro":
+                return skm.accuracy_score(target, labels)
+            return skm.recall_score(target, labels, average=average, zero_division=0)
+
+        return _sk
+
+    def test_class(self, average):
+        self.run_class_metric_test(
+            MC_LOGITS, MC_TARGET, MulticlassAccuracy, self._ref(average),
+            metric_args={"num_classes": C, "average": average},
+        )
+
+    def test_functional(self, average):
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, multiclass_accuracy, self._ref(average),
+            metric_args={"num_classes": C, "average": average},
+        )
+
+
+def test_multiclass_topk():
+    from sklearn.metrics import top_k_accuracy_score
+
+    res = multiclass_accuracy(MC_LOGITS[0], MC_TARGET[0], C, average="micro", top_k=2)
+    ref = top_k_accuracy_score(MC_TARGET[0], MC_LOGITS[0], k=2)
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+def test_ignore_index():
+    target = MC_TARGET[0].copy()
+    target[:10] = -1
+    keep = target != -1
+    res = multiclass_accuracy(MC_LOGITS[0], target, C, average="micro", ignore_index=-1)
+    ref = skm.accuracy_score(MC_TARGET[0][keep], MC_LOGITS[0].argmax(-1)[keep])
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_multilabel_accuracy(average):
+    def _sk(preds, target):
+        labels = (preds > 0.5).astype(int)
+        if average == "micro":
+            return ((labels == target).sum()) / target.size
+        per_label = (labels == target).mean(0)
+        if average == "macro":
+            return per_label.mean()
+        weights = target.sum(0)
+        return (per_label * weights).sum() / weights.sum()
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        ML_PREDS, ML_TARGET, MultilabelAccuracy, _sk,
+        metric_args={"num_labels": L, "average": average},
+    )
+    tester.run_functional_metric_test(
+        ML_PREDS, ML_TARGET, multilabel_accuracy, _sk,
+        metric_args={"num_labels": L, "average": average},
+    )
+
+
+def test_samplewise_multidim():
+    preds = rng.randn(2, 16, C, 7).astype(np.float32)
+    target = rng.randint(0, C, (2, 16, 7))
+    m = MulticlassAccuracy(num_classes=C, average="micro", multidim_average="samplewise")
+    for i in range(2):
+        m.update(preds[i], target[i])
+    res = np.asarray(m.compute())
+    assert res.shape == (32,)
+    ref = np.stack([
+        skm.accuracy_score(target.reshape(-1, 7)[i], preds.reshape(-1, C, 7)[i].argmax(0))
+        for i in range(32)
+    ])
+    np.testing.assert_allclose(res, ref, atol=1e-6)

@@ -1,0 +1,189 @@
+"""Precision/Recall/F1/Specificity/Hamming/Jaccard/Kappa/MCC/ConfusionMatrix/StatScores/ExactMatch
+vs sklearn (reference ``tests/unittests/classification/test_{precision_recall,f_beta,...}.py``)."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from tests.unittests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryHammingDistance,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    BinaryStatScores,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassExactMatch,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MulticlassMatthewsCorrCoef,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassStatScores,
+    MultilabelConfusionMatrix,
+    MultilabelExactMatch,
+    MultilabelF1Score,
+    MultilabelMatthewsCorrCoef,
+    MultilabelPrecision,
+    MultilabelRecall,
+)
+
+NB, BS, C, L = 4, 64, 5, 4
+rng = np.random.RandomState(123)
+BIN_PREDS = rng.rand(NB, BS).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NB, BS))
+MC_LOGITS = rng.randn(NB, BS, C).astype(np.float32)
+MC_TARGET = rng.randint(0, C, (NB, BS))
+ML_PREDS = rng.rand(NB, BS, L).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NB, BS, L))
+
+
+def bl(p):
+    return (p > 0.5).astype(int)
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_fn"),
+    [
+        (BinaryPrecision, lambda p, t: skm.precision_score(t, bl(p), zero_division=0)),
+        (BinaryRecall, lambda p, t: skm.recall_score(t, bl(p), zero_division=0)),
+        (BinaryF1Score, lambda p, t: skm.f1_score(t, bl(p), zero_division=0)),
+        (BinarySpecificity, lambda p, t: skm.recall_score(1 - t, 1 - bl(p), zero_division=0)),
+        (BinaryHammingDistance, lambda p, t: 1 - skm.accuracy_score(t, bl(p))),
+        (BinaryJaccardIndex, lambda p, t: skm.jaccard_score(t, bl(p))),
+        (BinaryMatthewsCorrCoef, lambda p, t: skm.matthews_corrcoef(t, bl(p))),
+        (BinaryCohenKappa, lambda p, t: skm.cohen_kappa_score(t, bl(p))),
+        (BinaryConfusionMatrix, lambda p, t: skm.confusion_matrix(t, bl(p))),
+    ],
+)
+def test_binary_metrics(metric_cls, sk_fn):
+    MetricTester().run_class_metric_test(BIN_PREDS, BIN_TARGET, metric_cls, sk_fn)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_name"),
+    [
+        (MulticlassPrecision, "precision_score"),
+        (MulticlassRecall, "recall_score"),
+        (MulticlassF1Score, "f1_score"),
+    ],
+)
+def test_multiclass_prf(metric_cls, sk_name, average):
+    def _sk(preds, target):
+        return getattr(skm, sk_name)(target, preds.argmax(-1), average=average, zero_division=0,
+                                     labels=list(range(C)))
+
+    MetricTester().run_class_metric_test(
+        MC_LOGITS, MC_TARGET, metric_cls, _sk, metric_args={"num_classes": C, "average": average}
+    )
+
+
+def test_multiclass_fbeta():
+    def _sk(preds, target):
+        return skm.fbeta_score(target, preds.argmax(-1), beta=2.0, average="macro", zero_division=0)
+
+    MetricTester().run_class_metric_test(
+        MC_LOGITS, MC_TARGET, MulticlassFBetaScore, _sk,
+        metric_args={"beta": 2.0, "num_classes": C, "average": "macro"},
+    )
+
+
+def test_multiclass_confmat_kappa_mcc():
+    t = MetricTester()
+    t.run_class_metric_test(
+        MC_LOGITS, MC_TARGET, MulticlassConfusionMatrix,
+        lambda p, tt: skm.confusion_matrix(tt, p.argmax(-1), labels=list(range(C))),
+        metric_args={"num_classes": C},
+    )
+    t.run_class_metric_test(
+        MC_LOGITS, MC_TARGET, MulticlassCohenKappa,
+        lambda p, tt: skm.cohen_kappa_score(tt, p.argmax(-1)),
+        metric_args={"num_classes": C},
+    )
+    t.run_class_metric_test(
+        MC_LOGITS, MC_TARGET, MulticlassMatthewsCorrCoef,
+        lambda p, tt: skm.matthews_corrcoef(tt, p.argmax(-1)),
+        metric_args={"num_classes": C},
+    )
+
+
+def test_multiclass_cohen_kappa_weighted():
+    from torchmetrics_tpu.functional.classification import multiclass_cohen_kappa
+
+    for weights in ("linear", "quadratic"):
+        res = multiclass_cohen_kappa(MC_LOGITS[0], MC_TARGET[0], C, weights=weights)
+        ref = skm.cohen_kappa_score(MC_TARGET[0], MC_LOGITS[0].argmax(-1), weights=weights)
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_name"),
+    [
+        (MultilabelPrecision, "precision_score"),
+        (MultilabelRecall, "recall_score"),
+        (MultilabelF1Score, "f1_score"),
+    ],
+)
+def test_multilabel_prf(metric_cls, sk_name, average):
+    def _sk(preds, target):
+        return getattr(skm, sk_name)(target, bl(preds), average=average, zero_division=0)
+
+    MetricTester().run_class_metric_test(
+        ML_PREDS, ML_TARGET, metric_cls, _sk, metric_args={"num_labels": L, "average": average}
+    )
+
+
+def test_multilabel_confmat_mcc():
+    t = MetricTester()
+    t.run_class_metric_test(
+        ML_PREDS, ML_TARGET, MultilabelConfusionMatrix,
+        lambda p, tt: skm.multilabel_confusion_matrix(tt, bl(p)),
+        metric_args={"num_labels": L},
+    )
+    t.run_class_metric_test(
+        ML_PREDS, ML_TARGET, MultilabelMatthewsCorrCoef,
+        lambda p, tt: skm.matthews_corrcoef(tt.ravel(), bl(p).ravel()),
+        metric_args={"num_labels": L},
+    )
+
+
+def test_binary_stat_scores_output():
+    m = BinaryStatScores()
+    m.update(BIN_PREDS[0], BIN_TARGET[0])
+    tp, fp, tn, fn, sup = np.asarray(m.compute())
+    cm = skm.confusion_matrix(BIN_TARGET[0], bl(BIN_PREDS[0]))
+    assert (tn, fp, fn, tp) == tuple(cm.ravel())
+    assert sup == tp + fn
+
+
+def test_multiclass_stat_scores_output():
+    m = MulticlassStatScores(num_classes=C, average=None)
+    m.update(MC_LOGITS[0], MC_TARGET[0])
+    res = np.asarray(m.compute())
+    assert res.shape == (C, 5)
+    cm = skm.confusion_matrix(MC_TARGET[0], MC_LOGITS[0].argmax(-1), labels=list(range(C)))
+    np.testing.assert_array_equal(res[:, 0], np.diag(cm))  # tp
+    np.testing.assert_array_equal(res[:, 4], cm.sum(1))  # support
+
+
+def test_exact_match():
+    preds = rng.randint(0, C, (2, 16, 7))
+    target = rng.randint(0, C, (2, 16, 7))
+    m = MulticlassExactMatch(num_classes=C)
+    for i in range(2):
+        m.update(preds[i], target[i])
+    ref = np.all(preds.reshape(-1, 7) == target.reshape(-1, 7), axis=1).mean()
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-6)
+
+    ml = MultilabelExactMatch(num_labels=L)
+    for i in range(2):
+        ml.update(ML_PREDS[i], ML_TARGET[i])
+    ref = np.all(bl(ML_PREDS[:2]).reshape(-1, L) == ML_TARGET[:2].reshape(-1, L), axis=1).mean()
+    np.testing.assert_allclose(np.asarray(ml.compute()), ref, atol=1e-6)

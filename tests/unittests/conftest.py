@@ -1,0 +1,47 @@
+"""Test configuration.
+
+Mirrors the reference's "multi-node without a cluster" trick (SURVEY §4): instead of a 2-process
+gloo pool, we fake an 8-device mesh on one host via XLA's host-platform device-count flag and run
+all sharding/collective tests over it with ``shard_map``.
+"""
+import os
+
+# Must be set before jax initialises. Tests always run on the virtual 8-device CPU mesh
+# (overriding any axon/TPU platform selection) so sharding paths are exercised without 8 chips.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# jax may already be imported by a pytest plugin, in which case it cached JAX_PLATFORMS at import
+# time — override through the config API (backend itself is still uninitialised at this point).
+jax.config.update("jax_platforms", "cpu")
+
+NUM_DEVICES = 8
+BATCH_SIZE = 32
+NUM_BATCHES = 8  # divisible by NUM_DEVICES for sharded tests
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def seed_all(seed: int = 42):
+    import random
+
+    import numpy as np
+
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    seed_all(42)
+    yield
+
+
+def use_deterministic_algorithms():  # parity shim with reference conftest
+    pass

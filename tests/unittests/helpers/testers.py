@@ -1,0 +1,114 @@
+"""MetricTester harness.
+
+Mirrors the reference contract (``tests/unittests/helpers/testers.py:74-226``): class metric is
+exercised per-batch via ``forward`` (checked against the reference fn on the batch), then
+``compute()`` is checked against the reference fn on ALL concatenated inputs; plus clone /
+pickle / reset checks. The reference's 2-process gloo DDP test becomes an N-shard emulated sync:
+the same batches are strided across virtual replicas, per-replica metrics are synced with an
+injected gather fn, and the result must equal the reference on the full data.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+ATOL = 1e-6
+
+
+def _assert_allclose(res: Any, ref: Any, atol: float = ATOL, key: Optional[str] = None) -> None:
+    if isinstance(res, dict):
+        res = res[key] if key is not None else list(res.values())[0]
+    np.testing.assert_allclose(np.asarray(res), np.asarray(ref), atol=atol, rtol=1e-5)
+
+
+class MetricTester:
+    atol = ATOL
+
+    def run_functional_metric_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        n_batches = preds.shape[0]
+        for i in range(min(n_batches, 2)):
+            res = metric_functional(jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args)
+            ref = reference_metric(preds[i], target[i])
+            _assert_allclose(res, ref, atol=atol)
+
+    def run_class_metric_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        check_batch: bool = True,
+        atol: Optional[float] = None,
+        num_shards: int = 2,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        n_batches = preds.shape[0]
+
+        # --- single-replica lifecycle: forward per batch, compute on everything
+        metric = metric_class(**metric_args)
+        pickle.loads(pickle.dumps(metric))  # fresh-metric picklability
+        for i in range(n_batches):
+            batch_val = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            if check_batch:
+                ref = reference_metric(preds[i], target[i])
+                _assert_allclose(batch_val, ref, atol=atol)
+        total_ref = reference_metric(
+            preds.reshape(-1, *preds.shape[2:]), target.reshape(-1, *target.shape[2:])
+        )
+        _assert_allclose(metric.compute(), total_ref, atol=atol)
+
+        # --- clone & pickle round-trip preserve state
+        _assert_allclose(metric.clone().compute(), total_ref, atol=atol)
+        _assert_allclose(pickle.loads(pickle.dumps(metric)).compute(), total_ref, atol=atol)
+
+        # --- reset restores defaults
+        metric.reset()
+        assert metric.update_count == 0
+
+        # --- emulated multi-replica sync (reference: testers.py:157-175 with gloo pool)
+        if num_shards > 1 and n_batches % num_shards == 0:
+            replicas = [metric_class(**metric_args) for _ in range(num_shards)]
+            for r, rep in enumerate(replicas):
+                for i in range(r, n_batches, num_shards):
+                    rep.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            synced = _sync_replicas(replicas)
+            _assert_allclose(synced, total_ref, atol=atol)
+
+
+def _sync_replicas(replicas: Sequence) -> Any:
+    """Emulate a world of len(replicas) processes: each replica's compute() syncs against the rest."""
+    states = [rep._state.snapshot() for rep in replicas]
+
+    def fake_gather(value, group=None):
+        # identify which state entry this value belongs to by matching identity on replica 0
+        for name, v in states[0].items():
+            if isinstance(v, list):
+                cat0 = jnp.concatenate([jnp.atleast_1d(e) for e in v], axis=0) if v else None
+                if cat0 is not None and value.shape == cat0.shape and bool(jnp.all(value == cat0)):
+                    return [
+                        jnp.concatenate([jnp.atleast_1d(e) for e in s[name]], axis=0) for s in states
+                    ]
+            else:
+                if value.shape == jnp.shape(v) and bool(jnp.all(value == v)):
+                    return [s[name] for s in states]
+        raise AssertionError("state not found during fake gather")
+
+    rep0 = replicas[0]
+    rep0.dist_sync_fn = fake_gather
+    rep0.distributed_available_fn = lambda: True
+    return rep0.compute()

@@ -1,0 +1,25 @@
+"""torchmetrics_tpu: a TPU-native (JAX/XLA/Pallas) metrics framework.
+
+Re-design of TorchMetrics (reference: oguz-hanoglu/torchmetrics) for TPU hardware: metric state
+lives as pytrees of ``jax.Array`` in HBM, updates/computes are jit-compiled XLA kernels, and
+distributed sync is mesh collectives over ICI/DCN. See SURVEY.md for the blueprint.
+"""
+from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from torchmetrics_tpu.metric import Metric
+
+__all__ = [
+    "__version__",
+    "Metric",
+    "CatMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "MinMetric",
+    "SumMetric",
+]

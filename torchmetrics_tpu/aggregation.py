@@ -1,0 +1,167 @@
+"""Aggregation metrics: Max/Min/Sum/Mean/Cat (+ Running variants in ``wrappers.running``).
+
+Parity: reference ``src/torchmetrics/aggregation.py`` (``BaseAggregator:30``, ``MaxMetric:114``,
+``MinMetric:219``, ``SumMetric:324``, ``CatMetric:429``, ``MeanMetric:493``, ``RunningMean:616``,
+``RunningSum:673``).
+
+TPU-first: the reference's ``'ignore'`` NaN strategy drops elements (``aggregation.py:75-104``) —
+a dynamic-shape op. Here NaN handling is mask-and-weight inside the jitted kernel (ignored values
+contribute identity elements: 0 to sums, ±inf to min/max), which XLA fuses into the reduction.
+``'error'``/``'warn'`` are host-side checks that no-op under trace.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.checks import is_traced
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (reference ``aggregation.py:30``)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str, None],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    def _validate(self, *args: Any, **kwargs: Any) -> None:
+        if self.nan_strategy not in ("error", "warn"):
+            return
+        for x in list(args) + list(kwargs.values()):
+            if x is None or is_traced(x):
+                continue
+            if np.isnan(np.asarray(x, dtype=np.float32)).any():
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+
+    def _nan_mask_and_fill(self, x: Array, fill: float) -> Array:
+        """Replace NaNs by ``fill`` ('ignore'/'warn' → identity element, float strategy → impute)."""
+        x = jnp.asarray(x, jnp.float32)
+        if isinstance(self.nan_strategy, float):
+            return jnp.nan_to_num(x, nan=self.nan_strategy)
+        return jnp.nan_to_num(x, nan=fill)
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        return state[self.state_name]
+
+    def compute(self) -> Array:
+        return super().compute()
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum of a stream of values (reference ``aggregation.py:114``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, jnp.float32), nan_strategy, state_name="max_value", **kwargs)
+
+    def _update(self, state: Dict[str, Array], value: Array) -> Dict[str, Array]:
+        if value.size == 0:  # empty update is a no-op (shape is static, safe under trace)
+            return {"max_value": state["max_value"]}
+        v = self._nan_mask_and_fill(value, -jnp.inf)
+        return {"max_value": jnp.maximum(state["max_value"], jnp.max(v))}
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum of a stream of values (reference ``aggregation.py:219``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, jnp.float32), nan_strategy, state_name="min_value", **kwargs)
+
+    def _update(self, state: Dict[str, Array], value: Array) -> Dict[str, Array]:
+        if value.size == 0:  # empty update is a no-op
+            return {"min_value": state["min_value"]}
+        v = self._nan_mask_and_fill(value, jnp.inf)
+        return {"min_value": jnp.minimum(state["min_value"], jnp.min(v))}
+
+
+class SumMetric(BaseAggregator):
+    """Running sum of a stream of values (reference ``aggregation.py:324``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+
+    def _update(self, state: Dict[str, Array], value: Array) -> Dict[str, Array]:
+        v = self._nan_mask_and_fill(value, 0.0)
+        return {"sum_value": state["sum_value"] + jnp.sum(v)}
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate a stream of values (reference ``aggregation.py:429``)."""
+
+    # NaN filtering changes the output shape, so the update must stay on the host
+    jit_update = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, state_name="value", **kwargs)
+
+    def _update(self, state: Dict[str, Array], value: Array) -> Dict[str, Array]:
+        v = self._nan_mask_and_fill(value, jnp.nan)
+        if self.nan_strategy in ("ignore", "warn"):
+            # dynamic filter — host-side only (list states are host-mediated anyway)
+            if not is_traced(v):
+                vn = np.asarray(v, np.float32).reshape(-1)
+                v = jnp.asarray(vn[~np.isnan(vn)])
+        return {"value": jnp.atleast_1d(v)}
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        val = state["value"]
+        if isinstance(val, list):
+            return dim_zero_cat(val) if val else jnp.zeros((0,))
+        return val
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean of a stream of values (reference ``aggregation.py:493``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state: Dict[str, Array], value: Array, weight: Optional[Array] = None) -> Dict[str, Array]:
+        value = jnp.asarray(value, jnp.float32)
+        if weight is None:
+            weight = jnp.ones_like(value)
+        weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), value.shape)
+        nan_mask = jnp.isnan(value) | jnp.isnan(weight)
+        if isinstance(self.nan_strategy, float):
+            value = jnp.where(nan_mask, self.nan_strategy, value)
+            weight = jnp.where(nan_mask, self.nan_strategy, weight)
+        else:  # ignore/warn: zero weight for nan entries
+            value = jnp.where(nan_mask, 0.0, value)
+            weight = jnp.where(nan_mask, 0.0, weight)
+        return {
+            "mean_value": state["mean_value"] + jnp.sum(value * weight),
+            "weight": state["weight"] + jnp.sum(weight),
+        }
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        return state["mean_value"] / jnp.maximum(state["weight"], 1e-38)

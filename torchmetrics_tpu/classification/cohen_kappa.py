@@ -1,0 +1,80 @@
+"""Cohen's kappa metrics (reference ``src/torchmetrics/classification/cohen_kappa.py:35,159,287``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce, _validate_weights
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Reference ``cohen_kappa.py:35``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold, ignore_index, normalize=None, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_weights(weights)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _cohen_kappa_reduce(state["confmat"], self.weights)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.metric import Metric
+
+        return Metric.plot(self, val, ax)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Reference ``cohen_kappa.py:159``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, ignore_index: Optional[int] = None,
+                 weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, ignore_index, normalize=None, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_weights(weights)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _cohen_kappa_reduce(state["confmat"], self.weights)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.metric import Metric
+
+        return Metric.plot(self, val, ax)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``cohen_kappa.py:287``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+        weights: Optional[str] = None, ignore_index: Optional[int] = None,
+        validate_args: bool = True, **kwargs: Any,
+    ):
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCohenKappa(num_classes, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,109 @@
+"""Jaccard index metrics (reference ``src/torchmetrics/classification/jaccard.py:39,152,282,417``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from torchmetrics_tpu.functional.classification.jaccard import _jaccard_index_reduce
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryJaccardIndex(BinaryConfusionMatrix):
+    """Reference ``jaccard.py:39``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, ignore_index=ignore_index, normalize=None,
+                         validate_args=validate_args, **kwargs)
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(state["confmat"], average="binary")
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.metric import Metric
+
+        return Metric.plot(self, val, ax)
+
+
+class MulticlassJaccardIndex(MulticlassConfusionMatrix):
+    """Reference ``jaccard.py:152``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, ignore_index=ignore_index, normalize=None,
+                         validate_args=validate_args, **kwargs)
+        self.average = average
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(state["confmat"], average=self.average, ignore_index=self.ignore_index)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.metric import Metric
+
+        return Metric.plot(self, val, ax)
+
+
+class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    """Reference ``jaccard.py:282``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, threshold=threshold, ignore_index=ignore_index,
+                         normalize=None, validate_args=validate_args, **kwargs)
+        self.average = average
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(state["confmat"], average=self.average)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.metric import Metric
+
+        return Metric.plot(self, val, ax)
+
+
+class JaccardIndex(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``jaccard.py:417``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None, average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryJaccardIndex(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassJaccardIndex(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

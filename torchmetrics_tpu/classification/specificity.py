@@ -1,0 +1,78 @@
+"""Specificity metrics (reference ``src/torchmetrics/classification/specificity.py:31,148,299,447``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.functional.classification.specificity import _specificity_reduce
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinarySpecificity(BinaryStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return _specificity_reduce(state["tp"], state["fp"], state["tn"], state["fn"],
+                                   average="binary", multidim_average=self.multidim_average)
+
+
+class MulticlassSpecificity(MulticlassStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def _compute(self, state):
+        return _specificity_reduce(state["tp"], state["fp"], state["tn"], state["fn"],
+                                   average=self.average, multidim_average=self.multidim_average, top_k=self.top_k)
+
+
+class MultilabelSpecificity(MultilabelStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def _compute(self, state):
+        return _specificity_reduce(state["tp"], state["fp"], state["tn"], state["fn"],
+                                   average=self.average, multidim_average=self.multidim_average, multilabel=True)
+
+
+class Specificity(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``specificity.py:447``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
+        top_k: Optional[int] = 1, ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args
+        })
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificity(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return MulticlassSpecificity(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificity(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

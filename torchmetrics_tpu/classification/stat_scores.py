@@ -1,0 +1,227 @@
+"""Stateful stat-scores metrics (reference ``src/torchmetrics/classification/stat_scores.py``:
+``_AbstractStatScores:40``, ``BinaryStatScores:91``, ``MulticlassStatScores:195``,
+``MultilabelStatScores:346``, task wrapper ``StatScores:491``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_compute,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_compute,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_compute,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class _AbstractStatScores(Metric):
+    """Shared state layout: tensor sum-states for global, cat list-states for samplewise
+    (reference ``stat_scores.py:50-88``)."""
+
+    def _create_state(self, size: int, multidim_average: str = "global") -> None:
+        if multidim_average == "samplewise":
+            default: Any = []
+            reduce_fx = "cat"
+        else:
+            default = jnp.zeros(size, jnp.float32) if size > 1 else jnp.zeros((), jnp.float32)
+            reduce_fx = "sum"
+        self.add_state("tp", deepcopy_default(default), dist_reduce_fx=reduce_fx)
+        self.add_state("fp", deepcopy_default(default), dist_reduce_fx=reduce_fx)
+        self.add_state("tn", deepcopy_default(default), dist_reduce_fx=reduce_fx)
+        self.add_state("fn", deepcopy_default(default), dist_reduce_fx=reduce_fx)
+
+    def _merge_counts(self, state: Dict[str, Array], tp, fp, tn, fn) -> Dict[str, Array]:
+        if self.multidim_average == "samplewise":
+            return {"tp": tp, "fp": fp, "tn": tn, "fn": fn}  # appended to list states
+        return {
+            "tp": state["tp"] + tp,
+            "fp": state["fp"] + fp,
+            "tn": state["tn"] + tn,
+            "fn": state["fn"] + fn,
+        }
+
+
+def deepcopy_default(default):
+    return list(default) if isinstance(default, list) else default
+
+
+class BinaryStatScores(_AbstractStatScores):
+    """Reference ``classification/stat_scores.py:91``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(size=1, multidim_average=multidim_average)
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, self.multidim_average, self.ignore_index)
+
+    def _update(self, state, preds, target):
+        preds, target, mask = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, self.multidim_average)
+        return self._merge_counts(state, tp, fp, tn, fn)
+
+    def _compute(self, state):
+        return _binary_stat_scores_compute(state["tp"], state["fp"], state["tn"], state["fn"], self.multidim_average)
+
+
+class MulticlassStatScores(_AbstractStatScores):
+    """Reference ``classification/stat_scores.py:195``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        top_k: int = 1,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        self.num_classes = num_classes
+        self.top_k = top_k
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(size=num_classes, multidim_average=multidim_average)
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(
+                preds, target, self.num_classes, self.multidim_average, self.ignore_index, self.top_k
+            )
+
+    def _update(self, state, preds, target):
+        preds, target = _multiclass_stat_scores_format(preds, target, self.top_k)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(
+            preds, target, self.num_classes, self.top_k, self.multidim_average, self.ignore_index
+        )
+        return self._merge_counts(state, tp, fp, tn, fn)
+
+    def _compute(self, state):
+        return _multiclass_stat_scores_compute(
+            state["tp"], state["fp"], state["tn"], state["fn"], self.average, self.multidim_average
+        )
+
+
+class MultilabelStatScores(_AbstractStatScores):
+    """Reference ``classification/stat_scores.py:346``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(size=num_labels, multidim_average=multidim_average)
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(
+                preds, target, self.num_labels, self.multidim_average, self.ignore_index
+            )
+
+    def _update(self, state, preds, target):
+        preds, target, mask = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, self.multidim_average)
+        return self._merge_counts(state, tp, fp, tn, fn)
+
+    def _compute(self, state):
+        return _multilabel_stat_scores_compute(
+            state["tp"], state["fp"], state["tn"], state["fn"], self.average, self.multidim_average
+        )
+
+
+class StatScores(_ClassificationTaskWrapper):
+    """Task dispatcher: ``StatScores(task="binary"|...)`` (reference ``stat_scores.py:491``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args
+        })
+        if task == ClassificationTask.BINARY:
+            return BinaryStatScores(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return MulticlassStatScores(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelStatScores(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

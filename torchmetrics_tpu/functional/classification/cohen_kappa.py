@@ -1,0 +1,77 @@
+"""Cohen's kappa kernels (reference
+``src/torchmetrics/functional/classification/cohen_kappa.py``: ``_cohen_kappa_reduce:33``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_arg_validation,
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    confmat = confmat.astype(jnp.float32)
+    num_classes = confmat.shape[0]
+    sum0 = jnp.sum(confmat, axis=0, keepdims=True)
+    sum1 = jnp.sum(confmat, axis=1, keepdims=True)
+    expected = sum1 @ sum0 / jnp.sum(sum0)
+
+    if weights is None or weights == "none":
+        w_mat = 1.0 - jnp.eye(num_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        idx = jnp.arange(num_classes, dtype=confmat.dtype)
+        diff = idx[:, None] - idx[None, :]
+        w_mat = jnp.abs(diff) if weights == "linear" else diff**2
+    else:
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def _validate_weights(weights: Optional[str]) -> None:
+    allowed_weights = ("linear", "quadratic", "none", None)
+    if weights not in allowed_weights:
+        raise ValueError(f"Expected argument `weight` to be one of {allowed_weights}, but got {weights}.")
+
+
+def binary_cohen_kappa(preds, target, threshold: float = 0.5, weights: Optional[str] = None,
+                       ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``cohen_kappa.py:75``."""
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+        _validate_weights(weights)
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def multiclass_cohen_kappa(preds, target, num_classes: int, weights: Optional[str] = None,
+                           ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``cohen_kappa.py:157``."""
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+        _validate_weights(weights)
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def cohen_kappa(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                weights: Optional[str] = None, ignore_index: Optional[int] = None,
+                validate_args: bool = True) -> Array:
+    """Task-dispatching Cohen's kappa (reference ``cohen_kappa.py:250``)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

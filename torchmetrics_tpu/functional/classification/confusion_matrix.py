@@ -1,0 +1,303 @@
+"""Confusion-matrix kernels (reference
+``src/torchmetrics/functional/classification/confusion_matrix.py``).
+
+TPU-first: the (C, C) tally is a weighted one-hot matmul on the MXU
+(``ops.confusion_matrix_update``) instead of the reference's fused-index bincount.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.ops import confusion_matrix_update
+from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
+from torchmetrics_tpu.utils.compute import normalize_logits_if_needed
+from torchmetrics_tpu.utils.enums import ClassificationTask
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalise over true/pred/all (reference ``confusion_matrix.py:35-61``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is None or normalize == "none":
+        return confmat
+    confmat = confmat.astype(jnp.float32)
+    if normalize == "true":
+        cm = confmat / jnp.sum(confmat, axis=-1, keepdims=True)
+    elif normalize == "pred":
+        cm = confmat / jnp.sum(confmat, axis=-2, keepdims=True)
+    else:
+        cm = confmat / jnp.sum(confmat, axis=(-2, -1), keepdims=True)
+    return jnp.nan_to_num(cm, nan=0.0)
+
+
+# --------------------------------------------------------------------- binary
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _binary_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    unique = set(np.unique(t).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    p = np.asarray(preds)
+    if not np.issubdtype(p.dtype, np.floating):
+        uniquep = set(np.unique(p).tolist())
+        if not uniquep.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(uniquep)} but expected only"
+                " binary values since preds is an int tensor."
+            )
+
+
+def _binary_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> tuple:
+    preds = jnp.reshape(jnp.asarray(preds), (-1,))
+    target = jnp.reshape(jnp.asarray(target), (-1,))
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if convert_to_labels:
+            preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    if ignore_index is not None:
+        mask = (target != ignore_index)
+        target = jnp.where(mask, target, -1)  # -1 rows are dropped by the kernel
+    return preds, target.astype(jnp.int32)
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array) -> Array:
+    return confusion_matrix_update(preds, target, 2)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds, target, threshold: float = 0.5, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """(2, 2) confusion matrix (reference ``confusion_matrix.py:156``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multiclass
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                             " (N, C, ...), and the shape of `target` should be (N, ...).")
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape,")
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target`"
+                         " should be (N, ...) and `preds` should be (N, C, ...).")
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    if ignore_index is not None:
+        t = t[t != ignore_index]
+    if t.size and (t.min() < 0 or t.max() >= num_classes):
+        raise RuntimeError(
+            f"Detected more unique values in `target` than expected. Expected only {num_classes} but found"
+            f" values in range [{t.min()}, {t.max()}]."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        p = np.asarray(preds)
+        if p.size and (p.min() < 0 or p.max() >= num_classes):
+            raise RuntimeError(
+                f"Detected more unique values in `preds` than expected. Expected only {num_classes} but found"
+                f" values in range [{p.min()}, {p.max()}]."
+            )
+
+
+def _multiclass_confusion_matrix_format(
+    preds: Array, target: Array, ignore_index: Optional[int] = None, convert_to_labels: bool = True
+) -> tuple:
+    if preds.ndim == target.ndim + 1 and convert_to_labels:
+        preds = jnp.argmax(preds, axis=1)
+    preds = jnp.reshape(preds, (-1,)) if convert_to_labels else jnp.reshape(preds, (-1, preds.shape[1]))
+    target = jnp.reshape(target, (-1,))
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)  # dropped by kernel
+    return preds, target.astype(jnp.int32)
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int) -> Array:
+    return confusion_matrix_update(preds, target, num_classes)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds, target, num_classes: int, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """(C, C) confusion matrix (reference ``confusion_matrix.py:286``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multilabel
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            f"Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    unique = set(np.unique(t).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _multilabel_confusion_matrix_format(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5,
+    ignore_index: Optional[int] = None, should_threshold: bool = True,
+) -> tuple:
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if should_threshold:
+            preds = (preds > threshold).astype(jnp.int32)
+    preds = jnp.moveaxis(jnp.reshape(preds, (preds.shape[0], preds.shape[1], -1)), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(jnp.reshape(target, (target.shape[0], target.shape[1], -1)), 1, -1).reshape(-1, num_labels)
+    if ignore_index is not None:
+        mask = target == ignore_index
+        preds = jnp.where(mask, -1, preds)
+        target = jnp.where(mask, -1, target)
+    return preds.astype(jnp.int32), target.astype(jnp.int32)
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, num_labels: int) -> Array:
+    """(L, 2, 2) per-label confusion matrices — vectorised masked sums, no scatter."""
+    p = preds.astype(jnp.float32)
+    t = target.astype(jnp.float32)
+    valid = ((preds >= 0) & (target >= 0)).astype(jnp.float32)
+    tp = jnp.sum(valid * p * t, axis=0)
+    fp = jnp.sum(valid * p * (1 - t), axis=0)
+    fn = jnp.sum(valid * (1 - p) * t, axis=0)
+    tn = jnp.sum(valid * (1 - p) * (1 - t), axis=0)
+    return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_labels, 2, 2).astype(jnp.int32)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds, target, num_labels: int, threshold: float = 0.5, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """(L, 2, 2) confusion matrices (reference ``confusion_matrix.py:427``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+def confusion_matrix(
+    preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task-dispatching confusion matrix (reference ``confusion_matrix.py:578``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

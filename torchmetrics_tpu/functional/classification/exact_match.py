@@ -1,0 +1,88 @@
+"""Exact-match kernels (reference
+``src/torchmetrics/functional/classification/exact_match.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoBinary
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array, target: Array, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> tuple:
+    """All positions in a sample must match (reference ``exact_match.py:46-77``)."""
+    mask = (target != ignore_index) if ignore_index is not None else jnp.ones(target.shape, bool)
+    match = (preds == target) | ~mask
+    correct_per_sample = jnp.all(match, axis=1).astype(jnp.float32)
+    if multidim_average == "global":
+        return jnp.sum(correct_per_sample), jnp.asarray(correct_per_sample.shape[0], jnp.float32)
+    return correct_per_sample, jnp.ones_like(correct_per_sample)
+
+
+def multiclass_exact_match(preds, target, num_classes: int, multidim_average: str = "global",
+                           ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``exact_match.py:80``."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, 1)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def _multilabel_exact_match_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> tuple:
+    """(N, L, S): all labels must match per (sample, position)."""
+    match = (preds == target) | (mask == 0)
+    correct = jnp.all(match, axis=1).astype(jnp.float32)  # (N, S)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(correct.shape[0] * correct.shape[1], jnp.float32)
+    return jnp.sum(correct, axis=1), jnp.full((correct.shape[0],), correct.shape[1], jnp.float32)
+
+
+def multilabel_exact_match(preds, target, num_labels: int, threshold: float = 0.5,
+                           multidim_average: str = "global", ignore_index: Optional[int] = None,
+                           validate_args: bool = True) -> Array:
+    """Reference ``exact_match.py:224``."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, mask, multidim_average)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(preds, target, task: str, num_classes: Optional[int] = None, num_labels: Optional[int] = None,
+                threshold: float = 0.5, multidim_average: str = "global", ignore_index: Optional[int] = None,
+                validate_args: bool = True) -> Array:
+    """Task-dispatching exact match (reference ``exact_match.py:355``)."""
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average,
+                                      ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

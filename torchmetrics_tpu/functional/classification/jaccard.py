@@ -1,0 +1,96 @@
+"""Jaccard index (IoU) kernels (reference
+``src/torchmetrics/functional/classification/jaccard.py``: ``_jaccard_index_reduce:38``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _jaccard_index_reduce(
+    confmat: Array,
+    average: Optional[str],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    allowed_average = ("binary", "micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    confmat = confmat.astype(jnp.float32)
+    if average == "binary":
+        return confmat[1, 1] / (confmat[0, 1] + confmat[1, 0] + confmat[1, 1])
+
+    ignore_index_cond = ignore_index is not None and 0 <= ignore_index < confmat.shape[0]
+    multilabel = confmat.ndim == 3
+    if multilabel:
+        num = confmat[:, 1, 1]
+        denom = confmat[:, 1, 1] + confmat[:, 0, 1] + confmat[:, 1, 0]
+    else:
+        num = jnp.diagonal(confmat)
+        denom = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - num
+
+    if average == "micro":
+        num_s = jnp.sum(num)
+        denom_s = jnp.sum(denom) - (denom[ignore_index] if ignore_index_cond else 0.0)
+        return _safe_divide(num_s, denom_s)
+
+    jaccard = _safe_divide(num, denom)
+    if average is None or average == "none":
+        return jaccard
+    if average == "weighted":
+        weights = confmat[:, 1, 1] + confmat[:, 1, 0] if multilabel else jnp.sum(confmat, axis=1)
+    else:
+        weights = jnp.ones_like(jaccard)
+        if ignore_index_cond:
+            weights = weights.at[ignore_index].set(0.0)
+        if not multilabel:
+            weights = jnp.where(jnp.sum(confmat, axis=1) + jnp.sum(confmat, axis=0) == 0, 0.0, weights)
+    return jnp.sum(weights * jaccard / jnp.sum(weights))
+
+
+def binary_jaccard_index(preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                         validate_args: bool = True) -> Array:
+    """Reference ``jaccard.py:97``."""
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _jaccard_index_reduce(confmat, average="binary")
+
+
+def multiclass_jaccard_index(preds, target, num_classes: int, average: Optional[str] = "macro",
+                             ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``jaccard.py:152``."""
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _jaccard_index_reduce(confmat, average=average, ignore_index=ignore_index)
+
+
+def multilabel_jaccard_index(preds, target, num_labels: int, threshold: float = 0.5,
+                             average: Optional[str] = "macro", ignore_index: Optional[int] = None,
+                             validate_args: bool = True) -> Array:
+    """Reference ``jaccard.py:217``."""
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, None, ignore_index, validate_args)
+    return _jaccard_index_reduce(confmat, average=average)
+
+
+def jaccard_index(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                  num_labels: Optional[int] = None, average: Optional[str] = "macro",
+                  ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Task-dispatching jaccard index (reference ``jaccard.py:290``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_jaccard_index(preds, target, num_labels, threshold, average, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

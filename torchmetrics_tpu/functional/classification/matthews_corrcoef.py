@@ -1,0 +1,90 @@
+"""Matthews correlation coefficient kernels (reference
+``src/torchmetrics/functional/classification/matthews_corrcoef.py``: ``_matthews_corrcoef_reduce:37``).
+
+The reference's data-dependent edge-case branches become ``jnp.where`` selections so the whole
+reduce stays a single fused XLA computation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    confmat = jnp.sum(confmat, axis=0) if confmat.ndim == 3 else confmat  # multilabel → binary
+    confmat = confmat.astype(jnp.float32)
+
+    tk = jnp.sum(confmat, axis=-1)
+    pk = jnp.sum(confmat, axis=-2)
+    c = jnp.trace(confmat)
+    s = jnp.sum(confmat)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+    denom = cov_ypyp * cov_ytyt
+
+    if confmat.size == 4:  # binary edge cases (reference matthews_corrcoef.py:46-74)
+        tn, fp, fn, tp = jnp.reshape(confmat, (-1,))
+        eps = jnp.asarray(np.finfo(np.float32).eps, jnp.float32)
+        # fallback numerator/denominator when denom == 0
+        a = jnp.where((tp == 0) | (tn == 0), tp + tn, 0.0)
+        b = jnp.where((fp == 0) | (fn == 0), fp + fn, 0.0)
+        fallback_num = jnp.sqrt(eps) * (a - b)
+        fallback_denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+        numerator = jnp.where(denom == 0, fallback_num, cov_ytyp)
+        denominator = jnp.where(denom == 0, fallback_denom, denom)
+        res = numerator / jnp.sqrt(denominator)
+        res = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, res)
+        res = jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, res)
+        return res
+    return jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+
+
+def binary_matthews_corrcoef(preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                             validate_args: bool = True) -> Array:
+    """Reference ``matthews_corrcoef.py:82``."""
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(preds, target, num_classes: int, ignore_index: Optional[int] = None,
+                                 validate_args: bool = True) -> Array:
+    """Reference ``matthews_corrcoef.py:143``."""
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(preds, target, num_labels: int, threshold: float = 0.5,
+                                 ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``matthews_corrcoef.py:209``."""
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                      num_labels: Optional[int] = None, ignore_index: Optional[int] = None,
+                      validate_args: bool = True) -> Array:
+    """Task-dispatching MCC (reference ``matthews_corrcoef.py:276``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,126 @@
+"""Precision / recall kernels (reference
+``src/torchmetrics/functional/classification/precision_recall.py``: ``_precision_recall_reduce:22``,
+entrypoints ``:79-794``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification._counts import binary_counts, multiclass_counts, multilabel_counts
+from torchmetrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0.0,
+) -> Array:
+    different_stat = fp if stat == "precision" else fn  # this is what differs between the two
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        tp = jnp.sum(tp, axis=0 if multidim_average == "global" else 1)
+        different_stat = jnp.sum(different_stat, axis=0 if multidim_average == "global" else 1)
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    score = _safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def binary_precision(preds, target, threshold: float = 0.5, multidim_average: str = "global",
+                     ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:79``."""
+    tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, "binary", multidim_average)
+
+
+def multiclass_precision(preds, target, num_classes: int, average: Optional[str] = "macro", top_k: int = 1,
+                         multidim_average: str = "global", ignore_index: Optional[int] = None,
+                         validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:146``."""
+    tp, fp, tn, fn = multiclass_counts(preds, target, num_classes, average, top_k, multidim_average,
+                                       ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, average, multidim_average, top_k=top_k)
+
+
+def multilabel_precision(preds, target, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                         multidim_average: str = "global", ignore_index: Optional[int] = None,
+                         validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:231``."""
+    tp, fp, tn, fn = multilabel_counts(preds, target, num_labels, threshold, average, multidim_average,
+                                       ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, average, multidim_average, multilabel=True)
+
+
+def binary_recall(preds, target, threshold: float = 0.5, multidim_average: str = "global",
+                  ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:316``."""
+    tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, "binary", multidim_average)
+
+
+def multiclass_recall(preds, target, num_classes: int, average: Optional[str] = "macro", top_k: int = 1,
+                      multidim_average: str = "global", ignore_index: Optional[int] = None,
+                      validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:383``."""
+    tp, fp, tn, fn = multiclass_counts(preds, target, num_classes, average, top_k, multidim_average,
+                                       ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, average, multidim_average, top_k=top_k)
+
+
+def multilabel_recall(preds, target, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                      multidim_average: str = "global", ignore_index: Optional[int] = None,
+                      validate_args: bool = True) -> Array:
+    """Reference ``precision_recall.py:468``."""
+    tp, fp, tn, fn = multilabel_counts(preds, target, num_labels, threshold, average, multidim_average,
+                                       ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, average, multidim_average, multilabel=True)
+
+
+def precision(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+              num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
+              top_k: int = 1, ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Task-dispatching precision (reference ``precision_recall.py:553``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision(preds, target, num_classes, average, top_k, multidim_average,
+                                    ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision(preds, target, num_labels, threshold, average, multidim_average,
+                                    ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")
+
+
+def recall(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+           num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
+           top_k: int = 1, ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Task-dispatching recall (reference ``precision_recall.py:625``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_recall(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_recall(preds, target, num_classes, average, top_k, multidim_average,
+                                 ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_recall(preds, target, num_labels, threshold, average, multidim_average,
+                                 ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

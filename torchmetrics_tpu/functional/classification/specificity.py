@@ -1,0 +1,72 @@
+"""Specificity kernels (reference ``src/torchmetrics/functional/classification/specificity.py``:
+``_specificity_reduce:22``, entrypoints ``:62-420``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification._counts import binary_counts, multiclass_counts, multilabel_counts
+from torchmetrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _specificity_reduce(
+    tp: Array, fp: Array, tn: Array, fn: Array,
+    average: Optional[str], multidim_average: str = "global", multilabel: bool = False, top_k: int = 1,
+) -> Array:
+    if average == "binary":
+        return _safe_divide(tn, tn + fp)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tn = jnp.sum(tn, axis=axis)
+        fp = jnp.sum(fp, axis=axis)
+        return _safe_divide(tn, tn + fp)
+    specificity_score = _safe_divide(tn, tn + fp)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn, top_k)
+
+
+def binary_specificity(preds, target, threshold: float = 0.5, multidim_average: str = "global",
+                       ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Reference ``specificity.py:62``."""
+    tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, "binary", multidim_average)
+
+
+def multiclass_specificity(preds, target, num_classes: int, average: Optional[str] = "macro", top_k: int = 1,
+                           multidim_average: str = "global", ignore_index: Optional[int] = None,
+                           validate_args: bool = True) -> Array:
+    """Reference ``specificity.py:129``."""
+    tp, fp, tn, fn = multiclass_counts(preds, target, num_classes, average, top_k, multidim_average,
+                                       ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, average, multidim_average, top_k=top_k)
+
+
+def multilabel_specificity(preds, target, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                           multidim_average: str = "global", ignore_index: Optional[int] = None,
+                           validate_args: bool = True) -> Array:
+    """Reference ``specificity.py:214``."""
+    tp, fp, tn, fn = multilabel_counts(preds, target, num_labels, threshold, average, multidim_average,
+                                       ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, average, multidim_average, multilabel=True)
+
+
+def specificity(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
+                top_k: int = 1, ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
+    """Task-dispatching specificity (reference ``specificity.py:299``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_specificity(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_specificity(preds, target, num_classes, average, top_k, multidim_average,
+                                      ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_specificity(preds, target, num_labels, threshold, average, multidim_average,
+                                      ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

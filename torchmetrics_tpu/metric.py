@@ -1,0 +1,817 @@
+"""Core stateful metric engine, TPU-native.
+
+Parity target: reference ``src/torchmetrics/metric.py`` (``Metric:50``, ``add_state:194``,
+``forward:274``, ``_reduce_states:392``, ``sync/unsync/sync_context:489-590``, ``reset:672``,
+``CompositionalMetric:1078``).
+
+TPU-first inversion of the reference's layering (SURVEY §7): the reference builds its functional
+API out of stateful pieces; here the *functional core is the bottom layer* — every metric is a
+pure, jit-compiled pair
+
+    ``_update(state, *batch) -> state``        (accumulation kernel)
+    ``_compute(state) -> value``               (finalisation kernel)
+
+over a pytree-of-``jax.Array`` state, and the ``Metric`` class is a thin host shell that owns the
+current state pytree, memoises the jitted kernels, and layers on the torchmetrics UX
+(``add_state`` / ``update`` / ``forward`` / ``compute`` / ``reset`` / ``sync``). Because state
+transitions are pure functions of explicit state:
+
+- ``forward`` needs ONE kernel launch, not two: the batch contribution ``_update(defaults, batch)``
+  is simultaneously the batch-local state (compute it → batch value) and the merge operand for the
+  global state (reference needs the snapshot/restore dance of ``metric.py:307-390``).
+- sync never overwrites local state: a *synced view* is derived functionally, so
+  ``unsync`` is a no-op restore instead of a cache dance (``metric.py:527-553``).
+- handing ``update`` a ``jax.Array`` sharded over a mesh makes XLA insert the cross-device
+  collectives automatically — data-parallel metric accumulation with zero explicit communication.
+
+List states ("cat"): XLA requires static shapes, so unbounded concat-states live as host-side
+lists of device arrays; ``_update`` returns the (jit-computed) per-batch entry and the shell
+appends it. ``_compute`` receives them pre-concatenated.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from contextlib import contextmanager
+from copy import deepcopy
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.parallel.sync import process_sync
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def jit_distributed_available() -> bool:
+    """Reference ``metric.py:45-47``: world > 1?"""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+class StateStore:
+    """Host-level container for a metric's state, mutated in place.
+
+    Arrays themselves are immutable (functional updates swap dict entries); sharing the *store*
+    object is how ``MetricCollection`` compute groups alias state across metrics
+    (reference ``collections.py:289`` shares tensors by reference).
+    """
+
+    __slots__ = ("tensors", "lists")
+
+    def __init__(self) -> None:
+        self.tensors: Dict[str, Array] = {}
+        self.lists: Dict[str, List[Array]] = {}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {**self.tensors, **{k: list(v) for k, v in self.lists.items()}}
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        for k in self.tensors:
+            self.tensors[k] = snap[k]
+        for k in self.lists:
+            self.lists[k] = list(snap[k])
+
+
+class Metric:
+    """Base class for all metrics (reference ``metric.py:50``).
+
+    Subclass contract (the functional core):
+
+    - call :meth:`add_state` in ``__init__`` for every accumulator,
+    - implement ``_update(state, *args, **kwargs) -> dict`` — a PURE function mapping the dict of
+      tensor states (+ batch) to the new tensor states; for list states, include the per-batch
+      entry to append under the state's name (omit to append nothing). Jitted when
+      ``jit_update`` is True.
+    - implement ``_compute(state) -> value`` — pure finalisation; list states arrive concatenated.
+    """
+
+    __hash__ = object.__hash__
+
+    # class flags (reference metric.py:70-98)
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+
+    # engine flags (TPU build)
+    jit_update: bool = True
+    jit_compute: bool = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        if not isinstance(self.dist_sync_on_step, bool):
+            raise ValueError("Expected keyword argument `dist_sync_on_step` to be a `bool`")
+        self.process_group = kwargs.pop("process_group", None)
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
+            raise ValueError("Expected keyword argument `dist_sync_fn` to be callable or None")
+        self.distributed_available_fn = kwargs.pop("distributed_available_fn", None) or jit_distributed_available
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        if not isinstance(self.sync_on_compute, bool):
+            raise ValueError("Expected keyword argument `sync_on_compute` to be a `bool`")
+        self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        if not isinstance(self.compute_with_cache, bool):
+            raise ValueError("Expected keyword argument `compute_with_cache` to be a `bool`")
+        if kwargs:
+            kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
+            raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
+
+        self._device = None
+        self._dtype = jnp.float32
+
+        self._defaults: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        self._state = StateStore()
+
+        self._update_count = 0
+        self._computed: Any = None
+        self._update_called = False
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        self._is_synced = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._jit_cache: Dict[str, Callable] = {}
+
+    # ------------------------------------------------------------------ state
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def update_called(self) -> bool:
+        return self._update_called
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    @property
+    def metric_state(self) -> Dict[str, Any]:
+        """Current state values (reference ``metric.py:186``)."""
+        return self._state.snapshot()
+
+    def add_state(
+        self,
+        name: str,
+        default: Any,
+        dist_reduce_fx: Union[str, Callable, None] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register an accumulator (reference ``metric.py:194-271``).
+
+        ``default`` is an array (tensor state) or an empty list (list state). ``dist_reduce_fx``
+        maps to an XLA collective at sync time: ``"sum"``→psum, ``"mean"``→pmean, ``"max"``→pmax,
+        ``"min"``→pmin, ``"cat"``/None→all_gather (see ``torchmetrics_tpu.parallel``).
+        """
+        if isinstance(default, list):
+            if default:
+                raise ValueError("state variable must be a jax array or any empty list (where you can append arrays)")
+        else:
+            try:
+                default = jnp.asarray(default)
+            except (TypeError, ValueError):
+                raise ValueError("state variable must be a jax array or any empty list (where you can append arrays)")
+        if isinstance(dist_reduce_fx, str):
+            if dist_reduce_fx not in ("sum", "mean", "cat", "min", "max"):
+                raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        elif not (callable(dist_reduce_fx) or dist_reduce_fx is None):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+
+        if name in ("tensors", "lists"):
+            raise ValueError(f"state name {name!r} is reserved")
+        self._defaults[name] = deepcopy(default)
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+        if isinstance(default, list):
+            self._state.lists[name] = []
+        else:
+            self._state.tensors[name] = default
+
+    def __getattr__(self, name: str):
+        # states are exposed as attributes (torchmetrics UX: ``self.tp``)
+        if name in ("_state", "__setstate__", "__getstate__"):
+            raise AttributeError(name)
+        state = self.__dict__.get("_state")
+        if state is not None:
+            if name in state.tensors:
+                return state.tensors[name]
+            if name in state.lists:
+                return state.lists[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state.tensors:
+            state.tensors[name] = jnp.asarray(value)
+        elif state is not None and name in state.lists:
+            state.lists[name] = list(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    # ------------------------------------------------------------- subclass API
+    def _update(self, state: Dict[str, Array], *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        raise NotImplementedError
+
+    def _compute(self, state: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ engine
+    def _jitted_update(self) -> Callable:
+        fn = self._jit_cache.get("update")
+        if fn is None:
+            fn = jax.jit(self._update) if self.jit_update else self._update
+            self._jit_cache["update"] = fn
+        return fn
+
+    def _jitted_compute(self) -> Callable:
+        fn = self._jit_cache.get("compute")
+        if fn is None:
+            fn = jax.jit(self._compute) if self.jit_compute else self._compute
+            self._jit_cache["compute"] = fn
+        return fn
+
+    def _coerce(self, args: tuple, kwargs: dict) -> tuple:
+        conv = lambda x: jnp.asarray(x) if isinstance(x, (np.ndarray, int, float, bool, np.generic)) or (
+            isinstance(x, (list, tuple)) and len(x) and isinstance(x[0], (int, float, bool))
+        ) else x
+        return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+
+    def _validate(self, *args: Any, **kwargs: Any) -> None:
+        """Host-side value checks (overridden by subclasses when ``validate_args``)."""
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate a batch into the metric state (reference ``metric.py:458-480`` wrapper)."""
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
+            )
+        args, kwargs = self._coerce(args, kwargs)
+        self._validate(*args, **kwargs)
+        out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
+        self._apply_update_result(out)
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+
+    def _apply_update_result(self, out: Dict[str, Any]) -> None:
+        for name in self._state.tensors:
+            if name in out:
+                self._state.tensors[name] = out[name]
+        if self._state.lists:
+            cpu = jax.devices("cpu")[0] if self.compute_on_cpu else None
+            for name in self._state.lists:
+                if name in out:
+                    entry = out[name]
+                    entries = list(entry) if isinstance(entry, (list, tuple)) else [entry]
+                    if cpu is not None:  # offload unbounded cat-states to host RAM (metric.py:482-487)
+                        entries = [jax.device_put(e, cpu) for e in entries]
+                    self._state.lists[name].extend(entries)
+
+    def _default_tensor_state(self) -> Dict[str, Array]:
+        return {k: self._defaults[k] for k in self._state.tensors}
+
+    def _reduce_states(self, global_tensors: Dict[str, Array], batch_out: Dict[str, Any]) -> None:
+        """Merge a batch-only state into the global state by reduce-fx (reference ``metric.py:392-424``)."""
+        n = self._update_count
+        for name in self._state.tensors:
+            if name not in batch_out:
+                continue
+            fx = self._reductions[name]
+            global_v = global_tensors[name]
+            batch_v = batch_out[name]
+            if fx == "sum" or fx is jnp.sum:
+                # batch_out already includes the default; sum-states have zero defaults so
+                # global + (batch - default) == global + batch-contribution
+                reduced = global_v + (batch_v - self._defaults[name])
+            elif fx == "cat":
+                reduced = jnp.concatenate([global_v, batch_v], axis=0)
+            elif fx == "mean":
+                reduced = ((n - 1) * global_v + batch_v) / n if n > 0 else batch_v
+            elif fx == "max" or fx is jnp.max:
+                reduced = jnp.maximum(global_v, batch_v)
+            elif fx == "min" or fx is jnp.min:
+                reduced = jnp.minimum(global_v, batch_v)
+            elif callable(fx):
+                reduced = fx(jnp.stack([global_v, batch_v]))
+            else:
+                raise TorchMetricsUserError(
+                    f"Cannot reduce states with `dist_reduce_fx={fx}` in forward; set `full_state_update=True`."
+                )
+            self._state.tensors[name] = reduced
+        for name in self._state.lists:
+            if name in batch_out:
+                entry = batch_out[name]
+                if isinstance(entry, (list, tuple)):
+                    self._state.lists[name].extend(entry)
+                else:
+                    self._state.lists[name].append(entry)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate AND return the batch-local value (reference ``metric.py:274-305``).
+
+        Single kernel launch: the batch contribution serves as both the batch-local state and the
+        merge operand (vs the reference's 1–2 extra ``update`` calls).
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError("The Metric shouldn't be synced when performing `forward`.")
+        if self.full_state_update or self.dist_sync_on_step:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Reference ``metric.py:307-350``: update global, then compute on batch-only state."""
+        self.update(*args, **kwargs)
+        update_count = self._update_count
+        cache = self._state.snapshot()
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+        # restore global state
+        self._state.restore(cache)
+        self._update_count = update_count
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self._update_called = True
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
+        args, kwargs = self._coerce(args, kwargs)
+        self._validate(*args, **kwargs)
+        batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+        # batch-local value
+        batch_state = {n: batch_out.get(n, self._defaults[n]) for n in self._state.tensors}
+        for n in self._state.lists:
+            if n in batch_out:
+                e = batch_out[n]
+                batch_state[n] = dim_zero_cat([*e] if isinstance(e, (list, tuple)) else [e])
+            else:
+                batch_state[n] = jnp.zeros((0,))
+        batch_val = self._squeeze_if_scalar(self._jitted_compute()(batch_state))
+        # merge into global
+        self._reduce_states(dict(self._state.tensors), batch_out)
+        if self.dist_sync_on_step:  # unreachable (routed to full path) but kept for clarity
+            pass
+        return batch_val
+
+    # ------------------------------------------------------------------- sync
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        """Gather+reduce every state across the world (reference ``metric.py:426-456``)."""
+        synced = process_sync(
+            self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn, group=process_group
+        )
+        for name in list(self._state.tensors):
+            self._state.tensors[name] = synced[name]
+        for name in list(self._state.lists):
+            v = synced[name]
+            self._state.lists[name] = list(v) if isinstance(v, (list, tuple)) else [v]
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> None:
+        """Snapshot local state and replace it with the world-synced state (reference ``metric.py:489``)."""
+        if self._is_synced and should_sync:
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        if distributed_available is None and self.distributed_available_fn is not None:
+            distributed_available = self.distributed_available_fn
+        is_distributed = distributed_available() if callable(distributed_available) else False
+        dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+        if not should_sync or (dist_sync_fn is None and not is_distributed):
+            # nothing to sync against (reference metric.py:519-522 early-returns)
+            return
+        self._cache = self._state.snapshot()
+        self._sync_dist(dist_sync_fn, process_group=process_group or self.process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore the pre-sync local state (reference ``metric.py:533-553``)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise TorchMetricsUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise TorchMetricsUserError("The internal cache should exist to unsync the Metric.")
+        self._state.restore(self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> Generator[None, None, None]:
+        """``sync()`` on entry, ``unsync()`` on exit (reference ``metric.py:555-590``)."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+        )
+        yield
+        self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    # ----------------------------------------------------------------- compute
+    @staticmethod
+    def _squeeze_if_scalar(value: Any) -> Any:
+        if isinstance(value, jax.Array) and value.ndim == 0:
+            return value
+        if isinstance(value, jax.Array) and value.shape == (1,):
+            return jnp.squeeze(value)
+        return value
+
+    def _computable_state(self) -> Dict[str, Any]:
+        state: Dict[str, Any] = dict(self._state.tensors)
+        for name, entries in self._state.lists.items():
+            state[name] = dim_zero_cat(entries) if entries else []
+        return state
+
+    def compute(self) -> Any:
+        """Finalise the accumulated state to the metric value (reference ``metric.py:592-622``)."""
+        if not self._update_called:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` method"
+                " which may lead to errors, as metric states have not yet been updated.",
+                UserWarning,
+            )
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+        with self.sync_context(
+            dist_sync_fn=self.dist_sync_fn,
+            should_sync=self._to_sync,
+            should_unsync=self._should_unsync,
+        ):
+            state = self._computable_state()
+            has_empty_list = any(
+                isinstance(v, list) and not len(v) for v in state.values()
+            )
+            compute_fn = self._compute if has_empty_list else self._jitted_compute()
+            value = self._squeeze_if_scalar(compute_fn(state))
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    def reset(self) -> None:
+        """Restore default state (reference ``metric.py:672-687``)."""
+        self._update_count = 0
+        self._update_called = False
+        self._computed = None
+        for name in self._state.tensors:
+            self._state.tensors[name] = self._defaults[name]
+        for name in self._state.lists:
+            self._state.lists[name] = []
+        self._cache = None
+        self._is_synced = False
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    # ------------------------------------------------------------- persistence
+    def clone(self) -> "Metric":
+        """Deep copy (reference ``metric.py:689``)."""
+        return deepcopy(self)
+
+    def __deepcopy__(self, memo: dict) -> "Metric":
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_jit_cache":
+                new.__dict__[k] = {}
+            else:
+                new.__dict__[k] = deepcopy(v, memo)
+        return new
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # jitted callables are not picklable; state arrays → numpy (reference metric.py:693-712)
+        d = {k: v for k, v in self.__dict__.items() if k != "_jit_cache"}
+        d["_state_tensors"] = {k: np.asarray(v) for k, v in self._state.tensors.items()}
+        d["_state_lists"] = {k: [np.asarray(e) for e in v] for k, v in self._state.lists.items()}
+        d["_defaults"] = {k: (np.asarray(v) if not isinstance(v, list) else []) for k, v in self._defaults.items()}
+        d.pop("_state")
+        cache = d.get("_cache")
+        if cache is not None:
+            d["_cache"] = {
+                k: ([np.asarray(e) for e in v] if isinstance(v, list) else np.asarray(v)) for k, v in cache.items()
+            }
+        return d
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        tensors = state.pop("_state_tensors")
+        lists = state.pop("_state_lists")
+        self.__dict__.update(state)
+        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_defaults"] = {
+            k: (jnp.asarray(v) if not isinstance(v, list) else []) for k, v in state["_defaults"].items()
+        }
+        store = StateStore()
+        store.tensors = {k: jnp.asarray(v) for k, v in tensors.items()}
+        store.lists = {k: [jnp.asarray(e) for e in v] for k, v in lists.items()}
+        self.__dict__["_state"] = store
+        if self.__dict__.get("_cache") is not None:
+            self.__dict__["_cache"] = {
+                k: ([jnp.asarray(e) for e in v] if isinstance(v, list) else jnp.asarray(v))
+                for k, v in self.__dict__["_cache"].items()
+            }
+
+    def persistent(self, mode: bool = False) -> None:
+        """Flip persistence of all states (reference ``metric.py:826``)."""
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "", keep_vars: bool = False) -> dict:
+        """Checkpoint dict of persistent states (reference ``metric.py:831``)."""
+        destination = destination if destination is not None else {}
+        for name, persistent in self._persistent.items():
+            if not persistent:
+                continue
+            if name in self._state.tensors:
+                v = self._state.tensors[name]
+                destination[prefix + name] = v if keep_vars else np.asarray(v)
+            else:
+                entries = self._state.lists[name]
+                destination[prefix + name] = [e if keep_vars else np.asarray(e) for e in entries]
+        return destination
+
+    def load_state_dict(self, state_dict: dict, strict: bool = True) -> None:
+        """Restore states from a checkpoint dict (reference ``metric.py:863``)."""
+        for name in self._persistent:
+            if name in state_dict:
+                v = state_dict[name]
+                if name in self._state.lists:
+                    self._state.lists[name] = [jnp.asarray(e) for e in v]
+                else:
+                    self._state.tensors[name] = jnp.asarray(v)
+                self._update_called = True
+                self._update_count = max(self._update_count, 1)
+            elif strict:
+                raise RuntimeError(f"Missing key {name!r} in state_dict")
+
+    # --------------------------------------------------------------- placement
+    def to(self, device) -> "Metric":
+        """Move all states to ``device`` (reference ``_apply``, ``metric.py:776-824``)."""
+        for name, v in self._state.tensors.items():
+            self._state.tensors[name] = jax.device_put(v, device)
+        for name, entries in self._state.lists.items():
+            self._state.lists[name] = [jax.device_put(e, device) for e in entries]
+        self._defaults = {
+            k: (jax.device_put(v, device) if not isinstance(v, list) else v) for k, v in self._defaults.items()
+        }
+        self._device = device
+        return self
+
+    def set_dtype(self, dst_type) -> "Metric":
+        """Cast float states (``.float()``/``.half()`` are deliberate no-ops — ``metric.py:740-774``)."""
+        self._dtype = dst_type
+        cast = lambda v: jnp.asarray(v, dst_type) if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v
+        for name, v in self._state.tensors.items():
+            self._state.tensors[name] = cast(v)
+        for name, entries in self._state.lists.items():
+            self._state.lists[name] = [cast(e) for e in entries]
+        self._defaults = {k: (cast(v) if not isinstance(v, list) else v) for k, v in self._defaults.items()}
+        self._jit_cache = {}
+        return self
+
+    def float(self) -> "Metric":
+        return self
+
+    def double(self) -> "Metric":
+        return self
+
+    def half(self) -> "Metric":
+        return self
+
+    # ----------------------------------------------------------------- helpers
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs accepted by this metric's ``update`` (reference ``metric.py:882-901``)."""
+        sig = inspect.signature(self.update if type(self).update is not Metric.update else self._update)
+        params = sig.parameters
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+        if has_var_kw:
+            return kwargs
+        names = {
+            n for n, p in params.items()
+            if n not in ("self", "state") and p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+        }
+        return {k: v for k, v in kwargs.items() if k in names}
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def plot(self, val: Any = None, ax: Any = None):
+        """Plot the (or a provided) metric value (reference ``metric.py:636-670``)."""
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val, ax=ax, higher_is_better=self.higher_is_better, name=type(self).__name__,
+            lower_bound=self.plot_lower_bound, upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+        )
+
+    # ---------------------------------------------------------- composition ops
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.divide, self, other)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.divide, other, self)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __inv__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    __invert__ = __inv__
+
+    def __getitem__(self, idx) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic over metrics (reference ``metric.py:1078-1201``)."""
+
+    full_state_update = True
+
+    def __init__(self, operator: Callable, metric_a: Union[Metric, float, int, Array, None],
+                 metric_b: Union[Metric, float, int, Array, None]) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (jnp.asarray(metric_a) if metric_a is not None else None)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (jnp.asarray(metric_b) if metric_b is not None else None)
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        pass  # No syncing on own state: operands sync themselves (reference metric.py:1117)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+        self._update_called = True
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        self._update_called = True
+        self._update_count += 1
+        if val_a is None:
+            return None
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                return None
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_called = False
+        self._update_count = 0
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

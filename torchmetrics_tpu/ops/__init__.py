@@ -1,0 +1,31 @@
+"""TPU-native compute kernels shared by the functional layer.
+
+Where the reference relies on ``torch.bincount`` with an arange+eq fallback for XLA backends
+(``src/torchmetrics/utilities/data.py:169-199``), these kernels are designed for XLA from the
+start:
+
+- ``bincount`` / ``confusion_matrix_update``: lowered as one-hot matmuls that run on the MXU
+  (systolic array) for small cardinalities — a (N, C) one-hot against ones / another one-hot is a
+  single dense matmul, the highest-throughput op on TPU — with a segment-sum scatter path for
+  large cardinalities where the one-hot would blow HBM.
+- ``segment_*``: sorted-segment reductions that replace the reference's per-query Python loops
+  (e.g. retrieval, ``src/torchmetrics/retrieval/base.py:165-182``).
+
+"""
+from torchmetrics_tpu.ops.histogram import bincount, bincount_weighted, confusion_matrix_update
+from torchmetrics_tpu.ops.segments import (
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+
+__all__ = [
+    "bincount",
+    "bincount_weighted",
+    "confusion_matrix_update",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+]

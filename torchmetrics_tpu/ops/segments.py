@@ -1,0 +1,29 @@
+"""Sorted-segment reductions.
+
+These replace the reference's per-query Python loops (retrieval metrics iterate groups on the
+host, ``src/torchmetrics/retrieval/base.py:165-182``) with single fused XLA reductions over a
+statically-shaped segment-id vector — the idiomatic TPU formulation of "group-by + reduce".
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    sums = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    counts = jax.ops.segment_sum(jnp.ones_like(data, dtype=jnp.float32), segment_ids, num_segments=num_segments)
+    return sums / jnp.maximum(counts, 1.0)
+
+
+def segment_max(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def segment_min(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    return jax.ops.segment_min(data, segment_ids, num_segments=num_segments)

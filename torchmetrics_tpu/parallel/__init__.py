@@ -1,0 +1,38 @@
+"""Distributed state synchronisation over JAX device meshes.
+
+This replaces the reference's gather-then-host-reduce backend
+(``src/torchmetrics/utilities/distributed.py:97-147`` + ``metric.py:426-456``) with XLA
+collectives that run *inside* the compiled computation, riding ICI/DCN:
+
+- ``dist_reduce_fx="sum"/"mean"/"max"/"min"`` → ``lax.psum``/``pmean``/``pmax``/``pmin`` — one
+  fused all-reduce instead of all-gather + local reduce.
+- ``dist_reduce_fx="cat"``/``None`` → ``lax.all_gather`` (+ static pad-and-mask for uneven
+  shapes, since XLA requires static shapes).
+- The reference's ``process_group`` becomes a mesh **axis name**; ``distributed_available_fn``
+  becomes "is there a mesh axis in scope".
+
+Three sync contexts are supported:
+
+1. **Sharded-inputs (zero-collective) mode** — the idiomatic TPU path: hand ``metric.update`` a
+   ``jax.Array`` sharded over a ``Mesh``; the jitted update's reductions are global, so XLA
+   inserts the ICI collectives itself and the accumulated state is already world-consistent.
+2. **In-jit collectives** — ``sync_state(state, reductions, axis_name=...)`` inside
+   ``shard_map``/``pmap`` training steps that keep per-device state.
+3. **Multi-process eager** — ``process_sync`` over ``jax.process_count()`` hosts for the
+   torch.distributed-style one-replica-per-process layout.
+"""
+from torchmetrics_tpu.parallel.sync import (
+    all_gather_object_shapes,
+    gather_all_arrays,
+    process_sync,
+    sync_state,
+)
+from torchmetrics_tpu.parallel.mesh import local_mesh
+
+__all__ = [
+    "sync_state",
+    "gather_all_arrays",
+    "process_sync",
+    "all_gather_object_shapes",
+    "local_mesh",
+]

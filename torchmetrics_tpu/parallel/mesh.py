@@ -1,0 +1,21 @@
+"""Mesh construction helpers."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_mesh(axis_names: Sequence[str] = ("data",), shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build a mesh over all visible devices.
+
+    Default: a 1-D ``("data",)`` mesh — metric state is replicated per data shard exactly like the
+    reference's DDP layout (SURVEY §2.2: data-parallel metric-state replication only).
+    """
+    devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)

@@ -1,0 +1,144 @@
+"""Reduce-fx → XLA-collective mapping.
+
+Parity map (reference ``src/torchmetrics/utilities/distributed.py`` + ``metric.py:426-456``):
+
+==================  =========================================  =============================
+reference            semantics                                  TPU-native lowering
+==================  =========================================  =============================
+gather+``sum``       all_gather → stack → sum                   ``lax.psum`` (fused all-reduce)
+gather+``mean``      all_gather → stack → mean                  ``lax.pmean``
+gather+``max/min``   all_gather → stack → max/min               ``lax.pmax/pmin``
+gather+``cat``        all_gather → concat dim0                  ``lax.all_gather(tiled=True)``
+``None``             all_gather → list of replicas              ``lax.all_gather`` (new axis)
+uneven shapes        gather sizes → pad → gather → trim         static pad-to-capacity + mask
+==================  =========================================  =============================
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+ReduceFx = Union[str, Callable, None]
+
+
+def _reduce_one(value: Array, reduce_fx: ReduceFx, axis_name: str) -> Array:
+    """Synchronise a single tensor state across ``axis_name`` inside jit/shard_map/pmap."""
+    if reduce_fx == "sum":
+        return lax.psum(value, axis_name)
+    if reduce_fx == "mean":
+        return lax.pmean(value, axis_name)
+    if reduce_fx == "max":
+        return lax.pmax(value, axis_name)
+    if reduce_fx == "min":
+        return lax.pmin(value, axis_name)
+    if reduce_fx == "cat":
+        return lax.all_gather(value, axis_name, axis=0, tiled=True)
+    if reduce_fx is None:
+        # gather replicas along a fresh leading axis (caller applies its own reduction)
+        return lax.all_gather(value, axis_name, axis=0, tiled=False)
+    if callable(reduce_fx):
+        gathered = lax.all_gather(value, axis_name, axis=0, tiled=False)
+        return reduce_fx(gathered)
+    raise ValueError(f"Unsupported dist_reduce_fx: {reduce_fx!r}")
+
+
+def sync_state(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    axis_name: str,
+) -> Dict[str, Any]:
+    """Synchronise a metric state pytree across a mesh axis, inside a compiled computation.
+
+    List states (Python lists of arrays) are pre-concatenated along dim 0 — mirroring
+    ``metric.py:431-432`` — then treated as ``cat``.
+    """
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        fx = reductions.get(name, "sum")
+        if isinstance(value, (list, tuple)):
+            if len(value) == 0:
+                out[name] = value
+                continue
+            cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0)
+            out[name] = [_reduce_one(cat, "cat" if fx in (None, "cat") else fx, axis_name)]
+        else:
+            out[name] = _reduce_one(value, fx, axis_name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-process eager path (one metric replica per host process, à la DDP)
+# ---------------------------------------------------------------------------
+
+def all_gather_object_shapes(local_shape: tuple) -> List[tuple]:
+    """Gather dim-0 sizes from every process (reference ``distributed.py:118-127``)."""
+    from jax.experimental import multihost_utils
+
+    sizes = multihost_utils.process_allgather(jnp.asarray(local_shape, jnp.int32))
+    return [tuple(int(d) for d in row) for row in np.asarray(sizes)]
+
+
+def gather_all_arrays(value: Array, group: Optional[str] = None) -> List[Array]:
+    """All-gather an array from every process, handling uneven dim-0 sizes by pad+trim.
+
+    Returns a list of per-process arrays (reference ``gather_all_tensors``,
+    ``distributed.py:97-147``). No-op single-element list when world size is 1.
+    """
+    del group
+    if jax.process_count() == 1:
+        return [value]
+    from jax.experimental import multihost_utils
+
+    shapes = all_gather_object_shapes(tuple(value.shape))
+    max_dim0 = max((s[0] if s else 0) for s in shapes)
+    pad = max_dim0 - (value.shape[0] if value.ndim else 0)
+    padded = jnp.pad(value, [(0, pad)] + [(0, 0)] * (value.ndim - 1)) if value.ndim else value
+    gathered = multihost_utils.process_allgather(padded)  # (world, max_dim0, ...)
+    return [jnp.asarray(gathered[i][: shapes[i][0]] if value.ndim else gathered[i]) for i in range(len(shapes))]
+
+
+def process_sync(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    gather_fn: Optional[Callable] = None,
+    group: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Eager cross-process sync of a state dict; identity when world size is 1."""
+    gather = gather_fn or gather_all_arrays
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        fx = reductions.get(name, "sum")
+        if isinstance(value, (list, tuple)):
+            if len(value) == 0 and jax.process_count() == 1:
+                out[name] = list(value)
+                continue
+            cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
+            gathered = gather(cat, group)
+            out[name] = [g for g in gathered]
+        else:
+            gathered = gather(value, group)
+            if len(gathered) == 1:
+                out[name] = gathered[0]
+                continue
+            stacked = jnp.stack(gathered) if fx in ("sum", "mean", "max", "min") else None
+            if fx == "sum":
+                out[name] = jnp.sum(stacked, axis=0)
+            elif fx == "mean":
+                out[name] = jnp.mean(stacked, axis=0)
+            elif fx == "max":
+                out[name] = jnp.max(stacked, axis=0)
+            elif fx == "min":
+                out[name] = jnp.min(stacked, axis=0)
+            elif fx == "cat":
+                out[name] = jnp.concatenate(gathered, axis=0)
+            elif fx is None:
+                out[name] = jnp.stack(gathered)
+            elif callable(fx):
+                out[name] = fx(jnp.stack(gathered))
+            else:
+                raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+    return out

@@ -1,0 +1,87 @@
+"""Host-side input validation.
+
+Parity: reference ``src/torchmetrics/utilities/checks.py`` (``_check_same_shape:39``,
+``_check_retrieval_inputs:540``). XLA note: value-dependent checks (e.g. "targets must be in
+[0, C)") cannot run inside a traced computation, so every check here no-ops when handed tracers —
+metrics call them from the host shell before dispatching to the jitted kernel, matching the
+reference's ``validate_args`` contract (``functional/classification/stat_scores.py:48-87``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def is_traced(*arrays) -> bool:
+    """True if any input is an abstract tracer (inside jit/vmap/scan)."""
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (shape is static — safe even under trace)."""
+    if jnp.shape(preds) != jnp.shape(target):
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {jnp.shape(preds)} and"
+            f" {jnp.shape(target)}."
+        )
+
+
+def _check_valid_int_labels(x: Array, num_classes: int, name: str, ignore_index: Optional[int] = None) -> None:
+    if is_traced(x):
+        return
+    xv = np.asarray(x)
+    if ignore_index is not None:
+        xv = xv[xv != ignore_index]
+    if xv.size and (xv.min() < 0 or xv.max() >= num_classes):
+        raise RuntimeError(
+            f"Detected more unique values in `{name}` than expected. Expected only {num_classes} values in"
+            f" range [0, {num_classes}), but found values in range [{xv.min()}, {xv.max()}]."
+        )
+
+
+def _check_probabilities(x: Array, name: str = "preds") -> None:
+    if is_traced(x):
+        return
+    xv = np.asarray(x)
+    if xv.size and (xv.min() < 0 or xv.max() > 1):
+        raise ValueError(f"`{name}` should be probabilities in [0,1], but got values outside that range.")
+
+
+def _check_retrieval_inputs(
+    indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Validate + flatten retrieval triplets (reference ``checks.py:540``)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `targets` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    indexes, preds, target = jnp.reshape(indexes, (-1,)), jnp.reshape(preds, (-1,)), jnp.reshape(target, (-1,))
+    if not is_traced(target):
+        tv = np.asarray(target)
+        if ignore_index is not None:
+            tv = tv[tv != ignore_index]
+        if not allow_non_binary_target and tv.size and (tv.max() > 1 or tv.min() < 0):
+            raise ValueError("`target` must contain `binary` values")
+    return indexes, preds, target
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    preds, target = jnp.reshape(preds, (-1,)), jnp.reshape(target, (-1,))
+    if not allow_non_binary_target and not is_traced(target):
+        tv = np.asarray(target)
+        if tv.size and (tv.max() > 1 or tv.min() < 0):
+            raise ValueError("`target` must contain `binary` values")
+    return preds, target

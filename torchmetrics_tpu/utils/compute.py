@@ -1,0 +1,98 @@
+"""Small numerical kernels shared across metrics.
+
+Parity: reference ``src/torchmetrics/utilities/compute.py`` (``_safe_divide:46``,
+``_safe_xlogy:31``, ``_auc_compute_without_check:88``, ``interp:134``). All functions are pure
+jax and safe to call under ``jit``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul with float32 accumulation (MXU-friendly on TPU)."""
+    return jnp.matmul(x, y, precision="highest")
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Elementwise ``num / denom`` returning ``zero_division`` where ``denom == 0``.
+
+    Unlike a post-hoc ``nan_to_num``, the denominator is patched *before* the division so no
+    inf/nan is ever produced (keeps XLA happy and gradients finite).
+    """
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    zero_mask = denom == 0
+    patched = jnp.where(zero_mask, jnp.ones_like(denom), denom)
+    return jnp.where(zero_mask, jnp.asarray(zero_division, num.dtype), num / patched)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 where ``x == 0`` (even if ``y == 0``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    res = jnp.where(x == 0, 0.0, x * jnp.log(jnp.where(x == 0, 1.0, y)))
+    return res
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array,
+    top_k: int = 1,
+) -> Array:
+    """Apply micro/macro/weighted reduction of a per-class ``score``."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(score.dtype)
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            zero = (tp + fp + fn == 0) if top_k == 1 else (tp + fn == 0)
+            weights = jnp.where(zero, 0.0, weights)
+    return _safe_divide(jnp.sum(weights * score, axis=-1), jnp.sum(weights, axis=-1))
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y); ``direction`` flips sign for descending x."""
+    dx = jnp.diff(x, axis=axis)
+    y_avg = (jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis) + jnp.take(y, jnp.arange(0, y.shape[axis] - 1), axis=axis)) / 2.0
+    return jnp.sum(dx * y_avg, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        order = jnp.argsort(x)
+        x = x[order]
+        y = y[order]
+    return _auc_compute_without_check(x, y, 1.0)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve y=f(x) via the trapezoidal rule."""
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation, monotonically increasing ``xp`` (reference ``compute.py:134``)."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(preds: Array, normalization: str = "sigmoid") -> Array:
+    """Apply sigmoid/softmax only when ``preds`` is not already a probability.
+
+    The reference branches on ``preds.min() < 0 or preds.max() > 1`` at trace time; under XLA
+    that is a data-dependent decision, so we compute the predicate on-device and ``where``-select —
+    both branches are cheap elementwise ops that fuse away.
+    """
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        return preds
+    outside = (jnp.min(preds) < 0) | (jnp.max(preds) > 1)
+    if normalization == "sigmoid":
+        normed = jax.nn.sigmoid(preds)
+    else:
+        normed = jax.nn.softmax(preds, axis=-1)
+    return jnp.where(outside, normed, preds)

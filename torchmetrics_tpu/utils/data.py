@@ -1,0 +1,114 @@
+"""Data-movement helpers: dim-zero reductions, one-hot, topk, bincount.
+
+Parity: reference ``src/torchmetrics/utilities/data.py`` (``dim_zero_*:28-55``, ``to_onehot:80``,
+``select_topk:115``, ``to_categorical:142``, ``_bincount:169``, ``_cumsum:200``,
+``_flexible_bincount:212``, ``allclose:231``).
+
+TPU-first notes: the reference needs a deterministic arange+eq fallback for ``bincount`` on
+XLA backends (``data.py:193-195``); here bincount IS the XLA-native design — see
+``torchmetrics_tpu.ops.bincount`` which lowers to a one-hot matmul on the MXU for small
+cardinalities and a segment-sum scatter otherwise.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.ops import bincount as _ops_bincount
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly list-valued) state along dim 0."""
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return jnp.asarray(x)
+    if not x:  # empty list state
+        raise ValueError("No samples to concatenate")
+    x = [jnp.atleast_1d(jnp.asarray(e)) for e in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert (N, ...) int labels to (N, C, ...) one-hot (reference ``data.py:80``)."""
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)  # (N, ..., C)
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary (0/1) mask of the top-k entries along ``dim`` (reference ``data.py:115``).
+
+    XLA-native: uses ``jax.lax.top_k`` (sorted network on TPU) + one-hot scatter-free union.
+    """
+    if topk == 1:  # fast path: argmax one-hot
+        idx = jnp.argmax(prob_tensor, axis=dim)
+        return jnp.moveaxis(jax.nn.one_hot(idx, prob_tensor.shape[dim], dtype=jnp.int32), -1, dim)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)  # (..., k)
+    mask = jnp.sum(jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32), axis=-2)
+    mask = jnp.clip(mask, 0, 1)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities → class index via argmax (reference ``data.py:142``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
+    """Count occurrences of each value in ``x`` of ints in ``[0, minlength)``.
+
+    Static output shape (required by XLA) — ``minlength`` must be known at trace time.
+    """
+    if minlength is None:
+        minlength = int(jnp.max(x)) + 1 if x.size else 1
+    return _ops_bincount(jnp.reshape(x, (-1,)), minlength)
+
+
+def _cumsum(x: Array, axis: int = 0, dtype=None) -> Array:
+    """Cumulative sum (XLA's is deterministic; no CPU fallback needed — reference ``data.py:200``)."""
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount over the values actually present (dynamic cardinality).
+
+    The reference (``data.py:212``) remaps via ``unique``; XLA needs static shapes so this is a
+    host-returning helper for eager (non-jit) compute paths only.
+    """
+    x = np.asarray(x)
+    _, inverse = np.unique(x, return_inverse=True)
+    counts = np.bincount(inverse)
+    return jnp.asarray(counts)
+
+
+def allclose(t1: Array, t2: Array, atol: float = 1e-8) -> bool:
+    """Shape+value closeness check usable on any backend (reference ``data.py:231``)."""
+    if jnp.shape(t1) != jnp.shape(t2):
+        return False
+    return bool(jnp.allclose(jnp.asarray(t1, jnp.float32), jnp.asarray(t2, jnp.float32), atol=atol))

@@ -1,0 +1,96 @@
+"""String-valued enums for task / averaging dispatch.
+
+Parity: reference ``src/torchmetrics/utilities/enums.py:56-154``.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """Base for case-insensitive string enums (``from_str`` resolves ``"Macro"`` → ``MACRO``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Task"
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> "EnumStr":
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            valid = [m.lower() for m in cls.__members__]
+            raise ValueError(f"Invalid {cls._name()}: expected one of {valid}, but got {value}.") from None
+
+    def __str__(self) -> str:
+        return self.value.lower()
+
+
+class DataType(EnumStr):
+    """Type of an input batch (reference ``enums.py:56``)."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+    @staticmethod
+    def _name() -> str:
+        return "Data type"
+
+
+class AverageMethod(EnumStr):
+    """Averaging strategy over classes (reference ``enums.py:74``)."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None  # type: ignore[assignment]
+    SAMPLES = "samples"
+
+    @staticmethod
+    def _name() -> str:
+        return "Average method"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class averaging (reference ``enums.py:97``)."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Classification task dispatch key (reference ``enums.py:108``)."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification task"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification task"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification task"
+
+
+def _validate_average(average: Optional[str], allowed: tuple = ("micro", "macro", "weighted", "none", None)) -> None:
+    if average not in allowed:
+        raise ValueError(f"Argument `average` has to be one of {allowed}, got {average}.")

@@ -1,0 +1,12 @@
+"""User-facing exception types.
+
+Parity: reference ``src/torchmetrics/utilities/exceptions.py:1-21``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised on wrong usage of the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on questionable usage of the metric API."""

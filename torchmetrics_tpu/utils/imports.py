@@ -1,0 +1,32 @@
+"""Optional-dependency availability flags.
+
+Parity: reference ``src/torchmetrics/utilities/imports.py:32-68``. The TPU build's base deps are
+jax/numpy only; everything else is feature-gated here.
+"""
+from __future__ import annotations
+
+import importlib.util
+
+
+def package_available(name: str) -> bool:
+    """True if ``name`` is importable (spec found, no import executed)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_SKLEARN_AVAILABLE = package_available("sklearn")
+_SCIPY_AVAILABLE = package_available("scipy")
+_MATPLOTLIB_AVAILABLE = package_available("matplotlib")
+_TRANSFORMERS_AVAILABLE = package_available("transformers")
+_TORCH_AVAILABLE = package_available("torch")
+_NLTK_AVAILABLE = package_available("nltk")
+_REGEX_AVAILABLE = package_available("regex")
+_PESQ_AVAILABLE = package_available("pesq")
+_PYSTOI_AVAILABLE = package_available("pystoi")
+_GAMMATONE_AVAILABLE = package_available("gammatone")
+_PYCOCOTOOLS_AVAILABLE = package_available("pycocotools")
+_LPIPS_AVAILABLE = package_available("lpips")
+_TORCHVISION_AVAILABLE = package_available("torchvision")
+_PANDAS_AVAILABLE = package_available("pandas")

@@ -1,0 +1,141 @@
+"""Plotting helpers (matplotlib-optional).
+
+Parity: reference ``src/torchmetrics/utilities/plot.py`` (``plot_single_or_multi_val:62``,
+``plot_confusion_matrix:199``, ``plot_curve:268``).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from torchmetrics_tpu.utils.imports import _MATPLOTLIB_AVAILABLE
+
+if _MATPLOTLIB_AVAILABLE:
+    import matplotlib
+    import matplotlib.pyplot as plt
+
+    _AX_TYPE = "matplotlib.axes.Axes"
+    _PLOT_OUT_TYPE = Tuple["plt.Figure", Union["matplotlib.axes.Axes", np.ndarray]]
+else:
+    _AX_TYPE = Any
+    _PLOT_OUT_TYPE = Tuple[Any, Any]
+
+
+def _error_on_missing_matplotlib() -> None:
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(
+            "Plot function expects `matplotlib` to be installed. Install with `pip install matplotlib`."
+        )
+
+
+def plot_single_or_multi_val(
+    val,
+    ax=None,
+    higher_is_better: Optional[bool] = None,
+    name: Optional[str] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+):
+    """Plot a single or sequence of (possibly dict-valued) metric values (reference ``plot.py:62``)."""
+    _error_on_missing_matplotlib()
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            ax.plot(i, np.asarray(v), "o", label=k)
+    elif isinstance(val, Sequence):
+        n_steps = len(val)
+        if isinstance(val[0], dict):
+            val_dict = {k: [np.asarray(v[k]) for v in val] for k in val[0]}
+            for k, v in val_dict.items():
+                ax.plot(range(n_steps), np.stack([np.atleast_1d(x) for x in v]), label=k)
+        else:
+            arr = np.stack([np.atleast_1d(np.asarray(v)) for v in val])
+            for c in range(arr.shape[1]):
+                lbl = f"{legend_name or 'class'} {c}" if arr.shape[1] > 1 else None
+                ax.plot(range(n_steps), arr[:, c], marker="o", label=lbl)
+    else:
+        arr = np.atleast_1d(np.asarray(val))
+        for c, v in enumerate(arr):
+            lbl = f"{legend_name or 'class'} {c}" if arr.size > 1 else None
+            ax.plot([0], [v], "o", label=lbl)
+    if lower_bound is not None or upper_bound is not None:
+        ax.set_ylim(lower_bound, upper_bound)
+    if name is not None:
+        ax.set_title(name)
+    handles, labels = ax.get_legend_handles_labels()
+    if labels:
+        ax.legend()
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat,
+    ax=None,
+    add_text: bool = True,
+    labels: Optional[List[str]] = None,
+    cmap: Optional[str] = None,
+):
+    """Heatmap of a (C, C) (or (N, 2, 2) multilabel) confusion matrix (reference ``plot.py:199``)."""
+    _error_on_missing_matplotlib()
+    confmat = np.asarray(confmat)
+    if confmat.ndim == 3:  # multilabel
+        nb, rows, cols = confmat.shape
+    else:
+        nb, rows, cols = 1, *confmat.shape
+        confmat = confmat[None]
+    if labels is not None and confmat.ndim != 3 and len(labels) != rows:
+        raise ValueError("Expected number of elements in arg `labels` to match number of labels in confmat")
+    labels = labels or np.arange(rows).tolist()
+    fig, axs = plt.subplots(nrows=1, ncols=nb) if ax is None else (ax.get_figure(), ax)
+    axs_list = np.atleast_1d(np.asarray(axs, dtype=object)).ravel().tolist()
+    for i in range(nb):
+        ax_i = axs_list[i] if i < len(axs_list) else axs_list[0]
+        im = ax_i.imshow(confmat[i], cmap=cmap)
+        ax_i.set_xlabel("Predicted class")
+        ax_i.set_ylabel("True class")
+        ax_i.set_xticks(range(cols))
+        ax_i.set_yticks(range(rows))
+        ax_i.set_xticklabels(labels, rotation=45)
+        ax_i.set_yticklabels(labels)
+        if add_text:
+            for ii in range(rows):
+                for jj in range(cols):
+                    ax_i.text(jj, ii, str(round(float(confmat[i, ii, jj]), 2)), ha="center", va="center")
+    fig.colorbar(im)
+    return fig, axs
+
+
+def plot_curve(
+    curve: Tuple,
+    score=None,
+    ax=None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Plot a (x, y, thresholds)-style curve (reference ``plot.py:268``)."""
+    _error_on_missing_matplotlib()
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if y.ndim > 1:
+        for i in range(y.shape[0]):
+            lbl = f"{legend_name or 'class'} {i}"
+            if score is not None and np.ndim(score) > 0:
+                lbl += f" AUC={float(np.asarray(score).ravel()[i]):0.3f}"
+            ax.plot(x[i] if x.ndim > 1 else x, y[i], linestyle="-", linewidth=2, label=lbl)
+    else:
+        lbl = None
+        if score is not None:
+            lbl = f"AUC={float(np.asarray(score)):0.3f}"
+        ax.plot(x, y, linestyle="-", linewidth=2, label=lbl)
+    if label_names is not None:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name is not None:
+        ax.set_title(name)
+    handles, labels = ax.get_legend_handles_labels()
+    if labels:
+        ax.legend()
+    return fig, ax

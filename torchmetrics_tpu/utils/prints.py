@@ -1,0 +1,64 @@
+"""Rank-gated printing / warning helpers.
+
+Parity: reference ``src/torchmetrics/utilities/prints.py:22-73``. TPU-native twist: the rank is
+``jax.process_index()`` when JAX is initialised, falling back to the usual env vars so the helpers
+work before distributed init.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _get_rank() -> int:
+    for env in ("LOCAL_RANK", "RANK", "PROCESS_ID"):
+        if env in os.environ:
+            try:
+                return int(os.environ[env])
+            except ValueError:
+                pass
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 5, **kwargs: Any) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, **kwargs: Any) -> None:
+    log.info(message, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, **kwargs: Any) -> None:
+    log.debug(message, **kwargs)
+
+
+def _future_warning(message: str) -> None:
+    warnings.warn(message, FutureWarning, stacklevel=5)
+
+
+rank_zero_deprecation = rank_zero_only(partial(_future_warning))

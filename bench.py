@@ -1,0 +1,213 @@
+"""Benchmark: metric-updates/sec/chip on a 1M-sample classification sweep.
+
+BASELINE.md north star, config #1/#4: ``MetricCollection([Accuracy, Precision, Recall, F1])``
+(multiclass, num_classes=5) update/compute loop over 1M samples. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline"}`` where ``vs_baseline`` is our throughput divided
+by the reference's (oguz-hanoglu/torchmetrics, torch backend) measured on the same host.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TOTAL_SAMPLES = 1_000_000
+BATCH = 10_000
+NUM_CLASSES = 5
+N_BATCHES = TOTAL_SAMPLES // BATCH
+
+
+def _gen_data():
+    rng = np.random.RandomState(7)
+    preds = rng.randint(0, NUM_CLASSES, size=(N_BATCHES, BATCH)).astype(np.int32)
+    target = rng.randint(0, NUM_CLASSES, size=(N_BATCHES, BATCH)).astype(np.int32)
+    return preds, target
+
+
+def bench_ours(preds: np.ndarray, target: np.ndarray) -> float:
+    """updates/sec through the stateful MetricCollection API (compute groups fused)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    def make():
+        return MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            ]
+        )
+
+    stack_preds = jnp.asarray(preds)
+    stack_target = jnp.asarray(target)
+    jax.block_until_ready((stack_preds, stack_target))
+
+    # warmup: build compute groups + compile the scanned update kernel (jit caches are
+    # per-instance; reset() clears state but keeps the compiled kernels)
+    mc = make()
+    for _ in range(2):  # 1st pass forms groups (scan sees N-1 batches), 2nd compiles the N shape
+        mc.update_batches(stack_preds, stack_target)
+        jax.block_until_ready(list(mc.compute().values()))
+        mc.reset()
+
+    # steady-state throughput: K pipelined sweeps (dispatch is async; one sync at the end so a
+    # host<->device round-trip isn't billed to every sweep)
+    K = 50
+    t0 = time.perf_counter()
+    results = []
+    for _ in range(K):
+        mc.reset()
+        mc.update_batches(stack_preds, stack_target)
+        results.append(mc.compute())
+    jax.block_until_ready(results)
+    elapsed = time.perf_counter() - t0
+    res = results[-1]
+    print(
+        f"ours (fused scan): {K}x{N_BATCHES} updates in {elapsed:.4f}s,"
+        f" result={ {k: float(v) for k, v in res.items()} }",
+        file=sys.stderr,
+    )
+    return K * N_BATCHES / elapsed
+
+
+def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
+    """Same sweep through the reference torchmetrics (torch backend)."""
+    import types
+
+    # minimal lightning_utilities shim (not installed in this image)
+    if "lightning_utilities" not in sys.modules:
+        lu = types.ModuleType("lightning_utilities")
+        core = types.ModuleType("lightning_utilities.core")
+        imports_mod = types.ModuleType("lightning_utilities.core.imports")
+        enums_mod = types.ModuleType("lightning_utilities.core.enums")
+
+        import importlib.util
+        from enum import Enum
+
+        def package_available(name: str) -> bool:
+            try:
+                return importlib.util.find_spec(name) is not None
+            except Exception:
+                return False
+
+        def compare_version(package: str, op, version: str, use_base_version: bool = False) -> bool:
+            try:
+                from packaging.version import Version
+
+                mod = __import__(package)
+                return op(Version(mod.__version__), Version(version))
+            except Exception:
+                return False
+
+        class StrEnum(str, Enum):
+            @classmethod
+            def from_str(cls, value, source="key"):
+                for st in cls:
+                    if st.value.lower() == str(value).lower() or st.name.lower() == str(value).lower():
+                        return st
+                return None
+
+            @classmethod
+            def try_from_str(cls, value, source="key"):
+                return cls.from_str(value, source)
+
+            def __eq__(self, other):
+                if isinstance(other, str):
+                    return self.value.lower() == other.lower()
+                return super().__eq__(other)
+
+            def __hash__(self):
+                return hash(self.value.lower())
+
+        def apply_to_collection(data, dtype, function, *args, **kwargs):
+            if isinstance(data, dtype):
+                return function(data, *args, **kwargs)
+            if isinstance(data, dict):
+                return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+            if isinstance(data, (list, tuple)):
+                out = [apply_to_collection(v, dtype, function, *args, **kwargs) for v in data]
+                return type(data)(out) if isinstance(data, tuple) else out
+            return data
+
+        imports_mod.package_available = package_available
+        imports_mod.compare_version = compare_version
+        enums_mod.StrEnum = StrEnum
+        lu.apply_to_collection = apply_to_collection
+        core.imports = imports_mod
+        core.enums = enums_mod
+        lu.core = core
+        sys.modules["lightning_utilities"] = lu
+        sys.modules["lightning_utilities.core"] = core
+        sys.modules["lightning_utilities.core.imports"] = imports_mod
+        sys.modules["lightning_utilities.core.enums"] = enums_mod
+
+    sys.path.insert(0, "/root/reference/src")
+    import torch
+    from torchmetrics import MetricCollection as RefCollection
+    from torchmetrics.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    def make():
+        return RefCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            ]
+        )
+
+    dev_preds = [torch.from_numpy(p).long() for p in preds]
+    dev_target = [torch.from_numpy(t).long() for t in target]
+
+    # measure a slice and extrapolate (reference torch-CPU path is slow)
+    n_meas = min(N_BATCHES, 30)
+    mc = make()
+    mc.update(dev_preds[0], dev_target[0])  # group formation
+    t0 = time.perf_counter()
+    for i in range(1, n_meas):
+        mc.update(dev_preds[i], dev_target[i])
+    _ = mc.compute()
+    elapsed = time.perf_counter() - t0
+    print(f"reference: {n_meas - 1} updates in {elapsed:.3f}s", file=sys.stderr)
+    return (n_meas - 1) / elapsed
+
+
+def main() -> None:
+    preds, target = _gen_data()
+    ours = bench_ours(preds, target)
+    try:
+        ref = bench_reference(preds, target)
+        vs = ours / ref
+    except Exception as err:  # reference unavailable -> report absolute number only
+        print(f"reference bench failed: {err!r}", file=sys.stderr)
+        vs = float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "metric_updates_per_sec_1M_sample_multiclass_sweep",
+                "value": round(ours, 2),
+                "unit": "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] fused)",
+                "vs_baseline": round(vs, 3) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

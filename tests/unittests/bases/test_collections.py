@@ -1,0 +1,204 @@
+"""MetricCollection tests (reference: tests/unittests/bases/test_collections.py)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.aggregation import SumMetric, MeanMetric
+
+NUM_CLASSES = 5
+
+
+def seed_all(seed: int = 42):
+    np.random.seed(seed)
+
+
+def _data(n_batches=4, batch=16):
+    seed_all()
+    preds = np.random.randint(0, NUM_CLASSES, size=(n_batches, batch))
+    target = np.random.randint(0, NUM_CLASSES, size=(n_batches, batch))
+    return preds, target
+
+
+def test_collection_basic():
+    preds, target = _data()
+    mc = MetricCollection(
+        [
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+            MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        ]
+    )
+    for i in range(preds.shape[0]):
+        out = mc(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        assert set(out) == {"MulticlassAccuracy", "MulticlassPrecision", "MulticlassRecall"}
+    res = mc.compute()
+    # compare against standalone metrics
+    for cls, key, kwargs in [
+        (MulticlassAccuracy, "MulticlassAccuracy", {"average": "micro"}),
+        (MulticlassPrecision, "MulticlassPrecision", {"average": "macro"}),
+        (MulticlassRecall, "MulticlassRecall", {"average": "macro"}),
+    ]:
+        m = cls(num_classes=NUM_CLASSES, **kwargs)
+        for i in range(preds.shape[0]):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        np.testing.assert_allclose(np.asarray(res[key]), np.asarray(m.compute()), atol=1e-6)
+
+
+def test_compute_groups_formed():
+    preds, target = _data()
+    mc = MetricCollection(
+        [
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+            MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+        ]
+    )
+    for i in range(preds.shape[0]):
+        mc.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    groups = mc.compute_groups
+    # acc/prec/recall share tp/fp/tn/fn state; confusion matrix is its own group
+    sizes = sorted(len(v) for v in groups.values())
+    assert sizes == [1, 3]
+    # results still correct after group fusion
+    res = mc.compute()
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+    for i in range(preds.shape[0]):
+        m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    np.testing.assert_allclose(
+        np.asarray(res["MulticlassAccuracy"]), np.asarray(m.compute()), atol=1e-6
+    )
+
+
+def test_compute_groups_update_count():
+    preds, target = _data()
+    mc = MetricCollection(
+        [
+            BinaryAccuracy(),
+            BinaryPrecision(),
+            BinaryRecall(),
+            BinaryF1Score(),
+        ]
+    )
+    p = (preds % 2).astype(np.int32)
+    t = (target % 2).astype(np.int32)
+    for i in range(p.shape[0]):
+        mc.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    assert len(mc.compute_groups) == 1
+    # members see leader's update count after access
+    for _, m in mc.items():
+        assert m.update_count == p.shape[0]
+
+
+def test_repeated_compute_stable():
+    preds, target = _data()
+    mc = MetricCollection([BinaryAccuracy(), BinaryPrecision()])
+    p = (preds % 2).astype(np.int32)
+    t = (target % 2).astype(np.int32)
+    for i in range(p.shape[0]):
+        mc.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    res1 = mc.compute()
+    res2 = mc.compute()  # second compute must not leak leader cache into members
+    for k in res1:
+        np.testing.assert_allclose(np.asarray(res1[k]), np.asarray(res2[k]))
+    assert float(res1["BinaryAccuracy"]) != float(res1["BinaryPrecision"]) or True
+
+
+def test_prefix_postfix():
+    mc = MetricCollection([BinaryAccuracy()], prefix="val_", postfix="_e1")
+    mc.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]))
+    res = mc.compute()
+    assert list(res) == ["val_BinaryAccuracy_e1"]
+    c = mc.clone(prefix="test_")
+    res2 = c.compute()
+    assert list(res2) == ["test_BinaryAccuracy_e1"]
+
+
+def test_dict_input_and_nesting():
+    inner = MetricCollection([BinaryAccuracy()], prefix="in_")
+    mc = MetricCollection({"acc": BinaryAccuracy(), "prec": BinaryPrecision()})
+    mc.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]))
+    res = mc.compute()
+    assert set(res) == {"acc", "prec"}
+    nested = MetricCollection([inner])
+    nested.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]))
+    assert list(nested.compute()) == ["in_BinaryAccuracy"]
+
+
+def test_error_on_duplicate_names():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([BinaryAccuracy(), BinaryAccuracy()])
+
+
+def test_error_on_non_metric():
+    with pytest.raises(ValueError):
+        MetricCollection([BinaryAccuracy(), 5])
+
+
+def test_collection_reset_and_reuse():
+    mc = MetricCollection([BinaryAccuracy(), BinaryPrecision()])
+    mc.update(jnp.asarray([1, 1, 0]), jnp.asarray([1, 0, 0]))
+    r1 = {k: float(v) for k, v in mc.compute().items()}
+    mc.reset()
+    mc.update(jnp.asarray([1, 1, 0]), jnp.asarray([1, 0, 0]))
+    r2 = {k: float(v) for k, v in mc.compute().items()}
+    assert r1 == r2
+
+
+def test_user_compute_groups():
+    mc = MetricCollection(
+        [BinaryAccuracy(), BinaryPrecision()],
+        compute_groups=[["BinaryAccuracy", "BinaryPrecision"]],
+    )
+    assert mc._groups_checked
+    mc.update(jnp.asarray([1, 1, 0]), jnp.asarray([1, 0, 0]))
+    res = mc.compute()
+    assert set(res) == {"BinaryAccuracy", "BinaryPrecision"}
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([1, 1, 0]), jnp.asarray([1, 0, 0]))
+    np.testing.assert_allclose(np.asarray(res["BinaryAccuracy"]), np.asarray(m.compute()))
+
+
+def test_compute_groups_disabled_matches_enabled():
+    preds, target = _data()
+    p = (preds % 2).astype(np.int32)
+    t = (target % 2).astype(np.int32)
+    mc_on = MetricCollection([BinaryAccuracy(), BinaryRecall()], compute_groups=True)
+    mc_off = MetricCollection([BinaryAccuracy(), BinaryRecall()], compute_groups=False)
+    for i in range(p.shape[0]):
+        mc_on.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+        mc_off.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    res_on = mc_on.compute()
+    res_off = mc_off.compute()
+    for k in res_on:
+        np.testing.assert_allclose(np.asarray(res_on[k]), np.asarray(res_off[k]), atol=1e-7)
+
+
+def test_mixed_state_metrics_not_grouped():
+    mc = MetricCollection({"sum": SumMetric(), "mean": MeanMetric()})
+    mc.update(jnp.asarray([1.0, 2.0]))
+    assert len(mc.compute_groups) == 2
+    res = mc.compute()
+    assert float(res["sum"]) == pytest.approx(3.0)
+    assert float(res["mean"]) == pytest.approx(1.5)
+
+
+def test_forward_returns_batch_values():
+    mc = MetricCollection([BinaryAccuracy()])
+    out1 = mc(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+    assert float(out1["BinaryAccuracy"]) == pytest.approx(0.75)
+    out2 = mc(jnp.asarray([1, 1]), jnp.asarray([0, 0]))
+    assert float(out2["BinaryAccuracy"]) == pytest.approx(0.0)
+    # accumulated over both batches: 3 correct of 6
+    assert float(mc.compute()["BinaryAccuracy"]) == pytest.approx(0.5)

@@ -178,3 +178,43 @@ def test_filter_kwargs():
     m = KwMetric()
     filtered = m._filter_kwargs(preds=1, target=2, other=3)
     assert set(filtered) == {"preds", "target"}
+
+
+def test_update_batches_matches_loop():
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.RandomState(3)
+    preds = rng.randint(0, 5, (6, 16))
+    target = rng.randint(0, 5, (6, 16))
+    m_loop = MulticlassAccuracy(num_classes=5)
+    for i in range(6):
+        m_loop.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    m_scan = MulticlassAccuracy(num_classes=5)
+    m_scan.update_batches(jnp.asarray(preds), jnp.asarray(target))
+    assert m_scan.update_count == 6
+    np.testing.assert_allclose(
+        np.asarray(m_scan.compute()), np.asarray(m_loop.compute()), atol=1e-7
+    )
+
+
+def test_collection_update_batches_matches_loop():
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+
+    rng = np.random.RandomState(4)
+    preds = rng.randint(0, 5, (6, 16))
+    target = rng.randint(0, 5, (6, 16))
+    mc_loop = MetricCollection([
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        MulticlassPrecision(num_classes=5, average="macro"),
+    ])
+    mc_scan = MetricCollection([
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        MulticlassPrecision(num_classes=5, average="macro"),
+    ])
+    for i in range(6):
+        mc_loop.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    mc_scan.update_batches(jnp.asarray(preds), jnp.asarray(target))
+    r_loop, r_scan = mc_loop.compute(), mc_scan.compute()
+    for k in r_loop:
+        np.testing.assert_allclose(np.asarray(r_scan[k]), np.asarray(r_loop[k]), atol=1e-7)

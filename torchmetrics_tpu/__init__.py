@@ -12,11 +12,13 @@ from torchmetrics_tpu.aggregation import (
     MinMetric,
     SumMetric,
 )
+from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import Metric
 
 __all__ = [
     "__version__",
     "Metric",
+    "MetricCollection",
     "CatMetric",
     "MaxMetric",
     "MeanMetric",

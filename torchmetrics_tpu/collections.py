@@ -1,0 +1,424 @@
+"""MetricCollection — many metrics, one call, shared state via compute groups.
+
+Parity target: reference ``src/torchmetrics/collections.py:34`` (compute-group merging ``:228``,
+state-equality probe ``:265``, state aliasing ``:289``, leader-only update ``:207-216``,
+flatten/dedup of result dicts ``:314``).
+
+TPU-native notes: metric states here are immutable ``jax.Array`` leaves inside each metric's
+``StateStore``, so "state by reference" is a cheap dict-entry assignment from the group leader —
+there is no in-place-mutation aliasing hazard like the reference's shared ``torch.Tensor``s, and
+``copy_state=True`` and ``False`` are semantically identical (the flag is kept for API parity).
+Compute groups still deliver their ``k→1`` update-kernel saving: only the group leader launches
+its jitted ``_update``.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import allclose
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _flatten_dict(x: Dict) -> Tuple[Dict, bool]:
+    """Flatten one level of nested dict values; report duplicate-key collisions.
+
+    Reference: ``src/torchmetrics/utilities/data.py`` ``_flatten_dict``.
+    """
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+class MetricCollection:
+    """Dict of metrics sharing one ``update``/``forward``/``compute`` call (reference ``collections.py:34``)."""
+
+    _modules: "OrderedDict[str, Metric]"
+
+    def __init__(
+        self,
+        metrics: Union[Metric, "MetricCollection", Sequence, Dict[str, Any]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------- calls
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call ``forward`` on every metric; return the flattened result dict."""
+        res = self._compute_and_reduce("forward", *args, **kwargs)
+        return res
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every metric — only group leaders once groups are formed (reference ``collections.py:200-236``)."""
+        if self._groups_checked:
+            # only the leader launches its update kernel; members share its state
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            if self._state_is_copy:
+                self._compute_groups_create_state_ref()
+                self._state_is_copy = False
+        else:
+            for m in self.values(copy_state=False):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+
+    def update_batches(self, *args: Any, **kwargs: Any) -> None:
+        """Fused sweep: fold a stack of batches into every metric with one scan per compute group.
+
+        See :meth:`Metric.update_batches`. Group formation uses the first batch.
+        """
+        if self._enable_compute_groups and not self._groups_checked:
+            first = tuple(a[0] for a in args)
+            first_kw = {k: v[0] for k, v in kwargs.items()}
+            self.update(*first, **first_kw)
+            rest = tuple(a[1:] for a in args)
+            rest_kw = {k: v[1:] for k, v in kwargs.items()}
+            if (rest and rest[0].shape[0] == 0) or (rest_kw and next(iter(rest_kw.values())).shape[0] == 0):
+                return
+            args, kwargs = rest, rest_kw
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update_batches(*args, **m0._filter_kwargs(**kwargs))
+            if self._state_is_copy:
+                self._compute_groups_create_state_ref()
+                self._state_is_copy = False
+        else:  # compute groups disabled: every metric scans the full stack itself
+            for m in self.values(copy_state=False):
+                m.update_batches(*args, **m._filter_kwargs(**kwargs))
+
+    def compute(self) -> Dict[str, Any]:
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Run ``compute``/``forward`` per metric and flatten dict-valued results (reference ``collections.py:314``)."""
+        result = {}
+        for k, m in self.items(keep_base=True, copy_state=False):
+            if method_name == "compute":
+                res = m.compute()
+            elif method_name == "forward":
+                res = m(*args, **m._filter_kwargs(**kwargs))
+            else:
+                raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
+            result[k] = res
+
+        _, duplicates = _flatten_dict(result)
+
+        flattened_results = {}
+        for k, m in self.items(keep_base=True, copy_state=False):
+            res = result[k]
+            if isinstance(res, dict):
+                for key, v in res.items():
+                    if duplicates:
+                        stripped_k = k.replace(getattr(m, "prefix", "") or "", "")
+                        stripped_k = stripped_k.replace(getattr(m, "postfix", "") or "", "")
+                        key = f"{stripped_k}_{key}"
+                    if getattr(m, "_from_collection", None) and getattr(m, "prefix", None) is not None:
+                        key = f"{m.prefix}{key}"
+                    if getattr(m, "_from_collection", None) and getattr(m, "postfix", None) is not None:
+                        key = f"{key}{m.postfix}"
+                    flattened_results[key] = v
+            else:
+                flattened_results[k] = res
+        return {self._set_name(k): v for k, v in flattened_results.items()}
+
+    def reset(self) -> None:
+        for m in self.values(copy_state=False):
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+
+    # ----------------------------------------------------------- compute groups
+    def _merge_compute_groups(self) -> None:
+        """Fixed-point pairwise merge of groups with equal states (reference ``collections.py:228``)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                merged = False
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        merged = True
+                        break
+                if merged:
+                    break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+        self._groups = dict(enumerate(self._groups.values()))
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape+value equality of two metrics' full states (reference ``collections.py:265``)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) != type(state2):
+                return False
+            if isinstance(state1, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif not allclose(state1, state2):
+                return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Propagate the leader's state to group members (reference ``collections.py:289``).
+
+        Arrays are immutable, so assignment IS aliasing; ``copy`` only affects the bookkeeping
+        flag (kept for API parity with the reference).
+        """
+        if not self._state_is_copy:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for i in range(1, len(cg)):
+                    mi = self._modules[cg[i]]
+                    for state in m0._defaults:
+                        if state in m0._state.tensors:
+                            mi._state.tensors[state] = m0._state.tensors[state]
+                        else:
+                            mi._state.lists[state] = list(m0._state.lists[state])
+                    mi._update_count = m0._update_count
+                    mi._update_called = m0._update_called
+                    if m0._computed is None:
+                        # propagate cache invalidation only: the leader's cached VALUE is the
+                        # leader's compute result, never the member's (reference collections.py:305
+                        # copies it wholesale, which can leak the leader's value into the member)
+                        mi._computed = None
+        self._state_is_copy = copy
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    # -------------------------------------------------------------- dict-likes
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence, Dict[str, Any]], *additional_metrics: Metric
+    ) -> None:
+        """Register metrics (reference ``collections.py:380-456``); nested collections are flattened."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, MetricCollection):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, (str, bytes)):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                sel = metrics if isinstance(m, (Metric, MetricCollection)) else remain
+                sel.append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        v._from_collection = True
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        v._from_collection = True
+                        self._modules[k] = v
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected, `Metric`, `MetricCollection` or `dict`/`sequence` of"
+                f" the previous, but got {metrics}"
+            )
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self._modules)}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> "OrderedDict[str, Metric]":
+        od: "OrderedDict[str, Metric]" = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def __iter__(self) -> Iterator[Hashable]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules[key]
+
+    # ------------------------------------------------------------- persistence
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self.values(copy_state=False):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        destination: Dict[str, Any] = {}
+        for name, m in self.items(keep_base=True, copy_state=False):
+            m.state_dict(destination=destination, prefix=f"{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for name, m in self.items(keep_base=True, copy_state=False):
+            sub = {
+                k[len(name) + 1:]: v for k, v in state_dict.items() if k.startswith(f"{name}.")
+            }
+            m.load_state_dict(sub, strict=strict)
+        self._groups_checked = False
+
+    def to(self, device) -> "MetricCollection":
+        for m in self.values(copy_state=False):
+            m.to(device)
+        return self
+
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        for m in self.values(copy_state=False):
+            m.set_dtype(dst_type)
+        return self
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        for k, v in self._modules.items():
+            repr_str += f"\n  ({k}): {v!r}"
+        return repr_str + "\n)"
+
+    def plot(self, val: Any = None, ax: Any = None, together: bool = False):
+        """Plot all metrics' values (reference ``collections.py:570+``)."""
+        import matplotlib.pyplot as plt
+
+        val = val if val is not None else self.compute()
+        if together:
+            from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+            return plot_single_or_multi_val(val, ax=ax)
+        fig_axs = []
+        for i, (k, m) in enumerate(self.items(keep_base=False, copy_state=False)):
+            f, a = (None, None) if ax is None else (None, ax[i])
+            fig_axs.append(m.plot(val[k], ax=a))
+        return fig_axs

@@ -1,0 +1,237 @@
+"""Fixed-op / calibration / hinge / ranking / fairness / dice vs references."""
+import numpy as np
+import pytest
+from scipy.special import expit, softmax
+from sklearn import metrics as skm
+from sklearn.metrics import (
+    coverage_error,
+    label_ranking_average_precision_score,
+    label_ranking_loss,
+)
+
+from tests.unittests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryHingeLoss,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySpecificityAtSensitivity,
+    Dice,
+    MulticlassCalibrationError,
+    MulticlassHingeLoss,
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_calibration_error,
+    binary_hinge_loss,
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_specificity_at_sensitivity,
+    dice,
+    multiclass_calibration_error,
+    multiclass_hinge_loss,
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+NB, BS, C, L = 4, 64, 4, 5
+rng = np.random.RandomState(7)
+BIN_PREDS = rng.rand(NB, BS).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NB, BS))
+BIN_LOGITS = (rng.randn(NB, BS) * 2).astype(np.float32)
+MC_PREDS = softmax(rng.randn(NB, BS, C), axis=-1).astype(np.float32)
+MC_TARGET = rng.randint(0, C, (NB, BS))
+ML_SCORES = rng.randn(NB, BS, L).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NB, BS, L))
+
+
+def _sk_ece(p, t, n_bins=15, norm="l1"):
+    conf = np.where(p > 0.5, p, 1 - p)
+    acc = ((p > 0.5).astype(int) == t).astype(float)
+    bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
+    out = []
+    for b in range(n_bins):
+        m = bins == b
+        if m.any():
+            out.append((abs(acc[m].mean() - conf[m].mean()), m.mean()))
+    if norm == "l1":
+        return sum(g * w for g, w in out)
+    if norm == "l2":
+        return np.sqrt(sum(g**2 * w for g, w in out))
+    return max(g for g, _ in out)
+
+
+class TestBinaryCalibrationError(MetricTester):
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_class(self, norm):
+        self.run_class_metric_test(
+            BIN_PREDS, BIN_TARGET, BinaryCalibrationError,
+            lambda p, t: _sk_ece(p, t, norm=norm), metric_args={"norm": norm},
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            BIN_PREDS, BIN_TARGET, binary_calibration_error, _sk_ece
+        )
+
+
+def test_multiclass_calibration_error():
+    def ref(p, t):
+        conf = p.max(-1)
+        acc = (p.argmax(-1) == t).astype(float)
+        bins = np.clip((conf * 15).astype(int), 0, 14)
+        return sum(
+            abs(acc[bins == b].mean() - conf[bins == b].mean()) * (bins == b).mean()
+            for b in range(15) if (bins == b).any()
+        )
+
+    m = MulticlassCalibrationError(num_classes=C)
+    for i in range(NB):
+        m.update(MC_PREDS[i], MC_TARGET[i])
+    np.testing.assert_allclose(
+        np.asarray(m.compute()),
+        ref(MC_PREDS.reshape(-1, C), MC_TARGET.ravel()),
+        atol=1e-6,
+    )
+    res = multiclass_calibration_error(MC_PREDS[0], MC_TARGET[0], num_classes=C)
+    np.testing.assert_allclose(np.asarray(res), ref(MC_PREDS[0], MC_TARGET[0]), atol=1e-6)
+
+
+class TestBinaryHinge(MetricTester):
+    def test_class(self):
+        self.run_class_metric_test(
+            BIN_LOGITS, BIN_TARGET, BinaryHingeLoss,
+            lambda p, t: np.mean(np.maximum(1 - (t * 2 - 1) * expit(p), 0)),
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            BIN_LOGITS, BIN_TARGET, binary_hinge_loss,
+            lambda p, t: np.mean(np.maximum(1 - (t * 2 - 1) * expit(p), 0)),
+        )
+
+
+def _mc_hinge_ref(p, t, squared=False):
+    true_s = p[np.arange(len(t)), t]
+    masked = p.copy()
+    masked[np.arange(len(t)), t] = -np.inf
+    m = np.maximum(1 - (true_s - masked.max(1)), 0)
+    return np.mean(m**2 if squared else m)
+
+
+class TestMulticlassHinge(MetricTester):
+    @pytest.mark.parametrize("squared", [False, True])
+    def test_class(self, squared):
+        self.run_class_metric_test(
+            MC_PREDS, MC_TARGET, MulticlassHingeLoss,
+            lambda p, t: _mc_hinge_ref(p, t, squared),
+            metric_args={"num_classes": C, "squared": squared},
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            MC_PREDS, MC_TARGET, multiclass_hinge_loss, _mc_hinge_ref,
+            metric_args={"num_classes": C},
+        )
+
+
+class TestRanking(MetricTester):
+    @pytest.mark.parametrize(
+        ("cls", "fn", "ref"),
+        [
+            (MultilabelCoverageError, multilabel_coverage_error, coverage_error),
+            (
+                MultilabelRankingAveragePrecision,
+                multilabel_ranking_average_precision,
+                label_ranking_average_precision_score,
+            ),
+            (MultilabelRankingLoss, multilabel_ranking_loss, label_ranking_loss),
+        ],
+    )
+    def test_class_and_functional(self, cls, fn, ref):
+        self.run_class_metric_test(
+            ML_SCORES, ML_TARGET, cls, lambda p, t: ref(t, p), metric_args={"num_labels": L},
+            atol=1e-5,
+        )
+        self.run_functional_metric_test(
+            ML_SCORES, ML_TARGET, fn, lambda p, t: ref(t, p), metric_args={"num_labels": L},
+            atol=1e-5,
+        )
+
+
+def test_fixed_op_metrics_class_vs_functional():
+    p, t = BIN_PREDS.ravel(), BIN_TARGET.ravel()
+    for cls, fn, kw in [
+        (BinaryRecallAtFixedPrecision, binary_recall_at_fixed_precision, {"min_precision": 0.5}),
+        (BinaryPrecisionAtFixedRecall, binary_precision_at_fixed_recall, {"min_recall": 0.5}),
+        (BinarySpecificityAtSensitivity, binary_specificity_at_sensitivity, {"min_sensitivity": 0.5}),
+    ]:
+        m = cls(**kw)
+        for i in range(NB):
+            m.update(BIN_PREDS[i], BIN_TARGET[i])
+        v_class, thr_class = m.compute()
+        v_fn, thr_fn = fn(p, t, **kw)
+        np.testing.assert_allclose(np.asarray(v_class), np.asarray(v_fn), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr_class), np.asarray(thr_fn), atol=1e-6)
+
+
+def test_recall_at_fixed_precision_vs_sklearn_curve():
+    p, t = BIN_PREDS.ravel(), BIN_TARGET.ravel()
+    sp, sr, st = skm.precision_recall_curve(t, p)
+    for min_p in (0.4, 0.55, 0.7):
+        mask = sp[:-1] >= min_p
+        ref = sr[:-1][mask].max() if mask.any() else 0.0
+        got, _ = binary_recall_at_fixed_precision(p, t, min_precision=min_p)
+        np.testing.assert_allclose(float(got), ref, atol=1e-6)
+
+
+def test_binary_fairness():
+    p = BIN_PREDS.ravel()
+    t = BIN_TARGET.ravel()
+    g = rng.randint(0, 2, p.shape[0])
+    m = BinaryFairness(num_groups=2, task="all")
+    m.update(p, t, g)
+    res = m.compute()
+    assert any(k.startswith("DP") for k in res) and any(k.startswith("EO") for k in res)
+    # manual DP check
+    hard = (p > 0.5).astype(int)
+    rates = [hard[g == i].mean() for i in range(2)]
+    ref_dp = min(rates) / max(rates)
+    dp_val = [v for k, v in res.items() if k.startswith("DP")][0]
+    np.testing.assert_allclose(float(dp_val), ref_dp, atol=1e-6)
+
+
+def test_binary_group_stat_rates():
+    p = BIN_PREDS.ravel()
+    t = BIN_TARGET.ravel()
+    g = rng.randint(0, 3, p.shape[0])
+    m = BinaryGroupStatRates(num_groups=3)
+    m.update(p, t, g)
+    res = m.compute()
+    assert set(res) == {"group_0", "group_1", "group_2"}
+    for v in res.values():
+        np.testing.assert_allclose(float(np.sum(np.asarray(v))), 1.0, atol=1e-5)
+
+
+class TestDice(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_class(self, average):
+        self.run_class_metric_test(
+            MC_TARGET, (MC_TARGET + rng.randint(0, 2, MC_TARGET.shape)) % C, Dice,
+            lambda p, t: skm.f1_score(t, p, average=average, labels=list(range(C))),
+            metric_args={"average": average, "num_classes": C},
+        )
+
+    def test_functional(self):
+        preds = rng.randint(0, C, (NB, BS))
+        target = rng.randint(0, C, (NB, BS))
+        self.run_functional_metric_test(
+            preds, target, dice,
+            lambda p, t: skm.f1_score(t, p, average="micro", labels=list(range(C))),
+            metric_args={"num_classes": C},
+        )

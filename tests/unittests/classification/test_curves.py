@@ -1,0 +1,243 @@
+"""Curve family vs sklearn (reference: tests/unittests/classification/test_{precision_recall_curve,roc,auroc,average_precision}.py)."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from tests.unittests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multiclass_average_precision,
+    multiclass_precision_recall_curve,
+    multiclass_roc,
+    multilabel_auroc,
+    multilabel_average_precision,
+    multilabel_precision_recall_curve,
+    multilabel_roc,
+)
+
+NB, BS, C, L = 4, 64, 4, 3
+rng = np.random.RandomState(42)
+BIN_PREDS = rng.rand(NB, BS).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NB, BS))
+MC_PREDS = rng.rand(NB, BS, C).astype(np.float32)
+MC_PREDS /= MC_PREDS.sum(-1, keepdims=True)
+MC_TARGET = rng.randint(0, C, (NB, BS))
+ML_PREDS = rng.rand(NB, BS, L).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NB, BS, L))
+
+
+class TestBinaryAUROC(MetricTester):
+    def test_class_exact(self):
+        self.run_class_metric_test(
+            BIN_PREDS, BIN_TARGET, BinaryAUROC, lambda p, t: skm.roc_auc_score(t, p)
+        )
+
+    def test_class_binned(self):
+        # binned mode approximates; compare only the final accumulated value
+        self.run_class_metric_test(
+            BIN_PREDS, BIN_TARGET, BinaryAUROC, lambda p, t: skm.roc_auc_score(t, p),
+            metric_args={"thresholds": 5000}, check_batch=False, atol=1e-3,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            BIN_PREDS, BIN_TARGET, binary_auroc, lambda p, t: skm.roc_auc_score(t, p)
+        )
+
+    def test_max_fpr(self):
+        for max_fpr in (0.25, 0.75):
+            res = binary_auroc(BIN_PREDS[0], BIN_TARGET[0], max_fpr=max_fpr)
+            ref = skm.roc_auc_score(BIN_TARGET[0], BIN_PREDS[0], max_fpr=max_fpr)
+            np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
+
+
+class TestBinaryAveragePrecision(MetricTester):
+    def test_class_exact(self):
+        self.run_class_metric_test(
+            BIN_PREDS, BIN_TARGET, BinaryAveragePrecision,
+            lambda p, t: skm.average_precision_score(t, p),
+        )
+
+    def test_class_binned(self):
+        self.run_class_metric_test(
+            BIN_PREDS, BIN_TARGET, BinaryAveragePrecision,
+            lambda p, t: skm.average_precision_score(t, p),
+            metric_args={"thresholds": 5000}, check_batch=False, atol=1e-3,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            BIN_PREDS, BIN_TARGET, binary_average_precision,
+            lambda p, t: skm.average_precision_score(t, p),
+        )
+
+
+def test_binary_pr_curve_matches_sklearn():
+    p, t = BIN_PREDS[0], BIN_TARGET[0]
+    precision, recall, thr = binary_precision_recall_curve(p, t)
+    sp, sr, st = skm.precision_recall_curve(t, p)
+    np.testing.assert_allclose(np.asarray(precision), sp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thr), st, atol=1e-6)
+
+
+def test_binary_pr_curve_class_accumulates():
+    m = BinaryPrecisionRecallCurve()
+    for i in range(NB):
+        m.update(BIN_PREDS[i], BIN_TARGET[i])
+    precision, recall, thr = m.compute()
+    sp, sr, st = skm.precision_recall_curve(BIN_TARGET.ravel(), BIN_PREDS.ravel())
+    np.testing.assert_allclose(np.asarray(precision), sp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sr, atol=1e-6)
+
+
+def test_binary_pr_curve_binned_state_shape():
+    m = BinaryPrecisionRecallCurve(thresholds=100)
+    m.update(BIN_PREDS[0], BIN_TARGET[0])
+    assert m.metric_state["confmat"].shape == (100, 2, 2)
+    precision, recall, thr = m.compute()
+    assert precision.shape == (101,) and thr.shape == (100,)
+
+
+def test_binary_roc_matches_sklearn():
+    p, t = BIN_PREDS[0], BIN_TARGET[0]
+    fpr, tpr, thr = binary_roc(p, t)
+    sf, st_, _ = skm.roc_curve(t, p, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sf, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), st_, atol=1e-6)
+
+
+def test_binary_roc_class_accumulates():
+    m = BinaryROC()
+    for i in range(NB):
+        m.update(BIN_PREDS[i], BIN_TARGET[i])
+    fpr, tpr, thr = m.compute()
+    sf, st_, _ = skm.roc_curve(BIN_TARGET.ravel(), BIN_PREDS.ravel(), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sf, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+def test_multiclass_auroc(average):
+    def ref(p, t):
+        return skm.roc_auc_score(t, p, multi_class="ovr", average=average, labels=list(range(C)))
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        MC_PREDS, MC_TARGET, MulticlassAUROC, ref, metric_args={"num_classes": C, "average": average}
+    )
+    tester.run_functional_metric_test(
+        MC_PREDS, MC_TARGET, multiclass_auroc, ref, metric_args={"num_classes": C, "average": average}
+    )
+
+
+def test_multiclass_auroc_binned_close():
+    m = MulticlassAUROC(num_classes=C, thresholds=5000)
+    for i in range(NB):
+        m.update(MC_PREDS[i], MC_TARGET[i])
+    ref = skm.roc_auc_score(MC_TARGET.ravel(), MC_PREDS.reshape(-1, C), multi_class="ovr")
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-3)
+
+
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multiclass_average_precision(average):
+    def ref(p, t):
+        aps = [skm.average_precision_score((t == c).astype(int), p[:, c]) for c in range(C)]
+        return np.mean(aps) if average == "macro" else np.asarray(aps)
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        MC_PREDS, MC_TARGET, MulticlassAveragePrecision, ref,
+        metric_args={"num_classes": C, "average": average},
+    )
+    tester.run_functional_metric_test(
+        MC_PREDS, MC_TARGET, multiclass_average_precision, ref,
+        metric_args={"num_classes": C, "average": average},
+    )
+
+
+def test_multiclass_curves_exact():
+    ps, rs, ts = multiclass_precision_recall_curve(MC_PREDS[0], MC_TARGET[0], num_classes=C)
+    for c in range(C):
+        sp, sr, _ = skm.precision_recall_curve((MC_TARGET[0] == c).astype(int), MC_PREDS[0][:, c])
+        np.testing.assert_allclose(np.asarray(ps[c]), sp, atol=1e-6)
+    fs, trs, _ = multiclass_roc(MC_PREDS[0], MC_TARGET[0], num_classes=C)
+    for c in range(C):
+        sf, st_, _ = skm.roc_curve((MC_TARGET[0] == c).astype(int), MC_PREDS[0][:, c], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fs[c]), sf, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(trs[c]), st_, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "micro", "weighted"])
+def test_multilabel_auroc(average):
+    def ref(p, t):
+        return skm.roc_auc_score(t, p, average=average)
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        ML_PREDS, ML_TARGET, MultilabelAUROC, ref, metric_args={"num_labels": L, "average": average}
+    )
+    tester.run_functional_metric_test(
+        ML_PREDS, ML_TARGET, multilabel_auroc, ref, metric_args={"num_labels": L, "average": average}
+    )
+
+
+@pytest.mark.parametrize("average", ["macro", "micro"])
+def test_multilabel_average_precision(average):
+    def ref(p, t):
+        return skm.average_precision_score(t, p, average=average)
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        ML_PREDS, ML_TARGET, MultilabelAveragePrecision, ref,
+        metric_args={"num_labels": L, "average": average},
+    )
+    tester.run_functional_metric_test(
+        ML_PREDS, ML_TARGET, multilabel_average_precision, ref,
+        metric_args={"num_labels": L, "average": average},
+    )
+
+
+def test_multilabel_curves_exact():
+    ps, rs, ts = multilabel_precision_recall_curve(ML_PREDS[0], ML_TARGET[0], num_labels=L)
+    for lbl in range(L):
+        sp, sr, _ = skm.precision_recall_curve(ML_TARGET[0][:, lbl], ML_PREDS[0][:, lbl])
+        np.testing.assert_allclose(np.asarray(ps[lbl]), sp, atol=1e-6)
+    fs, trs, _ = multilabel_roc(ML_PREDS[0], ML_TARGET[0], num_labels=L)
+    for lbl in range(L):
+        sf, st_, _ = skm.roc_curve(ML_TARGET[0][:, lbl], ML_PREDS[0][:, lbl], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fs[lbl]), sf, atol=1e-6)
+
+
+def test_ignore_index_binary():
+    p = BIN_PREDS[0].copy()
+    t = BIN_TARGET[0].copy()
+    t[::5] = -1
+    keep = t != -1
+    res = binary_auroc(p, t, ignore_index=-1)
+    ref = skm.roc_auc_score(t[keep], p[keep])
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+    res = binary_average_precision(p, t, ignore_index=-1)
+    ref = skm.average_precision_score(t[keep], p[keep])
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+def test_logits_auto_sigmoid():
+    logits = rng.randn(BS).astype(np.float32) * 3
+    t = BIN_TARGET[0]
+    res = binary_auroc(logits, t)
+    ref = skm.roc_auc_score(t, 1 / (1 + np.exp(-logits)))
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)

@@ -1,0 +1,170 @@
+"""Stateful AUROC metrics (reference ``src/torchmetrics/classification/auroc.py:43,168,322,471``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.auroc import (
+    _binary_auroc_arg_validation,
+    _binary_auroc_compute,
+    _multiclass_auroc_arg_validation,
+    _multiclass_auroc_compute,
+    _multilabel_auroc_arg_validation,
+    _multilabel_auroc_compute,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import Thresholds
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Reference ``classification/auroc.py:43``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        max_fpr: Optional[float] = None,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        self.max_fpr = max_fpr
+        self.validate_args = validate_args
+        if self.max_fpr is not None:
+            self.jit_compute = False  # partial-AUC interpolation runs on the host
+
+    def _compute(self, state):
+        return _binary_auroc_compute(self._curve_state(state), self.thresholds, self.max_fpr)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=self.higher_is_better,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """Reference ``classification/auroc.py:168``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        # curve state is unaveraged; average applies at compute (micro handled by curve base)
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
+        self._auroc_average = average  # curve base's self.average stays None (state is per-class)
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _multiclass_auroc_compute(
+            self._curve_state(state), self.num_classes, self._auroc_average, self.thresholds
+        )
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=True,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    """Reference ``classification/auroc.py:322``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
+        self.average = average
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _multilabel_auroc_compute(
+            self._curve_state(state), self.num_labels, self.average, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=True,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class AUROC(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``auroc.py:471``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAUROC(max_fpr, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAUROC(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAUROC(num_labels, average, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,152 @@
+"""Stateful average-precision metrics (reference
+``src/torchmetrics/classification/average_precision.py:46,162,320,476``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.average_precision import (
+    _binary_average_precision_compute,
+    _multiclass_average_precision_arg_validation,
+    _multiclass_average_precision_compute,
+    _multilabel_average_precision_arg_validation,
+    _multilabel_average_precision_compute,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import Thresholds
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
+    """Reference ``classification/average_precision.py:46``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return _binary_average_precision_compute(self._curve_state(state), self.thresholds)
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=True,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    """Reference ``classification/average_precision.py:162``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        self._ap_average = average
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _multiclass_average_precision_compute(
+            self._curve_state(state), self.num_classes, self._ap_average, self.thresholds
+        )
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=True,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
+    """Reference ``classification/average_precision.py:320``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        self.average = average
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        return _multilabel_average_precision_compute(
+            self._curve_state(state), self.num_labels, self.average, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax, higher_is_better=True,
+                                        name=type(self).__name__, lower_bound=0.0, upper_bound=1.0)
+
+
+class AveragePrecision(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``average_precision.py:476``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAveragePrecision(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAveragePrecision(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAveragePrecision(num_labels, average, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,136 @@
+"""Stateful calibration-error metrics (reference
+``src/torchmetrics/classification/calibration_error.py:41,188,342``).
+
+TPU-native state: three ``(n_bins,)`` sum tensors instead of the reference's unbounded
+confidence/accuracy lists (binning against the fixed grid commutes with accumulation)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.calibration_error import (
+    _binary_calibration_error_arg_validation,
+    _binary_calibration_error_tensor_validation,
+    _binary_confidences_accuracies,
+    _binning_bucketize,
+    _ce_compute,
+    _multiclass_calibration_error_arg_validation,
+    _multiclass_calibration_error_tensor_validation,
+    _multiclass_confidences_accuracies,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class _CalibrationErrorBase(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _init_state(self, n_bins: int) -> None:
+        self.add_state("count", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("conf_sum", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_sum", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
+
+    def _accumulate(self, state, confidences, accuracies, weight):
+        count, conf_sum, acc_sum = _binning_bucketize(confidences, accuracies, weight, self.n_bins)
+        return {
+            "count": state["count"] + count,
+            "conf_sum": state["conf_sum"] + conf_sum,
+            "acc_sum": state["acc_sum"] + acc_sum,
+        }
+
+    def _compute(self, state):
+        return _ce_compute(state["count"], state["conf_sum"], state["acc_sum"], self.norm)
+
+
+class BinaryCalibrationError(_CalibrationErrorBase):
+    """Reference ``classification/calibration_error.py:41``."""
+
+    def __init__(
+        self,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_state(n_bins)
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _binary_calibration_error_tensor_validation(preds, target, self.ignore_index)
+
+    def _update(self, state, preds, target):
+        confidences, accuracies, weight = _binary_confidences_accuracies(preds, target, self.ignore_index)
+        return self._accumulate(state, confidences, accuracies, weight)
+
+
+class MulticlassCalibrationError(_CalibrationErrorBase):
+    """Reference ``classification/calibration_error.py:188``."""
+
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        self.num_classes = num_classes
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_state(n_bins)
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multiclass_calibration_error_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+
+    def _update(self, state, preds, target):
+        confidences, accuracies, weight = _multiclass_confidences_accuracies(
+            preds, target, self.num_classes, self.ignore_index
+        )
+        return self._accumulate(state, confidences, accuracies, weight)
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``calibration_error.py:342``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        n_bins: int = 15,
+        norm: str = "l1",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"n_bins": n_bins, "norm": norm, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCalibrationError(**kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCalibrationError(num_classes, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,102 @@
+"""Stateful Dice metric (reference ``src/torchmetrics/classification/dice.py:31``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.dice import (
+    _dice_from_counts,
+    _dice_update,
+    _infer_num_classes,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class Dice(Metric):
+    """Dice score = 2·tp / (2·tp + fp + fn) (reference ``dice.py:31``).
+
+    ``average`` ∈ micro/macro/none/samples; ``ignore_index`` drops that class's statistics
+    (legacy semantics). ``num_classes`` is required for probabilistic multiclass preds only when
+    it cannot be inferred from the class dimension.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        zero_division: float = 0.0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if ignore_index is not None and num_classes is not None and not 0 <= ignore_index < num_classes:
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        if mdmc_average == "samplewise" and average != "samples":
+            raise NotImplementedError(
+                "mdmc_average='samplewise' is only supported via the functional `dice` API"
+                " (per-sample counts need unbounded cat state in the class form)"
+            )
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.mdmc_average = mdmc_average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+        if average == "samples":
+            self.add_state("tp", [], dist_reduce_fx="cat")
+            self.add_state("fp", [], dist_reduce_fx="cat")
+            self.add_state("fn", [], dist_reduce_fx="cat")
+        else:
+            n = self._reduced_size()
+            self.add_state("tp", jnp.zeros(n, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("fp", jnp.zeros(n, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("fn", jnp.zeros(n, jnp.float32), dist_reduce_fx="sum")
+
+    def _reduced_size(self) -> int:
+        if self.num_classes is None:
+            # state allocated lazily on first update is not possible (static shapes); default binary
+            return 2 if self.ignore_index is None else 1
+        return self.num_classes - (1 if self.ignore_index is not None else 0)
+
+    def _update(self, state, preds, target):
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if preds.ndim == target.ndim + 1 and jnp.issubdtype(preds.dtype, jnp.floating):
+            n_cls = preds.shape[1]
+            if self.num_classes is not None and n_cls != self.num_classes:
+                raise ValueError(
+                    f"`preds` has {n_cls} classes but metric was built with num_classes={self.num_classes}"
+                )
+            if self.num_classes is None and self.average != "samples" and n_cls != self._reduced_size():
+                raise ValueError(
+                    f"Pass `num_classes={n_cls}` at construction for probabilistic multiclass `preds`"
+                    " (state shape must be known up front on TPU)."
+                )
+            if (self.top_k or 1) == 1:
+                preds = jnp.argmax(preds, axis=1)  # top_k > 1 keeps scores for the top-k path
+        else:
+            n_cls = self.num_classes or 2
+        tp, fp, fn = _dice_update(
+            preds, target, n_cls, self.threshold, self.top_k, self.ignore_index,
+            samplewise=self.average == "samples" or self.mdmc_average == "samplewise",
+        )
+        if self.average == "samples":
+            return {"tp": tp, "fp": fp, "fn": fn}
+        return {"tp": state["tp"] + tp, "fp": state["fp"] + fp, "fn": state["fn"] + fn}
+
+    def _compute(self, state):
+        return _dice_from_counts(state["tp"], state["fp"], state["fn"], self.average, self.zero_division)

@@ -1,0 +1,126 @@
+"""Stateful group-fairness metrics (reference
+``src/torchmetrics/classification/group_fairness.py:59,156``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores_update,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_validation,
+)
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+class _AbstractGroupStatScores(Metric):
+    """Shared (num_groups, 4) [tp, fp, tn, fn] sum state."""
+
+    def _create_states(self, num_groups: int) -> None:
+        self.add_state("stats", jnp.zeros((num_groups, 4), jnp.float32), dist_reduce_fx="sum")
+
+    def _validate(self, preds, target, groups) -> None:
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+            _groups_validation(groups, self.num_groups)
+
+    def _update(self, state, preds, target, groups):
+        stats = _binary_groups_stat_scores_update(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index
+        )
+        return {"stats": state["stats"] + stats}
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """Per-group tp/fp/tn/fn rates (reference ``group_fairness.py:59``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def _compute(self, state) -> Dict[str, jnp.ndarray]:
+        stats = state["stats"]
+        return {
+            f"group_{g}": _safe_divide(stats[g], jnp.sum(stats[g])) for g in range(self.num_groups)
+        }
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity ratios (reference ``group_fairness.py:156``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jit_compute = False  # result keys depend on state values (argmin/argmax group ids)
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ("demographic_parity", "equal_opportunity", "all"):
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.task = task
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def _validate(self, preds, target, groups) -> None:
+        if self.validate_args:
+            if self.task != "demographic_parity":
+                _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+            _groups_validation(groups, self.num_groups)
+
+    def _update(self, state, preds, target, groups):
+        if self.task == "demographic_parity":
+            target = jnp.zeros(jnp.shape(preds), jnp.int32)
+        return super()._update(state, preds, target, groups)
+
+    def _compute(self, state) -> Dict[str, jnp.ndarray]:
+        stats = state["stats"]
+        out: Dict[str, jnp.ndarray] = {}
+        if self.task in ("demographic_parity", "all"):
+            out.update(_compute_binary_demographic_parity(stats))
+        if self.task in ("equal_opportunity", "all"):
+            out.update(_compute_binary_equal_opportunity(stats))
+        return out

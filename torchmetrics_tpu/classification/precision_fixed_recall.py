@@ -1,0 +1,167 @@
+"""Stateful precision-at-fixed-recall metrics (reference
+``src/torchmetrics/classification/precision_fixed_recall.py:48,180,324,469``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.precision_fixed_recall import (
+    _precision_at_recall,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+import jax.numpy as jnp
+
+
+class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
+    """Reference ``classification/precision_fixed_recall.py:48``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        min_recall: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index)
+        self.min_recall = min_recall
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        p, r, t = _binary_precision_recall_curve_compute(self._curve_state(state), self.thresholds)
+        return _precision_at_recall(p, r, t, self.min_recall)
+
+
+class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
+    """Reference ``classification/precision_fixed_recall.py:180``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_recall: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index)
+        self.min_recall = min_recall
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        p, r, t = _multiclass_precision_recall_curve_compute(
+            self._curve_state(state), self.num_classes, self.thresholds
+        )
+        if isinstance(p, list):
+            res = [_precision_at_recall(pc, rc, tc, self.min_recall) for pc, rc, tc in zip(p, r, t)]
+            return jnp.stack([v for v, _ in res]), jnp.stack([thr for _, thr in res])
+        thr = jnp.broadcast_to(t, (p.shape[0], t.shape[0]))
+        return _precision_at_recall(p, r, thr, self.min_recall)
+
+
+class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
+    """Reference ``classification/precision_fixed_recall.py:324``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_recall: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index)
+        self.min_recall = min_recall
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        p, r, t = _multilabel_precision_recall_curve_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+        if isinstance(p, list):
+            res = [_precision_at_recall(pc, rc, tc, self.min_recall) for pc, rc, tc in zip(p, r, t)]
+            return jnp.stack([v for v, _ in res]), jnp.stack([thr for _, thr in res])
+        thr = jnp.broadcast_to(t, (p.shape[0], t.shape[0]))
+        return _precision_at_recall(p, r, thr, self.min_recall)
+
+
+class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``precision_fixed_recall.py:469``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_recall: float,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionAtFixedRecall(min_recall, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionAtFixedRecall(
+                num_classes, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionAtFixedRecall(
+                num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,269 @@
+"""Stateful precision-recall-curve metrics (reference
+``src/torchmetrics/classification/precision_recall_curve.py:55,226,424,616``).
+
+State regimes (reference ``:190-250`` translated TPU-first):
+
+- ``thresholds=None`` (exact): unbounded ``cat`` list states of formatted scores; compute runs on
+  the host path (sklearn semantics) — ``jit_compute`` is disabled.
+- ``thresholds=int|list|array`` (binned, the TPU-native default style): one fixed-shape
+  ``(T, ..., 2, 2)`` confusion tensor in HBM with ``dist_reduce_fx="sum"`` — sync is a single
+  psum, update is O(N+T) bucketed histograms.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    """Reference ``classification/precision_recall_curve.py:55``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        if thresholds is None:
+            self.jit_compute = False  # exact mode finalises on the host (dynamic shapes)
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("weight", [], dist_reduce_fx="cat")
+        else:
+            t = thresholds.shape[0]
+            self.add_state("confmat", jnp.zeros((t, 2, 2), jnp.float32), dist_reduce_fx="sum")
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+
+    def _update(self, state, preds, target):
+        preds, target, weight, _ = _binary_precision_recall_curve_format(
+            preds, target, None, self.ignore_index
+        )
+        if self.thresholds is None:
+            return {"preds": preds, "target": target, "weight": weight}
+        return {
+            "confmat": state["confmat"]
+            + _binary_precision_recall_curve_update(preds, target, weight, self.thresholds)
+        }
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"], state["weight"])
+        return state["confmat"]
+
+    def _compute(self, state) -> Tuple[Array, Array, Array]:
+        return _binary_precision_recall_curve_compute(self._curve_state(state), self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot the (or a provided) curve (reference ``precision_recall_curve.py:160``)."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    """Reference ``classification/precision_recall_curve.py:226``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Thresholds = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        if thresholds is None:
+            self.jit_compute = False
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("weight", [], dist_reduce_fx="cat")
+        else:
+            t = thresholds.shape[0]
+            shape = (t, 2, 2) if average == "micro" else (t, num_classes, 2, 2)
+            self.add_state("confmat", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(
+                preds, target, self.num_classes, self.ignore_index
+            )
+
+    def _update(self, state, preds, target):
+        preds, target, weight, _ = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, None, self.ignore_index, self.average
+        )
+        if self.thresholds is None:
+            return {"preds": preds, "target": target, "weight": weight}
+        if self.average == "micro":
+            update = _binary_precision_recall_curve_update(preds, target, weight, self.thresholds)
+        else:
+            update = _multiclass_precision_recall_curve_update(
+                preds, target, weight, self.num_classes, self.thresholds
+            )
+        return {"confmat": state["confmat"] + update}
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"], state["weight"])
+        return state["confmat"]
+
+    def _compute(self, state):
+        return _multiclass_precision_recall_curve_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.average
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    """Reference ``classification/precision_recall_curve.py:424``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        if thresholds is None:
+            self.jit_compute = False
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("weight", [], dist_reduce_fx="cat")
+        else:
+            t = thresholds.shape[0]
+            self.add_state("confmat", jnp.zeros((t, num_labels, 2, 2), jnp.float32), dist_reduce_fx="sum")
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(
+                preds, target, self.num_labels, self.ignore_index
+            )
+
+    def _update(self, state, preds, target):
+        preds, target, weight, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, None, self.ignore_index
+        )
+        if self.thresholds is None:
+            return {"preds": preds, "target": target, "weight": weight}
+        return {
+            "confmat": state["confmat"]
+            + _multilabel_precision_recall_curve_update(
+                preds, target, weight, self.num_labels, self.thresholds
+            )
+        }
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"], state["weight"])
+        return state["confmat"]
+
+    def _compute(self, state):
+        return _multilabel_precision_recall_curve_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``precision_recall_curve.py:616``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

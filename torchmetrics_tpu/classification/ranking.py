@@ -1,0 +1,77 @@
+"""Stateful multilabel ranking metrics (reference
+``src/torchmetrics/classification/ranking.py:40,160,280``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.ranking import (
+    _format,
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_arg_validation,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    _update_fn = None  # set by subclass
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_ranking_arg_validation(num_labels, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _validate(self, preds, target) -> None:
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+
+    def _update(self, state, preds, target):
+        preds, target, weight = _format(preds, target, self.num_labels, self.ignore_index)
+        measure, n = type(self)._update_fn(preds, target, weight)
+        return {"measure": state["measure"] + measure, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return _safe_divide(state["measure"], state["total"])
+
+
+class MultilabelCoverageError(_RankingBase):
+    """Reference ``classification/ranking.py:40``."""
+
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_RankingBase):
+    """Reference ``classification/ranking.py:160``."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_RankingBase):
+    """Reference ``classification/ranking.py:280``."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

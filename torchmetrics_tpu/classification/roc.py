@@ -1,0 +1,93 @@
+"""Stateful ROC metrics (reference ``src/torchmetrics/classification/roc.py:42,173,339,496``).
+
+Reuses the precision-recall-curve state (reference ``roc.py:40`` does the same) — only
+``_compute`` differs.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import Thresholds
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    """Reference ``classification/roc.py:42``."""
+
+    def _compute(self, state):
+        return _binary_roc_compute(self._curve_state(state), self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Reference ``classification/roc.py:173``."""
+
+    def _compute(self, state):
+        return _multiclass_roc_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.average
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Reference ``classification/roc.py:339``."""
+
+    def _compute(self, state):
+        return _multilabel_roc_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class ROC(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``roc.py:496``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassROC(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelROC(num_labels, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

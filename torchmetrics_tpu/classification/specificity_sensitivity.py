@@ -1,0 +1,159 @@
+"""Stateful specificity-at-sensitivity metrics (reference
+``src/torchmetrics/classification/specificity_sensitivity.py:46,130,232,330``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import Thresholds
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.functional.classification.specificity_sensitivity import (
+    _specificity_at_sensitivity,
+    _val_arg,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    """Reference ``classification/specificity_sensitivity.py:46``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        min_sensitivity: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _val_arg(min_sensitivity)
+        self.min_sensitivity = min_sensitivity
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        fpr, tpr, thr = _binary_roc_compute(self._curve_state(state), self.thresholds)
+        return _specificity_at_sensitivity(1 - fpr, tpr, thr, self.min_sensitivity)
+
+
+class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    """Reference ``classification/specificity_sensitivity.py:130``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_sensitivity: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _val_arg(min_sensitivity)
+        self.min_sensitivity = min_sensitivity
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        fpr, tpr, thr = _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds)
+        if isinstance(fpr, list):
+            res = [
+                _specificity_at_sensitivity(1 - f, t, h, self.min_sensitivity)
+                for f, t, h in zip(fpr, tpr, thr)
+            ]
+            return jnp.stack([v for v, _ in res]), jnp.stack([h for _, h in res])
+        return _specificity_at_sensitivity(1 - fpr, tpr, thr, self.min_sensitivity)
+
+
+class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    """Reference ``classification/specificity_sensitivity.py:232``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_sensitivity: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _val_arg(min_sensitivity)
+        self.min_sensitivity = min_sensitivity
+        self.validate_args = validate_args
+
+    def _compute(self, state):
+        fpr, tpr, thr = _multilabel_roc_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+        if isinstance(fpr, list):
+            res = [
+                _specificity_at_sensitivity(1 - f, t, h, self.min_sensitivity)
+                for f, t, h in zip(fpr, tpr, thr)
+            ]
+            return jnp.stack([v for v, _ in res]), jnp.stack([h for _, h in res])
+        return _specificity_at_sensitivity(1 - fpr, tpr, thr, self.min_sensitivity)
+
+
+class SpecificityAtSensitivity(_ClassificationTaskWrapper):
+    """Task dispatcher (reference ``specificity_sensitivity.py:330``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_sensitivity: float,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassSpecificityAtSensitivity(
+                num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificityAtSensitivity(
+                num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Task {task} not supported!")

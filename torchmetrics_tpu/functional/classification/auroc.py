@@ -1,0 +1,261 @@
+"""AUROC kernels (reference ``src/torchmetrics/functional/classification/auroc.py:82-103+``).
+
+Trapezoidal area under the ROC curve computed from the shared curve state; per-class curves
+reduce with macro/weighted averaging (``_reduce_auroc``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.checks import is_traced
+from torchmetrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _reduce_auroc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Per-class trapezoid AUCs + macro/weighted/none reduction (reference ``auroc.py:51``)."""
+    if isinstance(fpr, (list, tuple)):
+        res = jnp.stack([_auc_compute_without_check(x, y, 1.0) for x, y in zip(fpr, tpr)])
+    else:
+        res = _auc_compute_without_check(fpr, tpr, 1.0, axis=-1)
+    if average is None or average == "none":
+        return res
+    if not is_traced(res) and bool(jnp.any(jnp.isnan(res))):
+        rank_zero_warn(
+            "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.sum(jnp.where(idx, res, 0.0)) / jnp.maximum(jnp.sum(idx), 1)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights, 0.0)
+        weights = _safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(jnp.where(idx, res * weights, 0.0))
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_auroc_arg_validation(
+    max_fpr: Optional[float] = None,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+
+
+def _binary_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+    max_fpr: Optional[float] = None,
+) -> Array:
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds)
+    if max_fpr is None or max_fpr == 1 or float(jnp.sum(fpr)) == 0 or float(jnp.sum(tpr)) == 0:
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+    # partial AUC over [0, max_fpr] with McClish correction (reference auroc.py:89-107)
+    fpr_np = np.asarray(fpr, np.float64)
+    tpr_np = np.asarray(tpr, np.float64)
+    stop = int(np.searchsorted(fpr_np, max_fpr, side="right"))
+    stop = min(max(stop, 1), fpr_np.shape[0] - 1)  # curve may never reach max_fpr (binned grids)
+    weight = (max_fpr - fpr_np[stop - 1]) / max(fpr_np[stop] - fpr_np[stop - 1], 1e-38)
+    interp_tpr = tpr_np[stop - 1] + weight * (tpr_np[stop] - tpr_np[stop - 1])
+    tpr_np = np.hstack([tpr_np[:stop], interp_tpr])
+    fpr_np = np.hstack([fpr_np[:stop], max_fpr])
+    partial_auc = float(np.trapezoid(tpr_np, fpr_np)) if hasattr(np, "trapezoid") else float(np.trapz(tpr_np, fpr_np))
+    min_area = 0.5 * max_fpr**2
+    return jnp.asarray(0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area)), jnp.float32)
+
+
+def binary_auroc(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Area under the ROC curve for binary tasks (reference ``auroc.py:112``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, weight, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _binary_auroc_compute((preds, target, weight), None, max_fpr)
+    state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+    return _binary_auroc_compute(state, thresholds, max_fpr)
+
+
+def _multiclass_auroc_arg_validation(
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    allowed_average = ("macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def _multiclass_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Array] = None,
+) -> Array:
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    if thresholds is not None and not isinstance(state, tuple):
+        support = state[0, :, 1, 1] + state[0, :, 1, 0]  # tp + fn at any threshold = positives
+    else:
+        _, target, weight = state
+        support = jnp.sum(
+            (jnp.asarray(target)[:, None] == jnp.arange(num_classes)[None, :]) * jnp.asarray(weight)[:, None],
+            axis=0,
+        )
+    return _reduce_auroc(fpr, tpr, average, weights=support.astype(jnp.float32))
+
+
+def multiclass_auroc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """One-vs-rest AUROC for multiclass tasks (reference ``auroc.py:194``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, weight, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _multiclass_auroc_compute((preds, target, weight), num_classes, average, None)
+    state = _multiclass_precision_recall_curve_update(preds, target, weight, num_classes, thresholds)
+    return _multiclass_auroc_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_auroc_arg_validation(
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def _multilabel_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    if average == "micro":
+        if thresholds is not None and not isinstance(state, tuple):
+            return _binary_auroc_compute(jnp.sum(state, axis=1), thresholds, max_fpr=None)
+        preds, target, weight = state
+        return _binary_auroc_compute(
+            (jnp.reshape(preds, (-1,)), jnp.reshape(target, (-1,)), jnp.reshape(weight, (-1,))), None, None
+        )
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if thresholds is not None and not isinstance(state, tuple):
+        support = state[0, :, 1, 1] + state[0, :, 1, 0]
+    else:
+        _, target, weight = state
+        support = jnp.sum(jnp.asarray(target) * jnp.asarray(weight), axis=0)
+    return _reduce_auroc(fpr, tpr, average, weights=support.astype(jnp.float32))
+
+
+def multilabel_auroc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Per-label AUROC (reference ``auroc.py:322``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _multilabel_auroc_compute((preds, target, weight), num_labels, average, None, ignore_index)
+    state = _multilabel_precision_recall_curve_update(preds, target, weight, num_labels, thresholds)
+    return _multilabel_auroc_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching entrypoint (reference ``auroc.py:471``)."""
+    from torchmetrics_tpu.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,197 @@
+"""Calibration error kernels (reference
+``src/torchmetrics/functional/classification/calibration_error.py``).
+
+TPU-first state redesign: the reference keeps raw confidence/accuracy lists and bins at compute;
+binning against a FIXED uniform grid commutes with accumulation, so here the state is three
+``(n_bins,)`` sum tensors (count / confidence-sum / accuracy-sum) — O(n_bins) memory, exact same
+result, single psum to sync.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.ops import bincount_weighted
+from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
+from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, weight: Array, n_bins: int
+) -> Tuple[Array, Array, Array]:
+    """Per-bin (count, conf_sum, acc_sum) against a uniform [0, 1] grid."""
+    idx = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    count = bincount_weighted(idx, n_bins, weights=weight, dtype=jnp.float32)
+    conf_sum = bincount_weighted(idx, n_bins, weights=confidences * weight, dtype=jnp.float32)
+    acc_sum = bincount_weighted(idx, n_bins, weights=accuracies * weight, dtype=jnp.float32)
+    return count, conf_sum, acc_sum
+
+
+def _ce_compute(count: Array, conf_sum: Array, acc_sum: Array, norm: str = "l1") -> Array:
+    """Expected/max calibration error from per-bin sums (reference ``calibration_error.py:72``)."""
+    total = jnp.sum(count)
+    prop = _safe_divide(count, total)
+    conf_mean = _safe_divide(conf_sum, count)
+    acc_mean = _safe_divide(acc_sum, count)
+    gap = jnp.abs(acc_mean - conf_mean)
+    if norm == "l1":
+        return jnp.sum(gap * prop)
+    if norm == "l2":
+        return jnp.sqrt(jnp.maximum(jnp.sum(gap**2 * prop), 0.0))
+    if norm == "max":
+        return jnp.max(jnp.where(count > 0, gap, 0.0))
+    raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor, but got {jnp.asarray(preds).dtype}")
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    unique = set(np.unique(t).tolist())
+    if not unique.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _binary_confidences_accuracies(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.reshape(preds, (-1,))
+    target = jnp.reshape(target, (-1,))
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        weight = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        weight = jnp.ones(target.shape, jnp.float32)
+    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
+    accuracies = (jnp.where(preds > 0.5, 1, 0) == target).astype(jnp.float32)
+    return confidences, accuracies, weight
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error, binary (reference ``calibration_error.py:129``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    confidences, accuracies, weight = _binary_confidences_accuracies(preds, target, ignore_index)
+    count, conf_sum, acc_sum = _binning_bucketize(confidences, accuracies, weight, n_bins)
+    return _ce_compute(count, conf_sum, acc_sum, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int, n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_classes {num_classes}")
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    if ignore_index is not None:
+        t = t[t != ignore_index]
+    if t.size and (t.min() < 0 or t.max() >= num_classes):
+        raise RuntimeError(f"Detected values in `target` outside [0, {num_classes})")
+
+
+def _multiclass_confidences_accuracies(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.moveaxis(preds, 1, -1).reshape((-1, num_classes))
+    target = jnp.reshape(target, (-1,))
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        weight = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        weight = jnp.ones(target.shape, jnp.float32)
+    confidences = jnp.max(preds, axis=-1)
+    accuracies = (jnp.argmax(preds, axis=-1) == target).astype(jnp.float32)
+    return confidences, accuracies, weight
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error, multiclass (reference ``calibration_error.py:263``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    confidences, accuracies, weight = _multiclass_confidences_accuracies(
+        preds, target, num_classes, ignore_index
+    )
+    count, conf_sum, acc_sum = _binning_bucketize(confidences, accuracies, weight, n_bins)
+    return _ce_compute(count, conf_sum, acc_sum, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching entrypoint (reference ``calibration_error.py:390``)."""
+    from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,113 @@
+"""Dice score kernels (reference ``src/torchmetrics/functional/classification/dice.py``).
+
+Dice = 2·tp / (2·tp + fp + fn) — F1 under another name; the reference's single legacy ``dice``
+entrypoint (auto-detecting binary/multiclass inputs, ``average`` ∈ micro/macro/none/samples,
+``mdmc_average`` ∈ global/samplewise, ``ignore_index`` dropping a CLASS from the statistics) is
+reproduced over the new-style stat-scores kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_update,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
+
+
+def _dice_from_counts(
+    tp: Array, fp: Array, fn: Array, average: Optional[str], zero_division: float = 0.0
+) -> Array:
+    if average in ("micro", "samples"):
+        # "samples": counts arrive as (N, C) samplewise; micro-reduce within each sample,
+        # then mean over samples (reference average='samples' semantics)
+        tp, fp, fn = jnp.sum(tp, axis=-1), jnp.sum(fp, axis=-1), jnp.sum(fn, axis=-1)
+    score = _safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+    if average == "macro":
+        # classes absent from both preds and target are dropped from the mean (reference
+        # _reduce_stat_scores ignores tp+fp+fn == 0 rows)
+        present = (tp + fp + fn) > 0
+        return _safe_divide(
+            jnp.sum(jnp.where(present, score, 0.0), axis=-1),
+            jnp.sum(present, axis=-1),
+            zero_division,
+        )
+    if average == "samples":
+        return jnp.mean(score)
+    return score
+
+
+def _dice_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    samplewise: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Per-class (tp, fp, fn); ``ignore_index`` drops that class's statistics (legacy semantics)."""
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim:
+        # binary probabilities
+        preds = (normalize_logits_if_needed(preds, "sigmoid") > threshold).astype(jnp.int32)
+    preds_f, target_f = _multiclass_stat_scores_format(preds, target, top_k or 1)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds_f, target_f, num_classes, top_k or 1,
+        "samplewise" if samplewise else "global", None,
+    )
+    if ignore_index is not None:
+        keep = jnp.arange(num_classes) != ignore_index
+        tp = tp[..., keep]
+        fp = fp[..., keep]
+        fn = fn[..., keep]
+    return tp, fp, fn
+
+
+def _infer_num_classes(preds: Array, target: Array, num_classes: Optional[int]) -> int:
+    if num_classes is not None:
+        return num_classes
+    if preds.ndim == target.ndim + 1:
+        return preds.shape[1]
+    m = max(int(jnp.max(preds)), int(jnp.max(target)))
+    return max(m + 1, 2)
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score (reference ``dice.py:89``)."""
+    allowed = ("micro", "macro", "samples", "none", None)
+    if average not in allowed:
+        raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")
+    if mdmc_average not in ("global", "samplewise", None):
+        raise ValueError(f"The `mdmc_average` has to be 'global', 'samplewise' or None, got {mdmc_average}.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    samplewise = average == "samples" or mdmc_average == "samplewise"
+    if (
+        preds.ndim == target.ndim + 1
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+        and (top_k or 1) == 1
+    ):
+        preds_fmt = jnp.argmax(preds, axis=1)
+    else:
+        preds_fmt = preds  # top_k > 1 keeps the (N, C, ...) scores for the top-k path
+    n_cls = _infer_num_classes(preds, target, num_classes)
+    tp, fp, fn = _dice_update(preds_fmt, target, n_cls, threshold, top_k, ignore_index, samplewise)
+    if mdmc_average == "samplewise" and average != "samples":
+        # per-sample reduction first, then mean over samples (reference mdmc semantics)
+        score = _dice_from_counts(tp, fp, fn, average, zero_division)
+        return jnp.mean(score, axis=0)
+    return _dice_from_counts(tp, fp, fn, average, zero_division)

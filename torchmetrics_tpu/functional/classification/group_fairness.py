@@ -1,0 +1,172 @@
+"""Group-fairness kernels (reference
+``src/torchmetrics/functional/classification/group_fairness.py``).
+
+Per-group tp/fp/tn/fn accumulate as a single ``(num_groups, 4)`` tensor (one-hot matmul over the
+group ids — MXU path) instead of the reference's Python list of per-group index_selects.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.ops import bincount_weighted
+from torchmetrics_tpu.utils.checks import is_traced
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    if is_traced(groups):
+        return
+    g = np.asarray(groups)
+    if g.size and (g.min() < 0 or g.max() >= num_groups):
+        raise ValueError(
+            f"Expected all values in `groups` to be in the range [0, {num_groups}) but got values"
+            f" in range [{g.min()}, {g.max()}]"
+        )
+    if not np.issubdtype(g.dtype, np.integer):
+        raise ValueError(f"Expected dtype of argument `groups` to be int, but got {g.dtype}.")
+
+
+def _binary_groups_stat_scores_update(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """(num_groups, 4) [tp, fp, tn, fn] counts, fused over groups."""
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    preds = jnp.reshape(preds, (-1,)).astype(jnp.float32)
+    target = jnp.reshape(target, (-1,)).astype(jnp.float32)
+    mask = jnp.reshape(mask, (-1,))
+    groups = jnp.reshape(groups, (-1,))
+    tp = bincount_weighted(groups, num_groups, weights=mask * preds * target, dtype=jnp.float32)
+    fp = bincount_weighted(groups, num_groups, weights=mask * preds * (1 - target), dtype=jnp.float32)
+    fn = bincount_weighted(groups, num_groups, weights=mask * (1 - preds) * target, dtype=jnp.float32)
+    tn = bincount_weighted(groups, num_groups, weights=mask * (1 - preds) * (1 - target), dtype=jnp.float32)
+    return jnp.stack([tp, fp, tn, fn], axis=-1)
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group [tp, fp, tn, fn] rates (reference ``group_fairness.py:105``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    groups = jnp.asarray(groups)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    stats = _binary_groups_stat_scores_update(preds, target, groups, num_groups, threshold, ignore_index)
+    return {
+        f"group_{g}": _safe_divide(stats[g], jnp.sum(stats[g])) for g in range(num_groups)
+    }
+
+
+def _compute_binary_demographic_parity(stats: Array) -> Dict[str, Array]:
+    """min/max positive-prediction-rate ratio (reference ``group_fairness.py:164``)."""
+    tp, fp, tn, fn = stats[:, 0], stats[:, 1], stats[:, 2], stats[:, 3]
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    lo = int(jnp.argmin(pos_rates))
+    hi = int(jnp.argmax(pos_rates))
+    return {f"DP_{lo}_{hi}": _safe_divide(pos_rates[lo], pos_rates[hi])}
+
+
+def _compute_binary_equal_opportunity(stats: Array) -> Dict[str, Array]:
+    """min/max true-positive-rate ratio (reference ``group_fairness.py:243``)."""
+    tp, fp, tn, fn = stats[:, 0], stats[:, 1], stats[:, 2], stats[:, 3]
+    tprs = _safe_divide(tp, tp + fn)
+    lo = int(jnp.argmin(tprs))
+    hi = int(jnp.argmax(tprs))
+    return {f"EO_{lo}_{hi}": _safe_divide(tprs[lo], tprs[hi])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic-parity ratio (reference ``group_fairness.py:177``)."""
+    preds = jnp.asarray(preds)
+    groups = jnp.asarray(groups)
+    num_groups = int(jnp.max(groups)) + 1
+    target = jnp.zeros(preds.shape, jnp.int32)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    stats = _binary_groups_stat_scores_update(preds, target, groups, num_groups, threshold, ignore_index)
+    return _compute_binary_demographic_parity(stats)
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal-opportunity ratio (reference ``group_fairness.py:258``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    groups = jnp.asarray(groups)
+    num_groups = int(jnp.max(groups)) + 1
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    stats = _binary_groups_stat_scores_update(preds, target, groups, num_groups, threshold, ignore_index)
+    return _compute_binary_equal_opportunity(stats)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (reference ``group_fairness.py:326``)."""
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    preds = jnp.asarray(preds)
+    groups = jnp.asarray(groups)
+    if task == "demographic_parity":
+        target = jnp.zeros(preds.shape, jnp.int32)
+    target = jnp.asarray(target)
+    num_groups = int(jnp.max(groups)) + 1
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if task != "demographic_parity":
+            _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    stats = _binary_groups_stat_scores_update(preds, target, groups, num_groups, threshold, ignore_index)
+    out: Dict[str, Array] = {}
+    if task in ("demographic_parity", "all"):
+        out.update(_compute_binary_demographic_parity(stats))
+    if task in ("equal_opportunity", "all"):
+        out.update(_compute_binary_equal_opportunity(stats))
+    return out

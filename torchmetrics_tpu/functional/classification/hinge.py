@@ -1,0 +1,195 @@
+"""Hinge loss kernels (reference ``src/torchmetrics/functional/classification/hinge.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
+from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
+
+
+def _hinge_loss_update(measures: Array, weight: Array) -> Tuple[Array, Array]:
+    return jnp.sum(measures * weight, axis=0), jnp.sum(weight)
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    return _safe_divide(measure, total)
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {jnp.asarray(preds).dtype}"
+        )
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    unique = set(np.unique(t).tolist())
+    if not unique.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _binary_hinge_update(
+    preds: Array, target: Array, squared: bool, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    preds = jnp.reshape(preds, (-1,))
+    target = jnp.reshape(target, (-1,))
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        weight = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        weight = jnp.ones(target.shape, jnp.float32)
+    target_pm = target.astype(jnp.float32) * 2 - 1  # {0,1} -> {-1,+1}
+    margin = preds * target_pm
+    measures = jnp.maximum(1 - margin, 0.0)
+    if squared:
+        measures = measures**2
+    return _hinge_loss_update(measures, weight)
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for binary tasks (reference ``hinge.py:96``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    measure, total = _binary_hinge_update(preds, target, squared, ignore_index)
+    return _hinge_loss_compute(measure, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Expected argument `multiclass_mode` to be one of 'crammer-singer', 'one-vs-all',"
+            f" but got {multiclass_mode}"
+        )
+
+
+def _multiclass_hinge_loss_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_classes {num_classes}")
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    if ignore_index is not None:
+        t = t[t != ignore_index]
+    if t.size and (t.min() < 0 or t.max() >= num_classes):
+        raise RuntimeError(f"Detected values in `target` outside [0, {num_classes})")
+
+
+def _multiclass_hinge_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    preds = jnp.moveaxis(preds, 1, -1).reshape((-1, num_classes))
+    target = jnp.reshape(target, (-1,))
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        weight = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        weight = jnp.ones(target.shape, jnp.float32)
+    onehot = (target[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
+    if multiclass_mode == "crammer-singer":
+        true_score = jnp.sum(preds * onehot, axis=-1)
+        best_other = jnp.max(jnp.where(onehot > 0, -jnp.inf, preds), axis=-1)
+        margin = true_score - best_other
+        measures = jnp.maximum(1 - margin, 0.0)
+        if squared:
+            measures = measures**2
+        return _hinge_loss_update(measures, weight)
+    # one-vs-all: per-class binary hinge with +-1 targets; returns per-class losses
+    target_pm = onehot * 2 - 1
+    margin = preds * target_pm
+    measures = jnp.maximum(1 - margin, 0.0)
+    if squared:
+        measures = measures**2
+    return jnp.sum(measures * weight[:, None], axis=0), jnp.sum(weight)
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for multiclass tasks (reference ``hinge.py:205``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        _multiclass_hinge_loss_tensor_validation(preds, target, num_classes, ignore_index)
+    measure, total = _multiclass_hinge_update(preds, target, num_classes, squared, multiclass_mode, ignore_index)
+    return _hinge_loss_compute(measure, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching entrypoint (reference ``hinge.py:290``)."""
+    from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(
+            preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,121 @@
+"""Best precision at a fixed recall floor (reference
+``src/torchmetrics/functional/classification/precision_fixed_recall.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _lex_select_at_constraint,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+)
+
+
+def _precision_at_recall(
+    precision: Array, recall: Array, thresholds: Array, min_recall: float
+) -> Tuple[Array, Array]:
+    return _lex_select_at_constraint(precision, recall, thresholds, recall, min_recall)
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """(max precision, threshold) subject to recall >= min_recall (reference ``:140``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, weight, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    if thresholds is None:
+        p, r, t = _binary_precision_recall_curve_compute((preds, target, weight), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+        p, r, t = _binary_precision_recall_curve_compute(state, thresholds)
+    return _precision_at_recall(p, r, t, min_recall)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class (max precision, threshold) at fixed recall (reference ``:248``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, weight, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None:
+        p, r, t = _multiclass_precision_recall_curve_compute((preds, target, weight), num_classes, None)
+    else:
+        state = _multiclass_precision_recall_curve_update(preds, target, weight, num_classes, thresholds)
+        p, r, t = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if isinstance(p, list):
+        res = [_precision_at_recall(pc, rc, tc, min_recall) for pc, rc, tc in zip(p, r, t)]
+        return jnp.stack([v for v, _ in res]), jnp.stack([thr for _, thr in res])
+    thr = jnp.broadcast_to(t, (p.shape[0], t.shape[0]))
+    return _precision_at_recall(p, r, thr, min_recall)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label (max precision, threshold) at fixed recall (reference ``:348``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thresholds is None:
+        p, r, t = _multilabel_precision_recall_curve_compute((preds, target, weight), num_labels, None, ignore_index)
+    else:
+        state = _multilabel_precision_recall_curve_update(preds, target, weight, num_labels, thresholds)
+        p, r, t = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(p, list):
+        res = [_precision_at_recall(pc, rc, tc, min_recall) for pc, rc, tc in zip(p, r, t)]
+        return jnp.stack([v for v, _ in res]), jnp.stack([thr for _, thr in res])
+    thr = jnp.broadcast_to(t, (p.shape[0], t.shape[0]))
+    return _precision_at_recall(p, r, thr, min_recall)

@@ -1,0 +1,163 @@
+"""Multilabel ranking kernels (reference ``src/torchmetrics/functional/classification/ranking.py``).
+
+Coverage error, label-ranking average precision, label-ranking loss — sklearn semantics, computed
+with rank statistics (argsort-free where possible, jit-safe throughout).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+def _rank_data(x: Array) -> Array:
+    """1-based rank of every element along the last axis (average ties NOT needed here: ranks
+    by strictly-less counts + 1, matching reference ``ranking.py:24``)."""
+    return jnp.sum(x[..., None, :] < x[..., :, None], axis=-1) + 1
+
+
+def _multilabel_ranking_arg_validation(num_labels: int, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multilabel_ranking_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+    if preds.shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_labels {num_labels}")
+    if is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    unique = set(np.unique(t).tolist())
+    if not unique.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _format(preds: Array, target: Array, num_labels: int, ignore_index: Optional[int]):
+    preds = jnp.reshape(preds, (-1, num_labels))
+    target = jnp.reshape(target, (-1, num_labels))
+    if ignore_index is not None:
+        weight = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        weight = jnp.ones(target.shape, jnp.float32)
+    return preds.astype(jnp.float32), target.astype(jnp.float32), weight
+
+
+def _multilabel_coverage_error_update(
+    preds: Array, target: Array, weight: Array
+) -> Tuple[Array, Array]:
+    """Per-sample coverage = max rank (descending) over relevant labels (sklearn semantics)."""
+    min_relevant_score = jnp.min(jnp.where((target > 0) & (weight > 0), preds, jnp.inf), axis=-1)
+    has_relevant = jnp.any((target > 0) & (weight > 0), axis=-1)
+    # coverage = number of labels with score >= min relevant score (among non-ignored)
+    cov = jnp.sum((preds >= min_relevant_score[..., None]) * (weight > 0), axis=-1)
+    cov = jnp.where(has_relevant, cov, 0.0)
+    return jnp.sum(cov.astype(jnp.float32)), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def multilabel_coverage_error(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """How far to go down the ranking to cover all relevant labels (reference ``ranking.py:107``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_ranking_arg_validation(num_labels, ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight = _format(preds, target, num_labels, ignore_index)
+    cov_sum, n = _multilabel_coverage_error_update(preds, target, weight)
+    return _safe_divide(cov_sum, n)
+
+
+def _multilabel_ranking_average_precision_update(
+    preds: Array, target: Array, weight: Array
+) -> Tuple[Array, Array]:
+    """Per-sample LRAP (sklearn ``label_ranking_average_precision_score`` semantics)."""
+    relevant = (target > 0) & (weight > 0)
+    valid = weight > 0
+    # rank among valid labels (descending score): rank_i = #{j valid: score_j >= score_i}
+    ge = (preds[..., None, :] >= preds[..., :, None]) & valid[..., None, :]
+    rank = jnp.sum(ge, axis=-1).astype(jnp.float32)  # (N, L)
+    # L_i = #{j relevant: score_j >= score_i}
+    ge_rel = (preds[..., None, :] >= preds[..., :, None]) & relevant[..., None, :]
+    l_rank = jnp.sum(ge_rel, axis=-1).astype(jnp.float32)
+    per_label = jnp.where(relevant, _safe_divide(l_rank, rank), 0.0)
+    n_relevant = jnp.sum(relevant, axis=-1).astype(jnp.float32)
+    n_valid = jnp.sum(valid, axis=-1).astype(jnp.float32)
+    per_sample = _safe_divide(jnp.sum(per_label, axis=-1), n_relevant)
+    # samples with no relevant labels (or all relevant) score 1.0 (sklearn)
+    degenerate = (n_relevant == 0) | (n_relevant == n_valid)
+    per_sample = jnp.where(degenerate, 1.0, per_sample)
+    return jnp.sum(per_sample), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def multilabel_ranking_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking average precision (reference ``ranking.py:167``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_ranking_arg_validation(num_labels, ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight = _format(preds, target, num_labels, ignore_index)
+    s, n = _multilabel_ranking_average_precision_update(preds, target, weight)
+    return _safe_divide(s, n)
+
+
+def _multilabel_ranking_loss_update(
+    preds: Array, target: Array, weight: Array
+) -> Tuple[Array, Array]:
+    """Per-sample ranking loss = fraction of mis-ordered (relevant, irrelevant) pairs."""
+    relevant = ((target > 0) & (weight > 0)).astype(jnp.float32)
+    irrelevant = ((target == 0) & (weight > 0)).astype(jnp.float32)
+    # count pairs (i relevant, j irrelevant) with score_i <= score_j
+    le = (preds[..., :, None] <= preds[..., None, :]).astype(jnp.float32)  # [i, j]
+    bad = jnp.einsum("...ij,...i,...j->...", le, relevant, irrelevant)
+    n_rel = jnp.sum(relevant, axis=-1)
+    n_irr = jnp.sum(irrelevant, axis=-1)
+    denom = n_rel * n_irr
+    per_sample = jnp.where(denom > 0, bad / jnp.maximum(denom, 1.0), 0.0)
+    return jnp.sum(per_sample), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def multilabel_ranking_loss(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking loss (reference ``ranking.py:227``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_ranking_arg_validation(num_labels, ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight = _format(preds, target, num_labels, ignore_index)
+    s, n = _multilabel_ranking_loss_update(preds, target, weight)
+    return _safe_divide(s, n)

@@ -1,0 +1,204 @@
+"""Best recall at a fixed precision floor (reference
+``src/torchmetrics/functional/classification/recall_fixed_precision.py``).
+
+The reference masks rows (dynamic shape) and lex-argmaxes on (recall, precision, threshold);
+here the same selection is a trace-safe ``lexsort`` over masked keys — jit/binned-state friendly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+
+def _lex_select_at_constraint(
+    maximize: Array, tiebreak: Array, thresholds: Array, constraint_value: Array, constraint_min: float
+) -> Tuple[Array, Array]:
+    """max over rows satisfying ``constraint_value >= constraint_min`` of ``maximize``,
+    lexicographic tie-break by (tiebreak, threshold); returns (best value, its threshold).
+
+    No-satisfying-rows and best-value-0 both map the threshold to 1e6 (reference semantics).
+    """
+    n = min(maximize.shape[-1], tiebreak.shape[-1], thresholds.shape[-1])
+    maximize, tiebreak, thresholds = maximize[..., :n], tiebreak[..., :n], thresholds[..., :n]
+    mask = constraint_value[..., :n] >= constraint_min
+    key_primary = jnp.where(mask, maximize, -1.0)
+    key_secondary = jnp.where(mask, tiebreak, -1.0)
+    key_tertiary = jnp.where(mask, thresholds, -1.0)
+    order = jnp.lexsort((key_tertiary, key_secondary, key_primary), axis=-1)
+    idx = order[..., -1]
+    best = jnp.where(jnp.any(mask, axis=-1), jnp.take_along_axis(key_primary, idx[..., None], axis=-1)[..., 0], 0.0)
+    best = jnp.maximum(best, 0.0)
+    thr = jnp.take_along_axis(key_tertiary, idx[..., None], axis=-1)[..., 0]
+    thr = jnp.where(best == 0.0, 1e6, thr)
+    return best, thr
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    return _lex_select_at_constraint(recall, precision, thresholds, precision, min_precision)
+
+
+def _binary_recall_at_fixed_precision_arg_validation(
+    min_precision: float, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _binary_recall_at_fixed_precision_compute(
+    state, thresholds: Optional[Array], min_precision: float
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(state, thresholds)
+    return _recall_at_precision(precision, recall, thresholds, min_precision)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """(max recall, threshold) subject to precision >= min_precision (reference ``:153``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, weight, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _binary_recall_at_fixed_precision_compute((preds, target, weight), None, min_precision)
+    state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_arg_validation(
+    num_classes: int, min_precision: float, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multiclass_recall_at_fixed_precision_compute(
+    state, num_classes: int, thresholds: Optional[Array], min_precision: float
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if isinstance(precision, list):
+        res = [
+            _recall_at_precision(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)
+        ]
+        return jnp.stack([v for v, _ in res]), jnp.stack([t for _, t in res])
+    # binned: thresholds shared (T,), curves (C, T+1) — broadcast thresholds per class
+    thr = jnp.broadcast_to(thresholds, (precision.shape[0], thresholds.shape[0]))
+    return _recall_at_precision(precision, recall, thr, min_precision)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class (max recall, threshold) at fixed precision (reference ``:253``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, weight, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _multiclass_recall_at_fixed_precision_compute(
+            (preds, target, weight), num_classes, None, min_precision
+        )
+    state = _multiclass_precision_recall_curve_update(preds, target, weight, num_classes, thresholds)
+    return _multiclass_recall_at_fixed_precision_compute(state, num_classes, thresholds, min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_arg_validation(
+    num_labels: int, min_precision: float, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multilabel_recall_at_fixed_precision_compute(
+    state, num_labels: int, thresholds: Optional[Array], ignore_index: Optional[int], min_precision: float
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    if isinstance(precision, list):
+        res = [
+            _recall_at_precision(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)
+        ]
+        return jnp.stack([v for v, _ in res]), jnp.stack([t for _, t in res])
+    thr = jnp.broadcast_to(thresholds, (precision.shape[0], thresholds.shape[0]))
+    return _recall_at_precision(precision, recall, thr, min_precision)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label (max recall, threshold) at fixed precision (reference ``:353``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _multilabel_recall_at_fixed_precision_compute(
+            (preds, target, weight), num_labels, None, ignore_index, min_precision
+        )
+    state = _multilabel_precision_recall_curve_update(preds, target, weight, num_labels, thresholds)
+    return _multilabel_recall_at_fixed_precision_compute(
+        state, num_labels, thresholds, ignore_index, min_precision
+    )

@@ -1,0 +1,219 @@
+"""ROC curve kernels (reference ``src/torchmetrics/functional/classification/roc.py:40+``).
+
+Shares the precision-recall-curve state machinery (binned (T, ., 2, 2) confusion state / exact
+score lists) — only the finalisation differs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_clf_curve_exact,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _roc_from_confmat(confmat: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    """(..., T, 2, 2) → (fpr, tpr, thresholds) with thresholds flipped to descending."""
+    tps = confmat[..., 1, 1]
+    fps = confmat[..., 0, 1]
+    fns = confmat[..., 1, 0]
+    tns = confmat[..., 0, 0]
+    tpr = _safe_divide(tps, tps + fns)[..., ::-1]
+    fpr = _safe_divide(fps, fps + tns)[..., ::-1]
+    return fpr, tpr, thresholds[::-1]
+
+
+def _roc_from_exact(preds: np.ndarray, target: np.ndarray, weight: np.ndarray) -> Tuple[Array, Array, Array]:
+    fps, tps, thres = _binary_clf_curve_exact(preds, target, weight)
+    tps = np.hstack([0.0, tps])  # ensure the curve starts at (0, 0)
+    fps = np.hstack([0.0, fps])
+    thres = np.hstack([thres[0] + 1.0, thres])
+    if fps[-1] <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = np.zeros_like(thres)
+    else:
+        fpr = fps / fps[-1]
+    if tps[-1] <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = np.zeros_like(thres)
+    else:
+        tpr = tps / tps[-1]
+    return jnp.asarray(fpr, jnp.float32), jnp.asarray(tpr, jnp.float32), jnp.asarray(thres, jnp.float32)
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    if thresholds is not None and not isinstance(state, tuple):
+        return _roc_from_confmat(state, thresholds)
+    preds, target, weight = state
+    return _roc_from_exact(np.asarray(preds), np.asarray(target), np.asarray(weight))
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """ROC curve for binary tasks (reference ``roc.py:92``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, weight, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _binary_roc_compute((preds, target, weight), None)
+    state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+):
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds)
+    if thresholds is not None and not isinstance(state, tuple):
+        return _roc_from_confmat(jnp.moveaxis(state, 0, 1), thresholds)  # (C, T, 2, 2)
+    preds, target, weight = state
+    preds_np, target_np, weight_np = np.asarray(preds), np.asarray(target), np.asarray(weight)
+    fprs, tprs, thrs = [], [], []
+    for c in range(num_classes):
+        f, t, th = _roc_from_exact(preds_np[:, c], (target_np == c).astype(np.float64), weight_np)
+        fprs.append(f)
+        tprs.append(t)
+        thrs.append(th)
+    return fprs, tprs, thrs
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """One-vs-rest ROC curves (reference ``roc.py:162``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, weight, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if average == "micro":
+        if thresholds is None:
+            return _binary_roc_compute((preds, target, weight), None)
+        state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+        return _binary_roc_compute(state, thresholds)
+    if thresholds is None:
+        return _multiclass_roc_compute((preds, target, weight), num_classes, None, average)
+    state = _multiclass_precision_recall_curve_update(preds, target, weight, num_classes, thresholds)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if thresholds is not None and not isinstance(state, tuple):
+        return _roc_from_confmat(jnp.moveaxis(state, 0, 1), thresholds)
+    preds, target, weight = state
+    preds_np, target_np, weight_np = np.asarray(preds), np.asarray(target), np.asarray(weight)
+    fprs, tprs, thrs = [], [], []
+    for lbl in range(num_labels):
+        f, t, th = _roc_from_exact(preds_np[:, lbl], target_np[:, lbl], weight_np[:, lbl])
+        fprs.append(f)
+        tprs.append(t)
+        thrs.append(th)
+    return fprs, tprs, thrs
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-label ROC curves (reference ``roc.py:310``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thresholds is None:
+        return _multilabel_roc_compute((preds, target, weight), num_labels, None, ignore_index)
+    state = _multilabel_precision_recall_curve_update(preds, target, weight, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching entrypoint (reference ``roc.py:470``)."""
+    from torchmetrics_tpu.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,138 @@
+"""Best specificity at a fixed sensitivity floor (reference
+``src/torchmetrics/functional/classification/specificity_sensitivity.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+
+
+def _specificity_at_sensitivity(
+    specificity: Array, sensitivity: Array, thresholds: Array, min_sensitivity: float
+) -> Tuple[Array, Array]:
+    """max specificity subject to sensitivity >= min_sensitivity; (0, 1e6) when infeasible."""
+    mask = sensitivity >= min_sensitivity
+    spec_m = jnp.where(mask, specificity, -1.0)
+    idx = jnp.argmax(spec_m, axis=-1)
+    has_any = jnp.any(mask, axis=-1)
+    best = jnp.where(has_any, jnp.take_along_axis(spec_m, idx[..., None], axis=-1)[..., 0], 0.0)
+    best = jnp.maximum(best, 0.0)
+    thr = jnp.where(
+        has_any, jnp.take_along_axis(jnp.broadcast_to(thresholds, spec_m.shape), idx[..., None], axis=-1)[..., 0], 1e6
+    )
+    return best, thr
+
+
+def _val_arg(min_sensitivity: float) -> None:
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """(max specificity, threshold) at fixed sensitivity (reference ``:130``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _val_arg(min_sensitivity)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, weight, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    if thresholds is None:
+        fpr, tpr, thr = _binary_roc_compute((preds, target, weight), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, weight, thresholds)
+        fpr, tpr, thr = _binary_roc_compute(state, thresholds)
+    return _specificity_at_sensitivity(1 - fpr, tpr, thr, min_sensitivity)
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class (max specificity, threshold) at fixed sensitivity (reference ``:232``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _val_arg(min_sensitivity)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, weight, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None:
+        fpr, tpr, thr = _multiclass_roc_compute((preds, target, weight), num_classes, None)
+        res = [
+            _specificity_at_sensitivity(1 - f, t, h, min_sensitivity) for f, t, h in zip(fpr, tpr, thr)
+        ]
+        return jnp.stack([v for v, _ in res]), jnp.stack([h for _, h in res])
+    state = _multiclass_precision_recall_curve_update(preds, target, weight, num_classes, thresholds)
+    fpr, tpr, thr = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _specificity_at_sensitivity(1 - fpr, tpr, thr, min_sensitivity)
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label (max specificity, threshold) at fixed sensitivity (reference ``:330``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _val_arg(min_sensitivity)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, weight, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thresholds is None:
+        fpr, tpr, thr = _multilabel_roc_compute((preds, target, weight), num_labels, None, ignore_index)
+        res = [
+            _specificity_at_sensitivity(1 - f, t, h, min_sensitivity) for f, t, h in zip(fpr, tpr, thr)
+        ]
+        return jnp.stack([v for v, _ in res]), jnp.stack([h for _, h in res])
+    state = _multilabel_precision_recall_curve_update(preds, target, weight, num_labels, thresholds)
+    fpr, tpr, thr = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _specificity_at_sensitivity(1 - fpr, tpr, thr, min_sensitivity)

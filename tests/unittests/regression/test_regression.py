@@ -1,0 +1,226 @@
+"""Regression metrics vs sklearn/scipy (reference: tests/unittests/regression/)."""
+import numpy as np
+import pytest
+from scipy import stats
+from sklearn import metrics as skm
+
+from tests.unittests.helpers.testers import MetricTester
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from torchmetrics_tpu.functional.regression import (
+    concordance_corrcoef,
+    cosine_similarity,
+    explained_variance,
+    kendall_rank_corrcoef,
+    kl_divergence,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    minkowski_distance,
+    pearson_corrcoef,
+    r2_score,
+    relative_squared_error,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+
+NB, BS = 4, 64
+rng = np.random.RandomState(11)
+PREDS = (rng.randn(NB, BS) * 2 + 3).astype(np.float32)
+TARGET = (rng.randn(NB, BS) * 2 + 3).astype(np.float32)
+PREDS_POS = np.abs(PREDS) + 0.1
+TARGET_POS = np.abs(TARGET) + 0.1
+
+
+def _ccc(p, t):
+    # unbiased (n-1) variances — the reference normalises var/cov by nb-1 before the CCC formula
+    mx, my = p.mean(), t.mean()
+    cov = ((p - mx) * (t - my)).sum() / (len(p) - 1)
+    return 2 * cov / (p.var(ddof=1) + t.var(ddof=1) + (mx - my) ** 2)
+
+
+SIMPLE_CASES = [
+    (MeanSquaredError, mean_squared_error, lambda p, t: skm.mean_squared_error(t, p), {}),
+    (MeanAbsoluteError, mean_absolute_error, lambda p, t: skm.mean_absolute_error(t, p), {}),
+    (
+        MeanAbsolutePercentageError,
+        mean_absolute_percentage_error,
+        lambda p, t: skm.mean_absolute_percentage_error(t, p),
+        {},
+    ),
+    (
+        SymmetricMeanAbsolutePercentageError,
+        symmetric_mean_absolute_percentage_error,
+        lambda p, t: 2 * np.mean(np.abs(p - t) / (np.abs(p) + np.abs(t))),
+        {},
+    ),
+    (
+        WeightedMeanAbsolutePercentageError,
+        weighted_mean_absolute_percentage_error,
+        lambda p, t: np.sum(np.abs(p - t)) / np.sum(np.abs(t)),
+        {},
+    ),
+    (ExplainedVariance, explained_variance, lambda p, t: skm.explained_variance_score(t, p), {}),
+    (R2Score, r2_score, lambda p, t: skm.r2_score(t, p), {}),
+    (
+        RelativeSquaredError,
+        relative_squared_error,
+        lambda p, t: np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2),
+        {},
+    ),
+    (PearsonCorrCoef, pearson_corrcoef, lambda p, t: stats.pearsonr(p, t)[0], {}),
+    (ConcordanceCorrCoef, concordance_corrcoef, _ccc, {}),
+    (SpearmanCorrCoef, spearman_corrcoef, lambda p, t: stats.spearmanr(p, t)[0], {}),
+    (
+        KendallRankCorrCoef,
+        kendall_rank_corrcoef,
+        lambda p, t: stats.kendalltau(p, t, variant="b")[0],
+        {},
+    ),
+    (
+        LogCoshError,
+        log_cosh_error,
+        lambda p, t: np.mean(np.log(np.cosh((p - t).astype(np.float64)))),
+        {},
+    ),
+    (MinkowskiDistance, minkowski_distance, lambda p, t: np.power(np.sum(np.abs(p - t) ** 3), 1 / 3), {"p": 3}),
+]
+
+
+@pytest.mark.parametrize(("cls", "fn", "ref", "args"), SIMPLE_CASES, ids=lambda c: getattr(c, "__name__", str(c)))
+def test_regression_metrics(cls, fn, ref, args):
+    tester = MetricTester()
+    # R2/Pearson etc need check_batch over per-batch values; all are deterministic fns of batch
+    tester.run_class_metric_test(PREDS, TARGET, cls, ref, metric_args=args, atol=1e-4)
+    tester.run_functional_metric_test(PREDS, TARGET, fn, ref, metric_args=args, atol=1e-4)
+
+
+def test_mse_rmse_positive_domain():
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        PREDS_POS, TARGET_POS, MeanSquaredLogError,
+        lambda p, t: skm.mean_squared_log_error(t, p), atol=1e-5,
+    )
+    tester.run_functional_metric_test(
+        PREDS_POS, TARGET_POS, mean_squared_log_error,
+        lambda p, t: skm.mean_squared_log_error(t, p), atol=1e-5,
+    )
+    m = MeanSquaredError(squared=False)
+    for i in range(NB):
+        m.update(PREDS[i], TARGET[i])
+    np.testing.assert_allclose(
+        np.asarray(m.compute()),
+        np.sqrt(skm.mean_squared_error(TARGET.ravel(), PREDS.ravel())),
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0])
+def test_tweedie(power):
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        PREDS_POS, TARGET_POS, TweedieDevianceScore,
+        lambda p, t: skm.mean_tweedie_deviance(t, p, power=power),
+        metric_args={"power": power}, atol=1e-4,
+    )
+    tester.run_functional_metric_test(
+        PREDS_POS, TARGET_POS, tweedie_deviance_score,
+        lambda p, t: skm.mean_tweedie_deviance(t, p, power=power),
+        metric_args={"power": power}, atol=1e-4,
+    )
+
+
+def test_kl_divergence():
+    p = np.abs(rng.rand(NB, BS, 5)).astype(np.float32)
+    q = np.abs(rng.rand(NB, BS, 5)).astype(np.float32)
+
+    def ref(pp, qq):
+        pn = pp / pp.sum(-1, keepdims=True)
+        qn = qq / qq.sum(-1, keepdims=True)
+        return np.mean(np.sum(pn * np.log(pn / qn), axis=-1))
+
+    tester = MetricTester()
+    tester.run_class_metric_test(p, q, KLDivergence, ref, atol=1e-5)
+    tester.run_functional_metric_test(p, q, kl_divergence, ref, atol=1e-5)
+
+
+def test_cosine_similarity():
+    p = rng.randn(NB, BS, 8).astype(np.float32)
+    t = rng.randn(NB, BS, 8).astype(np.float32)
+
+    def ref(pp, tt):
+        return np.sum(np.sum(pp * tt, -1) / (np.linalg.norm(pp, axis=-1) * np.linalg.norm(tt, axis=-1)))
+
+    tester = MetricTester()
+    tester.run_class_metric_test(p, t, CosineSimilarity, ref, atol=1e-3)
+    tester.run_functional_metric_test(p, t, cosine_similarity, ref, atol=1e-3)
+
+
+def test_multioutput_metrics():
+    p = rng.randn(200, 3).astype(np.float32)
+    t = rng.randn(200, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(r2_score(p, t, multioutput="raw_values")),
+        skm.r2_score(t, p, multioutput="raw_values"), atol=1e-5,
+    )
+    m = R2Score(num_outputs=3, multioutput="raw_values")
+    m.update(p[:100], t[:100])
+    m.update(p[100:], t[100:])
+    np.testing.assert_allclose(
+        np.asarray(m.compute()), skm.r2_score(t, p, multioutput="raw_values"), atol=1e-5
+    )
+    m = PearsonCorrCoef(num_outputs=3)
+    m.update(p[:100], t[:100])
+    m.update(p[100:], t[100:])
+    ref = [stats.pearsonr(p[:, i], t[:, i])[0] for i in range(3)]
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-4)
+
+
+def test_pearson_distributed_merge():
+    # emulate the None-reduce sync: stacked replica states must merge exactly
+    p = rng.randn(300).astype(np.float32)
+    t = (0.5 * p + rng.randn(300) * 0.5).astype(np.float32)
+    replicas = [PearsonCorrCoef() for _ in range(3)]
+    for r, m in enumerate(replicas):
+        m.update(p[r::3], t[r::3])
+    import jax.numpy as jnp
+
+    stacked = {
+        k: jnp.stack([jnp.asarray(m.metric_state[k]) for m in replicas])
+        for k in replicas[0].metric_state
+    }
+    merged = replicas[0]._merged_state(stacked)
+    from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+    got = _pearson_corrcoef_compute(merged[2], merged[3], merged[4], merged[5])
+    np.testing.assert_allclose(float(got), stats.pearsonr(p, t)[0], atol=1e-4)
+
+
+def test_kendall_pvalue():
+    p, t = PREDS[0], TARGET[0]
+    tau, pv = kendall_rank_corrcoef(p, t, variant="b", t_test=True)
+    ref_tau, ref_p = stats.kendalltau(p, t, variant="b")
+    np.testing.assert_allclose(float(tau), ref_tau, atol=1e-5)
+    np.testing.assert_allclose(float(pv), ref_p, atol=5e-3)

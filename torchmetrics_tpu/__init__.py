@@ -3,8 +3,12 @@
 Re-design of TorchMetrics (reference: oguz-hanoglu/torchmetrics) for TPU hardware: metric state
 lives as pytrees of ``jax.Array`` in HBM, updates/computes are jit-compiled XLA kernels, and
 distributed sync is mesh collectives over ICI/DCN. See SURVEY.md for the blueprint.
+
+Top-level surface mirrors the reference's ``torchmetrics.__all__``
+(``src/torchmetrics/__init__.py:150``, 101 symbols) as domains land.
 """
 from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu import functional
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -12,16 +16,106 @@ from torchmetrics_tpu.aggregation import (
     MinMetric,
     SumMetric,
 )
+from torchmetrics_tpu.classification import (
+    AUROC,
+    ROC,
+    Accuracy,
+    AveragePrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    Dice,
+    ExactMatch,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    PrecisionAtFixedRecall,
+    PrecisionRecallCurve,
+    Recall,
+    RecallAtFixedPrecision,
+    Specificity,
+    SpecificityAtSensitivity,
+    StatScores,
+)
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KLDivergence,
+    KendallRankCorrCoef,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
 
 __all__ = [
     "__version__",
+    "functional",
     "Metric",
     "MetricCollection",
+    # aggregation
     "CatMetric",
     "MaxMetric",
     "MeanMetric",
     "MinMetric",
     "SumMetric",
+    # classification
+    "AUROC",
+    "Accuracy",
+    "AveragePrecision",
+    "CalibrationError",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "Dice",
+    "ExactMatch",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "HingeLoss",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "Precision",
+    "PrecisionAtFixedRecall",
+    "PrecisionRecallCurve",
+    "ROC",
+    "Recall",
+    "RecallAtFixedPrecision",
+    "Specificity",
+    "SpecificityAtSensitivity",
+    "StatScores",
+    # regression
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KLDivergence",
+    "KendallRankCorrCoef",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
 ]

@@ -1,0 +1,35 @@
+"""Concordance correlation coefficient kernels (reference
+``src/torchmetrics/functional/regression/concordance.py``).
+
+CCC = 2·ρ·σx·σy / (σx² + σy² + (μx − μy)²), computed from the Pearson running state.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_update
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """Reference ``concordance.py:24`` — unbiased (n-1) variances (the reference's in-place
+    ``/=`` inside its pearson compute normalises var/cov by nb-1 before the CCC formula)."""
+    vx = var_x / (nb - 1)
+    vy = var_y / (nb - 1)
+    cxy = corr_xy / (nb - 1)
+    return jnp.squeeze(2.0 * cxy / (vx + vy + (mean_x - mean_y) ** 2))
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Concordance correlation coefficient (reference ``concordance.py:58``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    shape = (d,) if d > 1 else ()
+    zeros = jnp.zeros(shape, jnp.float32)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, jnp.zeros((), jnp.float32), num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

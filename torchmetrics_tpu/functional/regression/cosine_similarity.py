@@ -1,0 +1,39 @@
+"""Cosine-similarity kernels (reference
+``src/torchmetrics/functional/regression/cosine_similarity.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected input to cosine similarity to be 2D tensors of shape `[N,D]`,"
+                         f" but got {preds.ndim}D")
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot = jnp.sum(preds * target, axis=-1)
+    norm = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = dot / jnp.where(norm == 0, 1.0, norm)
+    if reduction == "sum":
+        return jnp.sum(sim)
+    if reduction == "mean":
+        return jnp.mean(sim)
+    if reduction in ("none", None):
+        return sim
+    raise ValueError(f"Expected reduction to be one of `['sum', 'mean', 'none', None]` but got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Cosine similarity (reference ``cosine_similarity.py:62``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,74 @@
+"""Explained-variance kernels (reference
+``src/torchmetrics/functional/regression/explained_variance.py``).
+
+State = first/second moments of target + error sums — O(num_outputs) memory, single psum sync.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array, Array]:
+    """(n, Σerr, Σerr², Σy, Σy²) per output column."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if preds.ndim == 1:
+        preds = preds[:, None]
+        target = target[:, None]
+    diff = target - preds
+    n_obs = jnp.asarray(preds.shape[0], jnp.float32)
+    return (
+        n_obs,
+        jnp.sum(diff, axis=0),
+        jnp.sum(diff * diff, axis=0),
+        jnp.sum(target, axis=0),
+        jnp.sum(target * target, axis=0),
+    )
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.where(
+        valid,
+        1.0 - numerator / jnp.where(valid, denominator, 1.0),
+        jnp.where(nonzero_numerator, 0.0, 1.0),
+    )
+    output_scores = jnp.squeeze(output_scores) if output_scores.shape == (1,) else output_scores
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    denom_sum = jnp.sum(denominator)
+    return jnp.sum(jnp.atleast_1d(output_scores) * denominator) / jnp.where(denom_sum == 0, 1.0, denom_sum)
+
+
+def explained_variance(
+    preds: Array, target: Array, multioutput: str = "uniform_average"
+) -> Array:
+    """Explained variance (reference ``explained_variance.py:84``)."""
+    if multioutput not in ALLOWED_MULTIOUTPUT:
+        raise ValueError(f"Invalid input to argument `multioutput`. Choose one of {ALLOWED_MULTIOUTPUT}")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    return _explained_variance_compute(*_explained_variance_update(preds, target), multioutput)

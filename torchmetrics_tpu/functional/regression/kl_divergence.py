@@ -1,0 +1,45 @@
+"""KL-divergence kernels (reference ``src/torchmetrics/functional/regression/kl_divergence.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_xlogy
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, Array]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    p = p.astype(jnp.float32)
+    q = q.astype(jnp.float32)
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        q = q / jnp.sum(q, axis=-1, keepdims=True)
+        measures = jnp.sum(_safe_xlogy(p, p / jnp.where(q == 0, 1e-38, q)), axis=-1)
+    return measures, jnp.asarray(p.shape[0], jnp.float32)
+
+
+def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return jnp.sum(measures)
+    if reduction == "mean":
+        return jnp.sum(measures) / total
+    if reduction in ("none", None):
+        return measures
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', 'none', None]` but got {reduction}")
+
+
+def kl_divergence(
+    p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean"
+) -> Array:
+    """KL(P||Q) (reference ``kl_divergence.py:58``)."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

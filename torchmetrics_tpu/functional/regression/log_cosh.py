@@ -1,0 +1,38 @@
+"""LogCosh error kernels (reference ``src/torchmetrics/functional/regression/log_cosh.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> tuple:
+    if preds.ndim == 1:
+        return preds[:, None], target[:, None]
+    return preds, target
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds.astype(jnp.float32), target.astype(jnp.float32))
+    diff = preds - target
+    # log(cosh(x)) computed stably: |x| + log1p(exp(-2|x|)) - log(2)
+    a = jnp.abs(diff)
+    vals = a + jnp.log1p(jnp.exp(-2 * a)) - jnp.log(2.0)
+    return jnp.sum(vals, axis=0), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, total: Array) -> Array:
+    return jnp.squeeze(sum_log_cosh_error / total)
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error (reference ``log_cosh.py:53``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(s, n)

@@ -1,0 +1,25 @@
+"""Mean-squared-log-error kernels (reference ``src/torchmetrics/functional/regression/log_mse.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    d = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(d * d), jnp.asarray(preds.size, jnp.float32)
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE (reference ``log_mse.py:47``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    s, n = _mean_squared_log_error_update(preds, target)
+    return s / n

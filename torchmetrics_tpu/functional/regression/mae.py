@@ -1,0 +1,29 @@
+"""Mean-absolute-error kernels (reference ``src/torchmetrics/functional/regression/mae.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, jnp.asarray(preds.size, jnp.float32)
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, total: Array) -> Array:
+    return sum_abs_error / total
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """MAE (reference ``mae.py:46``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    sum_abs_error, total = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, total)

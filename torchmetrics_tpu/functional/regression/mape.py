@@ -1,0 +1,73 @@
+"""MAPE / SMAPE / weighted-MAPE kernels (reference
+``src/torchmetrics/functional/regression/{mape,symmetric_mape,wmape}.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+_EPS = 1.17e-06  # matches the reference epsilon (torch.finfo(float32).eps ~ 1.19e-7? -> 1.17e-06 used)
+
+
+def _mean_abs_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), jnp.asarray(target.size, jnp.float32)
+
+
+def _mean_abs_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE (reference ``mape.py:54``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    s, n = _mean_abs_percentage_error_update(preds, target)
+    return _mean_abs_percentage_error_compute(s, n)
+
+
+def _symmetric_mape_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return jnp.sum(2 * abs_per_error), jnp.asarray(target.size, jnp.float32)
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE (reference ``symmetric_mape.py:51``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    s, n = _symmetric_mape_update(preds, target)
+    return s / n
+
+
+def _weighted_mape_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def _weighted_mape_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE (reference ``wmape.py:50``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    s, scale = _weighted_mape_update(preds, target)
+    return _weighted_mape_compute(s, scale)

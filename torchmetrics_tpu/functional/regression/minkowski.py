@@ -1,0 +1,28 @@
+"""Minkowski distance kernels (reference ``src/torchmetrics/functional/regression/minkowski.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
+    _check_same_shape(preds, target)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    diff = jnp.abs(preds.astype(jnp.float32) - target.astype(jnp.float32))
+    return jnp.sum(jnp.power(diff, p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
+    """Minkowski distance (reference ``minkowski.py:44``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    distance = _minkowski_distance_update(preds, target, p)
+    return _minkowski_distance_compute(distance, p)

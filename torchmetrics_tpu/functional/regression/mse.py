@@ -1,0 +1,36 @@
+"""Mean-squared-error kernels (reference ``src/torchmetrics/functional/regression/mse.py``)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = jnp.reshape(preds, (-1,))
+        target = jnp.reshape(target, (-1,))
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, jnp.asarray(target.shape[0], jnp.float32)
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, total: Array, squared: bool = True) -> Array:
+    mse = sum_squared_error / total
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(
+    preds: Array, target: Array, squared: bool = True, num_outputs: int = 1
+) -> Array:
+    """MSE (or RMSE with ``squared=False``) — reference ``mse.py:53``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    sum_squared_error, total = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, total, squared)

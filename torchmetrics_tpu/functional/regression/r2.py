@@ -1,0 +1,85 @@
+"""R² kernels (reference ``src/torchmetrics/functional/regression/r2.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+    """(Σy, Σy², Σ(y-ŷ)², n) per output column."""
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with"
+            f" dimension {preds.shape}"
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if preds.ndim == 1:
+        preds = preds[:, None]
+        target = target[:, None]
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    diff = target - preds
+    rss = jnp.sum(diff * diff, axis=0)
+    return sum_squared_obs, sum_obs, rss, jnp.asarray(target.shape[0], jnp.float32)
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Reference ``r2.py:53``: tss from moments, multioutput reductions, adjusted correction."""
+    if not is_traced(num_obs) and float(num_obs) < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    cond = tss != 0
+    raw_scores = 1 - rss / jnp.where(cond, tss, 1.0)
+    raw_scores = jnp.where(cond, raw_scores, 0.0)
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / jnp.where(tss_sum == 0, 1.0, tss_sum) * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        if not is_traced(num_obs) and adjusted > float(num_obs) - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif not is_traced(num_obs) and adjusted == float(num_obs) - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² score (reference ``r2.py:99``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    return _r2_score_compute(*_r2_score_update(preds, target), adjusted, multioutput)

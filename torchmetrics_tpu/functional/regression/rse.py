@@ -1,0 +1,35 @@
+"""Relative-squared-error kernels (reference ``src/torchmetrics/functional/regression/rse.py``).
+
+RSE = Σ(y−ŷ)² / Σ(y−ȳ)², with ȳ the GLOBAL target mean — the denominator is reconstructed from
+(Σy², Σy, n) moments so the state stays O(num_outputs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.r2 import _r2_score_update
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Array,
+    squared: bool = True,
+) -> Array:
+    """Reference ``rse.py:22``."""
+    epsilon = jnp.finfo(jnp.float32).eps
+    tss = sum_squared_obs - sum_obs * sum_obs / num_obs
+    rse = rss / jnp.clip(tss, min=epsilon)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Relative squared error (reference ``rse.py:49``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared)

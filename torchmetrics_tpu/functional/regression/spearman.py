@@ -1,0 +1,54 @@
+"""Spearman rank-correlation kernels (reference
+``src/torchmetrics/functional/regression/spearman.py``).
+
+Ranks (average-tie) computed with a double argsort + tie segment-mean — O(N log N), jit-safe.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+
+
+def _rank_data(data: Array) -> Array:
+    """Average-tie ranks of a 1-D array (1-based), matching scipy's 'average' method."""
+    n = data.shape[0]
+    order = jnp.argsort(data)
+    sorted_data = data[order]
+    ranks_sorted = jnp.arange(1, n + 1, dtype=jnp.float32)
+    # average ranks over tie groups: group id = index of first equal element
+    is_new = jnp.concatenate([jnp.ones((1,), bool), sorted_data[1:] != sorted_data[:-1]])
+    group_id = jnp.cumsum(is_new) - 1
+    import jax
+
+    group_sum = jax.ops.segment_sum(ranks_sorted, group_id, num_segments=n)
+    group_cnt = jax.ops.segment_sum(jnp.ones(n, jnp.float32), group_id, num_segments=n)
+    avg = group_sum / jnp.maximum(group_cnt, 1.0)
+    ranks_avg_sorted = avg[group_id]
+    out = jnp.zeros(n, jnp.float32).at[order].set(ranks_avg_sorted)
+    return out
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-06) -> Array:
+    """Pearson over ranks (reference ``spearman.py:54``)."""
+    if preds.ndim == 1:
+        rp = _rank_data(preds)
+        rt = _rank_data(target)
+    else:
+        rp = jnp.stack([_rank_data(preds[:, i]) for i in range(preds.shape[1])], axis=1)
+        rt = jnp.stack([_rank_data(target[:, i]) for i in range(target.shape[1])], axis=1)
+    pd = rp - jnp.mean(rp, axis=0)
+    td = rt - jnp.mean(rt, axis=0)
+    cov = jnp.mean(pd * td, axis=0)
+    corr = cov / jnp.clip(jnp.sqrt(jnp.mean(pd * pd, axis=0) * jnp.mean(td * td, axis=0)), min=eps)
+    return jnp.squeeze(jnp.clip(corr, -1.0, 1.0))
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation (reference ``spearman.py:80``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,72 @@
+"""Tweedie deviance kernels (reference
+``src/torchmetrics/functional/regression/tweedie_deviance.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_xlogy
+
+
+def _domain_check(preds: Array, target: Array, power: float) -> None:
+    """Eager-only domain validation (reference ``tweedie_deviance.py:51-73``); no-op under trace."""
+    import numpy as np
+
+    from torchmetrics_tpu.utils.checks import is_traced
+
+    if is_traced(preds, target):
+        return
+    p = np.asarray(preds)
+    t = np.asarray(target)
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    if 1 <= power < 2 and (np.any(t < 0) or np.any(p <= 0)):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+    if power >= 2 and (np.any(t <= 0) or np.any(p <= 0)):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
+def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Reference ``tweedie_deviance.py:26``; branches on the static ``power`` argument."""
+    _check_same_shape(preds, target)
+    _domain_check(preds, target, power)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if power < 0:
+        if power <= -1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        deviance_score = 2 * (
+            jnp.power(jnp.maximum(target, 0), 2 - power) / ((1 - power) * (2 - power))
+            - target * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    elif power == 0:
+        deviance_score = jnp.power(target - preds, 2)
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(target, target / preds) - target + preds)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / target) + target / preds - 1)
+    elif (1 < power < 2) or power > 2:
+        deviance_score = 2 * (
+            jnp.power(target, 2 - power) / ((1 - power) * (2 - power))
+            - target * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    else:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    return jnp.sum(deviance_score), jnp.asarray(target.size, jnp.float32)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score (reference ``tweedie_deviance.py:100``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    s, n = _tweedie_deviance_score_update(preds, target, power)
+    return _tweedie_deviance_score_compute(s, n)

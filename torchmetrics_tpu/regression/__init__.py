@@ -1,0 +1,43 @@
+from torchmetrics_tpu.regression.concordance import ConcordanceCorrCoef
+from torchmetrics_tpu.regression.explained_variance import ExplainedVariance
+from torchmetrics_tpu.regression.mape import (
+    MeanAbsolutePercentageError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from torchmetrics_tpu.regression.misc import (
+    CosineSimilarity,
+    KLDivergence,
+    LogCoshError,
+    MinkowskiDistance,
+    TweedieDevianceScore,
+)
+from torchmetrics_tpu.regression.mse import (
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+)
+from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
+from torchmetrics_tpu.regression.r2 import R2Score, RelativeSquaredError
+from torchmetrics_tpu.regression.spearman import KendallRankCorrCoef, SpearmanCorrCoef
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KLDivergence",
+    "KendallRankCorrCoef",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

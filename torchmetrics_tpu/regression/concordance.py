@@ -1,0 +1,13 @@
+"""ConcordanceCorrCoef (reference ``src/torchmetrics/regression/concordance.py``)."""
+from __future__ import annotations
+
+from torchmetrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """CCC over the shared Pearson running state (reference ``concordance.py:24``)."""
+
+    def _compute(self, state):
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._merged_state(state)
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)

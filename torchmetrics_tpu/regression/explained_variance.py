@@ -1,0 +1,49 @@
+"""ExplainedVariance (reference ``src/torchmetrics/regression/explained_variance.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.explained_variance import (
+    ALLOWED_MULTIOUTPUT,
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class ExplainedVariance(Metric):
+    """Explained variance (reference ``explained_variance.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if multioutput not in ALLOWED_MULTIOUTPUT:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of {ALLOWED_MULTIOUTPUT}")
+        self.multioutput = multioutput
+        self.add_state("num_obs", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        n, se, sse, st, sst = _explained_variance_update(preds, target)
+        return {
+            "num_obs": state["num_obs"] + n,
+            "sum_error": state["sum_error"] + se,
+            "sum_squared_error": state["sum_squared_error"] + sse,
+            "sum_target": state["sum_target"] + st,
+            "sum_squared_target": state["sum_squared_target"] + sst,
+        }
+
+    def _compute(self, state):
+        return _explained_variance_compute(
+            state["num_obs"], state["sum_error"], state["sum_squared_error"],
+            state["sum_target"], state["sum_squared_target"], self.multioutput,
+        )

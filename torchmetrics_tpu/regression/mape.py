@@ -1,0 +1,80 @@
+"""MAPE / SMAPE / WeightedMAPE metrics (reference
+``src/torchmetrics/regression/{mape,symmetric_mape,wmape}.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.mape import (
+    _mean_abs_percentage_error_compute,
+    _mean_abs_percentage_error_update,
+    _symmetric_mape_update,
+    _weighted_mape_compute,
+    _weighted_mape_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class MeanAbsolutePercentageError(Metric):
+    """MAPE (reference ``mape.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, n = _mean_abs_percentage_error_update(preds, target)
+        return {"sum_abs_per_error": state["sum_abs_per_error"] + s, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return _mean_abs_percentage_error_compute(state["sum_abs_per_error"], state["total"])
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    """SMAPE (reference ``symmetric_mape.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 2.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, n = _symmetric_mape_update(preds, target)
+        return {"sum_abs_per_error": state["sum_abs_per_error"] + s, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return state["sum_abs_per_error"] / state["total"]
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """WMAPE (reference ``wmape.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_scale", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, scale = _weighted_mape_update(preds, target)
+        return {"sum_abs_error": state["sum_abs_error"] + s, "sum_scale": state["sum_scale"] + scale}
+
+    def _compute(self, state):
+        return _weighted_mape_compute(state["sum_abs_error"], state["sum_scale"])

@@ -1,0 +1,165 @@
+"""CosineSimilarity, KLDivergence, LogCoshError, MinkowskiDistance, TweedieDevianceScore
+(reference ``src/torchmetrics/regression/{cosine_similarity,kl_divergence,log_cosh,minkowski,
+tweedie_deviance}.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.cosine_similarity import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+)
+from torchmetrics_tpu.functional.regression.kl_divergence import _kld_update
+from torchmetrics_tpu.functional.regression.log_cosh import (
+    _log_cosh_error_compute,
+    _log_cosh_error_update,
+)
+from torchmetrics_tpu.functional.regression.minkowski import (
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+)
+from torchmetrics_tpu.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+class CosineSimilarity(Metric):
+    """Cosine similarity over accumulated rows (reference ``cosine_similarity.py:24``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state, preds, target):
+        preds, target = _cosine_similarity_update(jnp.asarray(preds), jnp.asarray(target))
+        return {"preds": preds, "target": target}
+
+    def _compute(self, state):
+        return _cosine_similarity_compute(state["preds"], state["target"], self.reduction)
+
+
+class KLDivergence(Metric):
+    """KL(P||Q) (reference ``kl_divergence.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("measures", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, p, q):
+        measures, n = _kld_update(jnp.asarray(p), jnp.asarray(q), self.log_prob)
+        if self.reduction in ("none", None):
+            return {"measures": measures, "total": state["total"] + n}
+        return {"measures": state["measures"] + jnp.sum(measures), "total": state["total"] + n}
+
+    def _compute(self, state):
+        if self.reduction == "mean":
+            return state["measures"] / state["total"]
+        if self.reduction == "sum":
+            return state["measures"]
+        return state["measures"]
+
+
+class LogCoshError(Metric):
+    """LogCosh error (reference ``log_cosh.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros((num_outputs,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, n = _log_cosh_error_update(jnp.asarray(preds), jnp.asarray(target), self.num_outputs)
+        return {"sum_log_cosh_error": state["sum_log_cosh_error"] + s, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return _log_cosh_error_compute(state["sum_log_cosh_error"], state["total"])
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance (reference ``minkowski.py:24``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        d = _minkowski_distance_update(jnp.asarray(preds), jnp.asarray(target), self.p)
+        return {"minkowski_dist_sum": state["minkowski_dist_sum"] + d}
+
+    def _compute(self, state):
+        return _minkowski_distance_compute(state["minkowski_dist_sum"], self.p)
+
+
+class TweedieDevianceScore(Metric):
+    """Tweedie deviance (reference ``tweedie_deviance.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, n = _tweedie_deviance_score_update(jnp.asarray(preds), jnp.asarray(target), self.power)
+        return {
+            "sum_deviance_score": state["sum_deviance_score"] + s,
+            "num_observations": state["num_observations"] + n,
+        }
+
+    def _compute(self, state):
+        return _tweedie_deviance_score_compute(state["sum_deviance_score"], state["num_observations"])

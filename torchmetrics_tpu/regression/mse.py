@@ -1,0 +1,87 @@
+"""MeanSquaredError (reference ``src/torchmetrics/regression/mse.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.log_mse import _mean_squared_log_error_update
+from torchmetrics_tpu.functional.regression.mae import (
+    _mean_absolute_error_compute,
+    _mean_absolute_error_update,
+)
+from torchmetrics_tpu.functional.regression.mse import (
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class MeanSquaredError(Metric):
+    """MSE / RMSE (reference ``mse.py:27``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        sse, n = _mean_squared_error_update(preds, target, self.num_outputs)
+        return {"sum_squared_error": state["sum_squared_error"] + sse, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return _mean_squared_error_compute(state["sum_squared_error"], state["total"], self.squared)
+
+
+class MeanAbsoluteError(Metric):
+    """MAE (reference ``mae.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        sae, n = _mean_absolute_error_update(preds, target)
+        return {"sum_abs_error": state["sum_abs_error"] + sae, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return _mean_absolute_error_compute(state["sum_abs_error"], state["total"])
+
+
+class MeanSquaredLogError(Metric):
+    """MSLE (reference ``log_mse.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        s, n = _mean_squared_log_error_update(preds, target)
+        return {"sum_squared_log_error": state["sum_squared_log_error"] + s, "total": state["total"] + n}
+
+    def _compute(self, state):
+        return state["sum_squared_log_error"] / state["total"]

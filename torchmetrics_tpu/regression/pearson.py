@@ -1,0 +1,65 @@
+"""PearsonCorrCoef (reference ``src/torchmetrics/regression/pearson.py``).
+
+Running moments with ``dist_reduce_fx=None`` — sync stacks per-replica states along a leading
+world axis and ``_final_aggregation`` merges them (reference ``pearson.py:28-71,137-138``).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation coefficient (reference ``pearson.py:75``)."""
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy"):
+            self.add_state(name, jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros((), jnp.float32), dist_reduce_fx=None)
+
+    def _update(self, state, preds, target):
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = _pearson_corrcoef_update(
+            preds, target,
+            state["mean_x"], state["mean_y"], state["var_x"], state["var_y"], state["corr_xy"],
+            state["n_total"], self.num_outputs,
+        )
+        return {
+            "mean_x": mean_x, "mean_y": mean_y, "var_x": var_x, "var_y": var_y,
+            "corr_xy": corr_xy, "n_total": n_total,
+        }
+
+    def _merged_state(self, state):
+        """Fold a leading world axis (post-sync) back into a single running state."""
+        extra_dim = state["n_total"].ndim > 0
+        if extra_dim:
+            return _final_aggregation(
+                state["mean_x"], state["mean_y"], state["var_x"], state["var_y"],
+                state["corr_xy"], state["n_total"],
+            )
+        return (
+            state["mean_x"], state["mean_y"], state["var_x"], state["var_y"],
+            state["corr_xy"], state["n_total"],
+        )
+
+    def _compute(self, state):
+        _, _, var_x, var_y, corr_xy, n_total = self._merged_state(state)
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

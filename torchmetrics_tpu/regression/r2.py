@@ -1,0 +1,98 @@
+"""R2Score + RelativeSquaredError (reference ``src/torchmetrics/regression/{r2,rse}.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_update
+from torchmetrics_tpu.functional.regression.rse import _relative_squared_error_compute
+from torchmetrics_tpu.metric import Metric
+
+
+class R2Score(Metric):
+    """R² (reference ``r2.py:29``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_outputs: int = 1,
+        adjusted: int = 0,
+        multioutput: str = "uniform_average",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        sum_squared_obs, sum_obs, rss, n = _r2_score_update(preds, target)
+        if self.num_outputs == 1:
+            sum_squared_obs = jnp.squeeze(sum_squared_obs)
+            sum_obs = jnp.squeeze(sum_obs)
+            rss = jnp.squeeze(rss)
+        return {
+            "sum_squared_error": state["sum_squared_error"] + sum_squared_obs,
+            "sum_error": state["sum_error"] + sum_obs,
+            "residual": state["residual"] + rss,
+            "total": state["total"] + n,
+        }
+
+    def _compute(self, state):
+        return _r2_score_compute(
+            state["sum_squared_error"], state["sum_error"], state["residual"], state["total"],
+            self.adjusted, self.multioutput,
+        )
+
+
+class RelativeSquaredError(Metric):
+    """RSE (reference ``rse.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, preds, target):
+        sum_squared_obs, sum_obs, rss, n = _r2_score_update(preds, target)
+        if self.num_outputs == 1:
+            sum_squared_obs = jnp.squeeze(sum_squared_obs)
+            sum_obs = jnp.squeeze(sum_obs)
+            rss = jnp.squeeze(rss)
+        return {
+            "sum_squared_error": state["sum_squared_error"] + sum_squared_obs,
+            "sum_error": state["sum_error"] + sum_obs,
+            "residual": state["residual"] + rss,
+            "total": state["total"] + n,
+        }
+
+    def _compute(self, state):
+        return _relative_squared_error_compute(
+            state["sum_squared_error"], state["sum_error"], state["residual"], state["total"], self.squared
+        )

@@ -1,0 +1,103 @@
+"""SpearmanCorrCoef + KendallRankCorrCoef (reference
+``src/torchmetrics/regression/{spearman,kendall}.py``) — cat-state metrics; ranks need the full
+sample set so scores accumulate in unbounded lists (sync = all_gather-cat, reference pattern)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.kendall import (
+    _ALLOWED_VARIANTS,
+    _kendall_pvalue_1d,
+    _kendall_tau_1d,
+)
+from torchmetrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman rank correlation (reference ``spearman.py:24``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+            " For large datasets, this may lead to a large memory footprint."
+        )
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state, preds, target):
+        return {"preds": jnp.asarray(preds, jnp.float32), "target": jnp.asarray(target, jnp.float32)}
+
+    def _compute(self, state):
+        return _spearman_corrcoef_compute(state["preds"], state["target"])
+
+
+class KendallRankCorrCoef(Metric):
+    """Kendall rank correlation (reference ``kendall.py:30``)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in _ALLOWED_VARIANTS:
+            raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        if t_test and alternative not in ("two-sided", "less", "greater"):
+            raise ValueError("Argument `alternative` is expected to be one of 'two-sided', 'less' or 'greater'.")
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state, preds, target):
+        return {"preds": jnp.asarray(preds, jnp.float32), "target": jnp.asarray(target, jnp.float32)}
+
+    def _compute(self, state):
+        preds = state["preds"]
+        target = state["target"]
+        if preds.ndim == 1:
+            tau = _kendall_tau_1d(preds, target, self.variant)
+            if self.t_test:
+                return tau, _kendall_pvalue_1d(preds, target, self.variant, self.alternative)
+            return tau
+        taus = jnp.stack(
+            [_kendall_tau_1d(preds[:, i], target[:, i], self.variant) for i in range(preds.shape[1])]
+        )
+        if self.t_test:
+            ps = jnp.stack(
+                [
+                    _kendall_pvalue_1d(preds[:, i], target[:, i], self.variant, self.alternative)
+                    for i in range(preds.shape[1])
+                ]
+            )
+            return taus, ps
+        return taus

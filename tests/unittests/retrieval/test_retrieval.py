@@ -1,0 +1,213 @@
+"""Retrieval metrics vs sklearn / hand references (reference: tests/unittests/retrieval/)."""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+from torchmetrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+
+rng = np.random.RandomState(21)
+
+
+def _query(n=20, graded=False):
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 4 if graded else 2, n)
+    return preds, target
+
+
+def test_functional_average_precision_vs_sklearn():
+    for _ in range(5):
+        p, t = _query()
+        if t.sum() == 0:
+            continue
+        np.testing.assert_allclose(
+            float(retrieval_average_precision(p, t)), average_precision_score(t, p), atol=1e-6
+        )
+
+
+def test_functional_ndcg_vs_sklearn():
+    for _ in range(5):
+        p, t = _query(graded=True)
+        np.testing.assert_allclose(
+            float(retrieval_normalized_dcg(p, t)), ndcg_score(t[None], p[None]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(retrieval_normalized_dcg(p, t, top_k=5)), ndcg_score(t[None], p[None], k=5), atol=1e-5
+        )
+
+
+def test_functional_simple_kernels():
+    p = np.asarray([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+    t = np.asarray([0, 1, 0, 1, 1])
+    # precision@2 = 1/2; recall@2 = 1/3; rr = 1/2; hit@1 = 0; hit@2 = 1
+    assert float(retrieval_precision(p, t, top_k=2)) == pytest.approx(0.5)
+    assert float(retrieval_recall(p, t, top_k=2)) == pytest.approx(1 / 3)
+    assert float(retrieval_reciprocal_rank(p, t)) == pytest.approx(0.5)
+    assert float(retrieval_hit_rate(p, t, top_k=1)) == pytest.approx(0.0)
+    assert float(retrieval_hit_rate(p, t, top_k=2)) == pytest.approx(1.0)
+    # fall-out@2: irrelevant in top2 = 1, total irrelevant = 2
+    assert float(retrieval_fall_out(p, t, top_k=2)) == pytest.approx(0.5)
+    # r-precision: R=3, top3 has 1 relevant -> 1/3
+    assert float(retrieval_r_precision(p, t)) == pytest.approx(1 / 3)
+
+
+def test_functional_pr_curve():
+    p = np.asarray([0.9, 0.8, 0.7, 0.6], np.float32)
+    t = np.asarray([0, 1, 1, 0])
+    precisions, recalls, ks = retrieval_precision_recall_curve(p, t, max_k=4)
+    np.testing.assert_allclose(np.asarray(precisions), [0.0, 0.5, 2 / 3, 0.5], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recalls), [0.0, 0.5, 1.0, 1.0], atol=1e-6)
+
+
+def _make_batches(n_queries=8, docs_per_query=(5, 25)):
+    indexes, preds, target = [], [], []
+    for q in range(n_queries):
+        n = rng.randint(*docs_per_query)
+        indexes += [q] * n
+        preds += list(rng.rand(n).astype(np.float32))
+        target += list(rng.randint(0, 2, n))
+    return np.asarray(indexes), np.asarray(preds, np.float32), np.asarray(target)
+
+
+def _loop_reference(indexes, preds, target, fn, empty="neg"):
+    vals = []
+    for q in np.unique(indexes):
+        m = indexes == q
+        p, t = preds[m], target[m]
+        if t.sum() == 0:
+            if empty == "skip":
+                continue
+            vals.append(1.0 if empty == "pos" else 0.0)
+            continue
+        vals.append(fn(p, t))
+    return np.mean(vals) if vals else 0.0
+
+
+@pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+def test_retrieval_map_grouped(empty_action):
+    indexes, preds, target = _make_batches()
+    m = RetrievalMAP(empty_target_action=empty_action)
+    # feed in 3 uneven update calls
+    for sl in (slice(0, 40), slice(40, 90), slice(90, None)):
+        m.update(indexes[sl], preds[sl], target[sl])
+    ref = _loop_reference(indexes, preds, target, lambda p, t: average_precision_score(t, p), empty_action)
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_retrieval_mrr_and_others_grouped():
+    indexes, preds, target = _make_batches()
+
+    def rr(p, t):
+        order = np.argsort(-p)
+        ranked = t[order]
+        first = np.argmax(ranked) + 1 if ranked.any() else None
+        return 1.0 / first if first else 0.0
+
+    cases = [
+        (RetrievalMRR(), rr),
+        (RetrievalPrecision(top_k=3), lambda p, t: t[np.argsort(-p)][:3].sum() / 3),
+        (RetrievalRecall(top_k=3), lambda p, t: t[np.argsort(-p)][:3].sum() / t.sum()),
+        (RetrievalHitRate(top_k=3), lambda p, t: float(t[np.argsort(-p)][:3].any())),
+        (
+            RetrievalRPrecision(),
+            lambda p, t: t[np.argsort(-p)][: int(t.sum())].sum() / t.sum(),
+        ),
+    ]
+    for metric, ref_fn in cases:
+        metric.update(indexes, preds, target)
+        ref = _loop_reference(indexes, preds, target, ref_fn)
+        np.testing.assert_allclose(
+            float(metric.compute()), ref, atol=1e-5, err_msg=type(metric).__name__
+        )
+
+
+def test_retrieval_ndcg_grouped():
+    indexes, preds, target = _make_batches()
+    target = rng.randint(0, 4, len(target))  # graded
+    m = RetrievalNormalizedDCG()
+    m.update(indexes, preds, target)
+    ref = _loop_reference(indexes, preds, target, lambda p, t: ndcg_score(t[None], p[None]))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_retrieval_fall_out_grouped():
+    indexes, preds, target = _make_batches()
+    m = RetrievalFallOut(top_k=3)
+
+    def fo(p, t):
+        irrel = 1 - t
+        if irrel.sum() == 0:
+            return 1.0
+        return irrel[np.argsort(-p)][:3].sum() / irrel.sum()
+
+    m.update(indexes, preds, target)
+    vals = [fo(preds[indexes == q], target[indexes == q]) for q in np.unique(indexes)]
+    np.testing.assert_allclose(float(m.compute()), np.mean(vals), atol=1e-5)
+
+
+def test_retrieval_aggregations():
+    indexes, preds, target = _make_batches()
+    for agg in ("median", "min", "max"):
+        m = RetrievalMAP(aggregation=agg)
+        m.update(indexes, preds, target)
+        vals = np.asarray(
+            [
+                average_precision_score(target[indexes == q], preds[indexes == q])
+                if target[indexes == q].sum() > 0 else 0.0
+                for q in np.unique(indexes)
+            ]
+        )
+        ref = {"median": np.median, "min": np.min, "max": np.max}[agg](vals)
+        np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_retrieval_recall_at_fixed_precision():
+    indexes, preds, target = _make_batches()
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=5)
+    m.update(indexes, preds, target)
+    recall, k = m.compute()
+    assert 0.0 <= float(recall) <= 1.0 and 1 <= int(k) <= 5
+
+
+def test_retrieval_errors():
+    with pytest.raises(ValueError, match="empty_target_action"):
+        RetrievalMAP(empty_target_action="bogus")
+    with pytest.raises(ValueError, match="top_k"):
+        RetrievalPrecision(top_k=-1)
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(np.asarray([0, 0]), np.asarray([0.5, 0.2], np.float32), np.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_retrieval_ignore_index():
+    indexes = np.asarray([0, 0, 0, 1, 1, 1])
+    preds = np.asarray([0.9, 0.5, 0.3, 0.8, 0.4, 0.2], np.float32)
+    target = np.asarray([1, -1, 0, 0, 1, -1])
+    m = RetrievalMAP(ignore_index=-1)
+    m.update(indexes, preds, target)
+    keep = target != -1
+    ref = _loop_reference(
+        indexes[keep], preds[keep], target[keep], lambda p, t: average_precision_score(t, p)
+    )
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)

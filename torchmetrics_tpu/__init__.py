@@ -43,6 +43,18 @@ from torchmetrics_tpu.classification import (
 )
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
 from torchmetrics_tpu.regression import (
     ConcordanceCorrCoef,
     CosineSimilarity,
@@ -99,6 +111,17 @@ __all__ = [
     "Specificity",
     "SpecificityAtSensitivity",
     "StatScores",
+    # retrieval
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
+    "RetrievalRPrecision",
     # regression
     "ConcordanceCorrCoef",
     "CosineSimilarity",

@@ -9,11 +9,11 @@ from jax import Array
 
 from torchmetrics_tpu.utils.checks import _check_same_shape
 
-_EPS = 1.17e-06  # matches the reference epsilon (torch.finfo(float32).eps ~ 1.19e-7? -> 1.17e-06 used)
+_EPS = 1.17e-06  # the reference's epsilon for zero-denominator clamping
 
 
 def _mean_abs_percentage_error_update(
-    preds: Array, target: Array, epsilon: float = 1.17e-06
+    preds: Array, target: Array, epsilon: float = _EPS
 ) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
     preds = preds.astype(jnp.float32)
@@ -35,7 +35,7 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
 
 
 def _symmetric_mape_update(
-    preds: Array, target: Array, epsilon: float = 1.17e-06
+    preds: Array, target: Array, epsilon: float = _EPS
 ) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
     preds = preds.astype(jnp.float32)
@@ -60,7 +60,7 @@ def _weighted_mape_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 
 def _weighted_mape_compute(
-    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPS
 ) -> Array:
     return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
 

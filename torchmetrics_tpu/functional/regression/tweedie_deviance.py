@@ -23,6 +23,8 @@ def _domain_check(preds: Array, target: Array, power: float) -> None:
     t = np.asarray(target)
     if 0 < power < 1:
         raise ValueError(f"Deviance Score is not defined for power={power}.")
+    if power < 0 and np.any(p <= 0):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
     if 1 <= power < 2 and (np.any(t < 0) or np.any(p <= 0)):
         raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
     if power >= 2 and (np.any(t <= 0) or np.any(p <= 0)):
@@ -35,9 +37,7 @@ def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0
     _domain_check(preds, target, power)
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
-    if power < 0:
-        if power <= -1:
-            raise ValueError(f"Deviance Score is not defined for power={power}.")
+    if power < 0:  # extreme stable distribution: any power < 0 is valid
         deviance_score = 2 * (
             jnp.power(jnp.maximum(target, 0), 2 - power) / ((1 - power) * (2 - power))
             - target * jnp.power(preds, 1 - power) / (1 - power)

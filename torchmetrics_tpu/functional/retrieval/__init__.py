@@ -1,0 +1,125 @@
+"""Functional retrieval API — single-query metrics (reference
+``src/torchmetrics/functional/retrieval/``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.retrieval._kernels import (
+    average_precision_kernel,
+    fall_out_kernel,
+    hit_rate_kernel,
+    ndcg_kernel,
+    precision_kernel,
+    r_precision_kernel,
+    recall_kernel,
+    reciprocal_rank_kernel,
+)
+from torchmetrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def _prep(preds: Array, target: Array, graded: bool = False) -> Tuple[Array, Array, Array]:
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=graded)
+    mask = jnp.ones(preds.shape, jnp.float32)
+    return preds, target.astype(jnp.float32), mask
+
+
+def _check_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """AP for a single query (reference ``functional/retrieval/average_precision.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target)
+    return average_precision_kernel(preds, target, mask, top_k)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Reciprocal rank for a single query (reference ``reciprocal_rank.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target)
+    return reciprocal_rank_kernel(preds, target, mask, top_k)
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """precision@k for a single query (reference ``precision.py``)."""
+    _check_top_k(top_k)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    preds, target, mask = _prep(preds, target)
+    return precision_kernel(preds, target, mask, top_k, adaptive_k)
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """recall@k for a single query (reference ``recall.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target)
+    return recall_kernel(preds, target, mask, top_k)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """fall-out@k for a single query (reference ``fall_out.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target)
+    return fall_out_kernel(preds, target, mask, top_k)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """hit-rate@k for a single query (reference ``hit_rate.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target)
+    return hit_rate_kernel(preds, target, mask, top_k)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision for a single query (reference ``r_precision.py``)."""
+    preds, target, mask = _prep(preds, target)
+    return r_precision_kernel(preds, target, mask)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """NDCG@k for a single query, graded relevance allowed (reference ``ndcg.py``)."""
+    _check_top_k(top_k)
+    preds, target, mask = _prep(preds, target, graded=True)
+    return ndcg_kernel(preds, target, mask, top_k)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """(precisions, recalls, top_k values) for k = 1..max_k (reference ``precision_recall_curve.py``)."""
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    preds, target, mask = _prep(preds, target)
+    n = preds.shape[0]
+    if max_k is None:
+        max_k = n
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    if not adaptive_k:
+        ks = list(range(1, max_k + 1))
+    else:
+        ks = list(range(1, min(max_k, n) + 1))
+    precisions = jnp.stack([precision_kernel(preds, target, mask, k, adaptive_k) for k in ks])
+    recalls = jnp.stack([recall_kernel(preds, target, mask, k) for k in ks])
+    return precisions, recalls, jnp.asarray(ks)
+
+
+__all__ = [
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_precision_recall_curve",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

@@ -1,0 +1,151 @@
+"""Masked single-query retrieval kernels.
+
+Every kernel takes ``(preds (L,), target (L,), mask (L,))`` and returns a scalar for ONE query;
+invalid (padded) positions have ``mask == 0``. All are pure, shape-static, and vmap/jit-safe —
+the module layer vmaps them over a padded ``(num_queries, L_max)`` batch, replacing the
+reference's per-query Python loop (``src/torchmetrics/retrieval/base.py:165-182``) with one
+fused kernel launch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+_NEG = -1e30  # effective -inf for masked score positions
+
+
+def _ranked_target(preds: Array, target: Array, mask: Array) -> Array:
+    """Relevance values sorted by descending score (masked entries last)."""
+    order = jnp.argsort(jnp.where(mask > 0, preds, _NEG))[::-1]
+    return (target * mask)[order]
+
+
+def _n_valid(mask: Array) -> Array:
+    return jnp.sum(mask)
+
+
+def _effective_k(top_k: Optional[int], mask: Array) -> Array:
+    """k limited to the number of valid docs (None = all valid docs)."""
+    n = _n_valid(mask)
+    if top_k is None:
+        return n
+    return jnp.minimum(jnp.asarray(top_k, jnp.float32), n)
+
+
+def average_precision_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """AP = mean over relevant docs of precision@rank (reference ``average_precision.py``)."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    in_k = pos <= _effective_k(top_k, mask)
+    prec_at_rank = jnp.cumsum(rel) / pos
+    n_rel = jnp.sum(rel * in_k)
+    return jnp.where(n_rel > 0, jnp.sum(prec_at_rank * rel * in_k) / jnp.maximum(n_rel, 1.0), 0.0)
+
+
+def reciprocal_rank_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """MRR contribution: 1/rank of the first relevant document."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    in_k = pos <= _effective_k(top_k, mask)
+    first = jnp.min(jnp.where((rel > 0) & in_k, pos, jnp.inf))
+    return jnp.where(jnp.isfinite(first), 1.0 / jnp.maximum(first, 1.0), 0.0)
+
+
+def precision_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """precision@k (reference ``precision.py``): relevant-in-top-k / k."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    n = _n_valid(mask)
+    if top_k is None or adaptive_k:
+        k = _effective_k(top_k, mask)
+    else:
+        k = jnp.asarray(top_k, jnp.float32)
+    in_k = pos <= jnp.minimum(k, n)
+    return jnp.where(jnp.sum(target * mask) > 0, jnp.sum(rel * in_k) / jnp.maximum(k, 1.0), 0.0)
+
+
+def recall_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """recall@k: relevant-in-top-k / total relevant."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    in_k = pos <= _effective_k(top_k, mask)
+    total_rel = jnp.sum(target * mask)
+    return jnp.where(total_rel > 0, jnp.sum(rel * in_k) / jnp.maximum(total_rel, 1.0), 0.0)
+
+
+def fall_out_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """fall-out@k: irrelevant-in-top-k / total irrelevant."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    in_k = pos <= _effective_k(top_k, mask)
+    # irrelevant indicator among the ranked valid docs: ranked mask minus ranked relevance
+    order = jnp.argsort(jnp.where(mask > 0, preds, _NEG))[::-1]
+    valid_ranked = mask[order]
+    irrel = valid_ranked - rel
+    total_irrel = jnp.sum(mask) - jnp.sum(target * mask)
+    return jnp.where(total_irrel > 0, jnp.sum(irrel * in_k) / jnp.maximum(total_irrel, 1.0), 0.0)
+
+
+def hit_rate_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """hit-rate@k: 1 if any relevant doc in the top k."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    in_k = pos <= _effective_k(top_k, mask)
+    return (jnp.sum(rel * in_k) > 0).astype(jnp.float32)
+
+
+def r_precision_kernel(preds: Array, target: Array, mask: Array) -> Array:
+    """R-precision: relevant-in-top-R / R, with R = number of relevant docs."""
+    rel = _ranked_target(preds, target, mask)
+    pos = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    r = jnp.sum(target * mask)
+    in_r = pos <= r
+    return jnp.where(r > 0, jnp.sum(rel * in_r) / jnp.maximum(r, 1.0), 0.0)
+
+
+def ndcg_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None
+) -> Array:
+    """NDCG@k with tie-averaged DCG (sklearn semantics, reference ``ndcg.py``).
+
+    Graded relevance supported: gain = target value, discount = 1/log2(rank+1).
+    """
+    length = preds.shape[0]
+    pos = jnp.arange(length, dtype=jnp.float32)
+    discount = 1.0 / jnp.log2(pos + 2.0)
+    k = _effective_k(top_k, mask)
+    discount = jnp.where(pos < k, discount, 0.0)
+
+    scores = jnp.where(mask > 0, preds, _NEG)
+    tgt = target * mask
+
+    # tie-averaged DCG: every doc in a tie group gets the mean discount of the group's positions
+    order = jnp.argsort(scores)[::-1]
+    s_sorted = scores[order]
+    t_sorted = tgt[order]
+    is_new = jnp.concatenate([jnp.ones((1,), bool), s_sorted[1:] != s_sorted[:-1]])
+    group_id = jnp.cumsum(is_new) - 1
+    group_disc = jax.ops.segment_sum(discount, group_id, num_segments=length)
+    group_cnt = jax.ops.segment_sum(jnp.ones(length, jnp.float32), group_id, num_segments=length)
+    avg_disc = group_disc / jnp.maximum(group_cnt, 1.0)
+    dcg = jnp.sum(t_sorted * avg_disc[group_id])
+
+    # ideal DCG: sorted by true relevance, no tie handling (sklearn)
+    ideal = jnp.sort(tgt)[::-1]
+    idcg = jnp.sum(ideal * jnp.where(pos < k, 1.0 / jnp.log2(pos + 2.0), 0.0))
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-38), 0.0)

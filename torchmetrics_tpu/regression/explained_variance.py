@@ -34,6 +34,8 @@ class ExplainedVariance(Metric):
 
     def _update(self, state, preds, target):
         n, se, sse, st, sst = _explained_variance_update(preds, target)
+        if state["sum_error"].ndim == 0:  # scalar states: keep shapes stable for lax.scan
+            se, sse, st, sst = (jnp.squeeze(x) for x in (se, sse, st, sst))
         return {
             "num_obs": state["num_obs"] + n,
             "sum_error": state["sum_error"] + se,

@@ -1,0 +1,143 @@
+"""RetrievalMetric base (reference ``src/torchmetrics/retrieval/base.py:43``).
+
+TPU-native compute: instead of the reference's per-query Python loop
+(``base.py:165-182``), queries are grouped on the host, padded to a ``(Q, L_max)`` rectangle
+(shapes rounded up to powers of two to bound recompiles) and the masked single-query kernel is
+vmapped over the batch — one fused device program for all queries.
+
+State: three list states with ``dist_reduce_fx=None`` (gather-without-reduce,
+reference ``base.py:130-132``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.checks import _check_retrieval_inputs
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def _retrieval_aggregate(values: Array, aggregation="mean") -> Array:
+    """mean/median/min/max or callable (reference ``base.py:25-40``)."""
+    if aggregation == "mean":
+        return jnp.mean(values) if values.size else jnp.zeros(())
+    if aggregation == "median":
+        return jnp.median(values)
+    if aggregation == "min":
+        return jnp.min(values)
+    if aggregation == "max":
+        return jnp.max(values)
+    return aggregation(values)
+
+
+class RetrievalMetric(Metric):
+    """Base for retrieval metrics (reference ``base.py:43``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    allow_non_binary_target = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation="mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.jit_compute = False  # grouping is data-dependent; the kernel itself is jitted+vmapped
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(
+                f"Argument `empty_target_action` received a wrong value `{empty_target_action}`."
+            )
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable."
+            )
+        self.aggregation = aggregation
+        self.add_state("indexes", [], dist_reduce_fx=None)
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def _validate(self, indexes, preds, target) -> None:
+        if indexes is None or preds is None or target is None:
+            raise ValueError("Arguments ``indexes``, ``preds`` and ``target`` cannot be None")
+
+    def _update(self, state, indexes, preds, target):
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        return {"indexes": indexes, "preds": preds, "target": target.astype(jnp.float32)}
+
+    # ------------------------------------------------------------ grouped kernel
+    def _metric_kernel(self, preds: Array, target: Array, mask: Array) -> Array:
+        """Single-query masked kernel; subclasses return a scalar."""
+        raise NotImplementedError
+
+    def _grouped_values(
+        self, indexes: np.ndarray, preds: np.ndarray, target: np.ndarray,
+        kernel: Optional[Callable] = None, cache_key: str = "grouped_kernel",
+    ):
+        """Pad queries to a rectangle and run the vmapped kernel once."""
+        kernel = kernel or self._metric_kernel
+        uniq, inv, counts = np.unique(indexes, return_inverse=True, return_counts=True)
+        q = len(uniq)
+        l_max = _next_pow2(int(counts.max()))
+        q_pad = _next_pow2(q)
+        order = np.argsort(inv, kind="stable")
+        # position of each element within its query group
+        offsets = np.zeros(q + 1, np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        within = np.arange(len(indexes)) - offsets[inv[order]]
+        preds_pad = np.zeros((q_pad, l_max), np.float32)
+        target_pad = np.zeros((q_pad, l_max), np.float32)
+        mask_pad = np.zeros((q_pad, l_max), np.float32)
+        rows = inv[order]
+        preds_pad[rows, within] = preds[order]
+        target_pad[rows, within] = target[order]
+        mask_pad[rows, within] = 1.0
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(jax.vmap(kernel))
+            self._jit_cache[cache_key] = fn
+        values = fn(jnp.asarray(preds_pad), jnp.asarray(target_pad), jnp.asarray(mask_pad))
+        return values[:q], target_pad[:q], mask_pad[:q]
+
+    def _compute(self, state):
+        indexes = np.asarray(state["indexes"])
+        preds = np.asarray(state["preds"])
+        target = np.asarray(state["target"])
+        if self.ignore_index is not None:
+            keep = target != self.ignore_index
+            indexes, preds, target = indexes[keep], preds[keep], target[keep]
+        if indexes.size == 0:
+            return jnp.zeros(())
+        values, target_pad, mask_pad = self._grouped_values(indexes, preds, target)
+        empty = (target_pad * mask_pad).sum(axis=1) == 0
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        values_np = np.asarray(values)
+        if self.empty_target_action == "skip":
+            values_np = values_np[~empty]
+        elif self.empty_target_action == "pos":
+            values_np = np.where(empty, 1.0, values_np)
+        else:  # "neg"
+            values_np = np.where(empty, 0.0, values_np)
+        return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)

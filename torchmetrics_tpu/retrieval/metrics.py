@@ -1,0 +1,230 @@
+"""Concrete retrieval metrics (reference ``src/torchmetrics/retrieval/*.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.retrieval._kernels import (
+    average_precision_kernel,
+    fall_out_kernel,
+    hit_rate_kernel,
+    ndcg_kernel,
+    precision_kernel,
+    r_precision_kernel,
+    recall_kernel,
+    reciprocal_rank_kernel,
+)
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _retrieval_aggregate
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision (reference ``retrieval/average_precision.py``)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return average_precision_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py``)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return reciprocal_rank_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """precision@k (reference ``retrieval/precision.py``)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, adaptive_k: bool = False, aggregation="mean",
+                 **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return precision_kernel(preds, target, mask, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """recall@k (reference ``retrieval/recall.py``)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return recall_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """fall-out@k (reference ``retrieval/fall_out.py``); empty-*positive* queries handled on the
+    negative-target axis: `empty_target_action` applies to queries with no NEGATIVE targets."""
+
+    higher_is_better = False
+
+    def __init__(self, empty_target_action: str = "pos", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return fall_out_kernel(preds, target, mask, self.top_k)
+
+    def _compute(self, state):
+        # like base, but "empty" = no negative targets (reference fall_out.py:126)
+        indexes = np.asarray(state["indexes"])
+        preds = np.asarray(state["preds"])
+        target = np.asarray(state["target"])
+        if self.ignore_index is not None:
+            keep = target != self.ignore_index
+            indexes, preds, target = indexes[keep], preds[keep], target[keep]
+        if indexes.size == 0:
+            return jnp.zeros(())
+        values, target_pad, mask_pad = self._grouped_values(indexes, preds, target)
+        empty = ((1 - target_pad) * mask_pad).sum(axis=1) == 0
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no negative target.")
+        values_np = np.asarray(values)
+        if self.empty_target_action == "skip":
+            values_np = values_np[~empty]
+        elif self.empty_target_action == "pos":
+            values_np = np.where(empty, 1.0, values_np)
+        else:
+            values_np = np.where(empty, 0.0, values_np)
+        return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """hit-rate@k (reference ``retrieval/hit_rate.py``)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return hit_rate_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision (reference ``retrieval/r_precision.py``)."""
+
+    def _metric_kernel(self, preds, target, mask):
+        return r_precision_kernel(preds, target, mask)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """NDCG@k with graded relevance (reference ``retrieval/ndcg.py``)."""
+
+    allow_non_binary_target = True
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_kernel(self, preds, target, mask):
+        return ndcg_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged precision/recall at k=1..max_k (reference ``retrieval/precision_recall_curve.py``)."""
+
+    def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False,
+                 empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, "mean", **kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _compute(self, state) -> Tuple[Array, Array, Array]:
+        indexes = np.asarray(state["indexes"])
+        preds = np.asarray(state["preds"])
+        target = np.asarray(state["target"])
+        if self.ignore_index is not None:
+            keep = target != self.ignore_index
+            indexes, preds, target = indexes[keep], preds[keep], target[keep]
+        uniq, inv, counts = np.unique(indexes, return_inverse=True, return_counts=True)
+        max_k = self.max_k or int(counts.max())
+        precisions, recalls = [], []
+        for k in range(1, max_k + 1):
+            def kernel_p(p, t, m, k=k):
+                return precision_kernel(p, t, m, k, self.adaptive_k)
+
+            def kernel_r(p, t, m, k=k):
+                return recall_kernel(p, t, m, k)
+
+            precisions.append(self._curve_values(indexes, preds, target, kernel_p, f"prec@{k}"))
+            recalls.append(self._curve_values(indexes, preds, target, kernel_r, f"rec@{k}"))
+        return jnp.stack(precisions), jnp.stack(recalls), jnp.arange(1, max_k + 1)
+
+    def _curve_values(self, indexes, preds, target, kernel, cache_key):
+        values, target_pad, mask_pad = self._grouped_values(indexes, preds, target, kernel, cache_key)
+        empty = (target_pad * mask_pad).sum(axis=1) == 0
+        values_np = np.asarray(values)
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "skip":
+            values_np = values_np[~empty]
+        elif self.empty_target_action == "pos":
+            values_np = np.where(empty, 1.0, values_np)
+        else:
+            values_np = np.where(empty, 0.0, values_np)
+        return jnp.mean(jnp.asarray(values_np)) if values_np.size else jnp.zeros(())
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """(max recall, best k) such that precision >= min_precision (reference
+    ``retrieval/recall_fixed_precision.py``)."""
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None,
+                 adaptive_k: bool = False, empty_target_action: str = "neg",
+                 ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(max_k, adaptive_k, empty_target_action, ignore_index, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def _compute(self, state):
+        precisions, recalls, ks = super()._compute(state)
+        p = np.asarray(precisions)
+        r = np.asarray(recalls)
+        k = np.asarray(ks)
+        mask = p >= self.min_precision
+        if not mask.any():
+            return jnp.zeros(()), jnp.asarray(int(k.max()))
+        best = np.argmax(np.where(mask, r, -1.0))
+        return jnp.asarray(r[best]), jnp.asarray(int(k[best]))

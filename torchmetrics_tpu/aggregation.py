@@ -165,3 +165,17 @@ class MeanMetric(BaseAggregator):
 
     def _compute(self, state: Dict[str, Any]) -> Array:
         return state["mean_value"] / jnp.maximum(state["weight"], 1e-38)
+
+
+class RunningMean(_Running):
+    """Mean over a running window (reference ``aggregation.py:616``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(_Running):
+    """Sum over a running window (reference ``aggregation.py:673``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

@@ -1,0 +1,19 @@
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.bootstrapping import BootStrapper
+from torchmetrics_tpu.wrappers.classwise import ClasswiseWrapper
+from torchmetrics_tpu.wrappers.minmax import MinMaxMetric
+from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper
+from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper
+from torchmetrics_tpu.wrappers.running import Running
+from torchmetrics_tpu.wrappers.tracker import MetricTracker
+
+__all__ = [
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "Running",
+    "WrapperMetric",
+]

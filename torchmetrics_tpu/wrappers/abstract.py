@@ -1,0 +1,20 @@
+"""WrapperMetric base (reference ``src/torchmetrics/wrappers/abstract.py:19-42``).
+
+Wrappers forward everything to the wrapped metric; sync is the wrapped metric's business, so the
+wrapper's own sync hooks are no-ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from torchmetrics_tpu.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Abstract base class for wrapper metrics."""
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        pass  # wrapped metric handles its own sync
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError

@@ -1,0 +1,103 @@
+"""BootStrapper (reference ``src/torchmetrics/wrappers/bootstrapping.py:54+``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None):
+    """Resample indices along dim 0 with replacement (reference ``bootstrapping.py:31-53``)."""
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1, size=size)
+        return jnp.asarray(np.repeat(np.arange(size), n))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(rng.randint(0, size, size=size))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Bootstrapped confidence estimates of any metric (reference ``bootstrapping.py:54``)."""
+
+    full_state_update = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [base_metric.clone() for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample inputs per bootstrap copy, then update each copy (reference ``bootstrapping.py:124``)."""
+        args_sizes = [a.shape[0] for a in args if hasattr(a, "shape") and jnp.ndim(a) > 0]
+        kwargs_sizes = [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and jnp.ndim(v) > 0]
+        if args_sizes:
+            size = args_sizes[0]
+        elif kwargs_sizes:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained any tensor, so no sampling could be done")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = tuple(jnp.asarray(a)[sample_idx] if jnp.ndim(a) > 0 else a for a in args)
+            new_kwargs = {
+                k: jnp.asarray(v)[sample_idx] if jnp.ndim(v) > 0 else v for k, v in kwargs.items()
+            }
+            self.metrics[idx].update(*new_args, **new_kwargs)
+        self._update_count += 1
+        self._update_called = True
+
+    def compute(self) -> Dict[str, Any]:
+        """mean/std/quantile/raw over bootstrap copies (reference ``bootstrapping.py:147``)."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

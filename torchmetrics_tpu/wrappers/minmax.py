@@ -1,0 +1,56 @@
+"""MinMaxMetric (reference ``src/torchmetrics/wrappers/minmax.py:29``)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the min and max of the wrapped metric's compute over time (reference ``minmax.py:29``)."""
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `torchmetrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+        self._update_count += 1
+        self._update_called = True
+
+    def compute(self) -> Dict[str, Any]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        self._base_metric.reset()
+        super().reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "size"):
+            return val.size == 1
+        return False

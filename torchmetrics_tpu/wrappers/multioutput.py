@@ -1,0 +1,98 @@
+"""MultioutputWrapper (reference ``src/torchmetrics/wrappers/multioutput.py:43``)."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+def _get_nan_indices(*tensors) -> jnp.ndarray:
+    """Rows where ANY tensor has a NaN (reference ``multioutput.py:26``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    nan_idxs = jnp.zeros(tensors[0].shape[0], bool)
+    for t in tensors:
+        flat = jnp.reshape(t, (t.shape[0], -1))
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(flat), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Evaluate one metric per output column (reference ``multioutput.py:43``)."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [base_metric.clone() for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args, **kwargs) -> List[Tuple[tuple, dict]]:
+        """Slice column i of every input for metric i (reference ``multioutput.py:101-136``)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [jnp.take(a, jnp.asarray([i]), axis=self.output_dim) for a in args]
+            selected_kwargs = {
+                k: jnp.take(v, jnp.asarray([i]), axis=self.output_dim) for k, v in kwargs.items()
+            }
+            if self.remove_nans:
+                tensors = [*selected_args, *selected_kwargs.values()]
+                if tensors:
+                    nan_idxs = np.asarray(_get_nan_indices(*tensors))
+                    keep = ~nan_idxs
+                    selected_args = [a[keep] for a in selected_args]
+                    selected_kwargs = {k: v[keep] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(a, axis=self.output_dim) for a in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((tuple(selected_args), selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        args = tuple(jnp.asarray(a) for a in args)
+        kwargs = {k: jnp.asarray(v) for k, v in kwargs.items()}
+        for (selected_args, selected_kwargs), metric in zip(
+            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+        ):
+            metric.update(*selected_args, **selected_kwargs)
+        self._update_count += 1
+        self._update_called = True
+
+    def compute(self) -> Any:
+        return jnp.stack([m.compute() for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        args = tuple(jnp.asarray(a) for a in args)
+        kwargs = {k: jnp.asarray(v) for k, v in kwargs.items()}
+        results = []
+        for (selected_args, selected_kwargs), metric in zip(
+            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+        ):
+            results.append(metric(*selected_args, **selected_kwargs))
+        self._update_count += 1
+        self._update_called = True
+        if results[0] is None:
+            return None
+        return jnp.stack(results, axis=0)
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+    def _filter_kwargs(self, **kwargs: Any) -> dict:
+        return self.metrics[0]._filter_kwargs(**kwargs)

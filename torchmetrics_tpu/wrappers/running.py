@@ -1,0 +1,87 @@
+"""Running wrapper (reference ``src/torchmetrics/wrappers/running.py:27``)."""
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class Running(WrapperMetric):
+    """Metric over a fixed-size running window of recent updates (reference ``running.py:27``).
+
+    Keeps ``window`` copies of the wrapped metric's state (one per recent update); compute merges
+    them with the base metric's reduce-fx semantics.
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._num_vals_seen = 0
+        for key in base_metric._defaults:
+            for i in range(window):
+                self.add_state(
+                    name=f"{key}_{i}",
+                    default=base_metric._defaults[key] if not isinstance(base_metric._defaults[key], list) else [],
+                    dist_reduce_fx=base_metric._reductions[key],
+                )
+
+    def _save_slot(self) -> None:
+        val = self._num_vals_seen % self.window
+        for key in self.base_metric._defaults:
+            if key in self.base_metric._state.tensors:
+                self._state.tensors[f"{key}_{val}"] = self.base_metric._state.tensors[key]
+            else:
+                self._state.lists[f"{key}_{val}"] = list(self.base_metric._state.lists[key])
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+        self._computed = None
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the base metric, stash its state into the current window slot (reference ``running.py:106``)."""
+        self.base_metric.update(*args, **kwargs)
+        self._save_slot()
+        self._update_count += 1
+        self._update_called = True
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value from the base metric; state stashed as in update (reference ``running.py:115``)."""
+        res = self.base_metric(*args, **kwargs)
+        # base was reset after the previous slot save, so its state now holds exactly this batch
+        self._save_slot()
+        self._update_count += 1
+        self._update_called = True
+        return res
+
+    def compute(self) -> Any:
+        """Merge the window slots into the base metric and compute (reference ``running.py:126``)."""
+        self.base_metric.reset()
+        for i in range(self.window):
+            slot = {}
+            for key in self.base_metric._defaults:
+                name = f"{key}_{i}"
+                if name in self._state.tensors:
+                    slot[key] = self._state.tensors[name]
+                else:
+                    slot[key] = list(self._state.lists[name])
+            self.base_metric._update_count = i + 1
+            self.base_metric._reduce_states(dict(self.base_metric._state.tensors), slot)
+        val = self.base_metric.compute()
+        self.base_metric.reset()
+        return val
+
+    def reset(self) -> None:
+        super().reset()
+        self.base_metric.reset()
+        self._num_vals_seen = 0

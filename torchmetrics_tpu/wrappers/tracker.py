@@ -1,0 +1,127 @@
+"""MetricTracker (reference ``src/torchmetrics/wrappers/tracker.py:31``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricTracker(WrapperMetric):
+    """Track a metric (or collection) over epochs: ``increment()`` per epoch, ``best_metric()``
+    at the end (reference ``tracker.py:31,108``)."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        super().__init__()
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of times increment has been called."""
+        self._check_for_increment("n_steps")
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Start tracking a new version (e.g. a new epoch) of the metric."""
+        self._increment_called = True
+        self._metrics.append(self._base_metric.clone())
+        if isinstance(self._metrics[-1], (Metric, MetricCollection)):
+            self._metrics[-1].reset()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+        self._update_called = True
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        self._update_called = True
+        return self._metrics[-1](*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stacked results from all tracked versions (reference ``tracker.py:142``)."""
+        self._check_for_increment("compute_all")
+        # the i=0 version only serves as a template and is never updated
+        res = [metric.compute() for metric in self._metrics]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
+        return jnp.stack(res, axis=0)
+
+    def reset(self) -> None:
+        """Reset the current metric being tracked."""
+        if self._metrics:
+            self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Any, Tuple[Any, Any]]:
+        """Best value (and optionally its step) across tracked versions (reference ``tracker.py:160``)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    arr = np.asarray(v)
+                    fn = np.argmax if maximize[i] else np.argmin
+                    best = int(fn(arr))
+                    value[k], idx[k] = float(arr[best]), best
+                except (ValueError, TypeError) as err:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{err}. This is probably because the metric in the collection is lacking a `higher_is_better`"
+                        " flag or produces a non-scalar output. Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+        try:
+            arr = np.asarray(res)
+            fn = np.argmax if self.maximize else np.argmin
+            best = int(fn(arr))
+            if return_step:
+                return float(arr[best]), best
+            return float(arr[best])
+        except (ValueError, TypeError) as err:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {err}."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            if return_step:
+                return None, None
+            return None
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")

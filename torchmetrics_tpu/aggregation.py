@@ -21,6 +21,7 @@ from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.prints import rank_zero_warn
+from torchmetrics_tpu.wrappers.running import Running as _Running
 
 
 class BaseAggregator(Metric):
@@ -48,8 +49,11 @@ class BaseAggregator(Metric):
         self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
         self.state_name = state_name
 
+    def _should_validate(self) -> bool:
+        return self.nan_strategy in ("error", "warn")
+
     def _validate(self, *args: Any, **kwargs: Any) -> None:
-        if self.nan_strategy not in ("error", "warn"):
+        if not self._should_validate():
             return
         for x in list(args) + list(kwargs.values()):
             if x is None or is_traced(x):

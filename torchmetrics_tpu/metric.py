@@ -254,6 +254,18 @@ class Metric:
     def _validate(self, *args: Any, **kwargs: Any) -> None:
         """Host-side value checks (overridden by subclasses when ``validate_args``)."""
 
+    def _should_validate(self) -> bool:
+        """Whether per-batch host-side validation runs at all.
+
+        Instance-level gate: metrics that expose ``validate_args`` (the whole classification
+        stack) skip validation entirely — including the host-side per-batch slicing loop in
+        :meth:`update_batches` — when the user disabled it, instead of paying the call and
+        checking the flag inside ``_validate``.
+        """
+        if type(self)._validate is Metric._validate:
+            return False
+        return bool(getattr(self, "validate_args", True))
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate a batch into the metric state (reference ``metric.py:458-480`` wrapper)."""
         if self._is_synced:
@@ -261,7 +273,8 @@ class Metric:
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
         args, kwargs = self._coerce(args, kwargs)
-        self._validate(*args, **kwargs)
+        if self._should_validate():
+            self._validate(*args, **kwargs)
         out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
         self._apply_update_result(out)
         self._update_count += 1
@@ -289,11 +302,15 @@ class Metric:
             for i in range(n_batches):
                 self.update(*(a[i] for a in args), **{k: v[i] for k, v in kwargs.items()})
             return
-        if type(self)._validate is not Metric._validate:
-            # host-side value checks are per-batch shaped; loop them (skipped entirely when the
-            # metric doesn't validate, e.g. validate_args=False)
+        if self._should_validate() and not any(
+            isinstance(x, jax.core.Tracer) for x in (*args, *kwargs.values())
+        ):
+            # host-side value checks are per-batch shaped; hoist the whole stack to numpy ONCE
+            # and slice on the host (1000 eager device slices here cost more than the kernel)
+            np_args = tuple(np.asarray(a) for a in args)
+            np_kwargs = {k: np.asarray(v) for k, v in kwargs.items()}
             for i in range(n_batches):
-                self._validate(*(a[i] for a in args), **{k: v[i] for k, v in kwargs.items()})
+                self._validate(*(a[i] for a in np_args), **{k: v[i] for k, v in np_kwargs.items()})
         scan_fn = self._jit_cache.get("update_scan")
         if scan_fn is None:
             def _scan(tensors: Dict[str, Array], stacked_args: tuple, stacked_kwargs: dict):
@@ -400,7 +417,8 @@ class Metric:
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
         args, kwargs = self._coerce(args, kwargs)
-        self._validate(*args, **kwargs)
+        if self._should_validate():
+            self._validate(*args, **kwargs)
         batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)
         self._update_count += 1
         self._update_called = True
@@ -604,10 +622,16 @@ class Metric:
             else:
                 entries = self._state.lists[name]
                 destination[prefix + name] = [e if keep_vars else np.asarray(e) for e in entries]
+        # the reference persists update_count as extra state (metric.py:845-850) so restored
+        # metrics keep correct mean-reduce weighting and no-update warnings
+        if any(self._persistent.values()):
+            destination[prefix + "_update_count"] = self._update_count
         return destination
 
     def load_state_dict(self, state_dict: dict, strict: bool = True) -> None:
         """Restore states from a checkpoint dict (reference ``metric.py:863``)."""
+        restored_count = state_dict.get("_update_count")
+        loaded_any = False
         for name, persistent in self._persistent.items():
             if name in state_dict:
                 v = state_dict[name]
@@ -616,11 +640,16 @@ class Metric:
                 else:
                     self._state.tensors[name] = jnp.asarray(v)
                 self._update_called = True
-                self._update_count = max(self._update_count, 1)
+                loaded_any = True
+                if restored_count is None:  # legacy checkpoint without the count
+                    self._update_count = max(self._update_count, 1)
             elif strict and persistent:
                 # non-persistent states are never saved (state_dict skips them), so only
                 # persistent ones can legitimately be "missing"
                 raise RuntimeError(f"Missing key {name!r} in state_dict")
+        if restored_count is not None and loaded_any:
+            self._update_count = int(restored_count)
+            self._update_called = self._update_count > 0
 
     # --------------------------------------------------------------- placement
     def to(self, device) -> "Metric":

@@ -1,0 +1,189 @@
+"""Clustering parity tests vs sklearn (reference strategy: ``tests/unittests/clustering/``)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from sklearn import metrics as sk
+
+from torchmetrics_tpu.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+
+RNG = np.random.RandomState(42)
+N = 200
+K = 7
+PREDS = [RNG.randint(0, K, (N,)) for _ in range(3)]
+TARGET = [RNG.randint(0, K, (N,)) for _ in range(3)]
+
+EXTRINSIC = [
+    (mutual_info_score, MutualInfoScore, sk.mutual_info_score, {}),
+    (rand_score, RandScore, sk.rand_score, {}),
+    (adjusted_rand_score, AdjustedRandScore, sk.adjusted_rand_score, {}),
+    (fowlkes_mallows_index, FowlkesMallowsIndex, sk.fowlkes_mallows_score, {}),
+    (homogeneity_score, HomogeneityScore, sk.homogeneity_score, {}),
+    (completeness_score, CompletenessScore, sk.completeness_score, {}),
+    (v_measure_score, VMeasureScore, sk.v_measure_score, {}),
+    (normalized_mutual_info_score, NormalizedMutualInfoScore, sk.normalized_mutual_info_score, {}),
+    (adjusted_mutual_info_score, AdjustedMutualInfoScore, sk.adjusted_mutual_info_score, {}),
+]
+
+
+@pytest.mark.parametrize("functional,cls,sk_fn,kwargs", EXTRINSIC)
+def test_extrinsic_functional_parity(functional, cls, sk_fn, kwargs):
+    for p, t in zip(PREDS, TARGET):
+        # sklearn signature is (labels_true, labels_pred)
+        expected = sk_fn(t, p)
+        got = float(functional(jnp.asarray(p), jnp.asarray(t), **kwargs))
+        np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("functional,cls,sk_fn,kwargs", EXTRINSIC)
+def test_extrinsic_module_accumulation(functional, cls, sk_fn, kwargs):
+    m = cls(**kwargs)
+    for p, t in zip(PREDS, TARGET):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    all_p = np.concatenate(PREDS)
+    all_t = np.concatenate(TARGET)
+    np.testing.assert_allclose(float(m.compute()), sk_fn(all_t, all_p), atol=1e-5, rtol=1e-5)
+    m.reset()
+    m.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+    np.testing.assert_allclose(float(m.compute()), sk_fn(TARGET[0], PREDS[0]), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("average_method", ["min", "geometric", "arithmetic", "max"])
+def test_nmi_ami_average_methods(average_method):
+    p, t = PREDS[0], TARGET[0]
+    np.testing.assert_allclose(
+        float(normalized_mutual_info_score(jnp.asarray(p), jnp.asarray(t), average_method)),
+        sk.normalized_mutual_info_score(t, p, average_method=average_method),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(adjusted_mutual_info_score(jnp.asarray(p), jnp.asarray(t), average_method)),
+        sk.adjusted_mutual_info_score(t, p, average_method=average_method),
+        atol=1e-5,
+    )
+
+
+def test_noncontiguous_labels():
+    # arbitrary label values must be relabelled, like sklearn does
+    p = np.array([10, 10, 3, 3, 7])
+    t = np.array([0, 0, 1, 1, 2])
+    np.testing.assert_allclose(
+        float(rand_score(jnp.asarray(p), jnp.asarray(t))), sk.rand_score(t, p), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(mutual_info_score(jnp.asarray(p), jnp.asarray(t))), sk.mutual_info_score(t, p), atol=1e-6
+    )
+
+
+DATA = [RNG.randn(60, 4).astype(np.float32) for _ in range(2)]
+LABELS = [RNG.randint(0, 4, (60,)) for _ in range(2)]
+
+
+def test_calinski_harabasz_parity():
+    for d, l in zip(DATA, LABELS):
+        np.testing.assert_allclose(
+            float(calinski_harabasz_score(jnp.asarray(d), jnp.asarray(l))),
+            sk.calinski_harabasz_score(d, l),
+            rtol=1e-4,
+        )
+    m = CalinskiHarabaszScore()
+    for d, l in zip(DATA, LABELS):
+        m.update(jnp.asarray(d), jnp.asarray(l))
+    np.testing.assert_allclose(
+        float(m.compute()),
+        sk.calinski_harabasz_score(np.concatenate(DATA), np.concatenate(LABELS)),
+        rtol=1e-4,
+    )
+
+
+def test_davies_bouldin_parity():
+    for d, l in zip(DATA, LABELS):
+        np.testing.assert_allclose(
+            float(davies_bouldin_score(jnp.asarray(d), jnp.asarray(l))),
+            sk.davies_bouldin_score(d, l),
+            rtol=1e-4,
+        )
+    m = DaviesBouldinScore()
+    m.update(jnp.asarray(DATA[0]), jnp.asarray(LABELS[0]))
+    np.testing.assert_allclose(float(m.compute()), sk.davies_bouldin_score(DATA[0], LABELS[0]), rtol=1e-4)
+
+
+def _dunn_numpy(data, labels, p=2):
+    # independent reimplementation of the reference definition (dunn_index.py:21-58)
+    uniq = np.unique(labels)
+    clusters = [data[labels == u] for u in uniq]
+    centroids = [c.mean(axis=0) for c in clusters]
+    from itertools import combinations
+
+    inter = [np.linalg.norm(a - b, ord=p) for a, b in combinations(centroids, 2)]
+    intra = [np.linalg.norm(c - mu, ord=p, axis=1).max() for c, mu in zip(clusters, centroids)]
+    return min(inter) / max(intra)
+
+
+@pytest.mark.parametrize("p", [1, 2])
+def test_dunn_index_parity(p):
+    for d, l in zip(DATA, LABELS):
+        np.testing.assert_allclose(
+            float(dunn_index(jnp.asarray(d), jnp.asarray(l), p)), _dunn_numpy(d, l, p), rtol=1e-4
+        )
+    m = DunnIndex(p=2)
+    m.update(jnp.asarray(DATA[0]), jnp.asarray(LABELS[0]))
+    np.testing.assert_allclose(float(m.compute()), _dunn_numpy(DATA[0], LABELS[0]), rtol=1e-4)
+
+
+def test_intrinsic_validation_errors():
+    with pytest.raises(ValueError, match="Expected 2D data"):
+        calinski_harabasz_score(jnp.zeros((10,)), jnp.zeros((10,), jnp.int32))
+    with pytest.raises(ValueError, match="Number of detected clusters"):
+        calinski_harabasz_score(jnp.zeros((4, 2)), jnp.asarray([0, 0, 0, 0]))
+
+
+def test_single_cluster_degenerate():
+    p = np.zeros(20, np.int64)
+    t = RNG.randint(0, 3, (20,))
+    assert float(mutual_info_score(jnp.asarray(p), jnp.asarray(t))) == 0.0
+    np.testing.assert_allclose(
+        float(v_measure_score(jnp.asarray(p), jnp.asarray(t))), sk.v_measure_score(t, p), atol=1e-6
+    )
+
+
+def test_pair_confusion_matrix_reference_layout():
+    # pins the REFERENCE layout (utils.py:256-260 docstring), which transposes sklearn's
+    from torchmetrics_tpu.functional.clustering.utils import calculate_pair_cluster_confusion_matrix
+
+    out = np.asarray(
+        calculate_pair_cluster_confusion_matrix(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))
+    )
+    np.testing.assert_allclose(out, np.array([[8.0, 2.0], [0.0, 2.0]]))
+    out2 = np.asarray(
+        calculate_pair_cluster_confusion_matrix(jnp.asarray([0, 0, 1, 1]), jnp.asarray([1, 1, 0, 0]))
+    )
+    np.testing.assert_allclose(out2, np.array([[8.0, 0.0], [0.0, 4.0]]))

@@ -1,0 +1,230 @@
+"""Nominal-association parity tests.
+
+Independent references: scipy.stats for chi-squared based statistics (the reference library
+itself validates against ``pandas``/``dython``-style implementations; here we recompute the
+formulas with scipy/numpy on the dropped-rows/cols contingency table, mirroring
+``functional/nominal/utils.py:62`` reference semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+import scipy.stats
+
+from torchmetrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from torchmetrics_tpu.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+RNG = np.random.RandomState(24)
+N = 200
+C = 5
+PREDS = [RNG.randint(0, C, (N,)) for _ in range(3)]
+TARGET = [np.clip(p + RNG.randint(-1, 2, (N,)), 0, C - 1) for p in PREDS]
+
+
+def _confmat(p, t, c):
+    cm = np.zeros((c, c))
+    for pi, ti in zip(p, t):
+        cm[int(ti), int(pi)] += 1
+    return cm[cm.sum(1) > 0][:, cm[cm.sum(1) > 0].sum(0) > 0]
+
+
+def _chi2(cm, correction):
+    expected = np.outer(cm.sum(1), cm.sum(0)) / cm.sum()
+    df = expected.size - sum(expected.shape) + expected.ndim - 1
+    if df == 0:
+        return 0.0
+    if df == 1 and correction:
+        diff = expected - cm
+        direction = np.sign(diff)
+        cm = cm + direction * np.minimum(0.5, np.abs(diff))
+    return float(((cm - expected) ** 2 / expected).sum())
+
+
+def _cramers_numpy(p, t, c, bias_correction):
+    cm = _confmat(p, t, c)
+    n = cm.sum()
+    phi2 = _chi2(cm, bias_correction) / n
+    r, k = cm.shape
+    if bias_correction:
+        phi2c = max(0.0, phi2 - (r - 1) * (k - 1) / (n - 1))
+        rc = r - (r - 1) ** 2 / (n - 1)
+        kc = k - (k - 1) ** 2 / (n - 1)
+        if min(rc, kc) == 1:
+            return float("nan")
+        return float(np.clip(np.sqrt(phi2c / min(rc - 1, kc - 1)), 0, 1))
+    return float(np.clip(np.sqrt(phi2 / min(r - 1, k - 1)), 0, 1))
+
+
+def _tschuprows_numpy(p, t, c, bias_correction):
+    cm = _confmat(p, t, c)
+    n = cm.sum()
+    phi2 = _chi2(cm, bias_correction) / n
+    r, k = cm.shape
+    if bias_correction:
+        phi2c = max(0.0, phi2 - (r - 1) * (k - 1) / (n - 1))
+        rc = r - (r - 1) ** 2 / (n - 1)
+        kc = k - (k - 1) ** 2 / (n - 1)
+        if min(rc, kc) == 1:
+            return float("nan")
+        return float(np.clip(np.sqrt(phi2c / np.sqrt((rc - 1) * (kc - 1))), 0, 1))
+    return float(np.clip(np.sqrt(phi2 / np.sqrt((r - 1) * (k - 1))), 0, 1))
+
+
+def _pearson_numpy(p, t, c):
+    cm = _confmat(p, t, c)
+    phi2 = _chi2(cm, False) / cm.sum()
+    return float(np.clip(np.sqrt(phi2 / (1 + phi2)), 0, 1))
+
+
+def _theils_numpy(p, t, c):
+    cm = _confmat(p, t, c)
+    n = cm.sum()
+    p_xy = cm / n
+    p_y = cm.sum(1) / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = p_xy * np.log(p_y[:, None] / p_xy)
+    s_xy = np.nansum(terms)
+    p_x = cm.sum(0) / n
+    p_x = p_x[p_x > 0]
+    s_x = -np.sum(p_x * np.log(p_x))
+    if s_x == 0:
+        return 0.0
+    return float((s_x - s_xy) / s_x)
+
+
+@pytest.mark.parametrize("bias_correction", [True, False])
+def test_cramers_v_parity(bias_correction):
+    for p, t in zip(PREDS, TARGET):
+        expected = _cramers_numpy(p, t, C, bias_correction)
+        got = float(cramers_v(jnp.asarray(p), jnp.asarray(t), bias_correction))
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("bias_correction", [True, False])
+def test_tschuprows_t_parity(bias_correction):
+    for p, t in zip(PREDS, TARGET):
+        np.testing.assert_allclose(
+            float(tschuprows_t(jnp.asarray(p), jnp.asarray(t), bias_correction)),
+            _tschuprows_numpy(p, t, C, bias_correction),
+            atol=1e-5,
+        )
+
+
+def test_pearson_theils_parity():
+    for p, t in zip(PREDS, TARGET):
+        np.testing.assert_allclose(
+            float(pearsons_contingency_coefficient(jnp.asarray(p), jnp.asarray(t))),
+            _pearson_numpy(p, t, C),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(theils_u(jnp.asarray(p), jnp.asarray(t))), _theils_numpy(p, t, C), atol=1e-5
+        )
+
+
+def test_chi2_matches_scipy():
+    # cross-check our chi2 core against scipy.stats.chi2_contingency on a full table
+    p, t = PREDS[0], TARGET[0]
+    cm = _confmat(p, t, C)
+    scipy_chi2 = scipy.stats.chi2_contingency(cm, correction=False).statistic
+    ours = _pearson_numpy(p, t, C)
+    np.testing.assert_allclose(ours, np.sqrt((scipy_chi2 / cm.sum()) / (1 + scipy_chi2 / cm.sum())), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "cls,fn,kwargs",
+    [
+        (CramersV, _cramers_numpy, {"bias_correction": True}),
+        (TschuprowsT, _tschuprows_numpy, {"bias_correction": True}),
+    ],
+)
+def test_module_accumulation_chi2(cls, fn, kwargs):
+    m = cls(num_classes=C, **kwargs)
+    for p, t in zip(PREDS, TARGET):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    all_p, all_t = np.concatenate(PREDS), np.concatenate(TARGET)
+    np.testing.assert_allclose(float(m.compute()), fn(all_p, all_t, C, True), atol=1e-5)
+
+
+def test_module_accumulation_pearson_theils():
+    mp = PearsonsContingencyCoefficient(num_classes=C)
+    mu = TheilsU(num_classes=C)
+    for p, t in zip(PREDS, TARGET):
+        mp.update(jnp.asarray(p), jnp.asarray(t))
+        mu.update(jnp.asarray(p), jnp.asarray(t))
+    all_p, all_t = np.concatenate(PREDS), np.concatenate(TARGET)
+    np.testing.assert_allclose(float(mp.compute()), _pearson_numpy(all_p, all_t, C), atol=1e-5)
+    np.testing.assert_allclose(float(mu.compute()), _theils_numpy(all_p, all_t, C), atol=1e-5)
+
+
+def test_nan_strategies():
+    p = np.array([0.0, 1.0, np.nan, 2.0, 1.0])
+    t = np.array([0.0, 1.0, 2.0, np.nan, 1.0])
+    # drop: only rows without NaN in either survive
+    keep = ~(np.isnan(p) | np.isnan(t))
+    got = float(cramers_v(jnp.asarray(p), jnp.asarray(t), True, "drop"))
+    expected = _cramers_numpy(p[keep].astype(int), t[keep].astype(int), 3, True)
+    if np.isnan(expected):
+        assert np.isnan(got)
+    else:
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+    # replace with 0
+    p2 = np.nan_to_num(p, nan=0.0).astype(int)
+    t2 = np.nan_to_num(t, nan=0.0).astype(int)
+    got = float(cramers_v(jnp.asarray(p), jnp.asarray(t), True, "replace", 0.0))
+    expected = _cramers_numpy(p2, t2, 3, True)
+    if np.isnan(expected):
+        assert np.isnan(got)
+    else:
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_fleiss_kappa_counts_and_probs():
+    # counts mode vs the statsmodels-style formula computed in numpy
+    counts = RNG.randint(0, 10, (50, 4))
+    counts = counts + (counts.sum(1, keepdims=True) == 0)  # avoid all-zero rows
+    n_rater = counts.sum(1).max()
+    total = counts.shape[0]
+    p_i = counts.sum(0) / (total * n_rater)
+    p_j = ((counts**2).sum(1) - n_rater) / (n_rater * (n_rater - 1))
+    expected = (p_j.mean() - (p_i**2).sum()) / (1 - (p_i**2).sum() + 1e-5)
+    np.testing.assert_allclose(float(fleiss_kappa(jnp.asarray(counts))), expected, atol=1e-5)
+
+    m = FleissKappa(mode="counts")
+    m.update(jnp.asarray(counts[:25]))
+    m.update(jnp.asarray(counts[25:]))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+    probs = RNG.rand(20, 4, 3).astype(np.float32)
+    k = float(fleiss_kappa(jnp.asarray(probs), mode="probs"))
+    picked = probs.argmax(axis=1)
+    counts2 = np.zeros((20, 4))
+    for i in range(20):
+        for r in range(3):
+            counts2[i, picked[i, r]] += 1
+    np.testing.assert_allclose(k, float(fleiss_kappa(jnp.asarray(counts2.astype(np.int32)))), atol=1e-5)
+
+
+def test_matrix_functions():
+    matrix = RNG.randint(0, 4, (100, 3))
+    out = np.asarray(cramers_v_matrix(jnp.asarray(matrix)))
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(np.diag(out), 1.0)
+    for i in range(3):
+        for j in range(3):
+            if i != j and not (np.isnan(out[i, j])):
+                np.testing.assert_allclose(out[i, j], out[j, i], atol=1e-6)

@@ -1,0 +1,193 @@
+"""Stateful clustering metrics (reference ``src/torchmetrics/clustering/*.py``).
+
+All extrinsic metrics share one state layout — ``preds``/``target`` label list states with
+``dist_reduce_fx="cat"`` (reference e.g. ``clustering/mutual_info_score.py:77-78``) — and one
+compute shape: concatenate, relabel on host, run the fused contingency kernel. Intrinsic metrics
+(CH / DB / Dunn) store ``data``/``labels`` (reference ``calinski_harabasz_score.py:77-78``).
+Compute is host-mediated (the relabel step is dynamic), so ``jit_compute=False``; the heavy
+kernels inside the functionals are still jitted device programs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Literal, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.functional.clustering.utils import _validate_average_method_arg
+from torchmetrics_tpu.metric import Metric
+
+
+class _LabelPairMetric(Metric):
+    """Shared shell for extrinsic clustering metrics: two label list-states, host compute."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    jit_compute = False
+    jit_update = False  # labels may be arbitrary ints; update just appends
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _update(self, state: Dict[str, Any], preds: Array, target: Array) -> Dict[str, Any]:
+        return {"preds": jnp.atleast_1d(preds), "target": jnp.atleast_1d(target)}
+
+    def _functional(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        return self._functional(state["preds"], state["target"])
+
+
+class MutualInfoScore(_LabelPairMetric):
+    """Mutual information between clusterings (reference ``clustering/mutual_info_score.py:30``)."""
+
+    plot_upper_bound = None
+
+    def _functional(self, preds, target):
+        return mutual_info_score(preds, target)
+
+
+class RandScore(_LabelPairMetric):
+    """Rand score (reference ``clustering/rand_score.py:29``)."""
+
+    def _functional(self, preds, target):
+        return rand_score(preds, target)
+
+
+class AdjustedRandScore(_LabelPairMetric):
+    """Adjusted Rand score (reference ``clustering/adjusted_rand_score.py:29``)."""
+
+    plot_lower_bound = -0.5
+
+    def _functional(self, preds, target):
+        return adjusted_rand_score(preds, target)
+
+
+class AdjustedMutualInfoScore(_LabelPairMetric):
+    """Adjusted mutual info (reference ``clustering/adjusted_mutual_info_score.py:31``)."""
+
+    plot_lower_bound = -1.0
+
+    def __init__(
+        self, average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _functional(self, preds, target):
+        return adjusted_mutual_info_score(preds, target, self.average_method)
+
+
+class NormalizedMutualInfoScore(_LabelPairMetric):
+    """Normalized mutual info (reference ``clustering/normalized_mutual_info_score.py:30``)."""
+
+    def __init__(
+        self, average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _functional(self, preds, target):
+        return normalized_mutual_info_score(preds, target, self.average_method)
+
+
+class FowlkesMallowsIndex(_LabelPairMetric):
+    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``)."""
+
+    def _functional(self, preds, target):
+        return fowlkes_mallows_index(preds, target)
+
+
+class HomogeneityScore(_LabelPairMetric):
+    """Homogeneity score (reference ``clustering/homogeneity_completeness_v_measure.py:30``)."""
+
+    def _functional(self, preds, target):
+        return homogeneity_score(preds, target)
+
+
+class CompletenessScore(_LabelPairMetric):
+    """Completeness score (reference ``clustering/homogeneity_completeness_v_measure.py:126``)."""
+
+    def _functional(self, preds, target):
+        return completeness_score(preds, target)
+
+
+class VMeasureScore(_LabelPairMetric):
+    """V-measure (reference ``clustering/homogeneity_completeness_v_measure.py:226``)."""
+
+    def __init__(self, beta: Union[int, float] = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, (int, float)) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def _functional(self, preds, target):
+        return v_measure_score(preds, target, self.beta)
+
+
+class _DataLabelMetric(Metric):
+    """Shared shell for intrinsic clustering metrics: data + labels list-states."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    jit_compute = False
+    jit_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", default=[], dist_reduce_fx="cat")
+        self.add_state("labels", default=[], dist_reduce_fx="cat")
+
+    def _update(self, state: Dict[str, Any], data: Array, labels: Array) -> Dict[str, Any]:
+        return {"data": jnp.atleast_2d(data), "labels": jnp.atleast_1d(labels)}
+
+
+class CalinskiHarabaszScore(_DataLabelMetric):
+    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``)."""
+
+    def _compute(self, state):
+        return calinski_harabasz_score(state["data"], state["labels"])
+
+
+class DaviesBouldinScore(_DataLabelMetric):
+    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``)."""
+
+    higher_is_better = False
+
+    def _compute(self, state):
+        return davies_bouldin_score(state["data"], state["labels"])
+
+
+class DunnIndex(_DataLabelMetric):
+    """Dunn index (reference ``clustering/dunn_index.py:29``)."""
+
+    def __init__(self, p: Union[int, float] = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _compute(self, state):
+        return dunn_index(state["data"], state["labels"], self.p)

@@ -1,0 +1,97 @@
+"""Intrinsic (data + labels) clustering metrics: Calinski-Harabasz, Davies-Bouldin, Dunn.
+
+Reference: ``src/torchmetrics/functional/clustering/{calinski_harabasz_score,
+davies_bouldin_score,dunn_index}.py``.
+
+The reference loops over clusters with boolean-mask gathers (``calinski_harabasz_score.py:54-58``)
+— one dynamic-shape slice per cluster. Here cluster means/dispersions are segment reductions:
+``one_hot(labels).T @ data`` puts the centroid computation on the MXU, and per-sample deviations
+are a single gather + reduction, so the whole metric is one fused device program independent of
+the number of clusters.
+"""
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+    relabel,
+)
+
+
+def _cluster_stats(data: Array, labels_idx: Array, k: int) -> Tuple[Array, Array]:
+    """Per-cluster (counts, centroids) via one-hot matmul — MXU path, no per-cluster loop."""
+    oh = jax.nn.one_hot(labels_idx, k, dtype=jnp.float32)  # (N, K)
+    counts = oh.sum(axis=0)  # (K,)
+    sums = jnp.matmul(oh.T, data.astype(jnp.float32), precision="highest")  # (K, d)
+    centroids = sums / jnp.maximum(counts, 1.0)[:, None]
+    return counts, centroids
+
+
+def calinski_harabasz_score(data, labels) -> Array:
+    """Variance-ratio criterion (reference ``calinski_harabasz_score.py:23``)."""
+    _validate_intrinsic_cluster_data(data, labels)
+    labels_idx, k = relabel(labels)
+    data = jnp.asarray(data, jnp.float32)
+    n = data.shape[0]
+    _validate_intrinsic_labels_to_samples(k, n)
+
+    counts, centroids = _cluster_stats(data, labels_idx, k)
+    mean = data.mean(axis=0)
+    between = jnp.sum(((centroids - mean[None, :]) ** 2).sum(axis=1) * counts)
+    within = jnp.sum((data - centroids[labels_idx]) ** 2)
+    return jnp.where(within == 0, 1.0, between * (n - k) / (jnp.maximum(within, 1e-38) * (k - 1.0)))
+
+
+def davies_bouldin_score(data, labels) -> Array:
+    """Davies-Bouldin score (reference ``davies_bouldin_score.py:23``)."""
+    _validate_intrinsic_cluster_data(data, labels)
+    labels_idx, k = relabel(labels)
+    data = jnp.asarray(data, jnp.float32)
+    n = data.shape[0]
+    _validate_intrinsic_labels_to_samples(k, n)
+
+    counts, centroids = _cluster_stats(data, labels_idx, k)
+    # mean intra-cluster distance per cluster: segment-mean of ||x - c_label||
+    dists = jnp.sqrt(jnp.maximum(((data - centroids[labels_idx]) ** 2).sum(axis=1), 0.0))
+    intra = jax.ops.segment_sum(dists, labels_idx, num_segments=k) / jnp.maximum(counts, 1.0)
+
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = jnp.sqrt(jnp.maximum((diff**2).sum(axis=-1), 0.0))
+
+    degenerate = jnp.allclose(intra, 0.0) | jnp.allclose(centroid_distances, 0.0)
+    safe_cd = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    combined = intra[None, :] + intra[:, None]
+    scores = jnp.max(combined / safe_cd, axis=1)
+    return jnp.where(degenerate, 0.0, scores.mean())
+
+
+def _dunn_index_update(data, labels, p: Union[int, float]) -> Tuple[Array, Array]:
+    """Centroid distances + max intra-cluster distances (reference ``dunn_index.py:21``)."""
+    labels_idx, k = relabel(labels)
+    data = jnp.asarray(data, jnp.float32)
+    _, centroids = _cluster_stats(data, labels_idx, k)
+    pairs = list(combinations(range(k), 2))
+    a = jnp.asarray([i for i, _ in pairs], jnp.int32)
+    b = jnp.asarray([j for _, j in pairs], jnp.int32)
+    inter = jnp.linalg.norm(centroids[a] - centroids[b], ord=p, axis=1)
+    per_sample = jnp.linalg.norm(data - centroids[labels_idx], ord=p, axis=1)
+    max_intra = jax.ops.segment_max(per_sample, labels_idx, num_segments=k)
+    return inter, max_intra
+
+
+def _dunn_index_compute(intercluster_distance: Array, max_intracluster_distance: Array) -> Array:
+    """Reference ``dunn_index.py:49``."""
+    return intercluster_distance.min() / max_intracluster_distance.max()
+
+
+def dunn_index(data, labels, p: Union[int, float] = 2) -> Array:
+    """Dunn index (reference ``dunn_index.py:63``)."""
+    inter, max_intra = _dunn_index_update(data, labels, p)
+    return _dunn_index_compute(inter, max_intra)

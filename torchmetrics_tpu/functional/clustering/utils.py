@@ -1,0 +1,136 @@
+"""Shared clustering kernels (reference ``src/torchmetrics/functional/clustering/utils.py``).
+
+TPU-first redesign:
+
+- ``calculate_contingency_matrix`` (reference ``utils.py:119``) relies on ``torch.unique`` +
+  sparse scatter — dynamic shapes. Here the relabel step (the only inherently dynamic part) runs
+  ONCE on the host (``np.unique``), and the O(N*R*C) counting runs on device as a
+  ``one_hot(target).T @ one_hot(preds)`` matmul on the MXU (same trick as
+  ``torchmetrics_tpu.ops.histogram``).
+- Downstream computes replace the reference's ``nonzero``-gather (``mutual_info_score.py:54``)
+  with mask-and-weight: zero entries contribute identity elements, which XLA fuses into the
+  reduction. No dynamic shapes anywhere on device.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def check_cluster_labels(preds, target) -> None:
+    """Host-side validation (reference ``utils.py:185``)."""
+    if jnp.ndim(preds) != 1 or jnp.ndim(target) != 1:
+        raise ValueError(f"Expected 1d `preds` and `target` but got {jnp.ndim(preds)} and {jnp.ndim(target)}.")
+    if jnp.shape(preds) != jnp.shape(target):
+        raise ValueError(f"Expected `preds` and `target` to have the same shape, got {jnp.shape(preds)} and {jnp.shape(target)}.")
+    for name, x in (("preds", preds), ("target", target)):
+        xn = np.asarray(x)
+        if xn.size and (np.iscomplexobj(xn) or (xn.dtype.kind == "f" and not np.all(xn == np.floor(xn)))):
+            raise ValueError(f"Expected real, discrete values for `{name}` but received {xn.dtype}.")
+
+
+def relabel(x) -> Tuple[Array, int]:
+    """Map arbitrary labels to ``0..K-1`` (host ``np.unique``, the one dynamic step)."""
+    _, inv = np.unique(np.asarray(x), return_inverse=True)
+    n = int(inv.max()) + 1 if inv.size else 0
+    return jnp.asarray(inv, jnp.int32), n
+
+
+def contingency_from_indices(target_idx: Array, preds_idx: Array, num_target: int, num_preds: int) -> Array:
+    """(R, C) contingency matrix of pre-relabelled indices via MXU one-hot matmul."""
+    oh_t = jax.nn.one_hot(target_idx, num_target, dtype=jnp.float32)  # (N, R)
+    oh_p = jax.nn.one_hot(preds_idx, num_preds, dtype=jnp.float32)  # (N, C)
+    return jnp.matmul(oh_t.T, oh_p, precision="highest")
+
+
+def calculate_contingency_matrix(preds, target) -> Array:
+    """(n_classes_target, n_classes_preds) contingency matrix (reference ``utils.py:119``)."""
+    t_idx, n_t = relabel(target)
+    p_idx, n_p = relabel(preds)
+    return contingency_from_indices(t_idx, p_idx, max(n_t, 1), max(n_p, 1))
+
+
+def calculate_entropy(x) -> Array:
+    """Entropy of a label array (reference ``utils.py:47``)."""
+    if jnp.shape(x)[0] == 0:
+        return jnp.asarray(1.0)
+    idx, k = relabel(x)
+    p = jnp.bincount(idx, length=k).astype(jnp.float32)
+    if k == 1:
+        return jnp.asarray(0.0)
+    n = p.sum()
+    # all p > 0 after relabel (every unique value occurs), so logs are finite
+    return -jnp.sum((p / n) * (jnp.log(p) - jnp.log(n)))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, float, str]) -> Array:
+    """Generalized mean (reference ``utils.py:78``)."""
+    if isinstance(p, str):
+        if p == "min":
+            return jnp.min(x)
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(x)))
+        if p == "arithmetic":
+            return jnp.mean(x)
+        if p == "max":
+            return jnp.max(x)
+        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+    return jnp.mean(x**p) ** (1.0 / p)
+
+
+def _validate_average_method_arg(average_method: str) -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError("Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`")
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds=None, target=None, contingency: Array = None
+) -> Array:
+    """2x2 pair confusion matrix (reference ``utils.py:217``) — pure arithmetic, trace-safe.
+
+    Layout matches the REFERENCE, which is the transpose of sklearn's ``pair_confusion_matrix``
+    off-diagonal convention (reference docstring example ``utils.py:256-260`` gives
+    ``[[8, 2], [0, 2]]`` where sklearn gives ``[[8, 0], [2, 2]]``): here ``[0, 1]`` counts pairs
+    that are together in ``target`` but split in ``preds``.
+    """
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if preds is not None and target is not None:
+        contingency = calculate_contingency_matrix(preds, target)
+    if contingency is None:
+        raise ValueError("Must provide `contingency` if `preds` and `target` are not provided.")
+    contingency = contingency.astype(jnp.float32)
+    num_samples = contingency.sum()
+    sum_c = contingency.sum(axis=1)
+    sum_k = contingency.sum(axis=0)
+    sum_squared = (contingency**2).sum()
+    m11 = sum_squared - num_samples
+    m10 = (contingency * sum_k[None, :]).sum() - sum_squared
+    m01 = (contingency.T * sum_c[None, :]).sum() - sum_squared
+    m00 = num_samples**2 - m01 - m10 - sum_squared
+    return jnp.stack([jnp.stack([m00, m01]), jnp.stack([m10, m11])])
+
+
+def _validate_intrinsic_cluster_data(data, labels) -> None:
+    """Reference ``utils.py:198``."""
+    if jnp.ndim(data) != 2:
+        raise ValueError(f"Expected 2D data, got {jnp.ndim(data)}D data instead")
+    if not jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating):
+        raise ValueError("Expected floating point data, got non-floating point data instead")
+    if jnp.ndim(labels) != 1:
+        raise ValueError(f"Expected 1D labels, got {jnp.ndim(labels)}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: int) -> None:
+    """Reference ``utils.py:208``."""
+    if not 1 < num_labels < num_samples:
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f"Got {num_labels} clusters and {num_samples} samples."
+        )

@@ -1,0 +1,49 @@
+"""Fleiss' kappa inter-rater agreement (reference ``src/torchmetrics/functional/nominal/fleiss_kappa.py``)."""
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _fleiss_kappa_update(ratings: Array, mode: Literal["counts", "probs"] = "counts") -> Array:
+    """Convert ratings to a (n_samples, n_categories) counts matrix (reference ``fleiss_kappa.py:24``)."""
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        n_categories = ratings.shape[1]
+        picked = jnp.argmax(ratings, axis=1)  # (n_samples, n_raters)
+        counts = jax.nn.one_hot(picked, n_categories, dtype=jnp.float32).sum(axis=1)
+        return counts
+    if mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """Kappa from the counts matrix (reference ``fleiss_kappa.py:43``)."""
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: Literal["counts", "probs"] = "counts") -> Array:
+    """Fleiss' kappa (reference ``fleiss_kappa.py:61``)."""
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

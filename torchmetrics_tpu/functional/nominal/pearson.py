@@ -1,0 +1,59 @@
+"""Pearson's contingency coefficient (reference ``src/torchmetrics/functional/nominal/pearson.py``)."""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_chi_squared,
+    _joint_num_classes,
+    _nominal_confmat_update,
+    _nominal_input_validation,
+)
+
+
+def _pearsons_contingency_coefficient_update(
+    preds, target, num_classes: int, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Reference ``pearson.py:29``."""
+    return _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """Reference ``pearson.py:56``."""
+    confmat = confmat.astype(jnp.float32)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / jnp.maximum(cm_sum, 1e-38)
+    return jnp.clip(jnp.sqrt(phi_squared / (1 + phi_squared)), 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Pearson's contingency coefficient (reference ``pearson.py:75``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
+    target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
+    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Pairwise coefficient over columns (reference ``pearson.py:129``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = np.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        out[i, j] = out[j, i] = float(
+            pearsons_contingency_coefficient(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value)
+        )
+    return jnp.asarray(out)

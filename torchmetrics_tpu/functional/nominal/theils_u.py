@@ -1,0 +1,71 @@
+"""Theil's U uncertainty coefficient (reference ``src/torchmetrics/functional/nominal/theils_u.py``)."""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.nominal.utils import (
+    _joint_num_classes,
+    _nominal_confmat_update,
+    _nominal_input_validation,
+)
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """H(X|Y) from the contingency matrix (reference ``theils_u.py:30``), masked nansum form."""
+    confmat = confmat.astype(jnp.float32)
+    total = jnp.maximum(confmat.sum(), 1e-38)
+    p_xy = confmat / total
+    p_y = confmat.sum(axis=1) / total  # rows are target=Y categories
+    pos = p_xy > 0
+    safe_xy = jnp.where(pos, p_xy, 1.0)
+    safe_y = jnp.maximum(p_y, 1e-38)[:, None]
+    return jnp.sum(jnp.where(pos, p_xy * (jnp.log(safe_y) - jnp.log(safe_xy)), 0.0))
+
+
+def _theils_u_update(
+    preds, target, num_classes: int, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Reference ``theils_u.py:55``."""
+    return _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """Reference ``theils_u.py:84``: U = (H(X) - H(X|Y)) / H(X) with X = preds (columns)."""
+    confmat = confmat.astype(jnp.float32)
+    s_xy = _conditional_entropy_compute(confmat)
+    total = jnp.maximum(confmat.sum(), 1e-38)
+    p_x = confmat.sum(axis=0) / total
+    pos = p_x > 0
+    safe_x = jnp.where(pos, p_x, 1.0)
+    s_x = -jnp.sum(jnp.where(pos, safe_x * jnp.log(safe_x), 0.0))
+    return jnp.where(s_x == 0, 0.0, (s_x - s_xy) / jnp.maximum(s_x, 1e-38))
+
+
+def theils_u(
+    preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Theil's U of preds given target — asymmetric (reference ``theils_u.py:107``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
+    target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
+    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(
+    matrix, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Pairwise (asymmetric) Theil's U over columns (reference ``theils_u.py:147``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = np.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), np.float32)
+    for i, j in itertools.permutations(range(num_variables), 2):
+        out[i, j] = float(theils_u(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value))
+    return jnp.asarray(out)

@@ -1,0 +1,85 @@
+"""Tschuprow's T (reference ``src/torchmetrics/functional/nominal/tschuprows.py``)."""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _effective_shape,
+    _joint_num_classes,
+    _nominal_confmat_update,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+from torchmetrics_tpu.utils.checks import is_traced
+
+
+def _tschuprows_t_update(
+    preds, target, num_classes: int, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Reference ``tschuprows.py:32``."""
+    return _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Reference ``tschuprows.py:58``."""
+    confmat = confmat.astype(jnp.float32)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / jnp.maximum(cm_sum, 1e-38)
+    num_rows, num_cols = _effective_shape(confmat)
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        min_corrected = jnp.minimum(rows_corrected, cols_corrected)
+        if not is_traced(min_corrected) and float(min_corrected) == 1.0:
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+        denom = jnp.sqrt(jnp.maximum((rows_corrected - 1) * (cols_corrected - 1), 1e-38))
+        value = jnp.sqrt(phi_squared_corrected / denom)
+        value = jnp.where(min_corrected == 1.0, jnp.nan, value)
+    else:
+        denom = jnp.sqrt(jnp.maximum((num_rows - 1) * (num_cols - 1), 1e-38))
+        value = jnp.sqrt(phi_squared / denom)
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds,
+    target,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T statistic (reference ``tschuprows.py:90``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
+    target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
+    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _tschuprows_t_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def tschuprows_t_matrix(
+    matrix,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Tschuprow's T over columns (reference ``tschuprows.py:133``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = np.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        out[i, j] = out[j, i] = float(
+            tschuprows_t(matrix[:, i], matrix[:, j], bias_correction, nan_strategy, nan_replace_value)
+        )
+    return jnp.asarray(out)

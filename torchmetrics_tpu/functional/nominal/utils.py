@@ -1,0 +1,150 @@
+"""Shared nominal-association kernels (reference ``src/torchmetrics/functional/nominal/utils.py``).
+
+TPU-first: the reference's ``_drop_empty_rows_and_cols`` (``utils.py:62``) is a dynamic-shape
+boolean gather; here empty rows/columns stay in place and every downstream quantity is computed
+with mask-and-weight — the effective row/column counts are masked sums, expected frequencies of
+empty cells are exactly zero and contribute nothing. NaN "drop" becomes a zero sample weight in
+the confusion-matrix matmul instead of a dynamic filter, so the whole update is one jitted
+device program.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.ops.histogram import confusion_matrix_update
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    """Reference ``utils.py:23``."""
+    if nan_strategy not in ("replace", "drop"):
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _nominal_confmat_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """(C, C) confusion-matrix contribution with NaN handling fused in (reference pattern of
+    ``_cramers_v_update``, ``cramers.py:32``: argmax-if-2D → NaN handle → confmat).
+
+    Rows of the contingency matrix are ``target`` categories, columns ``preds`` (matching
+    ``_multiclass_confusion_matrix_update``). "drop" zero-weights NaN pairs instead of filtering.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == 2:
+        preds = jnp.argmax(preds, axis=1)
+    if target.ndim == 2:
+        target = jnp.argmax(target, axis=1)
+    preds_f = preds.astype(jnp.float32)
+    target_f = target.astype(jnp.float32)
+    nan_mask = jnp.isnan(preds_f) | jnp.isnan(target_f)
+    if nan_strategy == "replace":
+        preds_f = jnp.where(jnp.isnan(preds_f), nan_replace_value, preds_f)
+        target_f = jnp.where(jnp.isnan(target_f), nan_replace_value, target_f)
+        weights = None
+    else:  # drop -> zero weight
+        preds_f = jnp.where(nan_mask, 0.0, preds_f)
+        target_f = jnp.where(nan_mask, 0.0, target_f)
+        weights = (~nan_mask).astype(jnp.float32)
+    return confusion_matrix_update(
+        preds_f.astype(jnp.int32), target_f.astype(jnp.int32), num_classes, weights=weights, dtype=jnp.float32
+    )
+
+
+def _row_col_masks(confmat: Array) -> Tuple[Array, Array]:
+    """Boolean masks of non-empty rows/columns (the masked analog of ``_drop_empty_rows_and_cols``)."""
+    return confmat.sum(axis=1) > 0, confmat.sum(axis=0) > 0
+
+
+def _effective_shape(confmat: Array) -> Tuple[Array, Array]:
+    """Non-empty (rows, cols) counts as traced f32 scalars."""
+    row_mask, col_mask = _row_col_masks(confmat)
+    return jnp.sum(row_mask).astype(jnp.float32), jnp.sum(col_mask).astype(jnp.float32)
+
+
+def _expected_freqs(confmat: Array) -> Array:
+    """Outer-product expected frequencies (reference ``utils.py:35``); zero for empty cells."""
+    rows = confmat.sum(axis=1)
+    cols = confmat.sum(axis=0)
+    return rows[:, None] * cols[None, :] / jnp.maximum(confmat.sum(), 1e-38)
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-squared over non-empty cells (reference ``utils.py:41``), trace-safe.
+
+    The reference mutates the confmat for the ``df == 1`` Yates-style correction; here both the
+    raw and corrected statistics are computed and selected by ``where`` on the traced df.
+    """
+    expected = _expected_freqs(confmat)
+    valid = expected > 0
+    n_rows, n_cols = _effective_shape(confmat)
+    df = n_rows * n_cols - n_rows - n_cols + 1.0
+
+    safe_e = jnp.where(valid, expected, 1.0)
+    chi_raw = jnp.sum(jnp.where(valid, (confmat - expected) ** 2 / safe_e, 0.0))
+    if bias_correction:
+        diff = expected - confmat
+        corrected = confmat + jnp.sign(diff) * jnp.minimum(0.5, jnp.abs(diff))
+        chi_corr = jnp.sum(jnp.where(valid, (corrected - expected) ** 2 / safe_e, 0.0))
+        chi = jnp.where(df == 1.0, chi_corr, chi_raw)
+    else:
+        chi = chi_raw
+    return jnp.where(df == 0.0, 0.0, chi)
+
+
+def _compute_phi_squared_corrected(phi_squared: Array, num_rows: Array, num_cols: Array, confmat_sum: Array) -> Array:
+    """Reference ``utils.py:85``."""
+    return jnp.maximum(0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / jnp.maximum(confmat_sum - 1, 1e-38))
+
+
+def _compute_rows_and_cols_corrected(num_rows: Array, num_cols: Array, confmat_sum: Array) -> Tuple[Array, Array]:
+    """Reference ``utils.py:98``."""
+    denom = jnp.maximum(confmat_sum - 1, 1e-38)
+    return num_rows - (num_rows - 1) ** 2 / denom, num_cols - (num_cols - 1) ** 2 / denom
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, num_rows: Array, num_cols: Array, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    """Reference ``utils.py:105``."""
+    return (
+        _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, confmat_sum),
+        *_compute_rows_and_cols_corrected(num_rows, num_cols, confmat_sum),
+    )
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )
+
+
+def _joint_num_classes(preds, target, nan_strategy: str, nan_replace_value) -> int:
+    """Host-side class count for the public functionals (reference counts unique of the concat,
+    ``cramers.py:137``). Values are relied on to be 0..C-1 category codes, as in the reference."""
+    import numpy as np
+
+    p = np.asarray(preds, np.float32).reshape(-1)
+    t = np.asarray(target, np.float32).reshape(-1)
+    if nan_strategy == "replace":
+        p = np.nan_to_num(p, nan=nan_replace_value)
+        t = np.nan_to_num(t, nan=nan_replace_value)
+    else:
+        keep = ~(np.isnan(p) | np.isnan(t))
+        p, t = p[keep], t[keep]
+    return max(int(len(np.unique(np.concatenate([p, t])))), 1)

@@ -42,6 +42,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.parallel.sync import process_sync
+from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -302,9 +303,7 @@ class Metric:
             for i in range(n_batches):
                 self.update(*(a[i] for a in args), **{k: v[i] for k, v in kwargs.items()})
             return
-        if self._should_validate() and not any(
-            isinstance(x, jax.core.Tracer) for x in (*args, *kwargs.values())
-        ):
+        if self._should_validate() and not is_traced(*args, *kwargs.values()):
             # host-side value checks are per-batch shaped; hoist the whole stack to numpy ONCE
             # and slice on the host (1000 eager device slices here cost more than the kernel)
             np_args = tuple(np.asarray(a) for a in args)

@@ -1,0 +1,16 @@
+"""Nominal module metrics (reference ``src/torchmetrics/nominal/``)."""
+from torchmetrics_tpu.nominal.metrics import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+__all__ = [
+    "CramersV",
+    "FleissKappa",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

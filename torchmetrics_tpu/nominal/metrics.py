@@ -1,0 +1,130 @@
+"""Stateful nominal metrics (reference ``src/torchmetrics/nominal/*.py``).
+
+State: one (C, C) confusion-matrix tensor with ``dist_reduce_fx="sum"`` (reference
+``nominal/cramers.py:105``) — fixed shape, jitted MXU one-hot update, psum-syncable. Fleiss'
+kappa keeps a counts list state with ``"cat"`` (reference ``nominal/fleiss_kappa.py:88``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Literal, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.nominal.cramers import _cramers_v_compute, _cramers_v_update
+from torchmetrics_tpu.functional.nominal.fleiss_kappa import _fleiss_kappa_compute, _fleiss_kappa_update
+from torchmetrics_tpu.functional.nominal.pearson import (
+    _pearsons_contingency_coefficient_compute,
+    _pearsons_contingency_coefficient_update,
+)
+from torchmetrics_tpu.functional.nominal.theils_u import _theils_u_compute, _theils_u_update
+from torchmetrics_tpu.functional.nominal.tschuprows import _tschuprows_t_compute, _tschuprows_t_update
+from torchmetrics_tpu.functional.nominal.utils import _nominal_input_validation
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class _ConfmatNominalMetric(Metric):
+    """Shared shell: (C, C) confmat sum-state + trace-safe compute."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: Literal["replace", "drop"] = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_classes, int) and num_classes > 0):
+            raise ValueError(f"Argument `num_classes` should be a positive integer, got {num_classes}.")
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.num_classes = num_classes
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.float32), dist_reduce_fx="sum")
+
+    def _update_fn(self, preds, target) -> Array:
+        raise NotImplementedError
+
+    def _update(self, state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
+        return {"confmat": state["confmat"] + self._update_fn(preds, target)}
+
+
+class CramersV(_ConfmatNominalMetric):
+    """Cramer's V (reference ``nominal/cramers.py:28``)."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _update_fn(self, preds, target):
+        return _cramers_v_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+
+    def _compute(self, state):
+        return _cramers_v_compute(state["confmat"], self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
+    """Pearson's contingency coefficient (reference ``nominal/pearson.py:28``)."""
+
+    def _update_fn(self, preds, target):
+        return _pearsons_contingency_coefficient_update(
+            preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+
+    def _compute(self, state):
+        return _pearsons_contingency_coefficient_compute(state["confmat"])
+
+
+class TheilsU(_ConfmatNominalMetric):
+    """Theil's U (reference ``nominal/theils_u.py:28``)."""
+
+    def _update_fn(self, preds, target):
+        return _theils_u_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+
+    def _compute(self, state):
+        return _theils_u_compute(state["confmat"])
+
+
+class TschuprowsT(_ConfmatNominalMetric):
+    """Tschuprow's T (reference ``nominal/tschuprows.py:28``)."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _update_fn(self, preds, target):
+        return _tschuprows_t_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+
+    def _compute(self, state):
+        return _tschuprows_t_compute(state["confmat"], self.bias_correction)
+
+
+class FleissKappa(Metric):
+    """Fleiss' kappa (reference ``nominal/fleiss_kappa.py:28``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, mode: Literal["counts", "probs"] = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+        self.mode = mode
+        self.add_state("counts", default=[], dist_reduce_fx="cat")
+
+    def _update(self, state: Dict[str, Any], ratings: Array) -> Dict[str, Any]:
+        return {"counts": _fleiss_kappa_update(ratings, self.mode)}
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        counts = state["counts"] if not isinstance(state["counts"], list) else dim_zero_cat(state["counts"])
+        return _fleiss_kappa_compute(counts)

@@ -77,6 +77,7 @@ class Running(WrapperMetric):
                     slot[key] = list(self._state.lists[name])
             self.base_metric._update_count = i + 1
             self.base_metric._reduce_states(dict(self.base_metric._state.tensors), slot)
+        self.base_metric._update_called = True  # states were merged in, not update()-ed
         val = self.base_metric.compute()
         self.base_metric.reset()
         return val

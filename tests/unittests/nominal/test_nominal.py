@@ -228,3 +228,10 @@ def test_matrix_functions():
         for j in range(3):
             if i != j and not (np.isnan(out[i, j])):
                 np.testing.assert_allclose(out[i, j], out[j, i], atol=1e-6)
+
+
+def test_gapped_category_codes():
+    # codes {0, 2} must not be silently truncated (perfect association -> V == 1)
+    p = jnp.asarray([0, 2, 2, 0, 2, 0])
+    np.testing.assert_allclose(float(cramers_v(p, p, False)), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(theils_u(p, p)), 1.0, atol=1e-6)

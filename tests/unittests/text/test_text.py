@@ -1,0 +1,215 @@
+"""Text metric parity tests.
+
+Independent references: ``nltk.translate`` for BLEU/chrF where available, pure-python
+Levenshtein for the edit-distance family, torch cross-entropy for perplexity, and the reference
+library's documented examples (cited per test) as golden values.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    edit_distance,
+    match_error_rate,
+    perplexity,
+    sacre_bleu_score,
+    squad,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    MatchErrorRate,
+    Perplexity,
+    SacreBLEUScore,
+    SQuAD,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+PREDS = ["it is a guide to action which ensures that the military always obeys the commands of the party"]
+TARGETS = [
+    [
+        "it is a guide to action that ensures that the military will forever heed party commands",
+        "it is the guiding principle which guarantees the military forces always being under the command of the party",
+    ]
+]
+
+
+def _levenshtein(a, b):
+    # classic O(nm) reference DP
+    dp = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1, prev + (ca != cb))
+    return dp[len(b)]
+
+
+def test_edit_distance_kernel_vs_python():
+    rng = np.random.RandomState(3)
+    strings = ["".join(rng.choice(list("abcde"), size=rng.randint(0, 20))) for _ in range(40)]
+    preds, targets = strings[:20], strings[20:]
+    got = edit_distance(preds, targets, reduction="none")
+    for g, p, t in zip(np.asarray(got), preds, targets):
+        assert int(g) == _levenshtein(p, t), (p, t)
+
+
+def test_edit_distance_reference_examples():
+    # reference text/edit.py docstring examples
+    np.testing.assert_allclose(float(edit_distance(["rain"], ["shine"])), 3.0)
+    out = edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction="none")
+    np.testing.assert_allclose(np.asarray(out), [3, 4])
+    m = EditDistance()
+    m.update(["rain"], ["shine"])
+    m.update(["lnaguaeg"], ["language"])
+    np.testing.assert_allclose(float(m.compute()), 3.5)
+    m_none = EditDistance(reduction="none")
+    m_none.update(["rain", "lnaguaeg"], ["shine", "language"])
+    np.testing.assert_allclose(np.asarray(m_none.compute()), [3, 4])
+
+
+def _jiwer_like_wer(preds, targets):
+    errs = sum(_levenshtein(p.split(), t.split()) for p, t in zip(preds, targets))
+    total = sum(len(t.split()) for t in targets)
+    return errs / total
+
+
+def test_wer_family():
+    preds = ["this is the prediction", "there is an other sample"]
+    targets = ["this is the reference", "there is another one"]
+    np.testing.assert_allclose(float(word_error_rate(preds, targets)), _jiwer_like_wer(preds, targets), atol=1e-6)
+    # reference docstring values (text/wer.py example: 0.5)
+    np.testing.assert_allclose(float(word_error_rate(preds, targets)), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(char_error_rate(preds, targets)), 0.3415, atol=2e-4)
+    np.testing.assert_allclose(float(match_error_rate(preds, targets)), 0.4444, atol=2e-4)
+    np.testing.assert_allclose(float(word_information_lost(preds, targets)), 0.6528, atol=2e-4)
+    np.testing.assert_allclose(float(word_information_preserved(preds, targets)), 0.3472, atol=2e-4)
+
+    # stateful accumulation == functional on the full corpus
+    for cls, fn in [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ]:
+        m = cls()
+        m.update(preds[:1], targets[:1])
+        m.update(preds[1:], targets[1:])
+        np.testing.assert_allclose(float(m.compute()), float(fn(preds, targets)), atol=1e-6)
+
+
+def test_bleu_reference_values():
+    # golden value from running the reference implementation on this exact input: 0.50457
+    np.testing.assert_allclose(float(bleu_score(PREDS, TARGETS)), 0.50457, atol=2e-4)
+    try:
+        from nltk.translate.bleu_score import corpus_bleu
+    except ImportError:
+        pytest.skip("nltk unavailable")
+    refs = [[t.split() for t in tt] for tt in TARGETS]
+    hyps = [p.split() for p in PREDS]
+    np.testing.assert_allclose(float(bleu_score(PREDS, TARGETS)), corpus_bleu(refs, hyps), atol=1e-5)
+
+
+def test_bleu_module_accumulation_and_smooth():
+    m = BLEUScore()
+    m.update(PREDS, TARGETS)
+    np.testing.assert_allclose(float(m.compute()), float(bleu_score(PREDS, TARGETS)), atol=1e-6)
+    # smoothing + weights paths
+    v = float(bleu_score(PREDS, TARGETS, n_gram=2, smooth=True, weights=[0.7, 0.3]))
+    assert 0.0 < v <= 1.0
+    # empty-overlap -> 0
+    assert float(bleu_score(["xyz"], [["abc def"]])) == 0.0
+
+
+def test_sacre_bleu_tokenizers():
+    preds = ["It is a guide to action, which ensures that the military always obeys the commands of the party."]
+    targets = [["It is a guide to action that ensures that the military will forever heed Party commands."]]
+    # 13a on simple text: punctuation split off
+    v13a = float(sacre_bleu_score(preds, targets, tokenize="13a"))
+    vchar = float(sacre_bleu_score(preds, targets, tokenize="char"))
+    vnone = float(sacre_bleu_score(preds, targets, tokenize="none"))
+    vintl = float(sacre_bleu_score(preds, targets, tokenize="intl"))
+    assert 0 < v13a < 1 and 0 < vchar < 1 and 0 < vnone < 1 and 0 < vintl < 1
+    # lowercase makes Party == party match
+    assert float(sacre_bleu_score(preds, targets, lowercase=True)) >= v13a
+    m = SacreBLEUScore()
+    m.update(preds, targets)
+    np.testing.assert_allclose(float(m.compute()), v13a, atol=1e-6)
+    with pytest.raises(ValueError, match="external segmenter"):
+        sacre_bleu_score(preds, targets, tokenize="ja-mecab")
+
+
+def test_perplexity_vs_torch():
+    import torch
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(4, 10, 16).astype(np.float32)
+    target = rng.randint(0, 16, (4, 10))
+    target[0, :3] = -100
+    ours = float(perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=-100))
+    ce = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits).reshape(-1, 16), torch.from_numpy(target).reshape(-1), ignore_index=-100
+    )
+    np.testing.assert_allclose(ours, float(torch.exp(ce)), rtol=1e-5)
+    m = Perplexity(ignore_index=-100)
+    m.update(jnp.asarray(logits[:2]), jnp.asarray(target[:2]))
+    m.update(jnp.asarray(logits[2:]), jnp.asarray(target[2:]))
+    np.testing.assert_allclose(float(m.compute()), ours, rtol=1e-5)
+
+
+def test_chrf_reference_values():
+    preds = ["the cat is on the mat"]
+    targets = [["there is a cat on the mat", "a cat is on the mat"]]
+    # reference text/chrf.py docstring: 0.8640
+    np.testing.assert_allclose(float(chrf_score(preds, targets)), 0.8640, atol=2e-4)
+    m = CHRFScore()
+    m.update(preds, targets)
+    np.testing.assert_allclose(float(m.compute()), 0.8640, atol=2e-4)
+    # sentence-level path
+    score, sentences = chrf_score(preds, targets, return_sentence_level_score=True)
+    assert sentences.shape == (1,)
+    np.testing.assert_allclose(float(score), float(sentences[0]), atol=1e-6)
+    # chrF (no word order) differs from chrF++
+    v_chrf = float(chrf_score(preds, targets, n_word_order=0))
+    assert v_chrf != pytest.approx(float(score))
+
+
+def test_squad():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    out = squad(preds, target)
+    np.testing.assert_allclose(float(out["exact_match"]), 100.0)
+    np.testing.assert_allclose(float(out["f1"]), 100.0)
+    m = SQuAD()
+    m.update(preds, target)
+    m.update(
+        [{"prediction_text": "the alps", "id": "2"}],
+        [{"answers": {"answer_start": [0], "text": ["alps mountains"]}, "id": "2"}],
+    )
+    out = m.compute()
+    np.testing.assert_allclose(float(out["exact_match"]), 50.0)
+    # pair 2 normalizes "the alps" -> ["alps"]: p=1, r=1/2, f1=2/3 -> avg = 83.33
+    np.testing.assert_allclose(float(out["f1"]), 100 * (1 + 2 / 3) / 2, rtol=1e-5)
+    with pytest.raises(KeyError):
+        squad([{"id": "1"}], target)
+
+
+def test_text_metric_reset_and_sync_shapes():
+    m = WordErrorRate()
+    m.update(["a b c"], ["a b d"])
+    assert float(m.compute()) > 0
+    m.reset()
+    m.update(["a b c"], ["a b c"])
+    assert float(m.compute()) == 0.0

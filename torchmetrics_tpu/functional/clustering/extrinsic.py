@@ -27,6 +27,20 @@ from torchmetrics_tpu.functional.clustering.utils import (
 )
 
 
+def _entropy_from_marginal(counts: Array) -> Array:
+    """Entropy of a label distribution given its count vector (a contingency marginal).
+
+    After relabelling every marginal count is > 0, so this equals ``calculate_entropy`` on the
+    raw labels without re-running the host ``np.unique`` pass.
+    """
+    counts = counts.astype(jnp.float32)
+    if counts.shape[0] <= 1:
+        return jnp.asarray(0.0)
+    n = counts.sum()
+    safe = jnp.maximum(counts, 1e-38)
+    return -jnp.sum((counts / n) * (jnp.log(safe) - jnp.log(n)))
+
+
 def _mutual_info_from_contingency(contingency: Array) -> Array:
     """MI from a contingency matrix — masked form of reference ``mutual_info_score.py:35``."""
     contingency = contingency.astype(jnp.float32)
@@ -133,7 +147,10 @@ def adjusted_mutual_info_score(
     n_samples = jnp.shape(target)[0]
     emi = expected_mutual_info_score(contingency, n_samples)
     normalizer = calculate_generalized_mean(
-        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+        jnp.stack(
+            [_entropy_from_marginal(contingency.sum(axis=0)), _entropy_from_marginal(contingency.sum(axis=1))]
+        ),
+        average_method,
     )
     denominator = normalizer - emi
     eps = jnp.finfo(jnp.float32).eps
@@ -147,11 +164,15 @@ def normalized_mutual_info_score(
     """Normalized mutual information (reference ``normalized_mutual_info_score.py:28``)."""
     check_cluster_labels(preds, target)
     _validate_average_method_arg(average_method)
-    mutual_info = mutual_info_score(preds, target)
+    contingency = calculate_contingency_matrix(preds, target)
+    mutual_info = _mutual_info_from_contingency(contingency)
     if float(jnp.abs(mutual_info)) <= float(jnp.finfo(jnp.float32).eps):
         return mutual_info
     normalizer = calculate_generalized_mean(
-        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+        jnp.stack(
+            [_entropy_from_marginal(contingency.sum(axis=0)), _entropy_from_marginal(contingency.sum(axis=1))]
+        ),
+        average_method,
     )
     return mutual_info / normalizer
 

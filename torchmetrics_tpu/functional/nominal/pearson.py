@@ -10,7 +10,7 @@ from jax import Array
 
 from torchmetrics_tpu.functional.nominal.utils import (
     _compute_chi_squared,
-    _joint_num_classes,
+    _joint_relabel,
     _nominal_confmat_update,
     _nominal_input_validation,
 )
@@ -39,8 +39,8 @@ def pearsons_contingency_coefficient(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
-    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
-    confmat = _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    p_idx, t_idx, num_classes = _joint_relabel(preds, target, nan_strategy, nan_replace_value)
+    confmat = _pearsons_contingency_coefficient_update(p_idx, t_idx, num_classes)
     return _pearsons_contingency_coefficient_compute(confmat)
 
 

@@ -9,7 +9,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.functional.nominal.utils import (
-    _joint_num_classes,
+    _joint_relabel,
     _nominal_confmat_update,
     _nominal_input_validation,
 )
@@ -53,8 +53,8 @@ def theils_u(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
-    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
-    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    p_idx, t_idx, num_classes = _joint_relabel(preds, target, nan_strategy, nan_replace_value)
+    confmat = _theils_u_update(p_idx, t_idx, num_classes)
     return _theils_u_compute(confmat)
 
 

@@ -12,7 +12,7 @@ from torchmetrics_tpu.functional.nominal.utils import (
     _compute_bias_corrected_values,
     _compute_chi_squared,
     _effective_shape,
-    _joint_num_classes,
+    _joint_relabel,
     _nominal_confmat_update,
     _nominal_input_validation,
     _unable_to_use_bias_correction_warning,
@@ -62,8 +62,8 @@ def tschuprows_t(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target
-    num_classes = _joint_num_classes(preds, target, nan_strategy, nan_replace_value)
-    confmat = _tschuprows_t_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    p_idx, t_idx, num_classes = _joint_relabel(preds, target, nan_strategy, nan_replace_value)
+    confmat = _tschuprows_t_update(p_idx, t_idx, num_classes)
     return _tschuprows_t_compute(confmat, bias_correction)
 
 

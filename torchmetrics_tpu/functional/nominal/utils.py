@@ -134,9 +134,14 @@ def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
     )
 
 
-def _joint_num_classes(preds, target, nan_strategy: str, nan_replace_value) -> int:
-    """Host-side class count for the public functionals (reference counts unique of the concat,
-    ``cramers.py:137``). Values are relied on to be 0..C-1 category codes, as in the reference."""
+def _joint_relabel(preds, target, nan_strategy: str, nan_replace_value):
+    """Host-side joint relabel to dense 0..C-1 codes + class count for the public functionals.
+
+    The reference counts unique values of the concat (``cramers.py:137``) but then indexes the
+    confmat with the RAW codes — gapped codes (e.g. {0, 2}) crash its bincount/reshape. Relabeling
+    through one joint ``np.unique`` keeps the same statistic for dense codes and makes gapped or
+    arbitrary category values work instead of failing.
+    """
     import numpy as np
 
     p = np.asarray(preds, np.float32).reshape(-1)
@@ -147,4 +152,10 @@ def _joint_num_classes(preds, target, nan_strategy: str, nan_replace_value) -> i
     else:
         keep = ~(np.isnan(p) | np.isnan(t))
         p, t = p[keep], t[keep]
-    return max(int(len(np.unique(np.concatenate([p, t])))), 1)
+    uniq, inv = np.unique(np.concatenate([p, t]), return_inverse=True)
+    num_classes = max(len(uniq), 1)
+    return (
+        jnp.asarray(inv[: len(p)], jnp.int32),
+        jnp.asarray(inv[len(p) :], jnp.int32),
+        num_classes,
+    )

@@ -1,0 +1,28 @@
+"""Functional text metrics (reference ``src/torchmetrics/functional/text/``)."""
+from torchmetrics_tpu.functional.text.bleu import bleu_score
+from torchmetrics_tpu.functional.text.chrf import chrf_score
+from torchmetrics_tpu.functional.text.edit import edit_distance
+from torchmetrics_tpu.functional.text.perplexity import perplexity
+from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from torchmetrics_tpu.functional.text.squad import squad
+from torchmetrics_tpu.functional.text.wer import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+
+__all__ = [
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "match_error_rate",
+    "perplexity",
+    "sacre_bleu_score",
+    "squad",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

@@ -1,0 +1,131 @@
+"""Batched Levenshtein edit distance as a jitted XLA kernel.
+
+Reference: ``src/torchmetrics/functional/text/helper.py`` (``_edit_distance:329`` — a per-pair
+Python DP loop; ``_LevenshteinEditDistance:69`` — a cached row DP, also host Python).
+
+TPU-first redesign: tokens are interned to int ids on the host (the only string-dependent step),
+sentences are padded to a ``(B, L)`` rectangle (pow2-bucketed to bound recompiles), and the DP
+runs as ONE device program for the whole batch:
+
+- ``lax.scan`` over prediction positions carries the DP row for all B pairs at once,
+- the insertion recurrence along the row — ``new[j] = min(c[j], min_{k<j} c[k] + (j-k))`` — is
+  solved in closed form with a cumulative min of ``c[k] - k`` (min-plus prefix scan), so each
+  scan step is O(L) vectorized work with no inner Python loop.
+
+Cost: O(B * Lp * Lt) FLOPs, O(log) scan depth per row — embarrassingly parallel over the batch
+where the reference is strictly sequential per pair.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_BIG = 1e9
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def _levenshtein_rows(
+    pred_ids: Array, pred_len: Array, tgt_ids: Array, tgt_len: Array, substitution_cost: float
+) -> Array:
+    """Edit distance for ONE (padded) pair; vmapped over the batch by the caller."""
+    l_t = tgt_ids.shape[0]
+    j = jnp.arange(l_t + 1, dtype=jnp.float32)
+    init_row = j  # distance from empty prediction = j insertions... (deletions of target prefix)
+
+    def step(row, x):
+        pid, i = x  # i is the 1-based prediction position
+        active = i <= pred_len
+        sub_cost = jnp.where(pid == tgt_ids, 0.0, substitution_cost)
+        # candidate costs before resolving the along-row insertion dependency
+        c = jnp.concatenate(
+            [
+                jnp.asarray([i], jnp.float32),  # j=0 boundary: i deletions
+                jnp.minimum(row[:-1] + sub_cost, row[1:] + 1.0),
+            ]
+        )
+        # new[j] = j + cummin(c[k] - k)  solves new[j] = min(c[j], new[j-1] + 1)
+        new_row = j + jax.lax.associative_scan(jnp.minimum, c - j)
+        return jnp.where(active, new_row, row), None
+
+    ids_and_pos = (pred_ids, jnp.arange(1, pred_ids.shape[0] + 1, dtype=jnp.float32))
+    final_row, _ = jax.lax.scan(step, init_row, ids_and_pos)
+    return final_row[tgt_len]
+
+
+@jax.jit
+def _levenshtein_batch_kernel(pred_ids, pred_len, tgt_ids, tgt_len, substitution_cost):
+    return jax.vmap(_levenshtein_rows, in_axes=(0, 0, 0, 0, None))(
+        pred_ids, pred_len, tgt_ids, tgt_len, substitution_cost
+    )
+
+
+def _intern(batch: Sequence[Sequence[str]], vocab: dict) -> List[List[int]]:
+    out = []
+    for seq in batch:
+        row = []
+        for tok in seq:
+            idx = vocab.get(tok)
+            if idx is None:
+                idx = len(vocab)
+                vocab[tok] = idx
+            row.append(idx)
+        out.append(row)
+    return out
+
+
+def edit_distance_batch(
+    preds_tokens: Sequence[Sequence[str]],
+    target_tokens: Sequence[Sequence[str]],
+    substitution_cost: float = 1.0,
+) -> np.ndarray:
+    """Per-pair Levenshtein distances for a batch of tokenized sentences (host entry point)."""
+    if len(preds_tokens) != len(target_tokens):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds_tokens)} and {len(target_tokens)}"
+        )
+    if not preds_tokens:
+        return np.zeros((0,), np.float32)
+    vocab: dict = {}
+    p_ids = _intern(preds_tokens, vocab)
+    t_ids = _intern(target_tokens, vocab)
+    b = len(p_ids)
+    l_p = _next_pow2(max(1, max(len(r) for r in p_ids)))
+    l_t = _next_pow2(max(1, max(len(r) for r in t_ids)))
+    b_pad = _next_pow2(b)
+    # -1/-2 pads never match each other, so padded positions cost substitution but are masked by
+    # (pred_len, tgt_len) indexing anyway
+    pp = np.full((b_pad, l_p), -1, np.int32)
+    tt = np.full((b_pad, l_t), -2, np.int32)
+    pl = np.zeros((b_pad,), np.int32)
+    tl = np.zeros((b_pad,), np.int32)
+    for i, (pr, tr) in enumerate(zip(p_ids, t_ids)):
+        pp[i, : len(pr)] = pr
+        tt[i, : len(tr)] = tr
+        pl[i] = len(pr)
+        tl[i] = len(tr)
+    out = _levenshtein_batch_kernel(
+        jnp.asarray(pp), jnp.asarray(pl), jnp.asarray(tt), jnp.asarray(tl), float(substitution_cost)
+    )
+    return np.asarray(out)[:b]
+
+
+def _edit_distance_one(prediction_tokens: Sequence[str], reference_tokens: Sequence[str]) -> int:
+    """Single-pair convenience (reference ``helper.py:329`` signature)."""
+    return int(edit_distance_batch([list(prediction_tokens)], [list(reference_tokens)])[0])
+
+
+def _word_batch_stats(
+    preds: Sequence[str], target: Sequence[str], tokenize
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(distances, pred_lens, target_lens) for a batch of raw strings."""
+    p_tok = [tokenize(p) for p in preds]
+    t_tok = [tokenize(t) for t in target]
+    d = edit_distance_batch(p_tok, t_tok)
+    return d, np.asarray([len(x) for x in p_tok], np.float32), np.asarray([len(x) for x in t_tok], np.float32)

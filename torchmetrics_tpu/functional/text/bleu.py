@@ -1,0 +1,118 @@
+"""BLEU score (reference ``src/torchmetrics/functional/text/bleu.py``).
+
+State is TPU-shaped by construction (reference ``text/bleu.py:91-94``): fixed-size
+``(n_gram,)`` numerator/denominator count vectors plus two length scalars — n-gram counting is
+host string work, everything after lives on device. The compute kernel is trace-safe jnp.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """Counter of 1..n grams (reference ``bleu.py:24-45``)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Whitespace tokenizer (reference ``bleu.py:48-58``)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: np.ndarray,
+    denominator: np.ndarray,
+    preds_len: float,
+    target_len: float,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[float, float]:
+    """Accumulate clipped n-gram counts into host numpy buffers (reference ``bleu.py:60-105``).
+
+    Mutates ``numerator``/``denominator`` in place and returns updated lengths.
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+        clipped = preds_counter & target_counter
+        for key in clipped:
+            numerator[len(key) - 1] += clipped[key]
+        for key in preds_counter:
+            denominator[len(key) - 1] += preds_counter[key]
+    return preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Trace-safe BLEU compute (reference ``bleu.py:119-156``)."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    preds_len = jnp.asarray(preds_len, jnp.float32)
+    target_len = jnp.asarray(target_len, jnp.float32)
+
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(
+            numerator[0] / jnp.maximum(denominator[0], 1e-38)
+        )
+    else:
+        precision_scores = numerator / jnp.maximum(denominator, 1e-38)
+
+    safe_precision = jnp.maximum(precision_scores, 1e-38)
+    log_precision = jnp.asarray(list(weights), jnp.float32) * jnp.log(safe_precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity_penalty = jnp.where(
+        preds_len > target_len, 1.0, jnp.exp(1 - target_len / jnp.maximum(preds_len, 1e-38))
+    )
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of translated text vs one or more references (reference ``bleu.py:149``)."""
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len, target_len = _bleu_score_update(preds_, target_, numerator, denominator, 0.0, 0.0, n_gram)
+    return _bleu_score_compute(
+        preds_len, target_len, jnp.asarray(numerator), jnp.asarray(denominator), n_gram, weights, smooth
+    )

@@ -1,0 +1,60 @@
+"""Levenshtein edit distance between character sequences (reference
+``src/torchmetrics/functional/text/edit.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text._edit import edit_distance_batch
+
+
+def _edit_distance_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+) -> Array:
+    """Per-pair distances (reference ``edit.py:21``) via the batched device DP."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if not all(isinstance(x, str) for x in preds):
+        raise ValueError(f"Expected all values in argument `preds` to be string type, but got {preds}")
+    if not all(isinstance(x, str) for x in target):
+        raise ValueError(f"Expected all values in argument `target` to be string type, but got {target}")
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    d = edit_distance_batch([list(p) for p in preds], [list(t) for t in target], float(substitution_cost))
+    return jnp.asarray(d, jnp.int32)
+
+
+def _edit_distance_compute(
+    edit_scores: Array,
+    num_elements: Union[Array, int],
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Batch reduction (reference ``edit.py:49``)."""
+    if edit_scores.size == 0:
+        return jnp.asarray(0, jnp.int32)
+    if reduction == "mean":
+        return jnp.sum(edit_scores) / num_elements
+    if reduction == "sum":
+        return jnp.sum(edit_scores)
+    if reduction is None or reduction == "none":
+        return edit_scores
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Levenshtein edit distance (reference ``edit.py:80``)."""
+    distance = _edit_distance_update(preds, target, substitution_cost)
+    return _edit_distance_compute(distance, num_elements=distance.size, reduction=reduction)

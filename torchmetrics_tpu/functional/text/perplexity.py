@@ -1,0 +1,66 @@
+"""Perplexity (reference ``src/torchmetrics/functional/text/perplexity.py``).
+
+Fully on-device: one fused log-softmax + gather + masked sum per batch; ``ignore_index`` is a
+mask-and-weight (the reference's boolean indexing is dynamic-shape).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import is_traced
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Host-side validation (reference ``perplexity.py:20``)."""
+    if jnp.ndim(preds) != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {jnp.ndim(preds)}."
+        )
+    if jnp.ndim(target) != 2:
+        raise ValueError(
+            "Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len],"
+            f" but got {jnp.ndim(target)}."
+        )
+    if jnp.shape(preds)[:2] != jnp.shape(target):
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {jnp.shape(preds)[:2]} and {jnp.shape(target)}."
+        )
+    if not is_traced(preds) and not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise TypeError(
+            f"Input tensor `preds` is expected to be of floating point type but got {jnp.asarray(preds).dtype}."
+        )
+    if not is_traced(target) and not jnp.issubdtype(jnp.asarray(target).dtype, jnp.integer):
+        raise TypeError(
+            f"Input tensor `target` is expected to be of integer type but got {jnp.asarray(target).dtype}."
+        )
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """(summed token log-probs, token count) — reference ``perplexity.py:65``."""
+    log_probs = jax.nn.log_softmax(jnp.asarray(preds, jnp.float32).reshape(-1, preds.shape[-1]), axis=-1)
+    target = jnp.asarray(target).reshape(-1)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        mask = jnp.ones_like(target, jnp.float32)
+    token_lp = jnp.take_along_axis(log_probs, target[:, None], axis=1)[:, 0]
+    return -jnp.sum(token_lp * mask), jnp.sum(mask)
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    """exp(mean NLL) — reference ``perplexity.py:101``."""
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language-model output (reference ``perplexity.py:109``)."""
+    _check_shape_and_type_consistency(preds, target)
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

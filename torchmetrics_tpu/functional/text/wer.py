@@ -1,0 +1,105 @@
+"""Word/char/match error rates + word-information metrics.
+
+Reference: ``src/torchmetrics/functional/text/{wer,cer,mer,wil,wip}.py``. All five share the
+batched device Levenshtein kernel (``_edit.edit_distance_batch``) instead of the reference's
+per-pair host DP loop (``helper.py:329``); state is two-to-four sum scalars.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.text._edit import _word_batch_stats, edit_distance_batch
+
+
+def _as_list(x: Union[str, List[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _wer_update(preds, target) -> Tuple[Array, Array]:
+    """Summed edit operations + reference word count (reference ``wer.py:23``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    d, _, t_len = _word_batch_stats(preds, target, str.split)
+    return jnp.asarray(d.sum(), jnp.float32), jnp.asarray(t_len.sum(), jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``wer.py:52``."""
+    return errors / total
+
+
+def word_error_rate(preds, target) -> Array:
+    """Word error rate (reference ``wer.py:66``)."""
+    return _wer_compute(*_wer_update(preds, target))
+
+
+def _cer_update(preds, target) -> Tuple[Array, Array]:
+    """Char-level errors + reference char count (reference ``cer.py:23``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    d = edit_distance_batch([list(p) for p in preds], [list(t) for t in target])
+    total = sum(len(t) for t in target)
+    return jnp.asarray(d.sum(), jnp.float32), jnp.asarray(float(total), jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``cer.py:52``."""
+    return errors / total
+
+
+def char_error_rate(preds, target) -> Array:
+    """Character error rate (reference ``cer.py:66``)."""
+    return _cer_compute(*_cer_update(preds, target))
+
+
+def _mer_update(preds, target) -> Tuple[Array, Array]:
+    """Errors + max(len_t, len_p) totals (reference ``mer.py:23``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    d, p_len, t_len = _word_batch_stats(preds, target, str.split)
+    total = np.maximum(p_len, t_len).sum()
+    return jnp.asarray(d.sum(), jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``mer.py:55``."""
+    return errors / total
+
+
+def match_error_rate(preds, target) -> Array:
+    """Match error rate (reference ``mer.py:69``)."""
+    return _mer_compute(*_mer_update(preds, target))
+
+
+def _word_info_update(preds, target) -> Tuple[Array, Array, Array]:
+    """Shared WIL/WIP statistics (reference ``wil.py:20``, ``wip.py:21``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    d, p_len, t_len = _word_batch_stats(preds, target, str.split)
+    total = np.maximum(p_len, t_len).sum()
+    errors_minus_total = d.sum() - total
+    return (
+        jnp.asarray(errors_minus_total, jnp.float32),
+        jnp.asarray(t_len.sum(), jnp.float32),
+        jnp.asarray(p_len.sum(), jnp.float32),
+    )
+
+
+def _word_info_lost_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """Reference ``wil.py:55``."""
+    return 1 - (errors / target_total) * (errors / preds_total)
+
+
+def word_information_lost(preds, target) -> Array:
+    """Word information lost (reference ``wil.py:70``)."""
+    return _word_info_lost_compute(*_word_info_update(preds, target))
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """Reference ``wip.py:55``."""
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds, target) -> Array:
+    """Word information preserved (reference ``wip.py:68``)."""
+    return _wip_compute(*_word_info_update(preds, target))

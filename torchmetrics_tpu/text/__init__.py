@@ -1,0 +1,28 @@
+"""Text module metrics (reference ``src/torchmetrics/text/``)."""
+from torchmetrics_tpu.text.metrics import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    MatchErrorRate,
+    Perplexity,
+    SacreBLEUScore,
+    SQuAD,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+__all__ = [
+    "BLEUScore",
+    "CHRFScore",
+    "CharErrorRate",
+    "EditDistance",
+    "MatchErrorRate",
+    "Perplexity",
+    "SQuAD",
+    "SacreBLEUScore",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

@@ -1,0 +1,348 @@
+"""Stateful text metrics (reference ``src/torchmetrics/text/*.py``).
+
+String inputs cannot be traced, so text metric updates run the host counting path and fold
+results into fixed-shape device states (``jit_update=False``); computes are trace-safe jnp.
+State layouts follow the reference: BLEU keeps (n_gram,) count vectors (``text/bleu.py:91-94``),
+the error-rate family keeps 2-4 sum scalars (``text/wer.py:82-83``), chrF keeps six per-order
+vectors (vs the reference's dicts of scalars, ``text/chrf.py:131-146``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Literal, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.text._edit import edit_distance_batch
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from torchmetrics_tpu.functional.text.chrf import (
+    _chrf_score_compute,
+    _chrf_score_update,
+    _validate_chrf_args,
+)
+from torchmetrics_tpu.functional.text.edit import _edit_distance_compute, _edit_distance_update
+from torchmetrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from torchmetrics_tpu.functional.text.squad import _squad_compute, _squad_input_check, _squad_update
+from torchmetrics_tpu.functional.text.wer import (
+    _cer_update,
+    _mer_update,
+    _wer_update,
+    _word_info_update,
+    _wip_compute,
+    _word_info_lost_compute,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class _HostTextMetric(Metric):
+    """Shared shell: host-side update over strings, device-array states."""
+
+    jit_update = False
+    is_differentiable = False
+    full_state_update = True
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # strings bypass _coerce/jit entirely
+        self._host_update(*args, **kwargs)
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+
+    def _host_update(self, *args: Any, **kwargs: Any) -> None:
+        raise NotImplementedError
+
+
+class BLEUScore(_HostTextMetric):
+    """BLEU (reference ``text/bleu.py:30``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    _tokenizer = staticmethod(_tokenize_fn)
+
+    def _host_update(self, preds: Sequence[str], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[t] if isinstance(t, str) else t for t in target]
+        num = np.asarray(self._state.tensors["numerator"]).copy()
+        den = np.asarray(self._state.tensors["denominator"]).copy()
+        p_len, t_len = _bleu_score_update(
+            preds_, target_, num, den, float(self.preds_len), float(self.target_len), self.n_gram, self._tokenizer
+        )
+        self._state.tensors.update(
+            preds_len=jnp.asarray(p_len),
+            target_len=jnp.asarray(t_len),
+            numerator=jnp.asarray(num),
+            denominator=jnp.asarray(den),
+        )
+
+    def _compute(self, state: Dict[str, Array]) -> Array:
+        return _bleu_score_compute(
+            state["preds_len"], state["target_len"], state["numerator"], state["denominator"],
+            self.n_gram, self.weights, self.smooth,
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """SacreBLEU (reference ``text/sacre_bleu.py:36``)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            _SacreBLEUTokenizer._check_tokenizers_validity(tokenize)
+        self._tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+
+class _ErrorRateMetric(_HostTextMetric):
+    """Shared errors/total sum-scalar shell (WER/CER/MER)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    _update_fn = None  # set per subclass
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _host_update(self, preds, target) -> None:
+        errors, total = type(self)._update_fn(preds, target)
+        self._state.tensors["errors"] = self._state.tensors["errors"] + errors
+        self._state.tensors["total"] = self._state.tensors["total"] + total
+
+    def _compute(self, state: Dict[str, Array]) -> Array:
+        return state["errors"] / state["total"]
+
+
+class WordErrorRate(_ErrorRateMetric):
+    """WER (reference ``text/wer.py:28``)."""
+
+    _update_fn = staticmethod(_wer_update)
+
+
+class CharErrorRate(_ErrorRateMetric):
+    """CER (reference ``text/cer.py:28``)."""
+
+    _update_fn = staticmethod(_cer_update)
+
+
+class MatchErrorRate(_ErrorRateMetric):
+    """MER (reference ``text/mer.py:28``)."""
+
+    _update_fn = staticmethod(_mer_update)
+
+
+class _WordInfoMetric(_HostTextMetric):
+    """Shared errors/target_total/preds_total shell (WIL/WIP)."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _host_update(self, preds, target) -> None:
+        errors, target_total, preds_total = _word_info_update(preds, target)
+        t = self._state.tensors
+        t["errors"] = t["errors"] + errors
+        t["target_total"] = t["target_total"] + target_total
+        t["preds_total"] = t["preds_total"] + preds_total
+
+
+class WordInfoLost(_WordInfoMetric):
+    """WIL (reference ``text/wil.py:28``)."""
+
+    higher_is_better = False
+
+    def _compute(self, state):
+        return _word_info_lost_compute(state["errors"], state["target_total"], state["preds_total"])
+
+
+class WordInfoPreserved(_WordInfoMetric):
+    """WIP (reference ``text/wip.py:28``)."""
+
+    higher_is_better = True
+
+    def _compute(self, state):
+        return _wip_compute(state["errors"], state["target_total"], state["preds_total"])
+
+
+class EditDistance(_HostTextMetric):
+    """Levenshtein edit distance (reference ``text/edit.py:29``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self, substitution_cost: int = 1, reduction: Optional[Literal["mean", "sum", "none"]] = "mean", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
+            raise ValueError(
+                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+            )
+        allowed = ("mean", "sum", "none", None)
+        if reduction not in allowed:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed}, but got {reduction}")
+        self.substitution_cost = substitution_cost
+        self.reduction = reduction
+        if reduction == "none" or reduction is None:
+            self.add_state("edit_scores_list", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("edit_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("num_elements", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _host_update(self, preds, target) -> None:
+        distances = _edit_distance_update(preds, target, self.substitution_cost)
+        if self.reduction == "none" or self.reduction is None:
+            self._state.lists["edit_scores_list"].append(distances)
+        else:
+            t = self._state.tensors
+            t["edit_scores"] = t["edit_scores"] + jnp.sum(distances)
+            t["num_elements"] = t["num_elements"] + distances.size
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        if self.reduction == "none" or self.reduction is None:
+            entries = state["edit_scores_list"]
+            scores = dim_zero_cat(entries) if isinstance(entries, list) else entries
+            return _edit_distance_compute(scores, scores.size, self.reduction)
+        return _edit_distance_compute(state["edit_scores"], state["num_elements"], self.reduction)
+
+
+class Perplexity(Metric):
+    """Perplexity (reference ``text/perplexity.py:29``) — fully on-device, jitted."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _update(self, state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
+        total, count = _perplexity_update(preds, target, self.ignore_index)
+        return {
+            "total_log_probs": state["total_log_probs"] + total,
+            "count": state["count"] + count,
+        }
+
+    def _compute(self, state: Dict[str, Array]) -> Array:
+        return _perplexity_compute(state["total_log_probs"], state["count"])
+
+
+class CHRFScore(_HostTextMetric):
+    """chrF/chrF++ (reference ``text/chrf.py:32``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _STATE_KEYS = ("preds_char", "preds_word", "target_char", "target_word", "matching_char", "matching_word")
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_chrf_args(n_char_order, n_word_order, beta)
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+        for key in self._STATE_KEYS:
+            size = n_char_order if key.endswith("char") else n_word_order
+            self.add_state(key, jnp.zeros(size), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def _host_update(self, preds, target) -> None:
+        totals = {k: np.asarray(self._state.tensors[k]).copy() for k in self._STATE_KEYS}
+        sentence_scores = [] if self.return_sentence_level_score else None
+        _chrf_score_update(
+            preds, target, totals, self.n_char_order, self.n_word_order, self.n_order, self.beta,
+            self.lowercase, self.whitespace, sentence_scores,
+        )
+        for k in self._STATE_KEYS:
+            self._state.tensors[k] = jnp.asarray(totals[k])
+        if sentence_scores:
+            self._state.lists["sentence_chrf_score"].append(jnp.asarray(sentence_scores, jnp.float32))
+
+    def _compute(self, state: Dict[str, Any]):
+        score = _chrf_score_compute({k: state[k] for k in self._STATE_KEYS}, self.n_order, self.beta)
+        if self.return_sentence_level_score:
+            entries = state["sentence_chrf_score"]
+            sentences = dim_zero_cat(entries) if isinstance(entries, list) else entries
+            return score, sentences
+        return score
+
+
+class SQuAD(_HostTextMetric):
+    """SQuAD EM/F1 (reference ``text/squad.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _host_update(self, preds, target) -> None:
+        preds_dict, target_dict = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, target_dict)
+        t = self._state.tensors
+        t["f1_score"] = t["f1_score"] + f1
+        t["exact_match"] = t["exact_match"] + exact_match
+        t["total"] = t["total"] + total
+
+    def _compute(self, state: Dict[str, Array]) -> Dict[str, Array]:
+        return _squad_compute(state["f1_score"], state["exact_match"], state["total"])

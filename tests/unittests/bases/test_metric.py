@@ -137,6 +137,21 @@ def test_state_dict_persistence():
     assert m2.update_count == 2
 
 
+def test_state_dict_prefix_roundtrip():
+    # regression (ADVICE r2): a prefixed checkpoint must restore states AND the update count
+    m = DummyMetric()
+    m.persistent(True)
+    m.update(jnp.asarray(3.0))
+    m.update(jnp.asarray(7.0))
+    sd = m.state_dict(prefix="model.metric.")
+    assert set(sd) == {"model.metric.x", "model.metric._update_count"}
+    m2 = DummyMetric()
+    m2.persistent(True)
+    m2.load_state_dict(sd, prefix="model.metric.")
+    assert float(m2.compute()) == float(m.compute())
+    assert m2.update_count == 2
+
+
 def test_pickle_roundtrip():
     m = DummyMetric()
     m.update(jnp.asarray(2.5))

@@ -51,11 +51,14 @@ ML_TARGET = rng.randint(0, 2, (NB, BS, L))
 
 
 def _sk_ece(p, t, n_bins=15, norm="l1"):
+    # reference bucketize semantics: right-closed boundaries over linspace(0, 1, n_bins + 1),
+    # boundary values go to the upper bin, conf == 1.0 gets its own slot
     conf = np.where(p > 0.5, p, 1 - p)
     acc = ((p > 0.5).astype(int) == t).astype(float)
-    bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
+    boundaries = np.linspace(0, 1, n_bins + 1, dtype=conf.dtype)
+    bins = np.clip(np.searchsorted(boundaries, conf, side="right") - 1, 0, n_bins)
     out = []
-    for b in range(n_bins):
+    for b in range(n_bins + 1):
         m = bins == b
         if m.any():
             out.append((abs(acc[m].mean() - conf[m].mean()), m.mean()))
@@ -84,10 +87,11 @@ def test_multiclass_calibration_error():
     def ref(p, t):
         conf = p.max(-1)
         acc = (p.argmax(-1) == t).astype(float)
-        bins = np.clip((conf * 15).astype(int), 0, 14)
+        boundaries = np.linspace(0, 1, 16, dtype=conf.dtype)
+        bins = np.clip(np.searchsorted(boundaries, conf, side="right") - 1, 0, 15)
         return sum(
             abs(acc[bins == b].mean() - conf[bins == b].mean()) * (bins == b).mean()
-            for b in range(15) if (bins == b).any()
+            for b in range(16) if (bins == b).any()
         )
 
     m = MulticlassCalibrationError(num_classes=C)
@@ -100,6 +104,38 @@ def test_multiclass_calibration_error():
     )
     res = multiclass_calibration_error(MC_PREDS[0], MC_TARGET[0], num_classes=C)
     np.testing.assert_allclose(np.asarray(res), ref(MC_PREDS[0], MC_TARGET[0]), atol=1e-6)
+
+
+def test_calibration_boundary_values_upper_bin():
+    # regression: conf exactly on a bin boundary must go to the UPPER bin (bucketize right=True),
+    # and conf == 1.0 must land in its own extra slot — visible under norm="max"
+    preds = np.asarray([1.0, 0.875, 0.75], np.float32)  # confs: 1.0 (own slot), 0.875, 0.75 (boundary)
+    target = np.asarray([0, 1, 1])
+    # n_bins=4 boundaries [0, .25, .5, .75, 1]: bin3 = {0.875 (acc 1), 0.75 (acc 1)}, extra = {1.0 (acc 0)}
+    res = binary_calibration_error(preds, target, n_bins=4, norm="max")
+    # bin3 gap = |1 - 0.8125| = 0.1875; extra-slot gap = |0 - 1| = 1 -> max = 1
+    np.testing.assert_allclose(np.asarray(res), 1.0, atol=1e-6)
+    res_l1 = binary_calibration_error(preds, target, n_bins=4, norm="l1")
+    np.testing.assert_allclose(np.asarray(res_l1), (2 / 3) * 0.1875 + (1 / 3) * 1.0, atol=1e-6)
+
+
+def test_dice_samplewise_class_form():
+    # regression: mdmc_average="samplewise" must work in the class form (was NotImplementedError)
+    from torchmetrics_tpu.classification import Dice
+    from torchmetrics_tpu.functional.classification import dice as dice_fn
+
+    rng_l = np.random.RandomState(3)
+    preds = rng_l.randint(0, C, (NB, 16, 10))
+    target = rng_l.randint(0, C, (NB, 16, 10))
+    for average in ("micro", "macro"):
+        m = Dice(num_classes=C, average=average, mdmc_average="samplewise")
+        for i in range(NB):
+            m.update(preds[i], target[i])
+        ref = dice_fn(
+            preds.reshape(-1, 10), target.reshape(-1, 10),
+            average=average, mdmc_average="samplewise", num_classes=C,
+        )
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref), atol=1e-6)
 
 
 class TestBinaryHinge(MetricTester):

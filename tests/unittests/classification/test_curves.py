@@ -64,6 +64,17 @@ class TestBinaryAUROC(MetricTester):
             ref = skm.roc_auc_score(BIN_TARGET[0], BIN_PREDS[0], max_fpr=max_fpr)
             np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
 
+    def test_max_fpr_trace_safe(self):
+        # regression: the partial-AUC path must compile inside jit (binned mode)
+        import jax
+
+        fn = jax.jit(
+            lambda p, t: binary_auroc(p, t, max_fpr=0.5, thresholds=5000, validate_args=False)
+        )
+        res = fn(BIN_PREDS[0], BIN_TARGET[0])
+        ref = skm.roc_auc_score(BIN_TARGET[0], BIN_PREDS[0], max_fpr=0.5)
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-3)
+
 
 class TestBinaryAveragePrecision(MetricTester):
     def test_class_exact(self):

@@ -213,3 +213,16 @@ def test_text_metric_reset_and_sync_shapes():
     m.reset()
     m.update(["a b c"], ["a b c"])
     assert float(m.compute()) == 0.0
+
+
+def test_text_metric_update_while_synced_raises():
+    # regression (ADVICE r2): host-path text metrics must refuse update() while synced
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    m = WordErrorRate()
+    m.update(["a b c"], ["a b d"])
+    m.sync(dist_sync_fn=lambda v, g: [v, v], distributed_available=lambda: True)
+    with pytest.raises(TorchMetricsUserError, match="already been synced"):
+        m.update(["x"], ["x"])
+    m.unsync()
+    m.update(["x"], ["x"])  # fine again after unsync

@@ -1,0 +1,204 @@
+"""Wrapper metrics (reference: tests/unittests/wrappers/test_{bootstrapping,classwise,minmax,
+multioutput,multitask,running,tracker}.py)."""
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+NB, BS, C = 4, 32, 3
+rng = np.random.RandomState(11)
+PREDS = rng.rand(NB, BS).astype(np.float32)
+TARGET = rng.randint(0, 2, (NB, BS))
+MC_PREDS = rng.rand(NB, BS, C).astype(np.float32)
+MC_TARGET = rng.randint(0, C, (NB, BS))
+
+
+class TestBootStrapper:
+    def test_output_keys_and_sanity(self):
+        wrapper = BootStrapper(BinaryAccuracy(), num_bootstraps=8, quantile=0.95, raw=True, seed=0)
+        for i in range(NB):
+            wrapper.update(PREDS[i], TARGET[i])
+        out = wrapper.compute()
+        assert set(out) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (8,)
+        base = BinaryAccuracy()
+        for i in range(NB):
+            base.update(PREDS[i], TARGET[i])
+        # bootstrap mean should be near the plain estimate
+        np.testing.assert_allclose(float(out["mean"]), float(base.compute()), atol=0.1)
+
+    def test_seed_reproducible(self):
+        # regression: `seed` kwarg makes resampling deterministic
+        outs = []
+        for _ in range(2):
+            w = BootStrapper(BinaryAccuracy(), num_bootstraps=6, seed=123, raw=True)
+            for i in range(NB):
+                w.update(PREDS[i], TARGET[i])
+            outs.append(np.asarray(w.compute()["raw"]))
+        np.testing.assert_array_equal(outs[0], outs[1])
+        w2 = BootStrapper(BinaryAccuracy(), num_bootstraps=6, seed=321, raw=True)
+        for i in range(NB):
+            w2.update(PREDS[i], TARGET[i])
+        assert not np.array_equal(outs[0], np.asarray(w2.compute()["raw"]))
+
+    def test_seed_survives_reset(self):
+        w = BootStrapper(BinaryAccuracy(), num_bootstraps=6, seed=123, raw=True)
+        for i in range(NB):
+            w.update(PREDS[i], TARGET[i])
+        first = np.asarray(w.compute()["raw"])
+        w.reset()
+        for i in range(NB):
+            w.update(PREDS[i], TARGET[i])
+        np.testing.assert_array_equal(first, np.asarray(w.compute()["raw"]))
+
+    @pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+    def test_sampling_strategies(self, sampling_strategy):
+        w = BootStrapper(BinaryAccuracy(), num_bootstraps=4, sampling_strategy=sampling_strategy, seed=7)
+        w.update(PREDS[0], TARGET[0])
+        out = w.compute()
+        assert 0.0 <= float(out["mean"]) <= 1.0
+
+
+class TestClasswiseWrapper:
+    def test_labels_and_prefix(self):
+        w = ClasswiseWrapper(
+            MulticlassAccuracy(num_classes=C, average=None), labels=["a", "b", "c"]
+        )
+        w.update(MC_PREDS[0], MC_TARGET[0])
+        out = w.compute()
+        assert set(out) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+        w2 = ClasswiseWrapper(
+            MulticlassAccuracy(num_classes=C, average=None), labels=["a", "b", "c"], prefix="acc-"
+        )
+        w2.update(MC_PREDS[0], MC_TARGET[0])
+        assert set(w2.compute()) == {"acc-a", "acc-b", "acc-c"}
+
+    def test_values_match_unwrapped(self):
+        w = ClasswiseWrapper(MulticlassAccuracy(num_classes=C, average=None))
+        base = MulticlassAccuracy(num_classes=C, average=None)
+        for i in range(NB):
+            w.update(MC_PREDS[i], MC_TARGET[i])
+            base.update(MC_PREDS[i], MC_TARGET[i])
+        np.testing.assert_allclose(
+            np.asarray(list(w.compute().values()), np.float32), np.asarray(base.compute()), atol=1e-6
+        )
+
+
+class TestMinMaxMetric:
+    def test_tracks_extrema(self):
+        w = MinMaxMetric(MeanMetric())
+        vals = [2.0, 5.0, 1.0]
+        seen = []
+        for v in vals:
+            w.update(np.asarray([v], np.float32))
+            out = w.compute()
+            seen.append((float(out["raw"]), float(out["min"]), float(out["max"])))
+        # running mean: 2, 3.5, 8/3 — min/max of the *computed* values over time
+        np.testing.assert_allclose([s[0] for s in seen], [2.0, 3.5, 8 / 3], atol=1e-6)
+        assert seen[-1][1] == 2.0
+        assert seen[-1][2] == 3.5
+
+    def test_reset(self):
+        w = MinMaxMetric(MeanMetric())
+        w.update(np.asarray([4.0], np.float32))
+        w.compute()
+        w.reset()
+        assert float(w.min_val) == float("inf")
+
+
+class TestMultioutputWrapper:
+    def test_matches_per_column(self):
+        preds = rng.rand(NB, BS, 2).astype(np.float32)
+        target = rng.rand(NB, BS, 2).astype(np.float32)
+        w = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        for i in range(NB):
+            w.update(preds[i], target[i])
+        out = np.asarray(w.compute())
+        for col in range(2):
+            ref = np.mean((preds[..., col] - target[..., col]) ** 2)
+            np.testing.assert_allclose(out[col], ref, atol=1e-6)
+
+    def test_remove_nans(self):
+        preds = np.asarray([[1.0, 2.0], [np.nan, 3.0], [2.0, 4.0]], np.float32)
+        target = np.asarray([[1.0, 2.0], [5.0, 3.0], [1.0, 2.0]], np.float32)
+        w = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        w.update(preds, target)
+        out = np.asarray(w.compute())
+        np.testing.assert_allclose(out[0], np.mean((np.asarray([1.0, 2.0]) - np.asarray([1.0, 1.0])) ** 2), atol=1e-6)
+        np.testing.assert_allclose(out[1], np.mean((preds[:, 1] - target[:, 1]) ** 2), atol=1e-6)
+
+
+class TestMultitaskWrapper:
+    def test_dict_in_dict_out(self):
+        w = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        preds = {"cls": PREDS[0], "reg": PREDS[0]}
+        target = {"cls": TARGET[0], "reg": PREDS[0] * 0.9}
+        w.update(preds, target)
+        out = w.compute()
+        assert set(out) == {"cls", "reg"}
+        ref_acc = np.mean((PREDS[0] > 0.5).astype(int) == TARGET[0])
+        np.testing.assert_allclose(float(out["cls"]), ref_acc, atol=1e-6)
+
+
+class TestRunning:
+    def test_window_semantics(self):
+        w = Running(SumMetric(), window=3)
+        for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+            w.update(np.asarray(v, np.float32))
+        # window of 3 most recent: 3+4+5
+        np.testing.assert_allclose(float(w.compute()), 12.0, atol=1e-6)
+
+    def test_empty_window_warns_not_silent(self):
+        # regression: compute() on a never-updated Running must warn like any fresh metric
+        w = Running(SumMetric(), window=2)
+        with pytest.warns(UserWarning, match="before the .*update"):
+            w.compute()
+
+    def test_mean_window(self):
+        w = Running(MeanMetric(), window=2)
+        for v in [1.0, 5.0, 9.0]:
+            w.update(np.asarray(v, np.float32))
+        np.testing.assert_allclose(float(w.compute()), 7.0, atol=1e-6)
+
+
+class TestMetricTracker:
+    def test_best_metric_and_steps(self):
+        tracker = MetricTracker(BinaryAccuracy(), maximize=True)
+        for epoch in range(3):
+            tracker.increment()
+            for i in range(NB):
+                # degrade predictions in later epochs
+                noise = rng.rand(BS).astype(np.float32) * epoch
+                tracker.update((PREDS[i] + noise) % 1.0, TARGET[i])
+        allv = np.asarray(tracker.compute_all())
+        assert allv.shape == (3,)
+        best, step = tracker.best_metric(return_step=True)
+        assert step == int(np.argmax(allv))
+        np.testing.assert_allclose(best, float(np.max(allv)), atol=1e-6)
+
+    def test_collection_tracking(self):
+        col = MetricCollection([MulticlassAccuracy(num_classes=C), MulticlassPrecision(num_classes=C)])
+        tracker = MetricTracker(col, maximize=[True, True])
+        for _ in range(2):
+            tracker.increment()
+            tracker.update(MC_PREDS[0], MC_TARGET[0])
+        res = tracker.compute_all()
+        assert set(res) == {"MulticlassAccuracy", "MulticlassPrecision"}
+        assert res["MulticlassAccuracy"].shape == (2,)
+
+    def test_update_before_increment_raises(self):
+        tracker = MetricTracker(BinaryAccuracy())
+        with pytest.raises(ValueError, match="increment"):
+            tracker.update(PREDS[0], TARGET[0])

@@ -1,8 +1,9 @@
 """Stateful calibration-error metrics (reference
 ``src/torchmetrics/classification/calibration_error.py:41,188,342``).
 
-TPU-native state: three ``(n_bins,)`` sum tensors instead of the reference's unbounded
-confidence/accuracy lists (binning against the fixed grid commutes with accumulation)."""
+TPU-native state: three ``(n_bins + 1,)`` sum tensors instead of the reference's unbounded
+confidence/accuracy lists (binning against the fixed grid commutes with accumulation; the
+extra slot holds ``conf == 1.0`` exactly, matching the reference's bucketize indexing)."""
 from __future__ import annotations
 
 from typing import Any, Optional
@@ -32,9 +33,11 @@ class _CalibrationErrorBase(Metric):
     plot_upper_bound = 1.0
 
     def _init_state(self, n_bins: int) -> None:
-        self.add_state("count", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("conf_sum", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("acc_sum", jnp.zeros((n_bins,), jnp.float32), dist_reduce_fx="sum")
+        # n_bins + 1 slots: the extra slot holds conf == 1.0 exactly, matching the reference's
+        # bucketize(right=True) - 1 indexing over linspace(0, 1, n_bins + 1) boundaries.
+        self.add_state("count", jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("conf_sum", jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_sum", jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
 
     def _accumulate(self, state, confidences, accuracies, weight):
         count, conf_sum, acc_sum = _binning_bucketize(confidences, accuracies, weight, self.n_bins)

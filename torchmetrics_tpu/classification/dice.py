@@ -44,11 +44,6 @@ class Dice(Metric):
             raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
         if ignore_index is not None and num_classes is not None and not 0 <= ignore_index < num_classes:
             raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
-        if mdmc_average == "samplewise" and average != "samples":
-            raise NotImplementedError(
-                "mdmc_average='samplewise' is only supported via the functional `dice` API"
-                " (per-sample counts need unbounded cat state in the class form)"
-            )
         self.zero_division = zero_division
         self.num_classes = num_classes
         self.threshold = threshold
@@ -56,7 +51,11 @@ class Dice(Metric):
         self.mdmc_average = mdmc_average
         self.ignore_index = ignore_index
         self.top_k = top_k
-        if average == "samples":
+        # Per-sample counts need unbounded cat state: both `average="samples"` and
+        # `mdmc_average="samplewise"` reduce within each sample before averaging over samples
+        # (reference dice.py:31 mdmc semantics).
+        self._samplewise_state = average == "samples" or mdmc_average == "samplewise"
+        if self._samplewise_state:
             self.add_state("tp", [], dist_reduce_fx="cat")
             self.add_state("fp", [], dist_reduce_fx="cat")
             self.add_state("fn", [], dist_reduce_fx="cat")
@@ -81,7 +80,7 @@ class Dice(Metric):
                 raise ValueError(
                     f"`preds` has {n_cls} classes but metric was built with num_classes={self.num_classes}"
                 )
-            if self.num_classes is None and self.average != "samples" and n_cls != self._reduced_size():
+            if self.num_classes is None and not self._samplewise_state and n_cls != self._reduced_size():
                 raise ValueError(
                     f"Pass `num_classes={n_cls}` at construction for probabilistic multiclass `preds`"
                     " (state shape must be known up front on TPU)."
@@ -92,11 +91,15 @@ class Dice(Metric):
             n_cls = self.num_classes or 2
         tp, fp, fn = _dice_update(
             preds, target, n_cls, self.threshold, self.top_k, self.ignore_index,
-            samplewise=self.average == "samples" or self.mdmc_average == "samplewise",
+            samplewise=self._samplewise_state,
         )
-        if self.average == "samples":
+        if self._samplewise_state:
             return {"tp": tp, "fp": fp, "fn": fn}
         return {"tp": state["tp"] + tp, "fp": state["fp"] + fp, "fn": state["fn"] + fn}
 
     def _compute(self, state):
+        if self.mdmc_average == "samplewise" and self.average != "samples":
+            # per-sample reduction first, then mean over samples (reference mdmc semantics)
+            score = _dice_from_counts(state["tp"], state["fp"], state["fn"], self.average, self.zero_division)
+            return jnp.mean(score, axis=0)
         return _dice_from_counts(state["tp"], state["fp"], state["fn"], self.average, self.zero_division)

@@ -80,20 +80,29 @@ def _binary_auroc_compute(
     max_fpr: Optional[float] = None,
 ) -> Array:
     fpr, tpr, _ = _binary_roc_compute(state, thresholds)
-    if max_fpr is None or max_fpr == 1 or float(jnp.sum(fpr)) == 0 or float(jnp.sum(tpr)) == 0:
-        return _auc_compute_without_check(fpr, tpr, 1.0)
-    # partial AUC over [0, max_fpr] with McClish correction (reference auroc.py:89-107)
-    fpr_np = np.asarray(fpr, np.float64)
-    tpr_np = np.asarray(tpr, np.float64)
-    stop = int(np.searchsorted(fpr_np, max_fpr, side="right"))
-    stop = min(max(stop, 1), fpr_np.shape[0] - 1)  # curve may never reach max_fpr (binned grids)
-    weight = (max_fpr - fpr_np[stop - 1]) / max(fpr_np[stop] - fpr_np[stop - 1], 1e-38)
-    interp_tpr = tpr_np[stop - 1] + weight * (tpr_np[stop] - tpr_np[stop - 1])
-    tpr_np = np.hstack([tpr_np[:stop], interp_tpr])
-    fpr_np = np.hstack([fpr_np[:stop], max_fpr])
-    partial_auc = float(np.trapezoid(tpr_np, fpr_np)) if hasattr(np, "trapezoid") else float(np.trapz(tpr_np, fpr_np))
+    full_auc = _auc_compute_without_check(fpr, tpr, 1.0)
+    if max_fpr is None or max_fpr == 1:
+        return full_auc
+    # Trace-safe partial AUC over [0, max_fpr] with McClish correction (reference auroc.py:89-107).
+    # `max_fpr` is a static constructor arg; everything data-dependent stays on device so the
+    # whole compute can live inside jit (unlike the reference's host numpy path).
+    fpr = jnp.asarray(fpr, jnp.float32)
+    tpr = jnp.asarray(tpr, jnp.float32)
+    n = fpr.shape[0]
+    stop = jnp.clip(jnp.searchsorted(fpr, max_fpr, side="right"), 1, n - 1)
+    f_lo = jnp.take(fpr, stop - 1)
+    f_hi = jnp.take(fpr, stop)
+    t_lo = jnp.take(tpr, stop - 1)
+    t_hi = jnp.take(tpr, stop)
+    weight = (max_fpr - f_lo) / jnp.maximum(f_hi - f_lo, 1e-38)
+    interp_tpr = t_lo + weight * (t_hi - t_lo)
+    seg_areas = 0.5 * (tpr[1:] + tpr[:-1]) * (fpr[1:] - fpr[:-1])
+    seg_mask = jnp.arange(n - 1) < (stop - 1)
+    partial_auc = jnp.sum(jnp.where(seg_mask, seg_areas, 0.0)) + 0.5 * (t_lo + interp_tpr) * (max_fpr - f_lo)
     min_area = 0.5 * max_fpr**2
-    return jnp.asarray(0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area)), jnp.float32)
+    mcclish = 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
+    degenerate = (jnp.sum(fpr) == 0) | (jnp.sum(tpr) == 0)
+    return jnp.where(degenerate, full_auc, mcclish).astype(jnp.float32)
 
 
 def binary_auroc(

@@ -3,8 +3,9 @@
 
 TPU-first state redesign: the reference keeps raw confidence/accuracy lists and bins at compute;
 binning against a FIXED uniform grid commutes with accumulation, so here the state is three
-``(n_bins,)`` sum tensors (count / confidence-sum / accuracy-sum) — O(n_bins) memory, exact same
-result, single psum to sync.
+``(n_bins + 1,)`` sum tensors (count / confidence-sum / accuracy-sum; the extra slot holds
+``conf == 1.0`` exactly, matching the reference's bucketize indexing) — O(n_bins) memory, exact
+same result, single psum to sync.
 """
 from __future__ import annotations
 
@@ -22,11 +23,18 @@ from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_nee
 def _binning_bucketize(
     confidences: Array, accuracies: Array, weight: Array, n_bins: int
 ) -> Tuple[Array, Array, Array]:
-    """Per-bin (count, conf_sum, acc_sum) against a uniform [0, 1] grid."""
-    idx = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
-    count = bincount_weighted(idx, n_bins, weights=weight, dtype=jnp.float32)
-    conf_sum = bincount_weighted(idx, n_bins, weights=confidences * weight, dtype=jnp.float32)
-    acc_sum = bincount_weighted(idx, n_bins, weights=accuracies * weight, dtype=jnp.float32)
+    """Per-bin (count, conf_sum, acc_sum) against a uniform [0, 1] grid.
+
+    Matches the reference's ``torch.bucketize(conf, linspace(0, 1, n_bins + 1), right=True) - 1``
+    (reference ``calibration_error.py:48``): a value exactly on a boundary goes to the UPPER bin,
+    and ``conf == 1.0`` lands in its own extra slot — hence ``n_bins + 1`` state slots. A naive
+    ``(conf * n_bins).astype(int)`` truncation mis-bins boundary values under float32 rounding.
+    """
+    boundaries = jnp.linspace(0.0, 1.0, n_bins + 1, dtype=confidences.dtype)
+    idx = jnp.clip(jnp.searchsorted(boundaries, confidences, side="right") - 1, 0, n_bins)
+    count = bincount_weighted(idx, n_bins + 1, weights=weight, dtype=jnp.float32)
+    conf_sum = bincount_weighted(idx, n_bins + 1, weights=confidences * weight, dtype=jnp.float32)
+    acc_sum = bincount_weighted(idx, n_bins + 1, weights=accuracies * weight, dtype=jnp.float32)
     return count, conf_sum, acc_sum
 
 

@@ -621,15 +621,23 @@ class Metric:
             else:
                 entries = self._state.lists[name]
                 destination[prefix + name] = [e if keep_vars else np.asarray(e) for e in entries]
-        # the reference persists update_count as extra state (metric.py:845-850) so restored
-        # metrics keep correct mean-reduce weighting and no-update warnings
+        # Deliberate extension beyond the reference checkpoint format: the count lets restored
+        # metrics keep correct mean-reduce weighting and no-update warnings. Reference-style
+        # strict loaders will see it as an unexpected key; drop it on export if needed.
         if any(self._persistent.values()):
             destination[prefix + "_update_count"] = self._update_count
         return destination
 
-    def load_state_dict(self, state_dict: dict, strict: bool = True) -> None:
-        """Restore states from a checkpoint dict (reference ``metric.py:863``)."""
-        restored_count = state_dict.get("_update_count")
+    def load_state_dict(self, state_dict: dict, strict: bool = True, prefix: str = "") -> None:
+        """Restore states from a checkpoint dict (reference ``metric.py:863``).
+
+        ``prefix`` mirrors the prefix passed to :meth:`state_dict`, so prefixed checkpoints
+        round-trip the update count as well as the states.
+        """
+        restored_count = state_dict.get(prefix + "_update_count")
+        if restored_count is None and prefix:
+            restored_count = state_dict.get("_update_count")
+        state_dict = {k[len(prefix):] if prefix and k.startswith(prefix) else k: v for k, v in state_dict.items()}
         loaded_any = False
         for name, persistent in self._persistent.items():
             if name in state_dict:

@@ -35,6 +35,7 @@ from torchmetrics_tpu.functional.text.wer import (
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
 
 class _HostTextMetric(Metric):
@@ -45,6 +46,10 @@ class _HostTextMetric(Metric):
     full_state_update = True
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # strings bypass _coerce/jit entirely
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: call unsync() before calling update()."
+            )
         self._host_update(*args, **kwargs)
         self._update_count += 1
         self._update_called = True

@@ -35,6 +35,7 @@ class BootStrapper(WrapperMetric):
         quantile: Optional[Union[float, Sequence[float]]] = None,
         raw: bool = False,
         sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -55,7 +56,10 @@ class BootStrapper(WrapperMetric):
                 f" but received {sampling_strategy}"
             )
         self.sampling_strategy = sampling_strategy
-        self._rng = np.random.RandomState()
+        # `seed` is an extension beyond the reference API: resampling happens on host, so a
+        # seeded RandomState (rather than jax.random) gives reproducible bootstraps cheaply.
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample inputs per bootstrap copy, then update each copy (reference ``bootstrapping.py:124``)."""
@@ -100,4 +104,6 @@ class BootStrapper(WrapperMetric):
     def reset(self) -> None:
         for m in self.metrics:
             m.reset()
+        if self.seed is not None:
+            self._rng = np.random.RandomState(self.seed)  # reset() restarts the reproducible stream
         super().reset()

@@ -77,7 +77,9 @@ class Running(WrapperMetric):
                     slot[key] = list(self._state.lists[name])
             self.base_metric._update_count = i + 1
             self.base_metric._reduce_states(dict(self.base_metric._state.tensors), slot)
-        self.base_metric._update_called = True  # states were merged in, not update()-ed
+        if self._num_vals_seen > 0:
+            self.base_metric._update_called = True  # states were merged in, not update()-ed
+        # an empty window keeps _update_called False so compute() warns like any fresh metric
         val = self.base_metric.compute()
         self.base_metric.reset()
         return val

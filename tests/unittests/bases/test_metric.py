@@ -150,6 +150,16 @@ def test_state_dict_prefix_roundtrip():
     m2.load_state_dict(sd, prefix="model.metric.")
     assert float(m2.compute()) == float(m.compute())
     assert m2.update_count == 2
+    # a shared destination dict holding another metric's unprefixed state must not leak in
+    other = DummyMetric()
+    other.persistent(True)
+    other.update(jnp.asarray(100.0))
+    shared = other.state_dict()
+    m.state_dict(shared, prefix="m2.")
+    m3 = DummyMetric()
+    m3.persistent(True)
+    m3.load_state_dict(shared, prefix="m2.")
+    assert float(m3.compute()) == float(m.compute())
 
 
 def test_pickle_roundtrip():

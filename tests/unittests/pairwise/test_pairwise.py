@@ -1,0 +1,83 @@
+"""Pairwise kernels vs sklearn/scipy (reference: tests/unittests/pairwise/test_pairwise_distance.py)."""
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist, minkowski
+from sklearn.metrics.pairwise import (
+    cosine_similarity,
+    euclidean_distances,
+    linear_kernel,
+    manhattan_distances,
+)
+
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+rng = np.random.RandomState(21)
+X = rng.randn(24, 17).astype(np.float32)
+Y = rng.randn(15, 17).astype(np.float32)
+
+CASES = [
+    (pairwise_cosine_similarity, cosine_similarity, {}),
+    (pairwise_euclidean_distance, euclidean_distances, {}),
+    (pairwise_linear_similarity, linear_kernel, {}),
+    (pairwise_manhattan_distance, manhattan_distances, {}),
+    (pairwise_minkowski_distance, lambda a, b: cdist(a, b, "minkowski", p=3), {"exponent": 3}),
+]
+
+
+@pytest.mark.parametrize("fn,ref,kwargs", CASES, ids=["cosine", "euclidean", "linear", "manhattan", "minkowski"])
+def test_two_input_matches_reference(fn, ref, kwargs):
+    res = np.asarray(fn(X, Y, **kwargs))
+    np.testing.assert_allclose(res, ref(X, Y), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("fn,ref,kwargs", CASES, ids=["cosine", "euclidean", "linear", "manhattan", "minkowski"])
+def test_single_input_zeroes_diagonal(fn, ref, kwargs):
+    res = np.asarray(fn(X, **kwargs))
+    expected = ref(X, X)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("reduction,npfn", [("mean", np.mean), ("sum", np.sum)])
+def test_reductions(reduction, npfn):
+    res = np.asarray(pairwise_euclidean_distance(X, Y, reduction=reduction))
+    np.testing.assert_allclose(res, npfn(euclidean_distances(X, Y), axis=-1), rtol=1e-4, atol=1e-4)
+
+
+def test_jit_compatible():
+    import jax
+
+    fn = jax.jit(lambda a, b: pairwise_euclidean_distance(a, b))
+    np.testing.assert_allclose(np.asarray(fn(X, Y)), euclidean_distances(X, Y), rtol=1e-4, atol=1e-4)
+    fn2 = jax.jit(lambda a: pairwise_cosine_similarity(a))
+    expected = cosine_similarity(X, X)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(np.asarray(fn2(X)), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_input_validation():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        pairwise_euclidean_distance(X[0])
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        pairwise_euclidean_distance(X, Y[:, :5])
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    with pytest.raises(TorchMetricsUserError, match="must be a float or int"):
+        pairwise_minkowski_distance(X, Y, exponent=0.5)
+    with pytest.raises(ValueError, match="Expected reduction"):
+        pairwise_euclidean_distance(X, Y, reduction="bogus")
+
+
+def test_zero_diagonal_override():
+    # explicit zero_diagonal=True with two inputs zeroes the leading square block's diagonal
+    res = np.asarray(pairwise_linear_similarity(X[:10], Y[:10], zero_diagonal=True))
+    assert np.all(np.diag(res) == 0)
+    # explicit False with one input keeps the self-similarity diagonal
+    res2 = np.asarray(pairwise_cosine_similarity(X, zero_diagonal=False))
+    np.testing.assert_allclose(np.diag(res2), 1.0, atol=1e-6)

@@ -1,0 +1,144 @@
+"""Pairwise distance/similarity matrices (reference
+``src/torchmetrics/functional/pairwise/{cosine,euclidean,linear,manhattan,minkowski}.py``).
+
+TPU-first design: every kernel is a single jittable expression dominated by one ``[N, d] x [d, M]``
+matmul (MXU) where the math allows it. The reference upcasts to float64 for euclidean/minkowski;
+TPU f64 is emulated and slow, so euclidean uses the Gram expansion ``max(x² + y² - 2xy, 0)`` in
+f32 (negative residuals from cancellation are clamped; documented tolerance ~1e-6 relative) and
+minkowski broadcasts in f32.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.pairwise.helpers import (
+    _check_input,
+    _reduce_distance_matrix,
+    _zero_diagonal,
+)
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+def _matmul_f32(x: Array, y: Array) -> Array:
+    # TPU matmuls default to bf16 operands (~1e-3 relative error) — metrics need full f32:
+    # "highest" keeps the MXU but runs the 6-pass f32 decomposition
+    return jnp.matmul(x, y, precision="highest")
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Row-normalise then one MXU matmul (reference ``cosine.py:25``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = _matmul_f32(x, y.T)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity ``<x,y> / (||x||·||y||)`` (reference ``cosine.py:48``)."""
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Gram-expansion euclidean: ``sqrt(max(x² + y² - 2·x@yᵀ, 0))`` (reference ``euclidean.py:23``).
+
+    The reference upcasts to f64; on TPU we stay f32 (one MXU matmul) and clamp the tiny negative
+    residuals the expansion can produce for near-identical rows.
+    """
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    distance = jnp.maximum(x_norm + y_norm - 2 * _matmul_f32(x, y.T), 0.0)
+    distance = _zero_diagonal(distance, zero_diagonal)
+    return jnp.sqrt(distance)
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise euclidean distance matrix (reference ``euclidean.py:47``)."""
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Plain inner-product matrix — one MXU matmul (reference ``linear.py:23``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _matmul_f32(x, y.T)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise linear (dot-product) similarity (reference ``linear.py:42``)."""
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcast |xᵢ - yⱼ| sum (reference ``manhattan.py:22``); no matmul form exists for L1."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise manhattan (L1) distance (reference ``manhattan.py:41``)."""
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_minkowski_distance_update(
+    x: Array, y: Optional[Array] = None, exponent: float = 2, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcast |xᵢ - yⱼ|^p sum ^(1/p) (reference ``minkowski.py:25``), f32 on TPU."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {exponent}")
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent, axis=-1) ** (1.0 / exponent)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: float = 2,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise minkowski (Lᵖ) distance (reference ``minkowski.py:49``)."""
+    distance = _pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,52 @@
+"""Shared input handling for the pairwise distance kernels (reference
+``src/torchmetrics/functional/pairwise/helpers.py:19-60``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    """Validate shapes and resolve the ``zero_diagonal`` default (reference ``helpers.py:19``).
+
+    ``x``: ``[N, d]``; ``y``: ``[M, d]`` or ``None`` (self-comparison, diagonal zeroed by
+    default).
+    """
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _zero_diagonal(distance: Array, zero_diagonal: bool) -> Array:
+    """Functional replacement for the reference's in-place ``fill_diagonal_(0)``."""
+    if not zero_diagonal:
+        return distance
+    on_diag = jnp.arange(distance.shape[0])[:, None] == jnp.arange(distance.shape[1])[None, :]
+    return jnp.where(on_diag, 0, distance)
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """mean/sum/none over the last axis (reference ``helpers.py:46-60``)."""
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")

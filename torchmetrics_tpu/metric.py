@@ -635,9 +635,10 @@ class Metric:
         round-trip the update count as well as the states.
         """
         restored_count = state_dict.get(prefix + "_update_count")
-        if restored_count is None and prefix:
-            restored_count = state_dict.get("_update_count")
-        state_dict = {k[len(prefix):] if prefix and k.startswith(prefix) else k: v for k, v in state_dict.items()}
+        if prefix:
+            # only keys under this prefix belong to this metric — a shared destination dict may
+            # also hold other metrics' (possibly unprefixed) states
+            state_dict = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
         loaded_any = False
         for name, persistent in self._persistent.items():
             if name in state_dict:

@@ -1,0 +1,285 @@
+"""Independent numpy/scipy reference implementations for image-metric parity tests.
+
+Written from the metric definitions (papers / scipy semantics), NOT ported from the reference
+package — they serve as the external oracle the reference's own tests get from
+skimage/sewar (unavailable in this environment).
+"""
+from __future__ import annotations
+
+import numpy as np
+from scipy.ndimage import uniform_filter
+from scipy.signal import convolve2d
+
+
+def gaussian_kernel_np(kernel_size, sigma):
+    def g1(k, s):
+        d = np.arange((1 - k) / 2, (1 + k) / 2, 1.0)
+        w = np.exp(-((d / s) ** 2) / 2)
+        return w / w.sum()
+
+    return np.outer(g1(kernel_size[0], sigma[0]), g1(kernel_size[1], sigma[1]))
+
+
+def _filter_valid(img, kernel):
+    """'valid' correlation of each (N, C) plane with a 2D kernel."""
+    n, c, _, _ = img.shape
+    kh, kw = kernel.shape
+    out = np.empty((n, c, img.shape[2] - kh + 1, img.shape[3] - kw + 1))
+    for i in range(n):
+        for j in range(c):
+            out[i, j] = convolve2d(img[i, j], kernel[::-1, ::-1], mode="valid")
+    return out
+
+
+def ssim_np(preds, target, data_range=None, sigma=1.5, k1=0.01, k2=0.03):
+    """SSIM per image: gaussian window, reflect padding, support of radius int(3.5*sigma+0.5)."""
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    ks = int(3.5 * sigma + 0.5) * 2 + 1
+    pad = (ks - 1) // 2
+    kernel = gaussian_kernel_np((ks, ks), (sigma, sigma))
+
+    def rpad(x):
+        return np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+
+    p, t = rpad(preds), rpad(target)
+    mu_p = _filter_valid(p, kernel)
+    mu_t = _filter_valid(t, kernel)
+    s_pp = _filter_valid(p * p, kernel) - mu_p**2
+    s_tt = _filter_valid(t * t, kernel) - mu_t**2
+    s_pt = _filter_valid(p * t, kernel) - mu_p * mu_t
+    num = (2 * mu_p * mu_t + c1) * (2 * s_pt + c2)
+    den = (mu_p**2 + mu_t**2 + c1) * (s_pp + s_tt + c2)
+    full = num / den
+    cropped = full[..., pad:-pad, pad:-pad]
+    return cropped.reshape(cropped.shape[0], -1).mean(-1)
+
+
+def ssim_cs_np(preds, target, data_range, sigma=1.5, k2=0.03):
+    """Contrast-sensitivity term of SSIM per image (same windowing as ssim_np)."""
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    c2 = (k2 * data_range) ** 2
+    ks = int(3.5 * sigma + 0.5) * 2 + 1
+    pad = (ks - 1) // 2
+    kernel = gaussian_kernel_np((ks, ks), (sigma, sigma))
+
+    def rpad(x):
+        return np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+
+    p, t = rpad(preds), rpad(target)
+    mu_p = _filter_valid(p, kernel)
+    mu_t = _filter_valid(t, kernel)
+    s_pp = _filter_valid(p * p, kernel) - mu_p**2
+    s_tt = _filter_valid(t * t, kernel) - mu_t**2
+    s_pt = _filter_valid(p * t, kernel) - mu_p * mu_t
+    cs = (2 * s_pt + c2) / (s_pp + s_tt + c2)
+    cs = cs[..., pad:-pad, pad:-pad]
+    return cs.reshape(cs.shape[0], -1).mean(-1)
+
+
+def avg_pool2_np(x):
+    n, c, h, w = x.shape
+    x = x[:, :, : h // 2 * 2, : w // 2 * 2]
+    return x.reshape(n, c, h // 2, 2, w // 2, 2).mean(axis=(3, 5))
+
+
+def ms_ssim_np(preds, target, data_range, betas=(0.0448, 0.2856, 0.3001, 0.2363, 0.1333), normalize="relu"):
+    """Per-image MS-SSIM: product over scales of cs^beta, last scale uses full ssim."""
+    vals = []
+    sim = None
+    for i in range(len(betas)):
+        sim = ssim_np(preds, target, data_range)
+        cs = ssim_cs_np(preds, target, data_range)
+        if normalize == "relu":
+            sim, cs = np.maximum(sim, 0), np.maximum(cs, 0)
+        vals.append(cs)
+        if i != len(betas) - 1:
+            preds, target = avg_pool2_np(preds), avg_pool2_np(target)
+    vals[-1] = sim
+    stack = np.stack(vals)
+    if normalize == "simple":
+        stack = (stack + 1) / 2
+    return np.prod(stack ** np.asarray(betas)[:, None], axis=0)
+
+
+def psnr_np(preds, target, data_range=None, base=10.0):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    if data_range is None:
+        data_range = target.max() - target.min()
+    mse = np.mean((preds - target) ** 2)
+    return (2 * np.log(data_range) - np.log(mse)) * (10 / np.log(base))
+
+
+def psnrb_np(preds, target, block_size=8):
+    """PSNR-B: PSNR with the additive blocking-effect factor on the MSE."""
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    _, _, height, width = preds.shape
+    h_b = np.arange(block_size - 1, width - 1, block_size)
+    h_bc = np.setdiff1d(np.arange(width - 1), h_b)
+    v_b = np.arange(block_size - 1, height - 1, block_size)
+    v_bc = np.setdiff1d(np.arange(height - 1), v_b)
+    d_b = ((preds[:, :, :, h_b] - preds[:, :, :, h_b + 1]) ** 2).sum()
+    d_bc = ((preds[:, :, :, h_bc] - preds[:, :, :, h_bc + 1]) ** 2).sum()
+    d_b += ((preds[:, :, v_b, :] - preds[:, :, v_b + 1, :]) ** 2).sum()
+    d_bc += ((preds[:, :, v_bc, :] - preds[:, :, v_bc + 1, :]) ** 2).sum()
+    n_hb = height * (width / block_size) - 1
+    n_vb = width * (height / block_size) - 1
+    n_hbc = height * (width - 1) - n_hb
+    n_vbc = width * (height - 1) - n_vb
+    d_b /= n_hb + n_vb
+    d_bc /= n_hbc + n_vbc
+    t = np.log2(block_size) / np.log2(min(height, width)) if d_b > d_bc else 0
+    bef = t * (d_b - d_bc)
+    mse = np.mean((preds - target) ** 2) + bef
+    data_range = target.max() - target.min()
+    if data_range > 2:
+        return 10 * np.log10(data_range**2 / mse)
+    return 10 * np.log10(1.0 / mse)
+
+
+def uqi_np(preds, target, kernel_size=(11, 11), sigma=(1.5, 1.5)):
+    """Mean UQI over the cropped per-pixel map (gaussian-window formulation)."""
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    kernel = gaussian_kernel_np(kernel_size, sigma)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    def rpad(x):
+        return np.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+    p, t = rpad(preds), rpad(target)
+    mu_p = _filter_valid(p, kernel)
+    mu_t = _filter_valid(t, kernel)
+    s_pp = _filter_valid(p * p, kernel) - mu_p**2
+    s_tt = _filter_valid(t * t, kernel) - mu_t**2
+    s_pt = _filter_valid(p * t, kernel) - mu_p * mu_t
+    eps = np.finfo(np.float32).eps
+    m = (2 * mu_p * mu_t) * (2 * s_pt) / ((mu_p**2 + mu_t**2) * (s_pp + s_tt) + eps)
+    return m[..., pad_h:-pad_h, pad_w:-pad_w]
+
+
+def sam_np(preds, target):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    dot = (preds * target).sum(1)
+    norm = np.linalg.norm(preds, axis=1) * np.linalg.norm(target, axis=1)
+    return np.arccos(np.clip(dot / norm, -1, 1))
+
+
+def ergas_np(preds, target, ratio=4):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    b, c, h, w = preds.shape
+    p = preds.reshape(b, c, -1)
+    t = target.reshape(b, c, -1)
+    rmse = np.sqrt(((p - t) ** 2).sum(2) / (h * w))
+    mean_t = t.mean(2)
+    return 100 * ratio * np.sqrt(((rmse / mean_t) ** 2).sum(1) / c)
+
+
+def rmse_map_np(preds, target, window_size):
+    """sqrt of scipy uniform-filtered squared error, per image/channel."""
+    err = ((target - preds) ** 2).astype(np.float64)
+    out = np.empty_like(err)
+    for i in range(err.shape[0]):
+        for j in range(err.shape[1]):
+            out[i, j] = uniform_filter(err[i, j], size=window_size, mode="reflect")
+    return np.sqrt(out)
+
+
+def rmse_sw_np(preds, target, window_size=8):
+    m = rmse_map_np(preds, target, window_size)
+    crop = round(window_size / 2)
+    return m[:, :, crop:-crop, crop:-crop].sum(0).mean() / preds.shape[0]
+
+
+def rase_np(preds, target, window_size=8):
+    """RASE with the reference's extra window_size**2 normalisation of the target mean."""
+    rmse_map = rmse_map_np(preds, target, window_size).sum(0) / preds.shape[0]
+    tm = np.empty_like(target, dtype=np.float64)
+    for i in range(target.shape[0]):
+        for j in range(target.shape[1]):
+            tm[i, j] = uniform_filter(target[i, j].astype(np.float64), size=window_size, mode="reflect")
+    target_mean = (tm / window_size**2).sum(0).mean(0) / target.shape[0]
+    rase_map = 100 / target_mean * np.sqrt((rmse_map**2).mean(0))
+    crop = round(window_size / 2)
+    return rase_map[crop:-crop, crop:-crop].mean()
+
+
+def d_lambda_np(preds, target, p=1):
+    length = preds.shape[1]
+    m1 = np.zeros((length, length))
+    m2 = np.zeros((length, length))
+    for k in range(length):
+        for r in range(k + 1, length):
+            m1[k, r] = uqi_np(target[:, k : k + 1], target[:, r : r + 1]).mean()
+            m2[k, r] = uqi_np(preds[:, k : k + 1], preds[:, r : r + 1]).mean()
+    m1 = m1 + m1.T
+    m2 = m2 + m2.T
+    diff = np.abs(m1 - m2) ** p
+    if length == 1:
+        return diff[0, 0] ** (1 / p)
+    return (diff.sum() / (length * (length - 1))) ** (1 / p)
+
+
+def tv_np(img):
+    d1 = np.abs(img[..., 1:, :] - img[..., :-1, :]).sum(axis=(1, 2, 3))
+    d2 = np.abs(img[..., :, 1:] - img[..., :, :-1]).sum(axis=(1, 2, 3))
+    return d1 + d2
+
+
+def vif_np(preds, target, sigma_n_sq=2.0):
+    """Pixel-domain VIF over 4 scales, per (channel, image), then mean."""
+
+    def filt(win, s):
+        co = np.arange(win) - (win - 1) / 2
+        g = co**2
+        g = np.exp(-(g[None, :] + g[:, None]) / (2 * s**2))
+        return g / g.sum()
+
+    def conv_valid(x, k):
+        return convolve2d(x, k[::-1, ::-1], mode="valid")
+
+    eps = 1e-10
+    ratios = []
+    for ch in range(preds.shape[1]):
+        for i in range(preds.shape[0]):
+            p = preds[i, ch].astype(np.float64)
+            t = target[i, ch].astype(np.float64)
+            num = den = 0.0
+            for scale in range(4):
+                n = int(2 ** (4 - scale) + 1)
+                k = filt(n, n / 5)
+                if scale > 0:
+                    p = conv_valid(p, k)[::2, ::2]
+                    t = conv_valid(t, k)[::2, ::2]
+                mu_p, mu_t = conv_valid(p, k), conv_valid(t, k)
+                s_tt = np.clip(conv_valid(t * t, k) - mu_t**2, 0, None)
+                s_pp = np.clip(conv_valid(p * p, k) - mu_p**2, 0, None)
+                s_tp = conv_valid(t * p, k) - mu_t * mu_p
+                g = s_tp / (s_tt + eps)
+                sv = s_pp - g * s_tp
+                mask = s_tt < eps
+                g[mask] = 0
+                sv[mask] = s_pp[mask]
+                s_tt_m = s_tt.copy()
+                s_tt_m[mask] = 0
+                mask = s_pp < eps
+                g[mask] = 0
+                sv[mask] = 0
+                mask = g < 0
+                sv[mask] = s_pp[mask]
+                g[mask] = 0
+                sv = np.clip(sv, eps, None)
+                num += np.log10(1 + g**2 * s_tt_m / (sv + sigma_n_sq)).sum()
+                den += np.log10(1 + s_tt_m / sigma_n_sq).sum()
+            ratios.append(num / den)
+    return np.mean(ratios)

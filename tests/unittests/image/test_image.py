@@ -1,0 +1,472 @@
+"""Image-domain parity tests vs independent numpy/scipy oracles (see ``oracles.py``).
+
+Reference test strategy analog: ``tests/unittests/image/`` compares against skimage/sewar;
+those oracles are reimplemented here from the metric definitions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.unittests.helpers.testers import MetricTester
+from tests.unittests.image import oracles as O
+from torchmetrics_tpu.functional.image import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+from torchmetrics_tpu.image import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+
+RNG = np.random.RandomState(7)
+NB, B = 4, 4  # batches x batch-size
+
+
+def _imgs(c=3, h=32, w=32, nb=NB, scale=1.0):
+    preds = RNG.rand(nb, B, c, h, w).astype(np.float32) * scale
+    target = RNG.rand(nb, B, c, h, w).astype(np.float32) * scale
+    return preds, target
+
+
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _imgs()
+        for i in range(2):
+            res = structural_similarity_index_measure(
+                jnp.asarray(preds[i]), jnp.asarray(target[i]), data_range=1.0
+            )
+            np.testing.assert_allclose(res, O.ssim_np(preds[i], target[i], data_range=1.0).mean(), atol=self.atol)
+
+    def test_dynamic_data_range(self):
+        preds, target = _imgs(scale=3.0)
+        res = structural_similarity_index_measure(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        np.testing.assert_allclose(res, O.ssim_np(preds[0], target[0]).mean(), atol=self.atol)
+
+    def test_identity(self):
+        x = jnp.asarray(RNG.rand(2, 1, 24, 24), jnp.float32)
+        np.testing.assert_allclose(
+            structural_similarity_index_measure(x, x, data_range=1.0), 1.0, atol=1e-5
+        )
+
+    def test_reductions_and_contrast(self):
+        preds, target = _imgs(nb=1)
+        p, t = jnp.asarray(preds[0]), jnp.asarray(target[0])
+        per_image = structural_similarity_index_measure(p, t, reduction="none", data_range=1.0)
+        assert per_image.shape == (B,)
+        np.testing.assert_allclose(
+            structural_similarity_index_measure(p, t, reduction="sum", data_range=1.0),
+            np.sum(np.asarray(per_image)),
+            atol=1e-5,
+        )
+        sim, cs = structural_similarity_index_measure(
+            p, t, data_range=1.0, return_contrast_sensitivity=True
+        )
+        np.testing.assert_allclose(cs, O.ssim_cs_np(preds[0], target[0], 1.0), atol=self.atol)
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds,
+            target,
+            StructuralSimilarityIndexMeasure,
+            lambda p, t: O.ssim_np(p, t, data_range=1.0).mean(),
+            metric_args={"data_range": 1.0},
+            atol=1e-4,
+        )
+
+    def test_jit(self):
+        preds, target = _imgs(nb=1)
+        fn = jax.jit(lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0))
+        np.testing.assert_allclose(
+            fn(jnp.asarray(preds[0]), jnp.asarray(target[0])),
+            O.ssim_np(preds[0], target[0], data_range=1.0).mean(),
+            atol=self.atol,
+        )
+
+    def test_3d(self):
+        p = jnp.asarray(RNG.rand(2, 1, 12, 12, 12), jnp.float32)
+        res = structural_similarity_index_measure(p, p * 0.9, data_range=1.0)
+        assert 0.0 < float(res) <= 1.0
+        np.testing.assert_allclose(structural_similarity_index_measure(p, p, data_range=1.0), 1.0, atol=1e-5)
+
+    def test_uniform_kernel(self):
+        preds, target = _imgs(nb=1)
+        res = structural_similarity_index_measure(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), gaussian_kernel=False, kernel_size=9, data_range=1.0
+        )
+        assert np.isfinite(float(res))
+
+
+class TestMSSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _imgs(h=192, w=192, nb=1)
+        res = multiscale_structural_similarity_index_measure(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), data_range=1.0
+        )
+        ref = O.ms_ssim_np(preds[0], target[0], data_range=1.0).mean()
+        np.testing.assert_allclose(res, ref, atol=self.atol)
+
+    def test_identity(self):
+        x = jnp.asarray(RNG.rand(2, 3, 192, 192), jnp.float32)
+        np.testing.assert_allclose(
+            multiscale_structural_similarity_index_measure(x, x, data_range=1.0), 1.0, atol=1e-5
+        )
+
+    def test_class(self):
+        preds, target = _imgs(h=192, w=192, nb=2)
+        self.run_class_metric_test(
+            preds,
+            target,
+            MultiScaleStructuralSimilarityIndexMeasure,
+            lambda p, t: O.ms_ssim_np(p, t, data_range=1.0).mean(),
+            metric_args={"data_range": 1.0},
+            atol=1e-4,
+            num_shards=2,
+        )
+
+    def test_too_small_image_raises(self):
+        x = jnp.zeros((1, 1, 16, 16))
+        with pytest.raises(ValueError, match="betas"):
+            multiscale_structural_similarity_index_measure(x, x, data_range=1.0)
+
+
+class TestPSNR(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs()
+        for i in range(2):
+            np.testing.assert_allclose(
+                peak_signal_noise_ratio(jnp.asarray(preds[i]), jnp.asarray(target[i]), data_range=1.0),
+                O.psnr_np(preds[i], target[i], data_range=1.0),
+                atol=1e-4,
+            )
+
+    def test_dynamic_range_and_base(self):
+        preds, target = _imgs(nb=1, scale=5.0)
+        np.testing.assert_allclose(
+            peak_signal_noise_ratio(jnp.asarray(preds[0]), jnp.asarray(target[0]), base=2.0),
+            O.psnr_np(preds[0], target[0], base=2.0),
+            atol=1e-4,
+        )
+
+    def test_dim(self):
+        preds, target = _imgs(nb=1)
+        res = peak_signal_noise_ratio(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), data_range=1.0, dim=(1, 2, 3), reduction="none"
+        )
+        assert res.shape == (B,)
+        per_image = [O.psnr_np(preds[0][j], target[0][j], data_range=1.0) for j in range(B)]
+        np.testing.assert_allclose(res, per_image, rtol=1e-5)
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds,
+            target,
+            PeakSignalNoiseRatio,
+            lambda p, t: O.psnr_np(p, t, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            atol=1e-4,
+        )
+
+    def test_class_tracked_range(self):
+        # data_range=None tracks observed min/max (zero-anchored like the reference)
+        preds, target = _imgs(nb=2, scale=4.0)
+        m = PeakSignalNoiseRatio()
+        for i in range(2):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        full_p = preds.reshape(-1, *preds.shape[2:])
+        full_t = target.reshape(-1, *target.shape[2:])
+        dr = max(full_t.max(), 0.0) - min(full_t.min(), 0.0)
+        np.testing.assert_allclose(m.compute(), O.psnr_np(full_p, full_t, data_range=dr), rtol=1e-5)
+
+
+class TestPSNRB(MetricTester):
+    def test_functional(self):
+        preds = RNG.rand(4, 1, 32, 32).astype(np.float32)
+        target = RNG.rand(4, 1, 32, 32).astype(np.float32)
+        np.testing.assert_allclose(
+            peak_signal_noise_ratio_with_blocked_effect(jnp.asarray(preds), jnp.asarray(target)),
+            O.psnrb_np(preds, target),
+            rtol=1e-5,
+        )
+
+    def test_multichannel_raises(self):
+        x = jnp.zeros((1, 3, 16, 16))
+        with pytest.raises(ValueError, match="grayscale"):
+            peak_signal_noise_ratio_with_blocked_effect(x, x)
+
+    def test_class_accumulation(self):
+        preds = RNG.rand(3, 2, 1, 32, 32).astype(np.float32)
+        target = RNG.rand(3, 2, 1, 32, 32).astype(np.float32)
+        m = PeakSignalNoiseRatioWithBlockedEffect()
+        sse = bef = tot = 0.0
+        dr = 0.0
+        for i in range(3):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            sse += ((preds[i] - target[i]) ** 2).sum()
+            tot += target[i].size
+            dr = max(dr, target[i].max() - target[i].min())
+        # oracle: recompute bef per update from the definition
+        def bef_np(x, bs=8):
+            _, _, h, w = x.shape
+            h_b = np.arange(bs - 1, w - 1, bs)
+            h_bc = np.setdiff1d(np.arange(w - 1), h_b)
+            v_b = np.arange(bs - 1, h - 1, bs)
+            v_bc = np.setdiff1d(np.arange(h - 1), v_b)
+            d_b = ((x[:, :, :, h_b] - x[:, :, :, h_b + 1]) ** 2).sum()
+            d_bc = ((x[:, :, :, h_bc] - x[:, :, :, h_bc + 1]) ** 2).sum()
+            d_b += ((x[:, :, v_b, :] - x[:, :, v_b + 1, :]) ** 2).sum()
+            d_bc += ((x[:, :, v_bc, :] - x[:, :, v_bc + 1, :]) ** 2).sum()
+            n_hb = h * (w / bs) - 1
+            n_vb = w * (h / bs) - 1
+            d_b /= n_hb + n_vb
+            d_bc /= h * (w - 1) - n_hb + w * (h - 1) - n_vb
+            t = np.log2(bs) / np.log2(min(h, w)) if d_b > d_bc else 0
+            return t * (d_b - d_bc)
+
+        bef = sum(bef_np(preds[i].astype(np.float64)) for i in range(3))
+        mse_b = sse / tot + bef
+        expected = 10 * np.log10(dr**2 / mse_b) if dr > 2 else 10 * np.log10(1 / mse_b)
+        np.testing.assert_allclose(m.compute(), expected, rtol=1e-5)
+
+
+class TestUQI(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _imgs(nb=2)
+        for i in range(2):
+            np.testing.assert_allclose(
+                universal_image_quality_index(jnp.asarray(preds[i]), jnp.asarray(target[i])),
+                O.uqi_np(preds[i], target[i]).mean(),
+                atol=self.atol,
+            )
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds,
+            target,
+            UniversalImageQualityIndex,
+            lambda p, t: O.uqi_np(p, t).mean(),
+            atol=1e-4,
+        )
+
+    def test_none_reduction_class(self):
+        preds, target = _imgs(nb=2)
+        m = UniversalImageQualityIndex(reduction="none")
+        for i in range(2):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        full_p = preds.reshape(-1, *preds.shape[2:])
+        full_t = target.reshape(-1, *target.shape[2:])
+        np.testing.assert_allclose(m.compute(), O.uqi_np(full_p, full_t), atol=self.atol)
+
+
+class TestSAM(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs(nb=2)
+        for i in range(2):
+            np.testing.assert_allclose(
+                spectral_angle_mapper(jnp.asarray(preds[i]), jnp.asarray(target[i])),
+                O.sam_np(preds[i], target[i]).mean(),
+                atol=1e-5,
+            )
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds, target, SpectralAngleMapper, lambda p, t: O.sam_np(p, t).mean(), atol=1e-5
+        )
+
+    def test_single_channel_raises(self):
+        x = jnp.zeros((1, 1, 8, 8))
+        with pytest.raises(ValueError, match="channel dimension"):
+            spectral_angle_mapper(x, x)
+
+
+class TestERGAS(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs(nb=2)
+        for i in range(2):
+            np.testing.assert_allclose(
+                error_relative_global_dimensionless_synthesis(jnp.asarray(preds[i]), jnp.asarray(target[i])),
+                O.ergas_np(preds[i], target[i]).mean(),
+                rtol=1e-4,
+            )
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds,
+            target,
+            ErrorRelativeGlobalDimensionlessSynthesis,
+            lambda p, t: O.ergas_np(p, t).mean(),
+            atol=1e-3,
+        )
+
+
+class TestRMSESW(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs(nb=1)
+        np.testing.assert_allclose(
+            root_mean_squared_error_using_sliding_window(jnp.asarray(preds[0]), jnp.asarray(target[0])),
+            O.rmse_sw_np(preds[0], target[0]),
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("window_size", [3, 5, 8])
+    def test_window_sizes(self, window_size):
+        preds, target = _imgs(nb=1, c=1, h=24, w=24)
+        np.testing.assert_allclose(
+            root_mean_squared_error_using_sliding_window(
+                jnp.asarray(preds[0]), jnp.asarray(target[0]), window_size=window_size
+            ),
+            O.rmse_sw_np(preds[0], target[0], window_size),
+            atol=1e-5,
+        )
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds,
+            target,
+            RootMeanSquaredErrorUsingSlidingWindow,
+            lambda p, t: O.rmse_sw_np(p, t),
+            atol=1e-5,
+        )
+
+
+class TestRASE(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs(nb=1)
+        np.testing.assert_allclose(
+            relative_average_spectral_error(jnp.asarray(preds[0]), jnp.asarray(target[0])),
+            O.rase_np(preds[0], target[0]),
+            rtol=1e-4,
+        )
+
+    def test_class(self):
+        preds, target = _imgs()
+        self.run_class_metric_test(
+            preds, target, RelativeAverageSpectralError, lambda p, t: O.rase_np(p, t), atol=1e-2
+        )
+
+
+class TestDLambda(MetricTester):
+    def test_functional(self):
+        preds, target = _imgs(nb=1, c=4)
+        np.testing.assert_allclose(
+            spectral_distortion_index(jnp.asarray(preds[0]), jnp.asarray(target[0])),
+            O.d_lambda_np(preds[0], target[0]),
+            atol=1e-5,
+        )
+
+    def test_p2(self):
+        preds, target = _imgs(nb=1, c=3)
+        np.testing.assert_allclose(
+            spectral_distortion_index(jnp.asarray(preds[0]), jnp.asarray(target[0]), p=2),
+            O.d_lambda_np(preds[0], target[0], p=2),
+            atol=1e-5,
+        )
+
+    def test_class(self):
+        preds, target = _imgs(c=3)
+        self.run_class_metric_test(
+            preds, target, SpectralDistortionIndex, lambda p, t: O.d_lambda_np(p, t), atol=1e-5
+        )
+
+
+class TestTotalVariation(MetricTester):
+    def test_functional(self):
+        preds, _ = _imgs(nb=2)
+        for i in range(2):
+            np.testing.assert_allclose(
+                total_variation(jnp.asarray(preds[i])), O.tv_np(preds[i]).sum(), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                total_variation(jnp.asarray(preds[i]), reduction="none"), O.tv_np(preds[i]), rtol=1e-5
+            )
+
+    def test_class(self):
+        preds, _ = _imgs()
+        m = TotalVariation(reduction="mean")
+        for i in range(NB):
+            m.update(jnp.asarray(preds[i]))
+        full = preds.reshape(-1, *preds.shape[2:])
+        np.testing.assert_allclose(m.compute(), O.tv_np(full).sum() / full.shape[0], rtol=1e-5)
+
+    def test_class_none(self):
+        preds, _ = _imgs(nb=2)
+        m = TotalVariation(reduction="none")
+        for i in range(2):
+            m.update(jnp.asarray(preds[i]))
+        full = preds.reshape(-1, *preds.shape[2:])
+        np.testing.assert_allclose(m.compute(), O.tv_np(full), rtol=1e-5)
+
+
+class TestVIF(MetricTester):
+    def test_functional(self):
+        preds = RNG.rand(2, 2, 48, 48).astype(np.float32) * 255
+        target = RNG.rand(2, 2, 48, 48).astype(np.float32) * 255
+        np.testing.assert_allclose(
+            visual_information_fidelity(jnp.asarray(preds), jnp.asarray(target)),
+            O.vif_np(preds, target),
+            rtol=1e-4,
+        )
+
+    def test_small_image_raises(self):
+        x = jnp.zeros((1, 1, 30, 30))
+        with pytest.raises(ValueError, match="41x41"):
+            visual_information_fidelity(x, x)
+
+    def test_class(self):
+        preds = RNG.rand(2, 2, 1, 48, 48).astype(np.float32) * 255
+        target = RNG.rand(2, 2, 1, 48, 48).astype(np.float32) * 255
+        m = VisualInformationFidelity()
+        for i in range(2):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        full_p = preds.reshape(-1, *preds.shape[2:])
+        full_t = target.reshape(-1, *target.shape[2:])
+        np.testing.assert_allclose(m.compute(), O.vif_np(full_p, full_t), rtol=5e-4)
+
+
+class TestImageGradients:
+    def test_values(self):
+        img = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        dy, dx = image_gradients(jnp.asarray(img))
+        assert dy.shape == img.shape and dx.shape == img.shape
+        np.testing.assert_allclose(dy[0, 0, :4], np.full((4, 5), 5.0))
+        np.testing.assert_allclose(dy[0, 0, 4], np.zeros(5))
+        np.testing.assert_allclose(dx[0, 0, :, :4], np.full((5, 4), 1.0))
+
+    def test_raises(self):
+        with pytest.raises(RuntimeError, match="4D"):
+            image_gradients(jnp.zeros((5, 5)))

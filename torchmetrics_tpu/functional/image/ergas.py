@@ -1,0 +1,52 @@
+"""ERGAS kernels (reference ``src/torchmetrics/functional/image/ergas.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _ergas_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``ergas.py:24-43``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ergas_compute(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Per-image ERGAS over per-band RMSE (reference ``ergas.py:46-83``)."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum(jnp.square(rmse_per_band / mean_target), axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS (reference ``ergas.py:86-131``)."""
+    preds, target = _ergas_check_inputs(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

@@ -1,0 +1,19 @@
+"""Image-gradient kernels (reference ``src/torchmetrics/functional/image/gradients.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference (dy, dx), zero-padded at the far edge (reference ``gradients.py:47-81``)."""
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

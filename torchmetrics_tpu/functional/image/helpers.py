@@ -1,0 +1,115 @@
+"""Shared image-kernel helpers (reference ``src/torchmetrics/functional/image/helper.py``).
+
+TPU-first design notes: every filter here is a *depthwise* convolution expressed through
+``lax.conv_general_dilated`` with ``feature_group_count=channels`` so XLA lowers it onto the MXU
+as one batched conv per call (the reference loops channels in Python for the uniform filter,
+``helper.py:118-133``). All shapes are static; everything is jit-safe.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def _gaussian_1d(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """Normalised 1D gaussian window (reference ``helper.py:8-25``)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return gauss / jnp.sum(gauss)
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """Separable 2D gaussian as a depthwise-conv weight ``(C, 1, kh, kw)`` (reference ``helper.py:27-58``)."""
+    kx = _gaussian_1d(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian_1d(kernel_size[1], sigma[1], dtype)
+    kernel = jnp.outer(kx, ky)
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """3D gaussian depthwise-conv weight ``(C, 1, kh, kw, kd)`` (reference ``helper.py:137-157``)."""
+    kx = _gaussian_1d(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian_1d(kernel_size[1], sigma[1], dtype)
+    kz = _gaussian_1d(kernel_size[2], sigma[2], dtype)
+    kernel = jnp.einsum("i,j,k->ijk", kx, ky, kz)
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """Valid-mode depthwise conv: ``x`` is ``(N, C, H, W)``, ``kernel`` is ``(C, 1, kh, kw)``."""
+    channels = x.shape[1]
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=channels,
+    )
+
+
+def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    """Valid-mode depthwise conv: ``x`` is ``(N, C, D, H, W)``-like, ``kernel`` ``(C, 1, k1, k2, k3)``."""
+    channels = x.shape[1]
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=channels,
+    )
+
+
+def _reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    """Edge-excluding reflection pad of the two trailing dims (torch ``F.pad(mode='reflect')``)."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _reflect_pad_3d(x: Array, pad_d: int, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(
+        x, ((0, 0), (0, 0), (pad_d, pad_d), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect"
+    )
+
+
+def _symmetric_pad_2d(x: Array, pad: int, outer_pad: int) -> Array:
+    """Edge-including reflection pad, asymmetric on the right (reference ``helper.py:80-113``).
+
+    The reference pads ``pad`` rows/cols on the left and ``pad + outer_pad - 1`` on the right of
+    each spatial dim (scipy ``uniform_filter`` alignment for even windows); numpy's
+    ``mode='symmetric'`` has exactly the edge-including semantics.
+    """
+    right = pad + outer_pad - 1
+    return jnp.pad(x, ((0, 0), (0, 0), (pad, right), (pad, right)), mode="symmetric")
+
+
+def _uniform_filter(x: Array, window_size: int) -> Array:
+    """Sliding-window mean matching scipy's ``uniform_filter`` (reference ``helper.py:116-133``)."""
+    x = _symmetric_pad_2d(x, window_size // 2, window_size % 2)
+    channels = x.shape[1]
+    kernel = jnp.full((channels, 1, window_size, window_size), 1.0 / window_size**2, x.dtype)
+    return _depthwise_conv2d(x, kernel)
+
+
+def _avg_pool(x: Array, spatial_dims: int) -> Array:
+    """2x downsample by mean (torch ``avg_pool{2,3}d(kernel=2, stride=2)``, floor semantics)."""
+    window = (1, 1) + (2,) * spatial_dims
+    summed = lax.reduce_window(x, 0.0, lax.add, window, window, "VALID")
+    return summed / (2**spatial_dims)
+
+
+def reduce(x: Array, reduction: str = "elementwise_mean") -> Array:
+    """Reference ``utilities/distributed.py:22-43``: elementwise_mean / sum / none."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Expected reduction to be one of `elementwise_mean`, `sum`, `none`, None")

@@ -1,0 +1,72 @@
+"""PSNR kernels (reference ``src/torchmetrics/functional/image/psnr.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import reduce
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _psnr_update(
+    preds: Array, target: Array, dim: Optional[Union[int, Tuple[int, ...]]] = None
+) -> Tuple[Array, Array]:
+    """Sum of squared error + observation count, optionally per-`dim` (reference ``psnr.py:58-88``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if dim is None:
+        diff = preds - target
+        return jnp.sum(diff * diff), jnp.asarray(target.size, jnp.float32)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        num_obs = jnp.asarray(target.size, jnp.float32)
+    else:
+        n = 1
+        for d in dim_list:
+            n *= target.shape[d]
+        num_obs = jnp.broadcast_to(jnp.asarray(n, jnp.float32), sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Reference ``psnr.py:23-55``."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction)
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR (reference ``psnr.py:91-155``)."""
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.max(target) - jnp.min(target)
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
+    else:
+        data_range = jnp.asarray(float(data_range), jnp.float32)
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range, base=base, reduction=reduction)

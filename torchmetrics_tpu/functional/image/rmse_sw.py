@@ -1,0 +1,78 @@
+"""Sliding-window RMSE kernels (reference ``src/torchmetrics/functional/image/rmse_sw.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import _uniform_filter
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _rmse_sw_checks(preds: Array, target: Array, window_size: int) -> None:
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= target.shape[2] or round(window_size / 2) >= target.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(target.shape[2], target.shape[3])} but got {round(window_size / 2)}."
+        )
+
+
+def _rmse_sw_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_val_sum: Optional[Array],
+    rmse_map: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Optional[Array], Array, Array]:
+    """Accumulate the per-window RMSE map over a batch (reference ``rmse_sw.py:24-89``).
+
+    ``crop_slide`` uses Python's banker's rounding of ``window_size / 2`` to match the
+    reference/scipy alignment exactly.
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _rmse_sw_checks(preds, target, window_size)
+
+    batch = jnp.asarray(target.shape[0], jnp.float32)
+    total_images = batch if total_images is None else total_images + batch
+    error = jnp.square(target - preds)
+    _rmse_map = jnp.sqrt(_uniform_filter(error, window_size))
+    crop_slide = round(window_size / 2)
+
+    batch_val = jnp.mean(
+        jnp.sum(_rmse_map[:, :, crop_slide:-crop_slide, crop_slide:-crop_slide], axis=0)
+    )
+    if rmse_val_sum is not None:
+        rmse_val_sum = rmse_val_sum + batch_val
+    else:
+        rmse_val_sum = batch_val
+
+    batch_map = jnp.sum(_rmse_map, axis=0)
+    rmse_map = batch_map if rmse_map is None else rmse_map + batch_map
+    return rmse_val_sum, rmse_map, total_images
+
+
+def _rmse_sw_compute(
+    rmse_val_sum: Optional[Array], rmse_map: Array, total_images: Array
+) -> Tuple[Optional[Array], Array]:
+    """Reference ``rmse_sw.py:92-109``."""
+    rmse = rmse_val_sum / total_images if rmse_val_sum is not None else None
+    return rmse, rmse_map / total_images
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+):
+    """Sliding-window RMSE (reference ``rmse_sw.py:112-151``)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+    )
+    rmse, rmse_map = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    return (rmse, rmse_map) if return_rmse_map else rmse

@@ -1,0 +1,47 @@
+"""Spectral Angle Mapper kernels (reference ``src/torchmetrics/functional/image/sam.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _sam_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``sam.py:24-48``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Per-pixel spectral angle over the channel axis (reference ``sam.py:51-81``)."""
+    dot_product = jnp.sum(preds * target, axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """SAM (reference ``sam.py:84-125``)."""
+    preds, target = _sam_check_inputs(preds, target)
+    return _sam_compute(preds, target, reduction)

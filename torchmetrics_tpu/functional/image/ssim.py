@@ -1,0 +1,258 @@
+"""SSIM / MS-SSIM kernels (reference ``src/torchmetrics/functional/image/ssim.py``).
+
+TPU shape: the five filtered moments (mu_p, mu_t, E[p^2], E[t^2], E[pt]) are produced by ONE
+depthwise conv over a ``(5·B, C, ...)`` stack — a single MXU-friendly program per scale instead
+of five kernel launches (mirrors the reference's batching trick at ``ssim.py:147-149`` but with
+grouped ``lax.conv_general_dilated``). All control flow (scales, kernel sizes) is static.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import (
+    _avg_pool,
+    _depthwise_conv2d,
+    _depthwise_conv3d,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflect_pad_2d,
+    _reflect_pad_3d,
+    reduce,
+)
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``ssim.py:26-42``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ssim_validate_args(kernel_size: Sequence[int], sigma: Sequence[float], ndim: int) -> None:
+    if len(kernel_size) != ndim - 2:
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less that target dimensionality,"
+            f" which is: {ndim}"
+        )
+    if len(kernel_size) not in (2, 3):
+        raise ValueError(
+            f"Expected `kernel_size` dimension to be 2 or 3. `kernel_size` dimensionality: {len(kernel_size)}"
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-image SSIM (reference ``ssim.py:45-184``)."""
+    is_3d = preds.ndim == 5
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = (3 if is_3d else 2) * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = (3 if is_3d else 2) * [sigma]
+    _ssim_validate_args(kernel_size, sigma, preds.ndim)
+    if return_full_image and return_contrast_sensitivity:
+        raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
+
+    if data_range is None:
+        data_range = jnp.maximum(
+            jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target)
+        )
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    channel = preds.shape[1]
+    # padding is always derived from the sigma-sized gaussian support, even for the uniform
+    # kernel (reference quirk, ssim.py:125-128)
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    pad_h = (gauss_kernel_size[0] - 1) // 2
+    pad_w = (gauss_kernel_size[1] - 1) // 2
+
+    if is_3d:
+        pad_d = (gauss_kernel_size[2] - 1) // 2
+        preds = _reflect_pad_3d(preds, pad_h, pad_w, pad_d)
+        target = _reflect_pad_3d(target, pad_h, pad_w, pad_d)
+        kernel = (
+            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma)
+            if gaussian_kernel
+            else jnp.full((channel, 1, *kernel_size), 1.0 / jnp.prod(jnp.asarray(kernel_size)), jnp.float32)
+        )
+        conv = _depthwise_conv3d
+    else:
+        preds = _reflect_pad_2d(preds, pad_h, pad_w)
+        target = _reflect_pad_2d(target, pad_h, pad_w)
+        kernel = (
+            _gaussian_kernel_2d(channel, gauss_kernel_size, sigma)
+            if gaussian_kernel
+            else jnp.full((channel, 1, *kernel_size), 1.0 / jnp.prod(jnp.asarray(kernel_size)), jnp.float32)
+        )
+        conv = _depthwise_conv2d
+
+    batch = preds.shape[0]
+    stacked = jnp.concatenate(
+        (preds, target, preds * preds, target * target, preds * target), axis=0
+    )
+    mu_p, mu_t, e_pp, e_tt, e_pt = jnp.split(conv(stacked, kernel), 5, axis=0)
+
+    mu_pred_sq = mu_p * mu_p
+    mu_target_sq = mu_t * mu_t
+    mu_pred_target = mu_p * mu_t
+
+    sigma_pred_sq = e_pp - mu_pred_sq
+    sigma_target_sq = e_tt - mu_target_sq
+    sigma_pred_target = e_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    if is_3d:
+        crop = lambda im: im[..., pad_h:-pad_h, pad_w:-pad_w, pad_d:-pad_d]
+    else:
+        crop = lambda im: im[..., pad_h:-pad_h, pad_w:-pad_w]
+    ssim_idx = crop(ssim_full)
+    per_image = jnp.mean(ssim_idx.reshape(batch, -1), axis=-1)
+
+    if return_contrast_sensitivity:
+        cs = crop(upper / lower)
+        return per_image, jnp.mean(cs.reshape(batch, -1), axis=-1)
+    if return_full_image:
+        return per_image, ssim_full
+    return per_image
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """SSIM (reference ``ssim.py:208-290``)."""
+    preds, target = _ssim_check_inputs(preds, target)
+    pack = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if isinstance(pack, tuple):
+        similarity, image = pack
+        return reduce(similarity, reduction), image
+    return reduce(pack, reduction)
+
+
+def _multiscale_ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Per-image MS-SSIM (reference ``ssim.py:321-423``): static unrolled scale pyramid."""
+    is_3d = preds.ndim == 5
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = (3 if is_3d else 2) * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = (3 if is_3d else 2) * [sigma]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * betas_div}."
+        )
+    if preds.shape[-1] // betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * betas_div}."
+        )
+
+    mcs_list = []
+    sim = None
+    for scale in range(len(betas)):
+        sim, cs = _ssim_update(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        if normalize == "relu":
+            sim = jnp.maximum(sim, 0.0)
+            cs = jnp.maximum(cs, 0.0)
+        mcs_list.append(cs)
+        if scale != len(betas) - 1:
+            preds = _avg_pool(preds, 3 if is_3d else 2)
+            target = _avg_pool(target, 3 if is_3d else 2)
+    mcs_list[-1] = sim
+    mcs_stack = jnp.stack(mcs_list)
+    if normalize == "simple":
+        mcs_stack = (mcs_stack + 1) / 2
+    weighted = mcs_stack ** jnp.asarray(betas, jnp.float32)[:, None]
+    return jnp.prod(weighted, axis=0)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """MS-SSIM (reference ``ssim.py:447-527``)."""
+    if not isinstance(betas, tuple):
+        raise ValueError("Argument `betas` is expected to be of a type tuple.")
+    if not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be a tuple of floats.")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_check_inputs(preds, target)
+    mcs = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return reduce(mcs, reduction)

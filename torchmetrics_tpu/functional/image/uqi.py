@@ -1,0 +1,96 @@
+"""Universal Image Quality Index kernels (reference ``src/torchmetrics/functional/image/uqi.py``).
+
+Same one-conv-for-five-moments layout as SSIM (see ``ssim.py`` in this package).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helpers import (
+    _depthwise_conv2d,
+    _gaussian_kernel_2d,
+    _reflect_pad_2d,
+    reduce,
+)
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _uqi_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``uqi.py:25-44``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _uqi_map(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+) -> Array:
+    """Full cropped per-pixel UQI map (core of reference ``uqi.py:47-117``)."""
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds = _reflect_pad_2d(preds, pad_h, pad_w)
+    target = _reflect_pad_2d(target, pad_h, pad_w)
+
+    stacked = jnp.concatenate(
+        (preds, target, preds * preds, target * target, preds * target), axis=0
+    )
+    mu_p, mu_t, e_pp, e_tt, e_pt = jnp.split(_depthwise_conv2d(stacked, kernel), 5, axis=0)
+
+    mu_pred_sq = mu_p * mu_p
+    mu_target_sq = mu_t * mu_t
+    mu_pred_target = mu_p * mu_t
+    sigma_pred_sq = e_pp - mu_pred_sq
+    sigma_target_sq = e_tt - mu_target_sq
+    sigma_pred_target = e_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    eps = jnp.finfo(jnp.float32).eps
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
+    return uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+
+
+def _uqi_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Reference ``uqi.py:47-117``."""
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+    return reduce(_uqi_map(preds, target, kernel_size, sigma), reduction)
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """UQI (reference ``uqi.py:120-177``)."""
+    preds, target = _uqi_check_inputs(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -1,0 +1,610 @@
+"""Generative-model image metrics: FID, KID, IS, MiFID, LPIPS, PPL.
+
+Reference: ``src/torchmetrics/image/{fid,kid,inception,mifid,lpip,perceptual_path_length}.py``.
+
+TPU redesign decisions (SURVEY §7, VERDICT r2 item 2):
+
+- **Pluggable feature extractors.** The reference hard-depends on torch-fidelity's pretrained
+  InceptionV3 (``fid.py:44-66``); this build has no network egress and no bundled weights, so
+  every metric accepts ``feature`` as a *callable* ``imgs -> (N, d)`` (any JAX/host function —
+  e.g. a flax InceptionV3, a CLIP tower, or a host-callback into torch) or ``None`` (inputs to
+  ``update`` are already extracted features). Passing the reference's integer layer ids raises
+  the same ``ModuleNotFoundError`` contract the reference raises without torch-fidelity.
+- **f32 cancellation-free covariance states** instead of the reference's fp64 sums
+  (``fid.py:314-320``): per-batch *centered* Gram matrices (exact, small magnitudes) plus a
+  batch-mean outer-product accumulator. ``cov = cov_centered_sum + mu_outer_sum - n·μμᵀ`` only
+  cancels in the O(μ²) term, not in the dominant second moment — TPUs have no fast fp64, so
+  this is the hardware-honest equivalent. All states stay ``psum``-able.
+- **TPU-compilable matrix sqrt**: ``tr((Σ₁Σ₂)^½)`` via two symmetric eigendecompositions
+  (``tr((S Σ₂ S)^½)`` with ``S = Σ₁^½`` from ``eigh``) — the reference's non-symmetric
+  ``torch.linalg.eigvals`` (``fid.py:159-180``) has no TPU lowering.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+FeatureExtractor = Optional[Callable[[Array], Array]]
+
+_INCEPTION_LAYERS = (64, 192, 768, 2048)
+
+
+def _resolve_extractor(feature: Union[int, str, FeatureExtractor], metric_name: str) -> Tuple[FeatureExtractor, Optional[int]]:
+    """Map the ``feature`` argument to (extractor, num_features-if-known)."""
+    if feature is None:
+        return None, None
+    if isinstance(feature, (int, str)) and not callable(feature):
+        if isinstance(feature, int) and feature not in _INCEPTION_LAYERS:
+            raise ValueError(
+                f"Integer input to argument `feature` must be one of {_INCEPTION_LAYERS}, but got {feature}."
+            )
+        raise ModuleNotFoundError(
+            f"{metric_name} with a pretrained InceptionV3 feature layer requires bundled weights which are"
+            " not available in this build. Pass `feature` as a callable `imgs -> (N, d)` feature extractor"
+            " (e.g. a flax InceptionV3), or `feature=None` to feed pre-extracted features to `update`."
+        )
+    if callable(feature):
+        return feature, None
+    raise TypeError("Got unknown input to argument `feature`")
+
+
+def _sqrtm_trace_product(sigma1: Array, sigma2: Array) -> Array:
+    """``tr((Σ₁ Σ₂)^{1/2})`` for symmetric PSD inputs via two ``eigh`` factorisations."""
+    evals1, evecs1 = jnp.linalg.eigh(sigma1)
+    sqrt1 = (evecs1 * jnp.sqrt(jnp.clip(evals1, 0.0))) @ evecs1.T
+    inner = sqrt1 @ sigma2 @ sqrt1
+    evals = jnp.linalg.eigvalsh(inner)
+    return jnp.sum(jnp.sqrt(jnp.clip(evals, 0.0)))
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """Fréchet distance between two gaussians (reference ``fid.py:159-180``)."""
+    a = jnp.sum(jnp.square(mu1 - mu2))
+    b = jnp.trace(sigma1) + jnp.trace(sigma2)
+    c = _sqrtm_trace_product(sigma1, sigma2)
+    return a + b - 2 * c
+
+
+class _FeatureStatsMetric(Metric):
+    """Shared machinery: extractor resolution + real/fake dispatch (host-side ``real`` flag)."""
+
+    jit_update = False  # extractor may be arbitrary host code; `real` is a static branch
+    # forward() must route through the overridden update() (full-state path) so the feature
+    # extractor runs; the reduce-state fast path calls _update with raw images
+    full_state_update = True
+
+    def __init__(
+        self,
+        feature: Union[int, str, FeatureExtractor],
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.extractor, _ = _resolve_extractor(feature, type(self).__name__)
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+
+    def _extract(self, imgs: Array) -> Array:
+        if self.extractor is not None:
+            if self.normalize:  # [0,1] floats -> uint8 [0,255], the extractor contract (fid.py:324)
+                imgs = (jnp.asarray(imgs) * 255).astype(jnp.uint8)
+            feats = self.extractor(imgs)
+        else:
+            feats = jnp.asarray(imgs)
+        feats = jnp.asarray(feats, jnp.float32)
+        if feats.ndim == 1:
+            feats = feats[None]
+        return feats
+
+    def update(self, imgs: Array, real: bool = True) -> None:  # noqa: D102
+        super().update(self._extract(imgs), bool(real))
+
+    def update_batches(self, imgs: Array, real: bool = True) -> None:
+        """Per-batch loop: the host-side extractor and static `real` flag preclude a lax.scan sweep."""
+        for i in range(jnp.shape(imgs)[0]):
+            self.update(imgs[i], real=real)
+
+    def reset(self) -> None:
+        """Keep real-distribution statistics across resets when configured (reference ``fid.py:355-366``)."""
+        if not self.reset_real_features:
+            keep_t = {k: v for k, v in self._state.tensors.items() if k.startswith("real_")}
+            keep_l = {k: list(v) for k, v in self._state.lists.items() if k.startswith("real_")}
+            super().reset()
+            self._state.tensors.update(keep_t)
+            self._state.lists.update(keep_l)
+        else:
+            super().reset()
+
+
+class FrechetInceptionDistance(_FeatureStatsMetric):
+    """FID (reference ``image/fid.py:182``).
+
+    States are f32 streaming moments: per-distribution ``n``, feature sum, centered-Gram sum and
+    batch-mean outer-product sum — see the module docstring for why this replaces the
+    reference's fp64 raw second-moment sums.
+    """
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = True  # forward() must route through the extractor-running update()
+    plot_lower_bound = 0.0
+    jit_compute = False  # host-side sample-count guard; eigh still runs on device
+
+    def __init__(
+        self,
+        feature: Union[int, str, FeatureExtractor] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        num_features: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(feature, reset_real_features, normalize, **kwargs)
+        if num_features is None:
+            if self.extractor is None:
+                raise ValueError("`num_features` must be given when `feature` is None (raw-feature mode).")
+            num_features = int(np.asarray(self.extractor(jnp.zeros((1, 3, 299, 299), jnp.float32))).shape[-1])
+        d = num_features
+        for prefix in ("real", "fake"):
+            self.add_state(f"{prefix}_features_sum", jnp.zeros((d,), jnp.float32), dist_reduce_fx="sum")
+            self.add_state(f"{prefix}_features_cov_sum", jnp.zeros((d, d), jnp.float32), dist_reduce_fx="sum")
+            self.add_state(f"{prefix}_mu_outer_sum", jnp.zeros((d, d), jnp.float32), dist_reduce_fx="sum")
+            self.add_state(f"{prefix}_features_num_samples", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state: Dict[str, Array], features: Array, real: Array) -> Dict[str, Array]:
+        prefix = "real" if bool(real) else "fake"
+        n = features.shape[0]
+        bmean = jnp.mean(features, axis=0)
+        centered = features - bmean
+        return {
+            f"{prefix}_features_sum": state[f"{prefix}_features_sum"] + jnp.sum(features, axis=0),
+            f"{prefix}_features_cov_sum": state[f"{prefix}_features_cov_sum"] + centered.T @ centered,
+            f"{prefix}_mu_outer_sum": state[f"{prefix}_mu_outer_sum"] + n * jnp.outer(bmean, bmean),
+            f"{prefix}_features_num_samples": state[f"{prefix}_features_num_samples"] + n,
+        }
+
+    @staticmethod
+    def _stats(state: Dict[str, Array], prefix: str) -> Tuple[Array, Array]:
+        n = state[f"{prefix}_features_num_samples"]
+        mu = state[f"{prefix}_features_sum"] / n
+        cov_num = (
+            state[f"{prefix}_features_cov_sum"]
+            + state[f"{prefix}_mu_outer_sum"]
+            - n * jnp.outer(mu, mu)
+        )
+        return mu, cov_num / (n - 1)
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        if float(state["real_features_num_samples"]) < 2 or float(state["fake_features_num_samples"]) < 2:
+            raise RuntimeError(
+                "More than one sample is required for both the real and fake distributed to compute FID"
+            )
+        mu_r, cov_r = self._stats(state, "real")
+        mu_f, cov_f = self._stats(state, "fake")
+        return _compute_fid(mu_r, cov_r, mu_f, cov_f)
+
+
+def _poly_kernel(f1: Array, f2: Array, degree: int, gamma: Optional[float], coef: float) -> Array:
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def _poly_mmd(f_real: Array, f_fake: Array, degree: int, gamma: Optional[float], coef: float) -> Array:
+    """Unbiased polynomial-kernel MMD² (reference ``kid.py:34-70``) — three MXU matmuls."""
+    k_11 = _poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = _poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = _poly_kernel(f_real, f_fake, degree, gamma, coef)
+    m = k_11.shape[0]
+    kt_xx_sum = jnp.sum(k_11) - jnp.trace(k_11)
+    kt_yy_sum = jnp.sum(k_22) - jnp.trace(k_22)
+    k_xy_sum = jnp.sum(k_12)
+    return (kt_xx_sum + kt_yy_sum) / (m * (m - 1)) - 2 * k_xy_sum / (m**2)
+
+
+class KernelInceptionDistance(_FeatureStatsMetric):
+    """KID (reference ``image/kid.py:70``): subset-resampled polynomial MMD over feature lists."""
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = True  # forward() must route through the extractor-running update()
+    plot_lower_bound = 0.0
+    jit_compute = False  # host loop over random subsets; kernels run on device
+
+    def __init__(
+        self,
+        feature: Union[int, str, FeatureExtractor] = 2048,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(feature, reset_real_features, normalize, **kwargs)
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        self.subsets = subsets
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        self.subset_size = subset_size
+        if not (isinstance(degree, int) and degree > 0):
+            raise ValueError("Argument `degree` expected to be integer larger than 0")
+        self.degree = degree
+        if gamma is not None and not (isinstance(gamma, float) and gamma > 0):
+            raise ValueError("Argument `gamma` expected to be `None` or float larger than 0")
+        self.gamma = gamma
+        if not (isinstance(coef, float) and coef > 0):
+            raise ValueError("Argument `coef` expected to be float larger than 0")
+        self.coef = coef
+        # seeded subset resampling (reference uses the ambient torch RNG, kid.py:265-268)
+        self.seed = seed
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def _update(self, state: Dict[str, Array], features: Array, real: Array) -> Dict[str, Array]:
+        return {("real_features" if bool(real) else "fake_features"): features}
+
+    def _compute(self, state: Dict[str, Any]) -> Tuple[Array, Array]:
+        real_features = state["real_features"]
+        fake_features = state["fake_features"]
+        if isinstance(real_features, list) or isinstance(fake_features, list):
+            raise RuntimeError("No real/fake features accumulated; call `update` before `compute`.")
+        n_real, n_fake = real_features.shape[0], fake_features.shape[0]
+        if n_real < self.subset_size or n_fake < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+        rng = np.random.RandomState(self.seed)
+        scores = []
+        for _ in range(self.subsets):
+            f_real = real_features[rng.permutation(n_real)[: self.subset_size]]
+            f_fake = fake_features[rng.permutation(n_fake)[: self.subset_size]]
+            scores.append(_poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
+        kid = jnp.stack(scores)
+        return jnp.mean(kid), jnp.std(kid)
+
+
+class InceptionScore(Metric):
+    """IS (reference ``image/inception.py:34``): exp KL between conditional and marginal label dists.
+
+    ``feature`` must be a callable producing *logits* ``(N, num_classes)`` (the reference's
+    default is the InceptionV3 ``logits_unbiased`` head) or ``None`` for pre-extracted logits.
+    """
+
+    higher_is_better = True
+    is_differentiable = False
+    full_state_update = True  # forward() must run the overridden update() (extractor)
+    plot_lower_bound = 0.0
+    jit_update = False
+    jit_compute = False  # host-side permutation + python chunking
+
+    def __init__(
+        self,
+        feature: Union[int, str, FeatureExtractor] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.extractor, _ = _resolve_extractor(feature, type(self).__name__)
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.splits = splits
+        self.seed = seed
+        self.add_state("features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:  # noqa: D102
+        if self.extractor is not None:
+            if self.normalize:
+                imgs = (jnp.asarray(imgs) * 255).astype(jnp.uint8)
+            feats = self.extractor(imgs)
+        else:
+            feats = jnp.asarray(imgs)
+        super().update(jnp.asarray(feats, jnp.float32))
+
+    def update_batches(self, imgs: Array) -> None:
+        """Per-batch loop (host-side extractor + list state preclude the scan sweep)."""
+        for i in range(jnp.shape(imgs)[0]):
+            self.update(imgs[i])
+
+    def _update(self, state: Dict[str, Array], features: Array) -> Dict[str, Array]:
+        return {"features": features}
+
+    def _compute(self, state: Dict[str, Any]) -> Tuple[Array, Array]:
+        features = state["features"]
+        if isinstance(features, list):
+            raise RuntimeError("No features accumulated; call `update` before `compute`.")
+        rng = np.random.RandomState(self.seed)
+        features = features[rng.permutation(features.shape[0])]
+        log_prob = jax.nn.log_softmax(features, axis=1)
+        prob = jnp.exp(log_prob)
+        # torch.chunk split sizes: ceil(N/splits) per chunk (inception.py:162-163)
+        n = features.shape[0]
+        chunk = -(-n // self.splits)
+        kl_scores = []
+        for start in range(0, n, chunk):
+            p = prob[start : start + chunk]
+            log_p = log_prob[start : start + chunk]
+            mean_p = jnp.mean(p, axis=0, keepdims=True)
+            kl = jnp.sum(p * (log_p - jnp.log(mean_p)), axis=1)
+            kl_scores.append(jnp.exp(jnp.mean(kl)))
+        kl = jnp.stack(kl_scores)
+        return jnp.mean(kl), jnp.std(kl, ddof=1)
+
+
+def _cosine_distance(features1: Array, features2: Array, eps: float = 0.1) -> Array:
+    """Mean minimal cosine distance with the MiFID threshold rule (reference ``mifid.py:36-47``)."""
+    f1 = features1[np.asarray(jnp.sum(features1, axis=1)) != 0]
+    f2 = features2[np.asarray(jnp.sum(features2, axis=1)) != 0]
+    f1 = f1 / jnp.linalg.norm(f1, axis=1, keepdims=True)
+    f2 = f2 / jnp.linalg.norm(f2, axis=1, keepdims=True)
+    d = 1.0 - jnp.abs(f1 @ f2.T)
+    mean_min_d = jnp.mean(jnp.min(d, axis=1))
+    return jnp.where(mean_min_d < eps, mean_min_d, jnp.ones_like(mean_min_d))
+
+
+class MemorizationInformedFrechetInceptionDistance(_FeatureStatsMetric):
+    """MiFID (reference ``image/mifid.py:66``): FID penalised by train-set memorisation."""
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = True  # forward() must route through the extractor-running update()
+    plot_lower_bound = 0.0
+    jit_compute = False
+
+    def __init__(
+        self,
+        feature: Union[int, str, FeatureExtractor] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(feature, reset_real_features, normalize, **kwargs)
+        if not (isinstance(cosine_distance_eps, float) and 1 >= cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def _update(self, state: Dict[str, Array], features: Array, real: Array) -> Dict[str, Array]:
+        return {("real_features" if bool(real) else "fake_features"): features}
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        real, fake = state["real_features"], state["fake_features"]
+        if isinstance(real, list) or isinstance(fake, list):
+            raise RuntimeError("No real/fake features accumulated; call `update` before `compute`.")
+        mu_r, cov_r = jnp.mean(real, axis=0), jnp.cov(real, rowvar=False)
+        mu_f, cov_f = jnp.mean(fake, axis=0), jnp.cov(fake, rowvar=False)
+        fid = _compute_fid(mu_r, jnp.atleast_2d(cov_r), mu_f, jnp.atleast_2d(cov_f))
+        distance = _cosine_distance(fake, real, self.cosine_distance_eps)
+        return jnp.where(fid > 1e-8, fid / (distance + 1e-14), jnp.zeros_like(fid))
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS (reference ``image/lpip.py:40``).
+
+    ``net`` must be a callable ``(img1, img2) -> (N,)`` per-image distances (a flax/JAX port of
+    the learned AlexNet/VGG distance, or a host callback). The reference's pretrained
+    ``net_type`` strings raise the same no-weights contract as the FID extractor.
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    jit_update = False
+
+    def __init__(
+        self,
+        net_type: Union[str, Callable[[Array, Array], Array]] = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(net_type, str):
+            valid_net_type = ("vgg", "alex", "squeeze")
+            if net_type not in valid_net_type:
+                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+            raise ModuleNotFoundError(
+                "LPIPS with a pretrained backbone requires learned weights which are not bundled in this"
+                " build. Pass `net_type` as a callable `(img1, img2) -> (N,)` distance function."
+            )
+        if not callable(net_type):
+            raise ValueError("Argument `net_type` must be a string or callable")
+        self.net = net_type
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be an bool but got {normalize}")
+        self.normalize = normalize
+        self.add_state("sum_scores", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state: Dict[str, Array], img1: Array, img2: Array) -> Dict[str, Array]:
+        if self.normalize:  # [0,1] -> [-1,1], the learned nets' expected domain (lpips.py:382-385)
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        loss = jnp.asarray(self.net(img1, img2), jnp.float32).reshape(-1)
+        return {
+            "sum_scores": state["sum_scores"] + jnp.sum(loss),
+            "total": state["total"] + loss.shape[0],
+        }
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        if self.reduction == "mean":
+            return state["sum_scores"] / state["total"]
+        return state["sum_scores"]
+
+
+def _interpolate_latents(latents1: Array, latents2: Array, epsilon: float, method: str) -> Array:
+    """Latent-path interpolation (reference ``functional/image/perceptual_path_length.py:109-152``)."""
+    eps = 1e-7
+    if latents1.shape != latents2.shape:
+        raise ValueError("Latents must have the same shape.")
+    if method == "lerp":
+        return latents1 + (latents2 - latents1) * epsilon
+    if method in ("slerp_any", "slerp_unit"):
+        l1n = latents1 / jnp.clip(jnp.linalg.norm(latents1, axis=-1, keepdims=True), eps)
+        l2n = latents2 / jnp.clip(jnp.linalg.norm(latents2, axis=-1, keepdims=True), eps)
+        d = jnp.sum(l1n * l2n, axis=-1, keepdims=True)
+        degenerate = (d > 1 - eps) | (d < -1 + eps)
+        omega = jnp.arccos(jnp.clip(d, -1.0, 1.0))
+        denom = jnp.clip(jnp.sin(omega), eps)
+        out = (jnp.sin((1 - epsilon) * omega) / denom) * latents1 + (jnp.sin(epsilon * omega) / denom) * latents2
+        lerp = latents1 + (latents2 - latents1) * epsilon
+        out = jnp.where(degenerate, lerp, out)
+        if method == "slerp_unit":
+            out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), eps)
+        return out
+    raise ValueError(f"Interpolation method {method} not supported. Choose from 'lerp', 'slerp_any', 'slerp_unit'.")
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Optional[Callable[[Array, Array], Array]] = None,
+    seed: int = 0,
+) -> Tuple[Array, Array, Array]:
+    """Perceptual path length of a generator (reference ``functional/image/perceptual_path_length.py:155``).
+
+    ``generator`` needs ``sample(num_samples) -> (N, z)`` latents and ``__call__(z[, labels])``
+    producing images scaled to [0, 255]; ``sim_net`` is a required ``(img1, img2) -> (N,)``
+    perceptual distance (the reference defaults to pretrained LPIPS-vgg, unavailable here).
+    """
+    if sim_net is None:
+        raise ModuleNotFoundError(
+            "perceptual_path_length requires a similarity net; pretrained LPIPS weights are not bundled"
+            " in this build — pass `sim_net` as a callable `(img1, img2) -> (N,)`."
+        )
+    if not hasattr(generator, "sample") or not callable(generator.sample):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(num_samples: int) -> Tensor` where the"
+            " returned tensor has shape `(num_samples, z_size)`."
+        )
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`.")
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if lower_discard is not None and not (isinstance(lower_discard, float) and 0 <= lower_discard <= 1):
+        raise ValueError(
+            f"Argument `lower_discard` must be a float between 0 and 1 or `None`, but got {lower_discard}."
+        )
+    if upper_discard is not None and not (isinstance(upper_discard, float) and 0 <= upper_discard <= 1):
+        raise ValueError(
+            f"Argument `upper_discard` must be a float between 0 and 1 or `None`, but got {upper_discard}."
+        )
+
+    rng = np.random.RandomState(seed)
+    latent1 = jnp.asarray(generator.sample(num_samples))
+    latent2 = jnp.asarray(generator.sample(num_samples))
+    latent2 = _interpolate_latents(latent1, latent2, epsilon, interpolation_method)
+    labels = jnp.asarray(rng.randint(0, generator.num_classes, (num_samples,))) if conditional else None
+
+    distances = []
+    for i in range(math.ceil(num_samples / batch_size)):
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        z = jnp.concatenate((latent1[sl], latent2[sl]), axis=0)
+        if conditional:
+            lab = jnp.concatenate((labels[sl], labels[sl]), axis=0)
+            outputs = generator(z, lab)
+        else:
+            outputs = generator(z)
+        out1, out2 = jnp.split(jnp.asarray(outputs), 2, axis=0)
+        # [0, 255] -> [-1, 1], the similarity nets' expected domain
+        sim = sim_net(2 * (out1 / 255) - 1, 2 * (out2 / 255) - 1)
+        distances.append(jnp.asarray(sim).reshape(-1) / epsilon**2)
+    dist = jnp.concatenate(distances)
+
+    lower = jnp.quantile(dist, lower_discard, method="lower") if lower_discard is not None else jnp.asarray(0.0)
+    upper = jnp.quantile(dist, upper_discard, method="lower") if upper_discard is not None else jnp.max(dist)
+    kept = dist[np.asarray((dist >= lower) & (dist <= upper))]
+    return jnp.mean(kept), jnp.std(kept, ddof=1), kept
+
+
+class PerceptualPathLength(Metric):
+    """PPL module form (reference ``image/perceptual_path_length.py:32``): compute-only metric."""
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = True  # forward() must run the overridden update() (generator capture)
+    jit_update = False
+    jit_compute = False
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 64,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        sim_net: Optional[Callable[[Array, Array], Array]] = None,
+        seed: int = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.sim_net = sim_net
+        self.seed = seed
+        self.add_state("_dummy", jnp.zeros(()), dist_reduce_fx="sum")
+        self._generator: Any = None
+
+    def _update(self, state: Dict[str, Array], generator: Any = None) -> Dict[str, Array]:
+        return {}
+
+    def update(self, generator: Any) -> None:  # noqa: D102
+        self._generator = generator
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+
+    def _compute(self, state: Dict[str, Any]):
+        return perceptual_path_length(
+            self._generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
+            seed=self.seed,
+        )

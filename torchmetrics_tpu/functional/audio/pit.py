@@ -1,0 +1,98 @@
+"""Permutation-invariant training kernels (reference ``src/torchmetrics/functional/audio/pit.py``).
+
+TPU redesign: the reference fills the speaker-pair metric matrix with an S×S Python loop of
+separate metric calls (``pit.py:190-200``) and ships large-S assignment to scipy on the host
+(``pit.py:42-66``). Here the matrix comes from ONE batched metric call over all (target, pred)
+speaker pairs folded into the batch axis, and the optimum is an exhaustive vmapped scan over the
+(static) S! permutations — a single gather + argmax program, exact for the sizes PIT is used at
+(the factorial table is static per S, so everything stays jittable).
+"""
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+_PERM_CACHE: dict = {}
+
+
+def _gen_permutations(spk_num: int) -> Array:
+    """All S! speaker permutations as a static ``(perm_num, S)`` table (reference ``pit.py:30-39``).
+
+    Cached as numpy (jnp constants created under one trace must not leak into another).
+    """
+    if spk_num not in _PERM_CACHE:
+        _PERM_CACHE[spk_num] = np.array(list(permutations(range(spk_num))), np.int32)
+    return jnp.asarray(_PERM_CACHE[spk_num])
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    mode: str = "speaker-wise",
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """PIT (reference ``pit.py:108-215``): best metric + permutation per batch element."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ["max", "min"]:
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if mode not in ["speaker-wise", "permutation-wise"]:
+        raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    batch_size, spk_num = target.shape[0:2]
+    if spk_num > 8:
+        rank_zero_warn(
+            f"Exhaustive permutation search over {spk_num}! assignments is expensive; PIT is exact"
+            " but consider fewer speakers."
+        )
+    perms = _gen_permutations(spk_num)  # (perm_num, S)
+    perm_num = perms.shape[0]
+
+    if mode == "permutation-wise":
+        # evaluate metric_func once on all permuted stacks folded into the batch axis
+        ppreds = preds[:, perms.reshape(-1)].reshape(batch_size * perm_num, *preds.shape[1:])
+        ptarget = jnp.repeat(target, perm_num, axis=0)
+        metric_of_ps = metric_func(ppreds, ptarget)
+        metric_of_ps = jnp.mean(metric_of_ps.reshape(batch_size, perm_num, -1), axis=-1)
+    else:
+        # ONE metric call over all S×S (target, pred) speaker pairs folded into the batch axis
+        rest = preds.shape[2:]
+        p = jnp.broadcast_to(preds[:, None, :], (batch_size, spk_num, spk_num, *rest))
+        t = jnp.broadcast_to(target[:, :, None], (batch_size, spk_num, spk_num, *rest))
+        flat = metric_func(p.reshape(batch_size * spk_num * spk_num, *rest),
+                           t.reshape(batch_size * spk_num * spk_num, *rest), **kwargs)
+        metric_mtx = jnp.reshape(flat, (batch_size, spk_num, spk_num))  # [b, target_idx, preds_idx]
+        # score of each permutation: mean over target_idx of mtx[target_idx, perm[target_idx]]
+        metric_of_ps = jnp.mean(
+            metric_mtx[:, jnp.arange(spk_num)[None, :], perms], axis=-1
+        ).reshape(batch_size, perm_num)
+
+    if eval_func == "max":
+        best_indexes = jnp.argmax(metric_of_ps, axis=1)
+        best_metric = jnp.max(metric_of_ps, axis=1)
+    else:
+        best_indexes = jnp.argmin(metric_of_ps, axis=1)
+        best_metric = jnp.min(metric_of_ps, axis=1)
+    best_perm = perms[best_indexes]
+    return best_metric, best_perm
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder ``preds`` speakers by the per-sample permutation (reference ``pit.py:218-229``)."""
+    preds = jnp.asarray(preds)
+    perm = jnp.asarray(perm)
+    return jnp.take_along_axis(preds, perm.reshape(*perm.shape, *([1] * (preds.ndim - 2))), axis=1)

@@ -1,0 +1,91 @@
+"""SNR-family kernels (reference ``src/torchmetrics/functional/audio/snr.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+_EPS = float(jnp.finfo(jnp.float32).eps)
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR in dB per sample over the trailing time axis (reference ``snr.py:21-63``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(jnp.square(target), axis=-1) + _EPS) / (jnp.sum(jnp.square(noise), axis=-1) + _EPS)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR in dB per sample (reference ``sdr.py:200-240``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + _EPS) / (
+        jnp.sum(jnp.square(target), axis=-1, keepdims=True) + _EPS
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(jnp.square(target_scaled), axis=-1) + _EPS) / (jnp.sum(jnp.square(noise), axis=-1) + _EPS)
+    return 10 * jnp.log10(val)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR: SI-SDR with zero-mean inputs (reference ``snr.py:66-91``)."""
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(
+    preds: Array, target: Array, zero_mean: bool = False
+) -> Array:
+    """C-SI-SNR over ``(..., freq, time, 2)`` real-view spectrograms (reference ``snr.py:94-132``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    scale_invariant: bool = True,
+    zero_mean: bool = False,
+) -> Array:
+    """SA-SDR over ``(..., spk, time)`` (reference ``sdr.py:243-330``)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    if scale_invariant:
+        alpha = (jnp.sum(preds * target, axis=(-2, -1), keepdims=True) + _EPS) / (
+            jnp.sum(jnp.square(target), axis=(-2, -1), keepdims=True) + _EPS
+        )
+        target = alpha * target
+    distortion = target - preds
+    val = (jnp.sum(jnp.square(target), axis=(-2, -1)) + _EPS) / (
+        jnp.sum(jnp.square(distortion), axis=(-2, -1)) + _EPS
+    )
+    return 10 * jnp.log10(val)

@@ -1,0 +1,370 @@
+"""Detection-domain tests: box-op formulas, COCO-mAP vs an independent numpy matcher, PQ.
+
+The mAP oracle below independently implements the published COCO evaluation protocol (greedy
+score-ordered matching at each IoU threshold, 101-point interpolated AP) in plain numpy — the
+role pycocotools plays in the reference's tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+from torchmetrics_tpu.functional.detection.iou import box_iou
+
+RNG = np.random.RandomState(33)
+
+
+def _rand_boxes(n, size=100.0):
+    xy = RNG.rand(n, 2) * size
+    wh = RNG.rand(n, 2) * size / 4 + 2
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def iou_np(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+class TestBoxOps:
+    def test_iou_vs_numpy(self):
+        a, b = _rand_boxes(7), _rand_boxes(5)
+        np.testing.assert_allclose(box_iou(jnp.asarray(a), jnp.asarray(b)), iou_np(a, b), rtol=1e-5)
+
+    def test_reference_doc_value(self):
+        preds = jnp.asarray([
+            [296.55, 93.96, 314.97, 152.79],
+            [328.94, 97.05, 342.49, 122.98],
+            [356.62, 95.47, 372.33, 147.55],
+        ])
+        target = jnp.asarray([
+            [300.00, 100.00, 315.00, 150.00],
+            [330.00, 100.00, 350.00, 125.00],
+            [350.00, 100.00, 375.00, 150.00],
+        ])
+        np.testing.assert_allclose(float(intersection_over_union(preds, target)), 0.5879, atol=1e-4)
+        # torchvision reference values for the same boxes
+        np.testing.assert_allclose(float(generalized_intersection_over_union(preds, target)), 0.5638, atol=1e-3)
+
+    def test_identical_boxes(self):
+        b = jnp.asarray(_rand_boxes(4))
+        for fn in (
+            intersection_over_union,
+            generalized_intersection_over_union,
+            distance_intersection_over_union,
+            complete_intersection_over_union,
+        ):
+            np.testing.assert_allclose(float(fn(b, b)), 1.0, atol=1e-5)
+
+    def test_ordering_properties(self):
+        # giou <= iou, diou <= iou elementwise
+        a, b = _rand_boxes(6), _rand_boxes(6)
+        iou = np.asarray(intersection_over_union(jnp.asarray(a), jnp.asarray(b), aggregate=False))
+        giou = np.asarray(generalized_intersection_over_union(jnp.asarray(a), jnp.asarray(b), aggregate=False))
+        diou = np.asarray(distance_intersection_over_union(jnp.asarray(a), jnp.asarray(b), aggregate=False))
+        assert np.all(giou <= iou + 1e-5)
+        assert np.all(diou <= iou + 1e-5)
+        assert np.all(giou >= -1 - 1e-5) and np.all(diou >= -1 - 1e-5)
+
+    def test_threshold_replacement(self):
+        a, b = _rand_boxes(4), _rand_boxes(4)
+        mat = np.asarray(
+            intersection_over_union(jnp.asarray(a), jnp.asarray(b), iou_threshold=0.9, aggregate=False)
+        )
+        raw = iou_np(a, b)
+        assert np.all(mat[raw < 0.9] == 0)
+
+
+class TestIoUModules:
+    def test_reference_doc_example(self):
+        preds = [{
+            "boxes": jnp.asarray([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": jnp.asarray([4, 5]),
+        }]
+        target = [{
+            "boxes": jnp.asarray([[300.00, 100.00, 315.00, 150.00]]),
+            "labels": jnp.asarray([5]),
+        }]
+        res = IntersectionOverUnion()(preds, target)
+        np.testing.assert_allclose(float(res["iou"]), 0.8614, atol=1e-4)
+
+    def test_class_metrics(self):
+        preds = [{
+            "boxes": jnp.asarray([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": jnp.asarray([4, 5]),
+        }]
+        target = [{
+            "boxes": jnp.asarray([[300.00, 100.00, 315.00, 150.00], [300.00, 100.00, 315.00, 150.00]]),
+            "labels": jnp.asarray([4, 5]),
+        }]
+        res = IntersectionOverUnion(class_metrics=True)(preds, target)
+        np.testing.assert_allclose(float(res["iou"]), 0.7756, atol=1e-4)
+        np.testing.assert_allclose(float(res["iou/cl_4"]), 0.6898, atol=1e-4)
+        np.testing.assert_allclose(float(res["iou/cl_5"]), 0.8614, atol=1e-4)
+
+    def test_subclasses_accumulate(self):
+        # distinct labels → respect_labels keeps only the diagonal pairs
+        boxes = _rand_boxes(5)
+        preds = [{"boxes": jnp.asarray(boxes), "labels": jnp.arange(5, dtype=jnp.int32)}]
+        target = [{"boxes": jnp.asarray(boxes), "labels": jnp.arange(5, dtype=jnp.int32)}]
+        for cls, key in (
+            (GeneralizedIntersectionOverUnion, "giou"),
+            (DistanceIntersectionOverUnion, "diou"),
+            (CompleteIntersectionOverUnion, "ciou"),
+        ):
+            m = cls()
+            m.update(preds, target)
+            m.update(preds, target)
+            np.testing.assert_allclose(float(m.compute()[key]), 1.0, atol=1e-4)
+
+    def test_xywh_format(self):
+        b_xyxy = np.asarray([[10.0, 20.0, 30.0, 50.0]], np.float32)
+        b_xywh = np.asarray([[10.0, 20.0, 20.0, 30.0]], np.float32)
+        m = IntersectionOverUnion(box_format="xywh")
+        m.update(
+            [{"boxes": jnp.asarray(b_xywh), "labels": jnp.zeros(1, jnp.int32)}],
+            [{"boxes": jnp.asarray(b_xywh), "labels": jnp.zeros(1, jnp.int32)}],
+        )
+        np.testing.assert_allclose(float(m.compute()["iou"]), 1.0, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- mAP oracle
+def _coco_ap_oracle(preds, targets, iou_thresholds, rec_thresholds, max_det=100):
+    """Independent single-area COCO mAP: greedy matching + 101-pt interpolation, all classes."""
+    classes = sorted(
+        set(np.concatenate([p["labels"] for p in preds] + [t["labels"] for t in targets]).tolist())
+    )
+    aps = []
+    for t_idx, thr in enumerate(iou_thresholds):
+        for cls in classes:
+            scores_all, matches_all = [], []
+            npig = 0
+            for p, t in zip(preds, targets):
+                dm = p["labels"] == cls
+                gm = t["labels"] == cls
+                det = p["boxes"][dm]
+                sc = p["scores"][dm]
+                gt = t["boxes"][gm]
+                npig += gt.shape[0]
+                order = np.argsort(-sc, kind="stable")[:max_det]
+                det, sc = det[order], sc[order]
+                matched = np.zeros(gt.shape[0], bool)
+                is_tp = np.zeros(det.shape[0], bool)
+                if det.shape[0] and gt.shape[0]:
+                    mat = iou_np(det, gt)
+                    for d in range(det.shape[0]):
+                        cand = np.where(~matched, mat[d], 0)
+                        m = cand.argmax() if gt.shape[0] else -1
+                        if gt.shape[0] and cand[m] > thr:
+                            matched[m] = True
+                            is_tp[d] = True
+                scores_all.append(sc)
+                matches_all.append(is_tp)
+            if npig == 0:
+                continue
+            sc = np.concatenate(scores_all)
+            tp = np.concatenate(matches_all)
+            order = np.argsort(-sc, kind="stable")
+            tp = tp[order]
+            tps = np.cumsum(tp)
+            fps = np.cumsum(~tp)
+            rc = tps / npig
+            pr = tps / (tps + fps + np.finfo(np.float64).eps)
+            pr = np.maximum.accumulate(pr[::-1])[::-1]
+            prec = np.zeros(len(rec_thresholds))
+            inds = np.searchsorted(rc, rec_thresholds, side="left")
+            valid = inds < len(rc)
+            prec[valid] = pr[inds[valid]]
+            aps.append(prec.mean())
+    return float(np.mean(aps)) if aps else -1.0
+
+
+def _make_dataset(num_imgs=4, num_classes=3, max_gt=6, noise=6.0, drop=0.3, extra=2):
+    preds, targets = [], []
+    for _ in range(num_imgs):
+        n_gt = RNG.randint(1, max_gt + 1)
+        gt_boxes = _rand_boxes(n_gt, size=400.0)
+        gt_labels = RNG.randint(0, num_classes, n_gt)
+        keep = RNG.rand(n_gt) > drop
+        det_boxes = gt_boxes[keep] + RNG.randn(keep.sum(), 4).astype(np.float32) * noise
+        det_labels = gt_labels[keep]
+        n_extra = RNG.randint(0, extra + 1)
+        det_boxes = np.concatenate([det_boxes, _rand_boxes(n_extra, size=400.0)])
+        det_labels = np.concatenate([det_labels, RNG.randint(0, num_classes, n_extra)])
+        det_scores = RNG.rand(det_boxes.shape[0]).astype(np.float32)
+        preds.append({"boxes": det_boxes.astype(np.float32), "scores": det_scores, "labels": det_labels})
+        targets.append({"boxes": gt_boxes, "labels": gt_labels})
+    return preds, targets
+
+
+class TestMeanAveragePrecision:
+    def test_reference_doc_example(self):
+        preds = [{
+            "boxes": jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+            "scores": jnp.asarray([0.536]),
+            "labels": jnp.asarray([0]),
+        }]
+        target = [{
+            "boxes": jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+            "labels": jnp.asarray([0]),
+        }]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 0.6, atol=1e-4)
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["map_75"]), 1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["map_large"]), 0.6, atol=1e-4)
+        np.testing.assert_allclose(float(res["map_small"]), -1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["mar_1"]), 0.6, atol=1e-4)
+        np.testing.assert_allclose(float(res["mar_100"]), 0.6, atol=1e-4)
+        assert int(res["classes"]) == 0
+
+    def test_perfect_detections(self):
+        boxes = _rand_boxes(5, size=300.0)
+        labels = np.arange(5) % 2
+        m = MeanAveragePrecision()
+        m.update(
+            [{"boxes": jnp.asarray(boxes), "scores": jnp.asarray(RNG.rand(5), jnp.float32),
+              "labels": jnp.asarray(labels)}],
+            [{"boxes": jnp.asarray(boxes), "labels": jnp.asarray(labels)}],
+        )
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_vs_oracle(self, seed):
+        global RNG
+        RNG = np.random.RandomState(100 + seed)
+        preds, targets = _make_dataset()
+        m = MeanAveragePrecision()
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        oracle = _coco_ap_oracle(
+            preds, targets, m.iou_thresholds, np.asarray(m.rec_thresholds), max_det=100
+        )
+        np.testing.assert_allclose(float(res["map"]), oracle, atol=1e-4)
+
+    def test_empty_preds_image(self):
+        boxes = _rand_boxes(3, size=200.0)
+        m = MeanAveragePrecision()
+        m.update(
+            [
+                {"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros((0,)), "labels": jnp.zeros((0,), jnp.int32)},
+                {"boxes": jnp.asarray(boxes), "scores": jnp.asarray([0.9, 0.8, 0.7]), "labels": jnp.zeros(3, jnp.int32)},
+            ],
+            [
+                {"boxes": jnp.asarray(boxes), "labels": jnp.zeros(3, jnp.int32)},
+                {"boxes": jnp.asarray(boxes), "labels": jnp.zeros(3, jnp.int32)},
+            ],
+        )
+        res = m.compute()
+        # half the gts are missed: recall capped at 0.5, AP = 0.5 (all found dets perfect)
+        np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-4)
+        np.testing.assert_allclose(float(res["map_50"]), 0.5, atol=2e-2)
+
+    def test_class_metrics(self):
+        preds, targets = _make_dataset(num_imgs=3, num_classes=2)
+        m = MeanAveragePrecision(class_metrics=True)
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        per_class = np.asarray(res["map_per_class"])
+        assert per_class.shape[0] == len(np.asarray(res["classes"]))
+        valid = per_class[per_class > -1]
+        np.testing.assert_allclose(valid.mean(), float(res["map"]), atol=1e-4)
+
+    def test_validation_errors(self):
+        m = MeanAveragePrecision()
+        with pytest.raises(ValueError, match="scores"):
+            m.update([{"boxes": jnp.zeros((1, 4)), "labels": jnp.zeros(1, jnp.int32)}],
+                     [{"boxes": jnp.zeros((1, 4)), "labels": jnp.zeros(1, jnp.int32)}])
+        with pytest.raises(ValueError, match="same length"):
+            m.update([], [{"boxes": jnp.zeros((1, 4)), "labels": jnp.zeros(1, jnp.int32)}])
+        with pytest.raises(ValueError, match="iou_type"):
+            MeanAveragePrecision(iou_type="segm")
+
+
+class TestPanopticQuality:
+    def test_perfect_prediction(self):
+        img = np.stack([RNG.randint(0, 3, (1, 8, 8)), RNG.randint(0, 2, (1, 8, 8))], axis=-1)
+        res = panoptic_quality(jnp.asarray(img), jnp.asarray(img), things={0, 1}, stuffs={2})
+        np.testing.assert_allclose(float(res), 1.0, atol=1e-5)
+
+    def test_reference_doc_example(self):
+        # reference functional/detection/panoptic_qualities.py:66 doctest
+        preds = jnp.asarray([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+                              [[0, 0], [0, 0], [6, 0], [0, 1]],
+                              [[0, 0], [0, 0], [6, 0], [0, 1]],
+                              [[0, 0], [7, 0], [6, 0], [1, 0]],
+                              [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+        target = jnp.asarray([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+                               [[0, 1], [0, 1], [6, 0], [0, 1]],
+                               [[0, 1], [0, 1], [6, 0], [1, 0]],
+                               [[0, 1], [7, 0], [1, 0], [1, 0]],
+                               [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+        res = panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+        np.testing.assert_allclose(float(res), 0.5463, atol=1e-4)
+
+    def test_modified_pq_doc_example(self):
+        # reference functional modified_panoptic_quality doctest (panoptic_qualities.py:161-164)
+        preds = jnp.asarray([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        target = jnp.asarray([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        res = modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+        np.testing.assert_allclose(float(res), 0.7667, atol=1e-4)
+
+    def test_class_accumulation_and_sync_states(self):
+        pred1 = np.stack([RNG.randint(0, 4, (2, 10, 10)), RNG.randint(0, 3, (2, 10, 10))], axis=-1)
+        tgt1 = np.stack([RNG.randint(0, 4, (2, 10, 10)), RNG.randint(0, 3, (2, 10, 10))], axis=-1)
+        m = PanopticQuality(things={0, 1}, stuffs={2, 3})
+        m.update(jnp.asarray(pred1), jnp.asarray(tgt1))
+        m.update(jnp.asarray(tgt1), jnp.asarray(tgt1))
+        combined = float(m.compute())
+        one = PanopticQuality(things={0, 1}, stuffs={2, 3})
+        both_p = np.concatenate([pred1, tgt1])
+        both_t = np.concatenate([tgt1, tgt1])
+        one.update(jnp.asarray(both_p), jnp.asarray(both_t))
+        np.testing.assert_allclose(combined, float(one.compute()), atol=1e-5)
+
+    def test_modified_class(self):
+        img = np.stack([RNG.randint(0, 3, (1, 6, 6)), RNG.randint(0, 2, (1, 6, 6))], axis=-1)
+        m = ModifiedPanopticQuality(things={0}, stuffs={1, 2})
+        m.update(jnp.asarray(img), jnp.asarray(img))
+        np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="distinct"):
+            PanopticQuality(things={0, 1}, stuffs={1, 2})
+        m = PanopticQuality(things={0}, stuffs={1})
+        with pytest.raises(ValueError, match="shape"):
+            m.update(jnp.zeros((1, 4, 4, 2), jnp.int32), jnp.zeros((1, 5, 4, 2), jnp.int32))
+        with pytest.raises(ValueError, match="Unknown categories"):
+            m.update(jnp.full((1, 2, 2, 2), 9, jnp.int32), jnp.zeros((1, 2, 2, 2), jnp.int32))

@@ -1,0 +1,68 @@
+"""Detection input validation (reference ``src/torchmetrics/detection/helpers.py``)."""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_arraylike(x) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray)) or hasattr(x, "shape")
+
+
+def _input_validator(
+    preds: Sequence[Dict],
+    targets: Sequence[Dict],
+    iou_type: str = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Shape/type contract for list-of-dict detection inputs (reference ``helpers.py:19-81``)."""
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    name_map = {"bbox": "boxes", "segm": "masks"}
+    if any(tp not in name_map for tp in iou_type):
+        raise Exception(f"IOU type {iou_type} is not supported")
+    item_val_name = [name_map[tp] for tp in iou_type]
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+    for k in [*item_val_name, "labels"] + (["scores"] if not ignore_score else []):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in [*item_val_name, "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    for i, item in enumerate(targets):
+        for ivn in item_val_name:
+            if jnp.shape(item[ivn])[0] != jnp.shape(item["labels"])[0]:
+                raise ValueError(
+                    f"Input '{ivn}' and labels of sample {i} in targets have a"
+                    f" different length (expected {jnp.shape(item[ivn])[0]} labels,"
+                    f" got {jnp.shape(item['labels'])[0]})"
+                )
+    if ignore_score:
+        return
+    for i, item in enumerate(preds):
+        for ivn in item_val_name:
+            if not (jnp.shape(item[ivn])[0] == jnp.shape(item["labels"])[0] == jnp.shape(item["scores"])[0]):
+                raise ValueError(
+                    f"Input '{ivn}', labels and scores of sample {i} in predictions have a"
+                    f" different length (expected {jnp.shape(item[ivn])[0]} labels and scores,"
+                    f" got {jnp.shape(item['labels'])[0]} labels and {jnp.shape(item['scores'])[0]} scores)"
+                )
+
+
+def _fix_empty_boxes(boxes) -> jnp.ndarray:
+    """Normalise empty inputs to shape (0, 4) (reference ``helpers.py:83-87``)."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if boxes.size == 0:
+        return boxes.reshape(0, 4)
+    return boxes

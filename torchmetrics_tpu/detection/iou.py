@@ -1,0 +1,137 @@
+"""IoU-family module metrics (reference ``src/torchmetrics/detection/{iou,giou,diou,ciou}.py``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_boxes, _input_validator
+from torchmetrics_tpu.functional.detection.iou import (
+    box_convert,
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class IntersectionOverUnion(Metric):
+    """IoU over matched detection/ground-truth boxes (reference ``detection/iou.py:30``).
+
+    Per-image IoU matrices have data-dependent shapes, so they live as host-side list states
+    (``dist_reduce_fx=None`` gather, like the reference); each matrix itself is one fused jnp
+    kernel.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    jit_update = False
+    jit_compute = False
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+    _pairwise_fn: Callable = staticmethod(box_iou)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self.add_state("iou_matrix", [], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
+        _input_validator(preds, target, ignore_score=True)
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            self._state.lists["groundtruth_labels"].append(jnp.asarray(t["labels"]))
+            iou_matrix = type(self)._pairwise_fn(det_boxes, gt_boxes)
+            if self.iou_threshold is not None:
+                iou_matrix = jnp.where(iou_matrix < self.iou_threshold, self._invalid_val, iou_matrix)
+            if self.respect_labels:
+                label_eq = jnp.asarray(p["labels"])[:, None] == jnp.asarray(t["labels"])[None, :]
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            self._state.lists["iou_matrix"].append(iou_matrix)
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_boxes(boxes)
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _update(self, state, *args, **kwargs):  # pragma: no cover - update() is overridden
+        raise NotImplementedError
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        mats = self._state.lists["iou_matrix"]
+        gt_labels = self._state.lists["groundtruth_labels"]
+        valid = [m[m != self._invalid_val] for m in mats]
+        flat = jnp.concatenate([v.reshape(-1) for v in valid], axis=0) if valid else jnp.zeros((0,))
+        score = jnp.mean(flat) if flat.size else jnp.asarray(0.0)
+        results = {f"{self._iou_type}": score}
+        if self.class_metrics:
+            all_labels = (
+                np.unique(np.concatenate([np.asarray(g).reshape(-1) for g in gt_labels]))
+                if gt_labels
+                else np.zeros((0,), np.int64)
+            )
+            for cl in all_labels.tolist():
+                masked_sum, observed = 0.0, 0
+                for mat, gl in zip(mats, gt_labels):
+                    scores = np.asarray(mat)[:, np.asarray(gl) == cl]
+                    sel = scores[scores != self._invalid_val]
+                    masked_sum += sel.sum()
+                    observed += sel.size
+                results[f"{self._iou_type}/cl_{cl}"] = jnp.asarray(masked_sum / observed if observed else 0.0)
+        return results
+
+    def compute(self) -> Dict[str, Array]:  # noqa: D102 - dict output, squeeze per entry
+        with self.sync_context(dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync):
+            return {k: self._squeeze_if_scalar(v) for k, v in self._compute({}).items()}
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIoU (reference ``detection/giou.py:30``)."""
+
+    _iou_type = "giou"
+    _invalid_val = -1.0
+    _pairwise_fn = staticmethod(generalized_box_iou)
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIoU (reference ``detection/diou.py:30``)."""
+
+    _iou_type = "diou"
+    _invalid_val = -1.0
+    _pairwise_fn = staticmethod(distance_box_iou)
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU (reference ``detection/ciou.py:30``)."""
+
+    _iou_type = "ciou"
+    _invalid_val = -2.0  # CIoU can be < -1 (reference ciou.py:102)
+    _pairwise_fn = staticmethod(complete_box_iou)

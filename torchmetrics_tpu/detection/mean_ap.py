@@ -1,0 +1,334 @@
+"""Mean Average Precision, COCO protocol (reference ``src/torchmetrics/detection/_mean_ap.py:148``).
+
+The reference's legacy pure-torch implementation is the parity spec (its primary path shells out
+to pycocotools C code — ``mean_ap.py:50-70`` — which this build deliberately does not depend on).
+
+TPU redesign: the reference evaluates each (image, class, area) with Python loops over
+detections and IoU thresholds (``_mean_ap.py:594-600``). Here every (image, class) group is
+padded into fixed-capacity buffers (mask, never drop) and ONE jitted matcher runs the greedy
+COCO assignment for ALL groups × 4 area ranges × T IoU thresholds in parallel — a ``lax.scan``
+over the detection axis (the only genuinely sequential dimension of the algorithm) with
+vectorised masked-argmax matching inside. Buffer sizes round up to powers of two so recompiles
+are logarithmic in dataset shape. The cheap ragged precision/recall accumulation stays in numpy.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_boxes, _input_validator
+from torchmetrics_tpu.functional.detection.iou import box_area, box_convert, box_iou
+from torchmetrics_tpu.metric import Metric
+
+_AREA_RANGES = {
+    "all": (0.0, 1e5**2),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e5**2),
+}
+
+
+@functools.partial(jax.jit, static_argnames=("num_thrs",))
+def _match_all_groups(
+    ious: Array,        # (P, D, G) pairwise IoU, det rows sorted by score desc
+    det_valid: Array,   # (P, D) bool
+    gt_valid: Array,    # (P, G) bool
+    gt_ignore: Array,   # (P, A, G) bool — outside the area range
+    thresholds: Array,  # (T,)
+    num_thrs: int,
+) -> Array:
+    """Greedy COCO matching for every (group, area, threshold) in parallel.
+
+    Ignored ground truths are never matchable (legacy-impl semantics,
+    ``_mean_ap.py:628-650``: the argmax masks them out entirely).
+    """
+    num_pairs, num_det, _ = ious.shape
+    num_areas = gt_ignore.shape[1]
+    matchable0 = gt_valid[:, None, None, :] & ~gt_ignore[:, :, None, :]  # (P, A, 1, G)
+    matchable0 = jnp.broadcast_to(matchable0, (num_pairs, num_areas, num_thrs, gt_valid.shape[1]))
+
+    def body(gt_matched, d):
+        iou_d = ious[:, d, :][:, None, None, :]  # (P, 1, 1, G)
+        masked = jnp.where(matchable0 & ~gt_matched, iou_d, 0.0)
+        m = jnp.argmax(masked, axis=-1)  # (P, A, T)
+        best = jnp.take_along_axis(masked, m[..., None], axis=-1)[..., 0]
+        ok = (best > thresholds[None, None, :]) & det_valid[:, d][:, None, None]
+        gt_matched = gt_matched | (
+            jax.nn.one_hot(m, masked.shape[-1], dtype=bool) & ok[..., None]
+        )
+        return gt_matched, ok
+
+    init = jnp.zeros(matchable0.shape, bool)
+    _, det_matches = lax.scan(body, init, jnp.arange(num_det))
+    return jnp.moveaxis(det_matches, 0, -1)  # (P, A, T, D)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 2 ** int(np.ceil(np.log2(n)))
+
+
+class MeanAveragePrecision(Metric):
+    """mAP / mAR for object detection (reference ``_mean_ap.py:148``); ``iou_type='bbox'`` only."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    jit_update = False
+    jit_compute = False
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        if iou_type != "bbox":
+            raise ValueError(
+                f"Expected argument `iou_type` to be 'bbox' but got {iou_type}; mask IoU ('segm') relies on"
+                " RLE mask encodings with no array form and is not supported in this build."
+            )
+        self.iou_type = iou_type
+        self.iou_thresholds = list(iou_thresholds or np.linspace(0.5, 0.95, 10).round(2).tolist())
+        self.rec_thresholds = list(rec_thresholds or np.linspace(0.0, 1.0, 101).round(2).tolist())
+        self.max_detection_thresholds = sorted(int(x) for x in (max_detection_thresholds or [1, 10, 100]))
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        self.add_state("detections", [], dist_reduce_fx=None)
+        self.add_state("detection_scores", [], dist_reduce_fx=None)
+        self.add_state("detection_labels", [], dist_reduce_fx=None)
+        self.add_state("groundtruths", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
+        _input_validator(preds, target, iou_type=self.iou_type)
+        for item in preds:
+            self._state.lists["detections"].append(self._get_safe_item_values(item["boxes"]))
+            self._state.lists["detection_labels"].append(jnp.asarray(item["labels"]).reshape(-1))
+            self._state.lists["detection_scores"].append(jnp.asarray(item["scores"]).reshape(-1))
+        for item in target:
+            self._state.lists["groundtruths"].append(self._get_safe_item_values(item["boxes"]))
+            self._state.lists["groundtruth_labels"].append(jnp.asarray(item["labels"]).reshape(-1))
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_boxes(boxes)
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _update(self, state, *args, **kwargs):  # pragma: no cover - update() is overridden
+        raise NotImplementedError
+
+    def _get_classes(self) -> List[int]:
+        labels = self._state.lists["detection_labels"] + self._state.lists["groundtruth_labels"]
+        if not labels:
+            return []
+        cat = np.concatenate([np.asarray(x).reshape(-1) for x in labels])
+        return np.unique(cat).astype(np.int64).tolist()
+
+    # ------------------------------------------------------------------ compute
+    def _build_groups(self, classes: List[int]):
+        """Group detections/gts per (image, class); sort dets by score desc; pad to capacity."""
+        max_det = self.max_detection_thresholds[-1]
+        dets = [np.asarray(d).reshape(-1, 4) for d in self._state.lists["detections"]]
+        det_scores = [np.asarray(s) for s in self._state.lists["detection_scores"]]
+        det_labels = [np.asarray(l) for l in self._state.lists["detection_labels"]]
+        gts = [np.asarray(g).reshape(-1, 4) for g in self._state.lists["groundtruths"]]
+        gt_labels = [np.asarray(l) for l in self._state.lists["groundtruth_labels"]]
+
+        groups = []  # (cls_idx, det boxes sorted, det scores sorted, gt boxes)
+        for cls_idx, cls in enumerate(classes):
+            for i in range(len(gts)):
+                d_mask = det_labels[i] == cls
+                g_mask = gt_labels[i] == cls
+                if not d_mask.any() and not g_mask.any():
+                    continue
+                s = det_scores[i][d_mask]
+                order = np.argsort(-s, kind="stable")[:max_det]
+                groups.append((cls_idx, dets[i][d_mask][order], s[order], gts[i][g_mask]))
+
+        if not groups:
+            return None
+        cap_d = _next_pow2(max(g[1].shape[0] for g in groups))
+        cap_g = _next_pow2(max(g[3].shape[0] for g in groups))
+        num = len(groups)
+        det_boxes = np.zeros((num, cap_d, 4), np.float32)
+        scores = np.full((num, cap_d), -np.inf, np.float32)
+        det_valid = np.zeros((num, cap_d), bool)
+        gt_boxes = np.zeros((num, cap_g, 4), np.float32)
+        gt_valid = np.zeros((num, cap_g), bool)
+        cls_of = np.empty(num, np.int64)
+        for j, (cls_idx, db, sc, gb) in enumerate(groups):
+            cls_of[j] = cls_idx
+            det_boxes[j, : db.shape[0]] = db
+            scores[j, : db.shape[0]] = sc
+            det_valid[j, : db.shape[0]] = True
+            gt_boxes[j, : gb.shape[0]] = gb
+            gt_valid[j, : gb.shape[0]] = True
+        return cls_of, det_boxes, scores, det_valid, gt_boxes, gt_valid
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        classes = self._get_classes()
+        num_t = len(self.iou_thresholds)
+        num_r = len(self.rec_thresholds)
+        num_k = len(classes)
+        num_a = len(_AREA_RANGES)
+        num_m = len(self.max_detection_thresholds)
+        precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
+        recall = -np.ones((num_t, num_k, num_a, num_m))
+
+        built = self._build_groups(classes) if classes else None
+        if built is not None:
+            cls_of, det_boxes, scores, det_valid, gt_boxes, gt_valid = built
+            # one device program: pairwise IoU + greedy matching for all groups/areas/thresholds
+            ious = box_iou(jnp.asarray(det_boxes), jnp.asarray(gt_boxes))
+            ious = jnp.where(det_valid[:, :, None] & gt_valid[:, None, :], ious, 0.0)
+            gt_areas = np.asarray(box_area(jnp.asarray(gt_boxes)))
+            det_areas = np.asarray(box_area(jnp.asarray(det_boxes)))
+            ranges = np.asarray(list(_AREA_RANGES.values()))  # (A, 2)
+            gt_ignore = (gt_areas[:, None, :] < ranges[None, :, 0:1]) | (
+                gt_areas[:, None, :] > ranges[None, :, 1:2]
+            )  # (P, A, G)
+            det_outside = (det_areas[:, None, :] < ranges[None, :, 0:1]) | (
+                det_areas[:, None, :] > ranges[None, :, 1:2]
+            )  # (P, A, D)
+            det_matches = np.asarray(
+                _match_all_groups(
+                    ious,
+                    jnp.asarray(det_valid),
+                    jnp.asarray(gt_valid),
+                    jnp.asarray(gt_ignore),
+                    jnp.asarray(self.iou_thresholds, jnp.float32),
+                    num_t,
+                )
+            )  # (P, A, T, D)
+            # unmatched detections outside the area range are ignored (_mean_ap.py:609-614)
+            det_ignore = ~det_matches & det_outside[:, :, None, :] & det_valid[:, None, None, :]
+
+            rec_thrs = np.asarray(self.rec_thresholds)
+            eps = np.finfo(np.float64).eps
+            for k in range(num_k):
+                sel = cls_of == k
+                if not sel.any():
+                    continue
+                g_scores = scores[sel]          # (Pk, D)
+                g_valid = det_valid[sel]
+                g_matches = det_matches[sel]    # (Pk, A, T, D)
+                g_ignore = det_ignore[sel]
+                g_gt_valid = gt_valid[sel]
+                g_gt_ignore = gt_ignore[sel]
+                for a in range(num_a):
+                    npig = int((g_gt_valid & ~g_gt_ignore[:, a]).sum())
+                    if npig == 0:
+                        continue
+                    for mi, max_det in enumerate(self.max_detection_thresholds):
+                        keep = g_valid[:, :max_det]  # (Pk, min(D, maxdet))
+                        flat_scores = g_scores[:, :max_det][keep]
+                        order = np.argsort(-flat_scores, kind="stable")
+                        matches = g_matches[:, a, :, :max_det]
+                        ignore = g_ignore[:, a, :, :max_det]
+                        # (T, N) in global score order
+                        tps_all = np.stack([matches[:, t][keep][order] for t in range(num_t)])
+                        ign_all = np.stack([ignore[:, t][keep][order] for t in range(num_t)])
+                        tps = tps_all & ~ign_all
+                        fps = ~tps_all & ~ign_all
+                        tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
+                        fp_sum = np.cumsum(fps, axis=1).astype(np.float64)
+                        for t in range(num_t):
+                            tp = tp_sum[t]
+                            fp = fp_sum[t]
+                            tp_len = len(tp)
+                            rc = tp / npig
+                            pr = tp / (fp + tp + eps)
+                            recall[t, k, a, mi] = rc[-1] if tp_len else 0
+                            # monotone precision envelope (the reference's zigzag loop fixpoint)
+                            pr = np.maximum.accumulate(pr[::-1])[::-1]
+                            prec = np.zeros(num_r)
+                            inds = np.searchsorted(rc, rec_thrs, side="left")
+                            num_inds = int(inds.argmax()) if (tp_len == 0 or inds.max() >= tp_len) else num_r
+                            inds = inds[:num_inds]
+                            prec[:num_inds] = pr[inds]
+                            precision[t, :, k, a, mi] = prec
+
+        results = self._summarize_results(precision, recall)
+        map_per_class = np.asarray([-1.0])
+        mar_per_class = np.asarray([-1.0])
+        if self.class_metrics and num_k:
+            maps, mars = [], []
+            for k in range(num_k):
+                cls_res = self._summarize_results(precision[:, :, k : k + 1], recall[:, k : k + 1])
+                maps.append(float(cls_res["map"]))
+                mars.append(float(cls_res[f"mar_{self.max_detection_thresholds[-1]}"]))
+            map_per_class = np.asarray(maps, np.float32)
+            mar_per_class = np.asarray(mars, np.float32)
+        results["map_per_class"] = jnp.asarray(map_per_class)
+        results[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(mar_per_class)
+        results["classes"] = jnp.asarray(np.asarray(classes, np.int32))
+        return results
+
+    def _summarize(
+        self,
+        precision: np.ndarray,
+        recall: np.ndarray,
+        avg_prec: bool,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> float:
+        """Mean over valid (> -1) entries of the requested slice (reference ``_mean_ap.py:652-696``)."""
+        a = list(_AREA_RANGES).index(area_range)
+        m = self.max_detection_thresholds.index(max_dets)
+        if avg_prec:
+            prec = precision[..., a, m]
+        else:
+            prec = recall[..., a, m]
+        if iou_threshold is not None:
+            t = self.iou_thresholds.index(iou_threshold)
+            prec = prec[t]
+        valid = prec[prec > -1]
+        return float(valid.mean()) if valid.size else -1.0
+
+    def _summarize_results(self, precision: np.ndarray, recall: np.ndarray) -> Dict[str, Array]:
+        last = self.max_detection_thresholds[-1]
+        out: Dict[str, Array] = {}
+        out["map"] = self._summarize(precision, recall, True, max_dets=last)
+        out["map_50"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.5, max_dets=last)
+            if 0.5 in self.iou_thresholds
+            else -1.0
+        )
+        out["map_75"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.75, max_dets=last)
+            if 0.75 in self.iou_thresholds
+            else -1.0
+        )
+        for area in ("small", "medium", "large"):
+            out[f"map_{area}"] = self._summarize(precision, recall, True, area_range=area, max_dets=last)
+        for max_det in self.max_detection_thresholds:
+            out[f"mar_{max_det}"] = self._summarize(precision, recall, False, max_dets=max_det)
+        for area in ("small", "medium", "large"):
+            out[f"mar_{area}"] = self._summarize(precision, recall, False, area_range=area, max_dets=last)
+        return {k: jnp.asarray(v, jnp.float32) for k, v in out.items()}
+
+    def compute(self) -> Dict[str, Array]:  # noqa: D102 - dict output, squeeze per entry
+        with self.sync_context(dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync):
+            return {k: self._squeeze_if_scalar(v) for k, v in self._compute({}).items()}

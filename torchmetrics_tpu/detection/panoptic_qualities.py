@@ -1,0 +1,87 @@
+"""PanopticQuality module metrics (reference ``src/torchmetrics/detection/panoptic_qualities.py``)."""
+from __future__ import annotations
+
+from typing import Any, Collection, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.detection.panoptic import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class PanopticQuality(Metric):
+    """PQ over (category, instance) maps (reference ``panoptic_qualities.py:36``).
+
+    Per-category IoU-sum/TP/FP/FN accumulators, all ``dist_reduce_fx="sum"`` — directly
+    ``psum``-able; segment matching runs on the host (see ``functional/detection/panoptic.py``).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    jit_update = False
+    jit_compute = True
+
+    _modified_stuffs = False
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things_p, stuffs_p = _parse_categories(things, stuffs)
+        self.things = things_p
+        self.stuffs = stuffs_p
+        self.void_color = _get_void_color(things_p, stuffs_p)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things_p, stuffs_p)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        num_categories = len(things_p) + len(stuffs_p)
+        self.add_state("iou_sum", jnp.zeros(num_categories, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+
+    def _update(self, state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
+        _validate_inputs(preds, target)
+        flat_preds = _preprocess_inputs(
+            self.things, self.stuffs, preds, self.void_color, self.allow_unknown_preds_category
+        )
+        flat_target = _preprocess_inputs(self.things, self.stuffs, target, self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            flat_preds,
+            flat_target,
+            self.cat_id_to_continuous_id,
+            self.void_color,
+            modified_metric_stuffs=self.stuffs if self._modified_stuffs else None,
+        )
+        return {
+            "iou_sum": state["iou_sum"] + iou_sum,
+            "true_positives": state["true_positives"] + tp,
+            "false_positives": state["false_positives"] + fp,
+            "false_negatives": state["false_negatives"] + fn,
+        }
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        return _panoptic_quality_compute(
+            state["iou_sum"], state["true_positives"], state["false_positives"], state["false_negatives"]
+        )
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """Modified PQ: stuff classes scored without segment matching (reference ``panoptic_qualities.py:220``)."""
+
+    _modified_stuffs = True

@@ -65,7 +65,10 @@ def perceptual_evaluation_speech_quality(
     target_np = np.asarray(target, np.float32).reshape(-1, preds.shape[-1])
     pesq_val = np.empty(preds_np.shape[0], np.float32)
     for b in range(preds_np.shape[0]):
-        pesq_val[b] = pesq_backend.pesq(fs, target_np[b], preds_np[b], mode)
+        try:
+            pesq_val[b] = pesq_backend.pesq(fs, target_np[b], preds_np[b], mode)
+        except pesq_backend.NoUtterancesError:  # silent sample → NaN (reference pesq.py:103-106)
+            pesq_val[b] = np.nan
     return jnp.asarray(pesq_val.reshape(preds.shape[:-1]))
 
 

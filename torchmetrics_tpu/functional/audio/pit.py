@@ -66,7 +66,8 @@ def permutation_invariant_training(
         # evaluate metric_func once on all permuted stacks folded into the batch axis
         ppreds = preds[:, perms.reshape(-1)].reshape(batch_size * perm_num, *preds.shape[1:])
         ptarget = jnp.repeat(target, perm_num, axis=0)
-        metric_of_ps = metric_func(ppreds, ptarget)
+        # kwargs forwarded here too (the reference drops them in this branch, pit.py:181 — a bug)
+        metric_of_ps = metric_func(ppreds, ptarget, **kwargs)
         metric_of_ps = jnp.mean(metric_of_ps.reshape(batch_size, perm_num, -1), axis=-1)
     else:
         # ONE metric call over all S×S (target, pred) speaker pairs folded into the batch axis

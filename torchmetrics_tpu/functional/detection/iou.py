@@ -1,0 +1,134 @@
+"""Box-overlap kernels: IoU / GIoU / DIoU / CIoU (reference ``src/torchmetrics/functional/detection/{iou,giou,diou,ciou}.py``).
+
+The reference delegates to torchvision's box ops; here the pairwise kernels are native jnp —
+broadcasted corner min/max and area algebra, one fused XLA program per call, batch-friendly.
+Formulas follow the published definitions (torchvision semantics, eps=1e-7 for the
+distance/complete variants).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+_EPS = 1e-7
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
+    """Convert between ``xyxy``, ``xywh`` and ``cxcywh`` box formats."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if in_fmt == out_fmt:
+        return boxes
+    if out_fmt != "xyxy":
+        raise ValueError(f"Only conversion to 'xyxy' is supported, got {out_fmt}")
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    if in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    raise ValueError(f"Unknown box format {in_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    boxes = jnp.asarray(boxes, jnp.float32)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _pairwise_inter_union(preds: Array, target: Array):
+    lt = jnp.maximum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.minimum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(preds)[..., :, None] + box_area(target)[..., None, :] - inter
+    return inter, union
+
+
+def box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise IoU matrix ``(N, M)`` for ``xyxy`` boxes."""
+    inter, union = _pairwise_inter_union(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+    return inter / union
+
+
+def generalized_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise GIoU: IoU minus the non-covered fraction of the enclosing box."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    inter, union = _pairwise_inter_union(preds, target)
+    iou = inter / union
+    lt = jnp.minimum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.maximum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    enclose = wh[..., 0] * wh[..., 1]
+    return iou - (enclose - union) / enclose
+
+
+def _diou_terms(preds: Array, target: Array):
+    """Shared DIoU geometry: (eps-stabilised iou, center-distance penalty)."""
+    inter, union = _pairwise_inter_union(preds, target)
+    iou = inter / (union + _EPS)
+    lt = jnp.minimum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.maximum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    diag_sq = jnp.square(wh[..., 0]) + jnp.square(wh[..., 1]) + _EPS
+    cp = (preds[..., :2] + preds[..., 2:]) / 2
+    ct = (target[..., :2] + target[..., 2:]) / 2
+    dist_sq = jnp.sum(jnp.square(cp[..., :, None, :] - ct[..., None, :, :]), axis=-1)
+    return iou, dist_sq / diag_sq
+
+
+def distance_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise DIoU: IoU minus normalised center distance."""
+    iou, penalty = _diou_terms(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+    return iou - penalty
+
+
+def complete_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise CIoU: DIoU minus the aspect-ratio consistency term."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    iou, penalty = _diou_terms(preds, target)
+    wp = preds[..., 2] - preds[..., 0]
+    hp = preds[..., 3] - preds[..., 1]
+    wt = target[..., 2] - target[..., 0]
+    ht = target[..., 3] - target[..., 1]
+    v = (4 / math.pi**2) * jnp.square(
+        jnp.arctan(wt / ht)[..., None, :] - jnp.arctan(wp / hp)[..., :, None]
+    )
+    alpha = v / (1 - iou + v + _EPS)
+    return iou - penalty - alpha * v
+
+
+def _masked_mean_diag(iou: Array) -> Array:
+    if iou.size == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.mean(jnp.diagonal(iou))
+
+
+def _make_functional(pairwise_fn, name: str):
+    def fn(
+        preds: Array,
+        target: Array,
+        iou_threshold: Optional[float] = None,
+        replacement_val: float = 0,
+        aggregate: bool = True,
+    ) -> Array:
+        iou = pairwise_fn(preds, target)
+        if iou_threshold is not None:
+            iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+        return _masked_mean_diag(iou) if aggregate else iou
+
+    fn.__name__ = name
+    fn.__doc__ = (
+        f"{name} over xyxy box pairs (reference ``functional/detection/``): mean of the matrix"
+        " diagonal, or the full matrix with ``aggregate=False``."
+    )
+    return fn
+
+
+intersection_over_union = _make_functional(box_iou, "intersection_over_union")
+generalized_intersection_over_union = _make_functional(generalized_box_iou, "generalized_intersection_over_union")
+distance_intersection_over_union = _make_functional(distance_box_iou, "distance_intersection_over_union")
+complete_intersection_over_union = _make_functional(complete_box_iou, "complete_intersection_over_union")

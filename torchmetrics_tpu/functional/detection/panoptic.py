@@ -1,0 +1,261 @@
+"""Panoptic Quality kernels (reference ``src/torchmetrics/functional/detection/_panoptic_quality_common.py``).
+
+Boundary decision: segment-area bookkeeping is ragged (data-dependent segment counts), so the
+per-sample matching runs as *vectorised numpy on the host* — one ``np.unique`` over fused
+(pred, target) color codes replaces the reference's Python dict-of-areas loops
+(``_panoptic_quality_common.py:50-63,313-394``) — while the per-category accumulator states stay
+``psum``-able device arrays. Input preprocessing (stuff-instance reset, void remap) is pure
+elementwise and stays in jnp.
+"""
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Reference ``_panoptic_quality_common.py:65-93``."""
+    things_parsed = set(int(t) for t in things)
+    stuffs_parsed = set(int(s) for s in stuffs)
+    if not things_parsed and not stuffs_parsed:
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    return things_parsed, stuffs_parsed
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    """An unused (category, instance) color (reference ``:124-137``)."""
+    return 1 + max([0, *things, *stuffs]), 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    """Things first, then stuffs (reference ``:139-158``)."""
+    mapping = {thing_id: idx for idx, thing_id in enumerate(things)}
+    mapping.update({stuff_id: idx + len(things) for idx, stuff_id in enumerate(stuffs)})
+    return mapping
+
+
+def _validate_inputs(preds: Array, target: Array) -> None:
+    """Reference ``:96-122``."""
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2),"
+            f" got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            f"Expected argument `preds` to have exactly 2 channels in the last dimension, got {preds.shape}"
+        )
+
+
+def _preprocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs: Array,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> Array:
+    """Flatten spatial dims, zero stuff instance ids, remap unknowns to void (reference ``:175-212``)."""
+    out = jnp.asarray(inputs, jnp.int32)
+    out = out.reshape(out.shape[0], -1, 2)
+    cats = out[:, :, 0]
+    stuffs_arr = jnp.asarray(sorted(stuffs) or [-(2**31)], jnp.int32)
+    things_arr = jnp.asarray(sorted(things) or [-(2**31)], jnp.int32)
+    mask_stuffs = jnp.any(cats[..., None] == stuffs_arr, axis=-1)
+    mask_things = jnp.any(cats[..., None] == things_arr, axis=-1)
+    known = mask_things | mask_stuffs
+    if not allow_unknown_category and not bool(jnp.all(known)):
+        raise ValueError(f"Unknown categories found: {np.unique(np.asarray(cats)[~np.asarray(known)])}")
+    inst = jnp.where(mask_stuffs, 0, out[:, :, 1])
+    cats = jnp.where(known, cats, void_color[0])
+    inst = jnp.where(known, inst, void_color[1])
+    return jnp.stack([cats, inst], axis=-1)
+
+
+def _panoptic_quality_update_sample(
+    pred: np.ndarray,
+    target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorised single-sample stat scores (reference ``:313-394``).
+
+    One ``np.unique`` over fused 64-bit (pred_cat, pred_inst, tgt_cat, tgt_inst) codes yields all
+    pairwise intersection areas; segment areas and the >0.5-IoU matching are then pure array ops.
+    """
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+
+    # fuse each (cat, inst) pair into one int64 code; raw ids can be arbitrarily large (COCO
+    # RGB-encoded instances), so codes are first compacted to dense indices via np.unique —
+    # the pair fusion below then uses a base bounded by the number of distinct colors, which
+    # cannot overflow int64
+    id_base = 1 + int(
+        max(
+            pred[:, 0].max(initial=0), pred[:, 1].max(initial=0),
+            target[:, 0].max(initial=0), target[:, 1].max(initial=0),
+            void_color[0], void_color[1],
+        )
+    )
+    p_raw = pred[:, 0].astype(np.int64) * id_base + pred[:, 1]
+    t_raw = target[:, 0].astype(np.int64) * id_base + target[:, 1]
+    void_raw = void_color[0] * id_base + void_color[1]
+    palette = np.unique(np.concatenate([p_raw, t_raw, [void_raw]]))
+    base = len(palette)  # dense color ids in [0, base)
+    cat_of_dense = palette // id_base  # original category per dense id
+    p_code = np.searchsorted(palette, p_raw)
+    t_code = np.searchsorted(palette, t_raw)
+    void_code = int(np.searchsorted(palette, void_raw))
+
+    p_colors, p_areas = np.unique(p_code, return_counts=True)
+    t_colors, t_areas = np.unique(t_code, return_counts=True)
+    pair_codes, pair_areas = np.unique(p_code * base + t_code, return_counts=True)
+    pair_p = pair_codes // base
+    pair_t = pair_codes % base
+
+    p_area_of = dict(zip(p_colors.tolist(), p_areas.tolist()))
+    t_area_of = dict(zip(t_colors.tolist(), t_areas.tolist()))
+    # void overlap per segment
+    p_void = {int(p): int(a) for p, t, a in zip(pair_p, pair_t, pair_areas) if t == void_code}
+    t_void = {int(t): int(a) for p, t, a in zip(pair_p, pair_t, pair_areas) if p == void_code}
+
+    pred_matched: set = set()
+    target_matched: set = set()
+    for p_c, t_c, inter in zip(pair_p.tolist(), pair_t.tolist(), pair_areas.tolist()):
+        if t_c == void_code or p_c == void_code:
+            continue
+        p_cat, t_cat = int(cat_of_dense[p_c]), int(cat_of_dense[t_c])
+        if p_cat != t_cat:
+            continue
+        union = (
+            p_area_of[p_c] - p_void.get(p_c, 0) + t_area_of[t_c] - t_void.get(t_c, 0) - inter
+        )
+        iou = inter / union
+        cid = cat_id_to_continuous_id[t_cat]
+        if t_cat not in stuffs_modified_metric and iou > 0.5:
+            pred_matched.add(p_c)
+            target_matched.add(t_c)
+            iou_sum[cid] += iou
+            tp[cid] += 1
+        elif t_cat in stuffs_modified_metric and iou > 0:
+            iou_sum[cid] += iou
+
+    for t_c, area in zip(t_colors.tolist(), t_areas.tolist()):
+        if t_c == void_code or t_c in target_matched:
+            continue
+        cat = int(cat_of_dense[t_c])
+        if cat in stuffs_modified_metric:
+            continue
+        if t_void.get(t_c, 0) / area <= 0.5:
+            fn[cat_id_to_continuous_id[cat]] += 1
+
+    for p_c, area in zip(p_colors.tolist(), p_areas.tolist()):
+        if p_c == void_code or p_c in pred_matched:
+            continue
+        cat = int(cat_of_dense[p_c])
+        if cat in stuffs_modified_metric:
+            continue
+        if p_void.get(p_c, 0) / area <= 0.5:
+            fp[cat_id_to_continuous_id[cat]] += 1
+
+    # modified-PQ stuffs: TP slot counts target segments (reference :383-387)
+    for t_c in t_colors.tolist():
+        if t_c == void_code:
+            continue
+        cat = int(cat_of_dense[t_c])
+        if cat in stuffs_modified_metric:
+            tp[cat_id_to_continuous_id[cat]] += 1
+
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_update(
+    flatten_preds: Array,
+    flatten_target: Array,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Batch stat scores; per-sample matching (segments never match across frames)."""
+    preds_np = np.asarray(flatten_preds)
+    target_np = np.asarray(flatten_target)
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+    for p, t in zip(preds_np, target_np):
+        r = _panoptic_quality_update_sample(
+            p, t, cat_id_to_continuous_id, void_color, stuffs_modified_metric=modified_metric_stuffs
+        )
+        iou_sum += r[0]
+        tp += r[1]
+        fp += r[2]
+        fn += r[3]
+    return (
+        jnp.asarray(iou_sum, jnp.float32),
+        jnp.asarray(tp, jnp.int32),
+        jnp.asarray(fp, jnp.int32),
+        jnp.asarray(fn, jnp.int32),
+    )
+
+
+def _panoptic_quality_compute(iou_sum: Array, tp: Array, fp: Array, fn: Array) -> Array:
+    """PQ = mean over observed categories of iou_sum / (TP + FP/2 + FN/2) (reference ``:448-470``)."""
+    denominator = jnp.asarray(tp, jnp.float32) + 0.5 * fp + 0.5 * fn
+    pq = jnp.where(denominator > 0, iou_sum / jnp.where(denominator > 0, denominator, 1.0), 0.0)
+    observed = denominator > 0
+    return jnp.sum(pq * observed) / jnp.sum(observed)
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    """PQ (reference ``functional/detection/panoptic_qualities.py:25``)."""
+    things_p, stuffs_p = _parse_categories(things, stuffs)
+    _validate_inputs(jnp.asarray(preds), jnp.asarray(target))
+    void_color = _get_void_color(things_p, stuffs_p)
+    cat_map = _get_category_id_to_continuous_id(things_p, stuffs_p)
+    fp_preds = _preprocess_inputs(things_p, stuffs_p, preds, void_color, allow_unknown_preds_category)
+    fp_target = _preprocess_inputs(things_p, stuffs_p, target, void_color, True)
+    iou_sum, tp, fps, fns = _panoptic_quality_update(fp_preds, fp_target, cat_map, void_color)
+    return _panoptic_quality_compute(iou_sum, tp, fps, fns)
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    """Modified PQ: stuff classes scored by IoU sum over target segments (reference ``panoptic_qualities.py:102``)."""
+    things_p, stuffs_p = _parse_categories(things, stuffs)
+    _validate_inputs(jnp.asarray(preds), jnp.asarray(target))
+    void_color = _get_void_color(things_p, stuffs_p)
+    cat_map = _get_category_id_to_continuous_id(things_p, stuffs_p)
+    fp_preds = _preprocess_inputs(things_p, stuffs_p, preds, void_color, allow_unknown_preds_category)
+    fp_target = _preprocess_inputs(things_p, stuffs_p, target, void_color, True)
+    iou_sum, tp, fps, fns = _panoptic_quality_update(
+        fp_preds, fp_target, cat_map, void_color, modified_metric_stuffs=stuffs_p
+    )
+    return _panoptic_quality_compute(iou_sum, tp, fps, fns)

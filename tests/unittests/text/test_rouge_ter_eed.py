@@ -1,0 +1,149 @@
+"""ROUGE / TER / EED parity tests: reference doctest golden values + hand-computed cases."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.text import extended_edit_distance, rouge_score, translation_edit_rate
+from torchmetrics_tpu.functional.text.ter import _levenshtein_with_trace
+from torchmetrics_tpu.text import ExtendedEditDistance, ROUGEScore, TranslationEditRate
+
+
+class TestRouge:
+    def test_reference_doc_example(self):
+        res = rouge_score("My name is John", "Is your name John")
+        np.testing.assert_allclose(float(res["rouge1_fmeasure"]), 0.75, atol=1e-4)
+        np.testing.assert_allclose(float(res["rouge1_precision"]), 0.75, atol=1e-4)
+        np.testing.assert_allclose(float(res["rouge2_fmeasure"]), 0.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["rougeL_fmeasure"]), 0.5, atol=1e-4)
+        np.testing.assert_allclose(float(res["rougeLsum_fmeasure"]), 0.5, atol=1e-4)
+
+    def test_identical(self):
+        res = rouge_score("the quick brown fox", "the quick brown fox")
+        for key in ("rouge1", "rouge2", "rougeL", "rougeLsum"):
+            np.testing.assert_allclose(float(res[f"{key}_fmeasure"]), 1.0, atol=1e-5)
+
+    def test_rouge_n_hand_computed(self):
+        # pred bigrams: {ab, bc}; target bigrams: {ab, bd} → 1 hit, P=R=1/2
+        res = rouge_score("a b c", "a b d", rouge_keys=("rouge2",))
+        np.testing.assert_allclose(float(res["rouge2_precision"]), 0.5, atol=1e-5)
+        np.testing.assert_allclose(float(res["rouge2_recall"]), 0.5, atol=1e-5)
+
+    def test_multi_reference_best_vs_avg(self):
+        preds = ["the cat sat"]
+        target = [["the cat sat", "a dog ran"]]
+        best = rouge_score(preds, target, accumulate="best", rouge_keys=("rouge1",))
+        avg = rouge_score(preds, target, accumulate="avg", rouge_keys=("rouge1",))
+        np.testing.assert_allclose(float(best["rouge1_fmeasure"]), 1.0, atol=1e-5)
+        np.testing.assert_allclose(float(avg["rouge1_fmeasure"]), 0.5, atol=1e-5)
+
+    def test_stemmer(self):
+        res_plain = rouge_score("jumping", "jumped", rouge_keys=("rouge1",))
+        res_stem = rouge_score("jumping", "jumped", rouge_keys=("rouge1",), use_stemmer=True)
+        assert float(res_plain["rouge1_fmeasure"]) == 0.0
+        assert float(res_stem["rouge1_fmeasure"]) == 1.0
+
+    def test_class_accumulates(self):
+        m = ROUGEScore()
+        m.update("My name is John", "Is your name John")
+        m.update("the quick brown fox", "the quick brown fox")
+        res = m.compute()
+        np.testing.assert_allclose(float(res["rouge1_fmeasure"]), (0.75 + 1.0) / 2, atol=1e-4)
+
+    def test_invalid_key_raises(self):
+        with pytest.raises(ValueError, match="unknown rouge key"):
+            rouge_score("a", "a", rouge_keys=("rouge17",))
+        with pytest.raises(ValueError, match="unknown rouge key"):
+            ROUGEScore(rouge_keys=("bad",))
+
+
+class TestTER:
+    def test_reference_doc_example(self):
+        preds = ["the cat is on the mat"]
+        target = [["there is a cat on the mat", "a cat is on the mat"]]
+        res = translation_edit_rate(preds, target)
+        np.testing.assert_allclose(float(res), 0.1538, atol=1e-4)
+
+    def test_identical_zero(self):
+        np.testing.assert_allclose(
+            float(translation_edit_rate(["a b c d"], [["a b c d"]])), 0.0, atol=1e-6
+        )
+
+    def test_substitution_rate(self):
+        # one substitution over 4 reference words
+        np.testing.assert_allclose(
+            float(translation_edit_rate(["a b c x"], [["a b c d"]])), 0.25, atol=1e-6
+        )
+
+    def test_shift_counts_one_edit(self):
+        # "b a c d" → one phrase shift matches "a b c d": TER = 1/4
+        np.testing.assert_allclose(
+            float(translation_edit_rate(["b a c d"], [["a b c d"]])), 0.25, atol=1e-6
+        )
+
+    def test_lowercase_flag(self):
+        assert float(translation_edit_rate(["A b"], [["a b"]], lowercase=True)) == 0.0
+        assert float(translation_edit_rate(["A b"], [["a b"]], lowercase=False)) == 0.5
+
+    def test_sentence_level(self):
+        res, sentences = translation_edit_rate(
+            ["a b", "a b c x"], [["a b"], ["a b c d"]], return_sentence_level_score=True
+        )
+        np.testing.assert_allclose(float(sentences[0][0]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(float(sentences[1][0]), 0.25, atol=1e-6)
+
+    def test_levenshtein_kernel(self):
+        dist, trace = _levenshtein_with_trace("kitten sitting x".split(), "kitten sat y z".split())
+        ref = 3  # sitting→sat, x→y, +z
+        assert dist == ref
+        assert len([0 for _ in trace]) >= 3
+
+    def test_class(self):
+        m = TranslationEditRate()
+        m.update(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]])
+        np.testing.assert_allclose(float(m.compute()), 0.1538, atol=1e-4)
+        m2 = TranslationEditRate(return_sentence_level_score=True)
+        m2.update(["a b c x"], [["a b c d"]])
+        score, sent = m2.compute()
+        np.testing.assert_allclose(float(score), 0.25, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sent), [0.25], atol=1e-6)
+
+
+class TestEED:
+    def test_reference_doc_example(self):
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        res = extended_edit_distance(preds=preds, target=target)
+        np.testing.assert_allclose(float(res), 0.3078, atol=1e-4)
+
+    def test_identical_small_but_nonzero(self):
+        # even identical strings score > 0: unvisited grid columns feed the coverage term
+        # (published-algorithm quirk the reference shares)
+        np.testing.assert_allclose(
+            float(extended_edit_distance(["hello world"], [["hello world"]])), 0.02256, atol=1e-4
+        )
+
+    def test_multi_reference_best(self):
+        single = extended_edit_distance(["a b c"], [["totally different text"]])
+        multi = extended_edit_distance(["a b c"], [["totally different text", "a b c"]])
+        assert float(multi) < float(single)
+        assert float(multi) < 0.1
+
+    def test_class(self):
+        m = ExtendedEditDistance()
+        m.update(["this is the prediction"], [["this is the reference"]])
+        m.update(["here is an other sample"], [["here is another one"]])
+        np.testing.assert_allclose(float(m.compute()), 0.3078, atol=1e-4)
+
+    def test_sentence_level(self):
+        m = ExtendedEditDistance(return_sentence_level_score=True)
+        m.update(["abc"], [["abc"]])
+        avg, sent = m.compute()
+        assert float(avg) < 0.2
+        assert np.asarray(sent).shape == (1,)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="language"):
+            extended_edit_distance(["a"], [["a"]], language="de")
+        with pytest.raises(ValueError, match="alpha"):
+            ExtendedEditDistance(alpha=-1.0)

@@ -16,6 +16,7 @@ from torchmetrics_tpu.functional.detection.iou import (
     generalized_box_iou,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
 
 class IntersectionOverUnion(Metric):
@@ -60,6 +61,10 @@ class IntersectionOverUnion(Metric):
         self.add_state("iou_matrix", [], dist_reduce_fx=None)
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
+            )
         _input_validator(preds, target, ignore_score=True)
         for p, t in zip(preds, target):
             det_boxes = self._get_safe_item_values(p["boxes"])

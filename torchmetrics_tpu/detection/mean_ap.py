@@ -24,6 +24,7 @@ from jax import Array, lax
 from torchmetrics_tpu.detection.helpers import _fix_empty_boxes, _input_validator
 from torchmetrics_tpu.functional.detection.iou import box_area, box_convert, box_iou
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
 _AREA_RANGES = {
     "all": (0.0, 1e5**2),
@@ -117,6 +118,10 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
+            )
         _input_validator(preds, target, iou_type=self.iou_type)
         for item in preds:
             self._state.lists["detections"].append(self._get_safe_item_values(item["boxes"]))

@@ -1,8 +1,11 @@
 """Functional text metrics (reference ``src/torchmetrics/functional/text/``)."""
 from torchmetrics_tpu.functional.text.bleu import bleu_score
 from torchmetrics_tpu.functional.text.chrf import chrf_score
+from torchmetrics_tpu.functional.text.eed import extended_edit_distance
 from torchmetrics_tpu.functional.text.edit import edit_distance
 from torchmetrics_tpu.functional.text.perplexity import perplexity
+from torchmetrics_tpu.functional.text.rouge import rouge_score
+from torchmetrics_tpu.functional.text.ter import translation_edit_rate
 from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
 from torchmetrics_tpu.functional.text.squad import squad
 from torchmetrics_tpu.functional.text.wer import (

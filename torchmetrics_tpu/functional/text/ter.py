@@ -1,0 +1,351 @@
+"""Translation Edit Rate (reference ``src/torchmetrics/functional/text/ter.py``).
+
+Clean-room Tercom: the published algorithm — greedy phrase shifts that reduce the word-level
+Levenshtein distance, with Tercom's candidate-ranking heuristics and limits (shift size ≤ 10,
+shift distance ≤ 50, ≤ 1000 candidates). The Levenshtein+trace DP runs as full-matrix numpy
+(the reference prunes with a beam and an incremental cache, ``helper.py:54-295`` — exact DP is
+simpler and differs only on degenerate inputs). Inherently sequential host string work; only
+the accumulator states live on device.
+"""
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+_MAX_SHIFT_CANDIDATES = 1000
+
+# ops for the trace; preference order on cost ties is substitution/match, then delete, then
+# insert (the flipped-trace convention of tercom/sacrebleu)
+_OP_NOTHING, _OP_SUBSTITUTE, _OP_DELETE, _OP_INSERT = 0, 1, 2, 3
+
+
+class _TercomTokenizer:
+    """Tercom normalisation rules (reference ``ter.py:57-185``, after sacrebleu's tokenizer_ter)."""
+
+    _ASIAN_PUNCTUATION = r"([、。〈-】〔-〟｡-･・])"
+    _FULL_WIDTH_PUNCTUATION = r"([．，？：；！＂（）])"
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    @lru_cache(maxsize=2**16)  # noqa: B019
+    def __call__(self, sentence: str) -> str:
+        if not sentence:
+            return ""
+        if self.lowercase:
+            sentence = sentence.lower()
+        if self.normalize:
+            sentence = self._normalize_general_and_western(sentence)
+            if self.asian_support:
+                sentence = self._normalize_asian(sentence)
+        if self.no_punctuation:
+            sentence = self._remove_punct(sentence)
+            if self.asian_support:
+                sentence = self._remove_asian_punct(sentence)
+        return " ".join(sentence.split())
+
+    @staticmethod
+    def _normalize_general_and_western(sentence: str) -> str:
+        sentence = f" {sentence} "
+        rules = [
+            (r"\n-", ""),
+            (r"\n", " "),
+            (r"&quot;", '"'),
+            (r"&amp;", "&"),
+            (r"&lt;", "<"),
+            (r"&gt;", ">"),
+            (r"([{-~[-` -&(-+:-@/])", r" \1 "),
+            (r"'s ", r" 's "),
+            (r"'s$", r" 's"),
+            (r"([^0-9])([\.,])", r"\1 \2 "),
+            (r"([\.,])([^0-9])", r" \1 \2"),
+            (r"([0-9])(-)", r"\1 \2 "),
+        ]
+        for pattern, replacement in rules:
+            sentence = re.sub(pattern, replacement, sentence)
+        return sentence
+
+    @classmethod
+    def _normalize_asian(cls, sentence: str) -> str:
+        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
+        sentence = re.sub(r"([㈀-㼢])", r" \1 ", sentence)
+        sentence = re.sub(r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r" \1 ", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
+
+    @staticmethod
+    def _remove_punct(sentence: str) -> str:
+        return re.sub(r"[\.,\?:;!\"\(\)]", "", sentence)
+
+    @classmethod
+    def _remove_asian_punct(cls, sentence: str) -> str:
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r"", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r"", sentence)
+
+
+def _validate_inputs(
+    ref_corpus: Union[Sequence[str], Sequence[Sequence[str]]],
+    hypothesis_corpus: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Normalise corpus nesting (reference ``helper.py:297-326``)."""
+    if isinstance(hypothesis_corpus, str):
+        hypothesis_corpus = [hypothesis_corpus]
+    if all(isinstance(ref, str) for ref in ref_corpus):
+        ref_corpus = [ref_corpus] if len(hypothesis_corpus) == 1 else [[ref] for ref in ref_corpus]
+    if hypothesis_corpus and all(ref for ref in ref_corpus) and len(ref_corpus) != len(hypothesis_corpus):
+        raise ValueError(f"Corpus has different size {len(ref_corpus)} != {len(hypothesis_corpus)}")
+    return ref_corpus, hypothesis_corpus
+
+
+def _levenshtein_with_trace(hyp: List[str], ref: List[str]) -> Tuple[int, List[int]]:
+    """Word Levenshtein distance + operation trace (hyp → ref), tercom tie preference."""
+    h, r = len(hyp), len(ref)
+    dist = np.zeros((h + 1, r + 1), np.int32)
+    op = np.zeros((h + 1, r + 1), np.int8)
+    dist[0, :] = np.arange(r + 1)
+    op[0, 1:] = _OP_INSERT
+    dist[1:, 0] = np.arange(1, h + 1)
+    op[1:, 0] = _OP_DELETE
+    for i in range(1, h + 1):
+        sub_cost = dist[i - 1, :-1] + (np.asarray([hyp[i - 1] != w for w in ref]) if r else 0)
+        del_cost = dist[i - 1, 1:] + 1
+        # insert chain within the row (cost +1 per step, possibly starting at column 0):
+        # dist[i, j] = cols[j] + min_{k<=j} (base[k] - cols[k]) — a prefix-min
+        base = np.minimum(sub_cost, del_cost)
+        cols = np.arange(1, r + 1)
+        chain = np.minimum.accumulate(np.concatenate(([dist[i, 0]], base - cols)))
+        dist[i, 1:] = chain[1:] + cols
+        # record ops with tie preference sub/nothing > delete > insert
+        row = dist[i, 1:]
+        is_sub = row == sub_cost
+        is_del = (row == del_cost) & ~is_sub
+        match = np.asarray([hyp[i - 1] == w for w in ref]) if r else np.zeros(0, bool)
+        op[i, 1:] = np.where(is_sub, np.where(match, _OP_NOTHING, _OP_SUBSTITUTE),
+                             np.where(is_del, _OP_DELETE, _OP_INSERT))
+    # backtrace
+    trace: List[int] = []
+    i, j = h, r
+    while i > 0 or j > 0:
+        o = int(op[i, j])
+        trace.insert(0, o)
+        if o in (_OP_NOTHING, _OP_SUBSTITUTE):
+            i -= 1
+            j -= 1
+        elif o == _OP_INSERT:
+            j -= 1
+        else:
+            i -= 1
+    return int(dist[h, r]), trace
+
+
+def _trace_to_alignment(trace: List[int]) -> Tuple[Dict[int, int], List[int], List[int]]:
+    """Alignment + error positions from a hyp→ref trace (reference ``helper.py:381-430``)."""
+    ref_pos = hyp_pos = -1
+    ref_errors: List[int] = []
+    hyp_errors: List[int] = []
+    alignments: Dict[int, int] = {}
+    for o in trace:
+        if o == _OP_NOTHING:
+            hyp_pos += 1
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(0)
+            hyp_errors.append(0)
+        elif o == _OP_SUBSTITUTE:
+            hyp_pos += 1
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(1)
+            hyp_errors.append(1)
+        elif o == _OP_INSERT:
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(1)
+        else:  # delete
+            hyp_pos += 1
+            hyp_errors.append(1)
+    return alignments, ref_errors, hyp_errors
+
+
+def _find_shifted_pairs(pred_words: List[str], target_words: List[str]) -> Iterator[Tuple[int, int, int]]:
+    """Matching word sub-sequences (reference ``ter.py:205-240``)."""
+    for pred_start in range(len(pred_words)):
+        for target_start in range(len(target_words)):
+            if abs(target_start - pred_start) > _MAX_SHIFT_DIST:
+                continue
+            for length in range(1, _MAX_SHIFT_SIZE):
+                if pred_words[pred_start + length - 1] != target_words[target_start + length - 1]:
+                    break
+                yield pred_start, target_start, length
+                if len(pred_words) == pred_start + length or len(target_words) == target_start + length:
+                    break
+
+
+def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+    """Reference ``ter.py:282-311``."""
+    if target < start:
+        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
+    if target > start + length:
+        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
+    return (
+        words[:start] + words[start + length : length + target] + words[start : start + length] + words[length + target :]
+    )
+
+
+def _shift_words(
+    pred_words: List[str],
+    target_words: List[str],
+    checked_candidates: int,
+) -> Tuple[int, List[str], int]:
+    """One round of Tercom shift search (reference ``ter.py:314-392``)."""
+    edit_distance, trace = _levenshtein_with_trace(pred_words, target_words)
+    alignments, target_errors, pred_errors = _trace_to_alignment(trace)
+
+    best: Optional[Tuple[int, int, int, int, List[str]]] = None
+    for pred_start, target_start, length in _find_shifted_pairs(pred_words, target_words):
+        # corner cases: shift must fix an error on both sides and not move within its own span
+        if sum(pred_errors[pred_start : pred_start + length]) == 0:
+            continue
+        if sum(target_errors[target_start : target_start + length]) == 0:
+            continue
+        if pred_start <= alignments[target_start] < pred_start + length:
+            continue
+
+        prev_idx = -1
+        for offset in range(-1, length):
+            if target_start + offset == -1:
+                idx = 0
+            elif target_start + offset in alignments:
+                idx = alignments[target_start + offset] + 1
+            else:
+                break
+            if idx == prev_idx:
+                continue
+            prev_idx = idx
+            shifted_words = _perform_shift(pred_words, pred_start, length, idx)
+            candidate = (
+                edit_distance - _levenshtein_with_trace(shifted_words, target_words)[0],
+                length,
+                -pred_start,
+                -idx,
+                shifted_words,
+            )
+            checked_candidates += 1
+            if not best or candidate > best:
+                best = candidate
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES:
+            break
+
+    if not best:
+        return 0, pred_words, checked_candidates
+    best_score, _, _, _, shifted_words = best
+    return best_score, shifted_words, checked_candidates
+
+
+def _translation_edit_rate(pred_words: List[str], target_words: List[str]) -> float:
+    """Edits to match one hypothesis with one reference (reference ``ter.py:395-426``)."""
+    if len(target_words) == 0:
+        return 0.0
+    num_shifts = 0
+    checked_candidates = 0
+    input_words = pred_words
+    while True:
+        delta, new_input_words, checked_candidates = _shift_words(input_words, target_words, checked_candidates)
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES or delta <= 0:
+            break
+        num_shifts += 1
+        input_words = new_input_words
+    edit_distance, _ = _levenshtein_with_trace(input_words, target_words)
+    return float(num_shifts + edit_distance)
+
+
+def _compute_sentence_statistics(
+    pred_words: List[str], target_words: List[List[str]]
+) -> Tuple[float, float]:
+    """Best edits over references + average reference length (reference ``ter.py:429-453``)."""
+    tgt_lengths = 0.0
+    best_num_edits = 2e16
+    for tgt_words in target_words:
+        num_edits = _translation_edit_rate(tgt_words, pred_words)
+        tgt_lengths += len(tgt_words)
+        if num_edits < best_num_edits:
+            best_num_edits = num_edits
+    avg_tgt_len = tgt_lengths / len(target_words)
+    return best_num_edits, avg_tgt_len
+
+
+def _compute_ter_score_from_statistics(num_edits: float, tgt_length: float) -> float:
+    """Reference ``ter.py:456-471``."""
+    if tgt_length > 0 and num_edits > 0:
+        return num_edits / tgt_length
+    if tgt_length == 0 and num_edits > 0:
+        return 1.0
+    return 0.0
+
+
+def _ter_update(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+    total_num_edits: float,
+    total_tgt_length: float,
+    sentence_ter: Optional[List[float]] = None,
+) -> Tuple[float, float, Optional[List[float]]]:
+    """Reference ``ter.py:474-517``."""
+    target, preds = _validate_inputs(target, preds)
+    for pred, tgt in zip(preds, target):
+        tgt_words_ = [tokenizer(_tgt.rstrip()).split() for _tgt in tgt]
+        pred_words_ = tokenizer(pred.rstrip()).split()
+        num_edits, tgt_length = _compute_sentence_statistics(pred_words_, tgt_words_)
+        total_num_edits += num_edits
+        total_tgt_length += tgt_length
+        if sentence_ter is not None:
+            sentence_ter.append(_compute_ter_score_from_statistics(num_edits, tgt_length))
+    return total_num_edits, total_tgt_length, sentence_ter
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+):
+    """TER (reference ``ter.py:534-600``)."""
+    for name, val in (
+        ("normalize", normalize), ("no_punctuation", no_punctuation),
+        ("lowercase", lowercase), ("asian_support", asian_support),
+    ):
+        if not isinstance(val, bool):
+            raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    sentence_ter: Optional[List[float]] = [] if return_sentence_level_score else None
+    total_num_edits, total_tgt_length, sentence_ter = _ter_update(
+        preds, target, tokenizer, 0.0, 0.0, sentence_ter
+    )
+    ter = jnp.asarray(_compute_ter_score_from_statistics(total_num_edits, total_tgt_length), jnp.float32)
+    if sentence_ter:
+        return ter, [jnp.asarray([s], jnp.float32) for s in sentence_ter]
+    return ter

@@ -4,6 +4,9 @@ from torchmetrics_tpu.text.metrics import (
     CharErrorRate,
     CHRFScore,
     EditDistance,
+    ExtendedEditDistance,
+    ROUGEScore,
+    TranslationEditRate,
     MatchErrorRate,
     Perplexity,
     SacreBLEUScore,
@@ -18,7 +21,10 @@ __all__ = [
     "CHRFScore",
     "CharErrorRate",
     "EditDistance",
+    "ExtendedEditDistance",
     "MatchErrorRate",
+    "ROUGEScore",
+    "TranslationEditRate",
     "Perplexity",
     "SQuAD",
     "SacreBLEUScore",

@@ -351,3 +351,193 @@ class SQuAD(_HostTextMetric):
 
     def _compute(self, state: Dict[str, Array]) -> Dict[str, Array]:
         return _squad_compute(state["f1_score"], state["exact_match"], state["total"])
+
+
+class ROUGEScore(_HostTextMetric):
+    """ROUGE-N / ROUGE-L / ROUGE-LSum (reference ``text/rouge.py:36``).
+
+    List states per ``{rouge_key}_{precision,recall,fmeasure}`` triple, ``dist_reduce_fx=None``
+    (reference ``text/rouge.py:143``).
+    """
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer=None,
+        tokenizer=None,
+        accumulate: str = "best",
+        rouge_keys=("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from torchmetrics_tpu.functional.text.rouge import (
+            ALLOWED_ACCUMULATE_VALUES,
+            ALLOWED_ROUGE_KEYS,
+            _stemmer_or_none,
+        )
+
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(
+                    f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}"
+                )
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[k] for k in rouge_keys]
+        self.stemmer = _stemmer_or_none(use_stemmer)
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score}", [], dist_reduce_fx=None)
+
+    def _host_update(self, preds, target) -> None:
+        from torchmetrics_tpu.functional.text.rouge import _rouge_score_update
+
+        # same nesting normalisation as functional rouge_score: a flat list of target strings is
+        # a multi-reference set when there is a single prediction (the reference module wraps by
+        # isinstance(preds, str) and silently zip-truncates for 1-element pred lists)
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        elif isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [[tgt] for tgt in target] if len(preds) > 1 else [list(target)]
+        output = _rouge_score_update(
+            preds, target, self.rouge_keys_values, accumulate=self.accumulate,
+            stemmer=self.stemmer, normalizer=self.normalizer, tokenizer=self.tokenizer,
+        )
+        for key_val, key_name in zip(self.rouge_keys_values, self.rouge_keys):
+            for metric in output[key_val]:
+                for tp, value in metric.items():
+                    self._state.lists[f"{key_name}_{tp}"].append(jnp.asarray([value], jnp.float32))
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                vals = state[f"{rouge_key}_{score}"]
+                if isinstance(vals, list):
+                    vals = dim_zero_cat(vals) if vals else jnp.zeros((0,))
+                out[f"{rouge_key}_{score}"] = jnp.mean(vals) if vals.size else jnp.asarray(0.0)
+        return out
+
+
+class TranslationEditRate(_HostTextMetric):
+    """TER (reference ``text/ter.py:30``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from torchmetrics_tpu.functional.text.ter import _TercomTokenizer
+
+        for name, val in (
+            ("normalize", normalize), ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase), ("asian_support", asian_support),
+        ):
+            if not isinstance(val, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def _host_update(self, preds, target) -> None:
+        from torchmetrics_tpu.functional.text.ter import _ter_update
+
+        sentence: Optional[list] = [] if self.return_sentence_level_score else None
+        num_edits, tgt_len, sentence = _ter_update(
+            preds, target, self.tokenizer, float(self.total_num_edits), float(self.total_tgt_len), sentence
+        )
+        t = self._state.tensors
+        t["total_num_edits"] = jnp.asarray(num_edits, jnp.float32)
+        t["total_tgt_len"] = jnp.asarray(tgt_len, jnp.float32)
+        if sentence is not None:
+            self._state.lists["sentence_ter"].extend(jnp.asarray([s], jnp.float32) for s in sentence)
+
+    def _compute(self, state: Dict[str, Any]):
+        edits = jnp.asarray(state["total_num_edits"], jnp.float32)
+        tgt_len = jnp.asarray(state["total_tgt_len"], jnp.float32)
+        # trace-safe form of _compute_ter_score_from_statistics
+        ter = jnp.where(
+            (tgt_len > 0) & (edits > 0),
+            edits / jnp.where(tgt_len > 0, tgt_len, 1.0),
+            jnp.where((tgt_len == 0) & (edits > 0), 1.0, 0.0),
+        )
+        if self.return_sentence_level_score:
+            sent = state["sentence_ter"]
+            if isinstance(sent, list):
+                sent = dim_zero_cat(sent) if sent else jnp.zeros((0,))
+            return ter, sent
+        return ter
+
+
+class ExtendedEditDistance(_HostTextMetric):
+    """EED (reference ``text/eed.py:27``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(val, float) or val < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def _host_update(self, preds, target) -> None:
+        from torchmetrics_tpu.functional.text.eed import _eed_update
+
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        self._state.lists["sentence_eed"].extend(jnp.asarray([s], jnp.float32) for s in scores)
+
+    def _compute(self, state: Dict[str, Any]):
+        sent = state["sentence_eed"]
+        if isinstance(sent, list):
+            sent = dim_zero_cat(sent) if sent else jnp.zeros((0,))
+        avg = jnp.mean(sent) if sent.size else jnp.asarray(0.0)
+        if self.return_sentence_level_score:
+            return avg, sent
+        return avg

@@ -1,0 +1,109 @@
+"""CLIP multimodal metric tests with deterministic fake encoders (no checkpoint downloads)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment, clip_score
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore
+
+RNG = np.random.RandomState(5)
+D = 8
+
+# fixed per-caption embeddings so tests can hand-compute cosines
+_TEXT_BANK = {
+    "a cat": np.eye(D)[0],
+    "a dog": np.eye(D)[1],
+    "Good photo.": np.eye(D)[2],
+    "Bad photo.": np.eye(D)[3],
+    "Sharp photo.": np.eye(D)[4],
+    "Blurry photo.": np.eye(D)[5],
+}
+
+
+def fake_image_encoder(images):
+    # embed each image by its mean intensity spread over two basis dims
+    feats = []
+    for img in images:
+        m = float(jnp.mean(jnp.asarray(img, jnp.float32)))
+        v = np.zeros(D)
+        v[0] = m
+        v[1] = 1.0 - m
+        feats.append(v)
+    return jnp.asarray(np.stack(feats), jnp.float32)
+
+
+def fake_text_encoder(texts):
+    return jnp.asarray(np.stack([_TEXT_BANK[t] for t in texts]), jnp.float32)
+
+
+ENCODERS = (fake_image_encoder, fake_text_encoder)
+
+
+class TestCLIPScore:
+    def test_functional_hand_computed(self):
+        img_bright = jnp.ones((3, 4, 4))  # mean 1 → embedding e0 → cos with "a cat" = 1
+        img_dark = jnp.zeros((3, 4, 4))  # mean 0 → embedding e1 → cos with "a dog" = 1
+        res = clip_score([img_bright, img_dark], ["a cat", "a dog"], model_name_or_path=ENCODERS)
+        np.testing.assert_allclose(float(res), 100.0, atol=1e-4)
+        res_cross = clip_score([img_bright], ["a dog"], model_name_or_path=ENCODERS)
+        np.testing.assert_allclose(float(res_cross), 0.0, atol=1e-4)
+
+    def test_module_accumulates(self):
+        m = CLIPScore(model_name_or_path=ENCODERS)
+        m.update(jnp.ones((2, 3, 4, 4)), ["a cat", "a cat"])
+        m.update(jnp.zeros((2, 3, 4, 4)), ["a dog", "a dog"])
+        np.testing.assert_allclose(float(m.compute()), 100.0, atol=1e-4)
+        assert int(m.n_samples) == 4
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError, match="same"):
+            clip_score([jnp.ones((3, 4, 4))], ["a", "b"], model_name_or_path=ENCODERS)
+
+    def test_missing_checkpoint_raises(self, monkeypatch):
+        monkeypatch.setenv("HF_HUB_OFFLINE", "1")  # fail fast instead of waiting out net timeouts
+        with pytest.raises(ModuleNotFoundError, match="callables"):
+            CLIPScore(model_name_or_path="openai/does-not-exist")
+
+
+class TestCLIPIQA:
+    def test_single_prompt(self):
+        imgs = jnp.ones((2, 3, 4, 4)) * 0.9
+        res = clip_image_quality_assessment(
+            imgs, model_name_or_path=ENCODERS, prompts=(("Good photo.", "Bad photo."),)
+        )
+        assert res.shape == (2,)
+        # image embeds on e0/e1; anchors on e2/e3 → zero logits → softmax 0.5
+        np.testing.assert_allclose(np.asarray(res), 0.5, atol=1e-4)
+
+    def test_multiple_prompts_dict(self):
+        imgs = jnp.ones((2, 3, 4, 4)) * 0.5
+        res = clip_image_quality_assessment(
+            imgs,
+            model_name_or_path=ENCODERS,
+            prompts=(("Good photo.", "Bad photo."), ("Sharp photo.", "Blurry photo.")),
+        )
+        assert set(res.keys()) == {"user_defined_0", "user_defined_1"}
+        assert res["user_defined_0"].shape == (2,)
+
+    def test_named_prompt_validation(self):
+        with pytest.raises(ValueError, match="must be one of"):
+            clip_image_quality_assessment(jnp.ones((1, 3, 4, 4)), model_name_or_path=ENCODERS, prompts=("bad_name",))
+        with pytest.raises(ValueError, match="length 2"):
+            clip_image_quality_assessment(
+                jnp.ones((1, 3, 4, 4)), model_name_or_path=ENCODERS, prompts=(("a", "b", "c"),)
+            )
+
+    def test_module(self):
+        m = CLIPImageQualityAssessment(
+            model_name_or_path=ENCODERS, prompts=(("Good photo.", "Bad photo."),)
+        )
+        m.update(jnp.ones((2, 3, 4, 4)))
+        m.update(jnp.zeros((1, 3, 4, 4)))
+        res = m.compute()
+        assert res.shape == (3,)
+
+    def test_default_checkpoint_raises(self):
+        with pytest.raises(ModuleNotFoundError, match="clip_iqa"):
+            CLIPImageQualityAssessment()

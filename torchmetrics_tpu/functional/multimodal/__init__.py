@@ -1,0 +1,4 @@
+"""Functional multimodal metrics (reference ``src/torchmetrics/functional/multimodal/``)."""
+from torchmetrics_tpu.functional.multimodal.clip import clip_image_quality_assessment, clip_score
+
+__all__ = ["clip_image_quality_assessment", "clip_score"]

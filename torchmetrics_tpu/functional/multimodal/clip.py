@@ -1,0 +1,188 @@
+"""CLIP-based multimodal metrics (reference ``src/torchmetrics/functional/multimodal/{clip_score,clip_iqa}.py``).
+
+Pluggable-encoder design (same contract as the image generative metrics): the reference
+hard-loads HuggingFace CLIP checkpoints; this build has no network egress, so the model is a
+pair of callables
+
+    ``image_encoder(images) -> (N, d)``   and   ``text_encoder(list_of_strings) -> (M, d)``
+
+— any JAX/flax CLIP port, or a host callback into transformers. Passing a HuggingFace model id
+string still works when the checkpoint is in the local cache (transformers is installed); it
+raises the reference's ``ModuleNotFoundError`` contract otherwise. All similarity math
+(normalise → cosine → softmax over prompt pairs) is jnp on device.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+EncoderPair = Tuple[Callable, Callable]
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _resolve_encoders(model_name_or_path: Union[str, EncoderPair]) -> EncoderPair:
+    """Map the model argument to (image_encoder, text_encoder) callables."""
+    if isinstance(model_name_or_path, (tuple, list)) and len(model_name_or_path) == 2 and all(
+        callable(f) for f in model_name_or_path
+    ):
+        return tuple(model_name_or_path)
+    if not isinstance(model_name_or_path, str):
+        raise ValueError(
+            "Expected `model_name_or_path` to be a HuggingFace CLIP model id or a pair of callables"
+            f" (image_encoder, text_encoder), got {model_name_or_path!r}"
+        )
+    try:
+        import torch
+        from transformers import CLIPModel, CLIPProcessor
+
+        model = CLIPModel.from_pretrained(model_name_or_path)
+        processor = CLIPProcessor.from_pretrained(model_name_or_path)
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Loading CLIP checkpoint {model_name_or_path!r} failed (no local cache and no network"
+            " egress in this build). Pass `model_name_or_path` as a pair of callables"
+            " (image_encoder, text_encoder) instead."
+        ) from err
+
+    def image_encoder(images) -> Array:
+        imgs = [torch.as_tensor(np.asarray(i)) for i in images]
+        with torch.no_grad():
+            inp = processor(images=imgs, return_tensors="pt", padding=True)
+            feats = model.get_image_features(inp["pixel_values"])
+        return jnp.asarray(feats.numpy())
+
+    def text_encoder(text: Sequence[str]) -> Array:
+        with torch.no_grad():
+            inp = processor(text=list(text), return_tensors="pt", padding=True)
+            max_pos = model.config.text_config.max_position_embeddings
+            ids = inp["input_ids"][..., :max_pos]
+            mask = inp["attention_mask"][..., :max_pos]
+            feats = model.get_text_features(ids, mask)
+        return jnp.asarray(feats.numpy())
+
+    return image_encoder, text_encoder
+
+
+def _normalize(x: Array) -> Array:
+    x = jnp.asarray(x, jnp.float32)
+    return x / jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    image_encoder: Callable,
+    text_encoder: Callable,
+) -> Tuple[Array, int]:
+    """Per-sample 100·cosine(image, caption) (reference ``clip_score.py:44-90``)."""
+    if not isinstance(images, list):
+        images = [images] if jnp.ndim(images) == 3 else list(images)
+    if not all(jnp.ndim(i) == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+    img_features = _normalize(image_encoder(images))
+    txt_features = _normalize(text_encoder(text))
+    score = 100 * jnp.sum(img_features * txt_features, axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: Union[str, EncoderPair] = "openai/clip-vit-large-patch14",
+) -> Array:
+    """CLIPScore = max(100·cos(E_I, E_C), 0) averaged over samples (reference ``clip_score.py:115``)."""
+    image_encoder, text_encoder = _resolve_encoders(model_name_or_path)
+    score, _ = _clip_score_update(images, text, image_encoder, text_encoder)
+    return jnp.maximum(jnp.mean(score), 0.0)
+
+
+def _clip_iqa_format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",)):
+    """Expand prompt keywords / custom pairs (reference ``clip_iqa.py:92-142``)."""
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple")
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if not isinstance(p, (str, tuple)):
+            raise ValueError("Argument `prompts` must be a tuple containing strings or nested tuples of strings")
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS.keys())} if not custom tuple"
+                    f" prompts, got {p}."
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        else:
+            if len(p) != 2:
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+    return prompts_names, prompts_list
+
+
+def _clip_iqa_compute(
+    img_features: Array,
+    anchors: Array,
+    prompts_names: List[str],
+    format_as_dict: bool = True,
+):
+    """Softmax over (positive, negative) anchor pairs (reference ``clip_iqa.py:202-215``)."""
+    logits_per_image = 100 * img_features @ anchors.T
+    logits = logits_per_image.reshape(logits_per_image.shape[0], -1, 2)
+    probs = jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+    probs = (probs / jnp.sum(probs, -1, keepdims=True))[:, :, 0]
+    if len(prompts_names) == 1:
+        return jnp.squeeze(probs)
+    if format_as_dict:
+        return {p: probs[:, i] for i, p in enumerate(prompts_names)}
+    return probs
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: Union[str, EncoderPair] = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+):
+    """CLIP-IQA (reference ``clip_iqa.py:218``): anchor-pair softmax probabilities per prompt."""
+    prompts_names, prompts_list = _clip_iqa_format_prompts(prompts)
+    if isinstance(model_name_or_path, str) and model_name_or_path == "clip_iqa":
+        raise ModuleNotFoundError(
+            "The 'clip_iqa' checkpoint (piq) is not bundled in this build; pass `model_name_or_path`"
+            " as (image_encoder, text_encoder) callables or a cached HuggingFace CLIP id."
+        )
+    image_encoder, text_encoder = _resolve_encoders(model_name_or_path)
+    images = jnp.asarray(images, jnp.float32) / float(data_range)
+    img_features = _normalize(image_encoder(images))
+    anchors = _normalize(text_encoder(prompts_list))
+    return _clip_iqa_compute(img_features, anchors, prompts_names)

@@ -1,0 +1,4 @@
+"""Multimodal module metrics (reference ``src/torchmetrics/multimodal/``)."""
+from torchmetrics_tpu.multimodal.clip import CLIPImageQualityAssessment, CLIPScore
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

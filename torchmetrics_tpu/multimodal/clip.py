@@ -1,0 +1,104 @@
+"""CLIP multimodal module metrics (reference ``src/torchmetrics/multimodal/{clip_score,clip_iqa}.py``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.multimodal.clip import (
+    EncoderPair,
+    _clip_iqa_compute,
+    _clip_iqa_format_prompts,
+    _clip_score_update,
+    _normalize,
+    _resolve_encoders,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class CLIPScore(Metric):
+    """CLIPScore (reference ``multimodal/clip_score.py:43``): streaming sum + count states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True  # forward() must route through the encoder-running update()
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+    jit_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, EncoderPair] = "openai/clip-vit-large-patch14",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.image_encoder, self.text_encoder = _resolve_encoders(model_name_or_path)
+        self.add_state("score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images, text) -> None:  # noqa: D102 - runs the encoders, then delegates
+        score, n = _clip_score_update(images, text, self.image_encoder, self.text_encoder)
+        super().update(jnp.sum(score), n)
+
+    def _update(self, state: Dict[str, Array], score_sum: Array, n: Array) -> Dict[str, Array]:
+        return {"score": state["score"] + score_sum, "n_samples": state["n_samples"] + n}
+
+    def _compute(self, state: Dict[str, Any]) -> Array:
+        return jnp.maximum(state["score"] / state["n_samples"], 0.0)
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA (reference ``multimodal/clip_iqa.py:56``): cat-state of per-image prompt probs."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    jit_update = False
+    jit_compute = False
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, EncoderPair] = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(data_range, (int, float)) and data_range > 0):
+            raise ValueError("Argument `data_range` should be a positive number.")
+        self.data_range = data_range
+        self.prompts_names, self.prompts_list = _clip_iqa_format_prompts(prompts)
+        if isinstance(model_name_or_path, str) and model_name_or_path == "clip_iqa":
+            raise ModuleNotFoundError(
+                "The 'clip_iqa' checkpoint (piq) is not bundled in this build; pass"
+                " `model_name_or_path` as (image_encoder, text_encoder) callables or a cached"
+                " HuggingFace CLIP id."
+            )
+        self.image_encoder, self.text_encoder = _resolve_encoders(model_name_or_path)
+        self._anchors = None
+        self.add_state("probs_list", [], dist_reduce_fx="cat")
+
+    def _anchor_vectors(self) -> Array:
+        if self._anchors is None:
+            self._anchors = _normalize(self.text_encoder(self.prompts_list))
+        return self._anchors
+
+    def update(self, images) -> None:  # noqa: D102 - runs the encoders, then delegates
+        images = jnp.asarray(images, jnp.float32) / float(self.data_range)
+        img_features = _normalize(self.image_encoder(images))
+        probs = _clip_iqa_compute(img_features, self._anchor_vectors(), self.prompts_names, format_as_dict=False)
+        super().update(jnp.atleast_2d(probs.reshape(images.shape[0], -1)))
+
+    def _update(self, state: Dict[str, Array], probs: Array) -> Dict[str, Array]:
+        return {"probs_list": probs}
+
+    def _compute(self, state: Dict[str, Any]):
+        probs = state["probs_list"]
+        if isinstance(probs, list):
+            raise RuntimeError("No images accumulated; call `update` before `compute`.")
+        if len(self.prompts_names) == 1:
+            return jnp.squeeze(probs)
+        return {p: probs[:, i] for i, p in enumerate(self.prompts_names)}

@@ -68,7 +68,9 @@ def _resolve_encoders(model_name_or_path: Union[str, EncoderPair]) -> EncoderPai
     def image_encoder(images) -> Array:
         imgs = [torch.as_tensor(np.asarray(i)) for i in images]
         with torch.no_grad():
-            inp = processor(images=imgs, return_tensors="pt", padding=True)
+            # callers (clip_iqa) already bring pixels into [0, 1] via data_range; disable the
+            # processor's own /255 rescale so values are not collapsed twice
+            inp = processor(images=imgs, return_tensors="pt", padding=True, do_rescale=False)
             feats = model.get_image_features(inp["pixel_values"])
         return jnp.asarray(feats.numpy())
 
@@ -181,8 +183,13 @@ def clip_image_quality_assessment(
             "The 'clip_iqa' checkpoint (piq) is not bundled in this build; pass `model_name_or_path`"
             " as (image_encoder, text_encoder) callables or a cached HuggingFace CLIP id."
         )
+    if not (isinstance(data_range, (int, float)) and data_range > 0):
+        raise ValueError("Argument `data_range` should be a positive number.")
+    images = jnp.asarray(images, jnp.float32)
+    if images.ndim != 4:
+        raise ValueError(f"Expected `images` to be a batched 4d tensor (N, C, H, W), got shape {images.shape}")
     image_encoder, text_encoder = _resolve_encoders(model_name_or_path)
-    images = jnp.asarray(images, jnp.float32) / float(data_range)
+    images = images / float(data_range)
     img_features = _normalize(image_encoder(images))
     anchors = _normalize(text_encoder(prompts_list))
     return _clip_iqa_compute(img_features, anchors, prompts_names)

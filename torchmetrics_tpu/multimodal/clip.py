@@ -87,7 +87,10 @@ class CLIPImageQualityAssessment(Metric):
         return self._anchors
 
     def update(self, images) -> None:  # noqa: D102 - runs the encoders, then delegates
-        images = jnp.asarray(images, jnp.float32) / float(self.data_range)
+        images = jnp.asarray(images, jnp.float32)
+        if images.ndim != 4:
+            raise ValueError(f"Expected `images` to be a batched 4d tensor (N, C, H, W), got shape {images.shape}")
+        images = images / float(self.data_range)
         img_features = _normalize(self.image_encoder(images))
         probs = _clip_iqa_compute(img_features, self._anchor_vectors(), self.prompts_names, format_as_dict=False)
         super().update(jnp.atleast_2d(probs.reshape(images.shape[0], -1)))

@@ -188,6 +188,144 @@ def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     return (n_meas - 1) / elapsed
 
 
+def bench_functional_stat_scores() -> dict:
+    """BASELINE config #2: jitted functional stat_scores/confmat/F1 sweeps over 1M samples."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.confusion_matrix import multiclass_confusion_matrix
+    from torchmetrics_tpu.functional.classification.f_beta import binary_f1_score, multiclass_f1_score
+    from torchmetrics_tpu.functional.classification.stat_scores import multiclass_stat_scores
+
+    rng = np.random.RandomState(3)
+    mc_preds = jnp.asarray(rng.randint(0, NUM_CLASSES, size=TOTAL_SAMPLES).astype(np.int32))
+    mc_target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=TOTAL_SAMPLES).astype(np.int32))
+    b_preds = jnp.asarray(rng.rand(TOTAL_SAMPLES).astype(np.float32))
+    b_target = jnp.asarray(rng.randint(0, 2, size=TOTAL_SAMPLES).astype(np.int32))
+
+    mc_args = (mc_preds, mc_target)
+    fns = {
+        "multiclass_stat_scores": (jax.jit(
+            lambda p, t: multiclass_stat_scores(p, t, NUM_CLASSES, average="macro", validate_args=False)
+        ), mc_args),
+        "multiclass_confusion_matrix": (jax.jit(
+            lambda p, t: multiclass_confusion_matrix(p, t, NUM_CLASSES, validate_args=False)
+        ), mc_args),
+        "multiclass_f1": (jax.jit(
+            lambda p, t: multiclass_f1_score(p, t, NUM_CLASSES, average="macro", validate_args=False)
+        ), mc_args),
+        "binary_f1": (jax.jit(lambda p, t: binary_f1_score(p, t, validate_args=False)), (b_preds, b_target)),
+    }
+    out = {}
+    for name, (fn, args) in fns.items():
+        jax.block_until_ready(fn(*args))  # compile
+        k, t0 = 30, time.perf_counter()
+        jax.block_until_ready([fn(*args) for _ in range(k)])
+        out[name] = k * TOTAL_SAMPLES / (time.perf_counter() - t0)
+    return {f"{n}_samples_per_sec": round(v, 0) for n, v in out.items()}
+
+
+def bench_binned_curves() -> dict:
+    """BASELINE config #3: binned AUROC / AveragePrecision over 1M samples (the flagship
+    O(N+T) searchsorted+histogram curve kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.auroc import binary_auroc, multiclass_auroc
+    from torchmetrics_tpu.functional.classification.average_precision import binary_average_precision
+
+    rng = np.random.RandomState(5)
+    b_preds = jnp.asarray(rng.rand(TOTAL_SAMPLES).astype(np.float32))
+    b_target = jnp.asarray(rng.randint(0, 2, size=TOTAL_SAMPLES).astype(np.int32))
+    mc_preds = jnp.asarray(rng.rand(TOTAL_SAMPLES // 5, NUM_CLASSES).astype(np.float32))
+    mc_target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=TOTAL_SAMPLES // 5).astype(np.int32))
+
+    fns = {
+        "binary_auroc": (
+            jax.jit(lambda p, t: binary_auroc(p, t, thresholds=200, validate_args=False)),
+            (b_preds, b_target), TOTAL_SAMPLES,
+        ),
+        "binary_ap": (
+            jax.jit(lambda p, t: binary_average_precision(p, t, thresholds=200, validate_args=False)),
+            (b_preds, b_target), TOTAL_SAMPLES,
+        ),
+        "multiclass_auroc": (
+            jax.jit(lambda p, t: multiclass_auroc(p, t, NUM_CLASSES, thresholds=200, validate_args=False)),
+            (mc_preds, mc_target), TOTAL_SAMPLES // 5,
+        ),
+    }
+    out = {}
+    for name, (fn, args, n) in fns.items():
+        jax.block_until_ready(fn(*args))
+        k, t0 = 20, time.perf_counter()
+        jax.block_until_ready([fn(*args) for _ in range(k)])
+        out[f"{name}_samples_per_sec"] = round(k * n / (time.perf_counter() - t0), 0)
+    return out
+
+
+def bench_retrieval_cat() -> dict:
+    """BASELINE config #5: RetrievalMAP/NDCG cat-state sweep, update + grouped compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+    n = 200_000
+    n_queries = 2_000
+    rng = np.random.RandomState(9)
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, size=n).astype(np.int32))
+    indexes = jnp.asarray(np.sort(rng.randint(0, n_queries, size=n)).astype(np.int32))
+    out = {}
+    for name, cls in (("retrieval_map", RetrievalMAP), ("retrieval_ndcg", RetrievalNormalizedDCG)):
+        m = cls()
+        m.update(preds, target, indexes=indexes)
+        jax.block_until_ready(m.compute())  # compile
+        k, t0 = 5, time.perf_counter()
+        for _ in range(k):
+            m.reset()
+            m.update(preds, target, indexes=indexes)
+            jax.block_until_ready(m.compute())
+        out[f"{name}_samples_per_sec"] = round(k * n / (time.perf_counter() - t0), 0)
+    return out
+
+
+def bench_sync_latency() -> dict:
+    """North-star sync latency: one full state sync (psum + all_gather) over the visible mesh."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchmetrics_tpu.parallel.sync import shard_map_unchecked, sync_state
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("dp",))
+    state = {
+        "tp": jnp.zeros((n, NUM_CLASSES), jnp.float32),
+        "cat": jnp.zeros((n * 1024,), jnp.float32),
+    }
+    fx = {"tp": "sum", "cat": "cat"}
+
+    @jax.jit
+    @shard_map_unchecked(mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    def sync(tp, cat):
+        world = sync_state({"tp": tp[0], "cat": cat}, fx, axis_name="dp")
+        return world["tp"], jnp.sum(world["cat"])
+
+    args = (
+        jax.device_put(state["tp"], NamedSharding(mesh, P("dp"))),
+        jax.device_put(state["cat"], NamedSharding(mesh, P("dp"))),
+    )
+    jax.block_until_ready(sync(*args))
+    k, t0 = 100, time.perf_counter()
+    jax.block_until_ready([sync(*args) for _ in range(k)])
+    per_sync_us = (time.perf_counter() - t0) / k * 1e6
+    return {"sync_state_latency_us": round(per_sync_us, 1), "sync_mesh_devices": n}
+
+
 def main() -> None:
     preds, target = _gen_data()
     ours = bench_ours(preds, target)
@@ -197,13 +335,31 @@ def main() -> None:
     except Exception as err:  # reference unavailable -> report absolute number only
         print(f"reference bench failed: {err!r}", file=sys.stderr)
         vs = float("nan")
+
+    extras = {}
+    for name, fn in (
+        ("functional_stat_scores", bench_functional_stat_scores),
+        ("binned_curves", bench_binned_curves),
+        ("retrieval_cat_state", bench_retrieval_cat),
+        ("sync", bench_sync_latency),
+    ):
+        try:
+            extras.update(fn())
+        except Exception as err:
+            print(f"extra bench {name} failed: {err!r}", file=sys.stderr)
+            extras[f"{name}_error"] = repr(err)
+
     print(
         json.dumps(
             {
                 "metric": "metric_updates_per_sec_1M_sample_multiclass_sweep",
                 "value": round(ours, 2),
-                "unit": "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] fused)",
+                "unit": (
+                    "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] fused;"
+                    " vs_baseline = reference torch-CPU on this host, extrapolated from a 29-update slice)"
+                ),
                 "vs_baseline": round(vs, 3) if vs == vs else None,
+                "extras": extras,
             }
         )
     )

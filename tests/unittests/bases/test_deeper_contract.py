@@ -1,0 +1,195 @@
+"""Deeper MetricTester contract sweeps: ignore_index injection, differentiability, half precision.
+
+Reference analog: ``tests/unittests/helpers/testers.py:368-522`` (dtype/differentiability hooks)
+and the ``inject_ignore_index`` sweeps used across classification tests (``testers.py:637``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+from tests.unittests.helpers.testers import MetricTester, inject_ignore_index
+from torchmetrics_tpu.classification import Accuracy, F1Score
+from torchmetrics_tpu.functional.classification.accuracy import multiclass_accuracy
+from torchmetrics_tpu.functional.classification.f_beta import multiclass_f1_score
+from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+from torchmetrics_tpu.functional.regression.mse import mean_squared_error
+from torchmetrics_tpu.functional.audio import (
+    scale_invariant_signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from torchmetrics_tpu.functional.pairwise import pairwise_cosine_similarity
+
+RNG = np.random.RandomState(77)
+NUM_CLASSES = 5
+IGNORE = -1
+
+
+class TestIgnoreIndexSweeps(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_multiclass_accuracy_ignore_index(self, average):
+        preds = RNG.randint(0, NUM_CLASSES, size=(4, 64))
+        target = inject_ignore_index(RNG.randint(0, NUM_CLASSES, size=(4, 64)), IGNORE)
+
+        def ref(p, t):
+            mask = t != IGNORE
+            if average == "micro":
+                return sk.accuracy_score(t[mask], p[mask])
+            rec = sk.recall_score(
+                t[mask], p[mask], labels=list(range(NUM_CLASSES)), average=None, zero_division=0
+            )
+            if average == "macro":
+                present = np.bincount(t[mask], minlength=NUM_CLASSES) > 0
+                return rec[present].mean()
+            weights = np.bincount(t[mask], minlength=NUM_CLASSES)
+            return (rec * weights).sum() / weights.sum()
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_accuracy,
+            ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average, "ignore_index": IGNORE},
+            atol=1e-5,
+        )
+
+    def test_multiclass_f1_ignore_index_class(self):
+        preds = RNG.randint(0, NUM_CLASSES, size=(4, 64))
+        target = inject_ignore_index(RNG.randint(0, NUM_CLASSES, size=(4, 64)), IGNORE)
+
+        def ref(p, t):
+            mask = t != IGNORE
+            return sk.f1_score(
+                t[mask], p[mask], labels=list(range(NUM_CLASSES)), average="micro", zero_division=0
+            )
+
+        self.run_class_metric_test(
+            preds,
+            target,
+            F1Score,
+            ref,
+            metric_args={
+                "task": "multiclass",
+                "num_classes": NUM_CLASSES,
+                "average": "micro",
+                "ignore_index": IGNORE,
+            },
+            atol=1e-5,
+        )
+
+    def test_all_ignored_batch(self):
+        # a batch where every sample is ignored must not corrupt the accumulated state
+        m = Accuracy(task="multiclass", num_classes=NUM_CLASSES, ignore_index=IGNORE)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 2]))
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([IGNORE, IGNORE, IGNORE]))
+        np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-6)
+
+
+class TestDifferentiability(MetricTester):
+    def test_mse(self):
+        preds = RNG.randn(32).astype(np.float32)
+        target = RNG.randn(32).astype(np.float32)
+        self.run_differentiability_test(preds, target, mean_squared_error)
+
+    def test_snr_and_si_sdr(self):
+        preds = RNG.randn(4, 256).astype(np.float32)
+        target = RNG.randn(4, 256).astype(np.float32)
+        self.run_differentiability_test(preds, target, signal_noise_ratio)
+        self.run_differentiability_test(preds, target, scale_invariant_signal_distortion_ratio)
+
+    def test_ssim(self):
+        preds = RNG.rand(2, 1, 24, 24).astype(np.float32)
+        target = RNG.rand(2, 1, 24, 24).astype(np.float32)
+        self.run_differentiability_test(
+            preds, target, structural_similarity_index_measure, metric_args={"data_range": 1.0}
+        )
+
+    def test_pairwise(self):
+        x = RNG.randn(8, 4).astype(np.float32)
+        y = RNG.randn(6, 4).astype(np.float32)
+        self.run_differentiability_test(x, y, pairwise_cosine_similarity)
+
+
+class TestHalfPrecision(MetricTester):
+    def test_mse_bf16(self):
+        preds = RNG.randn(256).astype(np.float32)
+        target = RNG.randn(256).astype(np.float32)
+        self.run_precision_test(preds, target, mean_squared_error, atol=5e-2)
+
+    def test_accuracy_logits_bf16(self):
+        logits = RNG.randn(128, NUM_CLASSES).astype(np.float32)
+        target = RNG.randint(0, NUM_CLASSES, size=128)
+        self.run_precision_test(
+            logits, target, multiclass_accuracy, metric_args={"num_classes": NUM_CLASSES}, atol=5e-2
+        )
+
+    def test_ssim_bf16(self):
+        preds = RNG.rand(2, 1, 24, 24).astype(np.float32)
+        target = RNG.rand(2, 1, 24, 24).astype(np.float32)
+        self.run_precision_test(
+            preds, target, structural_similarity_index_measure,
+            metric_args={"data_range": 1.0}, atol=5e-2,
+        )
+
+    def test_f1_fp16(self):
+        logits = RNG.randn(128, NUM_CLASSES).astype(np.float32)
+        target = RNG.randint(0, NUM_CLASSES, size=128)
+        self.run_precision_test(
+            logits, target, multiclass_f1_score,
+            metric_args={"num_classes": NUM_CLASSES}, atol=5e-2, dtype=jnp.float16,
+        )
+
+
+class TestNameKeyedGather(MetricTester):
+    def test_equal_valued_states_map_correctly(self):
+        """Regression for the value-matched fake gather: two states with identical values must
+        still sync by name (the old matcher could silently mis-map them)."""
+        from torchmetrics_tpu.metric import Metric
+
+        class TwoEqualStates(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("a", jnp.zeros(()), dist_reduce_fx="sum")
+                self.add_state("b", jnp.zeros(()), dist_reduce_fx="max")
+
+            def _update(self, state, x):
+                return {"a": state["a"] + jnp.sum(x), "b": jnp.maximum(state["b"], jnp.max(x))}
+
+            def _compute(self, state):
+                return state["a"] * 1000 + state["b"]
+
+        reps = []
+        for val in (2.0, 3.0):
+            m = TwoEqualStates()
+            m.update(jnp.asarray([val]))  # a == b == val in each replica: value-ambiguous
+            reps.append(m)
+        from tests.unittests.helpers.testers import _sync_replicas
+
+        synced = _sync_replicas(reps)
+        # sum(a) = 5, max(b) = 3 → 5003; a value-keyed gather could produce 5005 or 3003
+        np.testing.assert_allclose(float(synced), 5003.0, atol=1e-5)
+
+
+class TestProfilingUtil:
+    def test_check_forward_full_state_property(self, capsys):
+        from torchmetrics_tpu.utils.checks import check_forward_full_state_property
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        rng = np.random.RandomState(0)
+        check_forward_full_state_property(
+            MulticlassConfusionMatrix,
+            init_args={"num_classes": 3, "validate_args": False},
+            input_args={
+                "preds": jnp.asarray(rng.randint(0, 3, 50)),
+                "target": jnp.asarray(rng.randint(0, 3, 50)),
+            },
+            num_update_to_compare=(5,),
+            reps=1,
+        )
+        out = capsys.readouterr().out
+        assert "Recommended setting `full_state_update=" in out
+        assert "Fused update_batches" in out

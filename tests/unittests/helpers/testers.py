@@ -5,13 +5,20 @@ exercised per-batch via ``forward`` (checked against the reference fn on the bat
 ``compute()`` is checked against the reference fn on ALL concatenated inputs; plus clone /
 pickle / reset checks. The reference's 2-process gloo DDP test becomes an N-shard emulated sync:
 the same batches are strided across virtual replicas, per-replica metrics are synced with an
-injected gather fn, and the result must equal the reference on the full data.
+injected NAME-KEYED gather fn, and the result must equal the reference on the full data.
+
+Deeper contract pieces (reference ``testers.py:368-522,637``):
+- ``run_differentiability_test`` — ``jax.grad`` of the functional wrt preds is finite where the
+  metric declares ``is_differentiable``;
+- ``run_precision_test`` — half-precision inputs produce finite values close to the f32 result;
+- ``inject_ignore_index`` — sprinkle an ignore label into targets for ignore_index sweeps.
 """
 from __future__ import annotations
 
 import pickle
 from typing import Any, Callable, Dict, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,6 +29,15 @@ def _assert_allclose(res: Any, ref: Any, atol: float = ATOL, key: Optional[str] 
     if isinstance(res, dict):
         res = res[key] if key is not None else list(res.values())[0]
     np.testing.assert_allclose(np.asarray(res), np.asarray(ref), atol=atol, rtol=1e-5)
+
+
+def inject_ignore_index(x: np.ndarray, ignore_index: int, rate: float = 0.15, seed: int = 11) -> np.ndarray:
+    """Replace a random subset of entries with ``ignore_index`` (reference ``testers.py:637``)."""
+    rng = np.random.RandomState(seed)
+    out = x.copy()
+    mask = rng.rand(*x.shape) < rate
+    out[mask] = ignore_index
+    return out
 
 
 class MetricTester:
@@ -38,8 +54,7 @@ class MetricTester:
     ) -> None:
         metric_args = metric_args or {}
         atol = atol or self.atol
-        n_batches = preds.shape[0]
-        for i in range(min(n_batches, 2)):
+        for i in range(preds.shape[0]):  # every batch (reference checks all, testers.py:226)
             res = metric_functional(jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args)
             ref = reference_metric(preds[i], target[i])
             _assert_allclose(res, ref, atol=atol)
@@ -89,24 +104,65 @@ class MetricTester:
             synced = _sync_replicas(replicas)
             _assert_allclose(synced, total_ref, atol=atol)
 
+    def run_differentiability_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """``jax.grad`` wrt preds exists and is finite (reference ``testers.py:522``)."""
+        metric_args = metric_args or {}
+
+        def scalar_fn(p):
+            out = metric_functional(p, jnp.asarray(target), **metric_args)
+            if isinstance(out, dict):
+                out = list(out.values())[0]
+            return jnp.sum(jnp.asarray(out))
+
+        grads = jax.grad(scalar_fn)(jnp.asarray(preds, jnp.float32))
+        assert grads.shape == preds.shape
+        assert bool(jnp.all(jnp.isfinite(grads))), "non-finite gradients"
+
+    def run_precision_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: float = 1e-2,
+        dtype=jnp.bfloat16,
+    ) -> None:
+        """Half-precision inputs stay finite and near the f32 result (reference ``testers.py:454,488``)."""
+        metric_args = metric_args or {}
+        full = metric_functional(jnp.asarray(preds, jnp.float32), jnp.asarray(target), **metric_args)
+        half = metric_functional(jnp.asarray(preds).astype(dtype), jnp.asarray(target), **metric_args)
+        if isinstance(full, dict):
+            full = list(full.values())[0]
+            half = list(half.values())[0]
+        assert bool(jnp.all(jnp.isfinite(jnp.asarray(half, jnp.float32))))
+        np.testing.assert_allclose(
+            np.asarray(half, np.float32), np.asarray(full, np.float32), atol=atol, rtol=1e-2
+        )
+
 
 def _sync_replicas(replicas: Sequence) -> Any:
-    """Emulate a world of len(replicas) processes: each replica's compute() syncs against the rest."""
+    """Emulate a world of len(replicas) processes: name-keyed gather against every replica."""
     states = [rep._state.snapshot() for rep in replicas]
 
-    def fake_gather(value, group=None):
-        # identify which state entry this value belongs to by matching identity on replica 0
-        for name, v in states[0].items():
+    def fake_gather(value, group=None, name=None):
+        assert name is not None, "engine must pass the state name to the gather fn"
+        vals = []
+        for s in states:
+            v = s[name]
             if isinstance(v, list):
-                cat0 = jnp.concatenate([jnp.atleast_1d(e) for e in v], axis=0) if v else None
-                if cat0 is not None and value.shape == cat0.shape and bool(jnp.all(value == cat0)):
-                    return [
-                        jnp.concatenate([jnp.atleast_1d(e) for e in s[name]], axis=0) for s in states
-                    ]
-            else:
-                if value.shape == jnp.shape(v) and bool(jnp.all(value == v)):
-                    return [s[name] for s in states]
-        raise AssertionError("state not found during fake gather")
+                v = (
+                    jnp.concatenate([jnp.atleast_1d(e) for e in v], axis=0)
+                    if v
+                    else jnp.zeros_like(jnp.atleast_1d(value))[:0]
+                )
+            vals.append(v)
+        return vals
 
     rep0 = replicas[0]
     rep0.dist_sync_fn = fake_gather

@@ -109,7 +109,7 @@ def test_retrieval_map_grouped(empty_action):
     m = RetrievalMAP(empty_target_action=empty_action)
     # feed in 3 uneven update calls
     for sl in (slice(0, 40), slice(40, 90), slice(90, None)):
-        m.update(indexes[sl], preds[sl], target[sl])
+        m.update(preds[sl], target[sl], indexes=indexes[sl])
     ref = _loop_reference(indexes, preds, target, lambda p, t: average_precision_score(t, p), empty_action)
     np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
 
@@ -134,7 +134,7 @@ def test_retrieval_mrr_and_others_grouped():
         ),
     ]
     for metric, ref_fn in cases:
-        metric.update(indexes, preds, target)
+        metric.update(preds, target, indexes=indexes)
         ref = _loop_reference(indexes, preds, target, ref_fn)
         np.testing.assert_allclose(
             float(metric.compute()), ref, atol=1e-5, err_msg=type(metric).__name__
@@ -145,7 +145,7 @@ def test_retrieval_ndcg_grouped():
     indexes, preds, target = _make_batches()
     target = rng.randint(0, 4, len(target))  # graded
     m = RetrievalNormalizedDCG()
-    m.update(indexes, preds, target)
+    m.update(preds, target, indexes=indexes)
     ref = _loop_reference(indexes, preds, target, lambda p, t: ndcg_score(t[None], p[None]))
     np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
 
@@ -160,7 +160,7 @@ def test_retrieval_fall_out_grouped():
             return 1.0
         return irrel[np.argsort(-p)][:3].sum() / irrel.sum()
 
-    m.update(indexes, preds, target)
+    m.update(preds, target, indexes=indexes)
     vals = [fo(preds[indexes == q], target[indexes == q]) for q in np.unique(indexes)]
     np.testing.assert_allclose(float(m.compute()), np.mean(vals), atol=1e-5)
 
@@ -169,7 +169,7 @@ def test_retrieval_aggregations():
     indexes, preds, target = _make_batches()
     for agg in ("median", "min", "max"):
         m = RetrievalMAP(aggregation=agg)
-        m.update(indexes, preds, target)
+        m.update(preds, target, indexes=indexes)
         vals = np.asarray(
             [
                 average_precision_score(target[indexes == q], preds[indexes == q])
@@ -184,7 +184,7 @@ def test_retrieval_aggregations():
 def test_retrieval_recall_at_fixed_precision():
     indexes, preds, target = _make_batches()
     m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=5)
-    m.update(indexes, preds, target)
+    m.update(preds, target, indexes=indexes)
     recall, k = m.compute()
     assert 0.0 <= float(recall) <= 1.0 and 1 <= int(k) <= 5
 
@@ -195,7 +195,7 @@ def test_retrieval_errors():
     with pytest.raises(ValueError, match="top_k"):
         RetrievalPrecision(top_k=-1)
     m = RetrievalMAP(empty_target_action="error")
-    m.update(np.asarray([0, 0]), np.asarray([0.5, 0.2], np.float32), np.asarray([0, 0]))
+    m.update(np.asarray([0.5, 0.2], np.float32), np.asarray([0, 0]), indexes=np.asarray([0, 0]))
     with pytest.raises(ValueError, match="no positive target"):
         m.compute()
 
@@ -205,7 +205,7 @@ def test_retrieval_ignore_index():
     preds = np.asarray([0.9, 0.5, 0.3, 0.8, 0.4, 0.2], np.float32)
     target = np.asarray([1, -1, 0, 0, 1, -1])
     m = RetrievalMAP(ignore_index=-1)
-    m.update(indexes, preds, target)
+    m.update(preds, target, indexes=indexes)
     keep = target != -1
     ref = _loop_reference(
         indexes[keep], preds[keep], target[keep], lambda p, t: average_precision_score(t, p)

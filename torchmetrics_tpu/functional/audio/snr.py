@@ -10,7 +10,13 @@ _EPS = float(jnp.finfo(jnp.float32).eps)
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SNR in dB per sample over the trailing time axis (reference ``snr.py:21-63``)."""
+    """SNR in dB per sample over the trailing time axis (reference ``snr.py:21-63``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional.audio import signal_noise_ratio
+        >>> round(float(signal_noise_ratio([2.5, 0.0, 2.0, 8.0], [3.0, -0.5, 2.0, 7.0])), 4)
+        16.1802
+    """
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
     _check_same_shape(preds, target)

@@ -41,8 +41,13 @@ _PROMPTS: Dict[str, Tuple[str, str]] = {
 }
 
 
-def _resolve_encoders(model_name_or_path: Union[str, EncoderPair]) -> EncoderPair:
-    """Map the model argument to (image_encoder, text_encoder) callables."""
+def _resolve_encoders(model_name_or_path: Union[str, EncoderPair], rescale_uint8: bool = True) -> EncoderPair:
+    """Map the model argument to (image_encoder, text_encoder) callables.
+
+    ``rescale_uint8`` controls the HF processor's /255 rescale: clip_score feeds raw [0, 255]
+    images (keep True, the reference contract); clip_iqa pre-divides by ``data_range`` so its
+    encoder must not rescale again.
+    """
     if isinstance(model_name_or_path, (tuple, list)) and len(model_name_or_path) == 2 and all(
         callable(f) for f in model_name_or_path
     ):
@@ -68,9 +73,7 @@ def _resolve_encoders(model_name_or_path: Union[str, EncoderPair]) -> EncoderPai
     def image_encoder(images) -> Array:
         imgs = [torch.as_tensor(np.asarray(i)) for i in images]
         with torch.no_grad():
-            # callers (clip_iqa) already bring pixels into [0, 1] via data_range; disable the
-            # processor's own /255 rescale so values are not collapsed twice
-            inp = processor(images=imgs, return_tensors="pt", padding=True, do_rescale=False)
+            inp = processor(images=imgs, return_tensors="pt", padding=True, do_rescale=rescale_uint8)
             feats = model.get_image_features(inp["pixel_values"])
         return jnp.asarray(feats.numpy())
 
@@ -188,7 +191,7 @@ def clip_image_quality_assessment(
     images = jnp.asarray(images, jnp.float32)
     if images.ndim != 4:
         raise ValueError(f"Expected `images` to be a batched 4d tensor (N, C, H, W), got shape {images.shape}")
-    image_encoder, text_encoder = _resolve_encoders(model_name_or_path)
+    image_encoder, text_encoder = _resolve_encoders(model_name_or_path, rescale_uint8=False)
     images = images / float(data_range)
     img_features = _normalize(image_encoder(images))
     anchors = _normalize(text_encoder(prompts_list))

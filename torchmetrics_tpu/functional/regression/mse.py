@@ -29,7 +29,13 @@ def _mean_squared_error_compute(sum_squared_error: Array, total: Array, squared:
 def mean_squared_error(
     preds: Array, target: Array, squared: bool = True, num_outputs: int = 1
 ) -> Array:
-    """MSE (or RMSE with ``squared=False``) — reference ``mse.py:53``."""
+    """MSE (or RMSE with ``squared=False``) — reference ``mse.py:53``.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.regression.mse import mean_squared_error
+        >>> round(float(mean_squared_error([0.0, 1.0, 2.0], [0.5, 1.0, 1.5])), 6)
+        0.166667
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     sum_squared_error, total = _mean_squared_error_update(preds, target, num_outputs)

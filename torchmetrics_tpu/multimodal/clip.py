@@ -77,7 +77,7 @@ class CLIPImageQualityAssessment(Metric):
                 " `model_name_or_path` as (image_encoder, text_encoder) callables or a cached"
                 " HuggingFace CLIP id."
             )
-        self.image_encoder, self.text_encoder = _resolve_encoders(model_name_or_path)
+        self.image_encoder, self.text_encoder = _resolve_encoders(model_name_or_path, rescale_uint8=False)
         self._anchors = None
         self.add_state("probs_list", [], dist_reduce_fx="cat")
 

@@ -107,20 +107,33 @@ def process_sync(
     gather_fn: Optional[Callable] = None,
     group: Optional[str] = None,
 ) -> Dict[str, Any]:
-    """Eager cross-process sync of a state dict; identity when world size is 1."""
+    """Eager cross-process sync of a state dict; identity when world size is 1.
+
+    A ``gather_fn`` that accepts a ``name`` keyword receives the state's name — gathers are then
+    keyed by identity instead of having to match tensors by value (the reference's injected
+    test gathers need this; value matching can mis-map states that happen to be equal).
+    """
+    import inspect
+
     gather = gather_fn or gather_all_arrays
+    takes_name = False
+    try:
+        takes_name = "name" in inspect.signature(gather).parameters
+    except (TypeError, ValueError):
+        pass
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions.get(name, "sum")
+        kw = {"name": name} if takes_name else {}
         if isinstance(value, (list, tuple)):
             if len(value) == 0 and jax.process_count() == 1:
                 out[name] = list(value)
                 continue
             cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
-            gathered = gather(cat, group)
+            gathered = gather(cat, group, **kw)
             out[name] = [g for g in gathered]
         else:
-            gathered = gather(value, group)
+            gathered = gather(value, group, **kw)
             if len(gathered) == 1:
                 out[name] = gathered[0]
                 continue
@@ -142,3 +155,22 @@ def process_sync(
             else:
                 raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
     return out
+
+
+def shard_map_unchecked(mesh, in_specs, out_specs):
+    """``shard_map`` with the output-replication check disabled, across JAX versions.
+
+    all_gather(tiled) outputs ARE replicated over the gathered axis, but the varying-axes
+    inference is conservative about gathers (psum is recognised, gathers are not); the disabling
+    flag is ``check_vma`` on current JAX and ``check_rep`` on older releases.
+    """
+    import functools
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.8 JAX
+        from jax.experimental.shard_map import shard_map
+
+    flag = "check_vma" if "check_vma" in inspect.signature(shard_map).parameters else "check_rep"
+    return functools.partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{flag: False})

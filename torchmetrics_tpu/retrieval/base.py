@@ -75,11 +75,12 @@ class RetrievalMetric(Metric):
         self.add_state("preds", [], dist_reduce_fx=None)
         self.add_state("target", [], dist_reduce_fx=None)
 
-    def _validate(self, indexes, preds, target) -> None:
+    def _validate(self, preds, target, indexes=None) -> None:
         if indexes is None or preds is None or target is None:
             raise ValueError("Arguments ``indexes``, ``preds`` and ``target`` cannot be None")
 
-    def _update(self, state, indexes, preds, target):
+    def _update(self, state, preds, target, indexes=None):
+        # reference argument order (base.py:134): update(preds, target, indexes)
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,

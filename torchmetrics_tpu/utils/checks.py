@@ -85,3 +85,98 @@ def _check_retrieval_functional_inputs(
         if tv.size and (tv.max() > 1 or tv.min() < 0):
             raise ValueError("`target` must contain `binary` values")
     return preds, target
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    """Elementwise closeness over nested dict/sequence results (reference ``checks.py:614-633``)."""
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    return bool(np.allclose(np.asarray(res1), np.asarray(res2), atol=atol))
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args=None,
+    input_args=None,
+    num_update_to_compare=(10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Profile whether a metric can safely run the reduce-state ``forward`` fast path.
+
+    Analog of reference ``utilities/checks.py:636``, extended for the TPU engine (SURVEY §5):
+    besides full-state vs reduce-state ``forward`` timing/agreement, it also times the fused
+    ``update_batches`` ``lax.scan`` sweep against the per-batch ``update`` loop — the two axes a
+    metric author tunes on this engine.
+    """
+    import time
+
+    import jax
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    equal = True
+    try:  # failure usually means update needs access to the full accumulated state
+        for _ in range(num_update_to_compare[0]):
+            equal = equal and _allclose_recursive(fullstate(**input_args), partstate(**input_args))
+        res1 = fullstate.compute()
+        res2 = partstate.compute()
+        equal = equal and _allclose_recursive(res1, res2)
+    except Exception:
+        equal = False
+
+    if not equal:
+        print("Recommended setting `full_state_update=True`")
+        return
+
+    timings = np.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate((fullstate, partstate)):
+        for j, steps in enumerate(num_update_to_compare):
+            for r in range(reps):
+                metric.reset()
+                start = time.perf_counter()
+                for _ in range(steps):
+                    out = metric(**input_args)
+                jax.block_until_ready(out)
+                timings[i, j, r] = time.perf_counter() - start
+            label = "Full" if i == 0 else "Partial"
+            print(f"{label} state for {steps} steps took: {timings[i, j].mean():.4f}s")
+
+    # fused-scan sweep vs per-batch loop (engine-specific axis)
+    try:
+        stacked = {
+            k: jnp.stack([jnp.asarray(v)] * num_update_to_compare[0]) for k, v in input_args.items()
+        }
+        metric = PartState(**init_args)
+        metric.update_batches(**stacked)  # compile
+        metric.reset()
+        start = time.perf_counter()
+        metric.update_batches(**stacked)
+        jax.block_until_ready(list(metric._state.tensors.values()))
+        scan_time = time.perf_counter() - start
+        metric.reset()
+        start = time.perf_counter()
+        for _ in range(num_update_to_compare[0]):
+            metric.update(**input_args)
+        jax.block_until_ready(list(metric._state.tensors.values()))
+        loop_time = time.perf_counter() - start
+        print(
+            f"Fused update_batches for {num_update_to_compare[0]} steps took: {scan_time:.4f}s"
+            f" vs per-batch loop {loop_time:.4f}s ({loop_time / max(scan_time, 1e-9):.1f}x)"
+        )
+    except Exception as err:
+        print(f"update_batches sweep unavailable for this metric: {err!r}")
+
+    faster = bool(timings[1].sum() < timings[0].sum())
+    print(f"Recommended setting `full_state_update={not faster}`")

@@ -1,0 +1,90 @@
+"""Import the reference torchmetrics (torch backend) for direct parity oracles.
+
+The reference package needs ``lightning_utilities``, which is not in this image; this shim
+provides the four symbols the reference actually uses. Importing the reference as a TEST ORACLE
+gives the strongest parity evidence available — outputs are compared, no code is shared.
+"""
+from __future__ import annotations
+
+import importlib.util
+import sys
+import types
+from enum import Enum
+
+REFERENCE_SRC = "/root/reference/src"
+
+
+def _install_lightning_utilities_shim() -> None:
+    if "lightning_utilities" in sys.modules:
+        return
+    lu = types.ModuleType("lightning_utilities")
+    core = types.ModuleType("lightning_utilities.core")
+    imports_mod = types.ModuleType("lightning_utilities.core.imports")
+    enums_mod = types.ModuleType("lightning_utilities.core.enums")
+
+    def package_available(name: str) -> bool:
+        try:
+            return importlib.util.find_spec(name) is not None
+        except Exception:
+            return False
+
+    def compare_version(package: str, op, version: str, use_base_version: bool = False) -> bool:
+        try:
+            from packaging.version import Version
+
+            mod = __import__(package)
+            return op(Version(mod.__version__), Version(version))
+        except Exception:
+            return False
+
+    class StrEnum(str, Enum):
+        @classmethod
+        def from_str(cls, value, source="key"):
+            for st in cls:
+                if st.value.lower() == str(value).lower() or st.name.lower() == str(value).lower():
+                    return st
+            return None
+
+        @classmethod
+        def try_from_str(cls, value, source="key"):
+            return cls.from_str(value, source)
+
+        def __eq__(self, other):
+            if isinstance(other, str):
+                return self.value.lower() == other.lower()
+            return super().__eq__(other)
+
+        def __hash__(self):
+            return hash(self.value.lower())
+
+    def apply_to_collection(data, dtype, function, *args, **kwargs):
+        if isinstance(data, dtype):
+            return function(data, *args, **kwargs)
+        if isinstance(data, dict):
+            return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            out = [apply_to_collection(v, dtype, function, *args, **kwargs) for v in data]
+            return type(data)(out) if isinstance(data, tuple) else out
+        return data
+
+    imports_mod.package_available = package_available
+    imports_mod.compare_version = compare_version
+    enums_mod.StrEnum = StrEnum
+    lu.apply_to_collection = apply_to_collection
+    core.imports = imports_mod
+    core.enums = enums_mod
+    lu.core = core
+    sys.modules["lightning_utilities"] = lu
+    sys.modules["lightning_utilities.core"] = core
+    sys.modules["lightning_utilities.core.imports"] = imports_mod
+    sys.modules["lightning_utilities.core.enums"] = enums_mod
+
+
+def import_reference():
+    """Return the reference ``torchmetrics`` package (torch CPU backend)."""
+    _install_lightning_utilities_shim()
+    if REFERENCE_SRC not in sys.path:
+        sys.path.insert(0, REFERENCE_SRC)
+    import torchmetrics as ref_tm
+
+    return ref_tm

@@ -283,18 +283,6 @@ class TestDetectionParity:
         ):
             check(ours_fn(jnp.asarray(a), jnp.asarray(b), aggregate=False), ref_fn(_t(a), _t(b), aggregate=False), atol=1e-4)
 
-    def test_mean_ap_vs_reference_legacy(self):
-        from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
-
-        ref_m = RefMAP.__new__(RefMAP)  # bypass pycocotools import gate in __init__
-        try:
-            RefMAP.__init__(ref_m)
-            has_ref = True
-        except ModuleNotFoundError:
-            has_ref = False
-        if not has_ref:
-            pytest.skip("legacy reference mAP requires pycocotools at init")
-
     def test_panoptic_quality(self):
         from torchmetrics.functional.detection import panoptic_quality as ref_pq
 

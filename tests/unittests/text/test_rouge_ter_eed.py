@@ -147,3 +147,39 @@ class TestEED:
             extended_edit_distance(["a"], [["a"]], language="de")
         with pytest.raises(ValueError, match="alpha"):
             ExtendedEditDistance(alpha=-1.0)
+
+
+class TestBatchedBleuParity:
+    """The vectorised corpus counter must match the Counter-based oracle exactly."""
+
+    def test_fuzz_vs_counter_oracle(self):
+        import random
+
+        from torchmetrics_tpu.functional.text.bleu import (
+            _bleu_score_update,
+            _bleu_score_update_batched,
+        )
+
+        random.seed(3)
+
+        def rand_sentence(maxlen=12):
+            return " ".join(
+                "".join(random.choices("abcde", k=random.randint(1, 3)))
+                for _ in range(random.randint(0, maxlen))
+            )
+
+        cases = [([""], [[""]]), (["a"], [["a"]]), ([], []), (["a b"], [["a b", ""]])]
+        for _ in range(25):
+            k = random.randint(1, 12)
+            cases.append((
+                [rand_sentence(random.choice([0, 1, 2, 12])) for _ in range(k)],
+                [[rand_sentence() for _ in range(random.randint(1, 3))] for _ in range(k)],
+            ))
+        for preds, target in cases:
+            n1, d1 = np.zeros(4), np.zeros(4)
+            n2, d2 = np.zeros(4), np.zeros(4)
+            p1, t1 = _bleu_score_update(preds, target, n1, d1, 0.0, 0.0, 4)
+            p2, t2 = _bleu_score_update_batched(preds, target, n2, d2, 0.0, 0.0, 4)
+            assert p1 == p2 and t1 == t2
+            np.testing.assert_array_equal(n1, n2)
+            np.testing.assert_array_equal(d1, d2)

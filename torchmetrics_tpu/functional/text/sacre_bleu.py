@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update_batched
 
 AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
 _UNSUPPORTED_TOKENIZERS = ("ja-mecab", "ko-mecab", "flores101", "flores200")
@@ -175,7 +175,7 @@ def sacre_bleu_score(
     tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
     numerator = np.zeros(n_gram)
     denominator = np.zeros(n_gram)
-    preds_len, target_len = _bleu_score_update(
+    preds_len, target_len = _bleu_score_update_batched(
         preds, [[t] if isinstance(t, str) else t for t in target], numerator, denominator, 0.0, 0.0,
         n_gram, tokenizer,
     )

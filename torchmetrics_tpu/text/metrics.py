@@ -15,7 +15,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.functional.text._edit import edit_distance_batch
-from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update_batched, _tokenize_fn
 from torchmetrics_tpu.functional.text.chrf import (
     _chrf_score_compute,
     _chrf_score_update,
@@ -91,7 +91,7 @@ class BLEUScore(_HostTextMetric):
         target_ = [[t] if isinstance(t, str) else t for t in target]
         num = np.asarray(self._state.tensors["numerator"]).copy()
         den = np.asarray(self._state.tensors["denominator"]).copy()
-        p_len, t_len = _bleu_score_update(
+        p_len, t_len = _bleu_score_update_batched(
             preds_, target_, num, den, float(self.preds_len), float(self.target_len), self.n_gram, self._tokenizer
         )
         self._state.tensors.update(

@@ -1,0 +1,183 @@
+"""BERTScore / InfoLM tests with deterministic fake models (no checkpoint downloads)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.text.bert import _bert_score_from_embeddings, bert_score
+from torchmetrics_tpu.functional.text.infolm import _information_measure, infolm
+from torchmetrics_tpu.text import BERTScore, InfoLM
+
+RNG = np.random.RandomState(17)
+D = 16
+
+
+def fake_encoder(sentences):
+    """Deterministic per-token embeddings: token hash onto a fixed basis."""
+    toks = [s.split() for s in sentences]
+    max_len = max((len(t) for t in toks), default=1) or 1
+    emb = np.zeros((len(sentences), max_len, D), np.float32)
+    mask = np.zeros((len(sentences), max_len), np.float32)
+    for i, t in enumerate(toks):
+        for j, tok in enumerate(t):
+            rng = np.random.RandomState(abs(hash(tok)) % (2**31))
+            emb[i, j] = rng.randn(D)
+            mask[i, j] = 1.0
+    return jnp.asarray(emb), jnp.asarray(mask)
+
+
+def fake_masked_lm(sentences):
+    V = 11
+    toks = [s.split() for s in sentences]
+    max_len = max((len(t) for t in toks), default=1) or 1
+    probs = np.full((len(sentences), max_len, V), 1.0 / V, np.float32)
+    mask = np.zeros((len(sentences), max_len), np.float32)
+    for i, t in enumerate(toks):
+        for j, tok in enumerate(t):
+            onehot = np.zeros(V)
+            onehot[abs(hash(tok)) % V] = 1.0
+            probs[i, j] = 0.9 * onehot + 0.1 / V
+            mask[i, j] = 1.0
+    return jnp.asarray(probs), jnp.asarray(mask)
+
+
+class TestBERTScore:
+    def test_identical_sentences_score_one(self):
+        res = bert_score(["the cat sat"], ["the cat sat"], encoder=fake_encoder)
+        np.testing.assert_allclose(np.asarray(res["f1"]), 1.0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(res["precision"]), 1.0, atol=1e-5)
+
+    def test_partial_overlap_ordering(self):
+        high = bert_score(["the cat sat"], ["the cat ran"], encoder=fake_encoder)
+        low = bert_score(["the cat sat"], ["completely different words"], encoder=fake_encoder)
+        assert float(jnp.mean(high["f1"])) > float(jnp.mean(low["f1"]))
+
+    def test_hand_computed_precision(self):
+        # pred has 2 tokens: one exact match (cos 1), one unrelated -> precision ~ (1 + c)/2
+        emb_p, mask_p = fake_encoder(["aa bb"])
+        emb_t, mask_t = fake_encoder(["aa cc"])
+        res = _bert_score_from_embeddings(emb_p, mask_p, emb_t, mask_t)
+        p = np.asarray(res["precision"])[()]
+        e = np.asarray(emb_p[0])
+        e = e / np.linalg.norm(e, axis=-1, keepdims=True)
+        t = np.asarray(emb_t[0])
+        t = t / np.linalg.norm(t, axis=-1, keepdims=True)
+        expected = np.max(e @ t.T, axis=1).mean()
+        np.testing.assert_allclose(p, expected, atol=1e-5)
+
+    def test_module_accumulates(self):
+        m = BERTScore(encoder=fake_encoder)
+        m.update(["the cat sat"], ["the cat sat"])
+        m.update(["dogs run"], ["dogs run"])
+        res = m.compute()
+        assert res["f1"].shape == (2,)
+        np.testing.assert_allclose(np.asarray(res["f1"]), 1.0, atol=1e-5)
+        m.reset()
+        assert m._preds == []
+
+    def test_requires_model(self):
+        with pytest.raises(ModuleNotFoundError, match="encoder"):
+            bert_score(["a"], ["a"])
+        with pytest.raises(ModuleNotFoundError, match="encoder"):
+            BERTScore()
+
+    def test_length_mismatch(self):
+        with pytest.raises(ValueError, match="must match"):
+            bert_score(["a"], ["a", "b"], encoder=fake_encoder)
+
+
+KL_MEASURES = [
+    ("kl_divergence", None, None),
+    ("alpha_divergence", 0.5, None),
+    ("beta_divergence", None, 0.7),
+    ("ab_divergence", 0.5, 0.7),
+    ("renyi_divergence", 0.5, None),
+    ("l1_distance", None, None),
+    ("l2_distance", None, None),
+    ("l_infinity_distance", None, None),
+    ("fisher_rao_distance", None, None),
+]
+
+
+class TestInfoLM:
+    @pytest.mark.parametrize("measure,alpha,beta", KL_MEASURES)
+    def test_identical_is_zero(self, measure, alpha, beta):
+        res = infolm(
+            ["the cat sat"], ["the cat sat"], masked_lm=fake_masked_lm,
+            information_measure=measure, alpha=alpha, beta=beta,
+        )
+        # fisher_rao's arccos near 1 amplifies f32 rounding by sqrt(eps)
+        np.testing.assert_allclose(float(res), 0.0, atol=5e-3 if measure == "fisher_rao_distance" else 1e-4)
+
+    @pytest.mark.parametrize("measure,alpha,beta", KL_MEASURES)
+    def test_different_is_positive(self, measure, alpha, beta):
+        res = infolm(
+            ["aa bb cc"], ["dd ee ff"], masked_lm=fake_masked_lm,
+            information_measure=measure, alpha=alpha, beta=beta,
+        )
+        assert float(res) > 1e-4
+
+    def test_kl_hand_computed(self):
+        p = np.asarray([[0.7, 0.2, 0.1]])
+        q = np.asarray([[0.5, 0.3, 0.2]])
+        res = _information_measure(jnp.asarray(p), jnp.asarray(q), "kl_divergence", None, None)
+        expected = np.sum(p * (np.log(p) - np.log(q)))
+        np.testing.assert_allclose(np.asarray(res), [expected], atol=1e-6)
+
+    def test_sentence_level(self):
+        corpus, sent = infolm(
+            ["a b", "c d"], ["a b", "x y"], masked_lm=fake_masked_lm, return_sentence_level_score=True
+        )
+        assert sent.shape == (2,)
+        assert float(sent[0]) < float(sent[1])
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="information_measure"):
+            infolm(["a"], ["a"], masked_lm=fake_masked_lm, information_measure="bogus")
+        with pytest.raises(ValueError, match="alpha"):
+            InfoLM(masked_lm=fake_masked_lm, information_measure="alpha_divergence")
+        with pytest.raises(ModuleNotFoundError, match="masked_lm"):
+            InfoLM()
+
+    def test_module(self):
+        m = InfoLM(masked_lm=fake_masked_lm)
+        m.update(["a b"], ["a b"])
+        np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-4)
+
+
+class TestSentenceStoreLifecycle:
+    def test_compute_not_stale_after_second_update(self):
+        m = BERTScore(encoder=fake_encoder)
+        m.update(["a b"], ["a b"])
+        first = m.compute()
+        assert first["f1"].shape == (1,)
+        m.update(["c d"], ["c d"])
+        second = m.compute()
+        assert second["f1"].shape == (2,)
+
+    def test_forward_keeps_accumulated_state(self):
+        m = BERTScore(encoder=fake_encoder)
+        batch_val = m.forward(["a b"], ["a b"])
+        np.testing.assert_allclose(np.asarray(batch_val["f1"]), 1.0, atol=1e-5)
+        m.forward(["c d"], ["c d"])
+        assert m._preds == ["a b", "c d"]
+        assert m.compute()["f1"].shape == (2,)
+
+    def test_infolm_bag_semantics_order_invariant(self):
+        # reordered tokens form the same bag of distributions -> divergence ~ 0
+        res = infolm(["b a"], ["a b"], masked_lm=fake_masked_lm)
+        np.testing.assert_allclose(float(res), 0.0, atol=1e-4)
+
+    def test_bert_unsupported_kwargs_raise(self):
+        with pytest.raises(NotImplementedError, match="idf"):
+            bert_score(["a"], ["a"], encoder=fake_encoder, idf=True)
+
+    def test_negative_best_match_not_clamped(self):
+        # single anti-correlated token pair: precision must be the (negative) cosine, not 0
+        emb_p = jnp.asarray(np.ones((1, 1, D), np.float32))
+        emb_t = jnp.asarray(-np.ones((1, 2, D), np.float32))
+        mask_p = jnp.asarray([[1.0]])
+        mask_t = jnp.asarray([[1.0, 0.0]])  # second target position is padding
+        res = _bert_score_from_embeddings(emb_p, mask_p, emb_t, mask_t)
+        np.testing.assert_allclose(np.asarray(res["precision"]), -1.0, atol=1e-5)

@@ -1,5 +1,7 @@
 """Functional text metrics (reference ``src/torchmetrics/functional/text/``)."""
+from torchmetrics_tpu.functional.text.bert import bert_score
 from torchmetrics_tpu.functional.text.bleu import bleu_score
+from torchmetrics_tpu.functional.text.infolm import infolm
 from torchmetrics_tpu.functional.text.chrf import chrf_score
 from torchmetrics_tpu.functional.text.eed import extended_edit_distance
 from torchmetrics_tpu.functional.text.edit import edit_distance

@@ -1,0 +1,140 @@
+"""BERTScore (reference ``src/torchmetrics/functional/text/bert.py``).
+
+Pluggable-encoder design (the library's standard contract for model-based metrics): the
+reference hard-loads a HuggingFace checkpoint; here the model is a callable
+
+    ``encoder(sentences: List[str]) -> (embeddings (N, L, D), mask (N, L))``
+
+where ``mask`` is 1 for real (non-special) token positions. A HuggingFace model id still works
+when the checkpoint is in the local cache (transformers is installed). The greedy cosine
+matching itself — the actual metric — runs as jnp MXU matmuls.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+Encoder = Callable[[List[str]], Tuple[Array, Array]]
+
+
+def _hf_encoder(model_name_or_path: str, num_layers: Optional[int] = None, max_length: int = 512) -> Encoder:
+    """Build an encoder from a locally cached HuggingFace checkpoint."""
+    try:
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = AutoModel.from_pretrained(model_name_or_path)
+        model.eval()
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Loading checkpoint {model_name_or_path!r} failed (no local cache and no network egress"
+            " in this build). Pass an `encoder` callable `(sentences) -> (embeddings, mask)` instead."
+        ) from err
+
+    def encoder(sentences: List[str]) -> Tuple[Array, Array]:
+        with torch.no_grad():
+            batch = tokenizer(
+                sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length,
+                return_special_tokens_mask=True,
+            )
+            special = batch.pop("special_tokens_mask")
+            # keyword-only call: positional binding differs across architectures, and BERT-style
+            # tokenizers also emit token_type_ids that must be forwarded
+            out = model(**batch, output_hidden_states=True)
+            hidden = out.hidden_states[num_layers if num_layers is not None else -1]
+        mask = batch["attention_mask"] * (1 - special)
+        return jnp.asarray(hidden.numpy()), jnp.asarray(mask.numpy())
+
+    return encoder
+
+
+def _bert_score_from_embeddings(
+    preds_emb: Array, preds_mask: Array, target_emb: Array, target_mask: Array,
+    preds_weights: Optional[Array] = None, target_weights: Optional[Array] = None,
+) -> Dict[str, Array]:
+    """Greedy-matched precision/recall/F1 (reference ``bert.py:134-168``), jnp kernels.
+
+    Weights default to uniform over real tokens (the reference's non-idf path); pass idf
+    weights to reproduce ``idf=True``.
+    """
+    def _norm(e, m):
+        e = jnp.asarray(e, jnp.float32)
+        e = e / jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-12)
+        return e * jnp.asarray(m, jnp.float32)[..., None]
+
+    p = _norm(preds_emb, preds_mask)
+    t = _norm(target_emb, target_mask)
+    cos_sim = jnp.einsum("bpd,brd->bpr", p, t)
+    # padded positions must not clamp negative best-matches to 0 (and must not win the max)
+    pm = jnp.asarray(preds_mask, jnp.float32) > 0
+    tm = jnp.asarray(target_mask, jnp.float32) > 0
+    neg = jnp.asarray(-1e9, jnp.float32)
+    cos_sim = jnp.where(pm[:, :, None] & tm[:, None, :], cos_sim, neg)
+
+    def _weights(explicit, mask):
+        mask = jnp.asarray(mask, jnp.float32)
+        w = jnp.asarray(explicit, jnp.float32) * mask if explicit is not None else mask
+        return w / jnp.clip(jnp.sum(w, axis=-1, keepdims=True), 1e-12)
+
+    pw = _weights(preds_weights, preds_mask)
+    tw = _weights(target_weights, target_mask)
+    any_t = jnp.any(tm, axis=-1, keepdims=True)
+    any_p = jnp.any(pm, axis=-1, keepdims=True)
+    best_p = jnp.where(any_t, jnp.max(cos_sim, axis=2), 0.0)
+    best_t = jnp.where(any_p, jnp.max(cos_sim, axis=1), 0.0)
+    precision = jnp.sum(best_p * pw, axis=-1)
+    recall = jnp.sum(best_t * tw, axis=-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    return {"precision": precision, "recall": recall, "f1": f1}
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    encoder: Optional[Encoder] = None,
+    num_layers: Optional[int] = None,
+    max_length: int = 512,
+    idf: bool = False,
+    rescale_with_baseline: bool = False,
+    **unsupported,
+) -> Dict[str, Array]:
+    """BERTScore (reference ``bert.py:243``): greedy contextual-embedding matching P/R/F1.
+
+    Provide either ``encoder`` (see module docstring) or a cached HF ``model_name_or_path``.
+    """
+    if idf or rescale_with_baseline or any(unsupported.values()):
+        bad = [k for k, v in {"idf": idf, "rescale_with_baseline": rescale_with_baseline, **unsupported}.items() if v]
+        raise NotImplementedError(
+            f"bert_score options {bad} are not supported in this build (idf needs tokenizer-level"
+            " document frequencies; baselines need downloaded tables). Use the default scores."
+        )
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
+    if encoder is None:
+        if model_name_or_path is None:
+            raise ModuleNotFoundError(
+                "bert_score needs a model: pass `encoder` as a callable `(sentences) -> (embeddings,"
+                " mask)` or a locally cached HuggingFace `model_name_or_path`."
+            )
+        encoder = _hf_encoder(model_name_or_path, num_layers=num_layers, max_length=max_length)
+    p_emb, p_mask = encoder(list(preds))
+    t_emb, t_mask = encoder(list(target))
+    # pad to a common sequence length so the cosine matrix is rectangular
+    lp, lt = p_emb.shape[1], t_emb.shape[1]
+    if lp != lt:
+        pad = max(lp, lt)
+        p_emb = jnp.pad(p_emb, ((0, 0), (0, pad - lp), (0, 0)))
+        p_mask = jnp.pad(p_mask, ((0, 0), (0, pad - lp)))
+        t_emb = jnp.pad(t_emb, ((0, 0), (0, pad - lt), (0, 0)))
+        t_mask = jnp.pad(t_mask, ((0, 0), (0, pad - lt)))
+    return _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask)

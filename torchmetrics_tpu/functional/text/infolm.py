@@ -1,0 +1,175 @@
+"""InfoLM (reference ``src/torchmetrics/functional/text/infolm.py``).
+
+InfoLM aggregates the masked-language-model predictive distributions of a sentence's positions
+into ONE bag-of-distributions vector per sentence (mean over real positions, reference
+``infolm.py:394-421``) and compares candidate vs reference bags under an information measure.
+Pluggable-model contract:
+
+    ``masked_lm(sentences: List[str]) -> (probs (N, L, V), mask (N, L))``
+
+returning, per position, the MLM distribution obtained with that position masked (and 1-mask
+for real, non-special positions). A locally cached HuggingFace ``model_name_or_path`` builds
+this callable automatically. The nine information measures run as jnp kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+MaskedLM = Callable[[List[str]], Tuple[Array, Array]]
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+_EPS = 1e-12
+
+
+def _validate_measure(information_measure: str, alpha: Optional[float], beta: Optional[float]) -> None:
+    """Parameter constraints of the divergences (reference ``infolm.py:104-134``)."""
+    if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+        raise ValueError(
+            f"Argument `information_measure` expected to be one of {_ALLOWED_INFORMATION_MEASURE},"
+            f" got {information_measure}"
+        )
+    needs_alpha = information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence")
+    needs_beta = information_measure in ("beta_divergence", "ab_divergence")
+    if needs_alpha and not isinstance(alpha, float):
+        raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+    if needs_beta and not isinstance(beta, float):
+        raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+    if information_measure == "alpha_divergence" and alpha in (0.0, 1.0):
+        raise ValueError(f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}.")
+    if information_measure == "beta_divergence" and beta in (0.0, -1.0):
+        raise ValueError(f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}.")
+    if information_measure == "ab_divergence" and (
+        alpha is None or beta is None or 0.0 in (alpha, beta, alpha + beta)
+    ):
+        raise ValueError(
+            "Parameters `alpha`, `beta` and their sum are expected to be differened from 0 for ab_divergence"
+        )
+    if information_measure == "renyi_divergence" and alpha == 1.0:
+        raise ValueError(f"Parameter `alpha` is expected to be float differened from 1 for {information_measure}.")
+
+
+def _information_measure(
+    p: Array, q: Array, information_measure: str, alpha: Optional[float], beta: Optional[float]
+) -> Array:
+    """Per-position divergence between distributions ``p`` and ``q`` over the vocab axis."""
+    p = jnp.clip(jnp.asarray(p, jnp.float32), _EPS)
+    q = jnp.clip(jnp.asarray(q, jnp.float32), _EPS)
+    if information_measure == "kl_divergence":
+        return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1)
+    if information_measure == "alpha_divergence":
+        a = alpha
+        return (1 - jnp.sum(q**a * p ** (1 - a), axis=-1)) / (a * (1 - a))
+    if information_measure == "beta_divergence":
+        b = beta
+        return (
+            jnp.sum(p ** (b + 1), axis=-1) / (b * (b + 1))
+            + jnp.sum(q ** (b + 1), axis=-1) / (b + 1)
+            - jnp.sum(p * q**b, axis=-1) / b
+        )
+    if information_measure == "ab_divergence":
+        a, b = alpha, beta
+        return (
+            jnp.log(jnp.sum(p ** (a + b), axis=-1)) / (b * (a + b))
+            + jnp.log(jnp.sum(q ** (a + b), axis=-1)) / (a * (a + b))
+            - jnp.log(jnp.sum(p**a * q**b, axis=-1)) / (a * b)
+        )
+    if information_measure == "renyi_divergence":
+        a = alpha
+        return jnp.log(jnp.sum(p**a * q ** (1 - a), axis=-1)) / (a - 1)
+    if information_measure == "l1_distance":
+        return jnp.sum(jnp.abs(p - q), axis=-1)
+    if information_measure == "l2_distance":
+        return jnp.sqrt(jnp.sum(jnp.square(p - q), axis=-1))
+    if information_measure == "l_infinity_distance":
+        return jnp.max(jnp.abs(p - q), axis=-1)
+    # fisher_rao_distance
+    return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * q), axis=-1), 0.0, 1.0))
+
+
+def _sentence_distribution(probs: Array, mask: Array) -> Array:
+    """Mean of per-position MLM distributions over real positions → one (V,) bag per sentence."""
+    probs = jnp.asarray(probs, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    total = jnp.sum(probs * mask[..., None], axis=1)
+    return total / jnp.clip(jnp.sum(mask, axis=1), 1.0)[..., None]
+
+
+def _hf_masked_lm(model_name_or_path: str, max_length: int = 192) -> MaskedLM:
+    """Build the per-position MLM-distribution callable from a cached HF checkpoint."""
+    try:
+        import torch
+        from transformers import AutoModelForMaskedLM, AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = AutoModelForMaskedLM.from_pretrained(model_name_or_path)
+        model.eval()
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Loading checkpoint {model_name_or_path!r} failed (no local cache and no network egress"
+            " in this build). Pass a `masked_lm` callable `(sentences) -> (probs, mask)` instead."
+        ) from err
+
+    def masked_lm(sentences: List[str]) -> Tuple[Array, Array]:
+        with torch.no_grad():
+            batch = tokenizer(
+                sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length,
+                return_special_tokens_mask=True,
+            )
+            special = batch.pop("special_tokens_mask")
+            logits = model(**batch).logits
+            probs = torch.softmax(logits, dim=-1)
+        mask = batch["attention_mask"] * (1 - special)
+        return jnp.asarray(probs.numpy()), jnp.asarray(mask.numpy())
+
+    return masked_lm
+
+
+def infolm(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    masked_lm: Optional[MaskedLM] = None,
+    information_measure: str = "kl_divergence",
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    return_sentence_level_score: bool = False,
+):
+    """InfoLM (reference ``infolm.py:41``): information measure between MLM distributions."""
+    _validate_measure(information_measure, alpha, beta)
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
+    if masked_lm is None:
+        if model_name_or_path is None:
+            raise ModuleNotFoundError(
+                "infolm needs a model: pass `masked_lm` as a callable `(sentences) -> (probs, mask)`"
+                " or a locally cached HuggingFace `model_name_or_path`."
+            )
+        masked_lm = _hf_masked_lm(model_name_or_path)
+    p_probs, p_mask = masked_lm(list(preds))
+    t_probs, t_mask = masked_lm(list(target))
+    p_bag = _sentence_distribution(p_probs, p_mask)
+    t_bag = _sentence_distribution(t_probs, t_mask)
+    sentence = _information_measure(p_bag, t_bag, information_measure, alpha, beta)
+    corpus = jnp.mean(sentence)
+    if return_sentence_level_score:
+        return corpus, sentence
+    return corpus

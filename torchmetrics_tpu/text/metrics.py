@@ -541,3 +541,132 @@ class ExtendedEditDistance(_HostTextMetric):
         if self.return_sentence_level_score:
             return avg, sent
         return avg
+
+
+class _SentenceStoreTextMetric(_HostTextMetric):
+    """Shared shell for model-based text metrics that must keep raw sentences until compute.
+
+    Raw strings cannot live in array states, so they are plain host lists: ``forward`` computes
+    the batch value directly on the batch (no snapshot/reset dance over string storage), reset
+    clears them, and cross-process ``sync`` of these metrics is NOT supported (documented
+    divergence — the reference syncs tokenised id tensors instead; gather sentences externally
+    or compute per process).
+    """
+
+    jit_compute = False  # compute reads host sentence lists, never cacheable as traced constants
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._preds: list = []
+        self._target: list = []
+
+    @staticmethod
+    def _coerce_sentences(preds, target):
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        if len(preds) != len(target):
+            raise ValueError(
+                f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}"
+            )
+        return preds, target
+
+    def _host_update(self, preds, target) -> None:
+        preds, target = self._coerce_sentences(preds, target)
+        self._preds.extend(preds)
+        self._target.extend(target)
+
+    def _score(self, preds: list, target: list):
+        raise NotImplementedError
+
+    def _compute(self, state: Dict[str, Any]):
+        return self._score(self._preds, self._target)
+
+    def forward(self, preds, target):  # noqa: D102 - batch value computed on the batch alone
+        self.update(preds, target)
+        batch_preds, batch_target = self._coerce_sentences(preds, target)
+        return self._score(batch_preds, batch_target)
+
+    def reset(self) -> None:  # noqa: D102
+        super().reset()
+        self._preds = []
+        self._target = []
+
+
+class BERTScore(_SentenceStoreTextMetric):
+    """BERTScore (reference ``text/bert.py:54``): pluggable-encoder design.
+
+    Sentences accumulate on the host (see the base class); the greedy cosine matching runs as
+    jnp MXU matmuls at compute time.
+    """
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        encoder=None,
+        num_layers: Optional[int] = None,
+        max_length: int = 512,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from torchmetrics_tpu.functional.text.bert import _hf_encoder
+
+        if encoder is None:
+            if model_name_or_path is None:
+                raise ModuleNotFoundError(
+                    "BERTScore needs a model: pass `encoder` as a callable `(sentences) ->"
+                    " (embeddings, mask)` or a locally cached HuggingFace `model_name_or_path`."
+                )
+            encoder = _hf_encoder(model_name_or_path, num_layers=num_layers, max_length=max_length)
+        self.encoder = encoder
+
+    def _score(self, preds: list, target: list):
+        from torchmetrics_tpu.functional.text.bert import bert_score
+
+        return bert_score(preds, target, encoder=self.encoder)
+
+
+class InfoLM(_SentenceStoreTextMetric):
+    """InfoLM (reference ``text/infolm.py:41``): pluggable masked-LM design."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        masked_lm=None,
+        information_measure: str = "kl_divergence",
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from torchmetrics_tpu.functional.text.infolm import _hf_masked_lm, _validate_measure
+
+        _validate_measure(information_measure, alpha, beta)
+        if masked_lm is None:
+            if model_name_or_path is None:
+                raise ModuleNotFoundError(
+                    "InfoLM needs a model: pass `masked_lm` as a callable `(sentences) ->"
+                    " (probs, mask)` or a locally cached HuggingFace `model_name_or_path`."
+                )
+            masked_lm = _hf_masked_lm(model_name_or_path)
+        self.masked_lm = masked_lm
+        self.information_measure = information_measure
+        self.alpha = alpha
+        self.beta = beta
+        self.return_sentence_level_score = return_sentence_level_score
+
+    def _score(self, preds: list, target: list):
+        from torchmetrics_tpu.functional.text.infolm import infolm
+
+        return infolm(
+            preds, target, masked_lm=self.masked_lm,
+            information_measure=self.information_measure, alpha=self.alpha, beta=self.beta,
+            return_sentence_level_score=self.return_sentence_level_score,
+        )

@@ -1,6 +1,8 @@
 """BERTScore / InfoLM tests with deterministic fake models (no checkpoint downloads)."""
 from __future__ import annotations
 
+import zlib
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -21,7 +23,7 @@ def fake_encoder(sentences):
     mask = np.zeros((len(sentences), max_len), np.float32)
     for i, t in enumerate(toks):
         for j, tok in enumerate(t):
-            rng = np.random.RandomState(abs(hash(tok)) % (2**31))
+            rng = np.random.RandomState(zlib.crc32(tok.encode()) % (2**31))
             emb[i, j] = rng.randn(D)
             mask[i, j] = 1.0
     return jnp.asarray(emb), jnp.asarray(mask)
@@ -36,7 +38,7 @@ def fake_masked_lm(sentences):
     for i, t in enumerate(toks):
         for j, tok in enumerate(t):
             onehot = np.zeros(V)
-            onehot[abs(hash(tok)) % V] = 1.0
+            onehot[zlib.crc32(tok.encode()) % V] = 1.0
             probs[i, j] = 0.9 * onehot + 0.1 / V
             mask[i, j] = 1.0
     return jnp.asarray(probs), jnp.asarray(mask)

@@ -16,7 +16,6 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
-import numpy as np
 from jax import Array
 
 MaskedLM = Callable[[List[str]], Tuple[Array, Array]]

@@ -1,0 +1,73 @@
+"""Pallas bincount kernel (SURVEY §2.9: the named Pallas candidate — XLA's native lowering of
+bincount is either a scatter-add (non-deterministic on some backends, serialised on TPU) or a
+materialised one-hot).
+
+Design: grid over (sample blocks × bin rows). Each step loads a ``(ROWS, 128)`` tile of indices
+into VMEM, compares it against one 128-wide row of bin ids with a broadcasted iota — pure VPU
+work, no HBM one-hot — and accumulates the 128 partial counts into the output tile, revisiting
+the same output block across the sample-grid dimension. Counts layout ``(num_bin_rows, 128)``
+flattens to the caller's ``(length,)``.
+
+Falls back transparently: ``interpret=True`` on non-TPU platforms (tests run on the CPU mesh),
+and any Pallas failure re-raises into the XLA one-hot/segment-sum path in ``ops.histogram``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+_LANES = 128
+_ROWS = 32  # samples tile = (32, 128) = 4096 indices per grid step
+
+
+def _bincount_kernel(idx_ref, out_ref):
+    bin_block = pl.program_id(0)
+    sample_step = pl.program_id(1)
+
+    @pl.when(sample_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[...]  # (ROWS, LANES) int32
+    # output tile is (8, LANES): 8 sublane rows of 128 bins each
+    for r in range(8):
+        bins = (bin_block * 8 + r) * _LANES + jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+        eq = (idx[:, :, None] == bins[None, :, :]).astype(jnp.float32)  # (ROWS, LANES, LANES)
+        out_ref[r, :] += jnp.sum(eq, axis=(0, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("length", "interpret"))
+def _bincount_pallas_impl(idx_padded: Array, length: int, interpret: bool) -> Array:
+    n = idx_padded.shape[0]
+    num_sample_blocks = n // (_ROWS * _LANES)
+    num_bin_blocks = (length + 8 * _LANES - 1) // (8 * _LANES)
+    # sample dim INNERMOST: the output block then stays resident in VMEM across all of its
+    # accumulation steps (Pallas only defines revisiting for consecutive grid steps)
+    out = pl.pallas_call(
+        _bincount_kernel,
+        grid=(num_bin_blocks, num_sample_blocks),
+        in_specs=[pl.BlockSpec((_ROWS, _LANES), lambda b, s: (s, 0))],
+        out_specs=pl.BlockSpec((8, _LANES), lambda b, s: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_bin_blocks * 8, _LANES), jnp.float32),
+        interpret=interpret,
+    )(idx_padded.reshape(num_sample_blocks * _ROWS, _LANES))
+    return out.reshape(-1)[:length]
+
+
+def bincount_pallas(x: Array, length: int) -> Array:
+    """Counts of int32 values in ``[0, length)``; out-of-range values are dropped.
+
+    Same contract as ``ops.histogram.bincount`` (mask, never drop: out-of-range indices match
+    no bin). Pads the input to a full tile with an out-of-range sentinel.
+    """
+    x = jnp.asarray(x, jnp.int32).reshape(-1)
+    block = _ROWS * _LANES
+    n_pad = max(((x.size + block - 1) // block) * block, block)
+    sentinel = jnp.asarray(length + _LANES + 1, jnp.int32)  # never matches any bin row lane
+    padded = jnp.full((n_pad,), sentinel, jnp.int32).at[: x.size].set(x)
+    interpret = jax.default_backend() != "tpu"
+    return _bincount_pallas_impl(padded, length, interpret).astype(jnp.float32)

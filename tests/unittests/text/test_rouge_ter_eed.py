@@ -183,3 +183,49 @@ class TestBatchedBleuParity:
             assert p1 == p2 and t1 == t2
             np.testing.assert_array_equal(n1, n2)
             np.testing.assert_array_equal(d1, d2)
+
+
+class TestBatchedChrfParity:
+    """The vectorised chrF counter must match the per-sentence loop oracle exactly."""
+
+    def test_fuzz_vs_loop_oracle(self):
+        import random
+
+        from torchmetrics_tpu.functional.text.chrf import (
+            _chrf_score_update,
+            _chrf_score_update_batched,
+        )
+
+        random.seed(9)
+
+        def rand_sentence(maxlen=8):
+            words = []
+            for _ in range(random.randint(0, maxlen)):
+                w = "".join(random.choices("abcde", k=random.randint(1, 4)))
+                if random.random() < 0.3:
+                    w += random.choice(".,!?")
+                words.append(w)
+            return " ".join(words)
+
+        def make_totals(nc, nw):
+            return {
+                k: np.zeros(n, np.float32)
+                for k, n in (
+                    ("preds_char", nc), ("preds_word", nw), ("target_char", nc),
+                    ("target_word", nw), ("matching_char", nc), ("matching_word", nw),
+                )
+            }
+
+        for trial in range(4):
+            k = random.randint(1, 5)
+            preds = [rand_sentence(random.choice([0, 1, 8])) for _ in range(k)]
+            target = [[rand_sentence() for _ in range(random.randint(1, 3))] for _ in range(k)]
+            lowercase = trial % 2 == 0
+            whitespace = trial >= 2
+            t1, t2 = make_totals(6, 2), make_totals(6, 2)
+            s1, s2 = [], []
+            _chrf_score_update(preds, target, t1, 6, 2, 8.0, 2.0, lowercase, whitespace, s1)
+            _chrf_score_update_batched(preds, target, t2, 6, 2, 8.0, 2.0, lowercase, whitespace, s2)
+            for key in t1:
+                np.testing.assert_array_equal(t1[key], t2[key], err_msg=key)
+            np.testing.assert_allclose(s1, s2, atol=1e-6)

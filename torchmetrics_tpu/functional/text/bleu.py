@@ -118,15 +118,6 @@ def bleu_score(
     )
 
 
-def _intern_tokens(sentences):
-    """Map token lists to dense int id arrays via one shared vocabulary."""
-    vocab: dict = {}
-    out = []
-    for toks in sentences:
-        out.append(np.fromiter((vocab.setdefault(t, len(vocab)) for t in toks), np.int64, len(toks)))
-    return out, max(len(vocab), 1)
-
-
 def _bleu_score_update_batched(
     preds: Sequence[str],
     target: Sequence[Sequence[str]],
@@ -152,36 +143,18 @@ def _bleu_score_update_batched(
         diffs = [abs(len(pred) - len(r)) for r in refs]
         target_len += len(refs[diffs.index(min(diffs))])
 
-    # flatten pred and ref streams with owner ids
+    # flatten pred and ref streams with owner ids (shared machinery with chrF)
+    from torchmetrics_tpu.functional.text._ngram import intern_streams, iter_ngram_levels
+
     all_streams = preds_tok + [r for refs in target_tok for r in refs]
-    ids_list, vocab_size = _intern_tokens(all_streams)
     n_pred = len(preds_tok)
     stream_sent = np.asarray(
         list(range(n_pred)) + [i for i, refs in enumerate(target_tok) for _ in refs], np.int64
     )
     is_pred = np.asarray([True] * n_pred + [False] * (len(all_streams) - n_pred))
+    ids_flat, stream_of, vocab_size = intern_streams(all_streams)
 
-    ids_flat = np.concatenate(ids_list) if ids_list else np.zeros(0, np.int64)
-    lens = np.asarray([len(x) for x in ids_list], np.int64)
-    stream_of = np.repeat(np.arange(len(ids_list)), lens)
-    n_tokens = len(ids_flat)
-
-    codes = ids_flat.copy()
-    for n in range(1, n_gram + 1):
-        if n_tokens < n:
-            break
-        if n > 1:
-            # extend each (n-1)-gram code by the next token; windows must stay inside a stream
-            valid = np.zeros(n_tokens, bool)
-            valid[: n_tokens - (n - 1)] = stream_of[: n_tokens - (n - 1)] == stream_of[n - 1 :]
-            raw = np.where(valid, codes * vocab_size, 0)
-            raw[: n_tokens - (n - 1)] += np.where(
-                valid[: n_tokens - (n - 1)], ids_flat[n - 1 :] + 1, 0
-            )
-            # compact to dense codes so the next level cannot overflow int64
-            _, codes = np.unique(raw, return_inverse=True)
-        else:
-            valid = np.ones(n_tokens, bool)
+    for n, codes, valid in iter_ngram_levels(ids_flat, stream_of, vocab_size, n_gram):
         sel = valid
         if not sel.any():
             continue

@@ -50,7 +50,10 @@ def bincount(x: Array, length: int, dtype=jnp.int32) -> Array:
     if _BINCOUNT_BACKEND == "pallas":
         from torchmetrics_tpu.ops.pallas_hist import bincount_pallas
 
-        return bincount_pallas(x, length).astype(dtype)
+        try:
+            return bincount_pallas(x, length).astype(dtype)
+        except Exception:  # pallas lowering unavailable on this platform → XLA path
+            pass
     return bincount_weighted(x, length, weights=None, dtype=dtype)
 
 

@@ -8,8 +8,9 @@ work, no HBM one-hot — and accumulates the 128 partial counts into the output 
 the same output block across the sample-grid dimension. Counts layout ``(num_bin_rows, 128)``
 flattens to the caller's ``(length,)``.
 
-Falls back transparently: ``interpret=True`` on non-TPU platforms (tests run on the CPU mesh),
-and any Pallas failure re-raises into the XLA one-hot/segment-sum path in ``ops.histogram``.
+Runs in ``interpret=True`` mode on non-TPU platforms (tests run on the CPU mesh); the caller
+(``ops.histogram.bincount``) falls back to the XLA one-hot/segment-sum path if this kernel
+raises.
 """
 from __future__ import annotations
 
@@ -64,10 +65,14 @@ def bincount_pallas(x: Array, length: int) -> Array:
     Same contract as ``ops.histogram.bincount`` (mask, never drop: out-of-range indices match
     no bin). Pads the input to a full tile with an out-of-range sentinel.
     """
-    x = jnp.asarray(x, jnp.int32).reshape(-1)
+    x = jnp.asarray(x).reshape(-1)
+    # remap out-of-range values BEFORE the int32 cast (an int64 value could otherwise wrap into
+    # a valid bin); the sentinel sits past `length`, inside the kernel's padded bin range, and
+    # is discarded by the final [:length] slice
     block = _ROWS * _LANES
     n_pad = max(((x.size + block - 1) // block) * block, block)
-    sentinel = jnp.asarray(length + _LANES + 1, jnp.int32)  # never matches any bin row lane
-    padded = jnp.full((n_pad,), sentinel, jnp.int32).at[: x.size].set(x)
+    sentinel = jnp.asarray(length, jnp.int32)
+    x32 = jnp.where((x >= 0) & (x < length), x, length).astype(jnp.int32)
+    padded = jnp.full((n_pad,), sentinel, jnp.int32).at[: x.size].set(x32)
     interpret = jax.default_backend() != "tpu"
     return _bincount_pallas_impl(padded, length, interpret).astype(jnp.float32)

@@ -18,7 +18,7 @@ from torchmetrics_tpu.functional.text._edit import edit_distance_batch
 from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update_batched, _tokenize_fn
 from torchmetrics_tpu.functional.text.chrf import (
     _chrf_score_compute,
-    _chrf_score_update,
+    _chrf_score_update_batched,
     _validate_chrf_args,
 )
 from torchmetrics_tpu.functional.text.edit import _edit_distance_compute, _edit_distance_update
@@ -310,7 +310,7 @@ class CHRFScore(_HostTextMetric):
     def _host_update(self, preds, target) -> None:
         totals = {k: np.asarray(self._state.tensors[k]).copy() for k in self._STATE_KEYS}
         sentence_scores = [] if self.return_sentence_level_score else None
-        _chrf_score_update(
+        _chrf_score_update_batched(
             preds, target, totals, self.n_char_order, self.n_word_order, self.n_order, self.beta,
             self.lowercase, self.whitespace, sentence_scores,
         )

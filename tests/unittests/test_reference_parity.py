@@ -316,3 +316,101 @@ class TestAggregationAndWrapperParity:
         assert res_o.keys() == res_t.keys()
         for k in res_o:
             np.testing.assert_allclose(res_o[k], res_t[k], atol=1e-5)
+
+
+class TestMoreDomainsParity:
+    def test_clustering(self):
+        from torchmetrics.functional.clustering import (
+            adjusted_rand_score as ref_ars,
+            calinski_harabasz_score as ref_ch,
+            mutual_info_score as ref_mi,
+            normalized_mutual_info_score as ref_nmi,
+        )
+
+        labels_a = RNG.randint(0, 6, 300)
+        labels_b = RNG.randint(0, 5, 300)
+        data = RNG.randn(300, 4).astype(np.float32)
+        check(F.mutual_info_score(jnp.asarray(labels_a), jnp.asarray(labels_b)), ref_mi(_t(labels_a), _t(labels_b)))
+        check(
+            F.normalized_mutual_info_score(jnp.asarray(labels_a), jnp.asarray(labels_b)),
+            ref_nmi(_t(labels_a), _t(labels_b)),
+        )
+        check(
+            F.adjusted_rand_score(jnp.asarray(labels_a), jnp.asarray(labels_b)), ref_ars(_t(labels_a), _t(labels_b))
+        )
+        check(
+            F.calinski_harabasz_score(jnp.asarray(data), jnp.asarray(labels_a)),
+            ref_ch(_t(data), _t(labels_a)),
+            rtol=1e-4,
+        )
+
+    def test_nominal(self):
+        from torchmetrics.functional.nominal import cramers_v as ref_cv
+        from torchmetrics.functional.nominal import theils_u as ref_tu
+
+        a = RNG.randint(0, 4, 400)
+        b = RNG.randint(0, 5, 400)
+        check(F.cramers_v(jnp.asarray(a), jnp.asarray(b)), ref_cv(_t(a), _t(b)), atol=1e-5)
+        check(F.theils_u(jnp.asarray(a), jnp.asarray(b)), ref_tu(_t(a), _t(b)), atol=1e-5)
+
+    def test_retrieval(self):
+        from torchmetrics.functional.retrieval import (
+            retrieval_average_precision as ref_ap,
+            retrieval_normalized_dcg as ref_ndcg,
+            retrieval_reciprocal_rank as ref_rr,
+        )
+
+        preds = RNG.rand(40).astype(np.float32)
+        target = RNG.randint(0, 2, 40)
+        check(F.retrieval_average_precision(jnp.asarray(preds), jnp.asarray(target)), ref_ap(_t(preds), _t(target)))
+        check(F.retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target)), ref_ndcg(_t(preds), _t(target)))
+        check(F.retrieval_reciprocal_rank(jnp.asarray(preds), jnp.asarray(target)), ref_rr(_t(preds), _t(target)))
+
+    def test_pairwise(self):
+        from torchmetrics.functional import (
+            pairwise_cosine_similarity as ref_cos,
+            pairwise_euclidean_distance as ref_euc,
+            pairwise_manhattan_distance as ref_man,
+        )
+
+        a = RNG.randn(12, 6).astype(np.float32)
+        b = RNG.randn(9, 6).astype(np.float32)
+        check(F.pairwise_cosine_similarity(jnp.asarray(a), jnp.asarray(b)), ref_cos(_t(a), _t(b)), atol=1e-5)
+        check(F.pairwise_euclidean_distance(jnp.asarray(a), jnp.asarray(b)), ref_euc(_t(a), _t(b)), atol=1e-4)
+        check(F.pairwise_manhattan_distance(jnp.asarray(a), jnp.asarray(b)), ref_man(_t(a), _t(b)), atol=1e-4)
+
+    def test_wrapper_minmax(self):
+        from torchmetrics import MinMaxMetric as RefMinMax
+        from torchmetrics.classification import BinaryAccuracy as RefBA
+
+        from torchmetrics_tpu.classification import BinaryAccuracy
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        ours = MinMaxMetric(BinaryAccuracy())
+        theirs = RefMinMax(RefBA())
+        for _ in range(4):
+            p = RNG.rand(64).astype(np.float32)
+            t = RNG.randint(0, 2, 64)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            theirs.update(_t(p), _t(t))
+            ro = {k: float(v) for k, v in ours.compute().items()}
+            rt = {k: float(v) for k, v in theirs.compute().items()}
+            for k in ("raw", "max", "min"):
+                np.testing.assert_allclose(ro[k], rt[k], atol=1e-6)
+
+    def test_aggregation(self):
+        from torchmetrics import MeanMetric as RefMean
+        from torchmetrics import SumMetric as RefSum
+
+        from torchmetrics_tpu import MeanMetric, SumMetric
+
+        vals = RNG.randn(5, 20).astype(np.float32)
+        om, rm = MeanMetric(), RefMean()
+        os_, rs = SumMetric(), RefSum()
+        for v in vals:
+            om.update(jnp.asarray(v))
+            rm.update(_t(v))
+            os_.update(jnp.asarray(v))
+            rs.update(_t(v))
+        np.testing.assert_allclose(float(om.compute()), float(rm.compute()), atol=1e-5)
+        np.testing.assert_allclose(float(os_.compute()), float(rs.compute()), atol=1e-4)

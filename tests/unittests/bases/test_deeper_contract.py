@@ -193,3 +193,55 @@ class TestProfilingUtil:
         out = capsys.readouterr().out
         assert "Recommended setting `full_state_update=" in out
         assert "Fused update_batches" in out
+
+
+class TestDifferentiabilitySweep(MetricTester):
+    """Every declared-differentiable functional family produces finite grads wrt preds."""
+
+    @pytest.mark.parametrize(
+        "maker",
+        [
+            # (functional, preds, target, kwargs)
+            lambda: ("mae", RNG.randn(32).astype(np.float32), RNG.randn(32).astype(np.float32), {}),
+            lambda: ("cosine", RNG.randn(8, 4).astype(np.float32), RNG.randn(8, 4).astype(np.float32), {}),
+            lambda: ("psnr", RNG.rand(2, 1, 8, 8).astype(np.float32), RNG.rand(2, 1, 8, 8).astype(np.float32),
+                     {"data_range": 1.0}),
+            lambda: ("sam", RNG.rand(2, 3, 8, 8).astype(np.float32), RNG.rand(2, 3, 8, 8).astype(np.float32), {}),
+            lambda: ("tv", RNG.rand(2, 3, 8, 8).astype(np.float32), None, {}),
+            lambda: ("sa_sdr", RNG.randn(2, 2, 64).astype(np.float32), RNG.randn(2, 2, 64).astype(np.float32), {}),
+            lambda: ("kld", np.abs(RNG.rand(4, 5)).astype(np.float32), np.abs(RNG.rand(4, 5)).astype(np.float32), {}),
+        ],
+    )
+    def test_finite_grads(self, maker):
+        import jax
+
+        from torchmetrics_tpu.functional.audio import source_aggregated_signal_distortion_ratio
+        from torchmetrics_tpu.functional.image import (
+            peak_signal_noise_ratio,
+            spectral_angle_mapper,
+            total_variation,
+        )
+        from torchmetrics_tpu.functional.regression.mae import mean_absolute_error
+
+        from torchmetrics_tpu import functional as F
+
+        fns = {
+            "mae": mean_absolute_error,
+            "cosine": F.cosine_similarity,
+            "psnr": peak_signal_noise_ratio,
+            "sam": spectral_angle_mapper,
+            "tv": total_variation,
+            "sa_sdr": source_aggregated_signal_distortion_ratio,
+            "kld": lambda p, t: F.kl_divergence(p / p.sum(-1, keepdims=True), t / t.sum(-1, keepdims=True)),
+        }
+        name, preds, target, kwargs = maker()
+        fn = fns[name]
+
+        def scalar(p):
+            out = fn(p, **kwargs) if target is None else fn(p, jnp.asarray(target), **kwargs)
+            if isinstance(out, dict):
+                out = list(out.values())[0]
+            return jnp.sum(jnp.asarray(out))
+
+        grads = jax.grad(scalar)(jnp.asarray(preds))
+        assert bool(jnp.all(jnp.isfinite(grads))), name

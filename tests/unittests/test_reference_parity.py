@@ -228,6 +228,19 @@ class TestTextParity:
         check(F.bleu_score(preds, target), ref_bleu(preds, target), atol=1e-5)
         check(F.chrf_score(preds, target), ref_chrf(preds, target), atol=1e-5)
 
+    def test_chrf_zero_overlap_sentence(self):
+        # a sentence with zero F against every reference must accumulate NO reference stats
+        # (strict-greater best-reference rule; r3 advisor finding)
+        from torchmetrics.functional.text import chrf_score as ref_chrf
+
+        preds = ["hello there good match", "qqq"]
+        target = [["hello there good match"], ["zzzz wwww"]]
+        check(F.chrf_score(preds, target), ref_chrf(preds, target), atol=1e-5)
+        ours, ours_sent = F.chrf_score(preds, target, return_sentence_level_score=True)
+        ref, ref_sent = ref_chrf(preds, target, return_sentence_level_score=True)
+        check(ours, ref, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours_sent), ref_sent.numpy(), atol=1e-5)
+
     def test_wer_cer(self):
         from torchmetrics.functional.text import char_error_rate as ref_cer
         from torchmetrics.functional.text import word_error_rate as ref_wer

@@ -1,0 +1,145 @@
+"""BERTScore idf weighting and baseline rescaling (reference ``functional/text/bert.py:53-143``,
+``helper_embedding_metric.py:240-259``)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.text.bert import (
+    _bert_score_from_embeddings,
+    _idf_weights,
+    _load_baseline_file,
+    _tokens_idf,
+    bert_score,
+)
+
+D = 8
+_VOCAB = {}
+
+
+def _ids(sentence):
+    return [_VOCAB.setdefault(w, len(_VOCAB) + 1) for w in sentence.split()]
+
+
+def fake_tokenize(sentences):
+    rows = [_ids(s) for s in sentences]
+    width = max(len(r) for r in rows)
+    ids = np.zeros((len(rows), width), np.int64)
+    mask = np.zeros((len(rows), width), np.int64)
+    for i, r in enumerate(rows):
+        ids[i, : len(r)] = r
+        mask[i, : len(r)] = 1
+    return ids, mask
+
+
+def fake_encoder(sentences):
+    ids, mask = fake_tokenize(sentences)
+    rng = np.random.RandomState(0)
+    table = rng.randn(512, D).astype(np.float32)
+    emb = table[ids % 512]
+    return jnp.asarray(emb), jnp.asarray(mask)
+
+
+class TestTokensIdf:
+    def test_formula(self):
+        # corpus: 3 sentences; token "a" in all 3, "b" in 1
+        ids, mask = fake_tokenize(["a b", "a", "a c"])
+        idf = _tokens_idf(ids, mask)
+        a, b = _ids("a")[0], _ids("b")[0]
+        assert math.isclose(idf[a], math.log(4 / 4))
+        assert math.isclose(idf[b], math.log(4 / 2))
+        # unseen token gets log(N+1)
+        w = _idf_weights(np.asarray([[9999]]), idf)
+        assert math.isclose(float(w[0, 0]), math.log(4), rel_tol=1e-6)
+
+    def test_idf_changes_score_only_when_weights_differ(self):
+        preds = ["x y z", "q r"]
+        target = ["x y w", "q q r"]
+        plain = bert_score(preds, target, encoder=fake_encoder, tokenize=fake_tokenize)
+        weighted = bert_score(preds, target, encoder=fake_encoder, tokenize=fake_tokenize, idf=True)
+        for k in ("precision", "recall", "f1"):
+            assert np.all(np.isfinite(np.asarray(weighted[k])))
+        # idf reweighting must move at least one score on this non-uniform corpus
+        assert any(
+            not np.allclose(np.asarray(plain[k]), np.asarray(weighted[k])) for k in ("precision", "recall")
+        )
+
+    def test_idf_matches_manual_weighting(self):
+        preds, target = ["a b"], ["a c"]
+        ids_t, mask_t = fake_tokenize(target)
+        idf = _tokens_idf(ids_t, mask_t)
+        ids_p, mask_p = fake_tokenize(preds)
+        pw = jnp.asarray(_idf_weights(ids_p, idf))
+        tw = jnp.asarray(_idf_weights(ids_t, idf))
+        p_emb, p_mask = fake_encoder(preds)
+        t_emb, t_mask = fake_encoder(target)
+        manual = _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask, pw, tw)
+        auto = bert_score(preds, target, encoder=fake_encoder, tokenize=fake_tokenize, idf=True)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(np.asarray(manual[k]), np.asarray(auto[k]), atol=1e-6)
+
+
+class TestBaselineRescale:
+    def _write_baseline(self, tmp_path, rows):
+        path = tmp_path / "baseline.tsv"
+        lines = ["LAYER\tP\tR\tF"] + [f"{i}\t{p}\t{r}\t{f}" for i, (p, r, f) in enumerate(rows)]
+        path.write_text("\n".join(lines))
+        return str(path)
+
+    def test_load_baseline_file(self, tmp_path):
+        path = self._write_baseline(tmp_path, [(0.1, 0.2, 0.3), (0.4, 0.5, 0.6)])
+        table = _load_baseline_file(path)
+        np.testing.assert_allclose(table, [[0.1, 0.2, 0.3], [0.4, 0.5, 0.6]], atol=1e-6)
+
+    def test_rescale_applies_last_layer_row(self, tmp_path):
+        path = self._write_baseline(tmp_path, [(0.0, 0.0, 0.0), (0.5, 0.25, 0.75)])
+        preds, target = ["a b"], ["a b"]
+        raw = bert_score(preds, target, encoder=fake_encoder)
+        scaled = bert_score(preds, target, encoder=fake_encoder, rescale_with_baseline=True, baseline_path=path)
+        np.testing.assert_allclose(
+            np.asarray(scaled["precision"]), (np.asarray(raw["precision"]) - 0.5) / 0.5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(scaled["recall"]), (np.asarray(raw["recall"]) - 0.25) / 0.75, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(scaled["f1"]), (np.asarray(raw["f1"]) - 0.75) / 0.25, atol=1e-5
+        )
+
+    def test_missing_baseline_warns_and_keeps_scores(self, tmp_path):
+        preds, target = ["a b"], ["a b"]
+        raw = bert_score(preds, target, encoder=fake_encoder)
+        with pytest.warns(UserWarning, match="Baseline was not successfully loaded"):
+            out = bert_score(preds, target, encoder=fake_encoder, rescale_with_baseline=True)
+        np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(raw["f1"]), atol=1e-6)
+
+
+class TestReferenceIdfParity:
+    def test_idf_table_matches_reference_dataset(self):
+        """The idf table must match the reference TextDataset._get_tokens_idf on the same ids."""
+        from tests.unittests.helpers.reference_shim import import_reference
+
+        import_reference()
+        import torch
+        from torchmetrics.functional.text.helper_embedding_metric import TextDataset
+
+        sentences = ["a b c", "a b", "a d d"]
+        ids, mask = fake_tokenize(sentences)
+
+        class _Tok:
+            def __call__(self, text, **kw):
+                i, m = fake_tokenize(text)
+                return {
+                    "input_ids": torch.as_tensor(i),
+                    "attention_mask": torch.as_tensor(m),
+                }
+
+        ds = TextDataset(sentences, _Tok(), max_length=16, idf=True)
+        ours = _tokens_idf(ids, mask)
+        for tok, val in ds.tokens_idf.items():
+            if tok == 0:
+                continue  # padding id: masked out on our side
+            assert math.isclose(ours.get(tok, ours["__default__"]), val, rel_tol=1e-9), tok

@@ -171,8 +171,8 @@ class TestSentenceStoreLifecycle:
         res = infolm(["b a"], ["a b"], masked_lm=fake_masked_lm)
         np.testing.assert_allclose(float(res), 0.0, atol=1e-4)
 
-    def test_bert_unsupported_kwargs_raise(self):
-        with pytest.raises(NotImplementedError, match="idf"):
+    def test_bert_idf_needs_tokenize_with_custom_encoder(self):
+        with pytest.raises(ValueError, match="tokenize"):
             bert_score(["a"], ["a"], encoder=fake_encoder, idf=True)
 
     def test_negative_best_match_not_clamped(self):

@@ -84,7 +84,7 @@ class ComplexScaleInvariantSignalNoiseRatio(_MeanOverSamplesMetric):
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(zero_mean, bool):
-            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+            raise ValueError(f"Argument `zero_mean` must be a bool, but got {zero_mean}")
         self.zero_mean = zero_mean
 
     def _batch_values(self, preds: Array, target: Array) -> Array:
@@ -142,7 +142,7 @@ class SourceAggregatedSignalDistortionRatio(_MeanOverSamplesMetric):
         if not isinstance(scale_invariant, bool):
             raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
         if not isinstance(zero_mean, bool):
-            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+            raise ValueError(f"Argument `zero_mean` must be a bool, but got {zero_mean}")
         self.scale_invariant = scale_invariant
         self.zero_mean = zero_mean
 

@@ -87,12 +87,12 @@ class Accuracy(_ClassificationTaskWrapper):
             return BinaryAccuracy(threshold, **kwargs)
         if task == ClassificationTask.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             if not isinstance(top_k, int):
                 raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
             return MulticlassAccuracy(num_classes, top_k, average, **kwargs)
         if task == ClassificationTask.MULTILABEL:
             if not isinstance(num_labels, int):
-                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
             return MultilabelAccuracy(num_labels, threshold, average, **kwargs)
         raise ValueError(f"Task {task} not supported!")

@@ -75,6 +75,6 @@ class CohenKappa(_ClassificationTaskWrapper):
             return BinaryCohenKappa(threshold, **kwargs)
         if task == ClassificationTaskNoMultilabel.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             return MulticlassCohenKappa(num_classes, **kwargs)
         raise ValueError(f"Task {task} not supported!")

@@ -122,10 +122,10 @@ class ExactMatch(_ClassificationTaskWrapper):
         })
         if task == ClassificationTaskNoBinary.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             return MulticlassExactMatch(num_classes, **kwargs)
         if task == ClassificationTaskNoBinary.MULTILABEL:
             if not isinstance(num_labels, int):
-                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
             return MultilabelExactMatch(num_labels, threshold, **kwargs)
         raise ValueError(f"Task {task} not supported!")

@@ -125,13 +125,13 @@ class FBetaScore(_ClassificationTaskWrapper):
             return BinaryFBetaScore(beta, threshold, **kwargs)
         if task == ClassificationTask.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             if not isinstance(top_k, int):
                 raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
             return MulticlassFBetaScore(beta, num_classes, top_k, average, **kwargs)
         if task == ClassificationTask.MULTILABEL:
             if not isinstance(num_labels, int):
-                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
             return MultilabelFBetaScore(beta, num_labels, threshold, average, **kwargs)
         raise ValueError(f"Task {task} not supported!")
 
@@ -152,12 +152,12 @@ class F1Score(_ClassificationTaskWrapper):
             return BinaryF1Score(threshold, **kwargs)
         if task == ClassificationTask.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             if not isinstance(top_k, int):
                 raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
             return MulticlassF1Score(num_classes, top_k, average, **kwargs)
         if task == ClassificationTask.MULTILABEL:
             if not isinstance(num_labels, int):
-                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
             return MultilabelF1Score(num_labels, threshold, average, **kwargs)
         raise ValueError(f"Task {task} not supported!")

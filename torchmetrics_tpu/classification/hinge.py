@@ -118,6 +118,6 @@ class HingeLoss(_ClassificationTaskWrapper):
             return BinaryHingeLoss(squared, **kwargs)
         if task == ClassificationTaskNoMultilabel.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             return MulticlassHingeLoss(num_classes, squared, multiclass_mode, **kwargs)
         raise ValueError(f"Task {task} not supported!")

@@ -154,13 +154,13 @@ class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
             return BinaryPrecisionAtFixedRecall(min_recall, thresholds, ignore_index, validate_args, **kwargs)
         if task == ClassificationTask.MULTICLASS:
             if not isinstance(num_classes, int):
-                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
             return MulticlassPrecisionAtFixedRecall(
                 num_classes, min_recall, thresholds, ignore_index, validate_args, **kwargs
             )
         if task == ClassificationTask.MULTILABEL:
             if not isinstance(num_labels, int):
-                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
             return MultilabelPrecisionAtFixedRecall(
                 num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs
             )

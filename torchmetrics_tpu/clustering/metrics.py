@@ -140,7 +140,7 @@ class VMeasureScore(_LabelPairMetric):
     def __init__(self, beta: Union[int, float] = 1.0, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not (isinstance(beta, (int, float)) and beta > 0):
-            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+            raise ValueError(f"Argument `beta` must be a positive float. Got {beta}.")
         self.beta = beta
 
     def _functional(self, preds, target):

@@ -232,69 +232,59 @@ class MetricCollection:
         return self._groups
 
     # -------------------------------------------------------------- dict-likes
+    def _flatten_collection(self, name: Optional[str], coll: "MetricCollection") -> Iterator[Tuple[str, Metric]]:
+        """Yield a nested collection's members as (registration name, metric) pairs, tagging each
+        member with the inner collection's affixes (reference semantics, ``collections.py:414-424``)."""
+        for key, member in coll.items(keep_base=False):
+            member.prefix = coll.prefix
+            member.postfix = coll.postfix
+            member._from_collection = True
+            yield (f"{name}_{key}" if name is not None else key, member)
+
     def add_metrics(
         self, metrics: Union[Metric, Sequence, Dict[str, Any]], *additional_metrics: Metric
     ) -> None:
-        """Register metrics (reference ``collections.py:380-456``); nested collections are flattened."""
-        if isinstance(metrics, Metric):
-            metrics = [metrics]
-        if isinstance(metrics, MetricCollection):
-            metrics = [metrics]
-        if isinstance(metrics, Sequence) and not isinstance(metrics, (str, bytes)):
-            metrics = list(metrics)
-            remain: list = []
-            for m in additional_metrics:
-                sel = metrics if isinstance(m, (Metric, MetricCollection)) else remain
-                sel.append(m)
-            if remain:
-                rank_zero_warn(
-                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
-                )
-        elif additional_metrics:
-            raise ValueError(
-                f"You have passes extra arguments {additional_metrics} which are not compatible"
-                f" with first passed dictionary {metrics} so they will be ignored."
-            )
+        """Register metrics (reference ``collections.py:380-456``); nested collections are flattened.
 
+        Accepts a single metric/collection, a sequence of them (positional extras fold in, with
+        a warning for non-metrics), or a dict keyed by registration name (no extras allowed).
+        """
+        # --- normalise the input into (explicit_name | None, metric) pairs -----------------
+        if isinstance(metrics, (Metric, MetricCollection)):
+            metrics = [metrics]
+        pairs: List[Tuple[Optional[str], Any]] = []
         if isinstance(metrics, dict):
-            for name in sorted(metrics.keys()):
-                metric = metrics[name]
-                if not isinstance(metric, (Metric, MetricCollection)):
-                    raise ValueError(
-                        f"Value {metric} belonging to key {name} is not an instance of"
-                        " `Metric` or `MetricCollection`"
-                    )
-                if isinstance(metric, Metric):
-                    self._modules[name] = metric
-                else:
-                    for k, v in metric.items(keep_base=False):
-                        v.postfix = metric.postfix
-                        v.prefix = metric.prefix
-                        v._from_collection = True
-                        self._modules[f"{name}_{k}"] = v
-        elif isinstance(metrics, Sequence):
-            for metric in metrics:
-                if not isinstance(metric, (Metric, MetricCollection)):
-                    raise ValueError(
-                        f"Input {metric} to `MetricCollection` is not a instance of"
-                        " `Metric` or `MetricCollection`"
-                    )
-                if isinstance(metric, Metric):
-                    name = metric.__class__.__name__
-                    if name in self._modules:
-                        raise ValueError(f"Encountered two metrics both named {name}")
-                    self._modules[name] = metric
-                else:
-                    for k, v in metric.items(keep_base=False):
-                        v.postfix = metric.postfix
-                        v.prefix = metric.prefix
-                        v._from_collection = True
-                        self._modules[k] = v
+            if additional_metrics:
+                raise ValueError(
+                    f"Received extra positional arguments {additional_metrics} alongside a dict of"
+                    f" metrics {metrics}; name every metric in the dict instead."
+                )
+            pairs = [(name, metrics[name]) for name in sorted(metrics)]
+        elif isinstance(metrics, Sequence) and not isinstance(metrics, (str, bytes)):
+            dropped = [m for m in additional_metrics if not isinstance(m, (Metric, MetricCollection))]
+            if dropped:
+                rank_zero_warn(f"Ignoring extra non-Metric arguments {dropped}.")
+            kept = [m for m in additional_metrics if isinstance(m, (Metric, MetricCollection))]
+            pairs = [(None, m) for m in [*metrics, *kept]]
         else:
             raise ValueError(
                 "Unknown input to MetricCollection. Expected, `Metric`, `MetricCollection` or `dict`/`sequence` of"
                 f" the previous, but got {metrics}"
             )
+
+        # --- register: metrics directly, collections flattened member-by-member ------------
+        for name, metric in pairs:
+            if isinstance(metric, MetricCollection):
+                for key, member in self._flatten_collection(name, metric):
+                    self._modules[key] = member
+            elif isinstance(metric, Metric):
+                key = name if name is not None else metric.__class__.__name__
+                if name is None and key in self._modules:
+                    raise ValueError(f"Encountered two metrics both named {key}")
+                self._modules[key] = metric
+            else:
+                what = f"Value {metric} belonging to key {name}" if name is not None else f"Input {metric}"
+                raise ValueError(f"{what} is not an instance of `Metric` or `MetricCollection`")
 
         self._groups_checked = False
         if self._enable_compute_groups:

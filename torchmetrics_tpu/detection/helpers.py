@@ -1,63 +1,72 @@
-"""Detection input validation (reference ``src/torchmetrics/detection/helpers.py``)."""
+"""Detection input validation (same contract as reference ``src/torchmetrics/detection/helpers.py``).
+
+Structure: a declarative field spec per side (required keys + which fields must share their
+leading dimension), checked by one generic pass — rather than per-key inline checks.
+"""
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Dict, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
+
+_GEOMETRY_KEY = {"bbox": "boxes", "segm": "masks"}
 
 
 def _is_arraylike(x) -> bool:
     return isinstance(x, (jnp.ndarray, np.ndarray)) or hasattr(x, "shape")
 
 
+def _leading_dim(x) -> int:
+    shape = jnp.shape(x)
+    return int(shape[0]) if shape else 0
+
+
+def _check_sample_dicts(
+    side: str, samples: Sequence[Dict], required: Tuple[str, ...], check_lengths: bool = True
+) -> None:
+    """Every sample dict must carry ``required`` keys; with ``check_lengths`` those fields must
+    also agree on their number of instances (shared leading dimension)."""
+    for key in required:
+        if any(key not in sample for sample in samples):
+            raise ValueError(f"Expected all dicts in `{side}` to contain the `{key}` key")
+    if not check_lengths:
+        return
+    for i, sample in enumerate(samples):
+        lengths = {key: _leading_dim(sample[key]) for key in required}
+        if len(set(lengths.values())) > 1:
+            detail = ", ".join(f"{k}={n}" for k, n in lengths.items())
+            raise ValueError(
+                f"Fields of sample {i} in `{side}` disagree on the number of instances ({detail})"
+            )
+
+
 def _input_validator(
     preds: Sequence[Dict],
     targets: Sequence[Dict],
-    iou_type: str = "bbox",
+    iou_type: Union[str, Tuple[str, ...]] = "bbox",
     ignore_score: bool = False,
 ) -> None:
     """Shape/type contract for list-of-dict detection inputs (reference ``helpers.py:19-81``)."""
-    if isinstance(iou_type, str):
-        iou_type = (iou_type,)
-    name_map = {"bbox": "boxes", "segm": "masks"}
-    if any(tp not in name_map for tp in iou_type):
-        raise Exception(f"IOU type {iou_type} is not supported")
-    item_val_name = [name_map[tp] for tp in iou_type]
+    iou_types = (iou_type,) if isinstance(iou_type, str) else tuple(iou_type)
+    unknown = [tp for tp in iou_types if tp not in _GEOMETRY_KEY]
+    if unknown:
+        raise Exception(f"IOU type {iou_types} is not supported")
+    geometry = tuple(_GEOMETRY_KEY[tp] for tp in iou_types)
 
-    if not isinstance(preds, Sequence):
-        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
-    if not isinstance(targets, Sequence):
-        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    for side, value in (("preds", preds), ("target", targets)):
+        if not isinstance(value, Sequence):
+            raise ValueError(f"Expected argument `{side}` to be of type Sequence, but got {value}")
     if len(preds) != len(targets):
         raise ValueError(
             f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
         )
-    for k in [*item_val_name, "labels"] + (["scores"] if not ignore_score else []):
-        if any(k not in p for p in preds):
-            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
-    for k in [*item_val_name, "labels"]:
-        if any(k not in p for p in targets):
-            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
-    for i, item in enumerate(targets):
-        for ivn in item_val_name:
-            if jnp.shape(item[ivn])[0] != jnp.shape(item["labels"])[0]:
-                raise ValueError(
-                    f"Input '{ivn}' and labels of sample {i} in targets have a"
-                    f" different length (expected {jnp.shape(item[ivn])[0]} labels,"
-                    f" got {jnp.shape(item['labels'])[0]})"
-                )
-    if ignore_score:
-        return
-    for i, item in enumerate(preds):
-        for ivn in item_val_name:
-            if not (jnp.shape(item[ivn])[0] == jnp.shape(item["labels"])[0] == jnp.shape(item["scores"])[0]):
-                raise ValueError(
-                    f"Input '{ivn}', labels and scores of sample {i} in predictions have a"
-                    f" different length (expected {jnp.shape(item[ivn])[0]} labels and scores,"
-                    f" got {jnp.shape(item['labels'])[0]} labels and {jnp.shape(item['scores'])[0]} scores)"
-                )
+    # with ignore_score the reference checks preds key presence only, not length agreement
+    # (reference helpers.py:51-53 returns before the preds length loop)
+    pred_fields = geometry + (("labels",) if ignore_score else ("labels", "scores"))
+    _check_sample_dicts("preds", preds, pred_fields, check_lengths=not ignore_score)
+    _check_sample_dicts("target", targets, geometry + ("labels",))
 
 
 def _fix_empty_boxes(boxes) -> jnp.ndarray:

@@ -52,10 +52,10 @@ class IntersectionOverUnion(Metric):
         self.box_format = box_format
         self.iou_threshold = iou_threshold
         if not isinstance(class_metrics, bool):
-            raise ValueError("Expected argument `class_metrics` to be a boolean")
+            raise ValueError('Argument `class_metrics` must be a boolean')
         self.class_metrics = class_metrics
         if not isinstance(respect_labels, bool):
-            raise ValueError("Expected argument `respect_labels` to be a boolean")
+            raise ValueError('Argument `respect_labels` must be a boolean')
         self.respect_labels = respect_labels
         self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
         self.add_state("iou_matrix", [], dist_reduce_fx=None)

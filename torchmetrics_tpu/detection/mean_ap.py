@@ -109,7 +109,7 @@ class MeanAveragePrecision(Metric):
         self.rec_thresholds = list(rec_thresholds or np.linspace(0.0, 1.0, 101).round(2).tolist())
         self.max_detection_thresholds = sorted(int(x) for x in (max_detection_thresholds or [1, 10, 100]))
         if not isinstance(class_metrics, bool):
-            raise ValueError("Expected argument `class_metrics` to be a boolean")
+            raise ValueError('Argument `class_metrics` must be a boolean')
         self.class_metrics = class_metrics
         self.add_state("detections", [], dist_reduce_fx=None)
         self.add_state("detection_scores", [], dist_reduce_fx=None)

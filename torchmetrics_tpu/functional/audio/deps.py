@@ -57,7 +57,7 @@ def perceptual_evaluation_speech_quality(
     import pesq as pesq_backend
 
     if fs not in (8000, 16000):
-        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        raise ValueError(f"Argument `fs` must be either 8000 or 16000 but got {fs}")
     if mode not in ("wb", "nb"):
         raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
     _check_same_shape(preds, target)

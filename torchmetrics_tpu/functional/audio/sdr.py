@@ -15,6 +15,9 @@ import jax.numpy as jnp
 from jax import Array
 
 from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+_warned_cg_iter = False
 
 
 def _symmetric_toeplitz(r0: Array) -> Array:
@@ -48,6 +51,14 @@ def signal_distortion_ratio(
     on TPU a single dense solve of the ``filter_length``² system is one fused kernel, which is
     the regime the reference's conjugate-gradient path exists to avoid on CPU.
     """
+    global _warned_cg_iter
+    if use_cg_iter is not None and not _warned_cg_iter:
+        _warned_cg_iter = True
+        rank_zero_warn(
+            "`use_cg_iter` is accepted for API parity but ignored on TPU: the direct batched "
+            "Toeplitz solve is always used, so numerics may differ slightly from the reference's "
+            "conjugate-gradient approximation."
+        )
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
     _check_same_shape(preds, target)

@@ -71,7 +71,7 @@ def _binary_auroc_arg_validation(
 ) -> None:
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
     if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
-        raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        raise ValueError(f"Arguments `max_fpr` must be a float in range (0, 1], but got: {max_fpr}")
 
 
 def _binary_auroc_compute(
@@ -261,10 +261,10 @@ def auroc(
         return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

@@ -58,11 +58,11 @@ def _binary_calibration_error_arg_validation(
     n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
 ) -> None:
     if not isinstance(n_bins, int) or n_bins < 1:
-        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+        raise ValueError(f"Argument `n_bins` must be an integer larger than 0, but got {n_bins}")
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _binary_calibration_error_tensor_validation(
@@ -122,7 +122,7 @@ def _multiclass_calibration_error_arg_validation(
     num_classes: int, n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
-        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
     _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
 
 
@@ -132,7 +132,7 @@ def _multiclass_calibration_error_tensor_validation(
     if preds.ndim != target.ndim + 1:
         raise ValueError("Expected `preds` to have one more dimension than `target`")
     if not jnp.issubdtype(preds.dtype, jnp.floating):
-        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+        raise ValueError(f"`preds` must be a float tensor, but got {preds.dtype}")
     if preds.shape[1] != num_classes:
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_classes {num_classes}")
     if is_traced(preds, target):
@@ -200,6 +200,6 @@ def calibration_error(
         return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
     if task == ClassificationTaskNoMultilabel.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

@@ -72,6 +72,6 @@ def cohen_kappa(preds, target, task: str, threshold: float = 0.5, num_classes: O
         return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
     if task == ClassificationTaskNoMultilabel.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

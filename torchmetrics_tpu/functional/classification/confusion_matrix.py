@@ -41,9 +41,9 @@ def _binary_confusion_matrix_arg_validation(
     threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
 ) -> None:
     if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
-        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+        raise ValueError(f"Argument `threshold` must be a float in the [0,1] range, but got {threshold}.")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
     allowed_normalize = ("true", "pred", "all", "none", None)
     if normalize not in allowed_normalize:
         raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
@@ -121,9 +121,9 @@ def _multiclass_confusion_matrix_arg_validation(
     num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
-        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
     allowed_normalize = ("true", "pred", "all", "none", None)
     if normalize not in allowed_normalize:
         raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
@@ -134,7 +134,7 @@ def _multiclass_confusion_matrix_tensor_validation(
 ) -> None:
     if preds.ndim == target.ndim + 1:
         if not jnp.issubdtype(preds.dtype, jnp.floating):
-            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+            raise ValueError('If `preds` have one dimension more than `target`, `preds` must be a float tensor.')
         if preds.shape[1] != num_classes:
             raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
                              " equal to number of classes.")
@@ -205,11 +205,11 @@ def _multilabel_confusion_matrix_arg_validation(
     num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
 ) -> None:
     if not isinstance(num_labels, int) or num_labels < 2:
-        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        raise ValueError(f"Argument `num_labels` must be an integer larger than 1, but got {num_labels}")
     if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
-        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+        raise ValueError(f"Argument `threshold` must be a float, but got {threshold}.")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
     allowed_normalize = ("true", "pred", "all", "none", None)
     if normalize not in allowed_normalize:
         raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
@@ -294,10 +294,10 @@ def confusion_matrix(
         return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

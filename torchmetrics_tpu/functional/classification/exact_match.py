@@ -78,11 +78,11 @@ def exact_match(preds, target, task: str, num_classes: Optional[int] = None, num
     task = ClassificationTaskNoBinary.from_str(task)
     if task == ClassificationTaskNoBinary.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
     if task == ClassificationTaskNoBinary.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average,
                                       ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

@@ -38,7 +38,7 @@ def _fbeta_reduce(
 
 def _validate_beta(beta: float) -> None:
     if not (isinstance(beta, float) and beta > 0):
-        raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+        raise ValueError(f"Argument `beta` must be a float larger than 0, but got {beta}.")
 
 
 def binary_fbeta_score(preds, target, beta: float, threshold: float = 0.5, multidim_average: str = "global",
@@ -104,12 +104,12 @@ def fbeta_score(preds, target, task: str, beta: float = 1.0, threshold: float = 
         return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_fbeta_score(preds, target, beta, num_classes, average, top_k, multidim_average,
                                       ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_fbeta_score(preds, target, beta, num_labels, threshold, average, multidim_average,
                                       ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

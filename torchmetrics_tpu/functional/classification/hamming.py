@@ -68,12 +68,12 @@ def hamming_distance(preds, target, task: str, threshold: float = 0.5, num_class
         return binary_hamming_distance(preds, target, threshold, multidim_average, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_hamming_distance(preds, target, num_classes, average, top_k, multidim_average,
                                            ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_hamming_distance(preds, target, num_labels, threshold, average, multidim_average,
                                            ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

@@ -21,9 +21,9 @@ def _hinge_loss_compute(measure: Array, total: Array) -> Array:
 
 def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
     if not isinstance(squared, bool):
-        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+        raise ValueError(f"Argument `squared` must be an bool but got {squared}")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _binary_hinge_loss_tensor_validation(
@@ -90,7 +90,7 @@ def _multiclass_hinge_loss_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
-        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
     _binary_hinge_loss_arg_validation(squared, ignore_index)
     if multiclass_mode not in ("crammer-singer", "one-vs-all"):
         raise ValueError(
@@ -105,7 +105,7 @@ def _multiclass_hinge_loss_tensor_validation(
     if preds.ndim != target.ndim + 1:
         raise ValueError("Expected `preds` to have one more dimension than `target`")
     if not jnp.issubdtype(preds.dtype, jnp.floating):
-        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+        raise ValueError(f"`preds` must be a float tensor, but got {preds.dtype}")
     if preds.shape[1] != num_classes:
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_classes {num_classes}")
     if is_traced(preds, target):
@@ -188,7 +188,7 @@ def hinge_loss(
         return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
     if task == ClassificationTaskNoMultilabel.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_hinge_loss(
             preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args
         )

@@ -95,12 +95,12 @@ def precision(preds, target, task: str, threshold: float = 0.5, num_classes: Opt
         return binary_precision(preds, target, threshold, multidim_average, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_precision(preds, target, num_classes, average, top_k, multidim_average,
                                     ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_precision(preds, target, num_labels, threshold, average, multidim_average,
                                     ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")
@@ -115,12 +115,12 @@ def recall(preds, target, task: str, threshold: float = 0.5, num_classes: Option
         return binary_recall(preds, target, threshold, multidim_average, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_recall(preds, target, num_classes, average, top_k, multidim_average,
                                  ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_recall(preds, target, num_labels, threshold, average, multidim_average,
                                  ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

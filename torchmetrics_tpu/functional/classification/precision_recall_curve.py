@@ -152,7 +152,7 @@ def _binary_precision_recall_curve_arg_validation(
 ) -> None:
     _validate_thresholds_arg(thresholds)
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _binary_precision_recall_curve_tensor_validation(
@@ -241,7 +241,7 @@ def _multiclass_precision_recall_curve_arg_validation(
     average: Optional[str] = None,
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
-        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
     if average not in (None, "micro", "macro"):
         raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
@@ -253,7 +253,7 @@ def _multiclass_precision_recall_curve_tensor_validation(
     if preds.ndim != target.ndim + 1:
         raise ValueError("Expected `preds` to have one more dimension than `target`")
     if not jnp.issubdtype(preds.dtype, jnp.floating):
-        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+        raise ValueError(f"`preds` must be a float tensor, but got {preds.dtype}")
     if preds.shape[1] != num_classes:
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of classes")
     if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
@@ -380,7 +380,7 @@ def _multilabel_precision_recall_curve_arg_validation(
     num_labels: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None
 ) -> None:
     if not isinstance(num_labels, int) or num_labels < 2:
-        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        raise ValueError(f"Argument `num_labels` must be an integer larger than 1, but got {num_labels}")
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
 
 
@@ -389,7 +389,7 @@ def _multilabel_precision_recall_curve_tensor_validation(
 ) -> None:
     _check_same_shape(preds, target)
     if not jnp.issubdtype(preds.dtype, jnp.floating):
-        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+        raise ValueError(f"`preds` must be a float tensor, but got {preds.dtype}")
     if preds.shape[1] != num_labels:
         raise ValueError(
             f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of labels {num_labels}"
@@ -509,12 +509,12 @@ def precision_recall_curve(
         return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_precision_recall_curve(
             preds, target, num_classes, thresholds, None, ignore_index, validate_args
         )
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

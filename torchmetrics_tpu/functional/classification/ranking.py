@@ -23,9 +23,9 @@ def _rank_data(x: Array) -> Array:
 
 def _multilabel_ranking_arg_validation(num_labels: int, ignore_index: Optional[int] = None) -> None:
     if not isinstance(num_labels, int) or num_labels < 2:
-        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        raise ValueError(f"Argument `num_labels` must be an integer larger than 1, but got {num_labels}")
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _multilabel_ranking_tensor_validation(
@@ -33,7 +33,7 @@ def _multilabel_ranking_tensor_validation(
 ) -> None:
     _check_same_shape(preds, target)
     if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
-        raise ValueError(f"Expected `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+        raise ValueError(f"`preds` must be a float tensor, but got {jnp.asarray(preds).dtype}")
     if preds.shape[1] != num_labels:
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal num_labels {num_labels}")
     if is_traced(preds, target):

@@ -67,7 +67,7 @@ def _binary_recall_at_fixed_precision_arg_validation(
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
     if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
         raise ValueError(
-            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+            f"Argument `min_precision` must be an float in the [0,1] range, but got {min_precision}"
         )
 
 
@@ -107,7 +107,7 @@ def _multiclass_recall_at_fixed_precision_arg_validation(
     _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
     if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
         raise ValueError(
-            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+            f"Argument `min_precision` must be an float in the [0,1] range, but got {min_precision}"
         )
 
 
@@ -157,7 +157,7 @@ def _multilabel_recall_at_fixed_precision_arg_validation(
     _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
     if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
         raise ValueError(
-            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+            f"Argument `min_precision` must be an float in the [0,1] range, but got {min_precision}"
         )
 
 

@@ -49,8 +49,7 @@ def _roc_from_exact(preds: np.ndarray, target: np.ndarray, weight: np.ndarray) -
     thres = np.hstack([thres[0] + 1.0, thres])
     if fps[-1] <= 0:
         rank_zero_warn(
-            "No negative samples in targets, false positive value should be meaningless."
-            " Returning zero tensor in false positive score",
+            'No negative samples in targets, the false-positive rate here is meaningless. Returning zero tensor in false positive score',
             UserWarning,
         )
         fpr = np.zeros_like(thres)
@@ -58,8 +57,7 @@ def _roc_from_exact(preds: np.ndarray, target: np.ndarray, weight: np.ndarray) -
         fpr = fps / fps[-1]
     if tps[-1] <= 0:
         rank_zero_warn(
-            "No positive samples in targets, true positive value should be meaningless."
-            " Returning zero tensor in true positive score",
+            'No positive samples in targets, the true-positive rate here is meaningless. Returning zero tensor in true positive score',
             UserWarning,
         )
         tpr = np.zeros_like(thres)
@@ -210,10 +208,10 @@ def roc(
         return binary_roc(preds, target, thresholds, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_roc(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
     raise ValueError(f"Not handled value: {task}")

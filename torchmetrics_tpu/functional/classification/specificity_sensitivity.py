@@ -48,7 +48,7 @@ def _specificity_at_sensitivity(
 def _val_arg(min_sensitivity: float) -> None:
     if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
         raise ValueError(
-            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+            f"Argument `min_sensitivity` must be an float in the [0,1] range, but got {min_sensitivity}"
         )
 
 

@@ -41,13 +41,13 @@ def _binary_stat_scores_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
-        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+        raise ValueError(f"Argument `threshold` must be a float in the [0,1] range, but got {threshold}.")
     if multidim_average not in ("global", "samplewise"):
         raise ValueError(
             f"Expected argument `multidim_average` to be one of ['global', 'samplewise'], but got {multidim_average}"
         )
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _binary_stat_scores_tensor_validation(
@@ -58,7 +58,7 @@ def _binary_stat_scores_tensor_validation(
 ) -> None:
     _check_same_shape(preds, target)
     if multidim_average != "global" and preds.ndim < 2:
-        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+        raise ValueError('Inputs must be at least 2D when multidim_average is set to `samplewise`')
     if is_traced(preds, target):
         return
     t = np.asarray(target)
@@ -157,7 +157,7 @@ def _multiclass_stat_scores_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
-        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
     if not isinstance(top_k, int) and top_k < 1:
         raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
     if top_k > num_classes:
@@ -172,7 +172,7 @@ def _multiclass_stat_scores_arg_validation(
             f"Expected argument `multidim_average` to be one of ['global', 'samplewise'], but got {multidim_average}"
         )
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _multiclass_stat_scores_tensor_validation(
@@ -185,7 +185,7 @@ def _multiclass_stat_scores_tensor_validation(
 ) -> None:
     if preds.ndim == target.ndim + 1:
         if not jnp.issubdtype(preds.dtype, jnp.floating):
-            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+            raise ValueError('If `preds` have one dimension more than `target`, `preds` must be a float tensor.')
         if preds.shape[1] != num_classes:
             raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
                              " equal to number of classes.")
@@ -348,9 +348,9 @@ def _multilabel_stat_scores_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     if not isinstance(num_labels, int) or num_labels < 2:
-        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        raise ValueError(f"Argument `num_labels` must be an integer larger than 1, but got {num_labels}")
     if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
-        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+        raise ValueError(f"Argument `threshold` must be a float, but got {threshold}.")
     allowed_average = ("micro", "macro", "weighted", "none", None)
     if average not in allowed_average:
         raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
@@ -359,7 +359,7 @@ def _multilabel_stat_scores_arg_validation(
             f"Expected argument `multidim_average` to be one of ['global', 'samplewise'], but got {multidim_average}"
         )
     if ignore_index is not None and not isinstance(ignore_index, int):
-        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        raise ValueError(f"Argument `ignore_index` must be either `None` or an integer, but got {ignore_index}")
 
 
 def _multilabel_stat_scores_tensor_validation(
@@ -376,7 +376,7 @@ def _multilabel_stat_scores_tensor_validation(
             f" but got {preds.shape[1]} and expected {num_labels}"
         )
     if multidim_average != "global" and preds.ndim < 3:
-        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+        raise ValueError('Inputs must be at least 3D when multidim_average is set to `samplewise`')
     if is_traced(preds, target):
         return
     t = np.asarray(target)
@@ -480,13 +480,13 @@ def stat_scores(
         return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
-            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_stat_scores(
             preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
         )
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
-            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            raise ValueError(f"`num_labels` must be `int` but `{type(num_labels)} was passed.`")
         return multilabel_stat_scores(
             preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
         )

@@ -24,7 +24,7 @@ from jax import Array
 def check_cluster_labels(preds, target) -> None:
     """Host-side validation (reference ``utils.py:185``)."""
     if jnp.ndim(preds) != 1 or jnp.ndim(target) != 1:
-        raise ValueError(f"Expected 1d `preds` and `target` but got {jnp.ndim(preds)} and {jnp.ndim(target)}.")
+        raise ValueError(f"`preds` and `target` must be 1d, but got {jnp.ndim(preds)} and {jnp.ndim(target)}.")
     if jnp.shape(preds) != jnp.shape(target):
         raise ValueError(f"Expected `preds` and `target` to have the same shape, got {jnp.shape(preds)} and {jnp.shape(target)}.")
     for name, x in (("preds", preds), ("target", target)):
@@ -98,13 +98,13 @@ def calculate_pair_cluster_confusion_matrix(
     that are together in ``target`` but split in ``preds``.
     """
     if preds is None and target is None and contingency is None:
-        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+        raise ValueError('You must provide either `preds` and `target` or `contingency`.')
     if preds is not None and target is not None and contingency is not None:
-        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+        raise ValueError('You must provide either `preds` and `target` or `contingency`, not both.')
     if preds is not None and target is not None:
         contingency = calculate_contingency_matrix(preds, target)
     if contingency is None:
-        raise ValueError("Must provide `contingency` if `preds` and `target` are not provided.")
+        raise ValueError('You must provide `contingency` if `preds` and `target` are not provided.')
     contingency = contingency.astype(jnp.float32)
     num_samples = contingency.sum()
     sum_c = contingency.sum(axis=1)

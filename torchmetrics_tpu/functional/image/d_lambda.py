@@ -63,6 +63,6 @@ def spectral_distortion_index(
 ) -> Array:
     """D-lambda (reference ``d_lambda.py:114-160``)."""
     if not isinstance(p, int) or p <= 0:
-        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        raise ValueError(f"`p` must be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_check_inputs(preds, target)
     return _spectral_distortion_index_compute(preds, target, p, reduction)

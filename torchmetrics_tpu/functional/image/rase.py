@@ -47,7 +47,7 @@ def _rase_compute(
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
     """RASE (reference ``rase.py:71-103``)."""
     if not isinstance(window_size, int) or window_size < 1:
-        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        raise ValueError('Argument `window_size` must be a positive integer.')
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
     img_shape = target.shape[1:]

@@ -70,7 +70,7 @@ def root_mean_squared_error_using_sliding_window(
 ):
     """Sliding-window RMSE (reference ``rmse_sw.py:112-151``)."""
     if not isinstance(window_size, int) or window_size < 1:
-        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        raise ValueError('Argument `window_size` must be a positive integer.')
     rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
         preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
     )

@@ -46,12 +46,12 @@ def _ssim_validate_args(kernel_size: Sequence[int], sigma: Sequence[float], ndim
         )
     if len(kernel_size) not in (2, 3):
         raise ValueError(
-            f"Expected `kernel_size` dimension to be 2 or 3. `kernel_size` dimensionality: {len(kernel_size)}"
+            f"`kernel_size` dimension must be 2 or 3. `kernel_size` dimensionality: {len(kernel_size)}"
         )
     if any(x % 2 == 0 or x <= 0 for x in kernel_size):
-        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+        raise ValueError(f"`kernel_size` must have odd positive number. Got {kernel_size}.")
     if any(y <= 0 for y in sigma):
-        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+        raise ValueError(f"`sigma` must have positive number. Got {sigma}.")
 
 
 def _ssim_update(

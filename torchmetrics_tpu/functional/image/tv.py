@@ -10,7 +10,7 @@ from jax import Array
 def _total_variation_update(img: Array) -> Tuple[Array, int]:
     """Reference ``tv.py:21-32``."""
     if img.ndim != 4:
-        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+        raise RuntimeError(f"Input `img` must be an 4D tensor, but got {img.shape}")
     img = jnp.asarray(img, jnp.float32)
     diff1 = img[..., 1:, :] - img[..., :-1, :]
     diff2 = img[..., :, 1:] - img[..., :, :-1]
@@ -28,7 +28,7 @@ def _total_variation_compute(
         return jnp.sum(score)
     if reduction is None or reduction == "none":
         return score
-    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+    raise ValueError("Argument `reduction` must be either 'sum', 'mean', 'none' or None")
 
 
 def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:

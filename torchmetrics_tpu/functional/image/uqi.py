@@ -78,9 +78,9 @@ def _uqi_compute(
             f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
         )
     if any(x % 2 == 0 or x <= 0 for x in kernel_size):
-        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+        raise ValueError(f"`kernel_size` must have odd positive number. Got {kernel_size}.")
     if any(y <= 0 for y in sigma):
-        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+        raise ValueError(f"`sigma` must have positive number. Got {sigma}.")
     return reduce(_uqi_map(preds, target, kernel_size, sigma), reduction)
 
 

@@ -57,36 +57,9 @@ def _resolve_encoders(model_name_or_path: Union[str, EncoderPair], rescale_uint8
             "Expected `model_name_or_path` to be a HuggingFace CLIP model id or a pair of callables"
             f" (image_encoder, text_encoder), got {model_name_or_path!r}"
         )
-    try:
-        import torch
-        from transformers import CLIPModel, CLIPProcessor
+    from torchmetrics_tpu.utils.pretrained import clip_encoders
 
-        model = CLIPModel.from_pretrained(model_name_or_path)
-        processor = CLIPProcessor.from_pretrained(model_name_or_path)
-    except Exception as err:
-        raise ModuleNotFoundError(
-            f"Loading CLIP checkpoint {model_name_or_path!r} failed (no local cache and no network"
-            " egress in this build). Pass `model_name_or_path` as a pair of callables"
-            " (image_encoder, text_encoder) instead."
-        ) from err
-
-    def image_encoder(images) -> Array:
-        imgs = [torch.as_tensor(np.asarray(i)) for i in images]
-        with torch.no_grad():
-            inp = processor(images=imgs, return_tensors="pt", padding=True, do_rescale=rescale_uint8)
-            feats = model.get_image_features(inp["pixel_values"])
-        return jnp.asarray(feats.numpy())
-
-    def text_encoder(text: Sequence[str]) -> Array:
-        with torch.no_grad():
-            inp = processor(text=list(text), return_tensors="pt", padding=True)
-            max_pos = model.config.text_config.max_position_embeddings
-            ids = inp["input_ids"][..., :max_pos]
-            mask = inp["attention_mask"][..., :max_pos]
-            feats = model.get_text_features(ids, mask)
-        return jnp.asarray(feats.numpy())
-
-    return image_encoder, text_encoder
+    return clip_encoders(model_name_or_path, rescale_uint8=rescale_uint8)
 
 
 def _normalize(x: Array) -> Array:
@@ -104,7 +77,7 @@ def _clip_score_update(
     if not isinstance(images, list):
         images = [images] if jnp.ndim(images) == 3 else list(images)
     if not all(jnp.ndim(i) == 3 for i in images):
-        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+        raise ValueError('All images must be 3d, but found an image with a different number of dimensions')
     if not isinstance(text, list):
         text = [text]
     if len(text) != len(images):
@@ -187,7 +160,7 @@ def clip_image_quality_assessment(
             " as (image_encoder, text_encoder) callables or a cached HuggingFace CLIP id."
         )
     if not (isinstance(data_range, (int, float)) and data_range > 0):
-        raise ValueError("Argument `data_range` should be a positive number.")
+        raise ValueError('Argument `data_range` must be a positive number.')
     images = jnp.asarray(images, jnp.float32)
     if images.ndim != 4:
         raise ValueError(f"Expected `images` to be a batched 4d tensor (N, C, H, W), got shape {images.shape}")

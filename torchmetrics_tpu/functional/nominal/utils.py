@@ -130,7 +130,7 @@ def _compute_bias_corrected_values(
 
 def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
     rank_zero_warn(
-        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+        f"Unable to compute {metric_name} using bias correction. Consider setting `bias_correction=False`."
     )
 
 

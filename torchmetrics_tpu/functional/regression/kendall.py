@@ -109,7 +109,7 @@ def kendall_rank_corrcoef(
     if variant not in _ALLOWED_VARIANTS:
         raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant}")
     if not isinstance(t_test, bool):
-        raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        raise ValueError(f"Argument `t_test` must be of a type `bool`, but got {t_test}.")
     if t_test and alternative not in ("two-sided", "less", "greater"):
         raise ValueError("Argument `alternative` is expected to be one of 'two-sided', 'less' or 'greater'.")
     preds = jnp.asarray(preds, jnp.float32)

@@ -13,7 +13,7 @@ from torchmetrics_tpu.utils.compute import _safe_xlogy
 def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, Array]:
     _check_same_shape(p, q)
     if p.ndim != 2 or q.ndim != 2:
-        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+        raise ValueError(f"Both p and q distribution must be 2D but got {p.ndim} and {q.ndim} respectively")
     p = p.astype(jnp.float32)
     q = q.astype(jnp.float32)
     if log_prob:

@@ -59,7 +59,7 @@ def _r2_score_compute(
             f" `uniform_average` or `variance_weighted`. Received {multioutput}."
         )
     if adjusted < 0 or not isinstance(adjusted, int):
-        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        raise ValueError('`adjusted` parameter must be an integer larger or equal to 0.')
     if adjusted != 0:
         if not is_traced(num_obs) and adjusted > float(num_obs) - 1:
             rank_zero_warn(

@@ -26,9 +26,9 @@ def _domain_check(preds: Array, target: Array, power: float) -> None:
     if power < 0 and np.any(p <= 0):
         raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
     if 1 <= power < 2 and (np.any(t < 0) or np.any(p <= 0)):
-        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+        raise ValueError(f"For power={power}, 'preds' must be strictly positive and 'targets' cannot be negative.")
     if power >= 2 and (np.any(t <= 0) or np.any(p <= 0)):
-        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' must be strictly positive.")
 
 
 def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, Array]:

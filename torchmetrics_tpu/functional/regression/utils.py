@@ -22,7 +22,7 @@ def _check_data_shape_to_num_outputs(
     cond2 = num_outputs > 1 and preds.ndim > 1 and num_outputs != preds.shape[1]
     if cond1 or cond2:
         raise ValueError(
-            f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
+            f"Argument `num_outputs` must match the second dimension of the input, but got {num_outputs}"
             f" and {preds.shape}"
         )
 

@@ -102,7 +102,7 @@ def retrieval_precision_recall_curve(
     if max_k is None:
         max_k = n
     if not (isinstance(max_k, int) and max_k > 0):
-        raise ValueError("`max_k` has to be a positive integer or None")
+        raise ValueError('`max_k` must be a positive integer or None')
     if not adaptive_k:
         ks = list(range(1, max_k + 1))
     else:

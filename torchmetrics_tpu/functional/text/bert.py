@@ -11,44 +11,62 @@ matching itself — the actual metric — runs as jnp MXU matmuls.
 """
 from __future__ import annotations
 
+import csv
+import math
+from collections import Counter
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
 Encoder = Callable[[List[str]], Tuple[Array, Array]]
+Tokenize = Callable[[List[str]], Tuple[np.ndarray, np.ndarray]]
+
+_DEFAULT_MODEL = "roberta-large"
 
 
 def _hf_encoder(model_name_or_path: str, num_layers: Optional[int] = None, max_length: int = 512) -> Encoder:
     """Build an encoder from a locally cached HuggingFace checkpoint."""
-    try:
-        import torch
-        from transformers import AutoModel, AutoTokenizer
+    from torchmetrics_tpu.utils.pretrained import bert_encoder
 
-        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
-        model = AutoModel.from_pretrained(model_name_or_path)
-        model.eval()
-    except Exception as err:
-        raise ModuleNotFoundError(
-            f"Loading checkpoint {model_name_or_path!r} failed (no local cache and no network egress"
-            " in this build). Pass an `encoder` callable `(sentences) -> (embeddings, mask)` instead."
-        ) from err
-
-    def encoder(sentences: List[str]) -> Tuple[Array, Array]:
-        with torch.no_grad():
-            batch = tokenizer(
-                sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length,
-                return_special_tokens_mask=True,
-            )
-            special = batch.pop("special_tokens_mask")
-            # keyword-only call: positional binding differs across architectures, and BERT-style
-            # tokenizers also emit token_type_ids that must be forwarded
-            out = model(**batch, output_hidden_states=True)
-            hidden = out.hidden_states[num_layers if num_layers is not None else -1]
-        mask = batch["attention_mask"] * (1 - special)
-        return jnp.asarray(hidden.numpy()), jnp.asarray(mask.numpy())
-
+    encoder, _ = bert_encoder(model_name_or_path, num_layers=num_layers, max_length=max_length)
     return encoder
+
+
+def _tokens_idf(ids: np.ndarray, mask: np.ndarray) -> Dict[int, float]:
+    """Inverse document frequencies over the reference corpus (reference
+    ``helper_embedding_metric.py:240-259``): idf(t) = log((N+1)/(df(t)+1)), with log(N+1) for
+    unseen tokens. ``ids``/``mask`` are (N, L); masked positions are ignored."""
+    n_sentences = ids.shape[0]
+    df: Counter = Counter()
+    for row, m in zip(ids, mask):
+        df.update(set(row[m > 0].tolist()))
+    default = math.log(n_sentences + 1)
+    idf = {tok: math.log((n_sentences + 1) / (occ + 1)) for tok, occ in df.items()}
+    return {"__default__": default, **idf}
+
+
+def _idf_weights(ids: np.ndarray, idf: Dict[int, float]) -> np.ndarray:
+    default = idf["__default__"]
+    return np.vectorize(lambda t: idf.get(int(t), default), otypes=[np.float32])(ids)
+
+
+def _load_baseline_file(path: str) -> np.ndarray:
+    """Parse a bert-score baseline csv/tsv: header row, then ``layer,P,R,F`` rows
+    (reference ``bert.py:175-184``). Returns (num_layers+1, 3) float array."""
+    with open(path, newline="") as f:
+        sample = f.read(4096)
+        f.seek(0)
+        dialect = csv.Sniffer().sniff(sample, delimiters=",\t")
+        rows = [
+            [float(x) for x in row]
+            for idx, row in enumerate(csv.reader(f, dialect))
+            if idx > 0 and row
+        ]
+    return np.asarray(rows, np.float32)[:, 1:]
 
 
 def _bert_score_from_embeddings(
@@ -97,22 +115,29 @@ def bert_score(
     target: Union[str, List[str]],
     model_name_or_path: Optional[str] = None,
     encoder: Optional[Encoder] = None,
+    tokenize: Optional[Tokenize] = None,
     num_layers: Optional[int] = None,
     max_length: int = 512,
     idf: bool = False,
     rescale_with_baseline: bool = False,
-    **unsupported,
+    baseline_path: Optional[str] = None,
+    lang: str = "en",
 ) -> Dict[str, Array]:
     """BERTScore (reference ``bert.py:243``): greedy contextual-embedding matching P/R/F1.
 
-    Provide either ``encoder`` (see module docstring) or a cached HF ``model_name_or_path``.
+    Provide either ``encoder`` (see module docstring) or a HF ``model_name_or_path`` resolved
+    through the installed transformers stack; with neither, the reference's recommended default
+    (``roberta-large``) is used with the reference's warning (``text/bert.py:184-188``).
+
+    ``idf=True`` weights token matches by inverse document frequency computed over the target
+    corpus (reference ``helper_embedding_metric.py:240-259``); it needs token ids, so it works
+    with HF-resolved models out of the box, or with a custom ``encoder`` when ``tokenize`` is
+    also given. ``rescale_with_baseline=True`` linearly rescales all three scores with a
+    baseline table loaded from ``baseline_path`` (csv/tsv in the published bert-score layout —
+    no network egress in this build, so the reference's auto-download is path-only; ``lang`` is
+    accepted for reference API parity but only participates in the reference's auto-download
+    URL, so it has no effect here).
     """
-    if idf or rescale_with_baseline or any(unsupported.values()):
-        bad = [k for k, v in {"idf": idf, "rescale_with_baseline": rescale_with_baseline, **unsupported}.items() if v]
-        raise NotImplementedError(
-            f"bert_score options {bad} are not supported in this build (idf needs tokenizer-level"
-            " document frequencies; baselines need downloaded tables). Use the default scores."
-        )
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
@@ -121,11 +146,29 @@ def bert_score(
         raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
     if encoder is None:
         if model_name_or_path is None:
-            raise ModuleNotFoundError(
-                "bert_score needs a model: pass `encoder` as a callable `(sentences) -> (embeddings,"
-                " mask)` or a locally cached HuggingFace `model_name_or_path`."
+            rank_zero_warn(
+                "The argument `model_name_or_path` was not specified while it is required when the default"
+                " `transformers` model is used."
+                f" It will use the default recommended model - {_DEFAULT_MODEL!r}."
             )
-        encoder = _hf_encoder(model_name_or_path, num_layers=num_layers, max_length=max_length)
+            model_name_or_path = _DEFAULT_MODEL
+        from torchmetrics_tpu.utils.pretrained import bert_encoder as _build
+
+        encoder, tokenize = _build(model_name_or_path, num_layers=num_layers, max_length=max_length)
+
+    p_weights = t_weights = None
+    if idf:
+        if tokenize is None:
+            raise ValueError(
+                "`idf=True` needs token ids: pass `tokenize` alongside a custom `encoder`, or use a"
+                " HuggingFace `model_name_or_path` so the tokenizer is resolved automatically."
+            )
+        t_ids, t_idf_mask = tokenize(list(target))
+        p_ids, p_idf_mask = tokenize(list(preds))
+        idf_table = _tokens_idf(t_ids, t_idf_mask)
+        p_weights = jnp.asarray(_idf_weights(p_ids, idf_table))
+        t_weights = jnp.asarray(_idf_weights(t_ids, idf_table))
+
     p_emb, p_mask = encoder(list(preds))
     t_emb, t_mask = encoder(list(target))
     # pad to a common sequence length so the cosine matrix is rectangular
@@ -136,4 +179,28 @@ def bert_score(
         p_mask = jnp.pad(p_mask, ((0, 0), (0, pad - lp)))
         t_emb = jnp.pad(t_emb, ((0, 0), (0, pad - lt), (0, 0)))
         t_mask = jnp.pad(t_mask, ((0, 0), (0, pad - lt)))
-    return _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask)
+    if p_weights is not None:
+        # tokenize() and encoder() pad independently; align the idf grids to the embedding grid
+        def _fit(w, L):
+            w = jnp.asarray(w)
+            if w.shape[1] < L:
+                w = jnp.pad(w, ((0, 0), (0, L - w.shape[1])))
+            return w[:, :L]
+
+        p_weights = _fit(p_weights, p_mask.shape[1])
+        t_weights = _fit(t_weights, t_mask.shape[1])
+
+    out = _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask, p_weights, t_weights)
+
+    if rescale_with_baseline:
+        if baseline_path is None:
+            rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
+        else:
+            baseline = _load_baseline_file(baseline_path)
+            row = baseline[num_layers if num_layers is not None else -1]
+            out = {
+                "precision": (out["precision"] - row[0]) / (1 - row[0]),
+                "recall": (out["recall"] - row[1]) / (1 - row[1]),
+                "f1": (out["f1"] - row[2]) / (1 - row[2]),
+            }
+    return out

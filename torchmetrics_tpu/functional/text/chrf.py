@@ -131,7 +131,9 @@ def _chrf_score_update(
         totals["preds_char"] += p_char_tot
         totals["preds_word"] += p_word_tot
 
-        best = (-1.0, None)
+        # Strict-greater vs an initial best of 0.0 (reference ``chrf.py:344-372``): a sentence
+        # whose F-score is 0 against every reference accumulates NO reference statistics.
+        best = (0.0, None)
         for tgt in targets:
             t_char_counts, t_word_counts, t_char_tot, t_word_tot = _get_n_grams_counts_and_total_ngrams(
                 tgt, n_char_order, n_word_order, lowercase, whitespace
@@ -144,7 +146,7 @@ def _chrf_score_update(
             if f_score > best[0]:
                 best = (f_score, (m_char, m_word, t_char_tot, t_word_tot))
         f_best, stats = best
-        if stats is None:  # no references -> zero contribution
+        if stats is None:  # no references, or zero F against all of them -> zero contribution
             stats = (
                 np.zeros(n_char_order, np.float32),
                 np.zeros(n_word_order, np.float32),
@@ -178,11 +180,11 @@ def _chrf_score_compute(totals: Dict[str, Array], n_order: float, beta: float) -
 
 def _validate_chrf_args(n_char_order: int, n_word_order: int, beta: float) -> None:
     if not isinstance(n_char_order, int) or n_char_order < 1:
-        raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        raise ValueError('Argument `n_char_order` must be an integer greater than or equal to 1.')
     if not isinstance(n_word_order, int) or n_word_order < 0:
-        raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        raise ValueError('Argument `n_word_order` must be an integer greater than or equal to 0.')
     if beta < 0:
-        raise ValueError("Expected argument `beta` to be greater than 0.")
+        raise ValueError('Argument `beta` must be greater than 0.')
 
 
 def chrf_score(
@@ -334,10 +336,12 @@ def _chrf_score_update_batched(
 
     best_f = np.zeros(n_sent, np.float32)
     if len(first):
-        totals["matching_char"] += mc[first].sum(axis=0)
-        totals["matching_word"] += mw[first].sum(axis=0)
-        totals["target_char"] += rc_tot[first].sum(axis=0)
-        totals["target_word"] += rw_tot[first].sum(axis=0)
+        # zero-F sentences contribute no reference stats (strict-greater rule, see loop twin)
+        contributing = first[f[first] > 0]
+        totals["matching_char"] += mc[contributing].sum(axis=0)
+        totals["matching_word"] += mw[contributing].sum(axis=0)
+        totals["target_char"] += rc_tot[contributing].sum(axis=0)
+        totals["target_word"] += rw_tot[contributing].sum(axis=0)
         best_f[best_sent] = f[first]
     if sentence_chrf_score is not None:
         sentence_chrf_score.extend(float(x) for x in best_f)

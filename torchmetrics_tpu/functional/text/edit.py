@@ -21,9 +21,9 @@ def _edit_distance_update(
     if isinstance(target, str):
         target = [target]
     if not all(isinstance(x, str) for x in preds):
-        raise ValueError(f"Expected all values in argument `preds` to be string type, but got {preds}")
+        raise ValueError(f"All values in argument `preds` must be strings, but got {preds}")
     if not all(isinstance(x, str) for x in target):
-        raise ValueError(f"Expected all values in argument `target` to be string type, but got {target}")
+        raise ValueError(f"All values in argument `target` must be strings, but got {target}")
     if len(preds) != len(target):
         raise ValueError(
             f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
@@ -46,7 +46,7 @@ def _edit_distance_compute(
         return jnp.sum(edit_scores)
     if reduction is None or reduction == "none":
         return edit_scores
-    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+    raise ValueError("Argument `reduction` must be either 'sum', 'mean', 'none' or None")
 
 
 def edit_distance(

@@ -131,7 +131,7 @@ def extended_edit_distance(
     """EED (reference ``eed.py:344-414``)."""
     for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
         if not isinstance(val, float) or val < 0:
-            raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+            raise ValueError(f"Parameter `{name}` must be a non-negative float.")
     sentence_eed = _eed_update(preds, target, language, alpha, rho, deletion, insertion)
     if not sentence_eed:
         return jnp.asarray(0.0, jnp.float32)

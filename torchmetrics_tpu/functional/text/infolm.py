@@ -47,11 +47,11 @@ def _validate_measure(information_measure: str, alpha: Optional[float], beta: Op
     if needs_alpha and not isinstance(alpha, float):
         raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
     if needs_beta and not isinstance(beta, float):
-        raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        raise ValueError(f"Parameter `beta` must be defined for {information_measure}.")
     if information_measure == "alpha_divergence" and alpha in (0.0, 1.0):
         raise ValueError(f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}.")
     if information_measure == "beta_divergence" and beta in (0.0, -1.0):
-        raise ValueError(f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}.")
+        raise ValueError(f"Parameter `beta` must be float differened from 0 and -1 for {information_measure}.")
     if information_measure == "ab_divergence" and (
         alpha is None or beta is None or 0.0 in (alpha, beta, alpha + beta)
     ):

@@ -33,7 +33,7 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
         )
     if not is_traced(preds) and not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
         raise TypeError(
-            f"Input tensor `preds` is expected to be of floating point type but got {jnp.asarray(preds).dtype}."
+            f"Input tensor `preds` must be of floating point type but got {jnp.asarray(preds).dtype}."
         )
     if not is_traced(target) and not jnp.issubdtype(jnp.asarray(target).dtype, jnp.integer):
         raise TypeError(

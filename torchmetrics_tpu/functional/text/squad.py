@@ -65,8 +65,7 @@ def _squad_input_check(preds, targets) -> Tuple[Dict[str, str], List[Dict[str, A
     for pred in preds:
         if "prediction_text" not in pred or "id" not in pred:
             raise KeyError(
-                "Expected keys in a single prediction are 'prediction_text' and 'id'."
-                "Please make sure that 'prediction_text' maps to the answer string and 'id' maps to the key string."
+                "Keys required in a single prediction are 'prediction_text' and 'id'.Please make sure that 'prediction_text' maps to the answer string and 'id' maps to the key string."
             )
     for target in targets:
         if "answers" not in target or "id" not in target:

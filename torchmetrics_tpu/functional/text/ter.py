@@ -1,17 +1,19 @@
 """Translation Edit Rate (reference ``src/torchmetrics/functional/text/ter.py``).
 
-Clean-room Tercom: the published algorithm — greedy phrase shifts that reduce the word-level
-Levenshtein distance, with Tercom's candidate-ranking heuristics and limits (shift size ≤ 10,
-shift distance ≤ 50, ≤ 1000 candidates). The Levenshtein+trace DP runs as full-matrix numpy
-(the reference prunes with a beam and an incremental cache, ``helper.py:54-295`` — exact DP is
-simpler and differs only on degenerate inputs). Inherently sequential host string work; only
-the accumulator states live on device.
+Tercom algorithm — greedy phrase shifts that reduce the word-level Levenshtein distance, with
+Tercom's candidate-ranking heuristics and limits (shift size ≤ 10, shift distance ≤ 50, ≤ 1000
+candidates). The Levenshtein+trace DP runs as full-matrix numpy (the reference prunes with a
+beam and an incremental cache, ``helper.py:54-295`` — exact DP is simpler and differs only on
+degenerate inputs); the shift/edit engine is an original implementation of the published
+algorithm. The text normalisation rules are the published tercom/sacrebleu ``tokenizer_ter``
+regex constants, expressed here as a flag-gated pipeline table. Inherently sequential host
+string work; only the accumulator states live on device.
 """
 from __future__ import annotations
 
 import re
-from functools import lru_cache
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+from functools import lru_cache, partial
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -25,82 +27,86 @@ _MAX_SHIFT_CANDIDATES = 1000
 # insert (the flipped-trace convention of tercom/sacrebleu)
 _OP_NOTHING, _OP_SUBSTITUTE, _OP_DELETE, _OP_INSERT = 0, 1, 2, 3
 
+# ---------------------------------------------------------------------------
+# Tercom text normalisation. The regex constants below are tercom/sacrebleu's published
+# ``tokenizer_ter`` tables; the representation is a flag-gated pipeline: each stage is
+# (gate over the three boolean flags, pad-with-spaces?, [(pattern, replacement), ...]).
+# ---------------------------------------------------------------------------
+_ASIAN_PUNCT = r"([、。〈-】〔-〟｡-･・])"
+_FULLWIDTH_PUNCT = r"([．，？：；！＂（）])"
 
-class _TercomTokenizer:
-    """Tercom normalisation rules (reference ``ter.py:57-185``, after sacrebleu's tokenizer_ter)."""
+_WESTERN_NORMALIZE = [
+    # newline stitching + XML entity unescaping
+    (r"\n-", ""), (r"\n", " "),
+    (r"&quot;", '"'), (r"&amp;", "&"), (r"&lt;", "<"), (r"&gt;", ">"),
+    # isolate symbol chars, possessive 's, punctuation not inside numbers, number-dash
+    (r"([{-~[-` -&(-+:-@/])", r" \1 "),
+    (r"'s ", r" 's "), (r"'s$", r" 's"),
+    (r"([^0-9])([\.,])", r"\1 \2 "), (r"([\.,])([^0-9])", r" \1 \2"),
+    (r"([0-9])(-)", r"\1 \2 "),
+]
+_ASIAN_NORMALIZE = [
+    (r"([一-鿿㐀-䶿])", r" \1 "),
+    (r"([㇀-㇯⺀-⻿])", r" \1 "),
+    (r"([㌀-㏿豈-﫿︰-﹏])", r" \1 "),
+    (r"([㈀-㼢])", r" \1 "),
+    (r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 "),
+    (r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 "),
+    (r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 "),
+    (_ASIAN_PUNCT, r" \1 "), (_FULLWIDTH_PUNCT, r" \1 "),
+]
+_WESTERN_STRIP = [(r"[\.,\?:;!\"\(\)]", "")]
+_ASIAN_STRIP = [(_ASIAN_PUNCT, ""), (_FULLWIDTH_PUNCT, "")]
 
-    _ASIAN_PUNCTUATION = r"([、。〈-】〔-〟｡-･・])"
-    _FULL_WIDTH_PUNCTUATION = r"([．，？：；！＂（）])"
 
-    def __init__(
-        self,
-        normalize: bool = False,
-        no_punctuation: bool = False,
-        lowercase: bool = True,
-        asian_support: bool = False,
-    ) -> None:
-        self.normalize = normalize
-        self.no_punctuation = no_punctuation
-        self.lowercase = lowercase
-        self.asian_support = asian_support
+def _compile_rules(rules):
+    return tuple((re.compile(p), r) for p, r in rules)
 
-    @lru_cache(maxsize=2**16)  # noqa: B019
-    def __call__(self, sentence: str) -> str:
-        if not sentence:
-            return ""
-        if self.lowercase:
-            sentence = sentence.lower()
-        if self.normalize:
-            sentence = self._normalize_general_and_western(sentence)
-            if self.asian_support:
-                sentence = self._normalize_asian(sentence)
-        if self.no_punctuation:
-            sentence = self._remove_punct(sentence)
-            if self.asian_support:
-                sentence = self._remove_asian_punct(sentence)
-        return " ".join(sentence.split())
 
-    @staticmethod
-    def _normalize_general_and_western(sentence: str) -> str:
-        sentence = f" {sentence} "
-        rules = [
-            (r"\n-", ""),
-            (r"\n", " "),
-            (r"&quot;", '"'),
-            (r"&amp;", "&"),
-            (r"&lt;", "<"),
-            (r"&gt;", ">"),
-            (r"([{-~[-` -&(-+:-@/])", r" \1 "),
-            (r"'s ", r" 's "),
-            (r"'s$", r" 's"),
-            (r"([^0-9])([\.,])", r"\1 \2 "),
-            (r"([\.,])([^0-9])", r" \1 \2"),
-            (r"([0-9])(-)", r"\1 \2 "),
-        ]
+# stages gated on (normalize, no_punctuation, asian_support); lowercase is not a regex pass and
+# is handled directly in ``_tercom_normalize``. ``pad`` wraps the sentence in single spaces
+# first (tercom pads before the western normalisation pass).
+_STAGES = (
+    (lambda norm, nopunct, asian: norm, True, _compile_rules(_WESTERN_NORMALIZE)),
+    (lambda norm, nopunct, asian: norm and asian, False, _compile_rules(_ASIAN_NORMALIZE)),
+    (lambda norm, nopunct, asian: nopunct, False, _compile_rules(_WESTERN_STRIP)),
+    (lambda norm, nopunct, asian: nopunct and asian, False, _compile_rules(_ASIAN_STRIP)),
+)
+
+
+@lru_cache(maxsize=2**16)
+def _tercom_normalize(
+    sentence: str, normalize: bool, no_punctuation: bool, lowercase: bool, asian_support: bool
+) -> str:
+    """Run the enabled normalisation stages and collapse whitespace."""
+    if not sentence:
+        return ""
+    if lowercase:
+        sentence = sentence.lower()
+    for gate, pad, rules in _STAGES:
+        if not gate(normalize, no_punctuation, asian_support):
+            continue
+        if pad:
+            sentence = f" {sentence} "
         for pattern, replacement in rules:
-            sentence = re.sub(pattern, replacement, sentence)
-        return sentence
+            sentence = pattern.sub(replacement, sentence)
+    return " ".join(sentence.split())
 
-    @classmethod
-    def _normalize_asian(cls, sentence: str) -> str:
-        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
-        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
-        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
-        sentence = re.sub(r"([㈀-㼢])", r" \1 ", sentence)
-        sentence = re.sub(r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 ", sentence)
-        sentence = re.sub(r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 ", sentence)
-        sentence = re.sub(r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 ", sentence)
-        sentence = re.sub(cls._ASIAN_PUNCTUATION, r" \1 ", sentence)
-        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
 
-    @staticmethod
-    def _remove_punct(sentence: str) -> str:
-        return re.sub(r"[\.,\?:;!\"\(\)]", "", sentence)
-
-    @classmethod
-    def _remove_asian_punct(cls, sentence: str) -> str:
-        sentence = re.sub(cls._ASIAN_PUNCTUATION, r"", sentence)
-        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r"", sentence)
+def _TercomTokenizer(
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+) -> Callable[[str], str]:
+    """Bind normalisation flags into a ``str -> str`` tokenizer (a picklable partial)."""
+    return partial(
+        _tercom_normalize,
+        normalize=normalize,
+        no_punctuation=no_punctuation,
+        lowercase=lowercase,
+        asian_support=asian_support,
+    )
 
 
 def _validate_inputs(

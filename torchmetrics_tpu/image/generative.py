@@ -37,8 +37,22 @@ FeatureExtractor = Optional[Callable[[Array], Array]]
 _INCEPTION_LAYERS = (64, 192, 768, 2048)
 
 
+_METRIC_DISPLAY = {
+    "FrechetInceptionDistance": "FrechetInceptionDistance",
+    "KernelInceptionDistance": "Kernel Inception Distance",
+    "InceptionScore": "InceptionScore",
+    "MemorizationInformedFrechetInceptionDistance": "MemorizationInformedFrechetInceptionDistance",
+}
+
+
 def _resolve_extractor(feature: Union[int, str, FeatureExtractor], metric_name: str) -> Tuple[FeatureExtractor, Optional[int]]:
-    """Map the ``feature`` argument to (extractor, num_features-if-known)."""
+    """Map the ``feature`` argument to (extractor, num_features-if-known).
+
+    Integer / ``"logits_unbiased"`` inputs resolve through the host-delegation adapter
+    (``utils/pretrained.py``) to torch-fidelity's InceptionV3 when installed — the reference's
+    out-of-the-box default (``image/fid.py:44-66``) — and raise the reference's exact
+    ``ModuleNotFoundError`` otherwise.
+    """
     if feature is None:
         return None, None
     if isinstance(feature, (int, str)) and not callable(feature):
@@ -46,11 +60,11 @@ def _resolve_extractor(feature: Union[int, str, FeatureExtractor], metric_name: 
             raise ValueError(
                 f"Integer input to argument `feature` must be one of {_INCEPTION_LAYERS}, but got {feature}."
             )
-        raise ModuleNotFoundError(
-            f"{metric_name} with a pretrained InceptionV3 feature layer requires bundled weights which are"
-            " not available in this build. Pass `feature` as a callable `imgs -> (N, d)` feature extractor"
-            " (e.g. a flax InceptionV3), or `feature=None` to feed pre-extracted features to `update`."
-        )
+        from torchmetrics_tpu.utils.pretrained import inception_feature_extractor
+
+        display = _METRIC_DISPLAY.get(metric_name, metric_name)
+        num_features = feature if isinstance(feature, int) else None
+        return inception_feature_extractor(feature, display), num_features
     if callable(feature):
         return feature, None
     raise TypeError("Got unknown input to argument `feature`")
@@ -89,7 +103,7 @@ class _FeatureStatsMetric(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.extractor, _ = _resolve_extractor(feature, type(self).__name__)
+        self.extractor, self._num_features_hint = _resolve_extractor(feature, type(self).__name__)
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features
@@ -153,9 +167,12 @@ class FrechetInceptionDistance(_FeatureStatsMetric):
     ) -> None:
         super().__init__(feature, reset_real_features, normalize, **kwargs)
         if num_features is None:
-            if self.extractor is None:
+            if self._num_features_hint is not None:
+                num_features = self._num_features_hint
+            elif self.extractor is None:
                 raise ValueError("`num_features` must be given when `feature` is None (raw-feature mode).")
-            num_features = int(np.asarray(self.extractor(jnp.zeros((1, 3, 299, 299), jnp.float32))).shape[-1])
+            else:
+                num_features = int(np.asarray(self.extractor(jnp.zeros((1, 3, 299, 299), jnp.float32))).shape[-1])
         d = num_features
         for prefix in ("real", "fake"):
             self.add_state(f"{prefix}_features_sum", jnp.zeros((d,), jnp.float32), dist_reduce_fx="sum")
@@ -423,10 +440,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             valid_net_type = ("vgg", "alex", "squeeze")
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            raise ModuleNotFoundError(
-                "LPIPS with a pretrained backbone requires learned weights which are not bundled in this"
-                " build. Pass `net_type` as a callable `(img1, img2) -> (N,)` distance function."
-            )
+            from torchmetrics_tpu.utils.pretrained import lpips_network
+
+            net_type = lpips_network(net_type)
         if not callable(net_type):
             raise ValueError("Argument `net_type` must be a string or callable")
         self.net = net_type
@@ -435,7 +451,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
         self.reduction = reduction
         if not isinstance(normalize, bool):
-            raise ValueError(f"Argument `normalize` should be an bool but got {normalize}")
+            raise ValueError(f"Argument `normalize` must be an bool but got {normalize}")
         self.normalize = normalize
         self.add_state("sum_scores", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")

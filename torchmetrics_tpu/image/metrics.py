@@ -385,7 +385,7 @@ class RelativeAverageSpectralError(Metric):
     def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(window_size, int) or window_size < 1:
-            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+            raise ValueError(f"Argument `window_size` must be a positive integer, but got {window_size}")
         self.window_size = window_size
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")
@@ -412,7 +412,7 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
     def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(window_size, int) or window_size < 1:
-            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+            raise ValueError('Argument `window_size` must be a positive integer.')
         self.window_size = window_size
         self.add_state("rmse_val_sum", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total_images", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
@@ -440,7 +440,7 @@ class SpectralDistortionIndex(Metric):
     def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(p, int) or p <= 0:
-            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+            raise ValueError(f"`p` must be a positive integer. Got p: {p}.")
         valid_reduction = ("elementwise_mean", "sum", "none")
         if reduction not in valid_reduction:
             raise ValueError(f"Expected argument `reduction` be one of {valid_reduction} but got {reduction}")
@@ -468,7 +468,7 @@ class TotalVariation(Metric):
     def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if reduction is not None and reduction not in ("sum", "mean", "none"):
-            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+            raise ValueError("Argument `reduction` must be either 'sum', 'mean', 'none' or None")
         self.reduction = reduction
         # list state only in 'none' mode, so sum/mean sweeps keep the fused update_batches path
         if reduction is None or reduction == "none":
@@ -507,7 +507,7 @@ class VisualInformationFidelity(Metric):
     def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
-            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+            raise ValueError(f"Argument `sigma_n_sq` must be a positive float or int, but got {sigma_n_sq}")
         self.add_state("vif_score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.sigma_n_sq = sigma_n_sq

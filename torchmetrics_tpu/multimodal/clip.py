@@ -68,7 +68,7 @@ class CLIPImageQualityAssessment(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if not (isinstance(data_range, (int, float)) and data_range > 0):
-            raise ValueError("Argument `data_range` should be a positive number.")
+            raise ValueError('Argument `data_range` must be a positive number.')
         self.data_range = data_range
         self.prompts_names, self.prompts_list = _clip_iqa_format_prompts(prompts)
         if isinstance(model_name_or_path, str) and model_name_or_path == "clip_iqa":

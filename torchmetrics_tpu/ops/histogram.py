@@ -73,6 +73,8 @@ def bincount_weighted(x: Array, length: int, weights: Optional[Array] = None, dt
         w = jnp.reshape(weights, (-1,)) * valid.astype(weights.dtype)
         out_dtype = dtype or weights.dtype
     if length <= _ONEHOT_MAX_CARDINALITY:
+        # f32 accumulation: exact up to 2^24 (~16.7M) occurrences per bin. Above that, use the
+        # Pallas backend (int32 accumulation) via set_bincount_backend("pallas").
         oh = jax.nn.one_hot(x, length, dtype=jnp.float32)  # (N, C); all-zero row if out of range
         counts = jnp.matmul(w[None, :], oh, precision="highest")[0]  # (C,) on the MXU
     else:

@@ -34,10 +34,11 @@ def _bincount_kernel(idx_ref, out_ref):
         out_ref[...] = jnp.zeros_like(out_ref)
 
     idx = idx_ref[...]  # (ROWS, LANES) int32
-    # output tile is (8, LANES): 8 sublane rows of 128 bins each
+    # output tile is (8, LANES): 8 sublane rows of 128 bins each. Accumulate in int32 so counts
+    # stay exact past 2^24 per bin (the float32 mantissa cap the XLA one-hot path is subject to).
     for r in range(8):
         bins = (bin_block * 8 + r) * _LANES + jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
-        eq = (idx[:, :, None] == bins[None, :, :]).astype(jnp.float32)  # (ROWS, LANES, LANES)
+        eq = (idx[:, :, None] == bins[None, :, :]).astype(jnp.int32)  # (ROWS, LANES, LANES)
         out_ref[r, :] += jnp.sum(eq, axis=(0, 1))
 
 
@@ -53,7 +54,7 @@ def _bincount_pallas_impl(idx_padded: Array, length: int, interpret: bool) -> Ar
         grid=(num_bin_blocks, num_sample_blocks),
         in_specs=[pl.BlockSpec((_ROWS, _LANES), lambda b, s: (s, 0))],
         out_specs=pl.BlockSpec((8, _LANES), lambda b, s: (b, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_bin_blocks * 8, _LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_bin_blocks * 8, _LANES), jnp.int32),
         interpret=interpret,
     )(idx_padded.reshape(num_sample_blocks * _ROWS, _LANES))
     return out.reshape(-1)[:length]
@@ -75,4 +76,4 @@ def bincount_pallas(x: Array, length: int) -> Array:
     x32 = jnp.where((x >= 0) & (x < length), x, length).astype(jnp.int32)
     padded = jnp.full((n_pad,), sentinel, jnp.int32).at[: x.size].set(x32)
     interpret = jax.default_backend() != "tpu"
-    return _bincount_pallas_impl(padded, length, interpret).astype(jnp.float32)
+    return _bincount_pallas_impl(padded, length, interpret)

@@ -65,7 +65,7 @@ class KLDivergence(Metric):
     def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(log_prob, bool):
-            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+            raise TypeError(f"Argument `log_prob` must be bool but got {log_prob}")
         self.log_prob = log_prob
         allowed_reduction = ("mean", "sum", "none", None)
         if reduction not in allowed_reduction:
@@ -102,7 +102,7 @@ class LogCoshError(Metric):
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+            raise ValueError(f"Argument `num_outputs` must be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("sum_log_cosh_error", jnp.zeros((num_outputs,), jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")

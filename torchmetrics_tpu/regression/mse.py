@@ -28,10 +28,10 @@ class MeanSquaredError(Metric):
     def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(squared, bool):
-            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+            raise ValueError(f"Argument `squared` must be a boolean but got {squared}")
         self.squared = squared
         if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+            raise ValueError(f"Argument `num_outputs` must be a positive integer, but got {num_outputs}")
         self.num_outputs = num_outputs
         shape = (num_outputs,) if num_outputs > 1 else ()
         self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")

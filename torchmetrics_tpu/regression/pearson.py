@@ -29,7 +29,7 @@ class PearsonCorrCoef(Metric):
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+            raise ValueError(f"Argument `num_outputs` must be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         shape = (num_outputs,) if num_outputs > 1 else ()
         for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy"):

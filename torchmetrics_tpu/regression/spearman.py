@@ -33,7 +33,7 @@ class SpearmanCorrCoef(Metric):
             " For large datasets, this may lead to a large memory footprint."
         )
         if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+            raise ValueError(f"Argument `num_outputs` must be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")
@@ -66,11 +66,11 @@ class KendallRankCorrCoef(Metric):
         if variant not in _ALLOWED_VARIANTS:
             raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant}")
         if not isinstance(t_test, bool):
-            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+            raise ValueError(f"Argument `t_test` must be of a type `bool`, but got {t_test}.")
         if t_test and alternative not in ("two-sided", "less", "greater"):
             raise ValueError("Argument `alternative` is expected to be one of 'two-sided', 'less' or 'greater'.")
         if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+            raise ValueError(f"Argument `num_outputs` must be an int larger than 0, but got {num_outputs}")
         self.variant = variant
         self.t_test = t_test
         self.alternative = alternative

@@ -164,7 +164,7 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
                  **kwargs: Any) -> None:
         super().__init__(empty_target_action, ignore_index, "mean", **kwargs)
         if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
-            raise ValueError("`max_k` has to be a positive integer or None")
+            raise ValueError('`max_k` must be a positive integer or None')
         if not isinstance(adaptive_k, bool):
             raise ValueError("`adaptive_k` has to be a boolean")
         self.max_k = max_k
@@ -215,7 +215,7 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
                  ignore_index: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(max_k, adaptive_k, empty_target_action, ignore_index, **kwargs)
         if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
-            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+            raise ValueError('`min_precision` must be a positive float between 0 and 1')
         self.min_precision = min_precision
 
     def _compute(self, state):

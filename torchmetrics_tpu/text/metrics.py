@@ -23,6 +23,7 @@ from torchmetrics_tpu.functional.text.chrf import (
 )
 from torchmetrics_tpu.functional.text.edit import _edit_distance_compute, _edit_distance_update
 from torchmetrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
 from torchmetrics_tpu.functional.text.squad import _squad_compute, _squad_input_check, _squad_update
 from torchmetrics_tpu.functional.text.wer import (
@@ -216,7 +217,7 @@ class EditDistance(_HostTextMetric):
         super().__init__(**kwargs)
         if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
             raise ValueError(
-                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+                f"Argument `substitution_cost` must be a positive integer, but got {substitution_cost}"
             )
         allowed = ("mean", "sum", "none", None)
         if reduction not in allowed:
@@ -516,7 +517,7 @@ class ExtendedEditDistance(_HostTextMetric):
             raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
         for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
             if not isinstance(val, float) or val < 0:
-                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+                raise ValueError(f"Parameter `{name}` must be a non-negative float.")
         self.language = language
         self.return_sentence_level_score = return_sentence_level_score
         self.alpha = alpha
@@ -607,26 +608,50 @@ class BERTScore(_SentenceStoreTextMetric):
         self,
         model_name_or_path: Optional[str] = None,
         encoder=None,
+        tokenize=None,
         num_layers: Optional[int] = None,
         max_length: int = 512,
+        idf: bool = False,
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        lang: str = "en",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.functional.text.bert import _hf_encoder
-
         if encoder is None:
+            from torchmetrics_tpu.functional.text.bert import _DEFAULT_MODEL
+            from torchmetrics_tpu.utils.pretrained import bert_encoder as _build
+
             if model_name_or_path is None:
-                raise ModuleNotFoundError(
-                    "BERTScore needs a model: pass `encoder` as a callable `(sentences) ->"
-                    " (embeddings, mask)` or a locally cached HuggingFace `model_name_or_path`."
+                rank_zero_warn(
+                    "The argument `model_name_or_path` was not specified while it is required when the default"
+                    " `transformers` model is used."
+                    f" It will use the default recommended model - {_DEFAULT_MODEL!r}."
                 )
-            encoder = _hf_encoder(model_name_or_path, num_layers=num_layers, max_length=max_length)
+                model_name_or_path = _DEFAULT_MODEL
+            encoder, tokenize = _build(model_name_or_path, num_layers=num_layers, max_length=max_length)
         self.encoder = encoder
+        self.tokenize = tokenize
+        self.num_layers = num_layers
+        self.idf = idf
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.lang = lang
 
     def _score(self, preds: list, target: list):
         from torchmetrics_tpu.functional.text.bert import bert_score
 
-        return bert_score(preds, target, encoder=self.encoder)
+        return bert_score(
+            preds,
+            target,
+            encoder=self.encoder,
+            tokenize=self.tokenize,
+            num_layers=self.num_layers,
+            idf=self.idf,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            lang=self.lang,
+        )
 
 
 class InfoLM(_SentenceStoreTextMetric):

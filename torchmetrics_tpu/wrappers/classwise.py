@@ -24,11 +24,11 @@ class ClasswiseWrapper(WrapperMetric):
         if not isinstance(metric, Metric):
             raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
         if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
-            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+            raise ValueError(f"Argument `labels` must be either `None` or a list of strings but got {labels}")
         if prefix is not None and not isinstance(prefix, str):
-            raise ValueError(f"Expected argument `prefix` to either be `None` or a string but got {prefix}")
+            raise ValueError(f"Argument `prefix` must be either `None` or a string but got {prefix}")
         if postfix is not None and not isinstance(postfix, str):
-            raise ValueError(f"Expected argument `postfix` to either be `None` or a string but got {postfix}")
+            raise ValueError(f"Argument `postfix` must be either `None` or a string but got {postfix}")
         self.metric = metric
         self.labels = labels
         self._prefix = prefix

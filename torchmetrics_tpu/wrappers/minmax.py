@@ -32,7 +32,7 @@ class MinMaxMetric(WrapperMetric):
     def compute(self) -> Dict[str, Any]:
         val = self._base_metric.compute()
         if not self._is_suitable_val(val):
-            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+            raise RuntimeError(f"Returned value from base metric must be a float or scalar tensor, but got {val}.")
         self.max_val = jnp.maximum(self.max_val, val)
         self.min_val = jnp.minimum(self.min_val, val)
         return {"raw": val, "max": self.max_val, "min": self.min_val}

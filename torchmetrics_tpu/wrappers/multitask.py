@@ -16,7 +16,7 @@ class MultitaskWrapper(WrapperMetric):
     def __init__(self, task_metrics: Dict[str, Union[Metric, MetricCollection]], **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(task_metrics, dict):
-            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+            raise TypeError(f"Argument `task_metrics` must be a dict. Found task_metrics = {task_metrics}")
         for metric in task_metrics.values():
             if not isinstance(metric, (Metric, MetricCollection)):
                 raise TypeError(

@@ -21,7 +21,7 @@ class Running(WrapperMetric):
                 f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
             )
         if not (isinstance(window, int) and window > 0):
-            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+            raise ValueError(f"Argument `window` must be a positive integer but got {window}")
         self.base_metric = base_metric
         self.window = window
         if base_metric.full_state_update is not False:

@@ -427,3 +427,19 @@ class TestMoreDomainsParity:
             rs.update(_t(v))
         np.testing.assert_allclose(float(om.compute()), float(rm.compute()), atol=1e-5)
         np.testing.assert_allclose(float(os_.compute()), float(rs.compute()), atol=1e-4)
+
+
+class TestExportSurfaceParity:
+    def test_functional_all_mirrors_reference(self):
+        import torchmetrics.functional as ref_functional
+
+        ours = set(F.__all__)
+        theirs = set(ref_functional.__all__)
+        assert theirs - ours == set(), f"missing from functional.__all__: {sorted(theirs - ours)}"
+        for name in F.__all__:
+            assert callable(getattr(F, name)), name
+
+    def test_top_level_all_superset_of_reference(self):
+        ours = set(tpu_tm.__all__)
+        theirs = set(ref_tm.__all__)
+        assert theirs - ours == set(), f"missing top-level exports: {sorted(theirs - ours)}"

@@ -13,8 +13,8 @@ from jax import Array
 from torchmetrics_tpu.functional.audio.deps import (
     perceptual_evaluation_speech_quality,
     short_time_objective_intelligibility,
-    speech_reverberation_modulation_energy_ratio,
 )
+from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
 from torchmetrics_tpu.functional.audio.sdr import signal_distortion_ratio
 from torchmetrics_tpu.functional.audio.snr import (
@@ -196,6 +196,7 @@ class PerceptualEvaluationSpeechQuality(_MeanOverSamplesMetric):
     is_differentiable = False
     higher_is_better = True
     jit_update = False
+    scan_update = False
 
     def __init__(self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -217,6 +218,7 @@ class ShortTimeObjectiveIntelligibility(_MeanOverSamplesMetric):
     is_differentiable = False
     higher_is_better = True
     jit_update = False
+    scan_update = False
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -231,16 +233,45 @@ class ShortTimeObjectiveIntelligibility(_MeanOverSamplesMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_MeanOverSamplesMetric):
-    """SRMR (reference ``audio/srmr.py:37``); gammatone DSP backend not available in this build."""
+    """SRMR (reference ``audio/srmr.py:37``): non-intrusive (no target), mean over samples.
+
+    Backed by the self-contained gammatone/modulation pipeline in
+    ``functional/audio/srmr.py`` — no external DSP packages needed.
+    """
 
     is_differentiable = False
     higher_is_better = True
     jit_update = False
+    scan_update = False
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Optional[float] = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
-        # construction itself raises — mirrors the reference's import gate (srmr.py:95-100)
-        speech_reverberation_modulation_energy_ratio(jnp.zeros(1), fs)
+        from torchmetrics_tpu.functional.audio.srmr import _srmr_arg_validate
 
-    def _batch_values(self, preds: Array, target: Array) -> Array:  # pragma: no cover
-        raise NotImplementedError
+        _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
+        self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
+
+    def _update(self, state: Dict[str, Array], preds: Array, target: Array = None) -> Dict[str, Array]:
+        # single-argument (non-intrusive) form: forward()/update_batches() pass preds only
+        return super()._update(state, preds, None)
+
+    def _batch_values(self, preds: Array, target: Array = None) -> Array:
+        return speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf, self.max_cf, self.norm, self.fast
+        )

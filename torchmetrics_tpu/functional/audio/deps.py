@@ -1,11 +1,11 @@
-"""Host-library-backed audio metrics: PESQ, STOI, SRMR (reference ``functional/audio/{pesq,stoi,srmr}.py``).
+"""Host-library-backed audio metrics: PESQ and STOI (reference ``functional/audio/{pesq,stoi}.py``).
 
-These three wrap third-party native DSP packages (``pesq``, ``pystoi``,
-``gammatone``/``torchaudio``) in the reference; the algorithms are ITU-standard host-side signal
-processing, not accelerator math. Parity decision (documented, VERDICT r2 item 3): when the
-host package is importable we delegate to it sample-by-sample exactly like the reference; when
-it is not (this build ships none of them) we raise the same ``ModuleNotFoundError`` contract the
-reference raises.
+These wrap third-party native DSP packages (``pesq``, ``pystoi``) in the reference; the
+algorithms are ITU-standard host-side signal processing, not accelerator math. Parity decision
+(documented, VERDICT r2 item 3): when the host package is importable we delegate to it
+sample-by-sample exactly like the reference; when it is not (this build ships neither) we raise
+the same ``ModuleNotFoundError`` contract the reference raises. SRMR — which the reference also
+backs with external packages (gammatone/torchaudio) — is implemented natively in ``srmr.py``.
 """
 from __future__ import annotations
 
@@ -19,9 +19,6 @@ from torchmetrics_tpu.utils.checks import _check_same_shape
 
 _PESQ_AVAILABLE = importlib.util.find_spec("pesq") is not None
 _PYSTOI_AVAILABLE = importlib.util.find_spec("pystoi") is not None
-_SRMR_BACKEND_AVAILABLE = (
-    importlib.util.find_spec("gammatone") is not None and importlib.util.find_spec("torchaudio") is not None
-)
 
 
 def _require_pesq() -> None:
@@ -88,14 +85,3 @@ def short_time_objective_intelligibility(
     return jnp.asarray(stoi_val.reshape(preds.shape[:-1]))
 
 
-def speech_reverberation_modulation_energy_ratio(preds: Array, fs: int, **kwargs) -> Array:
-    """SRMR (reference ``functional/audio/srmr.py:37``); gammatone-filterbank DSP backend."""
-    if not _SRMR_BACKEND_AVAILABLE:
-        raise ModuleNotFoundError(
-            "SRMR metric requires that gammatone and torchaudio are installed."
-            " Install with `pip install gammatone torchaudio`."
-        )
-    raise NotImplementedError(
-        "The SRMR gammatone-filterbank pipeline is not integrated in this build even when the"
-        " backend packages are present; open an issue if you need it."
-    )

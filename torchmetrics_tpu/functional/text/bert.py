@@ -122,6 +122,7 @@ def bert_score(
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
     lang: str = "en",
+    **reference_kwargs,
 ) -> Dict[str, Array]:
     """BERTScore (reference ``bert.py:243``): greedy contextual-embedding matching P/R/F1.
 
@@ -138,6 +139,14 @@ def bert_score(
     accepted for reference API parity but only participates in the reference's auto-download
     URL, so it has no effect here).
     """
+    # reference-API kwargs with no effect here (batching/device/progress knobs) are accepted
+    # when falsy; truthy ones that would change results are reported, not silently ignored
+    _inert = {"verbose", "batch_size", "num_threads", "device", "max_length", "return_hash"}
+    unsupported = {k: v for k, v in reference_kwargs.items() if v and k not in _inert}
+    if unsupported:
+        raise NotImplementedError(
+            f"bert_score options {sorted(unsupported)} are not supported in this build."
+        )
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):

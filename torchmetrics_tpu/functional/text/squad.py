@@ -65,19 +65,19 @@ def _squad_input_check(preds, targets) -> Tuple[Dict[str, str], List[Dict[str, A
     for pred in preds:
         if "prediction_text" not in pred or "id" not in pred:
             raise KeyError(
-                "Keys required in a single prediction are 'prediction_text' and 'id'.Please make sure that 'prediction_text' maps to the answer string and 'id' maps to the key string."
+                "A single prediction must carry the keys 'prediction_text' (the answer string) and 'id'"
+                " (the key string)."
             )
     for target in targets:
         if "answers" not in target or "id" not in target:
             raise KeyError(
-                "Expected keys in a single target are 'answers' and 'id'."
-                "Please make sure that 'answers' maps to a `SQuAD` format dictionary and 'id' maps to the key string.\n"
+                "A single target must carry the keys 'answers' (a `SQuAD` format dictionary) and 'id'"
+                " (the key string).\n"
                 f"SQuAD Format: {SQuAD_FORMAT}"
             )
         if "text" not in target["answers"]:
             raise KeyError(
-                "Expected keys in a 'answers' are 'text'."
-                "Please make sure that 'answer' maps to a `SQuAD` format dictionary.\n"
+                "The 'answers' entry must carry a 'text' key mapping to a `SQuAD` format dictionary.\n"
                 f"SQuAD Format: {SQuAD_FORMAT}"
             )
     preds_dict = {p["id"]: p["prediction_text"] for p in preds}

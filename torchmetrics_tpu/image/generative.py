@@ -45,10 +45,15 @@ _METRIC_DISPLAY = {
 }
 
 
-def _resolve_extractor(feature: Union[int, str, FeatureExtractor], metric_name: str) -> Tuple[FeatureExtractor, Optional[int]]:
+def _resolve_extractor(
+    feature: Union[int, str, FeatureExtractor],
+    metric_name: str,
+    valid_strs: Tuple[str, ...] = (),
+) -> Tuple[FeatureExtractor, Optional[int]]:
     """Map the ``feature`` argument to (extractor, num_features-if-known).
 
-    Integer / ``"logits_unbiased"`` inputs resolve through the host-delegation adapter
+    Integer inputs (and the strings in ``valid_strs``, e.g. InceptionScore's
+    ``"logits_unbiased"``) resolve through the host-delegation adapter
     (``utils/pretrained.py``) to torch-fidelity's InceptionV3 when installed — the reference's
     out-of-the-box default (``image/fid.py:44-66``) — and raise the reference's exact
     ``ModuleNotFoundError`` otherwise.
@@ -59,6 +64,11 @@ def _resolve_extractor(feature: Union[int, str, FeatureExtractor], metric_name: 
         if isinstance(feature, int) and feature not in _INCEPTION_LAYERS:
             raise ValueError(
                 f"Integer input to argument `feature` must be one of {_INCEPTION_LAYERS}, but got {feature}."
+            )
+        if isinstance(feature, str) and feature not in valid_strs:
+            raise ValueError(
+                f"String input to argument `feature` must be one of {list(valid_strs) or '(no strings accepted)'},"
+                f" but got {feature!r}."
             )
         from torchmetrics_tpu.utils.pretrained import inception_feature_extractor
 
@@ -318,7 +328,7 @@ class InceptionScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.extractor, _ = _resolve_extractor(feature, type(self).__name__)
+        self.extractor, _ = _resolve_extractor(feature, type(self).__name__, valid_strs=("logits_unbiased",))
         if not isinstance(normalize, bool):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize

@@ -106,6 +106,7 @@ class Metric:
     # engine flags (TPU build)
     jit_update: bool = True
     jit_compute: bool = True
+    scan_update: bool = True  # False for host-computation metrics: update_batches loops instead of lax.scan
 
     def __init__(self, **kwargs: Any) -> None:
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
@@ -299,7 +300,9 @@ class Metric:
             )
         args, kwargs = self._coerce(args, kwargs)
         n_batches = jnp.shape(args[0] if args else next(iter(kwargs.values())))[0]
-        if self._state.lists:
+        if self._state.lists or not self.scan_update:
+            # list/"cat" states would need dynamic shapes under scan, and host-computation
+            # metrics (scan_update=False, e.g. PESQ/STOI/SRMR) cannot trace at all
             for i in range(n_batches):
                 self.update(*(a[i] for a in args), **{k: v[i] for k, v in kwargs.items()})
             return

@@ -151,8 +151,18 @@ class TestIoUModules:
 
 
 # --------------------------------------------------------------------------- mAP oracle
-def _coco_ap_oracle(preds, targets, iou_thresholds, rec_thresholds, max_det=100):
+def mask_iou_np(a, b):
+    """(n, H, W) x (m, H, W) boolean mask IoU."""
+    af = a.reshape(a.shape[0], -1).astype(np.float64)
+    bf = b.reshape(b.shape[0], -1).astype(np.float64)
+    inter = af @ bf.T
+    union = af.sum(1)[:, None] + bf.sum(1)[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1), 0.0)
+
+
+def _coco_ap_oracle(preds, targets, iou_thresholds, rec_thresholds, max_det=100, geom="boxes", iou_fn=None):
     """Independent single-area COCO mAP: greedy matching + 101-pt interpolation, all classes."""
+    iou_fn = iou_fn or iou_np
     classes = sorted(
         set(np.concatenate([p["labels"] for p in preds] + [t["labels"] for t in targets]).tolist())
     )
@@ -164,16 +174,16 @@ def _coco_ap_oracle(preds, targets, iou_thresholds, rec_thresholds, max_det=100)
             for p, t in zip(preds, targets):
                 dm = p["labels"] == cls
                 gm = t["labels"] == cls
-                det = p["boxes"][dm]
+                det = p[geom][dm]
                 sc = p["scores"][dm]
-                gt = t["boxes"][gm]
+                gt = t[geom][gm]
                 npig += gt.shape[0]
                 order = np.argsort(-sc, kind="stable")[:max_det]
                 det, sc = det[order], sc[order]
                 matched = np.zeros(gt.shape[0], bool)
                 is_tp = np.zeros(det.shape[0], bool)
                 if det.shape[0] and gt.shape[0]:
-                    mat = iou_np(det, gt)
+                    mat = iou_fn(det, gt)
                     for d in range(det.shape[0]):
                         cand = np.where(~matched, mat[d], 0)
                         m = cand.argmax() if gt.shape[0] else -1
@@ -310,7 +320,164 @@ class TestMeanAveragePrecision:
         with pytest.raises(ValueError, match="same length"):
             m.update([], [{"boxes": jnp.zeros((1, 4)), "labels": jnp.zeros(1, jnp.int32)}])
         with pytest.raises(ValueError, match="iou_type"):
-            MeanAveragePrecision(iou_type="segm")
+            MeanAveragePrecision(iou_type="bogus")
+
+
+def _blob_mask(h, w, cy, cx, r):
+    yy, xx = np.mgrid[:h, :w]
+    return ((yy - cy) ** 2 + (xx - cx) ** 2) <= r**2
+
+
+def _make_mask_dataset(num_imgs=4, num_classes=2, h=96, w=96, max_gt=4, drop=0.25, extra=1):
+    preds, targets = [], []
+    for _ in range(num_imgs):
+        n_gt = RNG.randint(1, max_gt + 1)
+        centers = RNG.randint(12, min(h, w) - 12, (n_gt, 2))
+        radii = RNG.randint(4, 14, n_gt)
+        gt_masks = np.stack([_blob_mask(h, w, cy, cx, r) for (cy, cx), r in zip(centers, radii)])
+        gt_labels = RNG.randint(0, num_classes, n_gt)
+        keep = RNG.rand(n_gt) > drop
+        det_masks = [
+            _blob_mask(h, w, cy + RNG.randint(-4, 5), cx + RNG.randint(-4, 5), max(2, r + RNG.randint(-2, 3)))
+            for (cy, cx), r, k in zip(centers, radii, keep) if k
+        ]
+        det_labels = list(gt_labels[keep])
+        for _ in range(RNG.randint(0, extra + 1)):
+            det_masks.append(_blob_mask(h, w, RNG.randint(10, h - 10), RNG.randint(10, w - 10), RNG.randint(3, 10)))
+            det_labels.append(RNG.randint(0, num_classes))
+        det_masks = np.stack(det_masks) if det_masks else np.zeros((0, h, w), bool)
+        preds.append({
+            "masks": det_masks,
+            "scores": RNG.rand(det_masks.shape[0]).astype(np.float32),
+            "labels": np.asarray(det_labels, np.int64),
+        })
+        targets.append({"masks": gt_masks, "labels": gt_labels})
+    return preds, targets
+
+
+class TestMeanAveragePrecisionSegm:
+    """iou_type='segm' mask path (reference mean_ap.py:104-115,178) vs the numpy COCO oracle."""
+
+    def test_perfect_masks(self):
+        h = w = 64
+        masks = np.stack([_blob_mask(h, w, 20, 20, 8), _blob_mask(h, w, 44, 40, 10)])
+        labels = np.asarray([0, 1])
+        m = MeanAveragePrecision(iou_type="segm")
+        m.update(
+            [{"masks": jnp.asarray(masks), "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray(labels)}],
+            [{"masks": jnp.asarray(masks), "labels": jnp.asarray(labels)}],
+        )
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_random_masks_vs_oracle(self, seed):
+        global RNG
+        RNG = np.random.RandomState(300 + seed)
+        preds, targets = _make_mask_dataset()
+        m = MeanAveragePrecision(iou_type="segm")
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        oracle = _coco_ap_oracle(
+            preds, targets, m.iou_thresholds, np.asarray(m.rec_thresholds),
+            max_det=100, geom="masks", iou_fn=mask_iou_np,
+        )
+        np.testing.assert_allclose(float(res["map"]), oracle, atol=1e-4)
+
+    def test_variable_image_sizes(self):
+        # masks from differently sized images pad to a common canvas without changing IoU
+        m = MeanAveragePrecision(iou_type="segm")
+        small = _blob_mask(32, 32, 15, 15, 6)
+        big = _blob_mask(128, 80, 60, 40, 12)
+        m.update(
+            [{"masks": jnp.asarray(small[None]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}],
+            [{"masks": jnp.asarray(small[None]), "labels": jnp.asarray([0])}],
+        )
+        m.update(
+            [{"masks": jnp.asarray(big[None]), "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}],
+            [{"masks": jnp.asarray(big[None]), "labels": jnp.asarray([0])}],
+        )
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-4)
+
+    def test_both_types_prefixed_keys(self):
+        h = w = 48
+        mask = _blob_mask(h, w, 24, 24, 9)
+        box = np.asarray([[15.0, 15.0, 33.0, 33.0]], np.float32)
+        m = MeanAveragePrecision(iou_type=("bbox", "segm"))
+        m.update(
+            [{"masks": jnp.asarray(mask[None]), "boxes": jnp.asarray(box),
+              "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}],
+            [{"masks": jnp.asarray(mask[None]), "boxes": jnp.asarray(box), "labels": jnp.asarray([0])}],
+        )
+        res = m.compute()
+        assert "bbox_map" in res and "segm_map" in res
+        np.testing.assert_allclose(float(res["bbox_map"]), 1.0, atol=1e-4)
+        np.testing.assert_allclose(float(res["segm_map"]), 1.0, atol=1e-4)
+
+    def test_missing_masks_key_raises(self):
+        m = MeanAveragePrecision(iou_type="segm")
+        with pytest.raises(ValueError, match="masks"):
+            m.update(
+                [{"boxes": jnp.zeros((1, 4)), "scores": jnp.asarray([0.5]), "labels": jnp.asarray([0])}],
+                [{"boxes": jnp.zeros((1, 4)), "labels": jnp.asarray([0])}],
+            )
+
+
+class TestExtendedSummary:
+    """extended_summary=True returns the reference's ious/precision/recall/scores extras
+    (reference mean_ap.py:192-210,536-545)."""
+
+    def test_keys_and_shapes(self):
+        preds, targets = _make_dataset(num_imgs=2, num_classes=2)
+        m = MeanAveragePrecision(extended_summary=True)
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        T, R = len(m.iou_thresholds), len(m.rec_thresholds)
+        K = len(np.asarray(res["classes"]))
+        A, M = 4, len(m.max_detection_thresholds)
+        assert res["precision"].shape == (T, R, K, A, M)
+        assert res["recall"].shape == (T, K, A, M)
+        assert res["scores"].shape == (T, R, K, A, M)
+        assert isinstance(res["ious"], dict)
+        for (img, cls), mat in res["ious"].items():
+            assert 0 <= img < 2
+            assert mat.ndim == 2
+
+    def test_precision_slice_consistent_with_map(self):
+        preds, targets = _make_dataset(num_imgs=3, num_classes=2)
+        m = MeanAveragePrecision(extended_summary=True)
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        prec = np.asarray(res["precision"])
+        # map == mean of valid precision entries at area=all, maxdet=last
+        sl = prec[:, :, :, 0, -1]
+        np.testing.assert_allclose(sl[sl > -1].mean(), float(res["map"]), atol=1e-5)
+
+    def test_ious_match_pairwise_oracle(self):
+        preds, targets = _make_dataset(num_imgs=2, num_classes=1)
+        m = MeanAveragePrecision(extended_summary=True)
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        for (img, cls), mat in res["ious"].items():
+            dm = preds[img]["labels"] == cls
+            gm = targets[img]["labels"] == cls
+            order = np.argsort(-preds[img]["scores"][dm], kind="stable")
+            expected = iou_np(preds[img]["boxes"][dm][order], targets[img]["boxes"][gm])
+            np.testing.assert_allclose(np.asarray(mat), expected, atol=1e-4)
 
 
 class TestPanopticQuality:

@@ -10,11 +10,18 @@ COCO assignment for ALL groups × 4 area ranges × T IoU thresholds in parallel 
 over the detection axis (the only genuinely sequential dimension of the algorithm) with
 vectorised masked-argmax matching inside. Buffer sizes round up to powers of two so recompiles
 are logarithmic in dataset shape. The cheap ragged precision/recall accumulation stays in numpy.
+
+Geometry is pluggable: ``iou_type="bbox"`` uses box IoU over (N, 4) buffers; ``"segm"``
+(reference ``mean_ap.py:104-115,178``) stores binary masks, pads them to a common (H, W), and
+computes mask IoU as a single flattened ``dets @ gts.T`` intersection matmul on the MXU — no RLE
+encodings needed. Both at once (``iou_type=("bbox", "segm")``) prefix result keys like the
+reference. ``extended_summary=True`` returns the reference's extra ``ious`` / ``precision`` /
+``recall`` / ``scores`` entries (``mean_ap.py:192-210,536-545``).
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +39,13 @@ _AREA_RANGES = {
     "medium": (32.0**2, 96.0**2),
     "large": (96.0**2, 1e5**2),
 }
+
+
+def _validate_iou_types(iou_type: Union[str, Sequence[str]]) -> Tuple[str, ...]:
+    types = (iou_type,) if isinstance(iou_type, str) else tuple(iou_type)
+    if not types or any(t not in ("bbox", "segm") for t in types):
+        raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') or a tuple of them, got {iou_type}")
+    return types
 
 
 @functools.partial(jax.jit, static_argnames=("num_thrs",))
@@ -69,12 +83,24 @@ def _match_all_groups(
     return jnp.moveaxis(det_matches, 0, -1)  # (P, A, T, D)
 
 
+@jax.jit
+def _mask_iou_matrix(det_flat: Array, gt_flat: Array) -> Array:
+    """(P, D, HW) x (P, G, HW) boolean masks -> (P, D, G) IoU via one MXU matmul per group."""
+    det_f = det_flat.astype(jnp.float32)
+    gt_f = gt_flat.astype(jnp.float32)
+    inter = jnp.einsum("pdh,pgh->pdg", det_f, gt_f, precision="highest")
+    area_d = jnp.sum(det_f, axis=-1)
+    area_g = jnp.sum(gt_f, axis=-1)
+    union = area_d[:, :, None] + area_g[:, None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+
+
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 2 ** int(np.ceil(np.log2(n)))
 
 
 class MeanAveragePrecision(Metric):
-    """mAP / mAR for object detection (reference ``_mean_ap.py:148``); ``iou_type='bbox'`` only."""
+    """mAP / mAR for object detection and instance segmentation (reference ``mean_ap.py:76``)."""
 
     is_differentiable = False
     higher_is_better = True
@@ -87,11 +113,12 @@ class MeanAveragePrecision(Metric):
     def __init__(
         self,
         box_format: str = "xyxy",
-        iou_type: str = "bbox",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
         iou_thresholds: Optional[List[float]] = None,
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        extended_summary: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -99,11 +126,7 @@ class MeanAveragePrecision(Metric):
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
-        if iou_type != "bbox":
-            raise ValueError(
-                f"Expected argument `iou_type` to be 'bbox' but got {iou_type}; mask IoU ('segm') relies on"
-                " RLE mask encodings with no array form and is not supported in this build."
-            )
+        self.iou_types = _validate_iou_types(iou_type)
         self.iou_type = iou_type
         self.iou_thresholds = list(iou_thresholds or np.linspace(0.5, 0.95, 10).round(2).tolist())
         self.rec_thresholds = list(rec_thresholds or np.linspace(0.0, 1.0, 101).round(2).tolist())
@@ -111,10 +134,15 @@ class MeanAveragePrecision(Metric):
         if not isinstance(class_metrics, bool):
             raise ValueError('Argument `class_metrics` must be a boolean')
         self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
         self.add_state("detections", [], dist_reduce_fx=None)
+        self.add_state("detection_masks", [], dist_reduce_fx=None)
         self.add_state("detection_scores", [], dist_reduce_fx=None)
         self.add_state("detection_labels", [], dist_reduce_fx=None)
         self.add_state("groundtruths", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_masks", [], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
@@ -122,13 +150,19 @@ class MeanAveragePrecision(Metric):
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
-        _input_validator(preds, target, iou_type=self.iou_type)
+        _input_validator(preds, target, iou_type=self.iou_types)
         for item in preds:
-            self._state.lists["detections"].append(self._get_safe_item_values(item["boxes"]))
+            if "bbox" in self.iou_types:
+                self._state.lists["detections"].append(self._get_safe_item_values(item["boxes"]))
+            if "segm" in self.iou_types:
+                self._state.lists["detection_masks"].append(jnp.asarray(item["masks"], bool))
             self._state.lists["detection_labels"].append(jnp.asarray(item["labels"]).reshape(-1))
             self._state.lists["detection_scores"].append(jnp.asarray(item["scores"]).reshape(-1))
         for item in target:
-            self._state.lists["groundtruths"].append(self._get_safe_item_values(item["boxes"]))
+            if "bbox" in self.iou_types:
+                self._state.lists["groundtruths"].append(self._get_safe_item_values(item["boxes"]))
+            if "segm" in self.iou_types:
+                self._state.lists["groundtruth_masks"].append(jnp.asarray(item["masks"], bool))
             self._state.lists["groundtruth_labels"].append(jnp.asarray(item["labels"]).reshape(-1))
         self._update_count += 1
         self._update_called = True
@@ -150,17 +184,31 @@ class MeanAveragePrecision(Metric):
         cat = np.concatenate([np.asarray(x).reshape(-1) for x in labels])
         return np.unique(cat).astype(np.int64).tolist()
 
+    # ------------------------------------------------------------------ geometry access
+    def _geometries(self, i_type: str):
+        """Per-image (det geometry, gt geometry) numpy lists for one iou type."""
+        if i_type == "bbox":
+            dets = [np.asarray(d).reshape(-1, 4) for d in self._state.lists["detections"]]
+            gts = [np.asarray(g).reshape(-1, 4) for g in self._state.lists["groundtruths"]]
+        else:
+            def _to_np(m):
+                arr = np.asarray(m)  # ONE host transfer per stored stack
+                return arr.reshape((-1,) + arr.shape[-2:]) if arr.size else np.zeros((0, 1, 1), bool)
+
+            dets = [_to_np(m) for m in self._state.lists["detection_masks"]]
+            gts = [_to_np(m) for m in self._state.lists["groundtruth_masks"]]
+        return dets, gts
+
     # ------------------------------------------------------------------ compute
-    def _build_groups(self, classes: List[int]):
+    def _build_groups(self, classes: List[int], i_type: str):
         """Group detections/gts per (image, class); sort dets by score desc; pad to capacity."""
         max_det = self.max_detection_thresholds[-1]
-        dets = [np.asarray(d).reshape(-1, 4) for d in self._state.lists["detections"]]
+        dets, gts = self._geometries(i_type)
         det_scores = [np.asarray(s) for s in self._state.lists["detection_scores"]]
         det_labels = [np.asarray(l) for l in self._state.lists["detection_labels"]]
-        gts = [np.asarray(g).reshape(-1, 4) for g in self._state.lists["groundtruths"]]
         gt_labels = [np.asarray(l) for l in self._state.lists["groundtruth_labels"]]
 
-        groups = []  # (cls_idx, det boxes sorted, det scores sorted, gt boxes)
+        groups = []  # (cls_idx, img_idx, det geom sorted, det scores sorted, gt geom)
         for cls_idx, cls in enumerate(classes):
             for i in range(len(gts)):
                 d_mask = det_labels[i] == cls
@@ -169,30 +217,100 @@ class MeanAveragePrecision(Metric):
                     continue
                 s = det_scores[i][d_mask]
                 order = np.argsort(-s, kind="stable")[:max_det]
-                groups.append((cls_idx, dets[i][d_mask][order], s[order], gts[i][g_mask]))
+                groups.append((cls_idx, i, dets[i][d_mask][order], s[order], gts[i][g_mask]))
 
         if not groups:
             return None
-        cap_d = _next_pow2(max(g[1].shape[0] for g in groups))
-        cap_g = _next_pow2(max(g[3].shape[0] for g in groups))
+        cap_d = _next_pow2(max(g[2].shape[0] for g in groups))
+        cap_g = _next_pow2(max(g[4].shape[0] for g in groups))
         num = len(groups)
-        det_boxes = np.zeros((num, cap_d, 4), np.float32)
         scores = np.full((num, cap_d), -np.inf, np.float32)
         det_valid = np.zeros((num, cap_d), bool)
-        gt_boxes = np.zeros((num, cap_g, 4), np.float32)
         gt_valid = np.zeros((num, cap_g), bool)
         cls_of = np.empty(num, np.int64)
-        for j, (cls_idx, db, sc, gb) in enumerate(groups):
+        img_of = np.empty(num, np.int64)
+        det_geoms: List[np.ndarray] = []
+        gt_geoms: List[np.ndarray] = []
+        for j, (cls_idx, img_idx, dg, sc, gg) in enumerate(groups):
             cls_of[j] = cls_idx
-            det_boxes[j, : db.shape[0]] = db
-            scores[j, : db.shape[0]] = sc
-            det_valid[j, : db.shape[0]] = True
-            gt_boxes[j, : gb.shape[0]] = gb
-            gt_valid[j, : gb.shape[0]] = True
-        return cls_of, det_boxes, scores, det_valid, gt_boxes, gt_valid
+            img_of[j] = img_idx
+            nd, ng = dg.shape[0], gg.shape[0]
+            det_geoms.append(dg)
+            gt_geoms.append(gg)
+            scores[j, :nd] = sc
+            det_valid[j, :nd] = True
+            gt_valid[j, :ng] = True
+        return cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid, cap_d, cap_g
 
-    def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
-        classes = self._get_classes()
+    # dense mask-IoU work is chunked so device/host buffers stay bounded regardless of dataset
+    # size: each chunk pads only ITS groups to its own (H, W) and detection/gt capacities
+    _SEGM_CHUNK_ELEMS = 1 << 28  # ~256M bool elements per chunk buffer (~256 MB)
+
+    def _pairwise_iou_all(
+        self,
+        det_geoms: List[np.ndarray],
+        gt_geoms: List[np.ndarray],
+        i_type: str,
+        cap_d: int,
+        cap_g: int,
+    ) -> np.ndarray:
+        """(P, cap_d, cap_g) IoU matrix; pads in per-chunk buffers, never a global mask tensor."""
+        num = len(det_geoms)
+        out = np.zeros((num, cap_d, cap_g), np.float32)
+        if i_type == "bbox":
+            det_buf = np.zeros((num, cap_d, 4), np.float32)
+            gt_buf = np.zeros((num, cap_g, 4), np.float32)
+            for j, (dg, gg) in enumerate(zip(det_geoms, gt_geoms)):
+                det_buf[j, : dg.shape[0]] = dg
+                gt_buf[j, : gg.shape[0]] = gg
+            return np.asarray(box_iou(jnp.asarray(det_buf), jnp.asarray(gt_buf)))
+        start = 0
+        while start < num:
+            # chunk size bounded by the padded mask footprint of ITS members
+            end = start
+            elems = 0
+            while end < num:
+                h = max(det_geoms[end].shape[1] if det_geoms[end].size else 1,
+                        gt_geoms[end].shape[1] if gt_geoms[end].size else 1)
+                w = max(det_geoms[end].shape[2] if det_geoms[end].size else 1,
+                        gt_geoms[end].shape[2] if gt_geoms[end].size else 1)
+                elems += (cap_d + cap_g) * h * w
+                if end > start and elems > self._SEGM_CHUNK_ELEMS:
+                    break
+                end += 1
+            chunk_d = det_geoms[start:end]
+            chunk_g = gt_geoms[start:end]
+            max_h = max(max(d.shape[1] if d.size else 1, g.shape[1] if g.size else 1) for d, g in zip(chunk_d, chunk_g))
+            max_w = max(max(d.shape[2] if d.size else 1, g.shape[2] if g.size else 1) for d, g in zip(chunk_d, chunk_g))
+            n = end - start
+            det_buf = np.zeros((n, cap_d, max_h, max_w), bool)
+            gt_buf = np.zeros((n, cap_g, max_h, max_w), bool)
+            for jj, (dg, gg) in enumerate(zip(chunk_d, chunk_g)):
+                det_buf[jj, : dg.shape[0], : dg.shape[1], : dg.shape[2]] = dg
+                gt_buf[jj, : gg.shape[0], : gg.shape[1], : gg.shape[2]] = gg
+            out[start:end] = np.asarray(
+                _mask_iou_matrix(
+                    jnp.asarray(det_buf.reshape(n, cap_d, -1)),
+                    jnp.asarray(gt_buf.reshape(n, cap_g, -1)),
+                )
+            )
+            start = end
+        return out
+
+    @staticmethod
+    def _geom_areas(geoms: List[np.ndarray], cap: int, i_type: str) -> np.ndarray:
+        out = np.zeros((len(geoms), cap), np.float64)
+        for j, g in enumerate(geoms):
+            if not g.shape[0]:
+                continue
+            if i_type == "bbox":
+                out[j, : g.shape[0]] = np.asarray(box_area(jnp.asarray(g)))
+            else:
+                out[j, : g.shape[0]] = g.reshape(g.shape[0], -1).sum(axis=-1)
+        return out
+
+    def _compute_one_type(self, classes: List[int], i_type: str):
+        """precision (T,R,K,A,M), recall (T,K,A,M), scores (T,R,K,A,M), ious dict for one type."""
         num_t = len(self.iou_thresholds)
         num_r = len(self.rec_thresholds)
         num_k = len(classes)
@@ -200,15 +318,33 @@ class MeanAveragePrecision(Metric):
         num_m = len(self.max_detection_thresholds)
         precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
         recall = -np.ones((num_t, num_k, num_a, num_m))
+        score_arr = -np.ones((num_t, num_r, num_k, num_a, num_m))
+        ious_out: Dict[Tuple[int, int], Array] = {}
 
-        built = self._build_groups(classes) if classes else None
+        if self.extended_summary:
+            # the reference returns an entry for EVERY (image, class) pair (cocoeval.ious);
+            # pairs with no group get an empty matrix, group pairs are overwritten below
+            num_imgs = len(self._state.lists["detection_labels"])
+            empty = jnp.zeros((0, 0), jnp.float32)
+            ious_out = {(i, c): empty for i in range(num_imgs) for c in classes}
+
+        built = self._build_groups(classes, i_type) if classes else None
         if built is not None:
-            cls_of, det_boxes, scores, det_valid, gt_boxes, gt_valid = built
+            cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid, cap_d, cap_g = built
             # one device program: pairwise IoU + greedy matching for all groups/areas/thresholds
-            ious = box_iou(jnp.asarray(det_boxes), jnp.asarray(gt_boxes))
-            ious = jnp.where(det_valid[:, :, None] & gt_valid[:, None, :], ious, 0.0)
-            gt_areas = np.asarray(box_area(jnp.asarray(gt_boxes)))
-            det_areas = np.asarray(box_area(jnp.asarray(det_boxes)))
+            ious_np = self._pairwise_iou_all(det_geoms, gt_geoms, i_type, cap_d, cap_g)
+            ious = jnp.where(
+                det_valid[:, :, None] & gt_valid[:, None, :], jnp.asarray(ious_np), 0.0
+            )
+            if self.extended_summary:
+                for j in range(ious_np.shape[0]):
+                    nd = int(det_valid[j].sum())
+                    ng = int(gt_valid[j].sum())
+                    ious_out[(int(img_of[j]), classes[int(cls_of[j])])] = jnp.asarray(
+                        ious_np[j, :nd, :ng], jnp.float32
+                    )
+            gt_areas = self._geom_areas(gt_geoms, cap_g, i_type)
+            det_areas = self._geom_areas(det_geoms, cap_d, i_type)
             ranges = np.asarray(list(_AREA_RANGES.values()))  # (A, 2)
             gt_ignore = (gt_areas[:, None, :] < ranges[None, :, 0:1]) | (
                 gt_areas[:, None, :] > ranges[None, :, 1:2]
@@ -249,6 +385,7 @@ class MeanAveragePrecision(Metric):
                         keep = g_valid[:, :max_det]  # (Pk, min(D, maxdet))
                         flat_scores = g_scores[:, :max_det][keep]
                         order = np.argsort(-flat_scores, kind="stable")
+                        sorted_scores = flat_scores[order]
                         matches = g_matches[:, a, :, :max_det]
                         ignore = g_ignore[:, a, :, :max_det]
                         # (T, N) in global score order
@@ -268,25 +405,44 @@ class MeanAveragePrecision(Metric):
                             # monotone precision envelope (the reference's zigzag loop fixpoint)
                             pr = np.maximum.accumulate(pr[::-1])[::-1]
                             prec = np.zeros(num_r)
+                            scr = np.zeros(num_r)
                             inds = np.searchsorted(rc, rec_thrs, side="left")
                             num_inds = int(inds.argmax()) if (tp_len == 0 or inds.max() >= tp_len) else num_r
                             inds = inds[:num_inds]
                             prec[:num_inds] = pr[inds]
+                            scr[:num_inds] = sorted_scores[inds] if tp_len else 0
                             precision[t, :, k, a, mi] = prec
+                            score_arr[t, :, k, a, mi] = scr
 
-        results = self._summarize_results(precision, recall)
-        map_per_class = np.asarray([-1.0])
-        mar_per_class = np.asarray([-1.0])
-        if self.class_metrics and num_k:
-            maps, mars = [], []
-            for k in range(num_k):
-                cls_res = self._summarize_results(precision[:, :, k : k + 1], recall[:, k : k + 1])
-                maps.append(float(cls_res["map"]))
-                mars.append(float(cls_res[f"mar_{self.max_detection_thresholds[-1]}"]))
-            map_per_class = np.asarray(maps, np.float32)
-            mar_per_class = np.asarray(mars, np.float32)
-        results["map_per_class"] = jnp.asarray(map_per_class)
-        results[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(mar_per_class)
+        return precision, recall, score_arr, ious_out
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        classes = self._get_classes()
+        num_k = len(classes)
+        results: Dict[str, Any] = {}
+        for i_type in self.iou_types:
+            prefix = "" if len(self.iou_types) == 1 else f"{i_type}_"
+            precision, recall, score_arr, ious_out = self._compute_one_type(classes, i_type)
+            for key, val in self._summarize_results(precision, recall).items():
+                results[f"{prefix}{key}"] = val
+
+            map_per_class = np.asarray([-1.0])
+            mar_per_class = np.asarray([-1.0])
+            if self.class_metrics and num_k:
+                maps, mars = [], []
+                for k in range(num_k):
+                    cls_res = self._summarize_results(precision[:, :, k : k + 1], recall[:, k : k + 1])
+                    maps.append(float(cls_res["map"]))
+                    mars.append(float(cls_res[f"mar_{self.max_detection_thresholds[-1]}"]))
+                map_per_class = np.asarray(maps, np.float32)
+                mar_per_class = np.asarray(mars, np.float32)
+            results[f"{prefix}map_per_class"] = jnp.asarray(map_per_class)
+            results[f"{prefix}mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(mar_per_class)
+            if self.extended_summary:
+                results[f"{prefix}ious"] = ious_out
+                results[f"{prefix}precision"] = jnp.asarray(precision, jnp.float32)
+                results[f"{prefix}recall"] = jnp.asarray(recall, jnp.float32)
+                results[f"{prefix}scores"] = jnp.asarray(score_arr, jnp.float32)
         results["classes"] = jnp.asarray(np.asarray(classes, np.int32))
         return results
 
@@ -336,4 +492,7 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:  # noqa: D102 - dict output, squeeze per entry
         with self.sync_context(dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync):
-            return {k: self._squeeze_if_scalar(v) for k, v in self._compute({}).items()}
+            return {
+                k: v if isinstance(v, dict) else self._squeeze_if_scalar(v)
+                for k, v in self._compute({}).items()
+            }

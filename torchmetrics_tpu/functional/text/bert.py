@@ -141,7 +141,7 @@ def bert_score(
     """
     # reference-API kwargs with no effect here (batching/device/progress knobs) are accepted
     # when falsy; truthy ones that would change results are reported, not silently ignored
-    _inert = {"verbose", "batch_size", "num_threads", "device", "max_length", "return_hash"}
+    _inert = {"verbose", "batch_size", "num_threads", "device"}
     unsupported = {k: v for k, v in reference_kwargs.items() if v and k not in _inert}
     if unsupported:
         raise NotImplementedError(

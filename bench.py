@@ -86,6 +86,47 @@ def bench_ours(preds: np.ndarray, target: np.ndarray) -> float:
     return sweeps_per_window * N_BATCHES / best
 
 
+def bench_ours_per_step(preds: np.ndarray, target: np.ndarray, n_meas: int = 100) -> float:
+    """updates/sec through per-batch ``forward`` — the SAME protocol the reference loop uses
+    (one dispatch per batch, batch value returned), so `vs_baseline` compares like with like."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    mc = MetricCollection(
+        [
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassRecall(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+        ]
+    )
+    dev_preds = jnp.asarray(preds)
+    dev_target = jnp.asarray(target)
+    jax.block_until_ready((dev_preds, dev_target))
+    for i in range(2):  # group formation + compile
+        mc(dev_preds[i], dev_target[i])
+    mc.reset()
+
+    n_meas = min(n_meas, N_BATCHES)
+
+    def _window():
+        mc.reset()
+        out = [mc(dev_preds[i % N_BATCHES], dev_target[i % N_BATCHES]) for i in range(n_meas)]
+        jax.block_until_ready(list(out[-1].values()))
+
+    best = _best_of(_window, windows=3)
+    print(f"ours (per-step forward): {n_meas} updates in {best:.4f}s", file=sys.stderr)
+    return n_meas / best
+
+
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     """Same sweep through the reference torchmetrics (torch backend)."""
     import types
@@ -180,27 +221,52 @@ def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     dev_preds = [torch.from_numpy(p).long() for p in preds]
     dev_target = [torch.from_numpy(t).long() for t in target]
 
-    # measure a slice and extrapolate (reference torch-CPU path is slow)
+    # measure a slice and extrapolate (reference torch-CPU path is slow). Protocol matches
+    # bench_ours_per_step: per-batch forward() calls returning the batch value.
     n_meas = min(N_BATCHES, 30)
     mc = make()
-    mc.update(dev_preds[0], dev_target[0])  # group formation
+    mc(dev_preds[0], dev_target[0])  # group formation + first forward
     t0 = time.perf_counter()
     for i in range(1, n_meas):
-        mc.update(dev_preds[i], dev_target[i])
+        mc(dev_preds[i], dev_target[i])
     _ = mc.compute()
     elapsed = time.perf_counter() - t0
-    print(f"reference: {n_meas - 1} updates in {elapsed:.3f}s", file=sys.stderr)
+    print(f"reference (per-step forward): {n_meas - 1} updates in {elapsed:.3f}s", file=sys.stderr)
     return (n_meas - 1) / elapsed
 
 
+_WINDOW_STATS = {"spreads": []}  # best/median divergence per timed section (contention telemetry)
+
+
 def _best_of(run_window, windows: int = 5) -> float:
-    """Fastest of several independently timed windows (shared-chip interference damping)."""
-    best = float("inf")
+    """Fastest of several independently timed windows (shared-chip interference damping).
+
+    Also records the best/median spread: when the median window is much slower than the best,
+    the chip was contended during the run and even the best number is suspect.
+    """
+    times = []
     for _ in range(windows):
         t0 = time.perf_counter()
         run_window()
-        best = min(best, time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    best = times[0]
+    median = times[len(times) // 2]
+    _WINDOW_STATS["spreads"].append(median / best if best > 0 else 1.0)
     return best
+
+
+def _contention_report() -> dict:
+    """Summarise window spreads; flag suspected contention when median/best diverges >2x."""
+    spreads = _WINDOW_STATS["spreads"]
+    if not spreads:
+        return {"contention_suspected": False}
+    worst = max(spreads)
+    return {
+        "window_spread_max": round(worst, 2),
+        "window_spread_mean": round(sum(spreads) / len(spreads), 2),
+        "contention_suspected": worst > 2.0,
+    }
 
 
 def bench_functional_stat_scores() -> dict:
@@ -308,8 +374,89 @@ def bench_retrieval_cat() -> dict:
     return out
 
 
+_SYNC8_SNIPPET = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from torchmetrics_tpu.parallel.sync import shard_map_unchecked, sync_state
+
+NUM_CLASSES = 5
+devices = jax.devices()
+n = len(devices)
+mesh = Mesh(np.array(devices), ("dp",))
+state = {
+    "tp": jnp.zeros((n, NUM_CLASSES), jnp.float32),
+    "cat": jnp.zeros((n * 1024,), jnp.float32),
+}
+fx = {"tp": "sum", "cat": "cat"}
+
+@jax.jit
+@shard_map_unchecked(mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+def sync(tp, cat):
+    world = sync_state({"tp": tp[0], "cat": cat}, fx, axis_name="dp")
+    return world["tp"], jnp.sum(world["cat"])
+
+args = (
+    jax.device_put(state["tp"], NamedSharding(mesh, P("dp"))),
+    jax.device_put(state["cat"], NamedSharding(mesh, P("dp"))),
+)
+jax.block_until_ready(sync(*args))
+k = 50
+best = float("inf")
+for _ in range(5):
+    t0 = time.perf_counter()
+    jax.block_until_ready([sync(*args) for _ in range(k)])
+    best = min(best, time.perf_counter() - t0)
+print(json.dumps({"sync_state_latency_us_mesh8cpu": round(best / k * 1e6, 1), "sync_mesh_devices": n}))
+"""
+
+
+def bench_sync_mesh8() -> dict:
+    """North-star sync latency over a VIRTUAL 8-device CPU mesh (multi-chip TPU hardware is not
+    available in this environment; labeled accordingly). Runs in a subprocess so the XLA
+    host-device-count flag can be set before jax initialises."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", _SYNC8_SNIPPET], capture_output=True, text=True, env=env,
+        timeout=300, cwd="/root/repo",
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"sync@8 subprocess failed: {proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_dispatch_latency() -> dict:
+    """Per-launch overhead of the environment (tunneled chip): the floor for ANY per-step
+    protocol. per-step forward ≈ one launch, so its updates/s ceiling is 1/roundtrip."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.float32)
+    jax.block_until_ready(f(x))
+    k = 30
+    t0 = time.perf_counter()
+    for _ in range(k):
+        jax.block_until_ready(f(x))
+    roundtrip = (time.perf_counter() - t0) / k
+    t0 = time.perf_counter()
+    jax.block_until_ready([f(x) for _ in range(k)])
+    pipelined = (time.perf_counter() - t0) / k
+    return {
+        "dispatch_roundtrip_ms": round(roundtrip * 1e3, 2),
+        "dispatch_pipelined_ms": round(pipelined * 1e3, 2),
+    }
+
+
 def bench_sync_latency() -> dict:
-    """North-star sync latency: one full state sync (psum + all_gather) over the visible mesh."""
+    """Single-chip sync-path latency on the real device (collectives are no-ops at world=1;
+    this measures dispatch + program overhead of the sync program only)."""
     import functools
 
     import jax
@@ -345,35 +492,52 @@ def bench_sync_latency() -> dict:
 
 def main() -> None:
     preds, target = _gen_data()
-    ours = bench_ours(preds, target)
+    ours_fused = bench_ours(preds, target)
+    try:
+        ours_per_step = bench_ours_per_step(preds, target)
+    except Exception as err:
+        print(f"per-step bench failed: {err!r}", file=sys.stderr)
+        ours_per_step = float("nan")
     try:
         ref = bench_reference(preds, target)
-        vs = ours / ref
     except Exception as err:  # reference unavailable -> report absolute number only
         print(f"reference bench failed: {err!r}", file=sys.stderr)
-        vs = float("nan")
+        ref = float("nan")
+    # like-for-like: our per-batch forward vs the reference's per-batch forward
+    vs = ours_per_step / ref if ours_per_step == ours_per_step and ref == ref else float("nan")
 
-    extras = {}
+    extras = {
+        "updates_per_sec_per_step_forward": round(ours_per_step, 2) if ours_per_step == ours_per_step else None,
+        "updates_per_sec_reference_per_step": round(ref, 2) if ref == ref else None,
+        "fused_vs_reference": round(ours_fused / ref, 3) if ref == ref else None,
+    }
+    extras["fused_samples_per_sec"] = round(ours_fused * BATCH, 0)
     for name, fn in (
+        ("dispatch_latency", bench_dispatch_latency),
         ("functional_stat_scores", bench_functional_stat_scores),
         ("binned_curves", bench_binned_curves),
         ("retrieval_cat_state", bench_retrieval_cat),
-        ("sync", bench_sync_latency),
+        ("sync_single_chip", bench_sync_latency),
+        ("sync_mesh8", bench_sync_mesh8),
     ):
         try:
             extras.update(fn())
         except Exception as err:
             print(f"extra bench {name} failed: {err!r}", file=sys.stderr)
             extras[f"{name}_error"] = repr(err)
+    extras.update(_contention_report())
 
     print(
         json.dumps(
             {
                 "metric": "metric_updates_per_sec_1M_sample_multiclass_sweep",
-                "value": round(ours, 2),
+                "value": round(ours_fused, 2),
                 "unit": (
-                    "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] fused;"
-                    " vs_baseline = reference torch-CPU on this host, extrapolated from a 29-update slice)"
+                    "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] fused scan sweep;"
+                    " vs_baseline = ours per-batch forward vs reference torch-CPU per-batch forward"
+                    " on this host [30-update slice], like-for-like protocol; per-step is bound by"
+                    " dispatch_roundtrip_ms on this tunneled chip — one launch per step — see extras;"
+                    " fused-vs-reference in extras)"
                 ),
                 "vs_baseline": round(vs, 3) if vs == vs else None,
                 "extras": extras,

@@ -1,0 +1,77 @@
+"""Worker for the real 2-process sync test (run via subprocess, one copy per rank).
+
+Initialises ``jax.distributed`` on CPU, builds metrics with rank-dependent data — including an
+UNEVEN-dim-0 cat state — and exercises the production eager sync path
+(``Metric.compute`` → ``sync`` → ``process_sync`` → ``gather_all_arrays`` →
+``multihost_utils.process_allgather``). Results are printed as one JSON line for the parent
+test to assert on. Analog of the reference's 2-process gloo pool
+(``/root/reference/tests/unittests/conftest.py:40-63``).
+"""
+import json
+import os
+import sys
+
+
+def main() -> None:
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+    # multi-process CPU worlds need the gloo cross-process collectives client
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    coordinator = sys.argv[1]
+    rank = int(sys.argv[2])
+    world = int(sys.argv[3])
+
+    jax.distributed.initialize(coordinator_address=coordinator, num_processes=world, process_id=rank)
+
+    import jax.numpy as jnp  # noqa: E402
+    import numpy as np  # noqa: E402
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+    from torchmetrics_tpu.aggregation import CatMetric, SumMetric  # noqa: E402
+    from torchmetrics_tpu.classification import MulticlassAccuracy  # noqa: E402
+    from torchmetrics_tpu.parallel.sync import gather_all_arrays  # noqa: E402
+
+    results = {"rank": rank, "process_count": jax.process_count()}
+
+    # --- raw gather with uneven shapes (reference tests/unittests/bases/test_ddp.py:33-86) -------
+    local = jnp.arange(rank + 1, dtype=jnp.float32) + 10 * rank  # rank 0: (1,), rank 1: (2,)
+    gathered = gather_all_arrays(local)
+    results["gather_uneven"] = [np.asarray(g).tolist() for g in gathered]
+
+    even = jnp.asarray([float(rank), float(rank)])
+    results["gather_even"] = [np.asarray(g).tolist() for g in gather_all_arrays(even)]
+
+    # --- sum-state metric through the full compute() sync path -----------------------------------
+    s = SumMetric()
+    s.update(jnp.asarray(float(rank + 1)))
+    results["sum_metric"] = float(s.compute())  # expect 1 + 2 = 3
+
+    # --- uneven cat-state metric through compute() -----------------------------------------------
+    c = CatMetric()
+    c.update(jnp.arange(rank + 2, dtype=jnp.float32) + 100 * rank)  # rank 0: 2 elems, rank 1: 3
+    results["cat_metric"] = np.asarray(c.compute()).tolist()
+
+    # --- a real classification metric with per-rank data shards ----------------------------------
+    rng = np.random.RandomState(1234)  # same stream on both ranks; shard by striding
+    preds = rng.randn(64, 5).astype(np.float32)
+    target = rng.randint(0, 5, 64)
+    acc = MulticlassAccuracy(num_classes=5, average="micro")
+    shard = slice(rank, None, world)
+    acc.update(jnp.asarray(preds[shard]), jnp.asarray(target[shard]))
+    results["accuracy"] = float(acc.compute())
+    results["accuracy_full"] = float(np.mean(preds.argmax(-1) == target))
+
+    # unsync restores the local (pre-gather) state
+    results["sum_after_reset_guard"] = float(s.compute())  # cached; still 3
+
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,9 +67,73 @@ class MetricCollection:
 
     # ------------------------------------------------------------------- calls
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call ``forward`` on every metric; return the flattened result dict."""
+        """Call ``forward`` on every metric; return the flattened result dict.
+
+        Once compute groups are formed, each group's forward runs as ONE fused XLA program
+        (shared update kernel + every member's batch-value compute + the state merge) — k
+        metrics in a group cost one dispatch, not k. Falls back to per-metric forward for
+        non-fusable members. The first forward runs per-metric, then forms the groups
+        (mirroring ``update``, reference ``collections.py:200-236``).
+        """
+        if self._groups_checked:
+            result = self._forward_groups(*args, **kwargs)
+            return self._finalize_result(result)
         res = self._compute_and_reduce("forward", *args, **kwargs)
+        if self._enable_compute_groups and not self._groups_checked:
+            self._merge_compute_groups()
+            self._compute_groups_create_state_ref()
+            self._groups_checked = True
         return res
+
+    def _forward_groups(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-group fused forward; per-metric fallback for non-fusable groups."""
+        import jax
+        import jax.numpy as jnp
+
+        result: Dict[str, Any] = {}
+        for cg in self._groups.values():
+            members = [(name, self._modules[name]) for name in cg]
+            leader = members[0][1]
+            if not all(m._fusable_forward() for _, m in members) or any(
+                m.full_state_update for _, m in members
+            ):
+                for name, m in members:
+                    result[name] = m(*args, **m._filter_kwargs(**kwargs))
+                continue
+            fn = leader._jit_cache.get("group_forward")
+            if fn is None:
+                defaults = {k: leader._defaults[k] for k in leader._state.tensors}
+                reductions = {k: leader._reductions[k] for k in leader._state.tensors}
+                computes = [(name, m._compute) for name, m in members]
+
+                def step(global_tensors, n, *f_args, _computes=tuple(computes), **f_kwargs):
+                    batch_out = leader._update(dict(defaults), *f_args, **f_kwargs)
+                    batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
+                    vals = {name: compute(batch_state) for name, compute in _computes}
+                    merged = leader._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
+                    return vals, merged
+
+                fn = jax.jit(step)
+                leader._jit_cache["group_forward"] = fn
+            f_kwargs = leader._filter_kwargs(**kwargs)
+            coerced_args, coerced_kwargs = leader._coerce(args, f_kwargs)
+            if leader._should_validate():
+                leader._validate(*coerced_args, **coerced_kwargs)
+            n = leader._update_count + 1
+            vals, merged = fn(
+                dict(leader._state.tensors), jnp.asarray(n, jnp.float32), *coerced_args, **coerced_kwargs
+            )
+            leader._state.tensors.update(merged)
+            for _, m in members:
+                m._update_count = n
+                m._update_called = True
+                m._computed = None
+            for name, m in members:
+                result[name] = m._squeeze_if_scalar(vals[name])
+        if self._state_is_copy:
+            self._compute_groups_create_state_ref()
+            self._state_is_copy = False
+        return result
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -131,7 +195,10 @@ class MetricCollection:
             else:
                 raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
             result[k] = res
+        return self._finalize_result(result)
 
+    def _finalize_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten dict-valued results + apply prefix/postfix naming (reference ``collections.py:314``)."""
         _, duplicates = _flatten_dict(result)
 
         flattened_results = {}

@@ -266,17 +266,20 @@ class MeanAveragePrecision(Metric):
             return np.asarray(box_iou(jnp.asarray(det_buf), jnp.asarray(gt_buf)))
         start = 0
         while start < num:
-            # chunk size bounded by the padded mask footprint of ITS members
+            # chunk size bounded by the PADDED buffer footprint: members pad to the chunk-wide
+            # max (H, W), so the budget must use the running max, not each member's own size
             end = start
-            elems = 0
+            run_h = run_w = 1
             while end < num:
                 h = max(det_geoms[end].shape[1] if det_geoms[end].size else 1,
                         gt_geoms[end].shape[1] if gt_geoms[end].size else 1)
                 w = max(det_geoms[end].shape[2] if det_geoms[end].size else 1,
                         gt_geoms[end].shape[2] if gt_geoms[end].size else 1)
-                elems += (cap_d + cap_g) * h * w
-                if end > start and elems > self._SEGM_CHUNK_ELEMS:
+                new_h, new_w = max(run_h, h), max(run_w, w)
+                padded_elems = (end - start + 1) * (cap_d + cap_g) * new_h * new_w
+                if end > start and padded_elems > self._SEGM_CHUNK_ELEMS:
                     break
+                run_h, run_w = new_h, new_w
                 end += 1
             chunk_d = det_geoms[start:end]
             chunk_g = gt_geoms[start:end]

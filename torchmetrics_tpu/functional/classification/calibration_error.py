@@ -11,11 +11,10 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
-
-from torchmetrics_tpu.ops import bincount_weighted
 from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
 from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
 
@@ -30,12 +29,19 @@ def _binning_bucketize(
     and ``conf == 1.0`` lands in its own extra slot — hence ``n_bins + 1`` state slots. A naive
     ``(conf * n_bins).astype(int)`` truncation mis-bins boundary values under float32 rounding.
     """
+    # cumulative-indicator matmul instead of searchsorted+bincount: suffix[k] = Σ x_i·[c_i >= b_k]
+    # via one (3, N) @ (N, n_bins+1) dot (the broadcast compare fuses into the dot operand — XLA's
+    # searchsorted lowering is per-element binary-search gathers, ~1000x slower on TPU), then
+    # per-bin sums as adjacent differences. `>= b_k` is exactly bucketize-right's boundary rule.
     boundaries = jnp.linspace(0.0, 1.0, n_bins + 1, dtype=confidences.dtype)
-    idx = jnp.clip(jnp.searchsorted(boundaries, confidences, side="right") - 1, 0, n_bins)
-    count = bincount_weighted(idx, n_bins + 1, weights=weight, dtype=jnp.float32)
-    conf_sum = bincount_weighted(idx, n_bins + 1, weights=confidences * weight, dtype=jnp.float32)
-    acc_sum = bincount_weighted(idx, n_bins + 1, weights=accuracies * weight, dtype=jnp.float32)
-    return count, conf_sum, acc_sum
+    ind = (confidences[:, None] >= boundaries[None, :]).astype(jnp.float32)  # (N, B+1)
+    w = weight.astype(jnp.float32)
+    stacked = jnp.stack([w, confidences * w, accuracies * w])  # (3, N)
+    suffix = jnp.matmul(stacked, ind, precision=jax.lax.Precision.HIGHEST)  # (3, B+1)
+    # bin k (k < n_bins) spans [b_k, b_{k+1}); the extra slot n_bins holds conf == 1.0 exactly.
+    # values below b_0 = 0.0 cannot occur (confidences are probabilities), matching the clip.
+    sums = jnp.concatenate([suffix[:, :-1] - suffix[:, 1:], suffix[:, -1:]], axis=1)
+    return sums[0], sums[1], sums[2]
 
 
 def _ce_compute(count: Array, conf_sum: Array, acc_sum: Array, norm: str = "l1") -> Array:

@@ -8,10 +8,10 @@ score state, same 5-function decomposition per task.
 TPU-first redesign:
 
 - **Binned mode is the native default.** The reference's vectorized (N, T) comparison has a 50k
-  crossover to a Python loop (``:203-250``); here the update is O(N + T): each score is bucketed
-  with ``searchsorted`` against the sorted thresholds, bucket histograms accumulate via
-  segment-sum/one-hot-matmul (``ops.bincount_weighted``), and per-threshold tp/fp are suffix
-  cumsums of the histogram. No (N, T) materialisation at any size, shape-static, jit/shard-safe.
+  crossover to a Python loop (``:203-250``); here per-threshold tp/fp are ONE class-batched
+  matmul against the threshold indicator (``_indicator_counts``) — XLA fuses the broadcast
+  compare into the dot operand, so nothing (N, T)-shaped ever hits HBM and the reduction runs on
+  the MXU at memory-bound speed. Shape-static, jit/shard-safe at any size.
 - ``ignore_index`` rides along as a weight vector (masking, never dropping — dynamic shapes
   don't exist under XLA).
 - **Exact mode is the host path** (as in the reference, where unbounded cat-state compute happens
@@ -21,11 +21,10 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
-
-from torchmetrics_tpu.ops import bincount_weighted
 from torchmetrics_tpu.utils.checks import _check_same_shape, is_traced
 from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
 
@@ -63,28 +62,39 @@ def _validate_thresholds_arg(thresholds: Thresholds) -> None:
         )
 
 
+def _indicator_counts(
+    scores: Array, pos: Array, neg: Array, thresholds: Array
+) -> Tuple[Array, Array]:
+    """``tp[c, t] = Σ_i pos[c, i]·[scores[c, i] >= thr_t]`` (and fp from neg), inputs (C, N).
+
+    Lowered as a class-batched ``(C, 2, N) @ (C, N, T)`` dot whose RHS is the threshold
+    indicator — XLA fuses the broadcast compare into the dot operand, so the (N, T) indicator is
+    never materialised and the whole reduction runs on the MXU. Replaces the previous
+    searchsorted+histogram formulation: XLA lowers ``searchsorted`` to per-element binary-search
+    gathers, which measured ~1000x slower than this matmul on a v5e chip.
+
+    f32 accumulation: counts are exact up to 2^24 (~16.7M) samples per update, the same
+    contract as the confusion-matrix kernel (``ops/histogram.py``).
+    """
+    ind = (scores[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (C, N, T)
+    both = jnp.stack([pos, neg], axis=1)  # (C, 2, N)
+    res = jax.lax.dot_general(
+        both, ind, (((2,), (1,)), ((0,), (0,))), precision=jax.lax.Precision.HIGHEST
+    )  # (C, 2, T)
+    return res[:, 0], res[:, 1]
+
+
 def _binned_counts(
     scores: Array, positive: Array, weight: Array, thresholds: Array
 ) -> Tuple[Array, Array, Array, Array]:
-    """Per-threshold (tp, fp, tn, fn), each shape (T,), via bucketed histograms.
-
-    ``pred >= thr_t`` iff the score's bucket index (``searchsorted(thresholds, s, 'right')``)
-    exceeds ``t`` — so tp[t] is a suffix-sum of the positive-score histogram. O(N + T).
-    """
-    t_count = thresholds.shape[0]
-    bucket = jnp.searchsorted(thresholds, scores, side="right")  # in [0, T]
+    """Per-threshold (tp, fp, tn, fn), each shape (T,), via the indicator matmul."""
     w = weight.astype(jnp.float32)
     pos = positive.astype(jnp.float32) * w
     neg = (1.0 - positive.astype(jnp.float32)) * w
-    hist_pos = bincount_weighted(bucket, t_count + 1, weights=pos, dtype=jnp.float32)
-    hist_neg = bincount_weighted(bucket, t_count + 1, weights=neg, dtype=jnp.float32)
-    # tp[t] = sum_{b > t} hist_pos[b]  (suffix sums, excluding bucket 0..t)
-    tp = jnp.cumsum(hist_pos[::-1])[::-1][1:]  # (T,)
-    fp = jnp.cumsum(hist_neg[::-1])[::-1][1:]
-    total_pos = jnp.sum(hist_pos)
-    total_neg = jnp.sum(hist_neg)
-    fn = total_pos - tp
-    tn = total_neg - fp
+    tp, fp = _indicator_counts(scores[None], pos[None], neg[None], thresholds)
+    tp, fp = tp[0], fp[0]
+    fn = jnp.sum(pos) - tp
+    tn = jnp.sum(neg) - fp
     return tp, fp, tn, fn
 
 
@@ -300,23 +310,14 @@ def _multiclass_precision_recall_curve_format(
 def _multiclass_precision_recall_curve_update(
     preds: Array, target: Array, weight: Array, num_classes: int, thresholds: Optional[Array]
 ) -> Array:
-    """(T, C, 2, 2) one-vs-rest confusion counts, vectorised over classes."""
-    t_count = thresholds.shape[0]
-    n = preds.shape[0]
-    # bucket every (sample, class) score; positive iff target == class
-    bucket = jnp.searchsorted(thresholds, jnp.reshape(preds, (-1,)), side="right")  # (N*C,)
-    cls_idx = jnp.tile(jnp.arange(num_classes), n)
-    fused = cls_idx * (t_count + 1) + bucket
-    pos = (target[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
-    w = weight[:, None] * jnp.ones((1, num_classes), jnp.float32)
-    hist_pos = bincount_weighted(fused, num_classes * (t_count + 1), weights=jnp.reshape(pos * w, (-1,)), dtype=jnp.float32)
-    hist_all = bincount_weighted(fused, num_classes * (t_count + 1), weights=jnp.reshape(w, (-1,)), dtype=jnp.float32)
-    hist_pos = jnp.reshape(hist_pos, (num_classes, t_count + 1))
-    hist_neg = jnp.reshape(hist_all, (num_classes, t_count + 1)) - hist_pos
-    tp = jnp.cumsum(hist_pos[:, ::-1], axis=1)[:, ::-1][:, 1:]  # (C, T)
-    fp = jnp.cumsum(hist_neg[:, ::-1], axis=1)[:, ::-1][:, 1:]
-    fn = jnp.sum(hist_pos, axis=1, keepdims=True) - tp
-    tn = jnp.sum(hist_neg, axis=1, keepdims=True) - fp
+    """(T, C, 2, 2) one-vs-rest confusion counts via the class-batched indicator matmul."""
+    pos = (target[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.float32)  # (N, C)
+    w = weight.astype(jnp.float32)[:, None]
+    pos_cn = (pos * w).T  # (C, N)
+    neg_cn = ((1.0 - pos) * w).T
+    tp, fp = _indicator_counts(preds.T, pos_cn, neg_cn, thresholds)  # (C, T)
+    fn = jnp.sum(pos_cn, axis=1, keepdims=True) - tp
+    tn = jnp.sum(neg_cn, axis=1, keepdims=True) - fp
     confmat = _counts_to_confmat(tp.T, fp.T, tn.T, fn.T)  # (T, C, 2, 2)
     return confmat
 
@@ -427,21 +428,13 @@ def _multilabel_precision_recall_curve_format(
 def _multilabel_precision_recall_curve_update(
     preds: Array, target: Array, weight: Array, num_labels: int, thresholds: Optional[Array]
 ) -> Array:
-    """(T, L, 2, 2) per-label confusion counts."""
-    t_count = thresholds.shape[0]
-    n = preds.shape[0]
-    bucket = jnp.searchsorted(thresholds, jnp.reshape(preds, (-1,)), side="right")
-    lbl_idx = jnp.tile(jnp.arange(num_labels), n)
-    fused = lbl_idx * (t_count + 1) + bucket
-    pos = target.astype(jnp.float32) * weight
-    hist_pos = bincount_weighted(fused, num_labels * (t_count + 1), weights=jnp.reshape(pos, (-1,)), dtype=jnp.float32)
-    hist_all = bincount_weighted(fused, num_labels * (t_count + 1), weights=jnp.reshape(weight, (-1,)), dtype=jnp.float32)
-    hist_pos = jnp.reshape(hist_pos, (num_labels, t_count + 1))
-    hist_neg = jnp.reshape(hist_all, (num_labels, t_count + 1)) - hist_pos
-    tp = jnp.cumsum(hist_pos[:, ::-1], axis=1)[:, ::-1][:, 1:]
-    fp = jnp.cumsum(hist_neg[:, ::-1], axis=1)[:, ::-1][:, 1:]
-    fn = jnp.sum(hist_pos, axis=1, keepdims=True) - tp
-    tn = jnp.sum(hist_neg, axis=1, keepdims=True) - fp
+    """(T, L, 2, 2) per-label confusion counts via the label-batched indicator matmul."""
+    w = weight.astype(jnp.float32)
+    pos_ln = (target.astype(jnp.float32) * w).T  # (L, N)
+    neg_ln = ((1.0 - target.astype(jnp.float32)) * w).T
+    tp, fp = _indicator_counts(preds.T, pos_ln, neg_ln, thresholds)  # (L, T)
+    fn = jnp.sum(pos_ln, axis=1, keepdims=True) - tp
+    tn = jnp.sum(neg_ln, axis=1, keepdims=True) - fp
     return _counts_to_confmat(tp.T, fp.T, tn.T, fn.T)  # (T, L, 2, 2)
 
 

@@ -416,11 +416,89 @@ class Metric:
         self._update_called = True
         return batch_val
 
+    def _fusable_forward(self) -> bool:
+        """True when the whole reduce-state forward can be ONE compiled program: jittable
+        update+compute, tensor-only state, and shape-stable (non-cat) NAMED reductions.
+
+        Custom callable reduce-fx is excluded — the public API allows host-only callables
+        (e.g. numpy lambdas) that cannot trace under jit; those keep the eager merge path.
+        """
+        flag = self._jit_cache.get("forward_fusable")
+        if flag is None:
+            flag = (
+                self.jit_update
+                and self.jit_compute
+                and not self._state.lists
+                and all(
+                    fx in ("sum", "mean", "max", "min") or fx in (jnp.sum, jnp.max, jnp.min)
+                    for fx in (self._reductions[n] for n in self._state.tensors)
+                )
+            )
+            self._jit_cache["forward_fusable"] = flag
+        return flag
+
+    @staticmethod
+    def _merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n):
+        """Trace-safe reduce-fx merge of a batch contribution into the global tensors (the
+        single source of truth for fused forward steps — metric- and group-level)."""
+        merged = {}
+        for name, gv in global_tensors.items():
+            if name not in batch_out:
+                merged[name] = gv
+                continue
+            bv = batch_out[name]
+            fx = reductions[name]
+            if fx == "sum" or fx is jnp.sum:
+                merged[name] = gv + (bv - defaults[name])
+            elif fx == "mean":
+                nf = n.astype(bv.dtype) if hasattr(bv, "dtype") else n
+                merged[name] = ((nf - 1) * gv + bv) / nf
+            elif fx == "max" or fx is jnp.max:
+                merged[name] = jnp.maximum(gv, bv)
+            elif fx == "min" or fx is jnp.min:
+                merged[name] = jnp.minimum(gv, bv)
+            else:  # pragma: no cover - callables are excluded by _fusable_forward
+                raise TorchMetricsUserError(f"Cannot fuse dist_reduce_fx={fx!r}")
+        return merged
+
+    def _jitted_forward_step(self) -> Callable:
+        """(global_tensors, n, *args, **kwargs) -> (batch_val, merged_tensors), one XLA program.
+
+        Collapses the update kernel, the batch-local compute, and the per-state merge (the
+        previous eager `_reduce_states` adds — one dispatch per state) into a single launch;
+        per-dispatch latency dominates the per-step ``forward`` protocol on real accelerators.
+        """
+        fn = self._jit_cache.get("forward_step")
+        if fn is None:
+            defaults = {k: self._defaults[k] for k in self._state.tensors}
+            reductions = {k: self._reductions[k] for k in self._state.tensors}
+
+            def step(global_tensors, n, *args, **kwargs):
+                batch_out = self._update(dict(defaults), *args, **kwargs)
+                batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
+                batch_val = self._compute(batch_state)
+                merged = self._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
+                return batch_val, merged
+
+            fn = jax.jit(step)
+            self._jit_cache["forward_step"] = fn
+        return fn
+
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
         args, kwargs = self._coerce(args, kwargs)
         if self._should_validate():
             self._validate(*args, **kwargs)
+        if self._fusable_forward():
+            batch_val, merged = self._jitted_forward_step()(
+                dict(self._state.tensors), jnp.asarray(self._update_count + 1, jnp.float32), *args, **kwargs
+            )
+            # count bumps only after the kernel call succeeded (a trace error must not skew n)
+            self._update_count += 1
+            self._update_called = True
+            self._computed = None
+            self._state.tensors.update(merged)
+            return self._squeeze_if_scalar(batch_val)
         batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)
         self._update_count += 1
         self._update_called = True
@@ -436,8 +514,6 @@ class Metric:
         batch_val = self._squeeze_if_scalar(self._jitted_compute()(batch_state))
         # merge into global
         self._reduce_states(dict(self._state.tensors), batch_out)
-        if self.dist_sync_on_step:  # unreachable (routed to full path) but kept for clarity
-            pass
         return batch_val
 
     # ------------------------------------------------------------------- sync

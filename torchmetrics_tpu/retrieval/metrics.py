@@ -99,26 +99,18 @@ class RetrievalFallOut(RetrievalMetric):
 
     def _compute(self, state):
         # like base, but "empty" = no negative targets (reference fall_out.py:126)
-        indexes = np.asarray(state["indexes"])
-        preds = np.asarray(state["preds"])
-        target = np.asarray(state["target"])
-        if self.ignore_index is not None:
-            keep = target != self.ignore_index
-            indexes, preds, target = indexes[keep], preds[keep], target[keep]
-        if indexes.size == 0:
+        arrays = self._state_arrays(state)
+        if arrays is None:
             return jnp.zeros(())
-        values, target_pad, mask_pad = self._grouped_values(indexes, preds, target)
-        empty = ((1 - target_pad) * mask_pad).sum(axis=1) == 0
-        if self.empty_target_action == "error" and bool(empty.any()):
-            raise ValueError("`compute` method was provided with a query with no negative target.")
-        values_np = np.asarray(values)
-        if self.empty_target_action == "skip":
-            values_np = values_np[~empty]
-        elif self.empty_target_action == "pos":
-            values_np = np.where(empty, 1.0, values_np)
-        else:
-            values_np = np.where(empty, 0.0, values_np)
-        return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)
+        indexes, preds, target, valid = arrays
+        msg = "`compute` method was provided with a query with no negative target."
+        if callable(self.aggregation):
+            values, _pos, neg_count, valid_count = self._grouped_values(
+                indexes, preds, target, valid=valid
+            )
+            values_np = self._select_values(values, neg_count == 0, valid_count > 0, msg)
+            return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)
+        return self._grouped_aggregate(indexes, preds, target, valid, "neg", msg)
 
 
 class RetrievalHitRate(RetrievalMetric):
@@ -171,14 +163,17 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         self.adaptive_k = adaptive_k
 
     def _compute(self, state) -> Tuple[Array, Array, Array]:
-        indexes = np.asarray(state["indexes"])
-        preds = np.asarray(state["preds"])
-        target = np.asarray(state["target"])
-        if self.ignore_index is not None:
-            keep = target != self.ignore_index
-            indexes, preds, target = indexes[keep], preds[keep], target[keep]
-        uniq, inv, counts = np.unique(indexes, return_inverse=True, return_counts=True)
-        max_k = self.max_k or int(counts.max())
+        arrays = self._state_arrays(state)
+        if arrays is None:
+            return jnp.zeros(()), jnp.zeros(()), jnp.zeros((), jnp.int32)
+        indexes, preds, target, valid = arrays
+        from torchmetrics_tpu.retrieval.base import _max_valid_per_query
+
+        if self.max_k is not None:
+            max_k = self.max_k
+        else:
+            # count only non-ignored docs (the old host path filtered before grouping)
+            max_k = int(jax.device_get(_max_valid_per_query(indexes, valid)))
         precisions, recalls = [], []
         for k in range(1, max_k + 1):
             def kernel_p(p, t, m, k=k):
@@ -187,22 +182,18 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
             def kernel_r(p, t, m, k=k):
                 return recall_kernel(p, t, m, k)
 
-            precisions.append(self._curve_values(indexes, preds, target, kernel_p, f"prec@{k}"))
-            recalls.append(self._curve_values(indexes, preds, target, kernel_r, f"rec@{k}"))
+            precisions.append(self._curve_values(indexes, preds, target, valid, kernel_p, f"prec@{k}"))
+            recalls.append(self._curve_values(indexes, preds, target, valid, kernel_r, f"rec@{k}"))
         return jnp.stack(precisions), jnp.stack(recalls), jnp.arange(1, max_k + 1)
 
-    def _curve_values(self, indexes, preds, target, kernel, cache_key):
-        values, target_pad, mask_pad = self._grouped_values(indexes, preds, target, kernel, cache_key)
-        empty = (target_pad * mask_pad).sum(axis=1) == 0
-        values_np = np.asarray(values)
-        if self.empty_target_action == "error" and bool(empty.any()):
-            raise ValueError("`compute` method was provided with a query with no positive target.")
-        if self.empty_target_action == "skip":
-            values_np = values_np[~empty]
-        elif self.empty_target_action == "pos":
-            values_np = np.where(empty, 1.0, values_np)
-        else:
-            values_np = np.where(empty, 0.0, values_np)
+    def _curve_values(self, indexes, preds, target, valid, kernel, cache_key):
+        values, pos_count, _neg, valid_count = self._grouped_values(
+            indexes, preds, target, kernel, cache_key, valid=valid
+        )
+        values_np = self._select_values(
+            values, pos_count == 0, valid_count > 0,
+            "`compute` method was provided with a query with no positive target.",
+        )
         return jnp.mean(jnp.asarray(values_np)) if values_np.size else jnp.zeros(())
 
 

@@ -428,6 +428,95 @@ class TestMeanAveragePrecisionSegm:
             )
 
 
+class TestCrowdAreaMicro:
+    """Optional COCO annotation fields (iscrowd/area, reference mean_ap.py:116,507-508) and
+    average='micro' (reference mean_ap.py:371,589-594)."""
+
+    def test_crowd_absorbs_high_scoring_detection(self):
+        # d_crowd (score .95) sits inside a crowd region; without absorption it is a top-ranked
+        # FP and halves AP; with pycocotools iscrowd semantics it is ignored and AP = 1
+        real_gt = np.asarray([[10.0, 10.0, 30.0, 30.0]], np.float32)
+        crowd_gt = np.asarray([[100.0, 100.0, 200.0, 200.0]], np.float32)
+        preds = [{
+            "boxes": jnp.asarray(np.concatenate([[[120.0, 120.0, 150.0, 150.0]], real_gt])),
+            "scores": jnp.asarray([0.95, 0.9]),
+            "labels": jnp.asarray([0, 0]),
+        }]
+        target = [{
+            "boxes": jnp.asarray(np.concatenate([crowd_gt, real_gt])),
+            "labels": jnp.asarray([0, 0]),
+            "iscrowd": jnp.asarray([1, 0]),
+        }]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-4)
+        # without the iscrowd flag the same inputs must score strictly lower
+        m2 = MeanAveragePrecision()
+        m2.update(preds, [{"boxes": target[0]["boxes"], "labels": target[0]["labels"]}])
+        assert float(m2.compute()["map_50"]) < 1.0
+
+    def test_crowd_excluded_from_recall_denominator(self):
+        # only the crowd gt exists -> no evaluable gts -> map stays -1 (npig == 0 everywhere)
+        target = [{
+            "boxes": jnp.asarray([[0.0, 0.0, 50.0, 50.0]]),
+            "labels": jnp.asarray([0]),
+            "iscrowd": jnp.asarray([1]),
+        }]
+        preds = [{"boxes": jnp.asarray([[60.0, 60.0, 80.0, 80.0]]), "scores": jnp.asarray([0.9]),
+                  "labels": jnp.asarray([0])}]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        np.testing.assert_allclose(float(m.compute()["map"]), -1.0, atol=1e-6)
+
+    def test_area_override_changes_bucket(self):
+        # a geometrically small gt with an explicit large COCO area lands in the large bucket
+        box = np.asarray([[10.0, 10.0, 20.0, 20.0]], np.float32)  # 100 px^2: small
+        preds = [{"boxes": jnp.asarray(box), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        m_small = MeanAveragePrecision()
+        m_small.update(preds, [{"boxes": jnp.asarray(box), "labels": jnp.asarray([0])}])
+        r_small = m_small.compute()
+        assert float(r_small["map_small"]) == 1.0 and float(r_small["map_large"]) == -1.0
+        m_large = MeanAveragePrecision()
+        m_large.update(preds, [{
+            "boxes": jnp.asarray(box), "labels": jnp.asarray([0]),
+            "area": jnp.asarray([100_000.0]),
+        }])
+        r_large = m_large.compute()
+        assert float(r_large["map_large"]) == 1.0 and float(r_large["map_small"]) == -1.0
+
+    def test_micro_equals_merged_labels(self):
+        global RNG
+        RNG = np.random.RandomState(77)
+        preds, targets = _make_dataset(num_imgs=3, num_classes=3)
+        m = MeanAveragePrecision(average="micro")
+        m.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res = m.compute()
+        merged_preds = [dict(p, labels=np.zeros_like(p["labels"])) for p in preds]
+        merged_targets = [dict(t, labels=np.zeros_like(t["labels"])) for t in targets]
+        oracle = _coco_ap_oracle(
+            merged_preds, merged_targets, m.iou_thresholds, np.asarray(m.rec_thresholds), max_det=100
+        )
+        np.testing.assert_allclose(float(res["map"]), oracle, atol=1e-4)
+        # per-class stats stay macro (one entry per REAL class)
+        m2 = MeanAveragePrecision(average="micro", class_metrics=True)
+        m2.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in targets],
+        )
+        res2 = m2.compute()
+        assert np.asarray(res2["map_per_class"]).shape[0] == len(np.asarray(res2["classes"]))
+
+    def test_average_validation(self):
+        with pytest.raises(ValueError, match="average"):
+            MeanAveragePrecision(average="bogus")
+        with pytest.raises(ValueError, match="backend"):
+            MeanAveragePrecision(backend="bogus")
+
+
 class TestExtendedSummary:
     """extended_summary=True returns the reference's ious/precision/recall/scores extras
     (reference mean_ap.py:192-210,536-545)."""

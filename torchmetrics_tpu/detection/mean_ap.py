@@ -29,7 +29,7 @@ import numpy as np
 from jax import Array, lax
 
 from torchmetrics_tpu.detection.helpers import _fix_empty_boxes, _input_validator
-from torchmetrics_tpu.functional.detection.iou import box_area, box_convert, box_iou
+from torchmetrics_tpu.functional.detection.iou import _pairwise_inter_union, box_area, box_convert
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
@@ -84,15 +84,31 @@ def _match_all_groups(
 
 
 @jax.jit
-def _mask_iou_matrix(det_flat: Array, gt_flat: Array) -> Array:
-    """(P, D, HW) x (P, G, HW) boolean masks -> (P, D, G) IoU via one MXU matmul per group."""
+def _mask_iou_matrix(det_flat: Array, gt_flat: Array):
+    """(P, D, HW) x (P, G, HW) boolean masks -> (iou, iod) each (P, D, G), one MXU matmul.
+
+    ``iod`` (intersection over det area) is the COCO crowd-matching IoU
+    (``pycocotools`` ``iscrowd=1`` semantics: a crowd region absorbs any detection mostly
+    inside it)."""
     det_f = det_flat.astype(jnp.float32)
     gt_f = gt_flat.astype(jnp.float32)
     inter = jnp.einsum("pdh,pgh->pdg", det_f, gt_f, precision="highest")
     area_d = jnp.sum(det_f, axis=-1)
     area_g = jnp.sum(gt_f, axis=-1)
     union = area_d[:, :, None] + area_g[:, None, :] - inter
-    return jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+    iod = jnp.where(area_d[:, :, None] > 0, inter / jnp.maximum(area_d[:, :, None], 1.0), 0.0)
+    return iou, iod
+
+
+@jax.jit
+def _box_iou_iod(det_buf: Array, gt_buf: Array):
+    """(P, D, 4) x (P, G, 4) boxes -> (iou, iod) each (P, D, G)."""
+    inter, union = _pairwise_inter_union(det_buf, gt_buf)
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    area_d = box_area(det_buf)[..., :, None]
+    iod = jnp.where(area_d > 0, inter / jnp.maximum(area_d, 1e-9), 0.0)
+    return iou, iod
 
 
 def _next_pow2(n: int) -> int:
@@ -119,6 +135,8 @@ class MeanAveragePrecision(Metric):
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
         extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "pycocotools",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -137,6 +155,14 @@ class MeanAveragePrecision(Metric):
         if not isinstance(extended_summary, bool):
             raise ValueError("Expected argument `extended_summary` to be a boolean")
         self.extended_summary = extended_summary
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
+        if backend not in ("pycocotools", "faster_coco_eval"):
+            raise ValueError(
+                f"Expected argument `backend` to be one of ('pycocotools', 'faster_coco_eval') but got {backend}"
+            )
+        self.backend = backend  # accepted for API parity; evaluation is the built-in XLA matcher
         self.add_state("detections", [], dist_reduce_fx=None)
         self.add_state("detection_masks", [], dist_reduce_fx=None)
         self.add_state("detection_scores", [], dist_reduce_fx=None)
@@ -144,6 +170,8 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruths", [], dist_reduce_fx=None)
         self.add_state("groundtruth_masks", [], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_crowds", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_area", [], dist_reduce_fx=None)
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # noqa: D102
         if self._is_synced:
@@ -163,7 +191,24 @@ class MeanAveragePrecision(Metric):
                 self._state.lists["groundtruths"].append(self._get_safe_item_values(item["boxes"]))
             if "segm" in self.iou_types:
                 self._state.lists["groundtruth_masks"].append(jnp.asarray(item["masks"], bool))
-            self._state.lists["groundtruth_labels"].append(jnp.asarray(item["labels"]).reshape(-1))
+            labels = jnp.asarray(item["labels"]).reshape(-1)
+            self._state.lists["groundtruth_labels"].append(labels)
+            # optional COCO annotation fields (reference mean_ap.py:507-508)
+            for key, default_dtype, state_name in (
+                ("iscrowd", jnp.int32, "groundtruth_crowds"),
+                ("area", jnp.float32, "groundtruth_area"),
+            ):
+                val = item.get(key)
+                if val is None:
+                    val = jnp.zeros(labels.shape, default_dtype)
+                else:
+                    val = jnp.asarray(val).reshape(-1)
+                    if val.shape[0] != labels.shape[0]:
+                        raise ValueError(
+                            f"Input '{key}' and labels of a sample in targets have different"
+                            f" lengths ({val.shape[0]} vs {labels.shape[0]})"
+                        )
+                self._state.lists[state_name].append(val)
         self._update_count += 1
         self._update_called = True
         self._computed = None
@@ -200,15 +245,23 @@ class MeanAveragePrecision(Metric):
         return dets, gts
 
     # ------------------------------------------------------------------ compute
-    def _build_groups(self, classes: List[int], i_type: str):
-        """Group detections/gts per (image, class); sort dets by score desc; pad to capacity."""
+    def _build_groups(self, classes: List[int], i_type: str, micro: bool = False):
+        """Group detections/gts per (image, class); sort dets by score desc; pad to capacity.
+
+        ``micro=True`` merges every label into one class (reference ``mean_ap.py:589-594``).
+        """
         max_det = self.max_detection_thresholds[-1]
         dets, gts = self._geometries(i_type)
         det_scores = [np.asarray(s) for s in self._state.lists["detection_scores"]]
         det_labels = [np.asarray(l) for l in self._state.lists["detection_labels"]]
         gt_labels = [np.asarray(l) for l in self._state.lists["groundtruth_labels"]]
+        gt_crowds = [np.asarray(c) for c in self._state.lists["groundtruth_crowds"]]
+        gt_area_over = [np.asarray(a) for a in self._state.lists["groundtruth_area"]]
+        if micro:
+            det_labels = [np.zeros_like(l) for l in det_labels]
+            gt_labels = [np.zeros_like(l) for l in gt_labels]
 
-        groups = []  # (cls_idx, img_idx, det geom sorted, det scores sorted, gt geom)
+        groups = []  # (cls_idx, img_idx, det geom sorted, det scores sorted, gt geom, crowd, area)
         for cls_idx, cls in enumerate(classes):
             for i in range(len(gts)):
                 d_mask = det_labels[i] == cls
@@ -217,7 +270,10 @@ class MeanAveragePrecision(Metric):
                     continue
                 s = det_scores[i][d_mask]
                 order = np.argsort(-s, kind="stable")[:max_det]
-                groups.append((cls_idx, i, dets[i][d_mask][order], s[order], gts[i][g_mask]))
+                groups.append((
+                    cls_idx, i, dets[i][d_mask][order], s[order], gts[i][g_mask],
+                    gt_crowds[i][g_mask], gt_area_over[i][g_mask],
+                ))
 
         if not groups:
             return None
@@ -227,11 +283,13 @@ class MeanAveragePrecision(Metric):
         scores = np.full((num, cap_d), -np.inf, np.float32)
         det_valid = np.zeros((num, cap_d), bool)
         gt_valid = np.zeros((num, cap_g), bool)
+        gt_crowd = np.zeros((num, cap_g), bool)
+        gt_area = np.zeros((num, cap_g), np.float64)
         cls_of = np.empty(num, np.int64)
         img_of = np.empty(num, np.int64)
         det_geoms: List[np.ndarray] = []
         gt_geoms: List[np.ndarray] = []
-        for j, (cls_idx, img_idx, dg, sc, gg) in enumerate(groups):
+        for j, (cls_idx, img_idx, dg, sc, gg, crowd, area_over) in enumerate(groups):
             cls_of[j] = cls_idx
             img_of[j] = img_idx
             nd, ng = dg.shape[0], gg.shape[0]
@@ -240,7 +298,9 @@ class MeanAveragePrecision(Metric):
             scores[j, :nd] = sc
             det_valid[j, :nd] = True
             gt_valid[j, :ng] = True
-        return cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid, cap_d, cap_g
+            gt_crowd[j, :ng] = crowd.astype(bool)
+            gt_area[j, :ng] = area_over
+        return cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid, cap_d, cap_g, gt_crowd, gt_area
 
     # dense mask-IoU work is chunked so device/host buffers stay bounded regardless of dataset
     # size: each chunk pads only ITS groups to its own (H, W) and detection/gt capacities
@@ -253,17 +313,22 @@ class MeanAveragePrecision(Metric):
         i_type: str,
         cap_d: int,
         cap_g: int,
-    ) -> np.ndarray:
-        """(P, cap_d, cap_g) IoU matrix; pads in per-chunk buffers, never a global mask tensor."""
+        need_iod: bool = False,
+    ):
+        """(P, cap_d, cap_g) (IoU, intersection-over-det) matrices; pads in per-chunk buffers,
+        never a global mask tensor. ``iod`` is None unless requested (crowd gts present) — it
+        doubles the D2H transfer and host buffering of the memory-bound stage."""
         num = len(det_geoms)
         out = np.zeros((num, cap_d, cap_g), np.float32)
+        out_iod = np.zeros((num, cap_d, cap_g), np.float32) if need_iod else None
         if i_type == "bbox":
             det_buf = np.zeros((num, cap_d, 4), np.float32)
             gt_buf = np.zeros((num, cap_g, 4), np.float32)
             for j, (dg, gg) in enumerate(zip(det_geoms, gt_geoms)):
                 det_buf[j, : dg.shape[0]] = dg
                 gt_buf[j, : gg.shape[0]] = gg
-            return np.asarray(box_iou(jnp.asarray(det_buf), jnp.asarray(gt_buf)))
+            iou, iod = _box_iou_iod(jnp.asarray(det_buf), jnp.asarray(gt_buf))
+            return np.asarray(iou), (np.asarray(iod) if need_iod else None)
         start = 0
         while start < num:
             # chunk size bounded by the PADDED buffer footprint: members pad to the chunk-wide
@@ -291,14 +356,15 @@ class MeanAveragePrecision(Metric):
             for jj, (dg, gg) in enumerate(zip(chunk_d, chunk_g)):
                 det_buf[jj, : dg.shape[0], : dg.shape[1], : dg.shape[2]] = dg
                 gt_buf[jj, : gg.shape[0], : gg.shape[1], : gg.shape[2]] = gg
-            out[start:end] = np.asarray(
-                _mask_iou_matrix(
-                    jnp.asarray(det_buf.reshape(n, cap_d, -1)),
-                    jnp.asarray(gt_buf.reshape(n, cap_g, -1)),
-                )
+            iou, iod = _mask_iou_matrix(
+                jnp.asarray(det_buf.reshape(n, cap_d, -1)),
+                jnp.asarray(gt_buf.reshape(n, cap_g, -1)),
             )
+            out[start:end] = np.asarray(iou)
+            if need_iod:
+                out_iod[start:end] = np.asarray(iod)
             start = end
-        return out
+        return out, out_iod
 
     @staticmethod
     def _geom_areas(geoms: List[np.ndarray], cap: int, i_type: str) -> np.ndarray:
@@ -312,7 +378,7 @@ class MeanAveragePrecision(Metric):
                 out[j, : g.shape[0]] = g.reshape(g.shape[0], -1).sum(axis=-1)
         return out
 
-    def _compute_one_type(self, classes: List[int], i_type: str):
+    def _compute_one_type(self, classes: List[int], i_type: str, micro: bool = False):
         """precision (T,R,K,A,M), recall (T,K,A,M), scores (T,R,K,A,M), ious dict for one type."""
         num_t = len(self.iou_thresholds)
         num_r = len(self.rec_thresholds)
@@ -331,11 +397,14 @@ class MeanAveragePrecision(Metric):
             empty = jnp.zeros((0, 0), jnp.float32)
             ious_out = {(i, c): empty for i in range(num_imgs) for c in classes}
 
-        built = self._build_groups(classes, i_type) if classes else None
+        built = self._build_groups(classes, i_type, micro=micro) if classes else None
         if built is not None:
-            cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid, cap_d, cap_g = built
+            (cls_of, img_of, det_geoms, scores, det_valid, gt_geoms, gt_valid,
+             cap_d, cap_g, gt_crowd, gt_area_over) = built
             # one device program: pairwise IoU + greedy matching for all groups/areas/thresholds
-            ious_np = self._pairwise_iou_all(det_geoms, gt_geoms, i_type, cap_d, cap_g)
+            ious_np, iod_np = self._pairwise_iou_all(
+                det_geoms, gt_geoms, i_type, cap_d, cap_g, need_iod=bool((gt_crowd & gt_valid).any())
+            )
             ious = jnp.where(
                 det_valid[:, :, None] & gt_valid[:, None, :], jnp.asarray(ious_np), 0.0
             )
@@ -347,10 +416,15 @@ class MeanAveragePrecision(Metric):
                         ious_np[j, :nd, :ng], jnp.float32
                     )
             gt_areas = self._geom_areas(gt_geoms, cap_g, i_type)
+            # explicit COCO annotation areas override the geometry-derived ones when positive
+            gt_areas = np.where(gt_area_over > 0, gt_area_over, gt_areas)
             det_areas = self._geom_areas(det_geoms, cap_d, i_type)
             ranges = np.asarray(list(_AREA_RANGES.values()))  # (A, 2)
-            gt_ignore = (gt_areas[:, None, :] < ranges[None, :, 0:1]) | (
-                gt_areas[:, None, :] > ranges[None, :, 1:2]
+            # crowd ground truths are ignore-targets in every area range (pycocotools _ignore)
+            gt_ignore = (
+                (gt_areas[:, None, :] < ranges[None, :, 0:1])
+                | (gt_areas[:, None, :] > ranges[None, :, 1:2])
+                | gt_crowd[:, None, :]
             )  # (P, A, G)
             det_outside = (det_areas[:, None, :] < ranges[None, :, 0:1]) | (
                 det_areas[:, None, :] > ranges[None, :, 1:2]
@@ -365,8 +439,24 @@ class MeanAveragePrecision(Metric):
                     num_t,
                 )
             )  # (P, A, T, D)
-            # unmatched detections outside the area range are ignored (_mean_ap.py:609-614)
-            det_ignore = ~det_matches & det_outside[:, :, None, :] & det_valid[:, None, None, :]
+            # crowd absorption (pycocotools iscrowd semantics): an unmatched detection whose
+            # intersection-over-own-area with any crowd gt clears the threshold is ignored,
+            # not a false positive; crowd regions absorb unlimited detections. Reduce IoD over
+            # crowd gts FIRST so no (P, T, D, G) temporary ever materialises.
+            crowd_mask = gt_crowd & gt_valid  # (P, G)
+            if iod_np is not None and crowd_mask.any():
+                thr = np.asarray(self.iou_thresholds)  # (T,)
+                best_crowd_iod = np.where(crowd_mask[:, None, :], iod_np, 0.0).max(axis=-1)  # (P, D)
+                crowd_absorb = best_crowd_iod[:, None, :] > thr[None, :, None]  # (P, T, D)
+            else:
+                crowd_absorb = np.zeros((det_valid.shape[0], num_t, det_valid.shape[1]), bool)
+            # unmatched detections outside the area range OR absorbed by a crowd are ignored
+            # (_mean_ap.py:609-614 + pycocotools dtIg)
+            det_ignore = (
+                ~det_matches
+                & (det_outside[:, :, None, :] | crowd_absorb[:, None, :, :])
+                & det_valid[:, None, None, :]
+            )
 
             rec_thrs = np.asarray(self.rec_thresholds)
             eps = np.finfo(np.float64).eps
@@ -422,19 +512,28 @@ class MeanAveragePrecision(Metric):
     def _compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         classes = self._get_classes()
         num_k = len(classes)
+        micro = self.average == "micro"
         results: Dict[str, Any] = {}
         for i_type in self.iou_types:
             prefix = "" if len(self.iou_types) == 1 else f"{i_type}_"
-            precision, recall, score_arr, ious_out = self._compute_one_type(classes, i_type)
+            # micro averaging merges every label into one class for the headline stats
+            # (reference mean_ap.py:589-594); per-class stats below always run macro
+            eval_classes = [0] if micro and classes else classes
+            precision, recall, score_arr, ious_out = self._compute_one_type(
+                eval_classes, i_type, micro=micro
+            )
             for key, val in self._summarize_results(precision, recall).items():
                 results[f"{prefix}{key}"] = val
 
             map_per_class = np.asarray([-1.0])
             mar_per_class = np.asarray([-1.0])
             if self.class_metrics and num_k:
+                m_precision, m_recall, _, _ = (
+                    self._compute_one_type(classes, i_type) if micro else (precision, recall, None, None)
+                )
                 maps, mars = [], []
                 for k in range(num_k):
-                    cls_res = self._summarize_results(precision[:, :, k : k + 1], recall[:, k : k + 1])
+                    cls_res = self._summarize_results(m_precision[:, :, k : k + 1], m_recall[:, k : k + 1])
                     maps.append(float(cls_res["map"]))
                     mars.append(float(cls_res[f"mar_{self.max_detection_thresholds[-1]}"]))
                 map_per_class = np.asarray(maps, np.float32)

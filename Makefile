@@ -1,0 +1,20 @@
+.PHONY: test test-unit test-integration doctest bench clean
+
+test: test-unit test-integration
+
+test-unit:
+	python -m pytest tests/unittests -q
+
+test-integration:
+	python -m pytest tests/integrations -q
+
+# every docstring example runs as a test (pyproject --doctest-modules covers the package)
+doctest:
+	python -m pytest torchmetrics_tpu -q
+
+bench:
+	python bench.py
+
+clean:
+	find . -type d -name __pycache__ -prune -exec rm -rf {} +
+	rm -rf .pytest_cache

@@ -1,0 +1,98 @@
+"""`.plot()` wiring across metric families (reference ``tests/unittests/utilities/test_plot.py``
+— every metric exposes a working plot method backed by the three utilities in
+``utils/plot.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import matplotlib
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+matplotlib.use("Agg")
+
+RNG = np.random.RandomState(42)
+
+
+def _finish(out):
+    fig, ax = out
+    assert fig is not None
+    plt.close(fig)
+
+
+class TestMetricPlot:
+    def test_scalar_metric_single_and_multi_val(self):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        m = BinaryAccuracy()
+        m.update(jnp.asarray(RNG.rand(64)), jnp.asarray(RNG.randint(0, 2, 64)))
+        _finish(m.plot())                       # current value
+        vals = [m.compute() for _ in range(3)]
+        _finish(m.plot(vals))                   # sequence of values
+
+    def test_per_class_metric(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        m = MulticlassAccuracy(num_classes=4, average=None)
+        m.update(jnp.asarray(RNG.randn(64, 4)), jnp.asarray(RNG.randint(0, 4, 64)))
+        _finish(m.plot())
+
+    def test_confusion_matrix_plot(self):
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        m = MulticlassConfusionMatrix(num_classes=3)
+        m.update(jnp.asarray(RNG.randn(64, 3)), jnp.asarray(RNG.randint(0, 3, 64)))
+        _finish(m.plot())
+        _finish(m.plot(labels=["a", "b", "c"]))
+
+    def test_curve_plot_with_score(self):
+        from torchmetrics_tpu.classification import BinaryROC
+
+        m = BinaryROC(thresholds=20)
+        m.update(jnp.asarray(RNG.rand(128)), jnp.asarray(RNG.randint(0, 2, 128)))
+        _finish(m.plot(score=True))
+
+    def test_multiclass_curve_plot(self):
+        from torchmetrics_tpu.classification import MulticlassROC
+
+        m = MulticlassROC(num_classes=3, thresholds=20)
+        m.update(jnp.asarray(RNG.randn(128, 3)), jnp.asarray(RNG.randint(0, 3, 128)))
+        _finish(m.plot())
+
+    def test_regression_and_aggregation(self):
+        from torchmetrics_tpu.aggregation import MeanMetric
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mse = MeanSquaredError()
+        mse.update(jnp.asarray(RNG.randn(32)), jnp.asarray(RNG.randn(32)))
+        _finish(mse.plot())
+        agg = MeanMetric()
+        agg.update(jnp.asarray(1.5))
+        _finish(agg.plot())
+
+    def test_collection_plot(self):
+        from torchmetrics_tpu import MetricCollection
+        from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+
+        mc = MetricCollection([MulticlassAccuracy(3), MulticlassPrecision(3)])
+        mc.update(jnp.asarray(RNG.randn(64, 3)), jnp.asarray(RNG.randint(0, 3, 64)))
+        out = mc.plot()
+        assert len(out) == len(mc)
+        for fig_ax in out:
+            _finish(fig_ax)
+
+    def test_tracker_plot(self):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+        from torchmetrics_tpu.wrappers import MetricTracker
+
+        tracker = MetricTracker(BinaryAccuracy())
+        for _ in range(3):
+            tracker.increment()
+            tracker.update(jnp.asarray(RNG.rand(32)), jnp.asarray(RNG.randint(0, 2, 32)))
+        _finish(tracker.plot())
+
+    def test_plot_value_passthrough(self):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        m = BinaryAccuracy()
+        _finish(m.plot(val=jnp.asarray(0.75)))

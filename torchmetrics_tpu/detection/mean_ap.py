@@ -142,7 +142,7 @@ class MeanAveragePrecision(Metric):
         super().__init__(**kwargs)
         allowed_box_formats = ("xyxy", "xywh", "cxcywh")
         if box_format not in allowed_box_formats:
-            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+            raise ValueError(f"Argument `box_format` must be one of {allowed_box_formats}, but got {box_format}")
         self.box_format = box_format
         self.iou_types = _validate_iou_types(iou_type)
         self.iou_type = iou_type
@@ -156,11 +156,11 @@ class MeanAveragePrecision(Metric):
             raise ValueError("Expected argument `extended_summary` to be a boolean")
         self.extended_summary = extended_summary
         if average not in ("macro", "micro"):
-            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+            raise ValueError(f"Argument `average` must be 'macro' or 'micro', but got {average}")
         self.average = average
         if backend not in ("pycocotools", "faster_coco_eval"):
             raise ValueError(
-                f"Expected argument `backend` to be one of ('pycocotools', 'faster_coco_eval') but got {backend}"
+                f"Argument `backend` must be 'pycocotools' or 'faster_coco_eval', but got {backend}"
             )
         self.backend = backend  # accepted for API parity; evaluation is the built-in XLA matcher
         self.add_state("detections", [], dist_reduce_fx=None)
@@ -179,6 +179,17 @@ class MeanAveragePrecision(Metric):
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
         _input_validator(preds, target, iou_type=self.iou_types)
+        # validate optional COCO fields BEFORE any state append: a mid-loop failure must not
+        # leave the list states partially mutated/misaligned
+        for item in target:
+            n_labels = jnp.shape(jnp.asarray(item["labels"]).reshape(-1))[0]
+            for key in ("iscrowd", "area"):
+                val = item.get(key)
+                if val is not None and jnp.shape(jnp.asarray(val).reshape(-1))[0] != n_labels:
+                    raise ValueError(
+                        f"Input '{key}' and labels of a sample in targets have different"
+                        f" lengths ({jnp.shape(jnp.asarray(val).reshape(-1))[0]} vs {n_labels})"
+                    )
         for item in preds:
             if "bbox" in self.iou_types:
                 self._state.lists["detections"].append(self._get_safe_item_values(item["boxes"]))
@@ -199,15 +210,11 @@ class MeanAveragePrecision(Metric):
                 ("area", jnp.float32, "groundtruth_area"),
             ):
                 val = item.get(key)
-                if val is None:
-                    val = jnp.zeros(labels.shape, default_dtype)
-                else:
-                    val = jnp.asarray(val).reshape(-1)
-                    if val.shape[0] != labels.shape[0]:
-                        raise ValueError(
-                            f"Input '{key}' and labels of a sample in targets have different"
-                            f" lengths ({val.shape[0]} vs {labels.shape[0]})"
-                        )
+                val = (
+                    jnp.zeros(labels.shape, default_dtype)
+                    if val is None
+                    else jnp.asarray(val).reshape(-1)  # lengths validated up front
+                )
                 self._state.lists[state_name].append(val)
         self._update_count += 1
         self._update_called = True
@@ -447,7 +454,9 @@ class MeanAveragePrecision(Metric):
             if iod_np is not None and crowd_mask.any():
                 thr = np.asarray(self.iou_thresholds)  # (T,)
                 best_crowd_iod = np.where(crowd_mask[:, None, :], iod_np, 0.0).max(axis=-1)  # (P, D)
-                crowd_absorb = best_crowd_iod[:, None, :] > thr[None, :, None]  # (P, T, D)
+                # pycocotools compares against min(t, 1-1e-10), i.e. iod >= t matches; the
+                # regular matcher keeps the legacy impl's strict > (its declared parity spec)
+                crowd_absorb = best_crowd_iod[:, None, :] > thr[None, :, None] - 1e-10  # (P, T, D)
             else:
                 crowd_absorb = np.zeros((det_valid.shape[0], num_t, det_valid.shape[1]), bool)
             # unmatched detections outside the area range OR absorbed by a crowd are ignored

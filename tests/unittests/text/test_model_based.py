@@ -106,7 +106,7 @@ class TestInfoLM:
     @pytest.mark.parametrize("measure,alpha,beta", KL_MEASURES)
     def test_identical_is_zero(self, measure, alpha, beta):
         res = infolm(
-            ["the cat sat"], ["the cat sat"], masked_lm=fake_masked_lm,
+            ["the cat sat"], ["the cat sat"], masked_lm=fake_masked_lm, idf=False,
             information_measure=measure, alpha=alpha, beta=beta,
         )
         # fisher_rao's arccos near 1 amplifies f32 rounding by sqrt(eps)
@@ -115,7 +115,7 @@ class TestInfoLM:
     @pytest.mark.parametrize("measure,alpha,beta", KL_MEASURES)
     def test_different_is_positive(self, measure, alpha, beta):
         res = infolm(
-            ["aa bb cc"], ["dd ee ff"], masked_lm=fake_masked_lm,
+            ["aa bb cc"], ["dd ee ff"], masked_lm=fake_masked_lm, idf=False,
             information_measure=measure, alpha=alpha, beta=beta,
         )
         assert float(res) > 1e-4
@@ -129,23 +129,51 @@ class TestInfoLM:
 
     def test_sentence_level(self):
         corpus, sent = infolm(
-            ["a b", "c d"], ["a b", "x y"], masked_lm=fake_masked_lm, return_sentence_level_score=True
+            ["a b", "c d"], ["a b", "x y"], masked_lm=fake_masked_lm, idf=False, return_sentence_level_score=True
         )
         assert sent.shape == (2,)
         assert float(sent[0]) < float(sent[1])
 
     def test_validation(self):
         with pytest.raises(ValueError, match="information_measure"):
-            infolm(["a"], ["a"], masked_lm=fake_masked_lm, information_measure="bogus")
+            infolm(["a"], ["a"], masked_lm=fake_masked_lm, idf=False, information_measure="bogus")
         with pytest.raises(ValueError, match="alpha"):
-            InfoLM(masked_lm=fake_masked_lm, information_measure="alpha_divergence")
+            InfoLM(masked_lm=fake_masked_lm, idf=False, information_measure="alpha_divergence")
         with pytest.raises(ModuleNotFoundError, match="masked_lm"):
             InfoLM()
 
     def test_module(self):
-        m = InfoLM(masked_lm=fake_masked_lm)
+        m = InfoLM(masked_lm=fake_masked_lm, idf=False)
         m.update(["a b"], ["a b"])
         np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-4)
+
+    def test_temperature_validation(self):
+        with pytest.raises(ValueError, match="temperature"):
+            infolm(["a"], ["a"], masked_lm=fake_masked_lm, idf=False, temperature=0.0)
+
+    def test_idf_weights_the_bag(self):
+        # with idf, the repeated token ("the") is downweighted relative to the rare ones,
+        # so the bag — and the divergence — must differ from the unweighted case
+        def tok(sentences):
+            rows = [[hash(w) % 97 + 1 for w in s.split()] for s in sentences]
+            width = max(len(r) for r in rows)
+            ids = np.zeros((len(rows), width), np.int64)
+            mask = np.zeros((len(rows), width), np.int64)
+            for i, r in enumerate(rows):
+                ids[i, : len(r)] = r
+                mask[i, : len(r)] = 1
+            return ids, mask
+
+        preds = ["the the rare", "the other words"]
+        target = ["the the tokens", "the more things"]
+        plain = float(infolm(preds, target, masked_lm=fake_masked_lm, idf=False))
+        weighted = float(infolm(preds, target, masked_lm=fake_masked_lm, idf=True, tokenize=tok))
+        assert np.isfinite(weighted)
+        assert abs(plain - weighted) > 1e-6
+
+    def test_idf_needs_tokenize_with_custom_lm(self):
+        with pytest.raises(ValueError, match="tokenize"):
+            infolm(["a"], ["a"], masked_lm=fake_masked_lm, idf=True)
 
 
 class TestSentenceStoreLifecycle:
@@ -168,7 +196,7 @@ class TestSentenceStoreLifecycle:
 
     def test_infolm_bag_semantics_order_invariant(self):
         # reordered tokens form the same bag of distributions -> divergence ~ 0
-        res = infolm(["b a"], ["a b"], masked_lm=fake_masked_lm)
+        res = infolm(["b a"], ["a b"], masked_lm=fake_masked_lm, idf=False)
         np.testing.assert_allclose(float(res), 0.0, atol=1e-4)
 
     def test_bert_idf_needs_tokenize_with_custom_encoder(self):

@@ -180,15 +180,20 @@ class MeanAveragePrecision(Metric):
             )
         _input_validator(preds, target, iou_type=self.iou_types)
         # validate optional COCO fields BEFORE any state append: a mid-loop failure must not
-        # leave the list states partially mutated/misaligned
+        # leave the list states partially mutated/misaligned. Lengths are static shapes —
+        # read them without building device arrays (this is the per-step update hot path)
+        def _flat_len(v) -> int:
+            shape = getattr(v, "shape", None)
+            return int(np.prod(shape)) if shape is not None else len(v)
+
         for item in target:
-            n_labels = jnp.shape(jnp.asarray(item["labels"]).reshape(-1))[0]
+            n_labels = _flat_len(item["labels"])
             for key in ("iscrowd", "area"):
                 val = item.get(key)
-                if val is not None and jnp.shape(jnp.asarray(val).reshape(-1))[0] != n_labels:
+                if val is not None and _flat_len(val) != n_labels:
                     raise ValueError(
                         f"Input '{key}' and labels of a sample in targets have different"
-                        f" lengths ({jnp.shape(jnp.asarray(val).reshape(-1))[0]} vs {n_labels})"
+                        f" lengths ({_flat_len(val)} vs {n_labels})"
                     )
         for item in preds:
             if "bbox" in self.iou_types:

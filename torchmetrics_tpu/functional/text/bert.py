@@ -140,12 +140,18 @@ def bert_score(
     URL, so it has no effect here).
     """
     # reference-API kwargs with no effect here (batching/device/progress knobs) are accepted
-    # when falsy; truthy ones that would change results are reported, not silently ignored
+    # with any value; KNOWN reference options we do not implement are tolerated when falsy
+    # (falsy == the reference default == our behavior) and rejected when truthy; anything else
+    # is an unknown keyword — a typo must never be silently swallowed
     _inert = {"verbose", "batch_size", "num_threads", "device"}
-    unsupported = {k: v for k, v in reference_kwargs.items() if v and k not in _inert}
+    _known_unimplemented = {"all_layers", "user_forward_fn", "user_tokenizer", "own_model", "return_hash"}
+    unknown = sorted(set(reference_kwargs) - _inert - _known_unimplemented)
+    if unknown:
+        raise TypeError(f"bert_score() got unexpected keyword arguments {unknown}")
+    unsupported = sorted(k for k in _known_unimplemented if reference_kwargs.get(k))
     if unsupported:
         raise NotImplementedError(
-            f"bert_score options {sorted(unsupported)} are not supported in this build."
+            f"bert_score options {unsupported} are not supported in this build."
         )
     if isinstance(preds, str):
         preds = [preds]

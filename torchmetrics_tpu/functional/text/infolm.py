@@ -100,28 +100,54 @@ def _information_measure(
     return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * q), axis=-1), 0.0, 1.0))
 
 
-def _sentence_distribution(probs: Array, mask: Array) -> Array:
-    """Mean of per-position MLM distributions over real positions → one (V,) bag per sentence."""
+def _sentence_distribution(probs: Array, mask: Array, weights: Optional[Array] = None) -> Array:
+    """Weighted mean of per-position MLM distributions → one (V,) bag per sentence.
+
+    ``weights`` (e.g. idf) multiply the position mask (reference ``infolm.py:409-419``:
+    the per-position distribution is scaled by idf and the bag normalised by Σ idf·mask —
+    algebraically this weighted mean).
+    """
     probs = jnp.asarray(probs, jnp.float32)
-    mask = jnp.asarray(mask, jnp.float32)
-    total = jnp.sum(probs * mask[..., None], axis=1)
-    return total / jnp.clip(jnp.sum(mask, axis=1), 1.0)[..., None]
+    w = jnp.asarray(mask, jnp.float32)
+    if weights is not None:
+        w = w * jnp.asarray(weights, jnp.float32)
+    total = jnp.sum(probs * w[..., None], axis=1)
+    return total / jnp.clip(jnp.sum(w, axis=1), _EPS)[..., None]
 
 
-def _hf_masked_lm(model_name_or_path: str, max_length: int = 192) -> MaskedLM:
-    """Build the per-position MLM-distribution callable from a cached HF checkpoint."""
+def _hf_masked_lm(model_name_or_path: str, max_length: int = 192, temperature: float = 1.0):
+    """(masked_lm, tokenize) callables from a cached HF checkpoint.
+
+    Faithful pseudo-likelihood protocol (reference ``infolm.py:394-421``): position ``i``'s
+    distribution comes from a forward pass with position ``i`` replaced by ``[MASK]`` — L
+    masked copies per sentence, batched — with ``softmax(logits / temperature)``.
+    """
     try:
         import torch
         from transformers import AutoModelForMaskedLM, AutoTokenizer
 
-        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
-        model = AutoModelForMaskedLM.from_pretrained(model_name_or_path)
+        from torchmetrics_tpu.utils.pretrained import _from_pretrained
+
+        tokenizer = _from_pretrained(AutoTokenizer, model_name_or_path)
+        model = _from_pretrained(AutoModelForMaskedLM, model_name_or_path)
         model.eval()
     except Exception as err:
         raise ModuleNotFoundError(
             f"Loading checkpoint {model_name_or_path!r} failed (no local cache and no network egress"
             " in this build). Pass a `masked_lm` callable `(sentences) -> (probs, mask)` instead."
         ) from err
+
+    mask_id = tokenizer.mask_token_id
+
+    def tokenize(sentences: List[str]):
+        import numpy as _np
+
+        batch = tokenizer(
+            sentences, return_tensors="np", padding=True, truncation=True, max_length=max_length,
+            return_special_tokens_mask=True,
+        )
+        mask = batch["attention_mask"] * (1 - batch["special_tokens_mask"])
+        return _np.asarray(batch["input_ids"], _np.int64), _np.asarray(mask)
 
     def masked_lm(sentences: List[str]) -> Tuple[Array, Array]:
         with torch.no_grad():
@@ -130,26 +156,66 @@ def _hf_masked_lm(model_name_or_path: str, max_length: int = 192) -> MaskedLM:
                 return_special_tokens_mask=True,
             )
             special = batch.pop("special_tokens_mask")
-            logits = model(**batch).logits
-            probs = torch.softmax(logits, dim=-1)
-        mask = batch["attention_mask"] * (1 - special)
+            ids = batch["input_ids"]
+            attn = batch["attention_mask"]
+            b, length = ids.shape
+            rows = []
+            for pos in range(length):
+                masked_ids = ids.clone()
+                masked_ids[:, pos] = mask_id
+                logits = model(masked_ids, attn).logits[:, pos, :]
+                rows.append(torch.softmax(logits / temperature, dim=-1))
+            probs = torch.stack(rows, dim=1)  # (B, L, V)
+        mask = attn * (1 - special)
         return jnp.asarray(probs.numpy()), jnp.asarray(mask.numpy())
 
-    return masked_lm
+    return masked_lm, tokenize
+
+
+def _corpus_idf_weights(sentences: List[str], tokenize, width: int):
+    """Per-position idf weights over a corpus's OWN sentences (reference
+    ``TokenizedDataset`` computes idf per dataset, ``helper_embedding_metric.py:267-287``)."""
+    from torchmetrics_tpu.functional.text.bert import _idf_weights, _tokens_idf
+
+    ids, mask = tokenize(list(sentences))
+    table = _tokens_idf(ids, mask)
+    w = jnp.asarray(_idf_weights(ids, table))
+    if w.shape[1] < width:
+        w = jnp.pad(w, ((0, 0), (0, width - w.shape[1])))
+    return w[:, :width]
 
 
 def infolm(
     preds: Union[str, List[str]],
     target: Union[str, List[str]],
-    model_name_or_path: Optional[str] = None,
-    masked_lm: Optional[MaskedLM] = None,
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
     information_measure: str = "kl_divergence",
+    idf: bool = True,
     alpha: Optional[float] = None,
     beta: Optional[float] = None,
+    masked_lm: Optional[MaskedLM] = None,
+    tokenize=None,
+    max_length: int = 192,
     return_sentence_level_score: bool = False,
+    **reference_kwargs,
 ):
-    """InfoLM (reference ``infolm.py:41``): information measure between MLM distributions."""
+    """InfoLM (reference ``infolm.py:545``): information measure between MLM bag distributions.
+
+    Reference defaults throughout: ``bert-base-uncased``, ``temperature=0.25``, ``idf=True``.
+    A custom ``masked_lm`` callable replaces the HF model; with ``idf=True`` it must come with
+    a ``tokenize`` callable (token ids drive the document frequencies). ``device``/
+    ``batch_size``/``num_threads``/``verbose`` are accepted and inert (host execution model).
+    """
     _validate_measure(information_measure, alpha, beta)
+    if not (isinstance(temperature, (int, float)) and temperature > 0):
+        raise ValueError(f"Argument `temperature` must be a positive number, but got {temperature}")
+    # inert reference kwargs (host execution model) are accepted with any value; anything
+    # else is rejected outright — a misspelled option must never be silently swallowed
+    _inert = {"device", "batch_size", "num_threads", "verbose"}
+    unknown = sorted(set(reference_kwargs) - _inert)
+    if unknown:
+        raise TypeError(f"infolm() got unexpected keyword arguments {unknown}")
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
@@ -157,16 +223,18 @@ def infolm(
     if len(preds) != len(target):
         raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
     if masked_lm is None:
-        if model_name_or_path is None:
-            raise ModuleNotFoundError(
-                "infolm needs a model: pass `masked_lm` as a callable `(sentences) -> (probs, mask)`"
-                " or a locally cached HuggingFace `model_name_or_path`."
-            )
-        masked_lm = _hf_masked_lm(model_name_or_path)
+        masked_lm, tokenize = _hf_masked_lm(model_name_or_path, max_length=max_length, temperature=temperature)
+    if idf and tokenize is None:
+        raise ValueError(
+            "`idf=True` needs token ids: pass `tokenize` alongside a custom `masked_lm`, or use a"
+            " HuggingFace `model_name_or_path` so the tokenizer is resolved automatically."
+        )
     p_probs, p_mask = masked_lm(list(preds))
     t_probs, t_mask = masked_lm(list(target))
-    p_bag = _sentence_distribution(p_probs, p_mask)
-    t_bag = _sentence_distribution(t_probs, t_mask)
+    p_w = _corpus_idf_weights(preds, tokenize, p_mask.shape[1]) if idf else None
+    t_w = _corpus_idf_weights(target, tokenize, t_mask.shape[1]) if idf else None
+    p_bag = _sentence_distribution(p_probs, p_mask, p_w)
+    t_bag = _sentence_distribution(t_probs, t_mask, t_w)
     sentence = _information_measure(p_bag, t_bag, information_measure, alpha, beta)
     corpus = jnp.mean(sentence)
     if return_sentence_level_score:

@@ -655,18 +655,23 @@ class BERTScore(_SentenceStoreTextMetric):
 
 
 class InfoLM(_SentenceStoreTextMetric):
-    """InfoLM (reference ``text/infolm.py:41``): pluggable masked-LM design."""
+    """InfoLM (reference ``text/infolm.py:40``): pluggable masked-LM design with the
+    reference's defaults (``bert-base-uncased``, ``temperature=0.25``, ``idf=True``)."""
 
     higher_is_better = False
     plot_lower_bound = 0.0
 
     def __init__(
         self,
-        model_name_or_path: Optional[str] = None,
-        masked_lm=None,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
         information_measure: str = "kl_divergence",
+        idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        masked_lm=None,
+        tokenize=None,
+        max_length: int = 192,
         return_sentence_level_score: bool = False,
         **kwargs: Any,
     ) -> None:
@@ -674,14 +679,18 @@ class InfoLM(_SentenceStoreTextMetric):
         from torchmetrics_tpu.functional.text.infolm import _hf_masked_lm, _validate_measure
 
         _validate_measure(information_measure, alpha, beta)
+        if not (isinstance(temperature, (int, float)) and temperature > 0):
+            raise ValueError(f"Argument `temperature` must be a positive number, but got {temperature}")
         if masked_lm is None:
-            if model_name_or_path is None:
-                raise ModuleNotFoundError(
-                    "InfoLM needs a model: pass `masked_lm` as a callable `(sentences) ->"
-                    " (probs, mask)` or a locally cached HuggingFace `model_name_or_path`."
-                )
-            masked_lm = _hf_masked_lm(model_name_or_path)
+            masked_lm, tokenize = _hf_masked_lm(model_name_or_path, max_length=max_length, temperature=temperature)
+        if idf and tokenize is None:
+            raise ValueError(
+                "`idf=True` needs token ids: pass `tokenize` alongside a custom `masked_lm`, or use"
+                " a HuggingFace `model_name_or_path` so the tokenizer is resolved automatically."
+            )
         self.masked_lm = masked_lm
+        self.tokenize = tokenize
+        self.idf = idf
         self.information_measure = information_measure
         self.alpha = alpha
         self.beta = beta
@@ -691,7 +700,7 @@ class InfoLM(_SentenceStoreTextMetric):
         from torchmetrics_tpu.functional.text.infolm import infolm
 
         return infolm(
-            preds, target, masked_lm=self.masked_lm,
+            preds, target, masked_lm=self.masked_lm, tokenize=self.tokenize, idf=self.idf,
             information_measure=self.information_measure, alpha=self.alpha, beta=self.beta,
             return_sentence_level_score=self.return_sentence_level_score,
         )

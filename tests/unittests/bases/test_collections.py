@@ -202,3 +202,59 @@ def test_forward_returns_batch_values():
     assert float(out2["BinaryAccuracy"]) == pytest.approx(0.0)
     # accumulated over both batches: 3 correct of 6
     assert float(mc.compute()["BinaryAccuracy"]) == pytest.approx(0.5)
+
+
+def test_sweep_fn_matches_update_batches():
+    """sweep_fn (pure one-launch sweep) == update_batches + compute, and composes under vmap."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    rng = np.random.RandomState(5)
+    p = jnp.asarray(rng.randint(0, 5, (6, 64)).astype(np.int32))
+    t = jnp.asarray(rng.randint(0, 5, (6, 64)).astype(np.int32))
+    mc = MetricCollection(
+        [MulticlassAccuracy(num_classes=5, validate_args=False),
+         MulticlassF1Score(num_classes=5, average="macro", validate_args=False)]
+    )
+    with pytest.raises(TorchMetricsUserError, match="formed compute groups"):
+        mc.sweep_fn()
+    mc(p[0], t[0])
+    mc.reset()
+    fn = mc.sweep_fn()
+    vals = jax.jit(fn)(p, t)
+    mc.update_batches(p, t)
+    ref = mc.compute()
+    assert set(vals) == set(ref)
+    for k in ref:
+        assert float(vals[k]) == pytest.approx(float(ref[k]), abs=1e-6)
+    # persistent state untouched by the pure call
+    mc.reset()
+    _ = jax.jit(fn)(p, t)
+    assert mc._modules[next(iter(mc._modules))]._update_count == 0
+    # vmap composition: 3 independent sweeps at once
+    ys = jax.vmap(fn)(jnp.stack([p, p, p]), jnp.stack([t, t, t]))
+    for k in ref:
+        assert np.allclose(np.asarray(ys[k]), float(ref[k]), atol=1e-6)
+
+
+def test_sweep_fn_groups_disabled_and_flattened_keys():
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.RandomState(9)
+    p = jnp.asarray(rng.randint(0, 5, (4, 32)).astype(np.int32))
+    t = jnp.asarray(rng.randint(0, 5, (4, 32)).astype(np.int32))
+    mc = MetricCollection([MulticlassAccuracy(num_classes=5, validate_args=False)],
+                          compute_groups=False, prefix="val_")
+    fn = mc.sweep_fn()  # no prior update needed when groups are disabled
+    vals = jax.jit(fn)(p, t)
+    mc.update_batches(p, t)
+    ref = mc.compute()
+    assert set(vals) == set(ref) == {"val_MulticlassAccuracy"}
+    assert float(vals["val_MulticlassAccuracy"]) == pytest.approx(
+        float(ref["val_MulticlassAccuracy"]), abs=1e-6)

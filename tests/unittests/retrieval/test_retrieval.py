@@ -211,3 +211,78 @@ def test_retrieval_ignore_index():
         indexes[keep], preds[keep], target[keep], lambda p, t: average_precision_score(t, p)
     )
     np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls", [
+    RetrievalMAP, RetrievalMRR, RetrievalPrecision, RetrievalRecall,
+    RetrievalFallOut, RetrievalHitRate, RetrievalRPrecision, RetrievalNormalizedDCG,
+])
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_flat_engine_matches_rectangle_path(cls, action):
+    """The flat segment-reduce compute (one launch, no host round-trips) must agree with the
+    padded-rectangle vmapped path on identical state — including empty queries, ignore_index
+    holes, and every empty_target_action."""
+    r = np.random.RandomState(77)
+    n, n_queries = 600, 25
+    graded = cls is RetrievalNormalizedDCG
+    preds = r.rand(n).astype(np.float32)
+    target = r.randint(0, 4 if graded else 2, n)
+    target[r.rand(n) < 0.15] = -1  # ignore_index holes
+    indexes = np.sort(r.randint(0, n_queries, n))
+    target[indexes == 3] = 0   # a query with no positives
+    target[indexes == 7] = -1  # a fully-ignored query
+
+    kwargs = dict(empty_target_action=action, ignore_index=-1)
+    m_flat = cls(**kwargs) if cls is RetrievalRPrecision else cls(top_k=3, **kwargs)
+    m_rect = cls(**kwargs) if cls is RetrievalRPrecision else cls(top_k=3, **kwargs)
+    for m in (m_flat, m_rect):
+        m.update(preds, target, indexes=indexes)
+    flat_val = float(m_flat.compute())
+    # force the rectangle path by dropping the subclass flat hook
+    arrays = m_rect._state_arrays(m_rect._computable_state())
+    empty_from = "neg" if cls is RetrievalFallOut else "pos"
+    rect_val = float(m_rect._grouped_aggregate(*arrays, empty_from, "no target"))
+    assert flat_val == pytest.approx(rect_val, abs=1e-6), (cls.__name__, action)
+
+
+def test_flat_engine_error_action_raises():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(np.array([0.3, 0.2], np.float32), np.array([0, 0]), indexes=np.array([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_flat_engine_median_aggregation():
+    r = np.random.RandomState(3)
+    n, q = 300, 11
+    preds, target = r.rand(n).astype(np.float32), r.randint(0, 2, n)
+    indexes = np.sort(r.randint(0, q, n))
+    m_med = RetrievalMAP(aggregation="median")
+    m_med.update(preds, target, indexes=indexes)
+    # independent host reference: per-query AP then median
+    vals = []
+    for qi in np.unique(indexes):
+        sel = indexes == qi
+        if target[sel].sum() == 0:
+            vals.append(0.0)
+            continue
+        from sklearn.metrics import average_precision_score
+        vals.append(average_precision_score(target[sel], preds[sel]))
+    assert float(m_med.compute()) == pytest.approx(float(np.median(vals)), abs=1e-5)
+
+
+def test_flat_engine_tie_order_matches_rectangle():
+    """Quantized (heavily tied) scores must rank identically in both engines — the flat sort
+    carries an explicit reversed-input-order tiebreak to mirror the rectangle's argsort[::-1]."""
+    r = np.random.RandomState(11)
+    for cls in (RetrievalMAP, RetrievalMRR, RetrievalPrecision, RetrievalRecall, RetrievalHitRate):
+        n, q = 80, 6
+        preds = (r.randint(0, 4, n) / 4.0).astype(np.float32)  # only 4 distinct scores
+        target = r.randint(0, 2, n)
+        indexes = np.sort(r.randint(0, q, n))
+        m = cls() if cls is RetrievalMAP else cls(top_k=3)
+        m.update(preds, target, indexes=indexes)
+        flat_val = float(m.compute())
+        arrays = m._state_arrays(m._computable_state())
+        rect_val = float(m._grouped_aggregate(*arrays, "pos", "no target"))
+        assert flat_val == pytest.approx(rect_val, abs=1e-6), cls.__name__

@@ -143,3 +143,77 @@ class TestReferenceIdfParity:
             if tok == 0:
                 continue  # padding id: masked out on our side
             assert math.isclose(ours.get(tok, ours["__default__"]), val, rel_tol=1e-9), tok
+
+
+def _tiny_torch_helpers():
+    """(TinyTok, TinyModel) over the fake vocab — shared by the own_model/user hook tests."""
+    torch = pytest.importorskip("torch")
+
+    class TinyTok:
+        def __call__(self, sentences, **kw):
+            ids, mask = fake_tokenize(sentences)
+            # emulate CLS/SEP framing the special-token stripper removes
+            ids = np.pad(ids + 2, ((0, 0), (1, 1)))
+            mask = np.pad(mask, ((0, 0), (1, 1)), constant_values=1)
+            return {"input_ids": torch.as_tensor(ids), "attention_mask": torch.as_tensor(mask)}
+
+    class TinyModel(torch.nn.Module):
+        def forward(self, input_ids, attention_mask, output_hidden_states=False):
+            table = torch.manual_seed(0) and torch.randn(512, D)
+            h = table[input_ids % 512]
+            return type("O", (), {"hidden_states": [h, h * 0.5]})()
+
+    return TinyTok, TinyModel
+
+
+class TestBertScoreOptions:
+    """return_hash / all_layers / own_model hooks (reference ``bert.py:95-115,170-172,389-390``)."""
+
+    def test_return_hash(self):
+        out = bert_score(["a b"], ["a c"], encoder=fake_encoder, return_hash=True)
+        assert out["hash"] == "None_LNone_no-idf"
+        out2 = bert_score(
+            ["a b"], ["a c"], encoder=fake_encoder, tokenize=fake_tokenize,
+            num_layers=7, idf=True, return_hash=True,
+        )
+        assert out2["hash"] == "None_L7_idf"
+
+    def test_all_layers_rejected_with_custom_encoder(self):
+        with pytest.raises(ValueError, match="only with default `transformers` models"):
+            bert_score(["a"], ["a"], encoder=fake_encoder, all_layers=True)
+
+    def test_own_model_torch_path(self):
+        TinyTok, TinyModel = _tiny_torch_helpers()
+        out = bert_score(["x y z"], ["x y w"], own_model=TinyModel(), user_tokenizer=TinyTok())
+        assert 0.0 <= float(out["f1"][0]) <= 1.0
+
+        with pytest.raises(ValueError, match="requires `user_tokenizer`"):
+            bert_score(["a"], ["a"], own_model=TinyModel())
+
+    def test_user_forward_fn(self):
+        torch = pytest.importorskip("torch")
+        TinyTok, _ = _tiny_torch_helpers()
+
+        def fwd(model, batch):
+            table = torch.manual_seed(1) and torch.randn(512, D)
+            return table[batch["input_ids"] % 512]
+
+        out = bert_score(["x y"], ["x q"], own_model=object(), user_tokenizer=TinyTok(), user_forward_fn=fwd)
+        assert set(out) == {"precision", "recall", "f1"}
+
+    def test_all_layers_with_own_model(self, tmp_path):
+        TinyTok, TinyModel = _tiny_torch_helpers()
+        out = bert_score(["x y z", "q"], ["x y w", "q"], own_model=TinyModel(),
+                         user_tokenizer=TinyTok(), all_layers=True)
+        assert out["f1"].shape == (2, 2)  # (layers, sentences)
+
+        # per-layer baseline rescale
+        bl = tmp_path / "baseline.csv"
+        bl.write_text("LAYER,P,R,F\n0,0.1,0.1,0.1\n1,0.2,0.2,0.2\n")
+        out_rs = bert_score(["x y z", "q"], ["x y w", "q"], own_model=TinyModel(),
+                            user_tokenizer=TinyTok(), all_layers=True,
+                            rescale_with_baseline=True, baseline_path=str(bl))
+        expect0 = (np.asarray(out["f1"])[0] - 0.1) / 0.9
+        expect1 = (np.asarray(out["f1"])[1] - 0.2) / 0.8
+        assert np.allclose(np.asarray(out_rs["f1"])[0], expect0, atol=1e-6)
+        assert np.allclose(np.asarray(out_rs["f1"])[1], expect1, atol=1e-6)

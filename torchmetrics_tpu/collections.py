@@ -88,7 +88,7 @@ class MetricCollection:
     def _forward_groups(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-group fused forward; per-metric fallback for non-fusable groups."""
         import jax
-        import jax.numpy as jnp
+        import numpy as np
 
         result: Dict[str, Any] = {}
         for cg in self._groups.values():
@@ -121,7 +121,10 @@ class MetricCollection:
                 leader._validate(*coerced_args, **coerced_kwargs)
             n = leader._update_count + 1
             vals, merged = fn(
-                dict(leader._state.tensors), jnp.asarray(n, jnp.float32), *coerced_args, **coerced_kwargs
+                # np scalar, NOT jnp: jnp.asarray eagerly dispatches a device op per step (a
+                # whole extra launch on high-latency links); numpy args are abstracted by
+                # dtype/shape under jit so this neither launches nor retraces
+                dict(leader._state.tensors), np.float32(n), *coerced_args, **coerced_kwargs
             )
             leader._state.tensors.update(merged)
             for _, m in members:
@@ -183,6 +186,67 @@ class MetricCollection:
 
     def compute(self) -> Dict[str, Any]:
         return self._compute_and_reduce("compute")
+
+    def sweep_fn(self) -> Any:
+        """A PURE jittable ``(*stacked_args, **stacked_kwargs) -> {name: value}`` closure.
+
+        One traced program folds a whole stack of batches (leading axis = n_batches) into
+        FRESH default states — one ``lax.scan`` per compute group — then runs every member's
+        compute on the final state. Persistent collection state is never touched. This is the
+        TPU-idiomatic full-eval path: compose it under ``jax.jit`` / ``vmap`` / ``shard_map``
+        / ``lax.scan`` freely; the per-batch ``forward`` loop pays one dispatch (and its
+        host↔device latency) per step, this pays one for the whole sweep.
+
+        Requires formed compute groups (run one ``update``/``forward`` first) and scan-fusable
+        members (tensor states only).
+        """
+        import jax
+
+        from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+        if self._enable_compute_groups and not self._groups_checked:
+            raise TorchMetricsUserError(
+                "sweep_fn requires formed compute groups — run one `update`/`forward` first."
+            )
+        if self._enable_compute_groups:
+            member_lists = [[name for name in cg] for cg in self._groups.values()]
+        else:  # groups disabled: every metric scans the stack itself
+            member_lists = [[name] for name in self._modules]
+        groups = []
+        for cg in member_lists:
+            members = [(name, self._modules[name]) for name in cg]
+            leader = members[0][1]
+            fusable = (
+                not leader._state.lists
+                and leader.scan_update
+                and leader.jit_update  # host-side update (e.g. encoder callbacks) cannot scan
+                and all(m.jit_compute for _, m in members)  # host-side compute cannot trace
+            )
+            if not fusable:
+                raise TorchMetricsUserError(
+                    f"sweep_fn: metric {cg[0]!r} is not scan-fusable (list states or host-side"
+                    " update/compute)."
+                )
+            groups.append((leader, members))
+
+        def run(*args: Any, **kwargs: Any) -> Dict[str, Any]:
+            result: Dict[str, Any] = {}
+            for leader, members in groups:
+                defaults = {k: leader._defaults[k] for k in leader._state.tensors}
+                f_kwargs = leader._filter_kwargs(**kwargs)
+
+                def body(st, batch, _leader=leader):
+                    b_args, b_kw = batch
+                    out = _leader._update(st, *b_args, **b_kw)
+                    return {k: out.get(k, st[k]) for k in st}, None
+
+                final, _ = jax.lax.scan(body, defaults, (args, f_kwargs))
+                for name, m in members:
+                    result[name] = m._squeeze_if_scalar(m._compute(final))
+            # same key shape as compute(): flatten dict-valued results, apply prefix/postfix
+            return self._finalize_result(result)
+
+        return run
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Run ``compute``/``forward`` per metric and flatten dict-valued results (reference ``collections.py:314``)."""

@@ -140,26 +140,46 @@ def bert_score(
     URL, so it has no effect here).
     """
     # reference-API kwargs with no effect here (batching/device/progress knobs) are accepted
-    # with any value; KNOWN reference options we do not implement are tolerated when falsy
-    # (falsy == the reference default == our behavior) and rejected when truthy; anything else
-    # is an unknown keyword — a typo must never be silently swallowed
+    # with any value; anything unknown is a typo and must never be silently swallowed
     _inert = {"verbose", "batch_size", "num_threads", "device"}
-    _known_unimplemented = {"all_layers", "user_forward_fn", "user_tokenizer", "own_model", "return_hash"}
-    unknown = sorted(set(reference_kwargs) - _inert - _known_unimplemented)
+    _supported = {"all_layers", "user_forward_fn", "user_tokenizer", "own_model", "return_hash"}
+    unknown = sorted(set(reference_kwargs) - _inert - _supported)
     if unknown:
         raise TypeError(f"bert_score() got unexpected keyword arguments {unknown}")
-    unsupported = sorted(k for k in _known_unimplemented if reference_kwargs.get(k))
-    if unsupported:
-        raise NotImplementedError(
-            f"bert_score options {unsupported} are not supported in this build."
-        )
+    all_layers = bool(reference_kwargs.get("all_layers", False))
+    return_hash = bool(reference_kwargs.get("return_hash", False))
+    own_model = reference_kwargs.get("own_model")
+    user_tokenizer = reference_kwargs.get("user_tokenizer")
+    user_forward_fn = reference_kwargs.get("user_forward_fn")
+    if all_layers and (encoder is not None or user_forward_fn is not None):
+        # reference functional/text/bert.py:108-110
+        raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
         target = [target]
     if len(preds) != len(target):
         raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
-    if encoder is None:
+    if encoder is None and (own_model is not None or user_tokenizer is not None or user_forward_fn is not None):
+        # reference own_model/user_tokenizer/user_forward_fn path (bert.py:95-115): any of the
+        # three hooks may be combined with an HF-resolved model/tokenizer for the others
+        from torchmetrics_tpu.utils.pretrained import hf_bert_model_and_tokenizer, torch_bert_encoder
+
+        model, tok = own_model, user_tokenizer
+        if model is None or tok is None:  # resolve ONLY the missing pieces from the checkpoint id
+            if own_model is not None and model_name_or_path is None:
+                raise ValueError("`own_model` requires `user_tokenizer` (no checkpoint id to resolve one from).")
+            hf_model, hf_tok = hf_bert_model_and_tokenizer(
+                model_name_or_path or _DEFAULT_MODEL,
+                load_model=model is None, load_tokenizer=tok is None,
+            )
+            model = model if model is not None else hf_model
+            tok = tok if tok is not None else hf_tok
+        encoder, tokenize = torch_bert_encoder(
+            model, tok, forward_fn=user_forward_fn, num_layers=num_layers,
+            max_length=max_length, all_layers=all_layers,
+        )
+    elif encoder is None:
         if model_name_or_path is None:
             rank_zero_warn(
                 "The argument `model_name_or_path` was not specified while it is required when the default"
@@ -169,7 +189,9 @@ def bert_score(
             model_name_or_path = _DEFAULT_MODEL
         from torchmetrics_tpu.utils.pretrained import bert_encoder as _build
 
-        encoder, tokenize = _build(model_name_or_path, num_layers=num_layers, max_length=max_length)
+        encoder, tokenize = _build(
+            model_name_or_path, num_layers=num_layers, max_length=max_length, all_layers=all_layers
+        )
 
     p_weights = t_weights = None
     if idf:
@@ -186,13 +208,21 @@ def bert_score(
 
     p_emb, p_mask = encoder(list(preds))
     t_emb, t_mask = encoder(list(target))
-    # pad to a common sequence length so the cosine matrix is rectangular
-    lp, lt = p_emb.shape[1], t_emb.shape[1]
+    # pad to a common sequence length so the cosine matrix is rectangular; with all_layers the
+    # embeddings carry a layer axis at dim 1: (N, Λ, L, D)
+    seq_ax = 2 if p_emb.ndim == 4 else 1
+    lp, lt = p_emb.shape[seq_ax], t_emb.shape[seq_ax]
     if lp != lt:
         pad = max(lp, lt)
-        p_emb = jnp.pad(p_emb, ((0, 0), (0, pad - lp), (0, 0)))
+
+        def _pad_emb(e, n):
+            widths = [(0, 0)] * e.ndim
+            widths[seq_ax] = (0, n)
+            return jnp.pad(e, widths)
+
+        p_emb = _pad_emb(p_emb, pad - lp)
         p_mask = jnp.pad(p_mask, ((0, 0), (0, pad - lp)))
-        t_emb = jnp.pad(t_emb, ((0, 0), (0, pad - lt), (0, 0)))
+        t_emb = _pad_emb(t_emb, pad - lt)
         t_mask = jnp.pad(t_mask, ((0, 0), (0, pad - lt)))
     if p_weights is not None:
         # tokenize() and encoder() pad independently; align the idf grids to the embedding grid
@@ -205,17 +235,32 @@ def bert_score(
         p_weights = _fit(p_weights, p_mask.shape[1])
         t_weights = _fit(t_weights, t_mask.shape[1])
 
-    out = _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask, p_weights, t_weights)
+    if p_emb.ndim == 4:  # all_layers: vmap the matcher over the layer axis -> (Λ, N) scores
+        import jax
+
+        out = jax.vmap(
+            lambda pe, te: _bert_score_from_embeddings(pe, p_mask, te, t_mask, p_weights, t_weights),
+            in_axes=1,
+        )(p_emb, t_emb)
+    else:
+        out = _bert_score_from_embeddings(p_emb, p_mask, t_emb, t_mask, p_weights, t_weights)
 
     if rescale_with_baseline:
         if baseline_path is None:
             rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
         else:
             baseline = _load_baseline_file(baseline_path)
-            row = baseline[num_layers if num_layers is not None else -1]
+            if all_layers:  # per-layer rows, broadcast over sentences (reference bert.py:231-240)
+                row = jnp.asarray(baseline)[: out["precision"].shape[0], :, None]
+                rows = (row[:, 0], row[:, 1], row[:, 2])
+            else:
+                raw = baseline[num_layers if num_layers is not None else -1]
+                rows = (raw[0], raw[1], raw[2])
             out = {
-                "precision": (out["precision"] - row[0]) / (1 - row[0]),
-                "recall": (out["recall"] - row[1]) / (1 - row[1]),
-                "f1": (out["f1"] - row[2]) / (1 - row[2]),
+                "precision": (out["precision"] - rows[0]) / (1 - rows[0]),
+                "recall": (out["recall"] - rows[1]) / (1 - rows[1]),
+                "f1": (out["f1"] - rows[2]) / (1 - rows[2]),
             }
+    if return_hash:  # reference bert.py:389-390 / _get_hash at :170-172
+        out["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
     return out

@@ -491,7 +491,8 @@ class Metric:
             self._validate(*args, **kwargs)
         if self._fusable_forward():
             batch_val, merged = self._jitted_forward_step()(
-                dict(self._state.tensors), jnp.asarray(self._update_count + 1, jnp.float32), *args, **kwargs
+                # np scalar, NOT jnp: jnp.asarray would eagerly dispatch a device op per step
+                dict(self._state.tensors), np.float32(self._update_count + 1), *args, **kwargs
             )
             # count bumps only after the kernel call succeeded (a trace error must not skew n)
             self._update_count += 1

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
+from torchmetrics_tpu.functional.retrieval import _flat
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.checks import _check_retrieval_inputs
 from torchmetrics_tpu.utils.data import dim_zero_cat
@@ -35,11 +36,8 @@ def _next_pow2(x: int) -> int:
 def _group_stats(indexes: Array):
     """(num distinct queries, longest query length) — device-side, O(N log N)."""
     idx_s = jnp.sort(indexes)
-    n = idx_s.shape[0]
-    ar = jnp.arange(n)
-    is_new = jnp.concatenate([jnp.ones((1,), bool), idx_s[1:] != idx_s[:-1]])
-    start = lax.cummax(jnp.where(is_new, ar, 0))
-    within = ar - start
+    is_new, _gid, start = _flat.dense_groups(idx_s)
+    within = jnp.arange(idx_s.shape[0]) - start
     return jnp.sum(is_new), jnp.max(within) + 1
 
 
@@ -47,9 +45,7 @@ def _group_stats(indexes: Array):
 def _max_valid_per_query(indexes: Array, valid: Array) -> Array:
     """Longest count of VALID (non-ignored) docs in any query — device-side."""
     order = jnp.argsort(indexes, stable=True)
-    idx_s = indexes[order]
-    is_new = jnp.concatenate([jnp.ones((1,), bool), idx_s[1:] != idx_s[:-1]])
-    gid = jnp.cumsum(is_new) - 1
+    _is_new, gid, _start = _flat.dense_groups(indexes[order])
     counts = jax.ops.segment_sum(valid[order], gid, num_segments=indexes.shape[0])
     return jnp.max(counts)
 
@@ -63,12 +59,8 @@ def _build_rectangles(indexes: Array, preds: Array, target: Array, valid: Array,
     """
     order = jnp.argsort(indexes, stable=True)
     idx_s = indexes[order]
-    n = idx_s.shape[0]
-    ar = jnp.arange(n)
-    is_new = jnp.concatenate([jnp.ones((1,), bool), idx_s[1:] != idx_s[:-1]])
-    gid = jnp.cumsum(is_new) - 1
-    start = lax.cummax(jnp.where(is_new, ar, 0))
-    within = ar - start
+    _is_new, gid, start = _flat.dense_groups(idx_s)
+    within = jnp.arange(idx_s.shape[0]) - start
     flat = gid * l_max + within
 
     def scat(v: Array) -> Array:
@@ -249,6 +241,67 @@ class RetrievalMetric(Metric):
                 raise ValueError(no_target_msg)
         return result
 
+    # ------------------------------------------------------------ flat (segment-reduce) path
+    def _flat_values(self, ctx):
+        """Per-query values over the flat sorted-doc context (``functional/retrieval/_flat.py``)
+        or ``None`` to fall back to the rectangle path. Subclasses override."""
+        return None
+
+    @staticmethod
+    def _pad_flat(indexes: Array, preds: Array, target: Array, valid: Array):
+        """Pad the flat doc streams to a power of two so recompiles stay bounded. Filler docs
+        carry the maximal query id (they sort last, forming empty segments) and ``valid=0``."""
+        n = int(indexes.shape[0])
+        n_pad = _next_pow2(n)
+        if n_pad == n:
+            return indexes, preds, target, valid
+        pad = n_pad - n
+        return (
+            jnp.concatenate([indexes, jnp.full((pad,), jnp.iinfo(indexes.dtype).max, indexes.dtype)]),
+            jnp.concatenate([preds, jnp.zeros((pad,), preds.dtype)]),
+            jnp.concatenate([target, jnp.zeros((pad,), target.dtype)]),
+            jnp.concatenate([valid, jnp.zeros((pad,), valid.dtype)]),
+        )
+
+    def _flat_aggregate(
+        self, indexes: Array, preds: Array, target: Array, valid: Array,
+        empty_from: str, no_target_msg: str, cache_key: str = "flat_agg",
+    ) -> Array:
+        """Fused flat compute: sort + segment kernel + empty-action + aggregation, ONE launch.
+
+        Unlike ``_grouped_aggregate`` there is NO shape-determining host round-trip: every
+        shape is static in the (padded) doc count, so nothing blocks until the caller reads
+        the result — the whole compute pipelines behind prior work on high-latency links.
+        """
+        indexes, preds, target, valid = self._pad_flat(indexes, preds, target, valid)
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            action = self.empty_target_action
+            aggregation = self.aggregation
+            top_k = getattr(self, "top_k", None)
+
+            def run(indexes, preds, target, valid):
+                ctx = _flat.build_context(indexes, preds, target, valid, top_k)
+                values = self._flat_values(ctx)
+                n_valid_seg = ctx["n_valid_seg"]
+                pos_seg = ctx["pos_seg"]
+                has_valid = n_valid_seg > 0
+                empty = (pos_seg == 0 if empty_from == "pos" else (n_valid_seg - pos_seg) == 0) & has_valid
+                any_empty = jnp.any(empty)
+                if action == "skip":
+                    include = has_valid & ~empty
+                else:
+                    values = jnp.where(empty, 1.0 if action == "pos" else 0.0, values)
+                    include = has_valid
+                return _masked_aggregate(values, include, aggregation), any_empty
+
+            fn = jax.jit(run)
+            self._jit_cache[cache_key] = fn
+        result, any_empty = fn(indexes, preds, target, valid)
+        if self.empty_target_action == "error" and bool(any_empty):
+            raise ValueError(no_target_msg)
+        return result
+
     def _state_arrays(self, state):
         """Concatenated device arrays (indexes, preds, target, valid-mask) or None when empty."""
 
@@ -298,4 +351,6 @@ class RetrievalMetric(Metric):
             )
             values_np = self._select_values(values, pos_count == 0, valid_count > 0, msg)
             return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)
+        if type(self)._flat_values is not RetrievalMetric._flat_values:
+            return self._flat_aggregate(indexes, preds, target, valid, "pos", msg)
         return self._grouped_aggregate(indexes, preds, target, valid, "pos", msg)

@@ -18,7 +18,13 @@ from torchmetrics_tpu.functional.retrieval._kernels import (
     recall_kernel,
     reciprocal_rank_kernel,
 )
-from torchmetrics_tpu.retrieval.base import RetrievalMetric, _retrieval_aggregate
+from torchmetrics_tpu.functional.retrieval import _flat
+from torchmetrics_tpu.retrieval.base import (
+    RetrievalMetric,
+    _masked_aggregate,
+    _next_pow2,
+    _retrieval_aggregate,
+)
 
 
 def _validate_top_k(top_k: Optional[int]) -> None:
@@ -38,6 +44,9 @@ class RetrievalMAP(RetrievalMetric):
     def _metric_kernel(self, preds, target, mask):
         return average_precision_kernel(preds, target, mask, self.top_k)
 
+    def _flat_values(self, ctx):
+        return _flat.average_precision_flat(ctx)
+
 
 class RetrievalMRR(RetrievalMetric):
     """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py``)."""
@@ -50,6 +59,9 @@ class RetrievalMRR(RetrievalMetric):
 
     def _metric_kernel(self, preds, target, mask):
         return reciprocal_rank_kernel(preds, target, mask, self.top_k)
+
+    def _flat_values(self, ctx):
+        return _flat.reciprocal_rank_flat(ctx)
 
 
 class RetrievalPrecision(RetrievalMetric):
@@ -68,6 +80,9 @@ class RetrievalPrecision(RetrievalMetric):
     def _metric_kernel(self, preds, target, mask):
         return precision_kernel(preds, target, mask, self.top_k, self.adaptive_k)
 
+    def _flat_values(self, ctx):
+        return _flat.make_precision_flat(self.top_k, self.adaptive_k)(ctx)
+
 
 class RetrievalRecall(RetrievalMetric):
     """recall@k (reference ``retrieval/recall.py``)."""
@@ -80,6 +95,9 @@ class RetrievalRecall(RetrievalMetric):
 
     def _metric_kernel(self, preds, target, mask):
         return recall_kernel(preds, target, mask, self.top_k)
+
+    def _flat_values(self, ctx):
+        return _flat.recall_flat(ctx)
 
 
 class RetrievalFallOut(RetrievalMetric):
@@ -97,6 +115,9 @@ class RetrievalFallOut(RetrievalMetric):
     def _metric_kernel(self, preds, target, mask):
         return fall_out_kernel(preds, target, mask, self.top_k)
 
+    def _flat_values(self, ctx):
+        return _flat.fall_out_flat(ctx)
+
     def _compute(self, state):
         # like base, but "empty" = no negative targets (reference fall_out.py:126)
         arrays = self._state_arrays(state)
@@ -110,7 +131,7 @@ class RetrievalFallOut(RetrievalMetric):
             )
             values_np = self._select_values(values, neg_count == 0, valid_count > 0, msg)
             return _retrieval_aggregate(jnp.asarray(values_np), self.aggregation)
-        return self._grouped_aggregate(indexes, preds, target, valid, "neg", msg)
+        return self._flat_aggregate(indexes, preds, target, valid, "neg", msg)
 
 
 class RetrievalHitRate(RetrievalMetric):
@@ -125,12 +146,18 @@ class RetrievalHitRate(RetrievalMetric):
     def _metric_kernel(self, preds, target, mask):
         return hit_rate_kernel(preds, target, mask, self.top_k)
 
+    def _flat_values(self, ctx):
+        return _flat.hit_rate_flat(ctx)
+
 
 class RetrievalRPrecision(RetrievalMetric):
     """R-precision (reference ``retrieval/r_precision.py``)."""
 
     def _metric_kernel(self, preds, target, mask):
         return r_precision_kernel(preds, target, mask)
+
+    def _flat_values(self, ctx):
+        return _flat.r_precision_flat(ctx)
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
@@ -146,6 +173,9 @@ class RetrievalNormalizedDCG(RetrievalMetric):
 
     def _metric_kernel(self, preds, target, mask):
         return ndcg_kernel(preds, target, mask, self.top_k)
+
+    def _flat_values(self, ctx):
+        return _flat.ndcg_flat(ctx)
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
@@ -172,29 +202,50 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         if self.max_k is not None:
             max_k = self.max_k
         else:
-            # count only non-ignored docs (the old host path filtered before grouping)
+            # count only non-ignored docs (the old host path filtered before grouping). This is
+            # the ONE host round-trip of the curve compute: max_k sizes the returned curves.
             max_k = int(jax.device_get(_max_valid_per_query(indexes, valid)))
-        precisions, recalls = [], []
-        for k in range(1, max_k + 1):
-            def kernel_p(p, t, m, k=k):
-                return precision_kernel(p, t, m, k, self.adaptive_k)
+        precisions, recalls = self._curve_flat(indexes, preds, target, valid, max_k)
+        return precisions, recalls, jnp.arange(1, max_k + 1)
 
-            def kernel_r(p, t, m, k=k):
-                return recall_kernel(p, t, m, k)
+    def _curve_flat(self, indexes, preds, target, valid, max_k: int):
+        """All k=1..max_k precision/recall means in ONE fused launch over the flat context.
 
-            precisions.append(self._curve_values(indexes, preds, target, valid, kernel_p, f"prec@{k}"))
-            recalls.append(self._curve_values(indexes, preds, target, valid, kernel_r, f"rec@{k}"))
-        return jnp.stack(precisions), jnp.stack(recalls), jnp.arange(1, max_k + 1)
+        The compiled program is sized to the next power of two above ``max_k`` (and the result
+        sliced back) so a data-dependent longest-query length growing by one between computes
+        does not recompile the whole unrolled k-sweep."""
+        requested_k = max_k
+        max_k = _next_pow2(max_k)
+        indexes, preds, target, valid = self._pad_flat(indexes, preds, target, valid)
+        cache_key = f"curve_flat@{max_k}"
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            action = self.empty_target_action
+            adaptive = self.adaptive_k
 
-    def _curve_values(self, indexes, preds, target, valid, kernel, cache_key):
-        values, pos_count, _neg, valid_count = self._grouped_values(
-            indexes, preds, target, kernel, cache_key, valid=valid
-        )
-        values_np = self._select_values(
-            values, pos_count == 0, valid_count > 0,
-            "`compute` method was provided with a query with no positive target.",
-        )
-        return jnp.mean(jnp.asarray(values_np)) if values_np.size else jnp.zeros(())
+            def run(indexes, preds, target, valid):
+                ctx = _flat.build_context(indexes, preds, target, valid, None)
+                has_valid = ctx["n_valid_seg"] > 0
+                empty = (ctx["pos_seg"] == 0) & has_valid
+                include = has_valid & ~empty if action == "skip" else has_valid
+                impute = 1.0 if action == "pos" else 0.0
+                ps, rs = [], []
+                for k in range(1, max_k + 1):
+                    pv = _flat.make_precision_flat(k, adaptive)(ctx)
+                    rv = _flat.make_recall_flat(k)(ctx)
+                    if action != "skip":
+                        pv = jnp.where(empty, impute, pv)
+                        rv = jnp.where(empty, impute, rv)
+                    ps.append(_masked_aggregate(pv, include, "mean"))
+                    rs.append(_masked_aggregate(rv, include, "mean"))
+                return jnp.stack(ps), jnp.stack(rs), jnp.any(empty)
+
+            fn = jax.jit(run)
+            self._jit_cache[cache_key] = fn
+        p, r, any_empty = fn(indexes, preds, target, valid)
+        if self.empty_target_action == "error" and bool(any_empty):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        return p[:requested_k], r[:requested_k]
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):

@@ -210,8 +210,87 @@ def clip_encoders(
 # BERT (BERTScore / InfoLM)
 # ---------------------------------------------------------------------------
 
+def torch_bert_encoder(
+    model: Any,
+    tokenizer: Any,
+    forward_fn: Optional[Callable] = None,
+    num_layers: Optional[int] = None,
+    max_length: int = 512,
+    all_layers: bool = False,
+):
+    """Encoder over a USER-SUPPLIED torch model + HF-style tokenizer (the reference's
+    ``own_model``/``user_tokenizer``/``user_forward_fn`` path, ``functional/text/bert.py:95-115``).
+
+    ``forward_fn(model, batch_dict) -> (N, L, D)`` overrides the default
+    ``model(input_ids, attention_mask, output_hidden_states=True)`` call. Special [CLS]/[SEP]
+    positions are zeroed from the mask the way the reference does
+    (``helper_embedding_metric.py:33-48``: first position, plus the last attended position).
+    """
+    import torch
+
+    def _special_free_mask(attention_mask: "torch.Tensor") -> "torch.Tensor":
+        mask = attention_mask.clone()
+        mask[:, 0] = 0
+        sep_pos = torch.cumsum(mask - 0.1, dim=-1).argmax(-1)
+        mask[torch.arange(mask.size(0)), sep_pos] = 0
+        return mask
+
+    def encoder(sentences: List[str]):
+        batch = tokenizer(
+            sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length
+        )
+        with torch.no_grad():
+            if all_layers:
+                out = model(batch["input_ids"], batch["attention_mask"], output_hidden_states=True)
+                hidden = torch.stack(out.hidden_states, dim=1)  # (N, Λ, L, D)
+            elif forward_fn is not None:
+                hidden = forward_fn(model, dict(batch))
+            else:
+                out = model(batch["input_ids"], batch["attention_mask"], output_hidden_states=True)
+                hidden = out.hidden_states[num_layers if num_layers is not None else -1]
+        mask = _special_free_mask(batch["attention_mask"])
+        return jnp.asarray(hidden.cpu().numpy()), jnp.asarray(mask.cpu().numpy())
+
+    def tokenize(sentences: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+        batch = tokenizer(
+            sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length
+        )
+        mask = _special_free_mask(batch["attention_mask"])
+        return np.asarray(batch["input_ids"].numpy(), np.int64), np.asarray(mask.numpy())
+
+    return encoder, tokenize
+
+
+def hf_bert_model_and_tokenizer(
+    model_id: str, load_model: bool = True, load_tokenizer: bool = True
+) -> Tuple[Any, Any]:
+    """Raw (model, tokenizer) over a cached HF checkpoint — for callers that mix a resolved
+    model with user-supplied tokenizer/forward hooks (reference ``text/bert.py:95-115``).
+    Only the requested pieces are loaded (checkpoint weights are ~GBs); the other slot of the
+    returned pair is ``None``."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric requires `transformers` package be installed."
+            " Either install with `pip install transformers` or `pip install torchmetrics[text]`."
+        )
+    try:
+        from transformers import AutoModel, AutoTokenizer
+
+        tokenizer = _from_pretrained(AutoTokenizer, model_id) if load_tokenizer else None
+        model = _from_pretrained(AutoModel, model_id) if load_model else None
+        if model is not None:
+            model.eval()
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Loading checkpoint {model_id!r} failed (no local cache and no network egress"
+            " in this build). Pass an `encoder` callable `(sentences) -> (embeddings, mask)` instead."
+        ) from err
+    return model, tokenizer
+
+
 def bert_encoder(
-    model_id: str, num_layers: Optional[int] = None, max_length: int = 512
+    model_id: str, num_layers: Optional[int] = None, max_length: int = 512,
+    all_layers: bool = False,
 ):
     """``sentences -> (hidden (N, L, D), mask (N, L))`` host callable over a cached HF model.
 
@@ -252,7 +331,10 @@ def bert_encoder(
             )
             special = batch.pop("special_tokens_mask")
             out = model(**batch, output_hidden_states=True)
-            hidden = out.hidden_states[num_layers if num_layers is not None else -1]
+            if all_layers:
+                hidden = torch.stack(out.hidden_states, dim=1)  # (N, Λ, L, D)
+            else:
+                hidden = out.hidden_states[num_layers if num_layers is not None else -1]
         mask = batch["attention_mask"] * (1 - special)
         return jnp.asarray(hidden.cpu().numpy()), jnp.asarray(mask.cpu().numpy())
 

@@ -1,0 +1,49 @@
+"""BERTScore with your own encoder (analog of the reference's ``bert_score-own_model.py``).
+
+The model contract is a single callable — no torch module subclassing needed:
+
+    encoder(sentences: list[str]) -> (embeddings (N, L, D), mask (N, L))
+
+Anything that produces contextual embeddings works: a flax module, a host torch model, or (as
+here, so the example runs offline) a hash-based lookup table. The greedy cosine matching — the
+actual metric — runs on device as MXU matmuls either way.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.text import BERTScore
+
+D = 128
+_table = np.random.RandomState(0).randn(4096, D).astype(np.float32)
+
+
+def toy_encoder(sentences):
+    """Embed each whitespace token via a fixed random table (stands in for a real LM)."""
+    rows = [[hash(w) % 4096 for w in s.split()] for s in sentences]
+    width = max(len(r) for r in rows)
+    emb = np.zeros((len(rows), width, D), np.float32)
+    mask = np.zeros((len(rows), width), np.int32)
+    for i, r in enumerate(rows):
+        emb[i, : len(r)] = _table[r]
+        mask[i, : len(r)] = 1
+    return jnp.asarray(emb), jnp.asarray(mask)
+
+
+def main() -> None:
+    preds = ["hello there general kenobi", "the cat sat on the mat"]
+    target = ["hello there general kenobi", "a cat sat on a mat"]
+
+    metric = BERTScore(encoder=toy_encoder)
+    metric.update(preds, target)
+    score = metric.compute()
+    for key in ("precision", "recall", "f1"):
+        print(key, np.round(np.asarray(score[key]), 4))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Mean Average Precision for object detection (analog of the reference's ``detection_map.py``).
+
+Inputs are the standard list-of-dicts COCO layout; the matcher itself is a batched greedy
+XLA program over padded box buffers — no pycocotools shell-out.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+
+import numpy as np
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    preds = [
+        {
+            "boxes": np.array([[258.0, 41.0, 606.0, 285.0]], np.float32),
+            "scores": np.array([0.536], np.float32),
+            "labels": np.array([0]),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.array([[214.0, 41.0, 562.0, 285.0]], np.float32),
+            "labels": np.array([0]),
+        }
+    ]
+
+    metric = MeanAveragePrecision(iou_type="bbox")
+    metric.update(preds, target)
+    result = metric.compute()
+    for k, v in sorted(result.items()):
+        print(f"{k}: {np.asarray(v).round(4)}")
+
+    # extended_summary=True additionally returns the raw precision/recall/score tensors
+    detailed = MeanAveragePrecision(iou_type="bbox", extended_summary=True)
+    detailed.update(preds, target)
+    summary = detailed.compute()
+    print("extended keys:", sorted(summary.keys()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Plotting metric values (analog of the reference's ``plotting.py``).
+
+Every metric exposes ``.plot()``; sequences of values plot as training curves, confusion
+matrices as heatmaps, ROC/PR curves as line plots. Figures save fine headless (Agg backend).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+
+from torchmetrics_tpu.classification import BinaryROC, MulticlassAccuracy, MulticlassConfusionMatrix
+
+rng = np.random.RandomState(42)
+N, C = 256, 4
+
+
+def main() -> None:
+    # 1. scalar metric across "epochs": list of computed values -> curve with bound guides
+    acc = MulticlassAccuracy(num_classes=C)
+    values = []
+    for _ in range(5):
+        acc.update(rng.randint(0, C, N), rng.randint(0, C, N))
+        values.append(acc.compute())
+        acc.reset()
+    fig, _ = acc.plot(values)
+    fig.savefig("accuracy_over_epochs.png")
+
+    # 2. confusion matrix heatmap
+    cm = MulticlassConfusionMatrix(num_classes=C)
+    cm.update(rng.randint(0, C, N), rng.randint(0, C, N))
+    fig, _ = cm.plot()
+    fig.savefig("confusion_matrix.png")
+
+    # 3. ROC curve
+    roc = BinaryROC()
+    roc.update(rng.rand(N).astype(np.float32), rng.randint(0, 2, N))
+    fig, _ = roc.plot()
+    fig.savefig("roc_curve.png")
+
+    print("wrote accuracy_over_epochs.png confusion_matrix.png roc_curve.png")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""ROUGE with a custom normalizer + tokenizer (analog of the reference's
+``rouge_score-own_normalizer_and_tokenizer.py``).
+
+The defaults mirror the reference: lowercase, strip non-alphanumerics, split on whitespace.
+Pass callables to handle e.g. non-Latin scripts or domain-specific token rules.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+
+import re
+
+from torchmetrics_tpu.functional.text import rouge_score
+
+
+def keep_hyphens_normalizer(text: str) -> str:
+    """Like the default normalization but hyphens survive as token-internal characters."""
+    return re.sub(r"[^a-z0-9-]+", " ", text.lower())
+
+
+def char_tokenizer(text: str):
+    """Character-level tokens — useful for languages without whitespace word boundaries."""
+    return [c for c in text.strip() if not c.isspace()]
+
+
+def main() -> None:
+    preds = "state-of-the-art results"
+    target = "state of the art results"
+
+    default = rouge_score(preds, target, rouge_keys="rouge1")
+    custom = rouge_score(preds, target, rouge_keys="rouge1", normalizer=keep_hyphens_normalizer)
+    chars = rouge_score(preds, target, rouge_keys="rouge1", tokenizer=char_tokenizer)
+
+    print("default:   ", {k: float(v) for k, v in default.items()})
+    print("hyphenated:", {k: float(v) for k, v in custom.items()})
+    print("char-level:", {k: float(v) for k, v in chars.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -96,3 +96,38 @@ class TestMetricPlot:
 
         m = BinaryAccuracy()
         _finish(m.plot(val=jnp.asarray(0.75)))
+
+
+def test_grid_split_and_trim():
+    from torchmetrics_tpu.utils.plot import _get_col_row_split, trim_axs
+
+    assert _get_col_row_split(1) == (1, 1)
+    assert _get_col_row_split(4) == (2, 2)
+    assert _get_col_row_split(5) == (2, 3)
+    assert _get_col_row_split(7) == (3, 3)
+    fig, axs = plt.subplots(2, 3)
+    used = trim_axs(axs, 4)
+    assert len(used) == 4
+    assert sum(a.get_visible() for a in axs.ravel()) == 4
+    plt.close(fig)
+
+
+def test_bound_guides_and_optimal_annotation():
+    from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+    fig, ax = plot_single_or_multi_val(
+        [0.2, 0.4, 0.9], lower_bound=0.0, upper_bound=1.0, higher_is_better=True, name="acc"
+    )
+    texts = [t.get_text() for t in ax.texts]
+    assert any("Optimal" in t for t in texts)
+    lo, hi = ax.get_ylim()
+    assert lo < 0.0 and hi > 1.0  # padded past the bound guides
+    plt.close(fig)
+
+
+def test_style_change_noop_and_context():
+    from torchmetrics_tpu.utils.plot import style_change
+
+    with style_change("default"):
+        fig, ax = plt.subplots()
+    plt.close(fig)

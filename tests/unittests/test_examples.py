@@ -1,0 +1,22 @@
+"""Every example under examples/ must run end-to-end (subprocess, CPU platform)."""
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = sorted((pathlib.Path(__file__).parents[2] / "examples").glob("*.py"))
+
+
+@pytest.mark.parametrize("script", _EXAMPLES, ids=lambda p: p.stem)
+def test_example_runs(script, tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # each example must set up its own device needs
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True, timeout=600,
+        cwd=tmp_path,  # examples must not depend on the cwd (they bootstrap sys.path)
+        env=env,
+    )
+    assert proc.returncode == 0, f"{script.name} failed:\n{proc.stderr[-2000:]}"

@@ -1,0 +1,374 @@
+"""Whole-surface lifecycle sweep: EVERY exported metric class constructs, updates, computes,
+clones, pickles, and resets on synthetic inputs.
+
+The export-parity test proves every reference symbol exists; this one proves each is a working
+metric, not a shell — the full `update -> compute -> clone -> pickle round-trip -> reset`
+contract runs for all 130+ classes. Pretrained-model metrics run with pluggable toy encoders
+(their out-of-the-box HF path is covered separately in test_pretrained_adapters.py); metrics
+delegating to optional host packages skip cleanly when the package is absent.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+
+rng = np.random.RandomState(123)
+N, C, L = 40, 5, 3
+
+
+# ---------------------------------------------------------------------------- input factories
+def _mc():  # multiclass label pairs
+    return jnp.asarray(rng.randint(0, C, N)), jnp.asarray(rng.randint(0, C, N))
+
+
+def _mc_logits():
+    return jnp.asarray(rng.randn(N, C).astype(np.float32)), jnp.asarray(rng.randint(0, C, N))
+
+
+def _reg():
+    return (jnp.asarray(rng.randn(N).astype(np.float32)),
+            jnp.asarray(rng.randn(N).astype(np.float32)))
+
+
+def _reg_pos():
+    return (jnp.asarray((rng.rand(N) + 0.1).astype(np.float32)),
+            jnp.asarray((rng.rand(N) + 0.1).astype(np.float32)))
+
+
+def _probs2():
+    p = rng.rand(N, C).astype(np.float32)
+    t = rng.rand(N, C).astype(np.float32)
+    return jnp.asarray(p / p.sum(1, keepdims=True)), jnp.asarray(t / t.sum(1, keepdims=True))
+
+
+def _labels():
+    return jnp.asarray(rng.randint(0, 3, N)), jnp.asarray(rng.randint(0, 3, N))
+
+
+def _cluster_data():
+    return jnp.asarray(rng.randn(N, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 3, N))
+
+
+def _img(n=2, c=3, h=16, w=16):
+    return (jnp.asarray(rng.rand(n, c, h, w).astype(np.float32)),
+            jnp.asarray(rng.rand(n, c, h, w).astype(np.float32)))
+
+
+def _audio(n=2, t=800):
+    return (jnp.asarray(rng.randn(n, t).astype(np.float32)),
+            jnp.asarray(rng.randn(n, t).astype(np.float32)))
+
+
+def _text():
+    return (["the cat sat on the mat", "hello world"],
+            ["the cat sat on a mat", "hello there world"])
+
+
+def _retr():
+    return (jnp.asarray(rng.rand(N).astype(np.float32)), jnp.asarray(rng.randint(0, 2, N)))
+
+
+def _det_boxes():
+    preds = [{
+        "boxes": np.array([[10.0, 10.0, 60.0, 60.0], [5.0, 5.0, 25.0, 25.0]], np.float32),
+        "scores": np.array([0.8, 0.6], np.float32),
+        "labels": np.array([0, 1]),
+    }]
+    target = [{
+        "boxes": np.array([[12.0, 8.0, 58.0, 62.0]], np.float32),
+        "labels": np.array([0]),
+    }]
+    return preds, target
+
+
+def _panoptic():
+    p = rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32)
+    t = rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32)
+    return jnp.asarray(p), jnp.asarray(t)
+
+
+def _toy_feature(x):
+    """Deterministic 'network': channel-mean pooled patches as a (N, 8) feature."""
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim == 3:
+        x = x[None]
+    pooled = jnp.stack([
+        jnp.mean(x, axis=(1, 2, 3)), jnp.std(x, axis=(1, 2, 3)),
+        jnp.max(x, axis=(1, 2, 3)), jnp.min(x, axis=(1, 2, 3)),
+        jnp.mean(x[..., ::2, :], axis=(1, 2, 3)), jnp.mean(x[..., 1::2, :], axis=(1, 2, 3)),
+        jnp.mean(x[..., ::2], axis=(1, 2, 3)), jnp.mean(x[..., 1::2], axis=(1, 2, 3)),
+    ], axis=1)
+    return pooled
+
+
+def _toy_logits(x):
+    return _toy_feature(x)
+
+
+def _toy_lpips_net(a, b):
+    return jnp.mean(jnp.abs(jnp.asarray(a) - jnp.asarray(b)), axis=(1, 2, 3))
+
+
+_emb_table = rng.randn(1024, 16).astype(np.float32)
+
+
+def _toy_text_encoder(sentences):
+    rows = [[hash(w) % 1024 for w in s.split()] for s in sentences]
+    width = max(len(r) for r in rows)
+    emb = np.zeros((len(rows), width, 16), np.float32)
+    mask = np.zeros((len(rows), width), np.int32)
+    for i, r in enumerate(rows):
+        emb[i, : len(r)] = _emb_table[r]
+        mask[i, : len(r)] = 1
+    return jnp.asarray(emb), jnp.asarray(mask)
+
+
+def _toy_clip_image(images):
+    return _toy_feature(jnp.stack([jnp.asarray(i, jnp.float32) for i in images]))[:, :8]
+
+
+def _toy_clip_text(texts):
+    out = np.stack([_emb_table[[hash(w) % 1024 for w in t.split()]].mean(0)[:8] for t in texts])
+    return jnp.asarray(out)
+
+
+def _toy_tokenize(sentences, width=4):
+    ids = np.zeros((len(sentences), width), np.int64)
+    mask = np.zeros((len(sentences), width), np.int64)
+    for i, s in enumerate(sentences):
+        toks = [hash(w) % 1024 for w in s.split()[:width]]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return ids, mask
+
+
+def _toy_masked_lm(sentences):
+    """sentences -> (probs (N, L, V), mask (N, L)): softmaxed table rows, deterministic."""
+    ids, mask = _toy_tokenize(sentences)
+    logits = _emb_table[ids % 1024][..., :10]
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(mask)
+
+
+# ---------------------------------------------------------------------------- the spec table
+# name -> (constructor kwargs | callable -> instance, input factory, update kwargs)
+def _spec():
+    from torchmetrics_tpu.audio import PermutationInvariantTraining
+    from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    mextra: dict = {}
+    spec: dict = {}
+
+    # --- classification task wrappers
+    for name in ["Accuracy", "Precision", "Recall", "F1Score", "Specificity", "StatScores",
+                 "CohenKappa", "ConfusionMatrix", "MatthewsCorrCoef", "ExactMatch",
+                 "HammingDistance", "JaccardIndex"]:
+        spec[name] = (dict(task="multiclass", num_classes=C), _mc)
+    spec["CalibrationError"] = (dict(task="multiclass", num_classes=C), _mc_logits)
+    spec["FBetaScore"] = (dict(task="multiclass", num_classes=C, beta=0.5), _mc)
+    for name in ["AUROC", "AveragePrecision", "PrecisionRecallCurve", "ROC", "HingeLoss"]:
+        spec[name] = (dict(task="multiclass", num_classes=C), _mc_logits)
+    spec["PrecisionAtFixedRecall"] = (dict(task="multiclass", num_classes=C, min_recall=0.5), _mc_logits)
+    spec["RecallAtFixedPrecision"] = (dict(task="multiclass", num_classes=C, min_precision=0.2), _mc_logits)
+    spec["SpecificityAtSensitivity"] = (dict(task="multiclass", num_classes=C, min_sensitivity=0.5), _mc_logits)
+    spec["Dice"] = (dict(num_classes=C), _mc)
+
+    # --- regression
+    spec["CosineSimilarity"] = ({}, lambda: (jnp.asarray(rng.randn(N, 4).astype(np.float32)),
+                                             jnp.asarray(rng.randn(N, 4).astype(np.float32))))
+    for name in ["ConcordanceCorrCoef", "ExplainedVariance", "KendallRankCorrCoef",
+                 "LogCoshError", "MeanAbsoluteError", "MeanSquaredError", "PearsonCorrCoef", "R2Score",
+                 "RelativeSquaredError", "SpearmanCorrCoef"]:
+        spec[name] = ({}, _reg)
+    for name in ["MeanAbsolutePercentageError", "MeanSquaredLogError",
+                 "SymmetricMeanAbsolutePercentageError", "TweedieDevianceScore",
+                 "WeightedMeanAbsolutePercentageError"]:
+        spec[name] = ({}, _reg_pos)
+    spec["MinkowskiDistance"] = (dict(p=3), _reg)
+    spec["KLDivergence"] = ({}, _probs2)
+
+    # --- aggregation (single-input)
+    for name in ["CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
+                 "RunningMean", "RunningSum"]:
+        spec[name] = ({}, lambda: (jnp.asarray(rng.rand(N).astype(np.float32)),))
+
+    # --- clustering
+    for name in ["AdjustedMutualInfoScore", "AdjustedRandScore", "CompletenessScore",
+                 "FowlkesMallowsIndex", "HomogeneityScore", "MutualInfoScore",
+                 "NormalizedMutualInfoScore", "RandScore", "VMeasureScore"]:
+        spec[name] = ({}, _labels)
+    for name in ["CalinskiHarabaszScore", "DaviesBouldinScore", "DunnIndex"]:
+        spec[name] = ({}, _cluster_data)
+
+    # --- nominal
+    for name in ["CramersV", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]:
+        spec[name] = (dict(num_classes=3), _labels)
+    spec["FleissKappa"] = (dict(mode="counts"), lambda: (jnp.asarray(rng.randint(0, 5, (N, 4)).astype(np.int32)),))
+
+    # --- retrieval
+    for name in ["RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP", "RetrievalMRR",
+                 "RetrievalNormalizedDCG", "RetrievalPrecision", "RetrievalRecall",
+                 "RetrievalRPrecision", "RetrievalPrecisionRecallCurve",
+                 "RetrievalRecallAtFixedPrecision"]:
+        spec[name] = ({}, _retr)
+        mextra[name] = lambda: {"indexes": jnp.asarray(np.sort(rng.randint(0, 6, N)))}
+    spec["RetrievalRecallAtFixedPrecision"] = (dict(min_precision=0.3), _retr)
+
+    # --- image (conv/reduction)
+    spec["StructuralSimilarityIndexMeasure"] = ({}, _img)
+    spec["MultiScaleStructuralSimilarityIndexMeasure"] = ({}, lambda: _img(h=192, w=192))
+    spec["PeakSignalNoiseRatio"] = ({}, _img)
+    spec["PeakSignalNoiseRatioWithBlockedEffect"] = ({}, lambda: _img(c=1, h=32, w=32))
+    spec["UniversalImageQualityIndex"] = ({}, _img)
+    spec["SpectralAngleMapper"] = ({}, _img)
+    spec["ErrorRelativeGlobalDimensionlessSynthesis"] = ({}, _img)
+    spec["RelativeAverageSpectralError"] = ({}, _img)
+    spec["RootMeanSquaredErrorUsingSlidingWindow"] = ({}, _img)
+    spec["SpectralDistortionIndex"] = ({}, _img)
+    spec["TotalVariation"] = ({}, lambda: (_img()[0],))
+    spec["VisualInformationFidelity"] = ({}, lambda: _img(h=41, w=41))
+
+    # --- image (pretrained-model metrics with pluggable toy extractors)
+    def _alternating_real():
+        state = {"real": True}
+
+        def next_kwargs():
+            out = {"real": state["real"]}
+            state["real"] = not state["real"]
+            return out
+
+        return next_kwargs
+
+    spec["FrechetInceptionDistance"] = (dict(feature=_toy_feature), lambda: (_img(n=4)[0],))
+    mextra["FrechetInceptionDistance"] = _alternating_real()
+    spec["KernelInceptionDistance"] = (dict(feature=_toy_feature, subset_size=2), lambda: (_img(n=4)[0],))
+    mextra["KernelInceptionDistance"] = _alternating_real()
+    spec["MemorizationInformedFrechetInceptionDistance"] = (dict(feature=_toy_feature), lambda: (_img(n=4)[0],))
+    mextra["MemorizationInformedFrechetInceptionDistance"] = _alternating_real()
+    spec["InceptionScore"] = (dict(feature=_toy_logits), lambda: (_img()[0],))
+    spec["LearnedPerceptualImagePatchSimilarity"] = (dict(net_type=_toy_lpips_net, normalize=True), _img)
+    spec["PerceptualPathLength"] = None  # generator-model metric; exercised in its own tests
+
+    # --- audio
+    for name in ["ComplexScaleInvariantSignalNoiseRatio", "ScaleInvariantSignalDistortionRatio",
+                 "ScaleInvariantSignalNoiseRatio", "SignalDistortionRatio", "SignalNoiseRatio",
+                 "SourceAggregatedSignalDistortionRatio"]:
+        spec[name] = ({}, _audio)
+    spec["ComplexScaleInvariantSignalNoiseRatio"] = (
+        {}, lambda: tuple(jnp.stack([x, x * 0.5], axis=-1) for x in _audio(t=256)))
+    spec["SourceAggregatedSignalDistortionRatio"] = (
+        {}, lambda: tuple(jnp.stack([x, x * 0.7], axis=1) for x in _audio(t=256)))
+    spec["PermutationInvariantTraining"] = (
+        dict(metric_func=scale_invariant_signal_noise_ratio),
+        lambda: tuple(jnp.stack([x, x * 0.7], axis=1) for x in _audio(t=256)))
+    spec["SpeechReverberationModulationEnergyRatio"] = (dict(fs=8000), lambda: (_audio(n=1, t=8000)[0],))
+    spec["PerceptualEvaluationSpeechQuality"] = (dict(fs=8000, mode="nb"), lambda: _audio(n=1, t=8000))
+    spec["ShortTimeObjectiveIntelligibility"] = (dict(fs=8000), lambda: _audio(n=1, t=8000))
+
+    # --- text
+    for name in ["BLEUScore", "CHRFScore", "CharErrorRate", "EditDistance", "ExtendedEditDistance",
+                 "MatchErrorRate", "ROUGEScore", "SacreBLEUScore", "TranslationEditRate",
+                 "WordErrorRate", "WordInfoLost", "WordInfoPreserved"]:
+        spec[name] = ({}, _text)
+    spec["BLEUScore"] = (dict(n_gram=2), _text)
+    spec["SQuAD"] = ({}, lambda: (
+        [{"prediction_text": "the cat", "id": "1"}],
+        [{"answers": {"answer_start": [0], "text": ["the cat"]}, "id": "1"}]))
+    spec["Perplexity"] = ({}, lambda: (
+        jnp.asarray(rng.randn(2, 8, 10).astype(np.float32)), jnp.asarray(rng.randint(0, 10, (2, 8)))))
+    spec["BERTScore"] = (dict(encoder=_toy_text_encoder), _text)
+    spec["InfoLM"] = (dict(masked_lm=_toy_masked_lm, tokenize=_toy_tokenize), _text)
+
+    # --- detection
+    for name in ["CompleteIntersectionOverUnion", "DistanceIntersectionOverUnion",
+                 "GeneralizedIntersectionOverUnion", "IntersectionOverUnion"]:
+        spec[name] = ({}, _det_boxes)
+    spec["MeanAveragePrecision"] = ({}, _det_boxes)
+    spec["PanopticQuality"] = (dict(things={0}, stuffs={1}), _panoptic)
+    spec["ModifiedPanopticQuality"] = (dict(things={0}, stuffs={1}), _panoptic)
+
+    # --- multimodal
+    spec["CLIPScore"] = (dict(model_name_or_path=(_toy_clip_image, _toy_clip_text)),
+                         lambda: ([rng.randint(0, 255, (3, 16, 16)).astype(np.uint8)], ["a cat"]))
+    spec["CLIPImageQualityAssessment"] = (
+        dict(model_name_or_path=(_toy_clip_image, _toy_clip_text)),
+        lambda: (jnp.asarray(rng.rand(1, 3, 16, 16).astype(np.float32)),))
+
+    # --- wrappers / composition
+    spec["BootStrapper"] = (lambda: tm.BootStrapper(MeanSquaredError(), num_bootstraps=3), _reg)
+    spec["MinMaxMetric"] = (lambda: tm.MinMaxMetric(MeanSquaredError()), _reg)
+    spec["MultioutputWrapper"] = (lambda: tm.MultioutputWrapper(MeanSquaredError(), num_outputs=2),
+                                  lambda: (jnp.asarray(rng.randn(N, 2).astype(np.float32)),
+                                           jnp.asarray(rng.randn(N, 2).astype(np.float32))))
+    spec["MultitaskWrapper"] = (lambda: tm.MultitaskWrapper({"t1": MeanSquaredError()}),
+                                lambda: ({"t1": jnp.asarray(rng.randn(N).astype(np.float32))},
+                                         {"t1": jnp.asarray(rng.randn(N).astype(np.float32))}))
+    spec["ClasswiseWrapper"] = (
+        lambda: tm.ClasswiseWrapper(tm.classification.MulticlassAccuracy(num_classes=C, average=None)), _mc)
+    spec["MetricTracker"] = (lambda: tm.MetricTracker(MeanSquaredError()), _reg)
+    spec["MetricCollection"] = (
+        lambda: tm.MetricCollection([tm.classification.MulticlassAccuracy(num_classes=C)]), _mc)
+    spec["Metric"] = None          # abstract base
+    spec["__version__"] = None
+    spec["functional"] = None
+    return spec, mextra
+
+
+_SPEC, _MEXTRA = _spec()
+_UNLISTED = [n for n in tm.__all__ if n not in _SPEC]
+
+
+def test_every_export_has_a_spec():
+    assert _UNLISTED == [], f"exports without a lifecycle spec: {_UNLISTED}"
+
+
+@pytest.mark.parametrize("name", [n for n, v in _SPEC.items() if v is not None])
+def test_lifecycle(name):
+    ctor, inputs = _SPEC[name]
+    try:
+        metric = ctor() if callable(ctor) else getattr(tm, name)(**ctor)
+    except ModuleNotFoundError as err:
+        pytest.skip(f"{name}: optional backend absent ({err})")
+    mkw = _MEXTRA.get(name, dict)
+
+    if name == "MetricTracker":
+        metric.increment()
+    try:
+        metric.update(*inputs(), **mkw())
+        metric.update(*inputs(), **mkw())
+    except ModuleNotFoundError as err:
+        pytest.skip(f"{name}: optional backend absent ({err})")
+    value = metric.compute()
+    leaves = [np.asarray(x) for x in _leaves(value)]
+    assert leaves, f"{name}: compute returned no values"
+    assert all(np.all(np.isfinite(x) | np.isnan(x)) for x in leaves)
+
+    # clone + pickle round-trips preserve the computed value
+    for twin in (metric.clone(), pickle.loads(pickle.dumps(metric))):
+        if name == "MetricTracker":  # tracker compute() follows the active step
+            continue
+        tv = _leaves(twin.compute())
+        for a, b in zip(leaves, tv):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=1e-5, atol=1e-6, err_msg=name)
+
+    metric.reset()
+
+
+def _leaves(value):
+    if isinstance(value, dict):
+        out = []
+        for v in value.values():
+            out.extend(_leaves(v))
+        return out
+    if isinstance(value, (list, tuple)):
+        out = []
+        for v in value:
+            out.extend(_leaves(v))
+        return out
+    return [value]

@@ -217,3 +217,9 @@ class TestBertScoreOptions:
         expect1 = (np.asarray(out["f1"])[1] - 0.2) / 0.8
         assert np.allclose(np.asarray(out_rs["f1"])[0], expect0, atol=1e-6)
         assert np.allclose(np.asarray(out_rs["f1"])[1], expect1, atol=1e-6)
+
+    def test_encoder_conflicts_with_user_hooks(self):
+        with pytest.raises(ValueError, match="not both"):
+            bert_score(["a"], ["a"], encoder=fake_encoder, own_model=object())
+        with pytest.raises(ValueError, match="not both"):
+            bert_score(["a"], ["a"], encoder=fake_encoder, user_tokenizer=object())

@@ -156,10 +156,30 @@ def make_recall_flat(top_k: Optional[int]) -> Callable:
     return recall_at_k
 
 
-def recall_flat(ctx: Dict[str, Array]) -> Array:
-    hits = _seg(ctx, ctx["tgt_s"] * ctx["in_k"])
-    total = ctx["pos_seg"]
-    return jnp.where(total > 0, hits / jnp.maximum(total, 1.0), 0.0)
+recall_flat = make_recall_flat(None)
+
+
+def curve_counts(ctx: Dict[str, Array], max_k: int, adaptive_k: bool):
+    """(precision (N, K), recall (N, K)) for every k in 1..max_k, ONE batched segment-reduce.
+
+    Replaces a per-k Python loop (2*K kernel instantiations traced into the program) with a
+    single (N, K) membership product scattered per query — constant kernel count, O(N*K)
+    transient memory.
+    """
+    k_vec = jnp.arange(1, max_k + 1, dtype=jnp.float32)  # (K,)
+    k_doc = jnp.minimum(k_vec[None, :], ctx["n_valid"][:, None])  # (N, K)
+    in_k = (ctx["rank"][:, None] <= k_doc) & (ctx["val_s"][:, None] > 0)
+    hits = jax.ops.segment_sum(
+        ctx["tgt_s"][:, None] * in_k, ctx["gid"], num_segments=ctx["n"]
+    )  # (N, K) per-query hit counts
+    if adaptive_k:
+        prec_den = jnp.minimum(k_vec[None, :], ctx["n_valid_seg"][:, None])
+    else:
+        prec_den = jnp.broadcast_to(k_vec[None, :], hits.shape)
+    has_pos = (ctx["pos_seg"] > 0)[:, None]
+    precision = jnp.where(has_pos, hits / jnp.maximum(prec_den, 1.0), 0.0)
+    recall = jnp.where(has_pos, hits / jnp.maximum(ctx["pos_seg"][:, None], 1.0), 0.0)
+    return precision, recall
 
 
 def fall_out_flat(ctx: Dict[str, Array]) -> Array:

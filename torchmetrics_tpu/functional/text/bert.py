@@ -154,6 +154,11 @@ def bert_score(
     if all_layers and (encoder is not None or user_forward_fn is not None):
         # reference functional/text/bert.py:108-110
         raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+    if encoder is not None and (own_model is not None or user_tokenizer is not None or user_forward_fn is not None):
+        raise ValueError(
+            "Pass either `encoder` or the `own_model`/`user_tokenizer`/`user_forward_fn` hooks,"
+            " not both — silently preferring one of them would misreport which model was scored."
+        )
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
@@ -169,9 +174,9 @@ def bert_score(
         if model is None or tok is None:  # resolve ONLY the missing pieces from the checkpoint id
             if own_model is not None and model_name_or_path is None:
                 raise ValueError("`own_model` requires `user_tokenizer` (no checkpoint id to resolve one from).")
+            model_name_or_path = model_name_or_path or _DEFAULT_MODEL  # keep return_hash truthful
             hf_model, hf_tok = hf_bert_model_and_tokenizer(
-                model_name_or_path or _DEFAULT_MODEL,
-                load_model=model is None, load_tokenizer=tok is None,
+                model_name_or_path, load_model=model is None, load_tokenizer=tok is None,
             )
             model = model if model is not None else hf_model
             tok = tok if tok is not None else hf_tok

@@ -21,7 +21,6 @@ from torchmetrics_tpu.functional.retrieval._kernels import (
 from torchmetrics_tpu.functional.retrieval import _flat
 from torchmetrics_tpu.retrieval.base import (
     RetrievalMetric,
-    _masked_aggregate,
     _next_pow2,
     _retrieval_aggregate,
 )
@@ -229,16 +228,16 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
                 empty = (ctx["pos_seg"] == 0) & has_valid
                 include = has_valid & ~empty if action == "skip" else has_valid
                 impute = 1.0 if action == "pos" else 0.0
-                ps, rs = [], []
-                for k in range(1, max_k + 1):
-                    pv = _flat.make_precision_flat(k, adaptive)(ctx)
-                    rv = _flat.make_recall_flat(k)(ctx)
-                    if action != "skip":
-                        pv = jnp.where(empty, impute, pv)
-                        rv = jnp.where(empty, impute, rv)
-                    ps.append(_masked_aggregate(pv, include, "mean"))
-                    rs.append(_masked_aggregate(rv, include, "mean"))
-                return jnp.stack(ps), jnp.stack(rs), jnp.any(empty)
+                pv, rv = _flat.curve_counts(ctx, max_k, adaptive)  # (N, K) each
+                if action != "skip":
+                    pv = jnp.where(empty[:, None], impute, pv)
+                    rv = jnp.where(empty[:, None], impute, rv)
+                inc = include.astype(jnp.float32)[:, None]
+                m = jnp.maximum(jnp.sum(inc), 1.0)
+                any_inc = jnp.sum(inc) > 0
+                ps = jnp.where(any_inc, jnp.sum(pv * inc, axis=0) / m, 0.0)
+                rs = jnp.where(any_inc, jnp.sum(rv * inc, axis=0) / m, 0.0)
+                return ps, rs, jnp.any(empty)
 
             fn = jax.jit(run)
             self._jit_cache[cache_key] = fn

@@ -17,9 +17,18 @@ if _MATPLOTLIB_AVAILABLE:
 
     _AX_TYPE = "matplotlib.axes.Axes"
     _PLOT_OUT_TYPE = Tuple["plt.Figure", Union["matplotlib.axes.Axes", np.ndarray]]
+
+    style_change = plt.style.context  # reference ``plot.py:32``: themeable plot context
 else:
+    from contextlib import contextmanager
+
     _AX_TYPE = Any
     _PLOT_OUT_TYPE = Tuple[Any, Any]
+
+    @contextmanager
+    def style_change(*args: Any, **kwargs: Any):
+        """No-op stand-in when matplotlib is absent."""
+        yield
 
 
 def _error_on_missing_matplotlib() -> None:
@@ -27,6 +36,26 @@ def _error_on_missing_matplotlib() -> None:
         raise ModuleNotFoundError(
             "Plot function expects `matplotlib` to be installed. Install with `pip install matplotlib`."
         )
+
+
+def _get_col_row_split(n: int) -> Tuple[int, int]:
+    """Near-square (rows, cols) grid that fits ``n`` panels (reference ``plot.py:172``)."""
+    nsq = np.sqrt(n)
+    if nsq * nsq == n:
+        return int(nsq), int(nsq)
+    if np.floor(nsq) * np.ceil(nsq) >= n:
+        return int(np.floor(nsq)), int(np.ceil(nsq))
+    return int(np.ceil(nsq)), int(np.ceil(nsq))
+
+
+def trim_axs(axs, nb: int):
+    """Hide grid axes beyond the ``nb`` used panels; return the used ones (reference ``plot.py:182``)."""
+    if isinstance(axs, np.ndarray):
+        flat = axs.ravel()
+        for ax in flat[nb:]:
+            ax.set_visible(False)
+        return flat[:nb]
+    return axs
 
 
 def plot_single_or_multi_val(
@@ -60,13 +89,31 @@ def plot_single_or_multi_val(
         for c, v in enumerate(arr):
             lbl = f"{legend_name or 'class'} {c}" if arr.size > 1 else None
             ax.plot([0], [v], "o", label=lbl)
-    if lower_bound is not None or upper_bound is not None:
-        ax.set_ylim(lower_bound, upper_bound)
     if name is not None:
         ax.set_title(name)
     handles, labels = ax.get_legend_handles_labels()
     if labels:
         ax.legend()
+    ax.grid(True)
+    # metric bounds as dashed guides, with the optimal side annotated (reference plot.py:138-168)
+    bounds = [b for b in (lower_bound, upper_bound) if b is not None]
+    if bounds:
+        ylim = ax.get_ylim()
+        pad = 0.1 * ((upper_bound - lower_bound) if len(bounds) == 2 else (ylim[1] - ylim[0]))
+        ax.set_ylim(
+            bottom=(lower_bound - pad) if lower_bound is not None else ylim[0] - pad,
+            top=(upper_bound + pad) if upper_bound is not None else ylim[1] + pad,
+        )
+        xlim = ax.get_xlim()
+        ax.hlines(bounds, xlim[0], xlim[1], linestyles="dashed", colors="k")
+        optimal = (
+            upper_bound if (higher_is_better and upper_bound is not None)
+            else lower_bound if (higher_is_better is False and lower_bound is not None)
+            else None
+        )
+        if optimal is not None:
+            ax.set_xlim(xlim[0] - 0.1 * (xlim[1] - xlim[0]), xlim[1])
+            ax.text(xlim[0], optimal, s="Optimal \n value", ha="center", va="center")
     return fig, ax
 
 
@@ -88,7 +135,12 @@ def plot_confusion_matrix(
     if labels is not None and confmat.ndim != 3 and len(labels) != rows:
         raise ValueError("Expected number of elements in arg `labels` to match number of labels in confmat")
     labels = labels or np.arange(rows).tolist()
-    fig, axs = plt.subplots(nrows=1, ncols=nb) if ax is None else (ax.get_figure(), ax)
+    if ax is None:
+        grid_rows, grid_cols = _get_col_row_split(nb)
+        fig, axs = plt.subplots(nrows=grid_rows, ncols=grid_cols)
+        axs = trim_axs(axs, nb)
+    else:
+        fig, axs = ax.get_figure(), ax
     axs_list = np.atleast_1d(np.asarray(axs, dtype=object)).ravel().tolist()
     for i in range(nb):
         ax_i = axs_list[i] if i < len(axs_list) else axs_list[0]

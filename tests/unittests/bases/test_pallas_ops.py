@@ -56,3 +56,84 @@ def test_pallas_backend_actually_taken(monkeypatch):
         set_bincount_backend("xla")
     assert calls["n"] == 1
     np.testing.assert_array_equal(base, swapped)
+
+
+class TestPallasCurveCounts:
+    """VMEM-tiled threshold-counts kernel vs the XLA indicator-matmul (ops/pallas_curve.py)."""
+
+    def _data(self, n=5000, t=200, seed=0):
+        r = np.random.RandomState(seed)
+        scores = jnp.asarray(r.rand(n).astype(np.float32))
+        pos = jnp.asarray(r.rand(n).astype(np.float32))
+        neg = jnp.asarray(r.rand(n).astype(np.float32))
+        thr = jnp.linspace(0, 1, t)
+        return scores, pos, neg, thr
+
+    def test_matches_dot_formulation(self):
+        import importlib
+
+        from torchmetrics_tpu.ops.pallas_curve import curve_counts_pallas
+
+        prc = importlib.import_module(
+            "torchmetrics_tpu.functional.classification.precision_recall_curve")
+        scores, pos, neg, thr = self._data()
+        tp_ref, fp_ref = prc._indicator_counts(scores[None], pos[None], neg[None], thr)
+        tp, fp = curve_counts_pallas(scores, pos, neg, thr)
+        np.testing.assert_allclose(np.asarray(tp), np.asarray(tp_ref[0]), rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(fp), np.asarray(fp_ref[0]), rtol=1e-5, atol=1e-3)
+
+    def test_boundary_scores_and_ragged_sizes(self):
+        import importlib
+
+        from torchmetrics_tpu.ops.pallas_curve import curve_counts_pallas
+
+        prc = importlib.import_module(
+            "torchmetrics_tpu.functional.classification.precision_recall_curve")
+        for n, t in [(1, 1), (7, 3), (4096, 128), (5000, 200), (9000, 257)]:
+            r = np.random.RandomState(n)
+            thr = jnp.linspace(0, 1, t)
+            # half the scores sit EXACTLY on threshold values (the >= boundary)
+            exact = np.repeat(np.asarray(thr), max(1, n // (2 * t) + 1))[: n // 2]
+            scores = jnp.asarray(
+                np.concatenate([exact, r.rand(n - exact.size)]).astype(np.float32))
+            pos = jnp.asarray((r.rand(n) > 0.5).astype(np.float32))
+            neg = 1.0 - pos
+            tp_ref, fp_ref = prc._indicator_counts(scores[None], pos[None], neg[None], thr)
+            tp, fp = curve_counts_pallas(scores, pos, neg, thr)
+            np.testing.assert_allclose(np.asarray(tp), np.asarray(tp_ref[0]), atol=1e-3)
+            np.testing.assert_allclose(np.asarray(fp), np.asarray(fp_ref[0]), atol=1e-3)
+
+    def test_backend_toggle_through_binary_auroc(self, monkeypatch):
+        import importlib
+
+        prc = importlib.import_module(
+            "torchmetrics_tpu.functional.classification.precision_recall_curve")
+        import torchmetrics_tpu.ops.pallas_curve as pc
+        from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+        calls = {"n": 0}
+        real = pc.curve_counts_pallas
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        # the dispatch imports the symbol from the module at call time
+        monkeypatch.setattr(pc, "curve_counts_pallas", counting)
+
+        r = np.random.RandomState(1)
+        scores = jnp.asarray(r.rand(3000).astype(np.float32))
+        target = jnp.asarray(r.randint(0, 2, 3000))
+        ref = float(binary_auroc(scores, target, thresholds=100))
+        assert calls["n"] == 0
+        prc.set_curve_backend("pallas")
+        try:
+            got = float(binary_auroc(scores, target, thresholds=100))
+        finally:
+            prc.set_curve_backend("xla")
+        # the kernel must actually have run: a silent fallback would also pass the
+        # equality assert below, so count the invocation explicitly
+        assert calls["n"] == 1
+        assert got == pytest.approx(ref, abs=1e-6)
+        with pytest.raises(ValueError, match="curve backend"):
+            prc.set_curve_backend("nope")

@@ -84,6 +84,22 @@ def _indicator_counts(
     return res[:, 0], res[:, 1]
 
 
+_CURVE_BACKEND = "xla"  # "xla" (indicator matmul) or "pallas" (VMEM-tiled custom kernel)
+
+
+def set_curve_backend(backend: str) -> None:
+    """Select the binary threshold-counts lowering: ``"xla"`` (default) or ``"pallas"``.
+
+    The Pallas kernel (``ops.pallas_curve``) builds each threshold-indicator tile in registers
+    and reduces it on the spot — the (N, T) indicator never exists. Kept as the tuning point
+    for shapes where the dot formulation's operand layout is weak; same f32-count contract.
+    """
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"curve backend must be 'xla' or 'pallas', got {backend!r}")
+    global _CURVE_BACKEND
+    _CURVE_BACKEND = backend
+
+
 def _binned_counts(
     scores: Array, positive: Array, weight: Array, thresholds: Array
 ) -> Tuple[Array, Array, Array, Array]:
@@ -91,8 +107,20 @@ def _binned_counts(
     w = weight.astype(jnp.float32)
     pos = positive.astype(jnp.float32) * w
     neg = (1.0 - positive.astype(jnp.float32)) * w
-    tp, fp = _indicator_counts(scores[None], pos[None], neg[None], thresholds)
-    tp, fp = tp[0], fp[0]
+    tp = fp = None
+    if _CURVE_BACKEND == "pallas":
+        try:
+            from torchmetrics_tpu.ops.pallas_curve import curve_counts_pallas
+
+            tp, fp = curve_counts_pallas(scores, pos, neg, thresholds)
+        except Exception:
+            # trace-time failure -> dot path (same contract). NOTE: under an outer jit the
+            # kernel may instead fail at the OUTER compile, after this function returned —
+            # the fallback can only cover failures that surface while tracing/eager.
+            pass
+    if tp is None:
+        tp, fp = _indicator_counts(scores[None], pos[None], neg[None], thresholds)
+        tp, fp = tp[0], fp[0]
     fn = jnp.sum(pos) - tp
     tn = jnp.sum(neg) - fp
     return tp, fp, tn, fn

@@ -1,0 +1,102 @@
+"""Pallas threshold-counts kernel for the binned-curve family.
+
+The XLA formulation (``functional/classification/precision_recall_curve.py:_indicator_counts``)
+lowers ``tp[t] = Σ_i pos_i · [score_i >= thr_t]`` as a ``(2, N) @ (N, T)`` dot whose RHS is a
+broadcast compare. This kernel computes the same counts with an explicit VMEM pipeline: each
+grid step loads a ``(ROWS, 128)`` tile of scores/weights, builds the ``(tile, 128)`` threshold
+indicator in registers, reduces it on the spot, and accumulates into a ``(2·thr_rows, 128)``
+output block that stays resident across the whole sample grid — the (N, T) indicator never
+exists anywhere, in VMEM or HBM.
+
+Same contract as ``_indicator_counts`` restricted to one class: f32 accumulation (exact to
+2^24 ones per bucket), masked samples carried as zero weights. Used via
+``set_curve_backend("pallas")``; non-TPU platforms run in interpret mode, and the caller falls
+back to the dot path on any kernel failure.
+
+Measured on v5e (1M samples, T=200, fori-slope device rate): this VPU formulation reaches
+~0.7G samples/s vs ~2.6G for the XLA dot — the compare-into-dot fusion keeps the reduction on
+the MXU, which the elementwise compare+multiply+reduce here cannot match (Mosaic rejects the
+flattened-operand layout an in-kernel MXU dot would need). The kernel stays as the
+deterministic-layout tuning point and the template for shapes where the dot's operand layout
+is weak; the XLA dot remains the default.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+_LANES = 128
+_ROWS = 32  # sample tile = (32, 128) = 4096 scores per grid step
+
+
+def _curve_counts_kernel(scores_ref, pos_ref, neg_ref, thr_ref, out_ref):
+    sample_step = pl.program_id(0)
+
+    @pl.when(sample_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    s = scores_ref[...]  # (ROWS, LANES) f32
+    p = pos_ref[...]
+    n = neg_ref[...]
+    num_thr_rows = thr_ref.shape[0]
+    for r in range(num_thr_rows):  # static unroll: T is small (thr rows = ceil(T/128))
+        thr = thr_ref[r, :]  # (LANES,)
+        ind = (s[:, :, None] >= thr[None, None, :]).astype(jnp.float32)  # (ROWS, LANES, LANES)
+        out_ref[2 * r, :] += jnp.sum(p[:, :, None] * ind, axis=(0, 1))
+        out_ref[2 * r + 1, :] += jnp.sum(n[:, :, None] * ind, axis=(0, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _curve_counts_impl(scores, pos, neg, thr_rows, interpret: bool) -> Array:
+    n = scores.shape[0]
+    num_sample_blocks = n // (_ROWS * _LANES)
+    num_thr_rows = thr_rows.shape[0]
+    shaped = lambda x: x.reshape(num_sample_blocks * _ROWS, _LANES)
+    return pl.pallas_call(
+        _curve_counts_kernel,
+        grid=(num_sample_blocks,),
+        in_specs=[
+            pl.BlockSpec((_ROWS, _LANES), lambda s: (s, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda s: (s, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda s: (s, 0)),
+            pl.BlockSpec((num_thr_rows, _LANES), lambda s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2 * num_thr_rows, _LANES), lambda s: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2 * num_thr_rows, _LANES), jnp.float32),
+        interpret=interpret,
+    )(shaped(scores), shaped(pos), shaped(neg), thr_rows)
+
+
+def curve_counts_pallas(
+    scores: Array, pos: Array, neg: Array, thresholds: Array
+) -> Tuple[Array, Array]:
+    """(tp (T,), fp (T,)) threshold counts; the Pallas twin of ``_indicator_counts`` at C=1.
+
+    Pads samples to a full tile with zero weights (a zero-weight sample contributes to no
+    bucket) and thresholds to lane width with +inf (no score reaches them; sliced off).
+    """
+    scores = jnp.asarray(scores, jnp.float32).reshape(-1)
+    pos = jnp.asarray(pos, jnp.float32).reshape(-1)
+    neg = jnp.asarray(neg, jnp.float32).reshape(-1)
+    t = thresholds.shape[0]
+    block = _ROWS * _LANES
+    n_pad = max(((scores.size + block - 1) // block) * block, block)
+    t_rows = (t + _LANES - 1) // _LANES
+
+    def pad_to(x, fill):
+        return jnp.full((n_pad,), fill, jnp.float32).at[: x.size].set(x)
+
+    thr_rows = jnp.full((t_rows * _LANES,), jnp.inf, jnp.float32).at[:t].set(
+        jnp.asarray(thresholds, jnp.float32)
+    ).reshape(t_rows, _LANES)
+    interpret = jax.default_backend() != "tpu"
+    out = _curve_counts_impl(pad_to(scores, 0.0), pad_to(pos, 0.0), pad_to(neg, 0.0), thr_rows, interpret)
+    tp = out[0::2].reshape(-1)[:t]
+    fp = out[1::2].reshape(-1)[:t]
+    return tp, fp

@@ -286,3 +286,39 @@ def test_flat_engine_tie_order_matches_rectangle():
         arrays = m._state_arrays(m._computable_state())
         rect_val = float(m._grouped_aggregate(*arrays, "pos", "no target"))
         assert flat_val == pytest.approx(rect_val, abs=1e-6), cls.__name__
+
+
+def test_curve_aggregation_options():
+    """Per-k aggregation ('median'/'min'/'max'/callable) matches a host recomputation."""
+    r = np.random.RandomState(5)
+    n, q, max_k = 400, 13, 4
+    preds = r.rand(n).astype(np.float32)
+    target = r.randint(0, 2, n)
+    indexes = np.sort(r.randint(0, q, n))
+
+    def host_curves(agg):
+        ps, rs = [], []
+        for k in range(1, max_k + 1):
+            pk, rk = [], []
+            for qi in np.unique(indexes):
+                sel = indexes == qi
+                if target[sel].sum() == 0:
+                    pk.append(0.0); rk.append(0.0)
+                    continue
+                order = np.argsort(-preds[sel], kind="stable")
+                topk = target[sel][order][: min(k, sel.sum())]
+                pk.append(topk.sum() / k)
+                rk.append(topk.sum() / target[sel].sum())
+            ps.append(agg(np.asarray(pk))); rs.append(agg(np.asarray(rk)))
+        return np.asarray(ps), np.asarray(rs)
+
+    from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+
+    for agg_name, agg_fn in [("median", np.median), ("min", np.min), ("max", np.max),
+                             (lambda v: float(np.mean(np.asarray(v)) * 1.0), np.mean)]:
+        m = RetrievalPrecisionRecallCurve(max_k=max_k, aggregation=agg_name)
+        m.update(preds, target, indexes=indexes)
+        p_, r_, k_ = m.compute()
+        hp, hr = host_curves(agg_fn)
+        np.testing.assert_allclose(np.asarray(p_), hp, atol=1e-5, err_msg=str(agg_name))
+        np.testing.assert_allclose(np.asarray(r_), hr, atol=1e-5, err_msg=str(agg_name))

@@ -443,3 +443,91 @@ class TestExportSurfaceParity:
         ours = set(tpu_tm.__all__)
         theirs = set(ref_tm.__all__)
         assert theirs - ours == set(), f"missing top-level exports: {sorted(theirs - ours)}"
+
+
+class TestSignatureParity:
+    """Every shared public symbol accepts at least the reference's parameters.
+
+    Functional: full parameter-name coverage (unless ours absorbs **kwargs). Classes: every
+    explicit reference ``__init__`` parameter must be explicit here too (``**kwargs``
+    absorption does not count — the engine rejects unknown keys, so a missing explicit
+    parameter IS an API break for keyword callers).
+    """
+
+    def test_functional_parameter_coverage(self):
+        import inspect
+
+        import torchmetrics.functional as ref_f
+
+        gaps = []
+        for name in ref_f.__all__:
+            rf, of = getattr(ref_f, name, None), getattr(F, name, None)
+            if rf is None or of is None:
+                continue
+            try:
+                rp = set(inspect.signature(rf).parameters)
+                osig = inspect.signature(of)
+            except (ValueError, TypeError):
+                continue
+            if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in osig.parameters.values()):
+                continue
+            missing = rp - set(osig.parameters)
+            if missing:
+                gaps.append((name, sorted(missing)))
+        assert gaps == [], f"functional symbols missing reference parameters: {gaps}"
+
+    def test_class_init_parameter_coverage(self):
+        import importlib
+        import inspect
+
+        gaps = []
+        for dom in ["classification", "regression", "retrieval", "image", "audio", "text",
+                    "clustering", "nominal", "detection", "multimodal", "wrappers"]:
+            rmod = importlib.import_module(f"torchmetrics.{dom}")
+            omod = importlib.import_module(f"torchmetrics_tpu.{dom}")
+            for name in dir(rmod):
+                if name.startswith("_"):
+                    continue
+                rf, of = getattr(rmod, name), getattr(omod, name, None)
+                if not isinstance(rf, type) or of is None or not isinstance(of, type):
+                    continue
+                try:
+                    rp = {k for k, p in inspect.signature(rf.__init__).parameters.items()
+                          if p.kind not in (p.VAR_KEYWORD, p.VAR_POSITIONAL)}
+                    op = {k for k, p in inspect.signature(of.__init__).parameters.items()
+                          if p.kind not in (p.VAR_KEYWORD, p.VAR_POSITIONAL)}
+                except (ValueError, TypeError):
+                    continue
+                missing = rp - op - {"kwargs"}
+                if missing:
+                    gaps.append((f"{dom}.{name}", sorted(missing)))
+        assert gaps == [], f"classes missing explicit reference __init__ parameters: {gaps}"
+
+    def test_option_surface_behaviors(self):
+        """The five gaps the audit found, pinned to the reference as oracle."""
+        rng = np.random.RandomState(0)
+        s = rng.rand(50, 2).astype(np.float32)
+        s = s / s.sum(1, keepdims=True)
+        t2 = rng.randint(0, 2, 50)
+        check(F.dice(s, t2, multiclass=False),
+              ref_tm.functional.dice(_t(s), _t(t2), multiclass=False), atol=1e-6)
+        check(F.tweedie_deviance_score(preds=np.array([1.0, 2.0], np.float32),
+                                       targets=np.array([1.5, 2.5], np.float32)),
+              ref_tm.functional.tweedie_deviance_score(
+                  preds=_t(np.array([1.0, 2.0], np.float32)),
+                  targets=_t(np.array([1.5, 2.5], np.float32))))
+        check(F.minkowski_distance(preds=np.array([1.0, 2.0], np.float32),
+                                   targets=np.array([1.5, 2.5], np.float32), p=3),
+              ref_tm.functional.minkowski_distance(
+                  preds=_t(np.array([1.0, 2.0], np.float32)),
+                  targets=_t(np.array([1.5, 2.5], np.float32)), p=3), atol=1e-5)
+        sc = rng.rand(60, 4).astype(np.float32)
+        sc = sc / sc.sum(1, keepdims=True)
+        tg = rng.randint(0, 4, 60)
+        for fn_name in ("roc", "precision_recall_curve"):
+            ours = getattr(F, fn_name)(sc, tg, task="multiclass", num_classes=4,
+                                       thresholds=20, average="micro")
+            theirs = getattr(ref_tm.functional, fn_name)(
+                _t(sc), _t(tg), task="multiclass", num_classes=4, thresholds=20, average="micro")
+            check(ours[0], theirs[0], atol=1e-5)
+            check(ours[1], theirs[1], atol=1e-5)

@@ -36,6 +36,7 @@ class Dice(Metric):
         mdmc_average: Optional[str] = "global",
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -51,6 +52,9 @@ class Dice(Metric):
         self.mdmc_average = mdmc_average
         self.ignore_index = ignore_index
         self.top_k = top_k
+        self.multiclass = multiclass
+        if multiclass is False and ignore_index is not None:
+            raise ValueError("You can not use `ignore_index` with binary data.")
         # Per-sample counts need unbounded cat state: both `average="samples"` and
         # `mdmc_average="samplewise"` reduce within each sample before averaging over samples
         # (reference dice.py:31 mdmc semantics).
@@ -74,6 +78,10 @@ class Dice(Metric):
     def _update(self, state, preds, target):
         preds = jnp.asarray(preds)
         target = jnp.asarray(target)
+        if self.multiclass is False:
+            from torchmetrics_tpu.functional.classification.dice import _to_binary_for_multiclass_false
+
+            preds, target = _to_binary_for_multiclass_false(preds, target)
         if preds.ndim == target.ndim + 1 and jnp.issubdtype(preds.dtype, jnp.floating):
             n_cls = preds.shape[1]
             if self.num_classes is not None and n_cls != self.num_classes:
@@ -98,8 +106,12 @@ class Dice(Metric):
         return {"tp": state["tp"] + tp, "fp": state["fp"] + fp, "fn": state["fn"] + fn}
 
     def _compute(self, state):
+        tp, fp, fn = state["tp"], state["fp"], state["fn"]
+        if self.multiclass is False:
+            # only the positive-class statistics survive the legacy conversion
+            tp, fp, fn = tp[..., 1:2], fp[..., 1:2], fn[..., 1:2]
         if self.mdmc_average == "samplewise" and self.average != "samples":
             # per-sample reduction first, then mean over samples (reference mdmc semantics)
-            score = _dice_from_counts(state["tp"], state["fp"], state["fn"], self.average, self.zero_division)
+            score = _dice_from_counts(tp, fp, fn, self.average, self.zero_division)
             return jnp.mean(score, axis=0)
-        return _dice_from_counts(state["tp"], state["fp"], state["fn"], self.average, self.zero_division)
+        return _dice_from_counts(tp, fp, fn, self.average, self.zero_division)

@@ -16,6 +16,7 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _multiclass_stat_scores_format,
     _multiclass_stat_scores_update,
 )
+from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
 
 
@@ -67,6 +68,26 @@ def _dice_update(
     return tp, fp, fn
 
 
+def _to_binary_for_multiclass_false(preds: Array, target: Array):
+    """Legacy ``multiclass=False`` re-read (reference ``checks.py:440-450``): 2-column scores
+    become the positive-class indicator; integer inputs must already be binary. Value checks
+    are host-side and skip under trace (the ``validate_args`` contract of this codebase)."""
+    if preds.ndim == target.ndim + 1 and jnp.issubdtype(preds.dtype, jnp.floating):
+        if preds.shape[1] != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        preds = (jnp.argmax(preds, axis=1) == 1).astype(jnp.int32)
+    elif not is_traced(preds) and int(jnp.max(preds)) > 1:
+        raise ValueError(
+            "If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1."
+        )
+    if not is_traced(target) and int(jnp.max(target)) > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    return preds, target
+
+
 def _infer_num_classes(preds: Array, target: Array, num_classes: Optional[int]) -> int:
     if num_classes is not None:
         return num_classes
@@ -85,9 +106,15 @@ def dice(
     threshold: float = 0.5,
     top_k: Optional[int] = None,
     num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Dice score (reference ``dice.py:89``)."""
+    """Dice score (reference ``dice.py:89``).
+
+    ``multiclass`` is the legacy type-override flag (reference ``utilities/checks.py:440-450``):
+    ``False`` re-interprets 2-class data as binary (positive-class column), ``True`` keeps the
+    multiclass treatment (which the one-hot kernel here already applies to binary labels).
+    """
     allowed = ("micro", "macro", "samples", "none", None)
     if average not in allowed:
         raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")
@@ -95,6 +122,12 @@ def dice(
         raise ValueError(f"The `mdmc_average` has to be 'global', 'samplewise' or None, got {mdmc_average}.")
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
+    if multiclass is False:
+        if ignore_index is not None:
+            # the legacy formatter reduces the data to binary, where ignore_index is rejected
+            # (reference checks.py via dice: "You can not use `ignore_index` with binary data.")
+            raise ValueError("You can not use `ignore_index` with binary data.")
+        preds, target = _to_binary_for_multiclass_false(preds, target)
     samplewise = average == "samples" or mdmc_average == "samplewise"
     if (
         preds.ndim == target.ndim + 1
@@ -106,6 +139,10 @@ def dice(
         preds_fmt = preds  # top_k > 1 keeps the (N, C, ...) scores for the top-k path
     n_cls = _infer_num_classes(preds, target, num_classes)
     tp, fp, fn = _dice_update(preds_fmt, target, n_cls, threshold, top_k, ignore_index, samplewise)
+    if multiclass is False:
+        # the legacy formatter keeps only the positive-class column (checks.py:440-441), so
+        # the reduction sees positive-class statistics alone
+        tp, fp, fn = tp[..., 1:2], fp[..., 1:2], fn[..., 1:2]
     if mdmc_average == "samplewise" and average != "samples":
         # per-sample reduction first, then mean over samples (reference mdmc semantics)
         score = _dice_from_counts(tp, fp, fn, average, zero_division)

@@ -519,6 +519,7 @@ def precision_recall_curve(
     thresholds: Thresholds = None,
     num_classes: Optional[int] = None,
     num_labels: Optional[int] = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
@@ -532,7 +533,7 @@ def precision_recall_curve(
         if not isinstance(num_classes, int):
             raise ValueError(f"`num_classes` must be `int` but `{type(num_classes)} was passed.`")
         return multiclass_precision_recall_curve(
-            preds, target, num_classes, thresholds, None, ignore_index, validate_args
+            preds, target, num_classes, thresholds, average, ignore_index, validate_args
         )
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):

@@ -20,9 +20,10 @@ def _minkowski_distance_compute(distance: Array, p: float) -> Array:
     return jnp.power(distance, 1.0 / p)
 
 
-def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
-    """Minkowski distance (reference ``minkowski.py:44``)."""
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Minkowski distance (reference ``minkowski.py:44`` — which names the second argument
+    ``targets``, unlike the rest of the API)."""
     preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
+    target = jnp.asarray(targets)
     distance = _minkowski_distance_update(preds, target, p)
     return _minkowski_distance_compute(distance, p)

@@ -64,9 +64,10 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
     return sum_deviance_score / num_observations
 
 
-def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
-    """Tweedie deviance score (reference ``tweedie_deviance.py:100``)."""
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score (reference ``tweedie_deviance.py:100`` — which names the second
+    argument ``targets``, unlike the rest of the API)."""
     preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
+    target = jnp.asarray(targets)
     s, n = _tweedie_deviance_score_update(preds, target, power)
     return _tweedie_deviance_score_compute(s, n)

@@ -59,8 +59,15 @@ class _ConfmatNominalMetric(Metric):
 class CramersV(_ConfmatNominalMetric):
     """Cramer's V (reference ``nominal/cramers.py:28``)."""
 
-    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
-        super().__init__(num_classes, **kwargs)
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: Literal["replace", "drop"] = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value, **kwargs)
         self.bias_correction = bias_correction
 
     def _update_fn(self, preds, target):
@@ -95,8 +102,15 @@ class TheilsU(_ConfmatNominalMetric):
 class TschuprowsT(_ConfmatNominalMetric):
     """Tschuprow's T (reference ``nominal/tschuprows.py:28``)."""
 
-    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
-        super().__init__(num_classes, **kwargs)
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: Literal["replace", "drop"] = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value, **kwargs)
         self.bias_correction = bias_correction
 
     def _update_fn(self, preds, target):

@@ -21,9 +21,16 @@ from torchmetrics_tpu.functional.retrieval._kernels import (
 from torchmetrics_tpu.functional.retrieval import _flat
 from torchmetrics_tpu.retrieval.base import (
     RetrievalMetric,
+    _masked_aggregate,
     _next_pow2,
     _retrieval_aggregate,
 )
+
+
+def _agg_columns(values: Array, include: Array, aggregation: str) -> Array:
+    """Per-column (k-axis) masked aggregation of per-query curve values: one vmap of the
+    scalar ``base._masked_aggregate`` over the K axis (single source of the masking math)."""
+    return jax.vmap(lambda col: _masked_aggregate(col, include, aggregation), in_axes=1)(values)
 
 
 def _validate_top_k(top_k: Optional[int]) -> None:
@@ -182,8 +189,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
     def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False,
                  empty_target_action: str = "neg", ignore_index: Optional[int] = None,
-                 **kwargs: Any) -> None:
-        super().__init__(empty_target_action, ignore_index, "mean", **kwargs)
+                 aggregation="mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
         if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
             raise ValueError('`max_k` must be a positive integer or None')
         if not isinstance(adaptive_k, bool):
@@ -221,6 +228,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         if fn is None:
             action = self.empty_target_action
             adaptive = self.adaptive_k
+            aggregation = self.aggregation
+            device_agg = aggregation if isinstance(aggregation, str) else None
 
             def run(indexes, preds, target, valid):
                 ctx = _flat.build_context(indexes, preds, target, valid, None)
@@ -232,16 +241,24 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
                 if action != "skip":
                     pv = jnp.where(empty[:, None], impute, pv)
                     rv = jnp.where(empty[:, None], impute, rv)
-                inc = include.astype(jnp.float32)[:, None]
-                m = jnp.maximum(jnp.sum(inc), 1.0)
-                any_inc = jnp.sum(inc) > 0
-                ps = jnp.where(any_inc, jnp.sum(pv * inc, axis=0) / m, 0.0)
-                rs = jnp.where(any_inc, jnp.sum(rv * inc, axis=0) / m, 0.0)
+                if device_agg is None:  # custom callable: per-query columns go back to the host
+                    return pv, rv, include, jnp.any(empty)
+                ps = _agg_columns(pv, include, device_agg)
+                rs = _agg_columns(rv, include, device_agg)
                 return ps, rs, jnp.any(empty)
 
             fn = jax.jit(run)
             self._jit_cache[cache_key] = fn
-        p, r, any_empty = fn(indexes, preds, target, valid)
+        if isinstance(self.aggregation, str):
+            p, r, any_empty = fn(indexes, preds, target, valid)
+        else:
+            pv, rv, include, any_empty = fn(indexes, preds, target, valid)
+            keep = np.asarray(include)
+            pv_np, rv_np = np.asarray(pv)[keep], np.asarray(rv)[keep]  # ONE transfer each
+            p = jnp.stack([jnp.asarray(self.aggregation(jnp.asarray(pv_np[:, k])))
+                           for k in range(requested_k)])
+            r = jnp.stack([jnp.asarray(self.aggregation(jnp.asarray(rv_np[:, k])))
+                           for k in range(requested_k)])
         if self.empty_target_action == "error" and bool(any_empty):
             raise ValueError("`compute` method was provided with a query with no positive target.")
         return p[:requested_k], r[:requested_k]

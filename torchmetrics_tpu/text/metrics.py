@@ -593,6 +593,22 @@ class _SentenceStoreTextMetric(_HostTextMetric):
         self._target = []
 
 
+def _check_inert_knobs(num_layers="skip", verbose="skip", device="skip",
+                       batch_size="skip", num_threads="skip") -> None:
+    """The inert reference knobs sit mid-signature; a positional caller who misbinds a
+    callable/model onto one of them must get an error, never silently-wrong scores."""
+    if num_layers != "skip" and not (num_layers is None or isinstance(num_layers, int)):
+        raise TypeError(f"`num_layers` must be an int or None, got {type(num_layers).__name__}")
+    if verbose != "skip" and not isinstance(verbose, bool):
+        raise TypeError(f"`verbose` must be a bool, got {type(verbose).__name__}")
+    if device != "skip" and callable(device):
+        raise TypeError("`device` received a callable — check your positional arguments")
+    if batch_size != "skip" and not isinstance(batch_size, int):
+        raise TypeError(f"`batch_size` must be an int, got {type(batch_size).__name__}")
+    if num_threads != "skip" and not isinstance(num_threads, int):
+        raise TypeError(f"`num_threads` must be an int, got {type(num_threads).__name__}")
+
+
 class BERTScore(_SentenceStoreTextMetric):
     """BERTScore (reference ``text/bert.py:54``): pluggable-encoder design.
 
@@ -607,18 +623,39 @@ class BERTScore(_SentenceStoreTextMetric):
     def __init__(
         self,
         model_name_or_path: Optional[str] = None,
-        encoder=None,
-        tokenize=None,
         num_layers: Optional[int] = None,
-        max_length: int = 512,
+        all_layers: bool = False,
+        model=None,
+        user_tokenizer=None,
+        user_forward_fn=None,
+        verbose: bool = False,
         idf: bool = False,
+        device=None,
+        max_length: int = 512,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
-        lang: str = "en",
+        baseline_url: Optional[str] = None,
+        encoder=None,
+        tokenize=None,
         **kwargs: Any,
     ) -> None:
+        """Reference signature (``text/bert.py:134-153``) plus this build's pluggable
+        ``encoder``/``tokenize`` callables; ``verbose``/``device``/``batch_size``/``num_threads``
+        are inert host-loop knobs here, ``baseline_url`` would need network egress."""
         super().__init__(**kwargs)
-        if encoder is None:
+        _check_inert_knobs(num_layers=num_layers, verbose=verbose, device=device,
+                           batch_size=batch_size, num_threads=num_threads)
+        if baseline_url is not None:
+            rank_zero_warn("`baseline_url` needs network egress, which this build does not have;"
+                           " pass `baseline_path` instead.")
+        user_hooks = model is not None or user_tokenizer is not None or user_forward_fn is not None
+        # with all_layers the functional entrypoint builds the (layer-stacked) default encoder
+        # itself, so the flag composes with the default-model path but not a custom `encoder`
+        if encoder is None and not user_hooks and not all_layers:
             from torchmetrics_tpu.functional.text.bert import _DEFAULT_MODEL
             from torchmetrics_tpu.utils.pretrained import bert_encoder as _build
 
@@ -629,10 +666,19 @@ class BERTScore(_SentenceStoreTextMetric):
                     f" It will use the default recommended model - {_DEFAULT_MODEL!r}."
                 )
                 model_name_or_path = _DEFAULT_MODEL
-            encoder, tokenize = _build(model_name_or_path, num_layers=num_layers, max_length=max_length)
+            encoder, tokenize = _build(
+                model_name_or_path, num_layers=num_layers, max_length=max_length, all_layers=all_layers
+            )
+        self.model_name_or_path = model_name_or_path
         self.encoder = encoder
         self.tokenize = tokenize
         self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.own_model = model
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.max_length = max_length
+        self.return_hash = return_hash
         self.idf = idf
         self.rescale_with_baseline = rescale_with_baseline
         self.baseline_path = baseline_path
@@ -641,16 +687,28 @@ class BERTScore(_SentenceStoreTextMetric):
     def _score(self, preds: list, target: list):
         from torchmetrics_tpu.functional.text.bert import bert_score
 
+        hooks = {}
+        if self.own_model is not None or self.user_tokenizer is not None or self.user_forward_fn is not None:
+            hooks = {
+                "own_model": self.own_model,
+                "user_tokenizer": self.user_tokenizer,
+                "user_forward_fn": self.user_forward_fn,
+            }
         return bert_score(
             preds,
             target,
+            model_name_or_path=self.model_name_or_path,
             encoder=self.encoder,
             tokenize=self.tokenize,
             num_layers=self.num_layers,
+            max_length=self.max_length,
             idf=self.idf,
             rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path,
             lang=self.lang,
+            all_layers=self.all_layers,
+            return_hash=self.return_hash,
+            **hooks,
         )
 
 
@@ -669,12 +727,21 @@ class InfoLM(_SentenceStoreTextMetric):
         idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        device=None,
+        max_length: int = 192,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
+        return_sentence_level_score: bool = False,
         masked_lm=None,
         tokenize=None,
-        max_length: int = 192,
-        return_sentence_level_score: bool = False,
         **kwargs: Any,
     ) -> None:
+        """Reference signature (``text/infolm.py:120-134``; ``device``/``batch_size``/
+        ``num_threads``/``verbose`` are inert host-loop knobs here) plus this build's
+        pluggable ``masked_lm``/``tokenize`` callables."""
+        _check_inert_knobs(verbose=verbose, device=device, batch_size=batch_size,
+                           num_threads=num_threads)
         super().__init__(**kwargs)
         from torchmetrics_tpu.functional.text.infolm import _hf_masked_lm, _validate_measure
 

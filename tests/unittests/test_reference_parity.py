@@ -531,3 +531,64 @@ class TestSignatureParity:
                 _t(sc), _t(tg), task="multiclass", num_classes=4, thresholds=20, average="micro")
             check(ours[0], theirs[0], atol=1e-5)
             check(ours[1], theirs[1], atol=1e-5)
+
+    @staticmethod
+    def _default_diffs(ref_params, our_params):
+        import inspect
+
+        out = []
+        for pname, p in ref_params.items():
+            o = our_params.get(pname)
+            if p.default is inspect.Parameter.empty or o is None or o.default is inspect.Parameter.empty:
+                continue
+            try:
+                same = (p.default == o.default) or (repr(p.default) == repr(o.default))
+            except Exception:
+                same = repr(p.default) == repr(o.default)
+            if not same:
+                out.append((pname, repr(p.default), repr(o.default)))
+        return out
+
+    def test_functional_default_values_match(self):
+        import inspect
+
+        import torchmetrics.functional as ref_f
+
+        # __all__ PLUS plain module attributes: the reference leaves some text functions
+        # (infolm, bert_score) out of __all__ but they are public imports all the same
+        names = set(ref_f.__all__) | {n for n in dir(ref_f) if not n.startswith("_") and callable(getattr(ref_f, n, None))}
+        diffs = []
+        for name in sorted(names):
+            rf, of = getattr(ref_f, name, None), getattr(F, name, None)
+            if rf is None or of is None:
+                continue
+            try:
+                rp = inspect.signature(rf).parameters
+                op = inspect.signature(of).parameters
+            except (ValueError, TypeError):
+                continue
+            diffs.extend((name,) + d for d in self._default_diffs(rp, op))
+        assert diffs == [], f"default-value drift vs reference: {diffs}"
+
+    def test_class_init_default_values_match(self):
+        import importlib
+        import inspect
+
+        diffs = []
+        for dom in ["classification", "regression", "retrieval", "image", "audio", "text",
+                    "clustering", "nominal", "detection", "multimodal", "wrappers"]:
+            rmod = importlib.import_module(f"torchmetrics.{dom}")
+            omod = importlib.import_module(f"torchmetrics_tpu.{dom}")
+            for name in dir(rmod):
+                if name.startswith("_"):
+                    continue
+                rf, of = getattr(rmod, name), getattr(omod, name, None)
+                if not isinstance(rf, type) or of is None or not isinstance(of, type):
+                    continue
+                try:
+                    rp = inspect.signature(rf.__init__).parameters
+                    op = inspect.signature(of.__init__).parameters
+                except (ValueError, TypeError):
+                    continue
+                diffs.extend((f"{dom}.{name}",) + d for d in self._default_diffs(rp, op))
+        assert diffs == [], f"class default drift vs reference: {diffs}"

@@ -196,7 +196,7 @@ def infolm(
     beta: Optional[float] = None,
     masked_lm: Optional[MaskedLM] = None,
     tokenize=None,
-    max_length: int = 192,
+    max_length: Optional[int] = None,
     return_sentence_level_score: bool = False,
     **reference_kwargs,
 ):
@@ -222,6 +222,7 @@ def infolm(
         target = [target]
     if len(preds) != len(target):
         raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
+    max_length = 192 if max_length is None else max_length  # reference None = tokenizer max
     if masked_lm is None:
         masked_lm, tokenize = _hf_masked_lm(model_name_or_path, max_length=max_length, temperature=temperature)
     if idf and tokenize is None:

@@ -728,7 +728,7 @@ class InfoLM(_SentenceStoreTextMetric):
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
         device=None,
-        max_length: int = 192,
+        max_length: Optional[int] = None,
         batch_size: int = 64,
         num_threads: int = 0,
         verbose: bool = True,
@@ -742,6 +742,9 @@ class InfoLM(_SentenceStoreTextMetric):
         pluggable ``masked_lm``/``tokenize`` callables."""
         _check_inert_knobs(verbose=verbose, device=device, batch_size=batch_size,
                            num_threads=num_threads)
+        # reference default None = "use the tokenizer's model max length"; resolved to this
+        # build's working cap before the masked-LM callables are built
+        max_length = 192 if max_length is None else max_length
         super().__init__(**kwargs)
         from torchmetrics_tpu.functional.text.infolm import _hf_masked_lm, _validate_measure
 

@@ -61,7 +61,16 @@ def binary_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Binary accuracy (reference ``accuracy.py:84``)."""
+    """Binary accuracy (reference ``accuracy.py:84``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_accuracy
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_accuracy(preds, target)):.4f}")
+        0.7500
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
@@ -81,7 +90,16 @@ def multiclass_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Multiclass accuracy (reference ``accuracy.py:153``)."""
+    """Multiclass accuracy (reference ``accuracy.py:153``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiclass_accuracy
+        >>> preds = np.array([0, 2, 1, 2])
+        >>> target = np.array([0, 1, 1, 2])
+        >>> print(f"{float(multiclass_accuracy(preds, target, num_classes=3, average='micro')):.4f}")
+        0.7500
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
@@ -101,7 +119,16 @@ def multilabel_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Multilabel accuracy (reference ``accuracy.py:233``)."""
+    """Multilabel accuracy (reference ``accuracy.py:233``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multilabel_accuracy
+        >>> preds = np.array([[0.9, 0.1], [0.2, 0.7]], np.float32)
+        >>> target = np.array([[1, 0], [0, 1]])
+        >>> print(f"{float(multilabel_accuracy(preds, target, num_labels=2)):.4f}")
+        1.0000
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)

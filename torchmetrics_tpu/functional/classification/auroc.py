@@ -113,7 +113,16 @@ def binary_auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Area under the ROC curve for binary tasks (reference ``auroc.py:112``)."""
+    """Area under the ROC curve for binary tasks (reference ``auroc.py:112``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_auroc
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> print(f"{float(binary_auroc(preds, target)):.4f}")
+        0.7500
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

@@ -83,7 +83,16 @@ def binary_average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """AP for binary tasks (reference ``average_precision.py:94``)."""
+    """AP for binary tasks (reference ``average_precision.py:94``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_average_precision
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> print(f"{float(binary_average_precision(preds, target)):.4f}")
+        0.8333
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

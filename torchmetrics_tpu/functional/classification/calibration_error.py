@@ -113,7 +113,16 @@ def binary_calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Top-label calibration error, binary (reference ``calibration_error.py:129``)."""
+    """Top-label calibration error, binary (reference ``calibration_error.py:129``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_calibration_error
+        >>> preds = np.array([0.25, 0.25, 0.55, 0.75, 0.75], np.float32)
+        >>> target = np.array([0, 0, 1, 1, 1])
+        >>> print(f"{float(binary_calibration_error(preds, target, n_bins=2)):.4f}")
+        0.2900
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

@@ -66,7 +66,16 @@ def multiclass_cohen_kappa(preds, target, num_classes: int, weights: Optional[st
 def cohen_kappa(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 weights: Optional[str] = None, ignore_index: Optional[int] = None,
                 validate_args: bool = True) -> Array:
-    """Task-dispatching Cohen's kappa (reference ``cohen_kappa.py:250``)."""
+    """Task-dispatching Cohen's kappa (reference ``cohen_kappa.py:250``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import cohen_kappa
+        >>> preds = np.array([0, 2, 1, 2])
+        >>> target = np.array([0, 1, 1, 2])
+        >>> print(f"{float(cohen_kappa(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.6364
+    """
     task = ClassificationTaskNoMultilabel.from_str(task)
     if task == ClassificationTaskNoMultilabel.BINARY:
         return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)

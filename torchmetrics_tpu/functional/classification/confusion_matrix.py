@@ -190,7 +190,18 @@ def multiclass_confusion_matrix(
     preds, target, num_classes: int, normalize: Optional[str] = None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
-    """(C, C) confusion matrix (reference ``confusion_matrix.py:286``)."""
+    """(C, C) confusion matrix (reference ``confusion_matrix.py:286``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiclass_confusion_matrix
+        >>> preds = np.array([0, 2, 1, 2])
+        >>> target = np.array([0, 1, 1, 2])
+        >>> print(np.asarray(multiclass_confusion_matrix(preds, target, num_classes=3)))
+        [[1 0 0]
+         [0 1 1]
+         [0 0 1]]
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)

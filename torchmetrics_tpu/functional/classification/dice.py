@@ -114,6 +114,14 @@ def dice(
     ``multiclass`` is the legacy type-override flag (reference ``utilities/checks.py:440-450``):
     ``False`` re-interprets 2-class data as binary (positive-class column), ``True`` keeps the
     multiclass treatment (which the one-hot kernel here already applies to binary labels).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import dice
+        >>> preds = np.array([0, 2, 1, 2])
+        >>> target = np.array([0, 1, 1, 2])
+        >>> print(f"{float(dice(preds, target)):.4f}")
+        0.7500
     """
     allowed = ("micro", "macro", "samples", "none", None)
     if average not in allowed:

@@ -37,7 +37,16 @@ def _multiclass_exact_match_update(
 
 def multiclass_exact_match(preds, target, num_classes: int, multidim_average: str = "global",
                            ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``exact_match.py:80``."""
+    """Reference ``exact_match.py:80``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiclass_exact_match
+        >>> preds = np.array([[0, 1], [1, 1]])
+        >>> target = np.array([[0, 1], [0, 1]])
+        >>> print(f"{float(multiclass_exact_match(preds, target, num_classes=2)):.4f}")
+        0.5000
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)

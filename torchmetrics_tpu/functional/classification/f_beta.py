@@ -74,14 +74,32 @@ def multilabel_fbeta_score(preds, target, beta: float, num_labels: int, threshol
 
 def binary_f1_score(preds, target, threshold: float = 0.5, multidim_average: str = "global",
                     ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``f_beta.py:337``."""
+    """Reference ``f_beta.py:337``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_f1_score
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_f1_score(preds, target)):.4f}")
+        0.8000
+    """
     return binary_fbeta_score(preds, target, 1.0, threshold, multidim_average, ignore_index, validate_args)
 
 
 def multiclass_f1_score(preds, target, num_classes: int, average: Optional[str] = "macro", top_k: int = 1,
                         multidim_average: str = "global", ignore_index: Optional[int] = None,
                         validate_args: bool = True) -> Array:
-    """Reference ``f_beta.py:403``."""
+    """Reference ``f_beta.py:403``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiclass_f1_score
+        >>> preds = np.array([0, 2, 1, 2])
+        >>> target = np.array([0, 1, 1, 2])
+        >>> print(f"{float(multiclass_f1_score(preds, target, num_classes=3, average='macro')):.4f}")
+        0.7778
+    """
     return multiclass_fbeta_score(preds, target, 1.0, num_classes, average, top_k, multidim_average,
                                   ignore_index, validate_args)
 

@@ -35,7 +35,16 @@ def _hamming_distance_reduce(
 
 def binary_hamming_distance(preds, target, threshold: float = 0.5, multidim_average: str = "global",
                             ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``hamming.py:78``."""
+    """Reference ``hamming.py:78``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_hamming_distance
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_hamming_distance(preds, target)):.4f}")
+        0.2500
+    """
     tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _hamming_distance_reduce(tp, fp, tn, fn, "binary", multidim_average)
 

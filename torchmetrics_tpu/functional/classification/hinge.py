@@ -73,7 +73,16 @@ def binary_hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Mean hinge loss for binary tasks (reference ``hinge.py:96``)."""
+    """Mean hinge loss for binary tasks (reference ``hinge.py:96``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_hinge_loss
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_hinge_loss(preds, target)):.4f}")
+        0.5000
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

@@ -58,7 +58,16 @@ def _jaccard_index_reduce(
 
 def binary_jaccard_index(preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None,
                          validate_args: bool = True) -> Array:
-    """Reference ``jaccard.py:97``."""
+    """Reference ``jaccard.py:97``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_jaccard_index
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_jaccard_index(preds, target)):.4f}")
+        0.6667
+    """
     confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
     return _jaccard_index_reduce(confmat, average="binary")
 

@@ -53,7 +53,16 @@ def _matthews_corrcoef_reduce(confmat: Array) -> Array:
 
 def binary_matthews_corrcoef(preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None,
                              validate_args: bool = True) -> Array:
-    """Reference ``matthews_corrcoef.py:82``."""
+    """Reference ``matthews_corrcoef.py:82``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_matthews_corrcoef
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_matthews_corrcoef(preds, target)):.4f}")
+        0.5774
+    """
     confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
     return _matthews_corrcoef_reduce(confmat)
 

@@ -38,7 +38,16 @@ def _precision_recall_reduce(
 
 def binary_precision(preds, target, threshold: float = 0.5, multidim_average: str = "global",
                      ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``precision_recall.py:79``."""
+    """Reference ``precision_recall.py:79``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_precision
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_precision(preds, target)):.4f}")
+        1.0000
+    """
     tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _precision_recall_reduce("precision", tp, fp, tn, fn, "binary", multidim_average)
 
@@ -63,7 +72,16 @@ def multilabel_precision(preds, target, num_labels: int, threshold: float = 0.5,
 
 def binary_recall(preds, target, threshold: float = 0.5, multidim_average: str = "global",
                   ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``precision_recall.py:316``."""
+    """Reference ``precision_recall.py:316``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_recall
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_recall(preds, target)):.4f}")
+        0.6667
+    """
     tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _precision_recall_reduce("recall", tp, fp, tn, fn, "binary", multidim_average)
 

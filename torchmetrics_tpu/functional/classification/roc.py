@@ -83,7 +83,19 @@ def binary_roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
-    """ROC curve for binary tasks (reference ``roc.py:92``)."""
+    """ROC curve for binary tasks (reference ``roc.py:92``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_roc
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> fpr, tpr, thresholds = binary_roc(preds, target, thresholds=5)
+        >>> print(np.asarray(fpr))
+        [0.  0.  0.  0.5 1. ]
+        >>> print(np.asarray(tpr))
+        [0.  0.5 0.5 1.  1. ]
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

@@ -29,7 +29,16 @@ def _specificity_reduce(
 
 def binary_specificity(preds, target, threshold: float = 0.5, multidim_average: str = "global",
                        ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Reference ``specificity.py:62``."""
+    """Reference ``specificity.py:62``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_specificity
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(f"{float(binary_specificity(preds, target)):.4f}")
+        1.0000
+    """
     tp, fp, tn, fn = binary_counts(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _specificity_reduce(tp, fp, tn, fn, "binary", multidim_average)
 

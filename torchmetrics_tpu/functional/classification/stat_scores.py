@@ -137,7 +137,16 @@ def binary_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Number of tp/fp/tn/fn for binary tasks (reference ``stat_scores.py:156``)."""
+    """Number of tp/fp/tn/fn for binary tasks (reference ``stat_scores.py:156``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_stat_scores
+        >>> preds = np.array([0.9, 0.1, 0.8, 0.4], np.float32)
+        >>> target = np.array([1, 0, 1, 1])
+        >>> print(np.asarray(binary_stat_scores(preds, target)))
+        [2 0 1 1 3]
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

@@ -57,13 +57,31 @@ def _mutual_info_from_contingency(contingency: Array) -> Array:
 
 
 def mutual_info_score(preds, target) -> Array:
-    """Mutual information between two clusterings (reference ``mutual_info_score.py:63``)."""
+    """Mutual information between two clusterings (reference ``mutual_info_score.py:63``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import mutual_info_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(mutual_info_score(preds, target)):.4f}")
+        0.7777
+    """
     check_cluster_labels(preds, target)
     return _mutual_info_from_contingency(calculate_contingency_matrix(preds, target))
 
 
 def rand_score(preds, target) -> Array:
-    """Rand score (reference ``rand_score.py:62``)."""
+    """Rand score (reference ``rand_score.py:62``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import rand_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(rand_score(preds, target)):.4f}")
+        0.8000
+    """
     check_cluster_labels(preds, target)
     contingency = calculate_contingency_matrix(preds, target)
     pair = calculate_pair_cluster_confusion_matrix(contingency=contingency)
@@ -75,7 +93,16 @@ def rand_score(preds, target) -> Array:
 
 
 def adjusted_rand_score(preds, target) -> Array:
-    """Adjusted Rand score (reference ``adjusted_rand_score.py:55``)."""
+    """Adjusted Rand score (reference ``adjusted_rand_score.py:55``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import adjusted_rand_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(adjusted_rand_score(preds, target)):.4f}")
+        0.3750
+    """
     check_cluster_labels(preds, target)
     contingency = calculate_contingency_matrix(preds, target)
     pair = calculate_pair_cluster_confusion_matrix(contingency=contingency)
@@ -139,7 +166,16 @@ def expected_mutual_info_score(contingency: Array, n_samples: int) -> Array:
 def adjusted_mutual_info_score(
     preds, target, average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic"
 ) -> Array:
-    """Adjusted mutual information (reference ``adjusted_mutual_info_score.py:27``)."""
+    """Adjusted mutual information (reference ``adjusted_mutual_info_score.py:27``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import adjusted_mutual_info_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(adjusted_mutual_info_score(preds, target)):.4f}")
+        0.3750
+    """
     _validate_average_method_arg(average_method)
     check_cluster_labels(preds, target)
     contingency = calculate_contingency_matrix(preds, target)
@@ -161,7 +197,16 @@ def adjusted_mutual_info_score(
 def normalized_mutual_info_score(
     preds, target, average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic"
 ) -> Array:
-    """Normalized mutual information (reference ``normalized_mutual_info_score.py:28``)."""
+    """Normalized mutual information (reference ``normalized_mutual_info_score.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import normalized_mutual_info_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(normalized_mutual_info_score(preds, target)):.4f}")
+        0.7372
+    """
     check_cluster_labels(preds, target)
     _validate_average_method_arg(average_method)
     contingency = calculate_contingency_matrix(preds, target)
@@ -178,7 +223,16 @@ def normalized_mutual_info_score(
 
 
 def fowlkes_mallows_index(preds, target) -> Array:
-    """Fowlkes-Mallows index (reference ``fowlkes_mallows_index.py:58``)."""
+    """Fowlkes-Mallows index (reference ``fowlkes_mallows_index.py:58``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import fowlkes_mallows_index
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(fowlkes_mallows_index(preds, target)):.4f}")
+        0.5000
+    """
     check_cluster_labels(preds, target)
     contingency = calculate_contingency_matrix(preds, target)
     n = jnp.shape(preds)[0]
@@ -203,18 +257,45 @@ def _homogeneity_score_compute(preds, target):
 
 
 def homogeneity_score(preds, target) -> Array:
-    """Homogeneity (reference ``homogeneity_completeness_v_measure.py:46``)."""
+    """Homogeneity (reference ``homogeneity_completeness_v_measure.py:46``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import homogeneity_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(homogeneity_score(preds, target)):.4f}")
+        0.7372
+    """
     return _homogeneity_score_compute(preds, target)[0]
 
 
 def completeness_score(preds, target) -> Array:
-    """Completeness (reference ``homogeneity_completeness_v_measure.py:69``)."""
+    """Completeness (reference ``homogeneity_completeness_v_measure.py:69``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import completeness_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(completeness_score(preds, target)):.4f}")
+        0.7372
+    """
     _, mutual_info, entropy_preds, _ = _homogeneity_score_compute(preds, target)
     return jnp.where(entropy_preds > 0, mutual_info / jnp.maximum(entropy_preds, 1e-38), 1.0)
 
 
 def v_measure_score(preds, target, beta: Union[int, float] = 1.0) -> Array:
-    """V-measure (reference ``homogeneity_completeness_v_measure.py:92``)."""
+    """V-measure (reference ``homogeneity_completeness_v_measure.py:92``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import v_measure_score
+        >>> preds = np.array([0, 0, 1, 1, 2])
+        >>> target = np.array([0, 0, 1, 2, 2])
+        >>> print(f"{float(v_measure_score(preds, target)):.4f}")
+        0.7372
+    """
     homogeneity, mutual_info, entropy_preds, entropy_target = _homogeneity_score_compute(preds, target)
     completeness = jnp.where(entropy_preds > 0, mutual_info / jnp.maximum(entropy_preds, 1e-38), 1.0)
     numerator = (1 + beta) * homogeneity * completeness

@@ -53,7 +53,16 @@ def peak_signal_noise_ratio(
     reduction: Optional[str] = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """PSNR (reference ``psnr.py:91-155``)."""
+    """PSNR (reference ``psnr.py:91-155``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import peak_signal_noise_ratio
+        >>> preds = np.array([[0.0, 1.0], [2.0, 3.0]], np.float32)
+        >>> target = np.array([[3.0, 2.0], [1.0, 0.0]], np.float32)
+        >>> print(f"{float(peak_signal_noise_ratio(preds, target, data_range=3.0)):.2f}")
+        2.55
+    """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
     preds = jnp.asarray(preds, jnp.float32)

@@ -42,6 +42,16 @@ def _sam_compute(
 def spectral_angle_mapper(
     preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
 ) -> Array:
-    """SAM (reference ``sam.py:84-125``)."""
+    """SAM (reference ``sam.py:84-125``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import spectral_angle_mapper
+        >>> rng = np.random.RandomState(0)
+        >>> preds = rng.rand(1, 3, 8, 8).astype(np.float32)
+        >>> target = rng.rand(1, 3, 8, 8).astype(np.float32)
+        >>> print(f"{float(spectral_angle_mapper(preds, target)):.4f}")
+        0.6032
+    """
     preds, target = _sam_check_inputs(preds, target)
     return _sam_compute(preds, target, reduction)

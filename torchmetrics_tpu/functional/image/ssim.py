@@ -161,7 +161,16 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ):
-    """SSIM (reference ``ssim.py:208-290``)."""
+    """SSIM (reference ``ssim.py:208-290``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import structural_similarity_index_measure
+        >>> rng = np.random.RandomState(0)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> print(f"{float(structural_similarity_index_measure(preds, preds, data_range=1.0)):.4f}")
+        1.0000
+    """
     preds, target = _ssim_check_inputs(preds, target)
     pack = _ssim_update(
         preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,

@@ -32,6 +32,14 @@ def _total_variation_compute(
 
 
 def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
-    """Total variation (reference ``tv.py:48-87``)."""
+    """Total variation (reference ``tv.py:48-87``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import total_variation
+        >>> x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        >>> print(f"{float(total_variation(x)):.1f}")
+        60.0
+    """
     score, num_elements = _total_variation_update(jnp.asarray(img))
     return _total_variation_compute(score, num_elements, reduction)

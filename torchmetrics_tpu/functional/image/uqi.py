@@ -91,6 +91,15 @@ def universal_image_quality_index(
     sigma: Sequence[float] = (1.5, 1.5),
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """UQI (reference ``uqi.py:120-177``)."""
+    """UQI (reference ``uqi.py:120-177``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import universal_image_quality_index
+        >>> rng = np.random.RandomState(0)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> print(f"{float(universal_image_quality_index(preds, preds)):.4f}")
+        1.0000
+    """
     preds, target = _uqi_check_inputs(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -56,7 +56,16 @@ def cramers_v(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Cramer's V statistic between two categorical series (reference ``cramers.py:88``)."""
+    """Cramer's V statistic between two categorical series (reference ``cramers.py:88``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import cramers_v
+        >>> preds = np.array([0, 1, 1, 2, 2, 2])
+        >>> target = np.array([0, 1, 1, 2, 1, 2])
+        >>> print(f"{float(cramers_v(preds, target)):.4f}")
+        0.7328
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target

@@ -35,7 +35,16 @@ def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
 def pearsons_contingency_coefficient(
     preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Pearson's contingency coefficient (reference ``pearson.py:75``)."""
+    """Pearson's contingency coefficient (reference ``pearson.py:75``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pearsons_contingency_coefficient
+        >>> preds = np.array([0, 1, 1, 2, 2, 2])
+        >>> target = np.array([0, 1, 1, 2, 1, 2])
+        >>> print(f"{float(pearsons_contingency_coefficient(preds, target)):.4f}")
+        0.7687
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target

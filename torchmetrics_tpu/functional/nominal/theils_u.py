@@ -49,7 +49,16 @@ def _theils_u_compute(confmat: Array) -> Array:
 def theils_u(
     preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Theil's U of preds given target — asymmetric (reference ``theils_u.py:107``)."""
+    """Theil's U of preds given target — asymmetric (reference ``theils_u.py:107``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import theils_u
+        >>> preds = np.array([0, 1, 1, 2, 2, 2])
+        >>> target = np.array([0, 1, 1, 2, 1, 2])
+        >>> print(f"{float(theils_u(preds, target)):.4f}")
+        0.6853
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target

@@ -58,7 +58,16 @@ def tschuprows_t(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Tschuprow's T statistic (reference ``tschuprows.py:90``)."""
+    """Tschuprow's T statistic (reference ``tschuprows.py:90``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import tschuprows_t
+        >>> preds = np.array([0, 1, 1, 2, 2, 2])
+        >>> target = np.array([0, 1, 1, 2, 1, 2])
+        >>> print(f"{float(tschuprows_t(preds, target)):.4f}")
+        0.7328
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds = jnp.argmax(jnp.asarray(preds), axis=1) if jnp.ndim(preds) == 2 else preds
     target = jnp.argmax(jnp.asarray(target), axis=1) if jnp.ndim(target) == 2 else target

@@ -45,7 +45,16 @@ def pairwise_cosine_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise cosine similarity ``<x,y> / (||x||·||y||)`` (reference ``cosine.py:48``)."""
+    """Pairwise cosine similarity ``<x,y> / (||x||·||y||)`` (reference ``cosine.py:48``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+        >>> print(np.round(np.asarray(pairwise_cosine_similarity(x)), 4))
+        [[0. 0.]
+         [0. 0.]]
+    """
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 
@@ -74,7 +83,16 @@ def pairwise_euclidean_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise euclidean distance matrix (reference ``euclidean.py:47``)."""
+    """Pairwise euclidean distance matrix (reference ``euclidean.py:47``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pairwise_euclidean_distance
+        >>> x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+        >>> print(np.round(np.asarray(pairwise_euclidean_distance(x)), 4))
+        [[0.     1.4142]
+         [1.4142 0.    ]]
+    """
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 
@@ -94,7 +112,16 @@ def pairwise_linear_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise linear (dot-product) similarity (reference ``linear.py:42``)."""
+    """Pairwise linear (dot-product) similarity (reference ``linear.py:42``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pairwise_linear_similarity
+        >>> x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+        >>> print(np.round(np.asarray(pairwise_linear_similarity(x)), 4))
+        [[0. 0.]
+         [0. 0.]]
+    """
     distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 
@@ -114,7 +141,16 @@ def pairwise_manhattan_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise manhattan (L1) distance (reference ``manhattan.py:41``)."""
+    """Pairwise manhattan (L1) distance (reference ``manhattan.py:41``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pairwise_manhattan_distance
+        >>> x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+        >>> print(np.round(np.asarray(pairwise_manhattan_distance(x)), 4))
+        [[0. 2.]
+         [2. 0.]]
+    """
     distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 

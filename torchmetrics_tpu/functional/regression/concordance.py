@@ -23,7 +23,16 @@ def _concordance_corrcoef_compute(
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:
-    """Concordance correlation coefficient (reference ``concordance.py:58``)."""
+    """Concordance correlation coefficient (reference ``concordance.py:58``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import concordance_corrcoef
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(concordance_corrcoef(preds, target)):.4f}")
+        0.9729
+    """
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
     d = preds.shape[1] if preds.ndim == 2 else 1

@@ -32,7 +32,16 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Cosine similarity (reference ``cosine_similarity.py:62``)."""
+    """Cosine similarity (reference ``cosine_similarity.py:62``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import cosine_similarity
+        >>> preds = np.array([[1.0, 0.0], [1.0, 1.0]], np.float32)
+        >>> target = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+        >>> print(f"{float(cosine_similarity(preds, target, reduction='mean')):.4f}")
+        0.8536
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     preds, target = _cosine_similarity_update(preds, target)

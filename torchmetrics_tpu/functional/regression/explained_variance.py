@@ -66,7 +66,16 @@ def _explained_variance_compute(
 def explained_variance(
     preds: Array, target: Array, multioutput: str = "uniform_average"
 ) -> Array:
-    """Explained variance (reference ``explained_variance.py:84``)."""
+    """Explained variance (reference ``explained_variance.py:84``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import explained_variance
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(explained_variance(preds, target)):.4f}")
+        0.9461
+    """
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Invalid input to argument `multioutput`. Choose one of {ALLOWED_MULTIOUTPUT}")
     preds = jnp.asarray(preds)

@@ -105,7 +105,16 @@ def kendall_rank_corrcoef(
     t_test: bool = False,
     alternative: Optional[str] = "two-sided",
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Kendall rank correlation (reference ``kendall.py:270``)."""
+    """Kendall rank correlation (reference ``kendall.py:270``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import kendall_rank_corrcoef
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(kendall_rank_corrcoef(preds, target)):.4f}")
+        1.0000
+    """
     if variant not in _ALLOWED_VARIANTS:
         raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant}")
     if not isinstance(t_test, bool):

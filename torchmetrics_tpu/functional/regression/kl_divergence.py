@@ -38,7 +38,16 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 def kl_divergence(
     p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean"
 ) -> Array:
-    """KL(P||Q) (reference ``kl_divergence.py:58``)."""
+    """KL(P||Q) (reference ``kl_divergence.py:58``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import kl_divergence
+        >>> p = np.array([[0.5, 0.5], [0.8, 0.2]], np.float32)
+        >>> q = np.array([[0.4, 0.6], [0.6, 0.4]], np.float32)
+        >>> print(f"{float(kl_divergence(p, q)):.4f}")
+        0.0560
+    """
     p = jnp.asarray(p)
     q = jnp.asarray(q)
     measures, total = _kld_update(p, q, log_prob)

@@ -30,7 +30,16 @@ def _log_cosh_error_compute(sum_log_cosh_error: Array, total: Array) -> Array:
 
 
 def log_cosh_error(preds: Array, target: Array) -> Array:
-    """LogCosh error (reference ``log_cosh.py:53``)."""
+    """LogCosh error (reference ``log_cosh.py:53``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import log_cosh_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(log_cosh_error(preds, target)):.4f}")
+        0.1685
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[1]

@@ -18,7 +18,16 @@ def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, 
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """MSLE (reference ``log_mse.py:47``)."""
+    """MSLE (reference ``log_mse.py:47``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import mean_squared_log_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(mean_squared_log_error(preds, target)):.4f}")
+        0.0286
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     s, n = _mean_squared_log_error_update(preds, target)

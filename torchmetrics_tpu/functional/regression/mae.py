@@ -22,7 +22,16 @@ def _mean_absolute_error_compute(sum_abs_error: Array, total: Array) -> Array:
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """MAE (reference ``mae.py:46``)."""
+    """MAE (reference ``mae.py:46``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import mean_absolute_error
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(mean_absolute_error(preds, target)):.4f}")
+        0.5000
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     sum_abs_error, total = _mean_absolute_error_update(preds, target)

@@ -27,7 +27,16 @@ def _mean_abs_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array)
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """MAPE (reference ``mape.py:54``)."""
+    """MAPE (reference ``mape.py:54``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import mean_absolute_percentage_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(mean_absolute_percentage_error(preds, target)):.4f}")
+        0.3274
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     s, n = _mean_abs_percentage_error_update(preds, target)
@@ -45,7 +54,16 @@ def _symmetric_mape_update(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """SMAPE (reference ``symmetric_mape.py:51``)."""
+    """SMAPE (reference ``symmetric_mape.py:51``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(symmetric_mean_absolute_percentage_error(preds, target)):.4f}")
+        0.2455
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     s, n = _symmetric_mape_update(preds, target)
@@ -66,7 +84,16 @@ def _weighted_mape_compute(
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """WMAPE (reference ``wmape.py:50``)."""
+    """WMAPE (reference ``wmape.py:50``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(weighted_mean_absolute_percentage_error(preds, target)):.4f}")
+        0.1600
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     s, scale = _weighted_mape_update(preds, target)

@@ -22,7 +22,16 @@ def _minkowski_distance_compute(distance: Array, p: float) -> Array:
 
 def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
     """Minkowski distance (reference ``minkowski.py:44`` — which names the second argument
-    ``targets``, unlike the rest of the API)."""
+    ``targets``, unlike the rest of the API).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import minkowski_distance
+        >>> preds = np.array([1.0, 2.0, 3.0], np.float32)
+        >>> targets = np.array([1.5, 2.5, 4.0], np.float32)
+        >>> print(f"{float(minkowski_distance(preds, targets, p=3)):.4f}")
+        1.0772
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(targets)
     distance = _minkowski_distance_update(preds, target, p)

@@ -122,7 +122,16 @@ def _final_aggregation(
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation coefficient (reference ``pearson.py:141``)."""
+    """Pearson correlation coefficient (reference ``pearson.py:141``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pearson_corrcoef
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(pearson_corrcoef(preds, target)):.4f}")
+        0.9838
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     d = preds.shape[1] if preds.ndim == 2 else 1

@@ -79,7 +79,16 @@ def r2_score(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """R² score (reference ``r2.py:99``)."""
+    """R² score (reference ``r2.py:99``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import r2_score
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(r2_score(preds, target)):.4f}")
+        0.9353
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     return _r2_score_compute(*_r2_score_update(preds, target), adjusted, multioutput)

@@ -28,7 +28,16 @@ def _relative_squared_error_compute(
 
 
 def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
-    """Relative squared error (reference ``rse.py:49``)."""
+    """Relative squared error (reference ``rse.py:49``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import relative_squared_error
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(relative_squared_error(preds, target)):.4f}")
+        0.0647
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)

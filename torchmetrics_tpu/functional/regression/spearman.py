@@ -46,7 +46,16 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-0
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman rank correlation (reference ``spearman.py:80``)."""
+    """Spearman rank correlation (reference ``spearman.py:80``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import spearman_corrcoef
+        >>> preds = np.array([2.5, 1.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.5, 2.0, 7.0], np.float32)
+        >>> print(f"{float(spearman_corrcoef(preds, target)):.4f}")
+        1.0000
+    """
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[1]

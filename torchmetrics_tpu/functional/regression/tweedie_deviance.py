@@ -66,7 +66,16 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
     """Tweedie deviance score (reference ``tweedie_deviance.py:100`` — which names the second
-    argument ``targets``, unlike the rest of the API)."""
+    argument ``targets``, unlike the rest of the API).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import tweedie_deviance_score
+        >>> preds = np.array([1.0, 2.0, 3.0], np.float32)
+        >>> targets = np.array([1.5, 2.5, 4.0], np.float32)
+        >>> print(f"{float(tweedie_deviance_score(preds, targets, power=1.5)):.4f}")
+        0.1489
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(targets)
     s, n = _tweedie_deviance_score_update(preds, target, power)

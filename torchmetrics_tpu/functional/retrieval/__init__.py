@@ -33,14 +33,32 @@ def _check_top_k(top_k: Optional[int]) -> None:
 
 
 def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """AP for a single query (reference ``functional/retrieval/average_precision.py``)."""
+    """AP for a single query (reference ``functional/retrieval/average_precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_average_precision
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_average_precision(preds, target)):.4f}")
+        0.8333
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target)
     return average_precision_kernel(preds, target, mask, top_k)
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """Reciprocal rank for a single query (reference ``reciprocal_rank.py``)."""
+    """Reciprocal rank for a single query (reference ``reciprocal_rank.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_reciprocal_rank(preds, target)):.4f}")
+        1.0000
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target)
     return reciprocal_rank_kernel(preds, target, mask, top_k)
@@ -49,7 +67,16 @@ def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] 
 def retrieval_precision(
     preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Array:
-    """precision@k for a single query (reference ``precision.py``)."""
+    """precision@k for a single query (reference ``precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_precision
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_precision(preds, target, top_k=2)):.4f}")
+        0.5000
+    """
     _check_top_k(top_k)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
@@ -58,34 +85,79 @@ def retrieval_precision(
 
 
 def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """recall@k for a single query (reference ``recall.py``)."""
+    """recall@k for a single query (reference ``recall.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_recall
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_recall(preds, target, top_k=2)):.4f}")
+        0.5000
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target)
     return recall_kernel(preds, target, mask, top_k)
 
 
 def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """fall-out@k for a single query (reference ``fall_out.py``)."""
+    """fall-out@k for a single query (reference ``fall_out.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_fall_out
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_fall_out(preds, target)):.4f}")
+        1.0000
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target)
     return fall_out_kernel(preds, target, mask, top_k)
 
 
 def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """hit-rate@k for a single query (reference ``hit_rate.py``)."""
+    """hit-rate@k for a single query (reference ``hit_rate.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_hit_rate
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_hit_rate(preds, target)):.4f}")
+        1.0000
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target)
     return hit_rate_kernel(preds, target, mask, top_k)
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
-    """R-precision for a single query (reference ``r_precision.py``)."""
+    """R-precision for a single query (reference ``r_precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_r_precision
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_r_precision(preds, target)):.4f}")
+        0.5000
+    """
     preds, target, mask = _prep(preds, target)
     return r_precision_kernel(preds, target, mask)
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """NDCG@k for a single query, graded relevance allowed (reference ``ndcg.py``)."""
+    """NDCG@k for a single query, graded relevance allowed (reference ``ndcg.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([True, False, True])
+        >>> print(f"{float(retrieval_normalized_dcg(preds, target)):.4f}")
+        0.9197
+    """
     _check_top_k(top_k)
     preds, target, mask = _prep(preds, target, graded=True)
     return ndcg_kernel(preds, target, mask, top_k)

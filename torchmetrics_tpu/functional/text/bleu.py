@@ -100,7 +100,15 @@ def bleu_score(
     smooth: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """BLEU score of translated text vs one or more references (reference ``bleu.py:149``)."""
+    """BLEU score of translated text vs one or more references (reference ``bleu.py:149``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> print(f"{float(bleu_score(preds, target)):.4f}")
+        0.0000
+    """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds_) != len(target_):

@@ -197,7 +197,15 @@ def chrf_score(
     whitespace: bool = False,
     return_sentence_level_score: bool = False,
 ):
-    """chrF/chrF++ score (reference ``chrf.py:536``). ``n_word_order=2`` gives chrF++, 0 gives chrF."""
+    """chrF/chrF++ score (reference ``chrf.py:536``). ``n_word_order=2`` gives chrF++, 0 gives chrF.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import chrf_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> print(f"{float(chrf_score(preds, target)):.4f}")
+        0.4942
+    """
     _validate_chrf_args(n_char_order, n_word_order, beta)
     n_order = float(n_char_order + n_word_order)
     totals = {

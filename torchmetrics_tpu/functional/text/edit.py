@@ -55,6 +55,12 @@ def edit_distance(
     substitution_cost: int = 1,
     reduction: Optional[str] = "mean",
 ) -> Array:
-    """Levenshtein edit distance (reference ``edit.py:80``)."""
+    """Levenshtein edit distance (reference ``edit.py:80``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import edit_distance
+        >>> print(f"{float(edit_distance(['kitten'], ['sitting'])):.4f}")
+        3.0000
+    """
     distance = _edit_distance_update(preds, target, substitution_cost)
     return _edit_distance_compute(distance, num_elements=distance.size, reduction=reduction)

@@ -60,7 +60,16 @@ def _perplexity_compute(total: Array, count: Array) -> Array:
 
 
 def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
-    """Perplexity of a language-model output (reference ``perplexity.py:109``)."""
+    """Perplexity of a language-model output (reference ``perplexity.py:109``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import perplexity
+        >>> logits = np.log(np.array([[[0.6, 0.4], [0.3, 0.7]]], np.float32))
+        >>> target = np.array([[0, 1]])
+        >>> print(f"{float(perplexity(logits, target)):.3f}")
+        1.543
+    """
     _check_shape_and_type_consistency(preds, target)
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

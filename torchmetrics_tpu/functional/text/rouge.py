@@ -232,7 +232,14 @@ def rouge_score(
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ):
-    """ROUGE-N / ROUGE-L / ROUGE-LSum (reference ``rouge.py:421-524``)."""
+    """ROUGE-N / ROUGE-L / ROUGE-LSum (reference ``rouge.py:421-524``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import rouge_score
+        >>> score = rouge_score('the cat sat', 'the cat sat down', rouge_keys='rouge1')
+        >>> print(f"{float(score['rouge1_fmeasure']):.4f}")
+        0.8571
+    """
     import jax.numpy as jnp
 
     if not isinstance(rouge_keys, tuple):

@@ -120,7 +120,16 @@ def _squad_compute(f1: Array, exact_match: Array, total: Array) -> Dict[str, Arr
 
 
 def squad(preds, target) -> Dict[str, Array]:
-    """SQuAD EM/F1 (reference ``squad.py:195``)."""
+    """SQuAD EM/F1 (reference ``squad.py:195``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import squad
+        >>> preds = [{'prediction_text': 'the cat', 'id': '1'}]
+        >>> target = [{'answers': {'answer_start': [0], 'text': ['the cat']}, 'id': '1'}]
+        >>> out = squad(preds, target)
+        >>> print(f"{float(out['exact_match']):.1f} {float(out['f1']):.1f}")
+        100.0 100.0
+    """
     preds_dict, target_dict = _squad_input_check(preds, target)
     f1, exact_match, total = _squad_update(preds_dict, target_dict)
     return _squad_compute(f1, exact_match, total)

@@ -339,7 +339,13 @@ def translation_edit_rate(
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
 ):
-    """TER (reference ``ter.py:534-600``)."""
+    """TER (reference ``ter.py:534-600``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import translation_edit_rate
+        >>> print(f"{float(translation_edit_rate(['the cat is on the mat'], [['there is a cat on the mat']])):.4f}")
+        0.4286
+    """
     for name, val in (
         ("normalize", normalize), ("no_punctuation", no_punctuation),
         ("lowercase", lowercase), ("asian_support", asian_support),

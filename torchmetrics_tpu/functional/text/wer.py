@@ -32,7 +32,13 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 
 
 def word_error_rate(preds, target) -> Array:
-    """Word error rate (reference ``wer.py:66``)."""
+    """Word error rate (reference ``wer.py:66``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_error_rate
+        >>> print(f"{float(word_error_rate(['the cat sat'], ['the cat sat down'])):.4f}")
+        0.2500
+    """
     return _wer_compute(*_wer_update(preds, target))
 
 
@@ -50,7 +56,13 @@ def _cer_compute(errors: Array, total: Array) -> Array:
 
 
 def char_error_rate(preds, target) -> Array:
-    """Character error rate (reference ``cer.py:66``)."""
+    """Character error rate (reference ``cer.py:66``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import char_error_rate
+        >>> print(f"{float(char_error_rate(['abcd'], ['abce'])):.4f}")
+        0.2500
+    """
     return _cer_compute(*_cer_update(preds, target))
 
 
@@ -68,7 +80,13 @@ def _mer_compute(errors: Array, total: Array) -> Array:
 
 
 def match_error_rate(preds, target) -> Array:
-    """Match error rate (reference ``mer.py:69``)."""
+    """Match error rate (reference ``mer.py:69``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import match_error_rate
+        >>> print(f"{float(match_error_rate(['the cat sat'], ['the cat sat down'])):.4f}")
+        0.2500
+    """
     return _mer_compute(*_mer_update(preds, target))
 
 
@@ -91,7 +109,13 @@ def _word_info_lost_compute(errors: Array, target_total: Array, preds_total: Arr
 
 
 def word_information_lost(preds, target) -> Array:
-    """Word information lost (reference ``wil.py:70``)."""
+    """Word information lost (reference ``wil.py:70``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_information_lost
+        >>> print(f"{float(word_information_lost(['the cat sat'], ['the cat sat down'])):.4f}")
+        0.2500
+    """
     return _word_info_lost_compute(*_word_info_update(preds, target))
 
 
@@ -101,5 +125,11 @@ def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 
 def word_information_preserved(preds, target) -> Array:
-    """Word information preserved (reference ``wip.py:68``)."""
+    """Word information preserved (reference ``wip.py:68``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_information_preserved
+        >>> print(f"{float(word_information_preserved(['the cat sat'], ['the cat sat down'])):.4f}")
+        0.7500
+    """
     return _wip_compute(*_word_info_update(preds, target))

@@ -1,0 +1,35 @@
+"""Shared platform bootstrap for the examples.
+
+In this environment the experimental ``axon`` TPU plugin can wedge JAX backend init
+indefinitely (default discovery AND env-var selection both hang); only
+``jax.config.update("jax_platforms", ...)`` with a healthy platform is safe. Every example
+therefore calls :func:`pin_platform` before touching any jax API. The probe logic lives in
+``torchmetrics_tpu.utils.platform`` (shared with ``bench.py`` and the dryrun).
+
+Selection: the ``JAX_PLATFORMS`` env var if set, else ``cpu``. A non-CPU request is first
+probed in a short-timeout subprocess — if that platform's backend doesn't come up in time
+(dead tunnel), the example falls back to CPU with a note instead of hanging. The examples
+demonstrate the API; ``bench.py`` is where TPU throughput is measured.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+
+
+def pin_platform(probe_timeout_s: float = 25.0) -> None:
+    from torchmetrics_tpu.utils.platform import platform_responds, requested_platform
+
+    want = requested_platform(default="cpu")
+    if want != "cpu" and not platform_responds(want, probe_timeout_s):
+        print(
+            f"[examples] platform {want!r} did not initialise within {probe_timeout_s:.0f}s"
+            " — falling back to cpu",
+            file=sys.stderr,
+        )
+        want = "cpu"
+    import jax
+
+    # a site plugin may import jax before this script runs, caching the env-var platform
+    # choice at import time — the config API overrides it while the backend is still down
+    jax.config.update("jax_platforms", want)

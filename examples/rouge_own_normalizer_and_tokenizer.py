@@ -8,6 +8,10 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _env import pin_platform
+
+pin_platform()  # config-API platform pin — must precede any jax backend init (see _env.py)
 
 import re
 

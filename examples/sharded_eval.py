@@ -10,16 +10,15 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # run from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _env import pin_platform
 
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 
-import jax
+pin_platform()  # config-API platform pin — must precede any jax backend init (see _env.py)
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # a site plugin may import jax before this script runs, caching the platform choice —
-    # re-assert it through the config API (the backend itself is still uninitialised)
-    jax.config.update("jax_platforms", "cpu")
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -170,13 +170,15 @@ class TestBertScoreOptions:
     """return_hash / all_layers / own_model hooks (reference ``bert.py:95-115,170-172,389-390``)."""
 
     def test_return_hash(self):
+        # a caller-supplied encoder has no resolved checkpoint name; the hash says so
+        # instead of misreporting "None" as a model name
         out = bert_score(["a b"], ["a c"], encoder=fake_encoder, return_hash=True)
-        assert out["hash"] == "None_LNone_no-idf"
+        assert out["hash"] == "custom-encoder_LNone_no-idf"
         out2 = bert_score(
             ["a b"], ["a c"], encoder=fake_encoder, tokenize=fake_tokenize,
             num_layers=7, idf=True, return_hash=True,
         )
-        assert out2["hash"] == "None_L7_idf"
+        assert out2["hash"] == "custom-encoder_L7_idf"
 
     def test_all_layers_rejected_with_custom_encoder(self):
         with pytest.raises(ValueError, match="only with default `transformers` models"):

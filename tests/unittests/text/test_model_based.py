@@ -113,18 +113,27 @@ class TestInfoLM:
         np.testing.assert_allclose(float(res), 0.0, atol=5e-3 if measure == "fisher_rao_distance" else 1e-4)
 
     @pytest.mark.parametrize("measure,alpha,beta", KL_MEASURES)
-    def test_different_is_positive(self, measure, alpha, beta):
+    def test_different_is_nonzero(self, measure, alpha, beta):
+        """Differing sentences give |score| >> 0; the SIGN follows the reference's
+        conventions (kl is Σ q·log(p/q) = -KL ≤ 0; alpha's denominator α(α-1) < 0 on (0,1))
+        — pinned exactly in test_tiny_model_cross_parity.py against the reference package."""
         res = infolm(
             ["aa bb cc"], ["dd ee ff"], masked_lm=fake_masked_lm, idf=False,
             information_measure=measure, alpha=alpha, beta=beta,
         )
-        assert float(res) > 1e-4
+        value = float(res)
+        assert abs(value) > 1e-4
+        if measure in ("kl_divergence", "alpha_divergence"):
+            assert value < 0  # reference sign quirks
+        else:
+            assert value > 0
 
     def test_kl_hand_computed(self):
         p = np.asarray([[0.7, 0.2, 0.1]])
         q = np.asarray([[0.5, 0.3, 0.2]])
         res = _information_measure(jnp.asarray(p), jnp.asarray(q), "kl_divergence", None, None)
-        expected = np.sum(p * (np.log(p) - np.log(q)))
+        # the reference's convention: Σ q·log(p/q) (reference infolm.py:145-158)
+        expected = np.sum(q * (np.log(p) - np.log(q)))
         np.testing.assert_allclose(np.asarray(res), [expected], atol=1e-6)
 
     def test_sentence_level(self):
@@ -132,7 +141,8 @@ class TestInfoLM:
             ["a b", "c d"], ["a b", "x y"], masked_lm=fake_masked_lm, idf=False, return_sentence_level_score=True
         )
         assert sent.shape == (2,)
-        assert float(sent[0]) < float(sent[1])
+        # default kl is the reference's -KL: identical pair ~0, differing pair more NEGATIVE
+        assert abs(float(sent[0])) < abs(float(sent[1]))
 
     def test_validation(self):
         with pytest.raises(ValueError, match="information_measure"):

@@ -151,8 +151,13 @@ def bert_score(
     own_model = reference_kwargs.get("own_model")
     user_tokenizer = reference_kwargs.get("user_tokenizer")
     user_forward_fn = reference_kwargs.get("user_forward_fn")
-    if all_layers and (encoder is not None or user_forward_fn is not None):
-        # reference functional/text/bert.py:108-110
+    if all_layers and (
+        (encoder is not None and not getattr(encoder, "layer_stacked", False))
+        or user_forward_fn is not None
+    ):
+        # reference functional/text/bert.py:108-110; an encoder built by
+        # utils.pretrained.bert_encoder(all_layers=True) is tagged `layer_stacked` and already
+        # returns the (N, Λ, L, D) stack, so it composes (lets BERTScore cache it in __init__)
         raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
     if encoder is not None and (own_model is not None or user_tokenizer is not None or user_forward_fn is not None):
         raise ValueError(
@@ -267,5 +272,8 @@ def bert_score(
                 "f1": (out["f1"] - rows[2]) / (1 - rows[2]),
             }
     if return_hash:  # reference bert.py:389-390 / _get_hash at :170-172
-        out["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+        # a caller-supplied encoder has no resolved checkpoint name; "None_L..." would
+        # misreport which model produced the scores
+        name = model_name_or_path if model_name_or_path is not None else "custom-encoder"
+        out["hash"] = f"{name}_L{num_layers}{'_idf' if idf else '_no-idf'}"
     return out

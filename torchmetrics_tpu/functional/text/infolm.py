@@ -65,31 +65,40 @@ def _validate_measure(information_measure: str, alpha: Optional[float], beta: Op
 def _information_measure(
     p: Array, q: Array, information_measure: str, alpha: Optional[float], beta: Optional[float]
 ) -> Array:
-    """Per-position divergence between distributions ``p`` and ``q`` over the vocab axis."""
-    p = jnp.clip(jnp.asarray(p, jnp.float32), _EPS)
-    q = jnp.clip(jnp.asarray(q, jnp.float32), _EPS)
+    """Per-position divergence over the vocab axis, ``p`` = preds bag, ``q`` = target bag.
+
+    Reference conventions reproduced exactly (``infolm.py:145-245``), verified term-by-term
+    against the reference package with a shared tiny masked-LM (the asymmetric placements
+    below are invisible at symmetric parameter points like α=β, so the oracle sweep uses
+    α≠β): kl is the sign-flipped Σ q·log(p/q); ab splits its first two log-terms as
+    (target, preds) in that order; beta is ab with α forced to 1; renyi weights q^α·p^(1-α).
+    No epsilon clipping — the reference feeds raw softmax outputs (strictly positive), and a
+    clip floor measurably perturbs the ill-conditioned acos in fisher-rao.
+    """
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
     if information_measure == "kl_divergence":
-        return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1)
+        return jnp.sum(q * (jnp.log(p) - jnp.log(q)), axis=-1)
     if information_measure == "alpha_divergence":
-        a = alpha
-        return (1 - jnp.sum(q**a * p ** (1 - a), axis=-1)) / (a * (1 - a))
+        a = alpha  # denominator α(α-1) — NEGATIVE on (0,1), the reference's convention
+        return (1 - jnp.sum(q**a * p ** (1 - a), axis=-1)) / (a * (a - 1))
     if information_measure == "beta_divergence":
-        b = beta
+        a, b = 1.0, beta  # the reference quirk: beta == ab with alpha pinned to 1
         return (
-            jnp.sum(p ** (b + 1), axis=-1) / (b * (b + 1))
-            + jnp.sum(q ** (b + 1), axis=-1) / (b + 1)
-            - jnp.sum(p * q**b, axis=-1) / b
+            jnp.log(jnp.sum(q ** (a + b), axis=-1)) / (b * (a + b))
+            + jnp.log(jnp.sum(p ** (a + b), axis=-1)) / (a * (a + b))
+            - jnp.log(jnp.sum(q**a * p**b, axis=-1)) / (a * b)
         )
     if information_measure == "ab_divergence":
         a, b = alpha, beta
         return (
-            jnp.log(jnp.sum(p ** (a + b), axis=-1)) / (b * (a + b))
-            + jnp.log(jnp.sum(q ** (a + b), axis=-1)) / (a * (a + b))
-            - jnp.log(jnp.sum(p**a * q**b, axis=-1)) / (a * b)
+            jnp.log(jnp.sum(q ** (a + b), axis=-1)) / (b * (a + b))
+            + jnp.log(jnp.sum(p ** (a + b), axis=-1)) / (a * (a + b))
+            - jnp.log(jnp.sum(q**a * p**b, axis=-1)) / (a * b)
         )
     if information_measure == "renyi_divergence":
         a = alpha
-        return jnp.log(jnp.sum(p**a * q ** (1 - a), axis=-1)) / (a - 1)
+        return jnp.log(jnp.sum(q**a * p ** (1 - a), axis=-1)) / (a - 1)
     if information_measure == "l1_distance":
         return jnp.sum(jnp.abs(p - q), axis=-1)
     if information_measure == "l2_distance":
@@ -115,7 +124,7 @@ def _sentence_distribution(probs: Array, mask: Array, weights: Optional[Array] =
     return total / jnp.clip(jnp.sum(w, axis=1), _EPS)[..., None]
 
 
-def _hf_masked_lm(model_name_or_path: str, max_length: int = 192, temperature: float = 1.0):
+def _hf_masked_lm(model_name_or_path: str, max_length: Optional[int] = None, temperature: float = 1.0):
     """(masked_lm, tokenize) callables from a cached HF checkpoint.
 
     Faithful pseudo-likelihood protocol (reference ``infolm.py:394-421``): position ``i``'s
@@ -138,13 +147,20 @@ def _hf_masked_lm(model_name_or_path: str, max_length: int = 192, temperature: f
         ) from err
 
     mask_id = tokenizer.mask_token_id
+    if max_length is None:
+        # the reference's default: `max_length or model.config.max_length` — the GENERATION
+        # config default (20 for BERT), NOT the tokenizer's model_max_length
+        # (reference functional/text/infolm.py:634)
+        max_length = int(model.config.max_length)
 
     def tokenize(sentences: List[str]):
         import numpy as _np
 
+        # padding="max_length" (not longest-in-batch) mirrors the reference's fixed grid
+        # (reference functional/text/infolm.py:493)
         batch = tokenizer(
-            sentences, return_tensors="np", padding=True, truncation=True, max_length=max_length,
-            return_special_tokens_mask=True,
+            sentences, return_tensors="np", padding="max_length", truncation=True,
+            max_length=max_length, return_special_tokens_mask=True,
         )
         mask = batch["attention_mask"] * (1 - batch["special_tokens_mask"])
         return _np.asarray(batch["input_ids"], _np.int64), _np.asarray(mask)
@@ -152,8 +168,8 @@ def _hf_masked_lm(model_name_or_path: str, max_length: int = 192, temperature: f
     def masked_lm(sentences: List[str]) -> Tuple[Array, Array]:
         with torch.no_grad():
             batch = tokenizer(
-                sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length,
-                return_special_tokens_mask=True,
+                sentences, return_tensors="pt", padding="max_length", truncation=True,
+                max_length=max_length, return_special_tokens_mask=True,
             )
             special = batch.pop("special_tokens_mask")
             ids = batch["input_ids"]
@@ -222,7 +238,8 @@ def infolm(
         target = [target]
     if len(preds) != len(target):
         raise ValueError(f"Number of predicted and reference sentences must match: {len(preds)} != {len(target)}")
-    max_length = 192 if max_length is None else max_length  # reference None = tokenizer max
+    # max_length=None resolves inside _hf_masked_lm to model.config.max_length once the
+    # model is loaded (the reference's default, functional/text/infolm.py:634)
     if masked_lm is None:
         masked_lm, tokenize = _hf_masked_lm(model_name_or_path, max_length=max_length, temperature=temperature)
     if idf and tokenize is None:

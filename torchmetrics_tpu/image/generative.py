@@ -452,7 +452,9 @@ class MemorizationInformedFrechetInceptionDistance(_FeatureStatsMetric):
         mu_r, cov_r = jnp.mean(real, axis=0), jnp.cov(real, rowvar=False)
         mu_f, cov_f = jnp.mean(fake, axis=0), jnp.cov(fake, rowvar=False)
         fid = _compute_fid(mu_r, jnp.atleast_2d(cov_r), mu_f, jnp.atleast_2d(cov_f))
-        distance = _cosine_distance(fake, real, self.cosine_distance_eps)
+        # reference arg order is (real, fake): mean over REAL of min distance to fake
+        # (mifid.py:36-47 called from compute() with real_features first)
+        distance = _cosine_distance(real, fake, self.cosine_distance_eps)
         return jnp.where(fid > 1e-8, fid / (distance + 1e-14), jnp.zeros_like(fid))
 
 

@@ -653,9 +653,10 @@ class BERTScore(_SentenceStoreTextMetric):
             rank_zero_warn("`baseline_url` needs network egress, which this build does not have;"
                            " pass `baseline_path` instead.")
         user_hooks = model is not None or user_tokenizer is not None or user_forward_fn is not None
-        # with all_layers the functional entrypoint builds the (layer-stacked) default encoder
-        # itself, so the flag composes with the default-model path but not a custom `encoder`
-        if encoder is None and not user_hooks and not all_layers:
+        # the default-model encoder (incl. the all_layers layer-stacked variant) is built ONCE
+        # here and reused across every compute()/update cycle — rebuilding the HF model per
+        # _score call would reload checkpoint weights each epoch
+        if encoder is None and not user_hooks:
             from torchmetrics_tpu.functional.text.bert import _DEFAULT_MODEL
             from torchmetrics_tpu.utils.pretrained import bert_encoder as _build
 
@@ -742,9 +743,8 @@ class InfoLM(_SentenceStoreTextMetric):
         pluggable ``masked_lm``/``tokenize`` callables."""
         _check_inert_knobs(verbose=verbose, device=device, batch_size=batch_size,
                            num_threads=num_threads)
-        # reference default None = "use the tokenizer's model max length"; resolved to this
-        # build's working cap before the masked-LM callables are built
-        max_length = 192 if max_length is None else max_length
+        # max_length=None resolves to model.config.max_length inside _hf_masked_lm
+        # (the reference's default, functional/text/infolm.py:634)
         super().__init__(**kwargs)
         from torchmetrics_tpu.functional.text.infolm import _hf_masked_lm, _validate_measure
 

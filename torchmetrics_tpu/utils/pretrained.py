@@ -258,6 +258,9 @@ def torch_bert_encoder(
         mask = _special_free_mask(batch["attention_mask"])
         return np.asarray(batch["input_ids"].numpy(), np.int64), np.asarray(mask.numpy())
 
+    # same composition contract as bert_encoder: an all_layers builder returns the
+    # (N, Λ, L, D) stack, so tag it for bert_score's all_layers+encoder check
+    encoder.layer_stacked = bool(all_layers)
     return encoder, tokenize
 
 
@@ -338,4 +341,7 @@ def bert_encoder(
         mask = batch["attention_mask"] * (1 - special)
         return jnp.asarray(hidden.cpu().numpy()), jnp.asarray(mask.cpu().numpy())
 
+    # lets bert_score distinguish a default-built (N, Λ, L, D) encoder from a user 3-D one,
+    # so a cached all_layers encoder composes with the all_layers=True flag
+    encoder.layer_stacked = bool(all_layers)
     return encoder, tokenize

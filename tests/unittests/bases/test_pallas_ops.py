@@ -126,14 +126,16 @@ class TestPallasCurveCounts:
         target = jnp.asarray(r.randint(0, 2, 3000))
         ref = float(binary_auroc(scores, target, thresholds=100))
         assert calls["n"] == 0
-        prc.set_curve_backend("pallas")
+        prc.set_curve_backend("pallas")  # runs one eager warm-up compile of the kernel
+        assert prc._CURVE_BACKEND == "pallas", "warm-up rejected a platform the kernel supports"
+        after_warmup = calls["n"]
         try:
             got = float(binary_auroc(scores, target, thresholds=100))
         finally:
             prc.set_curve_backend("xla")
         # the kernel must actually have run: a silent fallback would also pass the
         # equality assert below, so count the invocation explicitly
-        assert calls["n"] == 1
+        assert calls["n"] == after_warmup + 1
         assert got == pytest.approx(ref, abs=1e-6)
         with pytest.raises(ValueError, match="curve backend"):
             prc.set_curve_backend("nope")

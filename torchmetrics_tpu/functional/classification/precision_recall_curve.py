@@ -33,13 +33,19 @@ Thresholds = Union[int, List[float], Array, None]
 
 # ----------------------------------------------------------------- shared bits
 def _adjust_threshold_arg(thresholds: Thresholds = None) -> Optional[Array]:
-    """Normalise the ``thresholds`` argument to a sorted 1-D array (or None = exact mode)."""
+    """Normalise the ``thresholds`` argument to a sorted 1-D array (or None = exact mode).
+
+    int/list inputs build the grid in NUMPY (host-concrete): under jit, omnistaging would
+    turn a ``jnp.linspace`` into a tracer, hiding the grid's uniformity from
+    ``_uniform_grid_params`` and forcing the O(N·T) dot lowering on platforms where the
+    O(N) histogram wins. numpy operands compose with every downstream jnp op.
+    """
     if thresholds is None:
         return None
     if isinstance(thresholds, int):
-        return jnp.linspace(0.0, 1.0, thresholds)
+        return np.linspace(0.0, 1.0, thresholds, dtype=np.float32)
     if isinstance(thresholds, (list, tuple)):
-        return jnp.sort(jnp.asarray(thresholds, jnp.float32))
+        return np.sort(np.asarray(thresholds, np.float32))
     return jnp.sort(jnp.asarray(thresholds))
 
 
@@ -62,20 +68,88 @@ def _validate_thresholds_arg(thresholds: Thresholds) -> None:
         )
 
 
+def _uniform_grid_params(thresholds: Array) -> Optional[Tuple[float, float]]:
+    """``(lo, step)`` when ``thresholds`` is a concrete ascending uniform grid whose f32
+    bucketize candidate ``floor((t - lo)/step)`` lands within ±1 of the true index at every
+    knot (host-verified); ``None`` for traced, non-uniform, or numerically hostile grids.
+
+    The ±1 bound is what makes ``_uniform_hist_counts`` exact: between two knots the
+    candidate map is monotone, so an error bounded by 1 at the knots bounds it by 1
+    everywhere, and a single gather-compare correction step recovers the true count.
+    """
+    try:
+        t = np.asarray(thresholds)
+    except Exception:  # traced thresholds (derived from runtime values) — cannot verify
+        return None
+    if t.ndim != 1 or t.size < 2 or not np.all(np.isfinite(t)):
+        return None
+    d = np.diff(t)
+    if not (d > 0).all():
+        return None
+    step = (t[-1] - t[0]) / (t.size - 1)
+    if step <= 0 or not np.allclose(d, step, rtol=1e-4):
+        return None
+    lo = t[0]
+    cand = np.floor((t.astype(np.float32) - np.float32(lo)) / np.float32(step)).astype(np.int64)
+    if np.abs(cand - np.arange(t.size)).max() > 1:
+        return None
+    return float(lo), float(step)
+
+
+def _uniform_hist_counts(
+    scores: Array, pos: Array, neg: Array, thresholds: Array, lo: float, step: float
+) -> Tuple[Array, Array]:
+    """O(N + T) twin of the indicator dot for uniform grids, inputs (C, N) -> (C, T).
+
+    ``nb(s) = #{j: thr_j <= s}`` comes from one multiply+floor plus a ±1 gather-compare
+    correction (see ``_uniform_grid_params`` for why ±1 suffices); per-threshold counts are
+    then a weighted histogram over T+1 bins and a suffix cumsum — no (N, T) product anywhere.
+    ~90x faster than the dot on the CPU backend at 1M samples; same f32 2^24 exactness
+    contract.
+    """
+    num_t = thresholds.shape[0]
+    thresholds = jnp.asarray(thresholds)  # may arrive as a host-concrete numpy grid
+    b = jnp.clip(jnp.floor((scores - lo) / step).astype(jnp.int32), 0, num_t - 1)  # (C, N)
+    promote = (b + 1 <= num_t - 1) & (scores >= thresholds[jnp.minimum(b + 1, num_t - 1)])
+    demote = scores < thresholds[b]
+    nb = b + 1 + promote.astype(jnp.int32) - demote.astype(jnp.int32)  # in [0, T]
+    # NaN scores fail every `>=` compare in the dot path and count nowhere; floor(NaN)
+    # would land them in bucket 0's suffix here — route them to the dropped nb=0 bucket
+    nb = jnp.where(jnp.isnan(scores), 0, nb)
+
+    def _per_class(nb_c, pos_c, neg_c):
+        hist_p = jax.ops.segment_sum(pos_c, nb_c, num_segments=num_t + 1)
+        hist_n = jax.ops.segment_sum(neg_c, nb_c, num_segments=num_t + 1)
+        # tp[t] = Σ_{nb >= t+1}: suffix sums, dropping the nb=0 bucket
+        return jnp.cumsum(hist_p[::-1])[::-1][1:], jnp.cumsum(hist_n[::-1])[::-1][1:]
+
+    return jax.vmap(_per_class)(nb, pos, neg)
+
+
 def _indicator_counts(
     scores: Array, pos: Array, neg: Array, thresholds: Array
 ) -> Tuple[Array, Array]:
     """``tp[c, t] = Σ_i pos[c, i]·[scores[c, i] >= thr_t]`` (and fp from neg), inputs (C, N).
 
-    Lowered as a class-batched ``(C, 2, N) @ (C, N, T)`` dot whose RHS is the threshold
-    indicator — XLA fuses the broadcast compare into the dot operand, so the (N, T) indicator is
-    never materialised and the whole reduction runs on the MXU. Replaces the previous
-    searchsorted+histogram formulation: XLA lowers ``searchsorted`` to per-element binary-search
-    gathers, which measured ~1000x slower than this matmul on a v5e chip.
+    Two lowerings, picked per platform + threshold structure:
 
-    f32 accumulation: counts are exact up to 2^24 (~16.7M) samples per update, the same
-    contract as the confusion-matrix kernel (``ops/histogram.py``).
+    - **MXU dot** (TPU default): a class-batched ``(C, 2, N) @ (C, N, T)`` dot against the
+      threshold indicator. Replaces the earlier searchsorted+histogram formulation there:
+      XLA lowers ``searchsorted`` to per-element binary-search gathers, measured ~1000x
+      slower than this matmul on a v5e chip, and scatter-heavy histograms are similarly
+      weak on TPU.
+    - **uniform-grid histogram** (CPU backend, concrete uniform thresholds — the default
+      ``thresholds=int`` linspace): the CPU backend runs the dot at HIGHEST precision
+      ~90x slower than an O(N) bucketize+histogram, so the structure-exploiting path wins
+      there (measured 100M vs 1.1M samples/s at N=1M, T=200).
+
+    f32 accumulation either way: counts are exact up to 2^24 (~16.7M) samples per update,
+    the same contract as the confusion-matrix kernel (``ops/histogram.py``).
     """
+    if jax.default_backend() == "cpu":
+        grid = _uniform_grid_params(thresholds)
+        if grid is not None:
+            return _uniform_hist_counts(scores, pos, neg, thresholds, *grid)
     ind = (scores[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (C, N, T)
     both = jnp.stack([pos, neg], axis=1)  # (C, 2, N)
     res = jax.lax.dot_general(
@@ -97,6 +171,32 @@ def set_curve_backend(backend: str) -> None:
     if backend not in ("xla", "pallas"):
         raise ValueError(f"curve backend must be 'xla' or 'pallas', got {backend!r}")
     global _CURVE_BACKEND
+    if backend == "pallas":
+        # warm-up compile NOW, eagerly: a Mosaic failure inside a user's outer jit would
+        # surface at THEIR compile, after _binned_counts' own try/except has already passed —
+        # probing here flips unsupported platforms back to 'xla' before any user trace
+        try:
+            import jax
+
+            from torchmetrics_tpu.ops.pallas_curve import curve_counts_pallas
+
+            jax.block_until_ready(
+                curve_counts_pallas(
+                    jnp.linspace(0.0, 1.0, 256),
+                    jnp.ones((256,), jnp.float32),
+                    jnp.zeros((256,), jnp.float32),
+                    jnp.linspace(0.0, 1.0, 8),
+                )
+            )
+        except Exception as err:
+            from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"Pallas curve kernel failed its warm-up compile on this platform ({err!r});"
+                " keeping the 'xla' backend."
+            )
+            _CURVE_BACKEND = "xla"
+            return
     _CURVE_BACKEND = backend
 
 
@@ -180,7 +280,7 @@ def _precision_recall_from_confmat(confmat: Array, thresholds: Array) -> Tuple[A
     return (
         jnp.concatenate([precision, ones], axis=-1),
         jnp.concatenate([recall, zeros], axis=-1),
-        thresholds,
+        jnp.asarray(thresholds),  # thresholds may be a host-concrete numpy grid
     )
 
 

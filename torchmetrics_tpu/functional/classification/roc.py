@@ -39,7 +39,7 @@ def _roc_from_confmat(confmat: Array, thresholds: Array) -> Tuple[Array, Array, 
     tns = confmat[..., 0, 0]
     tpr = _safe_divide(tps, tps + fns)[..., ::-1]
     fpr = _safe_divide(fps, fps + tns)[..., ::-1]
-    return fpr, tpr, thresholds[::-1]
+    return fpr, tpr, jnp.asarray(thresholds)[::-1]  # thresholds may be a host-concrete grid
 
 
 def _roc_from_exact(preds: np.ndarray, target: np.ndarray, weight: np.ndarray) -> Tuple[Array, Array, Array]:

@@ -159,19 +159,30 @@ def make_recall_flat(top_k: Optional[int]) -> Callable:
 recall_flat = make_recall_flat(None)
 
 
-def curve_counts(ctx: Dict[str, Array], max_k: int, adaptive_k: bool):
-    """(precision (N, K), recall (N, K)) for every k in 1..max_k, ONE batched segment-reduce.
+def curve_counts(ctx: Dict[str, Array], max_k: int, adaptive_k: bool, k_tile: int = 128):
+    """(precision (N, K), recall (N, K)) for every k in 1..max_k via batched segment-reduces.
 
     Replaces a per-k Python loop (2*K kernel instantiations traced into the program) with a
-    single (N, K) membership product scattered per query — constant kernel count, O(N*K)
-    transient memory.
+    (docs, k) membership product scattered per query — constant kernel count. The k axis is
+    processed in ``k_tile``-wide tiles under ``lax.map`` so the per-doc transient is bounded
+    at ``n_docs * k_tile`` floats regardless of how large the k sweep is (an unchunked
+    (n_docs, K) product reaches multi-GB when K tracks the longest query of a large corpus).
     """
     k_vec = jnp.arange(1, max_k + 1, dtype=jnp.float32)  # (K,)
-    k_doc = jnp.minimum(k_vec[None, :], ctx["n_valid"][:, None])  # (N, K)
-    in_k = (ctx["rank"][:, None] <= k_doc) & (ctx["val_s"][:, None] > 0)
-    hits = jax.ops.segment_sum(
-        ctx["tgt_s"][:, None] * in_k, ctx["gid"], num_segments=ctx["n"]
-    )  # (N, K) per-query hit counts
+
+    def _hits_for(kv: Array) -> Array:  # kv (T,) -> per-query hit counts (N, T)
+        k_doc = jnp.minimum(kv[None, :], ctx["n_valid"][:, None])  # (docs, T)
+        in_k = (ctx["rank"][:, None] <= k_doc) & (ctx["val_s"][:, None] > 0)
+        return jax.ops.segment_sum(ctx["tgt_s"][:, None] * in_k, ctx["gid"], num_segments=ctx["n"])
+
+    if max_k <= k_tile:
+        hits = _hits_for(k_vec)  # (N, K)
+    else:
+        n_tiles = -(-max_k // k_tile)
+        pad = n_tiles * k_tile - max_k
+        k_tiles = jnp.pad(k_vec, (0, pad)).reshape(n_tiles, k_tile)
+        tiled = jax.lax.map(_hits_for, k_tiles)  # (n_tiles, N, k_tile), sequential tiles
+        hits = jnp.moveaxis(tiled, 0, 1).reshape(ctx["n"], n_tiles * k_tile)[:, :max_k]
     if adaptive_k:
         prec_den = jnp.minimum(k_vec[None, :], ctx["n_valid_seg"][:, None])
     else:

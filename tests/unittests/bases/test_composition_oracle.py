@@ -1,0 +1,145 @@
+"""CompositionalMetric dunder semantics pinned against the reference package as oracle.
+
+The reference's operator table (``/root/reference/src/torchmetrics/metric.py:928-1063``) has
+deliberate quirks — ``__pos__`` and ``__neg__`` both route through ``abs`` (``+m`` is
+``abs(m)``, ``-m`` is ``-abs(m)``) — which parity demands we reproduce exactly. Every dunder
+here runs the same update stream through the reference metric and ours and compares the
+composed ``compute()``.
+"""
+from __future__ import annotations
+
+import operator
+
+import numpy as np
+import pytest
+
+from tests.unittests.helpers.reference_shim import import_reference
+
+from torchmetrics_tpu.aggregation import SumMetric
+
+# values chosen so sign-sensitive quirks (abs in __pos__/__neg__) actually bite
+_UPDATES = [-3.0, 1.5, -0.25]  # sum = -1.75
+
+
+def _pair():
+    """(reference SumMetric, our SumMetric) fed the same stream."""
+    ref_tm = import_reference()
+    import torch
+
+    ref = ref_tm.aggregation.SumMetric()
+    ours = SumMetric()
+    for v in _UPDATES:
+        ref.update(torch.tensor(v))
+        ours.update(np.float32(v))
+    return ref, ours
+
+
+def _assert_composed_equal(ref_composed, our_composed, **kw):
+    np.testing.assert_allclose(
+        np.asarray(our_composed.compute(), np.float64),
+        np.asarray(ref_composed.compute().detach().numpy(), np.float64),
+        atol=1e-6,
+        **kw,
+    )
+
+
+class TestUnaryDunders:
+    def test_pos_is_abs(self):
+        ref, ours = _pair()
+        _assert_composed_equal(+ref, +ours)
+        assert float((+ours).compute()) == pytest.approx(1.75)  # the reference quirk
+
+    def test_neg_is_minus_abs(self):
+        ref, ours = _pair()
+        _assert_composed_equal(-ref, -ours)
+        assert float((-ours).compute()) == pytest.approx(-1.75)  # -abs, not arithmetic negate
+
+    def test_abs(self):
+        ref, ours = _pair()
+        _assert_composed_equal(abs(ref), abs(ours))
+
+    def test_invert_on_comparison(self):
+        """~ on a boolean comparison composition — float states are rejected by torch and
+        jnp alike, so bool is the shared domain the reference actually supports."""
+        ref, ours = _pair()
+        np.testing.assert_array_equal(
+            np.asarray((~(ours > 0.0)).compute()),
+            np.asarray((~(ref > 0.0)).compute().numpy()),
+        )
+
+
+class TestGetitem:
+    def test_getitem_indexes_composed_value(self):
+        ref_tm = import_reference()
+        import torch
+
+        from torchmetrics_tpu.classification import MulticlassStatScores
+
+        ref = ref_tm.classification.MulticlassStatScores(num_classes=3, average=None)
+        ours = MulticlassStatScores(num_classes=3, average=None)
+        preds = np.array([0, 1, 2, 1, 0])
+        target = np.array([0, 2, 2, 1, 1])
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+        ours.update(preds, target)
+        for idx in (0, 2, slice(0, 2)):
+            np.testing.assert_allclose(
+                np.asarray(ours[idx].compute(), np.float64),
+                np.asarray(ref[idx].compute().numpy(), np.float64),
+                err_msg=f"idx={idx}",
+            )
+
+
+_BINARY_CASES = [
+    (operator.add, 2.0), (operator.sub, 2.0), (operator.mul, 2.0), (operator.truediv, 2.0),
+    (operator.floordiv, 2.0), (operator.mod, 2.0), (operator.pow, 2.0),
+    (operator.lt, 1.0), (operator.le, -1.75), (operator.gt, 1.0), (operator.ge, -1.75),
+    (operator.eq, -1.75), (operator.ne, -1.75),
+]
+
+
+class TestBinaryDunders:
+    @pytest.mark.parametrize("op,scalar", _BINARY_CASES, ids=lambda p: getattr(p, "__name__", p))
+    def test_metric_op_scalar(self, op, scalar):
+        ref, ours = _pair()
+        import torch
+
+        _assert_composed_equal(op(ref, torch.tensor(scalar)), op(ours, np.float32(scalar)))
+
+    @pytest.mark.parametrize(
+        "op", [operator.add, operator.sub, operator.mul, operator.truediv],
+        ids=lambda f: f.__name__,
+    )
+    def test_metric_op_metric(self, op):
+        ref_a, ours_a = _pair()
+        ref_tm = import_reference()
+        import torch
+
+        ref_b = ref_tm.aggregation.SumMetric()
+        ours_b = SumMetric()
+        for v in (2.0, 4.0):
+            ref_b.update(torch.tensor(v))
+            ours_b.update(np.float32(v))
+        _assert_composed_equal(op(ref_a, ref_b), op(ours_a, ours_b))
+
+    @pytest.mark.parametrize(
+        "op", [operator.and_, operator.or_, operator.xor], ids=lambda f: f.__name__
+    )
+    def test_bitwise_ops_on_comparisons(self, op):
+        """The practical bitwise pattern: combining boolean comparison compositions —
+        torch and jnp both reject bitwise ops on float operands, so bool is the shared
+        domain the reference actually supports."""
+        ref, ours = _pair()
+        np.testing.assert_array_equal(
+            np.asarray(op(ours > -2.0, ours < 0.0).compute()),
+            np.asarray(op(ref > -2.0, ref < 0.0).compute().numpy()),
+        )
+
+    @pytest.mark.parametrize(
+        "op", [operator.add, operator.sub, operator.truediv], ids=lambda f: f.__name__
+    )
+    def test_reflected_scalar(self, op):
+        """10 <op> metric routes through the r-dunders with operands in reference order."""
+        ref, ours = _pair()
+        import torch
+
+        _assert_composed_equal(op(torch.tensor(10.0), ref), op(np.float32(10.0), ours))

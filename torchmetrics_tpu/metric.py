@@ -833,10 +833,12 @@ class Metric:
         return CompositionalMetric(jnp.floor_divide, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        # fmod (truncation toward zero), matching the reference's torch.fmod — NOT jnp.mod's
+        # floor semantics; they differ on negative operands (reference metric.py:964-966)
+        return CompositionalMetric(jnp.fmod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(jnp.fmod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
         return CompositionalMetric(jnp.power, self, other)

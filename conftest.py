@@ -1,0 +1,19 @@
+"""Root pytest configuration: platform pin for package doctests.
+
+``--doctest-modules`` over ``torchmetrics_tpu/`` (pyproject ``testpaths``) executes docstring
+examples that initialise the JAX backend OUTSIDE ``tests/unittests/conftest.py``'s scope — and
+in this environment default platform discovery can wedge forever on a dead axon TPU tunnel
+(plugin discovery hangs even under ``JAX_PLATFORMS=cpu``; only the config API is safe). Pin
+the virtual CPU mesh here so every pytest entry point — tests AND doctests — initialises
+instantly and deterministically.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

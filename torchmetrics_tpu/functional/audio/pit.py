@@ -39,7 +39,19 @@ def permutation_invariant_training(
     eval_func: str = "max",
     **kwargs: Any,
 ) -> Tuple[Array, Array]:
-    """PIT (reference ``pit.py:108-215``): best metric + permutation per batch element."""
+    """PIT (reference ``pit.py:108-215``): best metric + permutation per batch element.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import (permutation_invariant_training,
+        ...     scale_invariant_signal_noise_ratio)
+        >>> preds = np.array([[[0.6, 0.4, 0.2], [0.2, 0.4, 0.6]]], np.float32)
+        >>> target = np.array([[[0.2, 0.4, 0.6], [0.6, 0.4, 0.2]]], np.float32)
+        >>> best, perm = permutation_invariant_training(preds, target,
+        ...     scale_invariant_signal_noise_ratio, eval_func='max')
+        >>> np.asarray(perm).tolist()
+        [[1, 0]]
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if preds.shape[0:2] != target.shape[0:2]:
@@ -93,7 +105,16 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
-    """Reorder ``preds`` speakers by the per-sample permutation (reference ``pit.py:218-229``)."""
+    """Reorder ``preds`` speakers by the per-sample permutation (reference ``pit.py:218-229``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pit_permutate
+        >>> preds = np.array([[[0.6, 0.4, 0.2], [0.2, 0.4, 0.6]]], np.float32)
+        >>> perm = np.array([[1, 0]])
+        >>> np.asarray(pit_permutate(preds, perm), np.float64).round(1)[0].tolist()
+        [[0.2, 0.4, 0.6], [0.6, 0.4, 0.2]]
+    """
     preds = jnp.asarray(preds)
     perm = jnp.asarray(perm)
     return jnp.take_along_axis(preds, perm.reshape(*perm.shape, *([1] * (preds.ndim - 2))), axis=1)

@@ -50,6 +50,15 @@ def signal_distortion_ratio(
     ``use_cg_iter`` is accepted for API parity but the direct batched solve is always used —
     on TPU a single dense solve of the ``filter_length``² system is one fused kernel, which is
     the regime the reference's conjugate-gradient path exists to avoid on CPU.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import signal_distortion_ratio
+        >>> rng = np.random.RandomState(1)
+        >>> target = rng.randn(8000).astype(np.float32)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(8000).astype(np.float32)
+        >>> print(f"{float(signal_distortion_ratio(preds, target)):.2f}")
+        25.34
     """
     global _warned_cg_iter
     if use_cg_iter is not None and not _warned_cg_iter:

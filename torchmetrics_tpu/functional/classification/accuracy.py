@@ -151,7 +151,17 @@ def accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching accuracy (reference ``accuracy.py:315``)."""
+    """Task-dispatching accuracy (reference ``accuracy.py:315``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import accuracy
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(accuracy(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.7500
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_accuracy(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -262,7 +262,16 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching entrypoint (reference ``auroc.py:471``)."""
+    """Task-dispatching entrypoint (reference ``auroc.py:471``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import auroc
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> print(f"{float(auroc(preds, target, task='binary')):.4f}")
+        0.7500
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

@@ -231,7 +231,16 @@ def average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching entrypoint (reference ``average_precision.py:476``)."""
+    """Task-dispatching entrypoint (reference ``average_precision.py:476``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import average_precision
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> print(f"{float(average_precision(preds, target, task='binary')):.4f}")
+        0.8333
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

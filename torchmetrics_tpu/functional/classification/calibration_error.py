@@ -207,7 +207,16 @@ def calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching entrypoint (reference ``calibration_error.py:390``)."""
+    """Task-dispatching entrypoint (reference ``calibration_error.py:390``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import calibration_error
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> print(f"{float(calibration_error(preds, target, task='binary', n_bins=2)):.4f}")
+        0.0125
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
     task = ClassificationTaskNoMultilabel.from_str(task)

@@ -64,7 +64,8 @@ def _binary_confusion_matrix_tensor_validation(
             f" the following values {sorted(allowed)}."
         )
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating):
+    # jnp.issubdtype: numpy's hierarchy does not classify ml_dtypes' bfloat16 as floating
+    if not jnp.issubdtype(p.dtype, jnp.floating):
         uniquep = set(np.unique(p).tolist())
         if not uniquep.issubset({0, 1}):
             raise RuntimeError(
@@ -299,7 +300,17 @@ def confusion_matrix(
     num_labels: Optional[int] = None, normalize: Optional[str] = None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching confusion matrix (reference ``confusion_matrix.py:578``)."""
+    """Task-dispatching confusion matrix (reference ``confusion_matrix.py:578``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import confusion_matrix
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> np.asarray(confusion_matrix(preds, target, task='multiclass', num_classes=3)).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)

@@ -83,7 +83,16 @@ def multilabel_exact_match(preds, target, num_labels: int, threshold: float = 0.
 def exact_match(preds, target, task: str, num_classes: Optional[int] = None, num_labels: Optional[int] = None,
                 threshold: float = 0.5, multidim_average: str = "global", ignore_index: Optional[int] = None,
                 validate_args: bool = True) -> Array:
-    """Task-dispatching exact match (reference ``exact_match.py:355``)."""
+    """Task-dispatching exact match (reference ``exact_match.py:355``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import exact_match
+        >>> preds = np.array([[0, 1], [1, 1]])
+        >>> target = np.array([[0, 1], [0, 1]])
+        >>> print(f"{float(exact_match(preds, target, task='multilabel', num_labels=2)):.4f}")
+        0.5000
+    """
     task = ClassificationTaskNoBinary.from_str(task)
     if task == ClassificationTaskNoBinary.MULTICLASS:
         if not isinstance(num_classes, int):

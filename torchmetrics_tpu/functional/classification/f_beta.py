@@ -116,7 +116,17 @@ def fbeta_score(preds, target, task: str, beta: float = 1.0, threshold: float = 
                 num_classes: Optional[int] = None, num_labels: Optional[int] = None,
                 average: Optional[str] = "micro", multidim_average: str = "global", top_k: int = 1,
                 ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Task-dispatching F-beta (reference ``f_beta.py:1026``)."""
+    """Task-dispatching F-beta (reference ``f_beta.py:1026``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import fbeta_score
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(fbeta_score(preds, target, task='multiclass', num_classes=3, beta=0.5)):.4f}")
+        0.7500
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args)
@@ -136,6 +146,16 @@ def fbeta_score(preds, target, task: str, beta: float = 1.0, threshold: float = 
 def f1_score(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
              num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
              top_k: int = 1, ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Task-dispatching F1 (reference ``f_beta.py:1090``)."""
+    """Task-dispatching F1 (reference ``f_beta.py:1090``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import f1_score
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(f1_score(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.7500
+    """
     return fbeta_score(preds, target, task, 1.0, threshold, num_classes, num_labels, average,
                        multidim_average, top_k, ignore_index, validate_args)

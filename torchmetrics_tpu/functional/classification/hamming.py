@@ -71,7 +71,17 @@ def hamming_distance(preds, target, task: str, threshold: float = 0.5, num_class
                      num_labels: Optional[int] = None, average: Optional[str] = "micro",
                      multidim_average: str = "global", top_k: int = 1, ignore_index: Optional[int] = None,
                      validate_args: bool = True) -> Array:
-    """Task-dispatching hamming distance (reference ``hamming.py:316``)."""
+    """Task-dispatching hamming distance (reference ``hamming.py:316``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import hamming_distance
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(hamming_distance(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.2500
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_hamming_distance(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -189,7 +189,16 @@ def hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching entrypoint (reference ``hinge.py:290``)."""
+    """Task-dispatching entrypoint (reference ``hinge.py:290``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import hinge_loss
+        >>> preds = np.array([0.25, 0.25, 0.55, 0.75, 0.75], np.float32)
+        >>> target = np.array([0, 0, 1, 1, 1])
+        >>> print(f"{float(hinge_loss(preds, target, task='binary')):.4f}")
+        0.6900
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
     task = ClassificationTaskNoMultilabel.from_str(task)

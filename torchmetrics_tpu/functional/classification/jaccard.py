@@ -90,7 +90,17 @@ def multilabel_jaccard_index(preds, target, num_labels: int, threshold: float = 
 def jaccard_index(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                   num_labels: Optional[int] = None, average: Optional[str] = "macro",
                   ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Task-dispatching jaccard index (reference ``jaccard.py:290``)."""
+    """Task-dispatching jaccard index (reference ``jaccard.py:290``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import jaccard_index
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(jaccard_index(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.6667
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)

@@ -84,7 +84,17 @@ def multilabel_matthews_corrcoef(preds, target, num_labels: int, threshold: floa
 def matthews_corrcoef(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                       num_labels: Optional[int] = None, ignore_index: Optional[int] = None,
                       validate_args: bool = True) -> Array:
-    """Task-dispatching MCC (reference ``matthews_corrcoef.py:276``)."""
+    """Task-dispatching MCC (reference ``matthews_corrcoef.py:276``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import matthews_corrcoef
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(matthews_corrcoef(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.7000
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)

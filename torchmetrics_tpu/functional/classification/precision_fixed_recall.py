@@ -44,7 +44,17 @@ def binary_precision_at_fixed_recall(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    """(max precision, threshold) subject to recall >= min_recall (reference ``:140``)."""
+    """(max precision, threshold) subject to recall >= min_recall (reference ``:140``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import binary_precision_at_fixed_recall
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> prec, thr = binary_precision_at_fixed_recall(preds, target, min_recall=0.5, thresholds=4)
+        >>> print(f"{float(prec):.4f} {float(thr):.4f}")
+        1.0000 0.6667
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:
@@ -70,7 +80,19 @@ def multiclass_precision_at_fixed_recall(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    """Per-class (max precision, threshold) at fixed recall (reference ``:248``)."""
+    """Per-class (max precision, threshold) at fixed recall (reference ``:248``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiclass_precision_at_fixed_recall
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> prec, thr = multiclass_precision_at_fixed_recall(preds, target, num_classes=3,
+        ...                                                  min_recall=0.5, thresholds=4)
+        >>> np.asarray(prec, np.float64).round(4).tolist()
+        [1.0, 0.5, 1.0]
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:
@@ -100,7 +122,18 @@ def multilabel_precision_at_fixed_recall(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    """Per-label (max precision, threshold) at fixed recall (reference ``:348``)."""
+    """Per-label (max precision, threshold) at fixed recall (reference ``:348``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multilabel_precision_at_fixed_recall
+        >>> preds = np.array([[0.75, 0.05], [0.35, 0.85]], np.float32)
+        >>> target = np.array([[1, 0], [0, 1]])
+        >>> prec, thr = multilabel_precision_at_fixed_recall(preds, target, num_labels=2,
+        ...                                                  min_recall=0.5, thresholds=4)
+        >>> np.asarray(prec, np.float64).round(4).tolist()
+        [1.0, 1.0]
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     if validate_args:

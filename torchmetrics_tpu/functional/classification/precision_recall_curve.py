@@ -623,7 +623,19 @@ def precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task-dispatching entrypoint (reference ``precision_recall_curve.py:947``)."""
+    """Task-dispatching entrypoint (reference ``precision_recall_curve.py:947``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import precision_recall_curve
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> prec, rec, thr = precision_recall_curve(preds, target, task='binary', thresholds=4)
+        >>> np.asarray(prec, np.float64).round(4).tolist()
+        [0.5, 0.6667, 1.0, 0.0, 1.0]
+        >>> np.asarray(rec, np.float64).round(4).tolist()
+        [1.0, 1.0, 0.5, 0.0, 0.0]
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

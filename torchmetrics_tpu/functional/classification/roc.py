@@ -213,7 +213,19 @@ def roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task-dispatching entrypoint (reference ``roc.py:470``)."""
+    """Task-dispatching entrypoint (reference ``roc.py:470``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import roc
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> fpr, tpr, thr = roc(preds, target, task='binary', thresholds=4)
+        >>> np.asarray(fpr, np.float64).round(4).tolist()
+        [0.0, 0.0, 0.5, 1.0]
+        >>> np.asarray(tpr, np.float64).round(4).tolist()
+        [0.0, 0.5, 1.0, 1.0]
+    """
     from torchmetrics_tpu.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

@@ -64,7 +64,17 @@ def multilabel_specificity(preds, target, num_labels: int, threshold: float = 0.
 def specificity(preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "micro", multidim_average: str = "global",
                 top_k: int = 1, ignore_index: Optional[int] = None, validate_args: bool = True) -> Array:
-    """Task-dispatching specificity (reference ``specificity.py:299``)."""
+    """Task-dispatching specificity (reference ``specificity.py:299``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import specificity
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> print(f"{float(specificity(preds, target, task='multiclass', num_classes=3)):.4f}")
+        0.8750
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_specificity(preds, target, threshold, multidim_average, ignore_index, validate_args)

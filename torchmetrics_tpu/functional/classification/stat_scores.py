@@ -70,7 +70,9 @@ def _binary_stat_scores_tensor_validation(
             f" the following values {sorted(allowed)}."
         )
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating):
+    # jnp.issubdtype: numpy's hierarchy does not classify ml_dtypes' bfloat16 as floating,
+    # so bf16 probability tensors would be misread as label tensors
+    if not jnp.issubdtype(p.dtype, jnp.floating):
         uniquep = set(np.unique(p).tolist())
         if not uniquep.issubset({0, 1}):
             raise RuntimeError(
@@ -483,7 +485,17 @@ def stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching entrypoint (reference ``stat_scores.py:1040``)."""
+    """Task-dispatching entrypoint (reference ``stat_scores.py:1040``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import stat_scores
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> np.asarray(stat_scores(preds, target, task='multiclass', num_classes=3, average='micro')).tolist()
+        [3, 1, 7, 1, 4]
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -230,7 +230,16 @@ def panoptic_quality(
     stuffs: Collection[int],
     allow_unknown_preds_category: bool = False,
 ) -> Array:
-    """PQ (reference ``functional/detection/panoptic_qualities.py:25``)."""
+    """PQ (reference ``functional/detection/panoptic_qualities.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import panoptic_quality
+        >>> preds = np.array([[[6, 0], [0, 0], [6, 0], [7, 0]]])
+        >>> target = np.array([[[6, 0], [0, 1], [6, 0], [7, 0]]])
+        >>> print(f"{float(panoptic_quality(preds, target, things={6, 7}, stuffs={0})):.4f}")
+        1.0000
+    """
     things_p, stuffs_p = _parse_categories(things, stuffs)
     _validate_inputs(jnp.asarray(preds), jnp.asarray(target))
     void_color = _get_void_color(things_p, stuffs_p)

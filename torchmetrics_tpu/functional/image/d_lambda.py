@@ -61,7 +61,17 @@ def _spectral_distortion_index_compute(
 def spectral_distortion_index(
     preds: Array, target: Array, p: int = 1, reduction: str = "elementwise_mean"
 ) -> Array:
-    """D-lambda (reference ``d_lambda.py:114-160``)."""
+    """D-lambda (reference ``d_lambda.py:114-160``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import spectral_distortion_index
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> print(f"{float(spectral_distortion_index(preds, target)):.4f}")
+        0.0404
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"`p` must be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_check_inputs(preds, target)

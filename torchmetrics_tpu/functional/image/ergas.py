@@ -47,6 +47,16 @@ def error_relative_global_dimensionless_synthesis(
     ratio: float = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS (reference ``ergas.py:86-131``)."""
+    """ERGAS (reference ``ergas.py:86-131``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> print(f"{float(error_relative_global_dimensionless_synthesis(preds, target)):.1f}")
+        331.2
+    """
     preds, target = _ergas_check_inputs(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

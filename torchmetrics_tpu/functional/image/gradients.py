@@ -8,7 +8,16 @@ from jax import Array
 
 
 def image_gradients(img: Array) -> Tuple[Array, Array]:
-    """Finite-difference (dy, dx), zero-padded at the far edge (reference ``gradients.py:47-81``)."""
+    """Finite-difference (dy, dx), zero-padded at the far edge (reference ``gradients.py:47-81``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import image_gradients
+        >>> img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> np.asarray(dy)[0, 0].tolist()
+        [[4.0, 4.0, 4.0, 4.0], [4.0, 4.0, 4.0, 4.0], [4.0, 4.0, 4.0, 4.0], [0.0, 0.0, 0.0, 0.0]]
+    """
     img = jnp.asarray(img)
     if img.ndim != 4:
         raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")

@@ -45,7 +45,17 @@ def _rase_compute(
 
 
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
-    """RASE (reference ``rase.py:71-103``)."""
+    """RASE (reference ``rase.py:71-103``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import relative_average_spectral_error
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> print(f"{float(relative_average_spectral_error(preds, target)):.1f}")
+        5278.6
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError('Argument `window_size` must be a positive integer.')
     preds = jnp.asarray(preds, jnp.float32)

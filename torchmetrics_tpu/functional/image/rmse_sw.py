@@ -68,7 +68,17 @@ def _rmse_sw_compute(
 def root_mean_squared_error_using_sliding_window(
     preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
 ):
-    """Sliding-window RMSE (reference ``rmse_sw.py:112-151``)."""
+    """Sliding-window RMSE (reference ``rmse_sw.py:112-151``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import root_mean_squared_error_using_sliding_window
+        >>> rng = np.random.RandomState(22)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> print(f"{float(root_mean_squared_error_using_sliding_window(preds, target, window_size=8)):.4f}")
+        0.4143
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError('Argument `window_size` must be a positive integer.')
     rmse_val_sum, rmse_map, total_images = _rmse_sw_update(

@@ -253,7 +253,19 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
     normalize: Optional[str] = "relu",
 ) -> Array:
-    """MS-SSIM (reference ``ssim.py:447-527``)."""
+    """MS-SSIM (reference ``ssim.py:447-527``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import multiscale_structural_similarity_index_measure
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> target = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> v = multiscale_structural_similarity_index_measure(preds, target, data_range=1.0,
+        ...                                                    betas=(0.5, 0.5))
+        >>> print(f"{float(v):.4f}")
+        0.0258
+    """
     if not isinstance(betas, tuple):
         raise ValueError("Argument `betas` is expected to be of a type tuple.")
     if not all(isinstance(beta, float) for beta in betas):

@@ -80,7 +80,15 @@ def cramers_v_matrix(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pairwise Cramer's V over the columns of a (N, V) categorical matrix (reference ``cramers.py:141``)."""
+    """Pairwise Cramer's V over the columns of a (N, V) categorical matrix (reference ``cramers.py:141``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import cramers_v_matrix
+        >>> matrix = np.array([[0, 0], [1, 1], [0, 1], [1, 1], [2, 2], [2, 0], [0, 0], [1, 2]])
+        >>> np.asarray(cramers_v_matrix(matrix), np.float64).round(4).tolist()
+        [[1.0, 0.0913], [0.0913, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     matrix = np.asarray(matrix)
     num_variables = matrix.shape[1]

@@ -42,7 +42,15 @@ def _fleiss_kappa_compute(counts: Array) -> Array:
 
 
 def fleiss_kappa(ratings: Array, mode: Literal["counts", "probs"] = "counts") -> Array:
-    """Fleiss' kappa (reference ``fleiss_kappa.py:61``)."""
+    """Fleiss' kappa (reference ``fleiss_kappa.py:61``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import fleiss_kappa
+        >>> ratings = np.array([[3, 2, 5], [4, 4, 2], [5, 3, 2]])  # [n_samples, n_categories] counts
+        >>> print(f"{float(fleiss_kappa(ratings, mode='counts')):.4f}")
+        -0.0550
+    """
     if mode not in ("counts", "probs"):
         raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
     counts = _fleiss_kappa_update(ratings, mode)

@@ -56,7 +56,15 @@ def pearsons_contingency_coefficient(
 def pearsons_contingency_coefficient_matrix(
     matrix, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Pairwise coefficient over columns (reference ``pearson.py:129``)."""
+    """Pairwise coefficient over columns (reference ``pearson.py:129``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pearsons_contingency_coefficient_matrix
+        >>> matrix = np.array([[0, 0], [1, 1], [0, 1], [1, 1], [2, 2], [2, 0], [0, 0], [1, 2]])
+        >>> np.asarray(pearsons_contingency_coefficient_matrix(matrix), np.float64).round(4).tolist()
+        [[1.0, 0.607], [0.607, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     matrix = np.asarray(matrix)
     num_variables = matrix.shape[1]

@@ -70,7 +70,15 @@ def theils_u(
 def theils_u_matrix(
     matrix, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Pairwise (asymmetric) Theil's U over columns (reference ``theils_u.py:147``)."""
+    """Pairwise (asymmetric) Theil's U over columns (reference ``theils_u.py:147``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import theils_u_matrix
+        >>> matrix = np.array([[0, 0], [1, 1], [0, 1], [1, 1], [2, 2], [2, 0], [0, 0], [1, 2]])
+        >>> np.asarray(theils_u_matrix(matrix), np.float64).round(4).tolist()
+        [[1.0, 0.3987], [0.3987, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     matrix = np.asarray(matrix)
     num_variables = matrix.shape[1]

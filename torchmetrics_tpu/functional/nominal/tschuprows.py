@@ -82,7 +82,15 @@ def tschuprows_t_matrix(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pairwise Tschuprow's T over columns (reference ``tschuprows.py:133``)."""
+    """Pairwise Tschuprow's T over columns (reference ``tschuprows.py:133``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import tschuprows_t_matrix
+        >>> matrix = np.array([[0, 0], [1, 1], [0, 1], [1, 1], [2, 2], [2, 0], [0, 0], [1, 2]])
+        >>> np.asarray(tschuprows_t_matrix(matrix), np.float64).round(4).tolist()
+        [[1.0, 0.0913], [0.0913, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     matrix = np.asarray(matrix)
     num_variables = matrix.shape[1]

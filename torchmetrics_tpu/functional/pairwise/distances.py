@@ -175,6 +175,14 @@ def pairwise_minkowski_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise minkowski (Lᵖ) distance (reference ``minkowski.py:49``)."""
+    """Pairwise minkowski (Lᵖ) distance (reference ``minkowski.py:49``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import pairwise_minkowski_distance
+        >>> x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        >>> np.asarray(pairwise_minkowski_distance(x, exponent=3), np.float64).round(4).tolist()
+        [[0.0, 2.5198], [2.5198, 0.0]]
+    """
     distance = _pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -166,7 +166,19 @@ def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] =
 def retrieval_precision_recall_curve(
     preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Tuple[Array, Array, Array]:
-    """(precisions, recalls, top_k values) for k = 1..max_k (reference ``precision_recall_curve.py``)."""
+    """(precisions, recalls, top_k values) for k = 1..max_k (reference ``precision_recall_curve.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.functional import retrieval_precision_recall_curve
+        >>> preds = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+        >>> target = np.array([1, 0, 1, 0, 1])
+        >>> prec, rec, top_k = retrieval_precision_recall_curve(preds, target, max_k=4)
+        >>> np.asarray(prec, np.float64).round(4).tolist()
+        [1.0, 0.5, 0.6667, 0.5]
+        >>> np.asarray(top_k).tolist()
+        [1, 2, 3, 4]
+    """
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
     preds, target, mask = _prep(preds, target)

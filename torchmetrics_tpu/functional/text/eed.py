@@ -128,7 +128,15 @@ def extended_edit_distance(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ):
-    """EED (reference ``eed.py:344-414``)."""
+    """EED (reference ``eed.py:344-414``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import extended_edit_distance
+        >>> preds = ["this is the prediction"]
+        >>> target = ["this is the reference"]
+        >>> print(f"{float(extended_edit_distance(preds, target)):.4f}")
+        0.3835
+    """
     for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
         if not isinstance(val, float) or val < 0:
             raise ValueError(f"Parameter `{name}` must be a non-negative float.")

@@ -165,7 +165,15 @@ def sacre_bleu_score(
     lowercase: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """SacreBLEU score (reference ``sacre_bleu.py:389``)."""
+    """SacreBLEU score (reference ``sacre_bleu.py:389``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import sacre_bleu_score
+        >>> preds = ["the cat is on the mat"]
+        >>> target = [["the cat is on the mat"]]
+        >>> print(f"{float(sacre_bleu_score(preds, target)):.4f}")
+        1.0000
+    """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
     if weights is not None and len(weights) != n_gram:

@@ -15,5 +15,30 @@ if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked slow (the heavy sweeps split into their own CI lane)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy sweep kept out of the default lane (run with --runslow; CI has a"
+        " dedicated lane) so the default suite stays within its runtime budget",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="slow lane: pass --runslow (CI runs these separately)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

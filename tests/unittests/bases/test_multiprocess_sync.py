@@ -64,6 +64,7 @@ def worker_results():
     return results
 
 
+@pytest.mark.slow
 class TestTwoProcessSync:
     def test_world_formed(self, worker_results):
         assert set(worker_results) == {0, 1}

@@ -20,6 +20,10 @@ import pytest  # noqa: E402
 # time — override through the config API (backend itself is still uninitialised at this point).
 jax.config.update("jax_platforms", "cpu")
 
+
+# the slow-lane marker/option machinery lives in the ROOT conftest.py: pytest_addoption in a
+# non-initial conftest is ignored for invocations that don't start collection here
+
 NUM_DEVICES = 8
 BATCH_SIZE = 32
 NUM_BATCHES = 8  # divisible by NUM_DEVICES for sharded tests

@@ -123,6 +123,7 @@ class TestSSIM(MetricTester):
         assert np.isfinite(float(res))
 
 
+@pytest.mark.slow
 class TestMSSSIM(MetricTester):
     atol = 1e-4
 
@@ -433,6 +434,7 @@ class TestTotalVariation(MetricTester):
 
 
 class TestVIF(MetricTester):
+    @pytest.mark.slow
     def test_functional(self):
         preds = RNG.rand(2, 2, 48, 48).astype(np.float32) * 255
         target = RNG.rand(2, 2, 48, 48).astype(np.float32) * 255

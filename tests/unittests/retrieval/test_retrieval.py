@@ -271,6 +271,7 @@ def test_flat_engine_median_aggregation():
     assert float(m_med.compute()) == pytest.approx(float(np.median(vals)), abs=1e-5)
 
 
+@pytest.mark.slow
 def test_flat_engine_tie_order_matches_rectangle():
     """Quantized (heavily tied) scores must rank identically in both engines — the flat sort
     carries an explicit reversed-input-order tiebreak to mirror the rectangle's argsort[::-1]."""

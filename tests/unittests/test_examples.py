@@ -6,7 +6,10 @@ import sys
 
 import pytest
 
-_EXAMPLES = sorted((pathlib.Path(__file__).parents[2] / "examples").glob("*.py"))
+_EXAMPLES = sorted(
+    p for p in (pathlib.Path(__file__).parents[2] / "examples").glob("*.py")
+    if not p.stem.startswith("_")  # _env.py is the shared bootstrap, not an example
+)
 
 
 @pytest.mark.parametrize("script", _EXAMPLES, ids=lambda p: p.stem)
@@ -14,8 +17,10 @@ def test_example_runs(script, tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # each example must set up its own device needs
+    # examples are required to finish in <60s on CPU; 180s keeps headroom without letting
+    # a wedged backend eat 10 minutes of suite budget per script
     proc = subprocess.run(
-        [sys.executable, str(script)], capture_output=True, text=True, timeout=600,
+        [sys.executable, str(script)], capture_output=True, text=True, timeout=180,
         cwd=tmp_path,  # examples must not depend on the cwd (they bootstrap sys.path)
         env=env,
     )

@@ -63,6 +63,7 @@ class TestClassificationParity:
             ref_ap(_t(self.b_probs), _t(self.b_target), thresholds=100),
         )
 
+    @pytest.mark.slow
     def test_exact_vs_binned_auroc_large(self):
         # weak-point regression (VERDICT r2 #7): exact (host) and binned modes agree at scale
         n = 100_000
@@ -143,6 +144,7 @@ class TestImageParity:
             rtol=1e-3,
         )
 
+    @pytest.mark.slow
     def test_multiscale_ssim(self):
         from torchmetrics.functional.image import (
             multiscale_structural_similarity_index_measure as ref_ms,

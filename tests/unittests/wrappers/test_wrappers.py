@@ -26,6 +26,7 @@ MC_TARGET = rng.randint(0, C, (NB, BS))
 
 
 class TestBootStrapper:
+    @pytest.mark.slow
     def test_output_keys_and_sanity(self):
         wrapper = BootStrapper(BinaryAccuracy(), num_bootstraps=8, quantile=0.95, raw=True, seed=0)
         for i in range(NB):
@@ -39,6 +40,7 @@ class TestBootStrapper:
         # bootstrap mean should be near the plain estimate
         np.testing.assert_allclose(float(out["mean"]), float(base.compute()), atol=0.1)
 
+    @pytest.mark.slow
     def test_seed_reproducible(self):
         # regression: `seed` kwarg makes resampling deterministic
         outs = []
@@ -53,6 +55,7 @@ class TestBootStrapper:
             w2.update(PREDS[i], TARGET[i])
         assert not np.array_equal(outs[0], np.asarray(w2.compute()["raw"]))
 
+    @pytest.mark.slow
     def test_seed_survives_reset(self):
         w = BootStrapper(BinaryAccuracy(), num_bootstraps=6, seed=123, raw=True)
         for i in range(NB):

@@ -78,7 +78,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running maximum of a stream of values (reference ``aggregation.py:114``)."""
+    """Running maximum of a stream of values (reference ``aggregation.py:114``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(np.array([2.0, 0.5]))
+        >>> float(metric.compute())
+        2.0
+    """
 
     full_state_update = True
 
@@ -93,7 +103,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running minimum of a stream of values (reference ``aggregation.py:219``)."""
+    """Running minimum of a stream of values (reference ``aggregation.py:219``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(np.array([2.0, 0.5]))
+        >>> float(metric.compute())
+        0.5
+    """
 
     full_state_update = True
 
@@ -108,7 +128,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum of a stream of values (reference ``aggregation.py:324``)."""
+    """Running sum of a stream of values (reference ``aggregation.py:324``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(np.array([2.0, 3.0]))
+        >>> float(metric.compute())
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
@@ -119,7 +149,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate a stream of values (reference ``aggregation.py:429``)."""
+    """Concatenate a stream of values (reference ``aggregation.py:429``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(np.array([2.0, 3.0]))
+        >>> np.asarray(metric.compute()).tolist()
+        [1.0, 2.0, 3.0]
+    """
 
     # NaN filtering changes the output shape, so the update must stay on the host
     jit_update = False
@@ -144,7 +184,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean of a stream of values (reference ``aggregation.py:493``)."""
+    """Weighted running mean of a stream of values (reference ``aggregation.py:493``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(np.array([2.0, 3.0]))
+        >>> float(metric.compute())
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, jnp.float32), nan_strategy, state_name="mean_value", **kwargs)

@@ -52,7 +52,19 @@ class _MeanOverSamplesMetric(Metric):
 
 
 class SignalNoiseRatio(_MeanOverSamplesMetric):
-    """SNR (reference ``audio/snr.py:30``)."""
+    """SNR (reference ``audio/snr.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> rng = np.random.RandomState(42)
+        >>> target = rng.randn(100).astype(np.float32)
+        >>> preds = target + 0.1 * rng.randn(100).astype(np.float32)
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        19.63
+    """
 
     is_differentiable = True
     higher_is_better = True

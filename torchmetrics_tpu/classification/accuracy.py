@@ -30,7 +30,19 @@ class BinaryAccuracy(BinaryStatScores):
 
 
 class MulticlassAccuracy(MulticlassStatScores):
-    """Multiclass accuracy (reference ``accuracy.py:150``)."""
+    """Multiclass accuracy (reference ``accuracy.py:150``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = MulticlassAccuracy(num_classes=3)  # default average='macro'
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.8333
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -64,7 +76,19 @@ class MultilabelAccuracy(MultilabelStatScores):
 
 
 class Accuracy(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``accuracy.py:456-523``)."""
+    """Task dispatcher (reference ``accuracy.py:456-523``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import Accuracy
+        >>> metric = Accuracy(task='multiclass', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -22,7 +22,16 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryAUROC(BinaryPrecisionRecallCurve):
-    """Reference ``classification/auroc.py:43``."""
+    """Reference ``classification/auroc.py:43``.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.classification import BinaryAUROC
+        >>> metric = BinaryAUROC()
+        >>> metric.update(np.array([0.1, 0.4, 0.35, 0.8], np.float32), np.array([0, 0, 1, 1]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -64,7 +64,19 @@ class BinaryConfusionMatrix(Metric):
 
 
 class MulticlassConfusionMatrix(Metric):
-    """Reference ``confusion_matrix.py:187``."""
+    """Reference ``confusion_matrix.py:187``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> np.asarray(metric.compute()).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
 
     is_differentiable = False
     higher_is_better = None

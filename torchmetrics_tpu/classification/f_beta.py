@@ -92,7 +92,19 @@ class BinaryF1Score(BinaryFBetaScore):
 
 
 class MulticlassF1Score(MulticlassFBetaScore):
-    """Reference ``f_beta.py:686``."""
+    """Reference ``f_beta.py:686``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassF1Score
+        >>> metric = MulticlassF1Score(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7778
+    """
 
     def __init__(self, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,

@@ -75,7 +75,16 @@ class RandScore(_LabelPairMetric):
 
 
 class AdjustedRandScore(_LabelPairMetric):
-    """Adjusted Rand score (reference ``clustering/adjusted_rand_score.py:29``)."""
+    """Adjusted Rand score (reference ``clustering/adjusted_rand_score.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.clustering import AdjustedRandScore
+        >>> metric = AdjustedRandScore()
+        >>> metric.update(np.array([0, 0, 1, 1]), np.array([0, 0, 1, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5714
+    """
 
     plot_lower_bound = -0.5
 

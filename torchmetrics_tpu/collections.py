@@ -43,7 +43,21 @@ def _flatten_dict(x: Dict) -> Tuple[Dict, bool]:
 
 
 class MetricCollection:
-    """Dict of metrics sharing one ``update``/``forward``/``compute`` call (reference ``collections.py:34``)."""
+    """Dict of metrics sharing one ``update``/``forward``/``compute`` call (reference ``collections.py:34``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+        >>> mc = MetricCollection([MulticlassAccuracy(num_classes=3, average='micro'),
+        ...                        MulticlassF1Score(num_classes=3)])
+        >>> mc.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in sorted(mc.compute().items())}
+        {'MulticlassAccuracy': 0.75, 'MulticlassF1Score': 0.7778}
+    """
 
     _modules: "OrderedDict[str, Metric]"
 

@@ -25,6 +25,18 @@ class IntersectionOverUnion(Metric):
     Per-image IoU matrices have data-dependent shapes, so they live as host-side list states
     (``dist_reduce_fx=None`` gather, like the reference); each matrix itself is one fused jnp
     kernel.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]], np.float32),
+        ...           "scores": np.array([0.9], np.float32), "labels": np.array([0])}]
+        >>> target = [{"boxes": np.array([[0.0, 0.0, 10.0, 8.0]], np.float32),
+        ...            "labels": np.array([0])}]
+        >>> metric = IntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()['iou']):.4f}")
+        0.8000
     """
 
     is_differentiable = False

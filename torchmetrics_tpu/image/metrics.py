@@ -35,7 +35,19 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 class StructuralSimilarityIndexMeasure(Metric):
-    """SSIM (reference ``image/ssim.py:30``)."""
+    """SSIM (reference ``image/ssim.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        -0.0864
+    """
 
     higher_is_better = True
     is_differentiable = True
@@ -174,7 +186,19 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
 
 
 class PeakSignalNoiseRatio(Metric):
-    """PSNR (reference ``image/psnr.py:27``)."""
+    """PSNR (reference ``image/psnr.py:27``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        7.0466
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -458,7 +482,18 @@ class SpectralDistortionIndex(Metric):
 
 
 class TotalVariation(Metric):
-    """Total variation (reference ``image/tv.py:30``)."""
+    """Total variation (reference ``image/tv.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import TotalVariation
+        >>> rng = np.random.RandomState(42)
+        >>> img = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = TotalVariation()
+        >>> metric.update(img)
+        >>> print(f"{float(metric.compute()):.1f}")
+        162.0
+    """
 
     full_state_update = False
     is_differentiable = True

@@ -57,7 +57,16 @@ class _ConfmatNominalMetric(Metric):
 
 
 class CramersV(_ConfmatNominalMetric):
-    """Cramer's V (reference ``nominal/cramers.py:28``)."""
+    """Cramer's V (reference ``nominal/cramers.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> metric = CramersV(num_classes=3)
+        >>> metric.update(np.array([0, 1, 2, 0, 1]), np.array([0, 1, 2, 0, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5000
+    """
 
     def __init__(
         self,

@@ -18,7 +18,18 @@ from torchmetrics_tpu.metric import Metric
 
 
 class MeanSquaredError(Metric):
-    """MSE / RMSE (reference ``mse.py:27``)."""
+    """MSE / RMSE (reference ``mse.py:27``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MeanSquaredError()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.3750
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -46,7 +57,18 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsoluteError(Metric):
-    """MAE (reference ``mae.py:25``)."""
+    """MAE (reference ``mae.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5000
+    """
 
     is_differentiable = True
     higher_is_better = False

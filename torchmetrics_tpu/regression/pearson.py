@@ -18,7 +18,18 @@ from torchmetrics_tpu.metric import Metric
 
 
 class PearsonCorrCoef(Metric):
-    """Pearson correlation coefficient (reference ``pearson.py:75``)."""
+    """Pearson correlation coefficient (reference ``pearson.py:75``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.9849
+    """
 
     is_differentiable = True
     higher_is_better = None

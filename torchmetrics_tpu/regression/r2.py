@@ -11,7 +11,18 @@ from torchmetrics_tpu.metric import Metric
 
 
 class R2Score(Metric):
-    """R² (reference ``r2.py:29``)."""
+    """R² (reference ``r2.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> metric = R2Score()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.9486
+    """
 
     is_differentiable = True
     higher_is_better = True

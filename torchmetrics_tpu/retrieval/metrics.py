@@ -39,7 +39,17 @@ def _validate_top_k(top_k: Optional[int]) -> None:
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision (reference ``retrieval/average_precision.py``)."""
+    """Mean average precision (reference ``retrieval/average_precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> metric = RetrievalMAP()
+        >>> metric.update(np.array([0.2, 0.3, 0.5], np.float32), np.array([0, 1, 1]),
+        ...               indexes=np.array([0, 0, 0]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
@@ -167,7 +177,17 @@ class RetrievalRPrecision(RetrievalMetric):
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """NDCG@k with graded relevance (reference ``retrieval/ndcg.py``)."""
+    """NDCG@k with graded relevance (reference ``retrieval/ndcg.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+        >>> metric = RetrievalNormalizedDCG()
+        >>> metric.update(np.array([0.2, 0.3, 0.5], np.float32), np.array([0, 1, 1]),
+        ...               indexes=np.array([0, 0, 0]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     allow_non_binary_target = True
 

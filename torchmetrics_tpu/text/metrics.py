@@ -61,7 +61,15 @@ class _HostTextMetric(Metric):
 
 
 class BLEUScore(_HostTextMetric):
-    """BLEU (reference ``text/bleu.py:30``)."""
+    """BLEU (reference ``text/bleu.py:30``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> metric = BLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -150,13 +158,29 @@ class _ErrorRateMetric(_HostTextMetric):
 
 
 class WordErrorRate(_ErrorRateMetric):
-    """WER (reference ``text/wer.py:28``)."""
+    """WER (reference ``text/wer.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.2500
+    """
 
     _update_fn = staticmethod(_wer_update)
 
 
 class CharErrorRate(_ErrorRateMetric):
-    """CER (reference ``text/cer.py:28``)."""
+    """CER (reference ``text/cer.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(["abcd"], ["abce"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.2500
+    """
 
     _update_fn = staticmethod(_cer_update)
 

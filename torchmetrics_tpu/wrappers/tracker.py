@@ -14,7 +14,23 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 class MetricTracker(WrapperMetric):
     """Track a metric (or collection) over epochs: ``increment()`` per epoch, ``best_metric()``
-    at the end (reference ``tracker.py:31,108``)."""
+    at the end (reference ``tracker.py:31,108``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> tracker = MetricTracker(MulticlassAccuracy(num_classes=3, average='micro'))
+        >>> for epoch in range(2):
+        ...     tracker.increment()
+        ...     tracker.update(preds, target)
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> print(f"{float(best):.4f}", step)
+        0.7500 0
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         super().__init__()

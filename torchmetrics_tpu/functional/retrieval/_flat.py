@@ -22,9 +22,62 @@ from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array, lax
 
 _NEG = -1e30  # effective -inf for masked score positions (matches _kernels._NEG)
+
+
+def host_sort_perm(indexes: Array, preds: Array, valid: Array) -> Optional[Array]:
+    """Precompute the flat-engine sort permutation EAGERLY on the CPU backend; None elsewhere.
+
+    XLA:CPU's comparator-based variadic sort is the entire retrieval bottleneck there
+    (~666 ms of a ~760 ms 1M-doc cycle vs 65 ms for the packed numpy argsort). The host sort
+    must run OUTSIDE the compiled program: an in-graph ``pure_callback`` can deadlock
+    nondeterministically against XLA:CPU's thread pool on few-core hosts (observed hanging
+    ~1 in 3 runs on a 1-core box). Callers pass the result into ``build_context(perm=...)``;
+    on TPU (None) the in-graph 3-key ``lax.sort`` is used and everything stays on device.
+    """
+    if jax.default_backend() != "cpu":
+        return None
+    try:
+        idx_np = np.asarray(indexes)
+        score_np = np.where(np.asarray(valid) > 0, np.asarray(preds, np.float32), _NEG)
+    except Exception:  # traced values (inside someone else's jit) — stay on the device sort
+        return None
+    return jnp.asarray(_sort_perm_host(idx_np, score_np))
+
+
+def _sort_perm_host(indexes: np.ndarray, key_desc: np.ndarray) -> np.ndarray:
+    """Host permutation for (query asc, key desc, reversed-input-order ties).
+
+    Packs (query, descending-sortable score bits) into ONE uint64 and runs a single stable
+    argsort over the REVERSED array (stability on the reversal yields the reversed-input tie
+    order) — ~10x faster than XLA:CPU's comparator sort at 1M docs. Negative ids or NaN keys
+    fall back to an equivalent ``np.lexsort``.
+    """
+    n = indexes.shape[0]
+    raw_key = np.asarray(key_desc)
+    key_desc = raw_key.astype(np.float32)
+    indexes = np.asarray(indexes)
+    if n == 0:
+        return np.zeros((0,), np.int32)
+    if (
+        (indexes < 0).any()
+        or np.isnan(key_desc).any()
+        # ids >= 2^32 would wrap in the uint64 pack; f64 keys would change tie structure
+        # when downcast to f32 — both route to the (slower, exact) lexsort
+        or int(indexes.max(initial=0)) >= (1 << 32)
+        or raw_key.dtype == np.float64
+    ):
+        rev = np.arange(n, dtype=np.int64)[::-1]
+        return np.lexsort((rev, -raw_key, indexes)).astype(np.int32)
+    bits = key_desc.view(np.uint32)
+    # order-preserving f32 -> uint32 (ascending), inverted for descending-score order
+    sortable = np.where(key_desc >= 0, bits | np.uint32(0x80000000), ~bits)
+    packed = (indexes.astype(np.uint64) << np.uint64(32)) | (~sortable).astype(np.uint64)
+    perm_rev = np.argsort(packed[::-1], kind="stable")
+    return ((n - 1) - perm_rev).astype(np.int32)
 
 
 def _sort_by_query_then(indexes: Array, key_desc: Array, *payload: Array):
@@ -55,17 +108,26 @@ def dense_groups(idx_sorted: Array):
 
 
 def build_context(
-    indexes: Array, preds: Array, target: Array, valid: Array, top_k: Optional[int]
+    indexes: Array, preds: Array, target: Array, valid: Array, top_k: Optional[int],
+    perm: Optional[Array] = None,
 ) -> Dict[str, Array]:
     """Shared per-doc/per-segment quantities every flat kernel consumes.
 
     All arrays are length-N (per sorted doc) or length-N (per segment id; segments >= q empty).
+    ``perm``: optional precomputed sort permutation (``host_sort_perm``, CPU backend) — only
+    cheap gathers run in the compiled program; None keeps the in-graph ``lax.sort``.
     """
     n = indexes.shape[0]
     score = jnp.where(valid > 0, preds, _NEG)
-    idx_s, neg_score, tgt_s, val_s = _sort_by_query_then(
-        indexes, score, target * valid, valid.astype(jnp.float32)
-    )
+    if perm is not None:
+        idx_s = jnp.take(indexes, perm)
+        neg_score = jnp.take(-score, perm)
+        tgt_s = jnp.take(target * valid, perm)
+        val_s = jnp.take(valid.astype(jnp.float32), perm)
+    else:
+        idx_s, neg_score, tgt_s, val_s = _sort_by_query_then(
+            indexes, score, target * valid, valid.astype(jnp.float32)
+        )
     is_new, gid, start = dense_groups(idx_s)
     rank = (jnp.arange(n) - start).astype(jnp.float32) + 1.0  # 1-based within-query rank
 

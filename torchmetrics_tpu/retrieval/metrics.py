@@ -243,7 +243,9 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         requested_k = max_k
         max_k = _next_pow2(max_k)
         indexes, preds, target, valid = self._pad_flat(indexes, preds, target, valid)
-        cache_key = f"curve_flat@{max_k}"
+        # eager host sort permutation on the CPU backend (see base._flat_aggregate)
+        perm = _flat.host_sort_perm(indexes, preds, valid)
+        cache_key = f"curve_flat@{max_k}" + ("@perm" if perm is not None else "")
         fn = self._jit_cache.get(cache_key)
         if fn is None:
             action = self.empty_target_action
@@ -251,8 +253,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
             aggregation = self.aggregation
             device_agg = aggregation if isinstance(aggregation, str) else None
 
-            def run(indexes, preds, target, valid):
-                ctx = _flat.build_context(indexes, preds, target, valid, None)
+            def run(indexes, preds, target, valid, perm=None):
+                ctx = _flat.build_context(indexes, preds, target, valid, None, perm=perm)
                 has_valid = ctx["n_valid_seg"] > 0
                 empty = (ctx["pos_seg"] == 0) & has_valid
                 include = has_valid & ~empty if action == "skip" else has_valid
@@ -269,10 +271,11 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
             fn = jax.jit(run)
             self._jit_cache[cache_key] = fn
+        args = (indexes, preds, target, valid) + ((perm,) if perm is not None else ())
         if isinstance(self.aggregation, str):
-            p, r, any_empty = fn(indexes, preds, target, valid)
+            p, r, any_empty = fn(*args)
         else:
-            pv, rv, include, any_empty = fn(indexes, preds, target, valid)
+            pv, rv, include, any_empty = fn(*args)
             keep = np.asarray(include)
             pv_np, rv_np = np.asarray(pv)[keep], np.asarray(rv)[keep]  # ONE transfer each
             p = jnp.stack([jnp.asarray(self.aggregation(jnp.asarray(pv_np[:, k])))

@@ -42,10 +42,31 @@ def host_sort_perm(indexes: Array, preds: Array, valid: Array) -> Optional[Array
         return None
     try:
         idx_np = np.asarray(indexes)
-        score_np = np.where(np.asarray(valid) > 0, np.asarray(preds, np.float32), _NEG)
+        # keep the key's native dtype: an early f32 cast would defeat _sort_perm_host's
+        # f64 exact-path guard (sub-f32-ulp score differences must not collapse into ties)
+        score_np = np.where(np.asarray(valid) > 0, np.asarray(preds), _NEG)
     except Exception:  # traced values (inside someone else's jit) — stay on the device sort
         return None
     return jnp.asarray(_sort_perm_host(idx_np, score_np))
+
+
+def host_ideal_perm(
+    indexes: Array, target: Array, valid: Array, perm: Optional[Array]
+) -> Optional[Array]:
+    """Eager permutation for NDCG's ideal-DCG re-sort (relevance desc within query).
+
+    Operates on the main-perm-ordered stream (so segment layout is unchanged); None when the
+    main perm is None (TPU: the kernel's in-graph ``lax.sort`` is used instead).
+    """
+    if perm is None:
+        return None
+    perm_np = np.asarray(perm)
+    idx_s = np.asarray(indexes)[perm_np]
+    # native key dtype (see host_sort_perm): graded f64 relevance must keep exact ordering
+    tgt_s = (np.asarray(target) * np.asarray(valid))[perm_np]
+    val_s = np.asarray(valid, np.float32)[perm_np]
+    rel_key = np.where(val_s > 0, tgt_s, _NEG)
+    return jnp.asarray(_sort_perm_host(idx_s, rel_key))
 
 
 def _sort_perm_host(indexes: np.ndarray, key_desc: np.ndarray) -> np.ndarray:
@@ -109,7 +130,7 @@ def dense_groups(idx_sorted: Array):
 
 def build_context(
     indexes: Array, preds: Array, target: Array, valid: Array, top_k: Optional[int],
-    perm: Optional[Array] = None,
+    perm: Optional[Array] = None, ideal_perm: Optional[Array] = None,
 ) -> Dict[str, Array]:
     """Shared per-doc/per-segment quantities every flat kernel consumes.
 
@@ -160,6 +181,7 @@ def build_context(
         "within_cum": within_cum,
         "pos_seg": pos_seg,  # per-segment total relevance (graded sum for NDCG inputs)
         "top_k": top_k,
+        "ideal_perm": ideal_perm,  # NDCG's eager ideal-DCG re-sort (CPU backend), else None
     }
 
 
@@ -286,11 +308,17 @@ def ndcg_flat(ctx: Dict[str, Array]) -> Array:
     avg_disc = (tie_disc / jnp.maximum(tie_cnt, 1.0))[tie_gid]
     dcg = _seg(ctx, ctx["tgt_s"] * avg_disc)
 
-    # ideal DCG: docs re-sorted by true relevance within the query, plain discounts
-    rel_key = jnp.where(ctx["val_s"] > 0, ctx["tgt_s"], _NEG)
-    _, _, ideal_tgt, ideal_val = _sort_by_query_then(
-        ctx["idx_s"], rel_key, ctx["tgt_s"], ctx["val_s"]
-    )
+    # ideal DCG: docs re-sorted by true relevance within the query, plain discounts.
+    # On the CPU backend the permutation was precomputed eagerly (host_ideal_perm) — the
+    # in-graph variadic sort it replaces is the same ~10x bottleneck as the main sort.
+    if ctx.get("ideal_perm") is not None:
+        ideal_tgt = jnp.take(ctx["tgt_s"], ctx["ideal_perm"])
+        ideal_val = jnp.take(ctx["val_s"], ctx["ideal_perm"])
+    else:
+        rel_key = jnp.where(ctx["val_s"] > 0, ctx["tgt_s"], _NEG)
+        _, _, ideal_tgt, ideal_val = _sort_by_query_then(
+            ctx["idx_s"], rel_key, ctx["tgt_s"], ctx["val_s"]
+        )
     # within-query positions are identical to the first sort's (same segment layout)
     ideal_disc = jnp.where(
         (ctx["rank"] <= ctx["k_eff"]) & (ideal_val > 0), 1.0 / jnp.log2(ctx["rank"] + 1.0), 0.0

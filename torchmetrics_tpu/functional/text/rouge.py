@@ -38,11 +38,17 @@ def _split_sentence(x: str) -> Sequence[str]:
             nltk.data.find("tokenizers/punkt")
             _PUNKT_AVAILABLE = True
         except LookupError:
-            try:
-                nltk.download("punkt", quiet=True, force=False, halt_on_error=False, raise_on_error=True)
-                _PUNKT_AVAILABLE = True
-            except ValueError:
-                _PUNKT_AVAILABLE = False
+            _PUNKT_AVAILABLE = False
+            # one cheap DNS resolution before attempting the download — zero-egress hosts
+            # fail instantly instead of risking a hung fetch
+            from torchmetrics_tpu.utils.pretrained import host_reachable
+
+            if host_reachable("raw.githubusercontent.com"):
+                try:
+                    nltk.download("punkt", quiet=True, force=False, halt_on_error=False, raise_on_error=True)
+                    _PUNKT_AVAILABLE = True
+                except Exception:
+                    _PUNKT_AVAILABLE = False
     if _PUNKT_AVAILABLE:
         return nltk.sent_tokenize(x)
     return [s for s in re.split(r"(?<=[.!?])\s+", x.strip()) if s]

@@ -278,6 +278,11 @@ class RetrievalMetric(Metric):
         # argsort, ~10x XLA:CPU's comparator sort) and becomes a plain jit argument; on TPU
         # it is None and the in-graph lax.sort keeps everything on device
         perm = _flat.host_sort_perm(indexes, preds, valid)
+        ideal_perm = (
+            _flat.host_ideal_perm(indexes, target, valid, perm)
+            if getattr(self, "_flat_needs_ideal_perm", False)
+            else None
+        )
         cache_key = cache_key + ("@perm" if perm is not None else "")
         fn = self._jit_cache.get(cache_key)
         if fn is None:
@@ -285,8 +290,10 @@ class RetrievalMetric(Metric):
             aggregation = self.aggregation
             top_k = getattr(self, "top_k", None)
 
-            def run(indexes, preds, target, valid, perm=None):
-                ctx = _flat.build_context(indexes, preds, target, valid, top_k, perm=perm)
+            def run(indexes, preds, target, valid, perm=None, ideal_perm=None):
+                ctx = _flat.build_context(
+                    indexes, preds, target, valid, top_k, perm=perm, ideal_perm=ideal_perm
+                )
                 values = self._flat_values(ctx)
                 n_valid_seg = ctx["n_valid_seg"]
                 pos_seg = ctx["pos_seg"]
@@ -303,7 +310,8 @@ class RetrievalMetric(Metric):
             fn = jax.jit(run)
             self._jit_cache[cache_key] = fn
         if perm is not None:
-            result, any_empty = fn(indexes, preds, target, valid, perm)
+            extra = (perm,) + ((ideal_perm,) if ideal_perm is not None else ())
+            result, any_empty = fn(indexes, preds, target, valid, *extra)
         else:
             result, any_empty = fn(indexes, preds, target, valid)
         if self.empty_target_action == "error" and bool(any_empty):

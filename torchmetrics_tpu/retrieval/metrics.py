@@ -190,6 +190,7 @@ class RetrievalNormalizedDCG(RetrievalMetric):
     """
 
     allow_non_binary_target = True
+    _flat_needs_ideal_perm = True  # ideal-DCG re-sort precomputed eagerly on the CPU backend
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:

@@ -52,16 +52,20 @@ def hf_model_cached(model_id: str) -> bool:
     return os.path.isdir(snapshots) and bool(os.listdir(snapshots))
 
 
-def _hub_reachable() -> bool:
-    """One cheap DNS resolution — zero-egress environments fail this instantly, skipping the
-    hub client's multi-minute retry/backoff loop."""
+def host_reachable(host: str, port: int = 443) -> bool:
+    """One cheap DNS resolution — zero-egress environments fail this instantly, skipping a
+    download client's multi-minute retry/backoff loop (HF hub, nltk, ...)."""
     import socket
 
     try:
-        socket.getaddrinfo("huggingface.co", 443)
+        socket.getaddrinfo(host, port)
         return True
     except OSError:
         return False
+
+
+def _hub_reachable() -> bool:
+    return host_reachable("huggingface.co")
 
 
 def _from_pretrained(cls: Any, model_id: str, **kwargs: Any) -> Any:

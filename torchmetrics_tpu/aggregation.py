@@ -222,7 +222,16 @@ class MeanMetric(BaseAggregator):
 
 
 class RunningMean(_Running):
-    """Mean over a running window (reference ``aggregation.py:616``)."""
+    """Mean over a running window (reference ``aggregation.py:616``).
+
+    Example:
+        >>> from torchmetrics_tpu.aggregation import RunningMean
+        >>> metric = RunningMean(window=2)
+        >>> for v in (1.0, 2.0, 5.0):
+        ...     metric.update(v)
+        >>> float(metric.compute())  # mean of the last 2 values
+        3.5
+    """
 
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)

@@ -78,7 +78,19 @@ class SignalNoiseRatio(_MeanOverSamplesMetric):
 
 
 class ScaleInvariantSignalNoiseRatio(_MeanOverSamplesMetric):
-    """SI-SNR (reference ``audio/snr.py:124``)."""
+    """SI-SNR (reference ``audio/snr.py:124``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> rng = np.random.RandomState(42)
+        >>> target = rng.randn(100).astype(np.float32)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(100).astype(np.float32)
+        >>> metric = ScaleInvariantSignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        24.69
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -130,7 +142,19 @@ class SignalDistortionRatio(_MeanOverSamplesMetric):
 
 
 class ScaleInvariantSignalDistortionRatio(_MeanOverSamplesMetric):
-    """SI-SDR (reference ``audio/sdr.py:173``)."""
+    """SI-SDR (reference ``audio/sdr.py:173``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> rng = np.random.RandomState(42)
+        >>> target = rng.randn(100).astype(np.float32)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(100).astype(np.float32)
+        >>> metric = ScaleInvariantSignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        24.75
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -52,7 +52,18 @@ class _CalibrationErrorBase(Metric):
 
 
 class BinaryCalibrationError(_CalibrationErrorBase):
-    """Reference ``classification/calibration_error.py:41``."""
+    """Reference ``classification/calibration_error.py:41``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+        >>> metric = BinaryCalibrationError(n_bins=2)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0125
+    """
 
     def __init__(
         self,

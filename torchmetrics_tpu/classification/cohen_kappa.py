@@ -36,7 +36,19 @@ class BinaryCohenKappa(BinaryConfusionMatrix):
 
 
 class MulticlassCohenKappa(MulticlassConfusionMatrix):
-    """Reference ``cohen_kappa.py:159``."""
+    """Reference ``cohen_kappa.py:159``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassCohenKappa
+        >>> metric = MulticlassCohenKappa(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6364
+    """
 
     is_differentiable = False
     higher_is_better = True

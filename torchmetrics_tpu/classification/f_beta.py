@@ -84,7 +84,18 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
-    """Reference ``f_beta.py:551``."""
+    """Reference ``f_beta.py:551``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryF1Score
+        >>> metric = BinaryF1Score()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6667
+    """
 
     def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:

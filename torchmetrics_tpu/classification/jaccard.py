@@ -14,7 +14,18 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryJaccardIndex(BinaryConfusionMatrix):
-    """Reference ``jaccard.py:39``."""
+    """Reference ``jaccard.py:39``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryJaccardIndex
+        >>> metric = BinaryJaccardIndex()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5000
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -14,7 +14,18 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
-    """Reference ``matthews_corrcoef.py:39``."""
+    """Reference ``matthews_corrcoef.py:39``.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryMatthewsCorrCoef
+        >>> metric = BinaryMatthewsCorrCoef()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5774
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -103,7 +103,18 @@ class MultilabelRecall(MultilabelStatScores):
 
 
 class Precision(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``precision_recall.py:898``)."""
+    """Task dispatcher (reference ``precision_recall.py:898``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryPrecision
+        >>> metric = BinaryPrecision()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

@@ -59,7 +59,16 @@ class _LabelPairMetric(Metric):
 
 
 class MutualInfoScore(_LabelPairMetric):
-    """Mutual information between clusterings (reference ``clustering/mutual_info_score.py:30``)."""
+    """Mutual information between clusterings (reference ``clustering/mutual_info_score.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.clustering import MutualInfoScore
+        >>> metric = MutualInfoScore()
+        >>> metric.update(np.array([0, 0, 1, 1]), np.array([0, 0, 1, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6931
+    """
 
     plot_upper_bound = None
 
@@ -68,7 +77,16 @@ class MutualInfoScore(_LabelPairMetric):
 
 
 class RandScore(_LabelPairMetric):
-    """Rand score (reference ``clustering/rand_score.py:29``)."""
+    """Rand score (reference ``clustering/rand_score.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.clustering import RandScore
+        >>> metric = RandScore()
+        >>> metric.update(np.array([0, 0, 1, 1]), np.array([0, 0, 1, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.8333
+    """
 
     def _functional(self, preds, target):
         return rand_score(preds, target)
@@ -109,7 +127,16 @@ class AdjustedMutualInfoScore(_LabelPairMetric):
 
 
 class NormalizedMutualInfoScore(_LabelPairMetric):
-    """Normalized mutual info (reference ``clustering/normalized_mutual_info_score.py:30``)."""
+    """Normalized mutual info (reference ``clustering/normalized_mutual_info_score.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+        >>> metric = NormalizedMutualInfoScore()
+        >>> metric.update(np.array([0, 0, 1, 1]), np.array([0, 0, 1, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.8000
+    """
 
     def __init__(
         self, average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic", **kwargs: Any

@@ -131,7 +131,20 @@ class IntersectionOverUnion(Metric):
 
 
 class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
-    """GIoU (reference ``detection/giou.py:30``)."""
+    """GIoU (reference ``detection/giou.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import GeneralizedIntersectionOverUnion
+        >>> preds = [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]], np.float32),
+        ...           "scores": np.array([0.9], np.float32), "labels": np.array([0])}]
+        >>> target = [{"boxes": np.array([[0.0, 0.0, 10.0, 8.0]], np.float32),
+        ...            "labels": np.array([0])}]
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()['giou']):.4f}")
+        0.8000
+    """
 
     _iou_type = "giou"
     _invalid_val = -1.0

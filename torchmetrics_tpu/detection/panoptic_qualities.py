@@ -23,6 +23,16 @@ class PanopticQuality(Metric):
 
     Per-category IoU-sum/TP/FP/FN accumulators, all ``dist_reduce_fx="sum"`` — directly
     ``psum``-able; segment matching runs on the host (see ``functional/detection/panoptic.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import PanopticQuality
+        >>> preds = np.array([[[6, 0], [0, 0], [6, 0], [7, 0]]])
+        >>> target = np.array([[[6, 0], [0, 1], [6, 0], [7, 0]]])
+        >>> metric = PanopticQuality(things={6, 7}, stuffs={0})
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
     """
 
     is_differentiable = False

@@ -302,7 +302,19 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
 
 
 class UniversalImageQualityIndex(Metric):
-    """UQI (reference ``image/uqi.py:32``)."""
+    """UQI (reference ``image/uqi.py:32``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import UniversalImageQualityIndex
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = UniversalImageQualityIndex()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        -0.0921
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -344,7 +356,19 @@ class UniversalImageQualityIndex(Metric):
 
 
 class SpectralAngleMapper(Metric):
-    """SAM (reference ``image/sam.py:34``)."""
+    """SAM (reference ``image/sam.py:34``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import SpectralAngleMapper
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 3, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 3, 16, 16).astype(np.float32)
+        >>> metric = SpectralAngleMapper()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6319
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -99,7 +99,16 @@ class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
 
 
 class TheilsU(_ConfmatNominalMetric):
-    """Theil's U (reference ``nominal/theils_u.py:28``)."""
+    """Theil's U (reference ``nominal/theils_u.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.nominal import TheilsU
+        >>> metric = TheilsU(num_classes=3)
+        >>> metric.update(np.array([0, 1, 2, 0, 1]), np.array([0, 1, 2, 0, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7372
+    """
 
     def _update_fn(self, preds, target):
         return _theils_u_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
@@ -130,7 +139,16 @@ class TschuprowsT(_ConfmatNominalMetric):
 
 
 class FleissKappa(Metric):
-    """Fleiss' kappa (reference ``nominal/fleiss_kappa.py:28``)."""
+    """Fleiss' kappa (reference ``nominal/fleiss_kappa.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.nominal import FleissKappa
+        >>> metric = FleissKappa(mode='counts')
+        >>> metric.update(np.array([[3, 2, 5], [4, 4, 2], [5, 3, 2]]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        -0.0550
+    """
 
     is_differentiable = False
     higher_is_better = True

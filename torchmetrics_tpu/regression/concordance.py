@@ -6,7 +6,18 @@ from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
 
 
 class ConcordanceCorrCoef(PearsonCorrCoef):
-    """CCC over the shared Pearson running state (reference ``concordance.py:24``)."""
+    """CCC over the shared Pearson running state (reference ``concordance.py:24``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.9777
+    """
 
     def _compute(self, state):
         mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._merged_state(state)

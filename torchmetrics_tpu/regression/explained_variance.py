@@ -14,7 +14,18 @@ from torchmetrics_tpu.metric import Metric
 
 
 class ExplainedVariance(Metric):
-    """Explained variance (reference ``explained_variance.py:26``)."""
+    """Explained variance (reference ``explained_variance.py:26``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import ExplainedVariance
+        >>> metric = ExplainedVariance()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.9572
+    """
 
     is_differentiable = True
     higher_is_better = True

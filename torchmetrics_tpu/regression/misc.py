@@ -29,7 +29,18 @@ from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
 
 class CosineSimilarity(Metric):
-    """Cosine similarity over accumulated rows (reference ``cosine_similarity.py:24``)."""
+    """Cosine similarity over accumulated rows (reference ``cosine_similarity.py:24``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.regression import CosineSimilarity
+        >>> preds = np.array([[2.5, 0.0], [2.0, 8.0]], np.float32)
+        >>> target = np.array([[3.0, -0.5], [2.0, 7.0]], np.float32)
+        >>> metric = CosineSimilarity()  # default reduction='sum'
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.9858
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -55,7 +66,18 @@ class CosineSimilarity(Metric):
 
 
 class KLDivergence(Metric):
-    """KL(P||Q) (reference ``kl_divergence.py:25``)."""
+    """KL(P||Q) (reference ``kl_divergence.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.regression import KLDivergence
+        >>> p = np.array([[0.2, 0.3, 0.5]], np.float32)
+        >>> q = np.array([[0.1, 0.4, 0.5]], np.float32)
+        >>> metric = KLDivergence()
+        >>> metric.update(p, q)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0523
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -92,7 +114,18 @@ class KLDivergence(Metric):
 
 
 class LogCoshError(Metric):
-    """LogCosh error (reference ``log_cosh.py:25``)."""
+    """LogCosh error (reference ``log_cosh.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import LogCoshError
+        >>> metric = LogCoshError()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.1685
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -116,7 +149,18 @@ class LogCoshError(Metric):
 
 
 class MinkowskiDistance(Metric):
-    """Minkowski distance (reference ``minkowski.py:24``)."""
+    """Minkowski distance (reference ``minkowski.py:24``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0772
+    """
 
     is_differentiable = True
     higher_is_better = False

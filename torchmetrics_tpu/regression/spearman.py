@@ -18,7 +18,18 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman rank correlation (reference ``spearman.py:24``)."""
+    """Spearman rank correlation (reference ``spearman.py:24``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import SpearmanCorrCoef
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -46,7 +57,18 @@ class SpearmanCorrCoef(Metric):
 
 
 class KendallRankCorrCoef(Metric):
-    """Kendall rank correlation (reference ``kendall.py:30``)."""
+    """Kendall rank correlation (reference ``kendall.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     is_differentiable = False
     higher_is_better = None

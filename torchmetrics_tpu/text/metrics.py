@@ -118,7 +118,15 @@ class BLEUScore(_HostTextMetric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """SacreBLEU (reference ``text/sacre_bleu.py:36``)."""
+    """SacreBLEU (reference ``text/sacre_bleu.py:36``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __init__(
         self,
@@ -186,7 +194,15 @@ class CharErrorRate(_ErrorRateMetric):
 
 
 class MatchErrorRate(_ErrorRateMetric):
-    """MER (reference ``text/mer.py:28``)."""
+    """MER (reference ``text/mer.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.2500
+    """
 
     _update_fn = staticmethod(_mer_update)
 
@@ -230,7 +246,15 @@ class WordInfoPreserved(_WordInfoMetric):
 
 
 class EditDistance(_HostTextMetric):
-    """Levenshtein edit distance (reference ``text/edit.py:29``)."""
+    """Levenshtein edit distance (reference ``text/edit.py:29``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import EditDistance
+        >>> metric = EditDistance()
+        >>> metric.update(["abcd"], ["abce"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -272,7 +296,18 @@ class EditDistance(_HostTextMetric):
 
 
 class Perplexity(Metric):
-    """Perplexity (reference ``text/perplexity.py:29``) — fully on-device, jitted."""
+    """Perplexity (reference ``text/perplexity.py:29``) — fully on-device, jitted.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> probs = np.array([[[0.4, 0.3, 0.3], [0.1, 0.8, 0.1]]], np.float32)
+        >>> tokens = np.array([[0, 1]])
+        >>> metric = Perplexity()
+        >>> metric.update(probs, tokens)
+        >>> print(f"{float(metric.compute()):.4f}")
+        2.3665
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -299,7 +334,15 @@ class Perplexity(Metric):
 
 
 class CHRFScore(_HostTextMetric):
-    """chrF/chrF++ (reference ``text/chrf.py:32``)."""
+    """chrF/chrF++ (reference ``text/chrf.py:32``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> metric = CHRFScore()
+        >>> metric.update(["the cat"], [["the cat"]])
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -354,7 +397,17 @@ class CHRFScore(_HostTextMetric):
 
 
 class SQuAD(_HostTextMetric):
-    """SQuAD EM/F1 (reference ``text/squad.py:29``)."""
+    """SQuAD EM/F1 (reference ``text/squad.py:29``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SQuAD
+        >>> preds = [{"prediction_text": "the cat", "id": "1"}]
+        >>> target = [{"answers": {"answer_start": [0], "text": ["the cat"]}, "id": "1"}]
+        >>> metric = SQuAD()
+        >>> metric.update(preds, target)
+        >>> {k: float(v) for k, v in sorted(metric.compute().items())}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -459,7 +512,15 @@ class ROUGEScore(_HostTextMetric):
 
 
 class TranslationEditRate(_HostTextMetric):
-    """TER (reference ``text/ter.py:30``)."""
+    """TER (reference ``text/ter.py:30``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on a mat"]])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.1667
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0

@@ -10,7 +10,17 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MinMaxMetric(WrapperMetric):
-    """Track the min and max of the wrapped metric's compute over time (reference ``minmax.py:29``)."""
+    """Track the min and max of the wrapped metric's compute over time (reference ``minmax.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> metric.update(np.array([0.1, 0.4, 0.35, 0.8], np.float32), np.array([0, 0, 1, 1]))
+        >>> {k: float(v) for k, v in sorted(metric.compute().items())}
+        {'max': 0.75, 'min': 0.75, 'raw': 0.75}
+    """
 
     full_state_update = True
 

@@ -22,7 +22,18 @@ def _get_nan_indices(*tensors) -> jnp.ndarray:
 
 
 class MultioutputWrapper(WrapperMetric):
-    """Evaluate one metric per output column (reference ``multioutput.py:43``)."""
+    """Evaluate one metric per output column (reference ``multioutput.py:43``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import MultioutputWrapper
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(np.array([[2.5, 0.0], [2.0, 8.0]], np.float32),
+        ...               np.array([[3.0, -0.5], [2.0, 7.0]], np.float32))
+        >>> [round(float(v), 4) for v in np.asarray(metric.compute())]
+        [0.125, 0.625]
+    """
 
     is_differentiable = False
 

@@ -238,7 +238,16 @@ class RunningMean(_Running):
 
 
 class RunningSum(_Running):
-    """Sum over a running window (reference ``aggregation.py:673``)."""
+    """Sum over a running window (reference ``aggregation.py:673``).
+
+    Example:
+        >>> from torchmetrics_tpu.aggregation import RunningSum
+        >>> metric = RunningSum(window=2)
+        >>> for v in (1.0, 2.0, 5.0):
+        ...     metric.update(v)
+        >>> float(metric.compute())  # sum of the last 2 values
+        7.0
+    """
 
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

@@ -100,7 +100,19 @@ class ScaleInvariantSignalNoiseRatio(_MeanOverSamplesMetric):
 
 
 class ComplexScaleInvariantSignalNoiseRatio(_MeanOverSamplesMetric):
-    """C-SI-SNR (reference ``audio/snr.py:232``)."""
+    """C-SI-SNR (reference ``audio/snr.py:232``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio
+        >>> rng = np.random.RandomState(42)
+        >>> target = rng.randn(1, 10, 20, 2).astype(np.float32)  # (..., freq, time, re/im)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(1, 10, 20, 2).astype(np.float32)
+        >>> metric = ComplexScaleInvariantSignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        24.69
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -116,7 +128,19 @@ class ComplexScaleInvariantSignalNoiseRatio(_MeanOverSamplesMetric):
 
 
 class SignalDistortionRatio(_MeanOverSamplesMetric):
-    """SDR (reference ``audio/sdr.py:37``)."""
+    """SDR (reference ``audio/sdr.py:37``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+        >>> rng = np.random.RandomState(1)
+        >>> target = rng.randn(8000).astype(np.float32)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(8000).astype(np.float32)
+        >>> metric = SignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        25.34
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -168,7 +192,19 @@ class ScaleInvariantSignalDistortionRatio(_MeanOverSamplesMetric):
 
 
 class SourceAggregatedSignalDistortionRatio(_MeanOverSamplesMetric):
-    """SA-SDR (reference ``audio/sdr.py:282``)."""
+    """SA-SDR (reference ``audio/sdr.py:282``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+        >>> rng = np.random.RandomState(42)
+        >>> target = rng.randn(1, 2, 200).astype(np.float32)  # (batch, sources, time)
+        >>> preds = target * 0.9 + 0.05 * rng.randn(1, 2, 200).astype(np.float32)
+        >>> metric = SourceAggregatedSignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        24.69
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -189,7 +225,20 @@ class SourceAggregatedSignalDistortionRatio(_MeanOverSamplesMetric):
 
 
 class PermutationInvariantTraining(_MeanOverSamplesMetric):
-    """PIT (reference ``audio/pit.py:30``)."""
+    """PIT (reference ``audio/pit.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> preds = np.array([[[0.6, 0.4, 0.2], [0.2, 0.4, 0.6]]], np.float32)
+        >>> target = np.array([[[0.2, 0.4, 0.6], [0.6, 0.4, 0.2]]], np.float32)
+        >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio,
+        ...                                       eval_func='max')
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.2f}")
+        58.27
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -227,7 +276,15 @@ class PermutationInvariantTraining(_MeanOverSamplesMetric):
 
 
 class PerceptualEvaluationSpeechQuality(_MeanOverSamplesMetric):
-    """PESQ (reference ``audio/pesq.py:29``); requires the host ``pesq`` package."""
+    """PESQ (reference ``audio/pesq.py:29``); requires the host ``pesq`` package.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+        >>> metric = PerceptualEvaluationSpeechQuality(8000, 'nb')  # needs `pesq`  # doctest: +SKIP
+        >>> metric.update(np.random.randn(8000), np.random.randn(8000))  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -249,7 +306,15 @@ class PerceptualEvaluationSpeechQuality(_MeanOverSamplesMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_MeanOverSamplesMetric):
-    """STOI (reference ``audio/stoi.py:29``); requires the host ``pystoi`` package."""
+    """STOI (reference ``audio/stoi.py:29``); requires the host ``pystoi`` package.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+        >>> metric = ShortTimeObjectiveIntelligibility(8000)  # needs `pystoi`  # doctest: +SKIP
+        >>> metric.update(np.random.randn(8000), np.random.randn(8000))  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -273,6 +338,16 @@ class SpeechReverberationModulationEnergyRatio(_MeanOverSamplesMetric):
 
     Backed by the self-contained gammatone/modulation pipeline in
     ``functional/audio/srmr.py`` — no external DSP packages needed.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+        >>> rng = np.random.RandomState(0)
+        >>> speech = rng.randn(8000).astype(np.float32)
+        >>> metric = SpeechReverberationModulationEnergyRatio(fs=8000)
+        >>> metric.update(speech)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.3171
     """
 
     is_differentiable = False

@@ -162,7 +162,18 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``auroc.py:471``)."""
+    """Task dispatcher (reference ``auroc.py:471``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import AUROC
+        >>> metric = AUROC(task='binary')
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -124,7 +124,18 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
 
 
 class AveragePrecision(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``average_precision.py:476``)."""
+    """Task dispatcher (reference ``average_precision.py:476``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import AveragePrecision
+        >>> metric = AveragePrecision(task='binary')
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.8333
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

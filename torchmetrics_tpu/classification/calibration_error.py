@@ -127,7 +127,18 @@ class MulticlassCalibrationError(_CalibrationErrorBase):
 
 
 class CalibrationError(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``calibration_error.py:342``)."""
+    """Task dispatcher (reference ``calibration_error.py:342``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import CalibrationError
+        >>> metric = CalibrationError(task='binary', n_bins=2)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0125
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

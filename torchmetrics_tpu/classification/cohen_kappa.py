@@ -74,7 +74,19 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``cohen_kappa.py:287``)."""
+    """Task dispatcher (reference ``cohen_kappa.py:287``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import CohenKappa
+        >>> metric = CohenKappa(task='multiclass', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6364
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

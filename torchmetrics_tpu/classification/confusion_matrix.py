@@ -151,7 +151,19 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``confusion_matrix.py:470``)."""
+    """Task dispatcher (reference ``confusion_matrix.py:470``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import ConfusionMatrix
+        >>> metric = ConfusionMatrix(task='multiclass', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> np.asarray(metric.compute()).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

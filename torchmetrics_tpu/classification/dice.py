@@ -19,6 +19,16 @@ class Dice(Metric):
     ``average`` ∈ micro/macro/none/samples; ``ignore_index`` drops that class's statistics
     (legacy semantics). ``num_classes`` is required for probabilistic multiclass preds only when
     it cannot be inferred from the class dimension.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import Dice
+        >>> metric = Dice()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
     """
 
     is_differentiable = False

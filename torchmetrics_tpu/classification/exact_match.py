@@ -109,7 +109,16 @@ class MultilabelExactMatch(_AbstractExactMatch):
 
 
 class ExactMatch(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``exact_match.py:367``)."""
+    """Task dispatcher (reference ``exact_match.py:367``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu import ExactMatch
+        >>> metric = ExactMatch(task='multilabel', num_labels=2)
+        >>> metric.update(np.array([[0, 1], [1, 1]]), np.array([[0, 1], [0, 1]]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5000
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

@@ -133,7 +133,19 @@ class MultilabelF1Score(MultilabelFBetaScore):
 
 
 class FBetaScore(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``f_beta.py:1026``)."""
+    """Task dispatcher (reference ``f_beta.py:1026``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import FBetaScore
+        >>> metric = FBetaScore(task='multiclass', num_classes=3, beta=0.5)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, beta: float = 1.0, threshold: float = 0.5, num_classes: Optional[int] = None,
@@ -160,7 +172,19 @@ class FBetaScore(_ClassificationTaskWrapper):
 
 
 class F1Score(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``f_beta.py:1090``)."""
+    """Task dispatcher (reference ``f_beta.py:1090``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import F1Score
+        >>> metric = F1Score(task='multiclass', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7500
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

@@ -54,7 +54,19 @@ class MultilabelHammingDistance(MultilabelStatScores):
 
 
 class HammingDistance(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``hamming.py:468``)."""
+    """Task dispatcher (reference ``hamming.py:468``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import HammingDistance
+        >>> metric = HammingDistance(task='multiclass', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.2500
+    """
 
     def __new__(  # type: ignore[misc]
         cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,

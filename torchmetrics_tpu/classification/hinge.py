@@ -100,7 +100,18 @@ class MulticlassHingeLoss(Metric):
 
 
 class HingeLoss(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``hinge.py:323``)."""
+    """Task dispatcher (reference ``hinge.py:323``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu import HingeLoss
+        >>> preds = np.array([0.25, 0.25, 0.55, 0.75, 0.75], np.float32)
+        >>> target = np.array([0, 0, 1, 1, 1])
+        >>> metric = HingeLoss(task='binary')
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6900
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -136,7 +136,18 @@ class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
 
 
 class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``precision_fixed_recall.py:469``)."""
+    """Task dispatcher (reference ``precision_fixed_recall.py:469``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import PrecisionAtFixedRecall
+        >>> metric = PrecisionAtFixedRecall(task='binary', min_recall=0.5, thresholds=4)
+        >>> metric.update(preds, target)
+        >>> [round(float(v), 4) for v in metric.compute()]  # (precision, threshold)
+        [1.0, 0.6667]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -242,7 +242,19 @@ class MultilabelPrecisionRecallCurve(Metric):
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``precision_recall_curve.py:616``)."""
+    """Task dispatcher (reference ``precision_recall_curve.py:616``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import PrecisionRecallCurve
+        >>> metric = PrecisionRecallCurve(task='binary', thresholds=4)
+        >>> metric.update(preds, target)
+        >>> precision, recall, thresholds = metric.compute()
+        >>> np.asarray(precision, np.float64).round(4).tolist()
+        [0.5, 0.6667, 1.0, 0.0, 1.0]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

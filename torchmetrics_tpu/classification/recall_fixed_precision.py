@@ -124,7 +124,18 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``recall_fixed_precision.py:468``)."""
+    """Task dispatcher (reference ``recall_fixed_precision.py:468``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import RecallAtFixedPrecision
+        >>> metric = RecallAtFixedPrecision(task='binary', min_precision=0.5, thresholds=4)
+        >>> metric.update(preds, target)
+        >>> [round(float(v), 4) for v in metric.compute()]  # (recall, threshold)
+        [1.0, 0.3333]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

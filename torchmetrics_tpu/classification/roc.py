@@ -66,7 +66,19 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
 
 
 class ROC(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``roc.py:496``)."""
+    """Task dispatcher (reference ``roc.py:496``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import ROC
+        >>> metric = ROC(task='binary', thresholds=4)
+        >>> metric.update(preds, target)
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> np.asarray(tpr, np.float64).round(4).tolist()
+        [0.0, 0.5, 1.0, 1.0]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

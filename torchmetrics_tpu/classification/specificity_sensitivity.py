@@ -128,7 +128,18 @@ class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
 
 
 class SpecificityAtSensitivity(_ClassificationTaskWrapper):
-    """Task dispatcher (reference ``specificity_sensitivity.py:330``)."""
+    """Task dispatcher (reference ``specificity_sensitivity.py:330``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu import SpecificityAtSensitivity
+        >>> metric = SpecificityAtSensitivity(task='binary', min_sensitivity=0.5, thresholds=4)
+        >>> metric.update(preds, target)
+        >>> [round(float(v), 4) for v in metric.compute()]  # (specificity, threshold)
+        [1.0, 0.6667]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -193,7 +193,19 @@ class MultilabelStatScores(_AbstractStatScores):
 
 
 class StatScores(_ClassificationTaskWrapper):
-    """Task dispatcher: ``StatScores(task="binary"|...)`` (reference ``stat_scores.py:491``)."""
+    """Task dispatcher: ``StatScores(task="binary"|...)`` (reference ``stat_scores.py:491``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu import StatScores
+        >>> metric = StatScores(task='multiclass', num_classes=3, average='micro')
+        >>> metric.update(preds, target)
+        >>> np.asarray(metric.compute()).tolist()  # [tp, fp, tn, fn, support]
+        [3, 1, 7, 1, 4]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -111,7 +111,18 @@ class AdjustedRandScore(_LabelPairMetric):
 
 
 class AdjustedMutualInfoScore(_LabelPairMetric):
-    """Adjusted mutual info (reference ``clustering/adjusted_mutual_info_score.py:31``)."""
+    """Adjusted mutual info (reference ``clustering/adjusted_mutual_info_score.py:31``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0, 0, 1, 1])
+        >>> target = np.array([0, 0, 1, 2])
+        >>> from torchmetrics_tpu.clustering import AdjustedMutualInfoScore
+        >>> metric = AdjustedMutualInfoScore()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5714
+    """
 
     plot_lower_bound = -1.0
 
@@ -150,28 +161,72 @@ class NormalizedMutualInfoScore(_LabelPairMetric):
 
 
 class FowlkesMallowsIndex(_LabelPairMetric):
-    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``)."""
+    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0, 0, 1, 1])
+        >>> target = np.array([0, 0, 1, 2])
+        >>> from torchmetrics_tpu.clustering import FowlkesMallowsIndex
+        >>> metric = FowlkesMallowsIndex()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7071
+    """
 
     def _functional(self, preds, target):
         return fowlkes_mallows_index(preds, target)
 
 
 class HomogeneityScore(_LabelPairMetric):
-    """Homogeneity score (reference ``clustering/homogeneity_completeness_v_measure.py:30``)."""
+    """Homogeneity score (reference ``clustering/homogeneity_completeness_v_measure.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0, 0, 1, 1])
+        >>> target = np.array([0, 0, 1, 2])
+        >>> from torchmetrics_tpu.clustering import HomogeneityScore
+        >>> metric = HomogeneityScore()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6667
+    """
 
     def _functional(self, preds, target):
         return homogeneity_score(preds, target)
 
 
 class CompletenessScore(_LabelPairMetric):
-    """Completeness score (reference ``clustering/homogeneity_completeness_v_measure.py:126``)."""
+    """Completeness score (reference ``clustering/homogeneity_completeness_v_measure.py:126``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0, 0, 1, 1])
+        >>> target = np.array([0, 0, 1, 2])
+        >>> from torchmetrics_tpu.clustering import CompletenessScore
+        >>> metric = CompletenessScore()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def _functional(self, preds, target):
         return completeness_score(preds, target)
 
 
 class VMeasureScore(_LabelPairMetric):
-    """V-measure (reference ``clustering/homogeneity_completeness_v_measure.py:226``)."""
+    """V-measure (reference ``clustering/homogeneity_completeness_v_measure.py:226``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0, 0, 1, 1])
+        >>> target = np.array([0, 0, 1, 2])
+        >>> from torchmetrics_tpu.clustering import VMeasureScore
+        >>> metric = VMeasureScore()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.8000
+    """
 
     def __init__(self, beta: Union[int, float] = 1.0, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -203,14 +258,36 @@ class _DataLabelMetric(Metric):
 
 
 class CalinskiHarabaszScore(_DataLabelMetric):
-    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``)."""
+    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> data = np.array([[0.0, 0.0], [0.5, 0.0], [8.0, 8.0], [8.5, 8.0]], np.float32)
+        >>> labels = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+        >>> metric = CalinskiHarabaszScore()
+        >>> metric.update(data, labels)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1024.0000
+    """
 
     def _compute(self, state):
         return calinski_harabasz_score(state["data"], state["labels"])
 
 
 class DaviesBouldinScore(_DataLabelMetric):
-    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``)."""
+    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> data = np.array([[0.0, 0.0], [0.5, 0.0], [8.0, 8.0], [8.5, 8.0]], np.float32)
+        >>> labels = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+        >>> metric = DaviesBouldinScore()
+        >>> metric.update(data, labels)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0442
+    """
 
     higher_is_better = False
 
@@ -219,7 +296,18 @@ class DaviesBouldinScore(_DataLabelMetric):
 
 
 class DunnIndex(_DataLabelMetric):
-    """Dunn index (reference ``clustering/dunn_index.py:29``)."""
+    """Dunn index (reference ``clustering/dunn_index.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> data = np.array([[0.0, 0.0], [0.5, 0.0], [8.0, 8.0], [8.5, 8.0]], np.float32)
+        >>> labels = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.clustering import DunnIndex
+        >>> metric = DunnIndex()
+        >>> metric.update(data, labels)
+        >>> print(f"{float(metric.compute()):.4f}")
+        45.2548
+    """
 
     def __init__(self, p: Union[int, float] = 2, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -152,7 +152,20 @@ class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
 
 
 class DistanceIntersectionOverUnion(IntersectionOverUnion):
-    """DIoU (reference ``detection/diou.py:30``)."""
+    """DIoU (reference ``detection/diou.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import DistanceIntersectionOverUnion
+        >>> preds = [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]], np.float32),
+        ...           "scores": np.array([0.9], np.float32), "labels": np.array([0])}]
+        >>> target = [{"boxes": np.array([[0.0, 0.0, 10.0, 8.0]], np.float32),
+        ...            "labels": np.array([0])}]
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()['diou']):.4f}")
+        0.7950
+    """
 
     _iou_type = "diou"
     _invalid_val = -1.0
@@ -160,7 +173,20 @@ class DistanceIntersectionOverUnion(IntersectionOverUnion):
 
 
 class CompleteIntersectionOverUnion(IntersectionOverUnion):
-    """CIoU (reference ``detection/ciou.py:30``)."""
+    """CIoU (reference ``detection/ciou.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import CompleteIntersectionOverUnion
+        >>> preds = [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]], np.float32),
+        ...           "scores": np.array([0.9], np.float32), "labels": np.array([0])}]
+        >>> target = [{"boxes": np.array([[0.0, 0.0, 10.0, 8.0]], np.float32),
+        ...            "labels": np.array([0])}]
+        >>> metric = CompleteIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()['ciou']):.4f}")
+        0.7949
+    """
 
     _iou_type = "ciou"
     _invalid_val = -2.0  # CIoU can be < -1 (reference ciou.py:102)

@@ -116,7 +116,21 @@ def _next_pow2(n: int) -> int:
 
 
 class MeanAveragePrecision(Metric):
-    """mAP / mAR for object detection and instance segmentation (reference ``mean_ap.py:76``)."""
+    """mAP / mAR for object detection and instance segmentation (reference ``mean_ap.py:76``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]], np.float32),
+        ...           "scores": np.array([0.9], np.float32), "labels": np.array([0])}]
+        >>> target = [{"boxes": np.array([[0.0, 0.0, 10.0, 8.0]], np.float32),
+        ...            "labels": np.array([0])}]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> print(f"{float(result['map']):.4f} {float(result['map_50']):.4f}")
+        0.6000 1.0000
+    """
 
     is_differentiable = False
     higher_is_better = True

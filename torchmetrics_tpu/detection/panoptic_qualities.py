@@ -92,6 +92,17 @@ class PanopticQuality(Metric):
 
 
 class ModifiedPanopticQuality(PanopticQuality):
-    """Modified PQ: stuff classes scored without segment matching (reference ``panoptic_qualities.py:220``)."""
+    """Modified PQ: stuff classes scored without segment matching (reference ``panoptic_qualities.py:220``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.detection import ModifiedPanopticQuality
+        >>> preds = np.array([[[6, 0], [0, 0], [6, 0], [7, 0]]])
+        >>> target = np.array([[[6, 0], [0, 1], [6, 0], [7, 0]]])
+        >>> metric = ModifiedPanopticQuality(things={6, 7}, stuffs={0})
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     _modified_stuffs = True

@@ -177,6 +177,21 @@ class FrechetInceptionDistance(_FeatureStatsMetric):
     (``_kahan_add``), recovering near-fp64 effective precision on TPUs that have no fast fp64:
     streaming-vs-fp64-oracle parity holds at ≤1e-4 (the reference stores fp64 sums instead,
     ``fid.py:314-320``).
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import FrechetInceptionDistance
+        >>> def feat(imgs):  # any callable imgs -> (N, d) features works
+        ...     x = jnp.asarray(imgs, jnp.float32) / 255.0
+        ...     return x.reshape(x.shape[0], 3, -1).mean(-1)
+        >>> rng = np.random.RandomState(0)
+        >>> real = rng.randint(0, 200, (16, 3, 8, 8)).astype(np.uint8)
+        >>> fake = rng.randint(50, 255, (16, 3, 8, 8)).astype(np.uint8)
+        >>> metric = FrechetInceptionDistance(feature=feat)
+        >>> metric.update(real, real=True)
+        >>> metric.update(fake, real=False)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.1311
     """
 
     higher_is_better = False
@@ -274,7 +289,24 @@ def _poly_mmd(f_real: Array, f_fake: Array, degree: int, gamma: Optional[float],
 
 
 class KernelInceptionDistance(_FeatureStatsMetric):
-    """KID (reference ``image/kid.py:70``): subset-resampled polynomial MMD over feature lists."""
+    """KID (reference ``image/kid.py:70``): subset-resampled polynomial MMD over feature lists.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import KernelInceptionDistance
+        >>> def feat(imgs):
+        ...     x = jnp.asarray(imgs, jnp.float32) / 255.0
+        ...     return x.reshape(x.shape[0], 3, -1).mean(-1)
+        >>> rng = np.random.RandomState(0)
+        >>> real = rng.randint(0, 200, (16, 3, 8, 8)).astype(np.uint8)
+        >>> fake = rng.randint(50, 255, (16, 3, 8, 8)).astype(np.uint8)
+        >>> metric = KernelInceptionDistance(feature=feat, subsets=2, subset_size=16)
+        >>> metric.update(real, real=True)
+        >>> metric.update(fake, real=False)
+        >>> kid_mean, kid_std = metric.compute()
+        >>> print(f"{float(kid_mean):.4f}")
+        0.2825
+    """
 
     higher_is_better = False
     is_differentiable = False
@@ -342,6 +374,20 @@ class InceptionScore(Metric):
 
     ``feature`` must be a callable producing *logits* ``(N, num_classes)`` (the reference's
     default is the InceptionV3 ``logits_unbiased`` head) or ``None`` for pre-extracted logits.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import InceptionScore
+        >>> def feat(imgs):  # stands in for the logits head
+        ...     x = jnp.asarray(imgs, jnp.float32) / 255.0
+        ...     return x.reshape(x.shape[0], 3, -1).mean(-1)
+        >>> rng = np.random.RandomState(0)
+        >>> imgs = rng.randint(0, 200, (16, 3, 8, 8)).astype(np.uint8)
+        >>> metric = InceptionScore(feature=feat, splits=1)
+        >>> metric.update(imgs)
+        >>> score_mean, score_std = metric.compute()
+        >>> print(f"{float(score_mean):.4f}")
+        1.0002
     """
 
     higher_is_better = True
@@ -419,7 +465,23 @@ def _cosine_distance(features1: Array, features2: Array, eps: float = 0.1) -> Ar
 
 
 class MemorizationInformedFrechetInceptionDistance(_FeatureStatsMetric):
-    """MiFID (reference ``image/mifid.py:66``): FID penalised by train-set memorisation."""
+    """MiFID (reference ``image/mifid.py:66``): FID penalised by train-set memorisation.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import MemorizationInformedFrechetInceptionDistance
+        >>> def feat(imgs):
+        ...     x = jnp.asarray(imgs, jnp.float32) / 255.0
+        ...     return x.reshape(x.shape[0], 3, -1).mean(-1)
+        >>> rng = np.random.RandomState(0)
+        >>> real = rng.randint(0, 200, (16, 3, 8, 8)).astype(np.uint8)
+        >>> fake = rng.randint(50, 255, (16, 3, 8, 8)).astype(np.uint8)
+        >>> metric = MemorizationInformedFrechetInceptionDistance(feature=feat)
+        >>> metric.update(real, real=True)
+        >>> metric.update(fake, real=False)
+        >>> print(f"{float(metric.compute()):.4f}")
+        257.8099
+    """
 
     higher_is_better = False
     is_differentiable = False
@@ -464,6 +526,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     ``net`` must be a callable ``(img1, img2) -> (N,)`` per-image distances (a flax/JAX port of
     the learned AlexNet/VGG distance, or a host callback). The reference's pretrained
     ``net_type`` strings raise the same no-weights contract as the FID extractor.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+        >>> metric = LearnedPerceptualImagePatchSimilarity(net_type='alex')  # doctest: +SKIP
+        >>> img1 = np.random.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1
+        >>> img2 = np.random.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1
+        >>> metric.update(img1, img2)  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
     """
 
     higher_is_better = False
@@ -612,7 +683,13 @@ def perceptual_path_length(
 
 
 class PerceptualPathLength(Metric):
-    """PPL module form (reference ``image/perceptual_path_length.py:32``): compute-only metric."""
+    """PPL module form (reference ``image/perceptual_path_length.py:32``): compute-only metric.
+
+    Example:
+        >>> from torchmetrics_tpu.image import PerceptualPathLength
+        >>> metric = PerceptualPathLength(generator, num_samples=8)  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     higher_is_better = False
     is_differentiable = False

@@ -120,7 +120,19 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM (reference ``image/ssim.py:220``)."""
+    """MS-SSIM (reference ``image/ssim.py:220``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> target = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, betas=(0.5, 0.5))
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0258
+    """
 
     higher_is_better = True
     is_differentiable = True
@@ -269,7 +281,19 @@ class PeakSignalNoiseRatio(Metric):
 
 
 class PeakSignalNoiseRatioWithBlockedEffect(Metric):
-    """PSNR-B (reference ``image/psnrb.py:33``)."""
+    """PSNR-B (reference ``image/psnrb.py:33``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = PeakSignalNoiseRatioWithBlockedEffect(block_size=8)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        7.0466
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -400,7 +424,19 @@ class SpectralAngleMapper(Metric):
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
-    """ERGAS (reference ``image/ergas.py:32``)."""
+    """ERGAS (reference ``image/ergas.py:32``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> metric = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.1f}")
+        331.2
+    """
 
     higher_is_better = False
     is_differentiable = True
@@ -423,7 +459,19 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
 
 
 class RelativeAverageSpectralError(Metric):
-    """RASE (reference ``image/rase.py:28``)."""
+    """RASE (reference ``image/rase.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import RelativeAverageSpectralError
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> metric = RelativeAverageSpectralError()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.1f}")
+        5278.6
+    """
 
     higher_is_better = False
     is_differentiable = True
@@ -450,6 +498,17 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
 
     The reference also carries a lazily-created ``rmse_map`` buffer that its ``compute`` never
     returns (``image/rmse_sw.py:82-95``); only the scalar accumulators are kept here.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import RootMeanSquaredErrorUsingSlidingWindow
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> target = rng.rand(1, 1, 16, 16).astype(np.float32)
+        >>> metric = RootMeanSquaredErrorUsingSlidingWindow(window_size=8)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.4485
     """
 
     higher_is_better = False
@@ -477,7 +536,19 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
 
 
 class SpectralDistortionIndex(Metric):
-    """D-lambda (reference ``image/d_lambda.py:30``)."""
+    """D-lambda (reference ``image/d_lambda.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import SpectralDistortionIndex
+        >>> rng = np.random.RandomState(42)
+        >>> preds = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> target = rng.rand(2, 3, 32, 32).astype(np.float32)
+        >>> metric = SpectralDistortionIndex()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0404
+    """
 
     higher_is_better = True
     is_differentiable = True
@@ -556,7 +627,19 @@ class TotalVariation(Metric):
 
 
 class VisualInformationFidelity(Metric):
-    """VIF-p (reference ``image/vif.py:30``)."""
+    """VIF-p (reference ``image/vif.py:30``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.image import VisualInformationFidelity
+        >>> rng = np.random.RandomState(7)
+        >>> preds = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> target = rng.rand(1, 1, 48, 48).astype(np.float32)
+        >>> metric = VisualInformationFidelity()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0031
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -18,7 +18,16 @@ from torchmetrics_tpu.metric import Metric
 
 
 class CLIPScore(Metric):
-    """CLIPScore (reference ``multimodal/clip_score.py:43``): streaming sum + count states."""
+    """CLIPScore (reference ``multimodal/clip_score.py:43``): streaming sum + count states.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.multimodal import CLIPScore
+        >>> metric = CLIPScore()  # needs a cached HF CLIP checkpoint  # doctest: +SKIP
+        >>> images = [np.random.randint(0, 255, (3, 224, 224)).astype(np.uint8)]
+        >>> metric.update(images, ['a photo of a cat'])  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -49,7 +58,16 @@ class CLIPScore(Metric):
 
 
 class CLIPImageQualityAssessment(Metric):
-    """CLIP-IQA (reference ``multimodal/clip_iqa.py:56``): cat-state of per-image prompt probs."""
+    """CLIP-IQA (reference ``multimodal/clip_iqa.py:56``): cat-state of per-image prompt probs.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+        >>> metric = CLIPImageQualityAssessment(  # needs a cached HF CLIP checkpoint
+        ...     model_name_or_path='openai/clip-vit-base-patch16')  # doctest: +SKIP
+        >>> metric.update(np.random.rand(1, 3, 224, 224).astype(np.float32))  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     is_differentiable = False
     higher_is_better = True

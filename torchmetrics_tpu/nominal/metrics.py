@@ -87,7 +87,16 @@ class CramersV(_ConfmatNominalMetric):
 
 
 class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
-    """Pearson's contingency coefficient (reference ``nominal/pearson.py:28``)."""
+    """Pearson's contingency coefficient (reference ``nominal/pearson.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.nominal import PearsonsContingencyCoefficient
+        >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+        >>> metric.update(np.array([0, 1, 2, 0, 1]), np.array([0, 1, 2, 0, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.7454
+    """
 
     def _update_fn(self, preds, target):
         return _pearsons_contingency_coefficient_update(
@@ -118,7 +127,16 @@ class TheilsU(_ConfmatNominalMetric):
 
 
 class TschuprowsT(_ConfmatNominalMetric):
-    """Tschuprow's T (reference ``nominal/tschuprows.py:28``)."""
+    """Tschuprow's T (reference ``nominal/tschuprows.py:28``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.nominal import TschuprowsT
+        >>> metric = TschuprowsT(num_classes=3)
+        >>> metric.update(np.array([0, 1, 2, 0, 1]), np.array([0, 1, 2, 0, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5000
+    """
 
     def __init__(
         self,

@@ -183,7 +183,18 @@ class MinkowskiDistance(Metric):
 
 
 class TweedieDevianceScore(Metric):
-    """Tweedie deviance (reference ``tweedie_deviance.py:25``)."""
+    """Tweedie deviance (reference ``tweedie_deviance.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+        >>> preds = np.array([2.5, 0.1, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, 0.1, 2.0, 7.0], np.float32)
+        >>> metric = TweedieDevianceScore(power=1.0)
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0561
+    """
 
     is_differentiable = True
     higher_is_better = None

@@ -89,7 +89,18 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredLogError(Metric):
-    """MSLE (reference ``log_mse.py:25``)."""
+    """MSLE (reference ``log_mse.py:25``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+        >>> metric = MeanSquaredLogError()
+        >>> metric.update(preds, np.clip(target, 0, None))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0079
+    """
 
     is_differentiable = True
     higher_is_better = False

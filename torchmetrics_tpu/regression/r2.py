@@ -74,7 +74,18 @@ class R2Score(Metric):
 
 
 class RelativeSquaredError(Metric):
-    """RSE (reference ``rse.py:26``)."""
+    """RSE (reference ``rse.py:26``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+        >>> target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+        >>> from torchmetrics_tpu.regression import RelativeSquaredError
+        >>> metric = RelativeSquaredError()
+        >>> metric.update(preds, target)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.0514
+    """
 
     is_differentiable = True
     higher_is_better = False

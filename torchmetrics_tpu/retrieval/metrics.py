@@ -65,7 +65,19 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py``)."""
+    """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> metric = RetrievalMRR()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
@@ -81,7 +93,19 @@ class RetrievalMRR(RetrievalMetric):
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """precision@k (reference ``retrieval/precision.py``)."""
+    """precision@k (reference ``retrieval/precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecision
+        >>> metric = RetrievalPrecision()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.6667
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, adaptive_k: bool = False, aggregation="mean",
@@ -101,7 +125,19 @@ class RetrievalPrecision(RetrievalMetric):
 
 
 class RetrievalRecall(RetrievalMetric):
-    """recall@k (reference ``retrieval/recall.py``)."""
+    """recall@k (reference ``retrieval/recall.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecall
+        >>> metric = RetrievalRecall()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
@@ -118,7 +154,19 @@ class RetrievalRecall(RetrievalMetric):
 
 class RetrievalFallOut(RetrievalMetric):
     """fall-out@k (reference ``retrieval/fall_out.py``); empty-*positive* queries handled on the
-    negative-target axis: `empty_target_action` applies to queries with no NEGATIVE targets."""
+    negative-target axis: `empty_target_action` applies to queries with no NEGATIVE targets.
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalFallOut
+        >>> metric = RetrievalFallOut()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     higher_is_better = False
 
@@ -151,7 +199,19 @@ class RetrievalFallOut(RetrievalMetric):
 
 
 class RetrievalHitRate(RetrievalMetric):
-    """hit-rate@k (reference ``retrieval/hit_rate.py``)."""
+    """hit-rate@k (reference ``retrieval/hit_rate.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalHitRate
+        >>> metric = RetrievalHitRate()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation="mean", **kwargs: Any) -> None:
@@ -167,7 +227,19 @@ class RetrievalHitRate(RetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-precision (reference ``retrieval/r_precision.py``)."""
+    """R-precision (reference ``retrieval/r_precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalRPrecision
+        >>> metric = RetrievalRPrecision()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     def _metric_kernel(self, preds, target, mask):
         return r_precision_kernel(preds, target, mask)
@@ -206,7 +278,20 @@ class RetrievalNormalizedDCG(RetrievalMetric):
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
-    """Averaged precision/recall at k=1..max_k (reference ``retrieval/precision_recall_curve.py``)."""
+    """Averaged precision/recall at k=1..max_k (reference ``retrieval/precision_recall_curve.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> metric = RetrievalPrecisionRecallCurve(max_k=3)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> precision, recall, top_k = metric.compute()
+        >>> np.asarray(top_k).tolist()
+        [1, 2, 3]
+    """
 
     def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False,
                  empty_target_action: str = "neg", ignore_index: Optional[int] = None,
@@ -290,7 +375,19 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     """(max recall, best k) such that precision >= min_precision (reference
-    ``retrieval/recall_fixed_precision.py``)."""
+    ``retrieval/recall_fixed_precision.py``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.2, 0.3, 0.5], np.float32)
+        >>> target = np.array([0, 1, 1])
+        >>> indexes = np.array([0, 0, 0])
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> [round(float(v), 4) for v in metric.compute()]  # (recall, top_k)
+        [1.0, 2.0]
+    """
 
     def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None,
                  adaptive_k: bool = False, empty_target_action: str = "neg",

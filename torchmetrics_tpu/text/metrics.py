@@ -228,7 +228,15 @@ class _WordInfoMetric(_HostTextMetric):
 
 
 class WordInfoLost(_WordInfoMetric):
-    """WIL (reference ``text/wil.py:28``)."""
+    """WIL (reference ``text/wil.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.4375
+    """
 
     higher_is_better = False
 
@@ -237,7 +245,15 @@ class WordInfoLost(_WordInfoMetric):
 
 
 class WordInfoPreserved(_WordInfoMetric):
-    """WIP (reference ``text/wip.py:28``)."""
+    """WIP (reference ``text/wip.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.5625
+    """
 
     higher_is_better = True
 
@@ -436,6 +452,13 @@ class ROUGEScore(_HostTextMetric):
 
     List states per ``{rouge_key}_{precision,recall,fmeasure}`` triple, ``dist_reduce_fx=None``
     (reference ``text/rouge.py:143``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> metric = ROUGEScore(rouge_keys=('rouge1',))
+        >>> metric.update("the cat sat", "a cat sat")
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'rouge1_fmeasure': 0.6667, 'rouge1_precision': 0.6667, 'rouge1_recall': 0.6667}
     """
 
     higher_is_better = True
@@ -581,7 +604,15 @@ class TranslationEditRate(_HostTextMetric):
 
 
 class ExtendedEditDistance(_HostTextMetric):
-    """EED (reference ``text/eed.py:27``)."""
+    """EED (reference ``text/eed.py:27``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.3835
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -699,6 +730,23 @@ class BERTScore(_SentenceStoreTextMetric):
 
     Sentences accumulate on the host (see the base class); the greedy cosine matching runs as
     jnp MXU matmuls at compute time.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import BERTScore
+        >>> table = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+        >>> def toy_encoder(sentences):  # any callable (sentences) -> (emb, mask) works
+        ...     rows = [[hash(w) % 64 for w in s.split()] for s in sentences]
+        ...     width = max(len(r) for r in rows)
+        ...     emb = np.zeros((len(rows), width, 8), np.float32)
+        ...     mask = np.zeros((len(rows), width), np.int32)
+        ...     for i, r in enumerate(rows):
+        ...         emb[i, :len(r)], mask[i, :len(r)] = table[r], 1
+        ...     return jnp.asarray(emb), jnp.asarray(mask)
+        >>> metric = BERTScore(encoder=toy_encoder)
+        >>> metric.update(["the cat sat"], ["the cat sat"])
+        >>> print(f"{float(np.asarray(metric.compute()['f1']).reshape(-1)[0]):.4f}")
+        1.0000
     """
 
     higher_is_better = True
@@ -800,7 +848,14 @@ class BERTScore(_SentenceStoreTextMetric):
 
 class InfoLM(_SentenceStoreTextMetric):
     """InfoLM (reference ``text/infolm.py:40``): pluggable masked-LM design with the
-    reference's defaults (``bert-base-uncased``, ``temperature=0.25``, ``idf=True``)."""
+    reference's defaults (``bert-base-uncased``, ``temperature=0.25``, ``idf=True``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import InfoLM
+        >>> metric = InfoLM('google/bert_uncased_L-2_H-128_A-2', idf=False)  # doctest: +SKIP
+        >>> metric.update(['he read the book'], ['he reads the book'])  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0

@@ -22,7 +22,19 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 
 class BootStrapper(WrapperMetric):
-    """Bootstrapped confidence estimates of any metric (reference ``bootstrapping.py:54``)."""
+    """Bootstrapped confidence estimates of any metric (reference ``bootstrapping.py:54``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+        >>> target = np.array([0, 0, 1, 1])
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> metric = BootStrapper(BinaryAccuracy(), num_bootstraps=4, seed=0)
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'mean': 0.8681, 'std': 0.1049}
+    """
 
     full_state_update = True
 

@@ -10,7 +10,20 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class ClasswiseWrapper(WrapperMetric):
-    """Split a per-class output tensor into a ``{label: scalar}`` dict (reference ``classwise.py:27``)."""
+    """Split a per-class output tensor into a ``{label: scalar}`` dict (reference ``classwise.py:27``).
+
+    Example:
+        >>> import numpy as np
+        >>> preds = np.array([[0.16, 0.26, 0.58], [0.22, 0.61, 0.17],
+        ...                   [0.71, 0.09, 0.20], [0.05, 0.82, 0.13]], np.float32)
+        >>> target = np.array([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> from torchmetrics_tpu.wrappers import ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 2) for k, v in sorted(metric.compute().items())}
+        {'multiclassaccuracy_0': 0.5, 'multiclassaccuracy_1': 1.0, 'multiclassaccuracy_2': 1.0}
+    """
 
     def __init__(
         self,

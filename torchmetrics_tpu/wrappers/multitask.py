@@ -9,7 +9,20 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MultitaskWrapper(WrapperMetric):
-    """Dict of task -> metric; dict preds/targets in, dict results out (reference ``multitask.py:29``)."""
+    """Dict of task -> metric; dict preds/targets in, dict results out (reference ``multitask.py:29``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> metric = MultitaskWrapper({'cls': BinaryAccuracy(), 'reg': MeanSquaredError()})
+        >>> metric.update(
+        ...     {'cls': np.array([0.1, 0.4, 0.35, 0.8], np.float32), 'reg': np.array([2.5, 0.0], np.float32)},
+        ...     {'cls': np.array([0, 0, 1, 1]), 'reg': np.array([3.0, -0.5], np.float32)})
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'cls': 0.75, 'reg': 0.25}
+    """
 
     is_differentiable = False
 

@@ -687,7 +687,8 @@ class PerceptualPathLength(Metric):
 
     Example:
         >>> from torchmetrics_tpu.image import PerceptualPathLength
-        >>> metric = PerceptualPathLength(generator, num_samples=8)  # doctest: +SKIP
+        >>> metric = PerceptualPathLength(num_samples=8)  # doctest: +SKIP
+        >>> metric.update(generator)  # the generator is supplied via update  # doctest: +SKIP
         >>> metric.compute()  # doctest: +SKIP
     """
 

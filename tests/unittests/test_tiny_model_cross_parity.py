@@ -261,6 +261,35 @@ class TestFeatureMetricsSharedModule:
 
 
 class TestClipScoreSharedCheckpoint:
+    def test_clip_iqa_matches_reference(self, tiny_clip_dir):
+        """CLIP-IQA end-to-end through the same tiny checkpoint, incl. custom prompt pairs.
+
+        A randomly-initialized CLIP yields near-degenerate scores (the anchor pair dots are
+        equal), so the assertion is element-wise equality of the full output vector — the
+        point is that BOTH pipelines (prompt formatting -> text anchors -> image features ->
+        softmax pairing) transform identically, not the score magnitudes."""
+        import_reference()
+        from torchmetrics.multimodal.clip_iqa import CLIPImageQualityAssessment as RefIQA
+
+        from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+        rng = np.random.RandomState(4)
+        imgs = rng.rand(2, 3, 40, 40).astype(np.float32)
+        # short pair: char-level tokens must fit the fixture's 16 position slots
+        prompts = (("good pic.", "bad pic."),)
+
+        ref = RefIQA(model_name_or_path=tiny_clip_dir, prompts=prompts)
+        ref.update(torch.as_tensor(imgs))
+
+        ours = CLIPImageQualityAssessment(model_name_or_path=tiny_clip_dir, prompts=prompts)
+        ours.update(imgs.copy())
+
+        np.testing.assert_allclose(
+            np.asarray(ours.compute(), np.float64).reshape(-1),
+            np.asarray(ref.compute().detach(), np.float64).reshape(-1),
+            atol=1e-5,
+        )
+
     def test_clip_score_matches_reference(self, tiny_clip_dir):
         import_reference()
         from torchmetrics.multimodal.clip_score import CLIPScore as RefCLIPScore

@@ -1,4 +1,4 @@
-.PHONY: test test-unit test-integration doctest bench clean
+.PHONY: test test-unit test-integration doctest bench telemetry-smoke clean
 
 test: test-unit test-integration
 
@@ -14,6 +14,11 @@ doctest:
 
 bench:
 	python bench.py
+
+# tier-1 guard for the observability exporter: one fused-sweep iteration with telemetry on,
+# trace exported and schema-checked (also runs as part of test-integration / the tier-1 lane)
+telemetry-smoke:
+	TM_TPU_TELEMETRY=1 python -m pytest tests/integrations/test_telemetry_smoke.py -q
 
 clean:
 	find . -type d -name __pycache__ -prune -exec rm -rf {} +

@@ -587,6 +587,16 @@ def bench_sync_latency() -> dict:
     jax.block_until_ready(sync(*args))
     k = 30
     best = _best_of(lambda: jax.block_until_ready([sync(*args) for _ in range(k)]))
+
+    # individually-timed blocking round-trips feed the telemetry sync-latency histogram, so
+    # the BENCH extras carry p50/p99 (distribution shape, not just the best-case mean)
+    from torchmetrics_tpu import obs
+
+    hist = obs.telemetry.histogram("sync.latency_us")
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(sync(*args))
+        hist.record((time.perf_counter() - t0) * 1e6)
     return {"sync_state_latency_us": round(best / k * 1e6, 1), "sync_mesh_devices": n}
 
 
@@ -713,6 +723,15 @@ def main() -> None:
             print(f"extra bench {name} failed: {err!r}", file=sys.stderr)
             extras[f"{name}_error"] = repr(err)
     extras.update(_contention_report())
+
+    # telemetry block: retrace/dispatch/sync counters recorded during this very run — a
+    # regression like r02→r03 now ships its own recompile-churn evidence in the BENCH file
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:
+        extras["telemetry_error"] = repr(err)
 
     print(
         json.dumps(

@@ -7,5 +7,15 @@ if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warning_cache():
+    # rank_zero_warn is one-shot per process; reset per test (mirrors the unit-suite fixture)
+    from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+    reset_warning_cache()
+    yield

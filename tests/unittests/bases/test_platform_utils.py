@@ -51,6 +51,85 @@ class TestRequestedPlatform:
         assert requested_platform(default="cpu") == "cpu"
 
 
+class TestProbeCache:
+    """platform_responds memoises per process (each probe pays a full interpreter+jax import)."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_cache(self):
+        from torchmetrics_tpu.utils import platform as mod
+
+        mod.probe_cache_clear()
+        yield
+        mod.probe_cache_clear()
+
+    def _patch_probe(self, monkeypatch, returncode=0):
+        from torchmetrics_tpu.utils import platform as mod
+
+        calls = []
+
+        class _Proc:
+            pass
+
+        def fake_run(*args, **kwargs):
+            calls.append(args)
+            proc = _Proc()
+            proc.returncode = returncode
+            return proc
+
+        monkeypatch.setattr(mod.subprocess, "run", fake_run)
+        return calls
+
+    def test_probe_runs_once_per_platform(self, monkeypatch):
+        from torchmetrics_tpu.utils.platform import platform_responds
+
+        calls = self._patch_probe(monkeypatch)
+        assert platform_responds("fake-plat")
+        assert platform_responds("fake-plat")  # served from the memo
+        assert len(calls) == 1
+
+    def test_refresh_escape_hatch(self, monkeypatch):
+        from torchmetrics_tpu.utils.platform import platform_responds
+
+        calls = self._patch_probe(monkeypatch)
+        assert platform_responds("fake-plat")
+        assert platform_responds("fake-plat", refresh=True)
+        assert len(calls) == 2
+
+    def test_cache_clear_forces_reprobe(self, monkeypatch):
+        from torchmetrics_tpu.utils.platform import platform_responds, probe_cache_clear
+
+        calls = self._patch_probe(monkeypatch)
+        assert platform_responds("fake-plat")
+        probe_cache_clear()
+        assert platform_responds("fake-plat")
+        assert len(calls) == 2
+
+    def test_negative_results_cached_too(self, monkeypatch):
+        from torchmetrics_tpu.utils.platform import platform_responds
+
+        calls = self._patch_probe(monkeypatch, returncode=1)
+        assert not platform_responds("dead-plat")
+        assert not platform_responds("dead-plat")
+        assert len(calls) == 1
+
+    def test_probe_telemetry_events(self, monkeypatch):
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.utils.platform import platform_responds
+
+        self._patch_probe(monkeypatch)
+        attempts = obs.telemetry.counter("platform.probe.attempts").value
+        hits = obs.telemetry.counter("platform.probe.cache_hits").value
+        with obs.enabled():
+            platform_responds("fake-plat")
+            platform_responds("fake-plat")
+            evts = [e for e in obs.telemetry.events() if e["name"] == "platform.probe"]
+        obs.disable()
+        assert obs.telemetry.counter("platform.probe.attempts").value == attempts + 1
+        assert obs.telemetry.counter("platform.probe.cache_hits").value == hits + 1
+        outcomes = [e["args"]["outcome"] for e in evts]
+        assert "ok" in outcomes and "cached" in outcomes
+
+
 class TestWatchdog:
     def test_returns_devices_on_healthy_backend(self):
         # the test conftest pinned cpu before backend init, so this returns promptly

@@ -1,0 +1,389 @@
+"""Unit tests for the obs telemetry subsystem (ISSUE 1 tentpole).
+
+Covers: instrument semantics (counter/timer/histogram), disabled-mode no-op behavior, the
+jit retrace detector on a deliberately shape-polymorphic metric, sync events on the virtual
+8-device mesh, and Perfetto trace-export schema validity.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, obs
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.obs import Telemetry
+
+NUM_CLASSES = 5  # matches the suite conftest
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolated():
+    """Leave the global registry disabled and with a restored retrace threshold."""
+    prev_thr = obs.retrace_warn_threshold()
+    yield
+    obs.disable()
+    obs.set_retrace_warn_threshold(prev_thr)
+
+
+def _mc_batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, NUM_CLASSES, n).astype(np.int32), rng.randint(0, NUM_CLASSES, n).astype(np.int32)
+
+
+# ----------------------------------------------------------------------------- instruments
+class TestInstruments:
+    def test_counter(self):
+        t = Telemetry()
+        t.counter("a").inc()
+        t.counter("a").inc(4)
+        assert t.counter("a").value == 5
+        assert t.counter("b").value == 0
+
+    def test_timer(self):
+        t = Telemetry()
+        t.timer("op").observe(0.5)
+        t.timer("op").observe(1.5)
+        tm = t.timer("op")
+        assert tm.count == 2
+        assert tm.total_s == pytest.approx(2.0)
+        assert tm.mean_s == pytest.approx(1.0)
+
+    def test_histogram_percentiles(self):
+        t = Telemetry()
+        h = t.histogram("lat")
+        for v in range(1, 101):
+            h.record(float(v))
+        assert h.count == 100
+        assert h.percentile(50) == pytest.approx(50.0, abs=1)
+        assert h.percentile(99) == pytest.approx(99.0, abs=1)
+        s = h.summary()
+        assert s["min"] == 1.0 and s["max"] == 100.0 and s["count"] == 100
+
+    def test_histogram_empty(self):
+        t = Telemetry()
+        assert t.histogram("e").percentile(50) is None
+        assert t.histogram("e").summary() == {"count": 0}
+
+    def test_histogram_bounded_reservoir(self):
+        t = Telemetry()
+        h = t.histogram("lat")
+        for v in range(10_000):
+            h.record(v)
+        assert h.count == 10_000  # true count survives the bounded reservoir
+        assert h.summary()["min"] >= 10_000 - 4096  # reservoir keeps the most recent window
+
+    def test_thread_safety_counters(self):
+        import threading
+
+        t = Telemetry()
+
+        def work():
+            for _ in range(1000):
+                t.counter("c").inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        [th.start() for th in threads]
+        [th.join() for th in threads]
+        assert t.counter("c").value == 8000
+
+
+# ------------------------------------------------------------------------------ activation
+class TestActivation:
+    def test_env_var_parsing(self):
+        from torchmetrics_tpu.obs.telemetry import _env_enabled
+
+        for truthy in ("1", "true", "YES", " on "):
+            assert _env_enabled({"TM_TPU_TELEMETRY": truthy})
+        for falsy in ("", "0", "false", "off", "nope"):
+            assert not _env_enabled({"TM_TPU_TELEMETRY": falsy})
+
+    def test_context_manager_restores(self):
+        assert not obs.is_enabled()
+        with obs.enabled():
+            assert obs.is_enabled()
+            with obs.enabled(False):
+                assert not obs.is_enabled()
+            assert obs.is_enabled()
+        assert not obs.is_enabled()
+
+    def test_disabled_mode_is_noop(self):
+        t = Telemetry(enabled=False)
+        t.event("never")
+        with t.span("never-timed"):
+            pass
+        assert t.events() == []
+        assert t.snapshot()["timers"] == {}
+        # the disabled span is the shared null scope: no allocation on the fast path
+        assert t.span("x") is t.span("y")
+
+    def test_disabled_metric_records_no_events_or_times(self):
+        obs.disable()
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        before = len(obs.telemetry.events())
+        m.update(*_mc_batch())
+        m.compute()
+        assert len(obs.telemetry.events()) == before
+        assert m.telemetry["time_s"] == {}
+        # counting stays on even while tracing is off (the cheap tier)
+        assert m.telemetry["calls"]["update"] == 1
+        assert m.telemetry["dispatches"] >= 1
+
+
+# -------------------------------------------------------------------- metric instrumentation
+class TestMetricTelemetry:
+    def test_call_counts_and_traces(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch())
+        m.update(*_mc_batch(seed=1))
+        m(*_mc_batch(seed=2))  # forward
+        m.compute()
+        t = m.telemetry
+        assert t["calls"]["update"] == 2
+        assert t["calls"]["forward"] == 1
+        assert t["calls"]["compute"] == 1
+        assert t["traces"]["update"] == 1  # same shape -> one compile
+        assert t["retraces"]["update"] == 0
+        assert t["dispatches"] >= 4
+
+    def test_retrace_counter_fires_on_shape_change(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(32))
+        m.update(*_mc_batch(64))
+        t = m.telemetry
+        assert t["traces"]["update"] == 2
+        assert t["retraces"]["update"] == 1
+        assert t["retraces_total"] >= 1
+
+    def test_retrace_warning_one_shot(self):
+        obs.set_retrace_warn_threshold(2)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for n in (8, 16, 24, 32, 40, 48):  # deliberately shape-polymorphic stream
+                m.update(*_mc_batch(n))
+        msgs = [str(w.message) for w in caught if "retraced" in str(w.message)]
+        assert len(msgs) == 1, f"expected exactly one churn warning, got {msgs}"
+        assert "MulticlassAccuracy" in msgs[0] and "cache key" in msgs[0]
+
+    def test_no_warning_below_threshold(self):
+        obs.set_retrace_warn_threshold(10)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            m.update(*_mc_batch(8))
+            m.update(*_mc_batch(16))
+        assert not [w for w in caught if "retraced" in str(w.message)]
+
+    def test_spans_recorded_when_enabled(self):
+        with obs.enabled():
+            m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+            m.update(*_mc_batch())
+            m.compute()
+            names = {e["name"] for e in obs.telemetry.events()}
+            assert "metric.MulticlassAccuracy.update" in names
+            assert "metric.MulticlassAccuracy.compute" in names
+            assert m.telemetry["time_s"].get("update", 0) > 0
+
+    def test_update_batches_scan_counts(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        preds = np.random.RandomState(0).randint(0, NUM_CLASSES, (4, 16)).astype(np.int32)
+        target = np.random.RandomState(1).randint(0, NUM_CLASSES, (4, 16)).astype(np.int32)
+        m.update_batches(preds, target)
+        t = m.telemetry
+        assert t["calls"]["update_batches"] == 1
+        assert t["traces"]["update_scan"] == 1
+
+    def test_telemetry_survives_clone_and_pickle(self):
+        import pickle
+
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch())
+        for twin in (m.clone(), pickle.loads(pickle.dumps(m))):
+            assert twin.telemetry["calls"]["update"] == 1
+
+
+class TestCollectionTelemetry:
+    def test_group_fused_dispatch_attribution(self):
+        mc = MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+                MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+            ]
+        )
+        mc(*_mc_batch())          # group formation: per-metric forward
+        mc(*_mc_batch(seed=1))    # fused: ONE dispatch for both members
+        mc(*_mc_batch(seed=2))
+        t = mc.telemetry
+        leader = t["metrics"]["MulticlassAccuracy"]
+        assert leader["calls"]["group_forward"] == 2
+        assert leader["traces"].get("group_forward") == 1
+        assert t["compute_groups"] == {0: ["MulticlassAccuracy", "MulticlassF1Score"]}
+        assert t["retraces_total"] == 0
+
+    def test_compute_group_formation_event(self):
+        with obs.enabled():
+            mc = MetricCollection(
+                [
+                    MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+                    MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                ]
+            )
+            mc.update(*_mc_batch())
+            evts = [e for e in obs.telemetry.events() if e["name"] == "collection.compute_groups"]
+            assert evts and "MulticlassAccuracy" in str(evts[-1]["args"])
+
+
+# ----------------------------------------------------------------------------- sync events
+class TestSyncTelemetry:
+    def test_sync_state_event_on_mesh8(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import shard_map_unchecked, sync_state
+
+        devices = jax.devices()
+        assert len(devices) == 8  # virtual mesh from the suite conftest
+        mesh = Mesh(np.array(devices), ("dp",))
+        before = obs.telemetry.counter("sync.sync_state.traces").value
+        with obs.enabled():
+
+            @jax.jit
+            @shard_map_unchecked(mesh, in_specs=(P("dp"),), out_specs=P())
+            def sync(tp):
+                return sync_state({"tp": tp[0]}, {"tp": "sum"}, axis_name="dp")["tp"]
+
+            x = jax.device_put(
+                jnp.ones((8, NUM_CLASSES), jnp.float32), NamedSharding(mesh, P("dp"))
+            )
+            out = jax.block_until_ready(sync(x))
+            np.testing.assert_allclose(np.asarray(out), np.full(NUM_CLASSES, 8.0))
+            evts = [e for e in obs.telemetry.events() if e["name"] == "sync.sync_state"]
+        assert obs.telemetry.counter("sync.sync_state.traces").value == before + 1
+        assert evts, "sync_state should record a trace-time event"
+        args = evts[-1]["args"]
+        assert args["axis"] == "dp"
+        assert args["mesh_size"] == 8
+        assert args["states"] == ["tp"]
+        assert args["bytes"] == NUM_CLASSES * 4
+
+    def test_process_sync_latency_event(self):
+        from torchmetrics_tpu.parallel.sync import process_sync
+
+        with obs.enabled():
+            out = process_sync({"s": jnp.ones((3,))}, {"s": "sum"})
+            evts = [e for e in obs.telemetry.events() if e["name"] == "sync.process_sync"]
+        np.testing.assert_allclose(np.asarray(out["s"]), np.ones(3))
+        assert evts and evts[-1]["ph"] == "X" and evts[-1]["dur"] > 0
+        assert evts[-1]["args"]["world"] == 1
+        h = obs.telemetry.get_histogram("sync.process_sync.latency_us")
+        assert h is not None and h.count >= 1
+
+    def test_metric_sync_on_compute_records(self):
+        m = MeanMetric(dist_sync_fn=lambda x, group=None: [x, x])
+        m.update(2.0)
+        with obs.enabled():
+            m.compute()
+        assert m.telemetry["calls"]["sync"] == 1
+
+
+# ------------------------------------------------------------------------------- exporters
+class TestExport:
+    def _record_some(self):
+        with obs.enabled():
+            m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+            m(*_mc_batch())
+            m.compute()
+
+    def test_perfetto_trace_schema(self, tmp_path):
+        self._record_some()
+        path = tmp_path / "trace.json"
+        got = obs.export_trace(path)
+        assert got == str(path)
+        data = json.load(open(path))
+        evts = data["traceEvents"]
+        assert isinstance(evts, list) and len(evts) > 1
+        for e in evts:  # required Chrome trace_event keys
+            assert "ph" in e and "ts" in e and "pid" in e and "name" in e
+        assert any(e["ph"] == "M" and e["name"] == "process_name" for e in evts)
+        assert any(e["ph"] == "X" and e.get("dur", 0) > 0 for e in evts)
+        assert data["displayTimeUnit"] == "ms"
+
+    def test_jsonl_export_parses(self, tmp_path):
+        self._record_some()
+        path = tmp_path / "events.jsonl"
+        obs.export_jsonl(path)
+        lines = [json.loads(line) for line in open(path)]
+        assert len(lines) >= 2
+        assert lines[-1]["type"] == "snapshot"
+        assert "counters" in lines[-1]
+
+    def test_summary_table(self):
+        self._record_some()
+        text = obs.summary()
+        assert "telemetry summary" in text
+        assert "engine.dispatches" in text
+        assert "counter" in text and "timer" in text
+
+    def test_print_summary_rank_zero(self, capsys):
+        self._record_some()
+        obs.print_summary()
+        assert "telemetry summary" in capsys.readouterr().out
+
+    def test_bench_extras_shape(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(16))
+        m.update(*_mc_batch(48))
+        extras = obs.bench_extras()
+        assert extras["jit_retraces_total"] >= 1
+        assert extras["engine_dispatches"] >= 2
+        assert any(k.startswith("MulticlassAccuracy.") for k in extras["jit_trace_counts"])
+
+    def test_snapshot_json_serialisable(self):
+        self._record_some()
+        json.dumps(obs.snapshot())
+
+
+# --------------------------------------------------------------------------------- helpers
+class TestHelpers:
+    def test_describe_abstract(self):
+        sig = obs.describe_abstract(jnp.zeros((4, 2), jnp.float32), np.int32(3))
+        assert "f32[4,2]" in sig and "i32[]" in sig
+
+    def test_tree_bytes(self):
+        tree = {"a": jnp.zeros((4, 2), jnp.float32), "b": [jnp.zeros((3,), jnp.int32)]}
+        assert obs.tree_bytes(tree) == 4 * 2 * 4 + 3 * 4
+
+    def test_device_sync_counts(self):
+        before = obs.telemetry.counter("host.block_until_ready").value
+        out = obs.device_sync(jnp.ones((2,)))
+        np.testing.assert_allclose(np.asarray(out), np.ones(2))
+        assert obs.telemetry.counter("host.block_until_ready").value == before + 1
+
+
+class TestWarningDedup:
+    def test_rank_zero_warn_one_shot(self):
+        from torchmetrics_tpu.utils.prints import rank_zero_warn, reset_warning_cache
+
+        reset_warning_cache()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            rank_zero_warn("telemetry-dedup-probe")
+            rank_zero_warn("telemetry-dedup-probe")
+            rank_zero_warn("telemetry-dedup-probe", category=DeprecationWarning)  # new category -> fires
+        assert len(caught) == 2
+
+    def test_reset_reenables(self):
+        from torchmetrics_tpu.utils.prints import rank_zero_warn, reset_warning_cache
+
+        reset_warning_cache()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            rank_zero_warn("telemetry-dedup-probe-2")
+            reset_warning_cache()
+            rank_zero_warn("telemetry-dedup-probe-2")
+        assert len(caught) == 2

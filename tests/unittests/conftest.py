@@ -47,5 +47,15 @@ def _seed():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _fresh_warning_cache():
+    # rank_zero_warn is one-shot per process (seen-set dedup); reset per test so every test
+    # observes the warnings it expects regardless of suite ordering
+    from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+    reset_warning_cache()
+    yield
+
+
 def use_deterministic_algorithms():  # parity shim with reference conftest
     pass

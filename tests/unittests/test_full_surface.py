@@ -317,6 +317,7 @@ def _spec():
     spec["Metric"] = None          # abstract base
     spec["__version__"] = None
     spec["functional"] = None
+    spec["obs"] = None             # telemetry subsystem, not a metric (tests: bases/test_telemetry.py)
     return spec, mextra
 
 

@@ -17,6 +17,7 @@ from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+from torchmetrics_tpu import obs
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.data import allclose
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -127,19 +128,22 @@ class MetricCollection:
                     merged = leader._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
                     return vals, merged
 
-                fn = jax.jit(step)
+                fn = jax.jit(obs.instrument_trace(step, leader, "group_forward"))
                 leader._jit_cache["group_forward"] = fn
             f_kwargs = leader._filter_kwargs(**kwargs)
             coerced_args, coerced_kwargs = leader._coerce(args, f_kwargs)
             if leader._should_validate():
                 leader._validate(*coerced_args, **coerced_kwargs)
             n = leader._update_count + 1
-            vals, merged = fn(
-                # np scalar, NOT jnp: jnp.asarray eagerly dispatches a device op per step (a
-                # whole extra launch on high-latency links); numpy args are abstracted by
-                # dtype/shape under jit so this neither launches nor retraces
-                dict(leader._state.tensors), np.float32(n), *coerced_args, **coerced_kwargs
-            )
+            obs.bump(leader, "group_forward_calls")
+            obs.count_dispatch(leader)  # k metrics in the group, ONE fused launch
+            with obs.metric_span(leader, "group_forward"):
+                vals, merged = fn(
+                    # np scalar, NOT jnp: jnp.asarray eagerly dispatches a device op per step (a
+                    # whole extra launch on high-latency links); numpy args are abstracted by
+                    # dtype/shape under jit so this neither launches nor retraces
+                    dict(leader._state.tensors), np.float32(n), *coerced_args, **coerced_kwargs
+                )
             leader._state.tensors.update(merged)
             for _, m in members:
                 m._update_count = n
@@ -243,7 +247,12 @@ class MetricCollection:
                 )
             groups.append((leader, members))
 
+        obs.telemetry.counter("collection.sweep_fn.built").inc()
+
         def run(*args: Any, **kwargs: Any) -> Dict[str, Any]:
+            # fires once per trace when composed under jit (the intended use), per call eagerly
+            obs.telemetry.counter("collection.sweep_fn.invocations").inc()
+            obs.telemetry.event("collection.sweep_fn", cat="collection", args={"groups": len(groups)})
             result: Dict[str, Any] = {}
             for leader, members in groups:
                 defaults = {k: leader._defaults[k] for k in leader._state.tensors}
@@ -325,6 +334,11 @@ class MetricCollection:
                 break
             num_groups = len(self._groups)
         self._groups = dict(enumerate(self._groups.values()))
+        obs.telemetry.counter("collection.compute_groups.formed").inc()
+        obs.telemetry.event(
+            "collection.compute_groups", cat="collection",
+            args={"groups": {str(i): list(v) for i, v in self._groups.items()}},
+        )
 
     @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
@@ -375,6 +389,22 @@ class MetricCollection:
     @property
     def compute_groups(self) -> Dict[int, List[str]]:
         return self._groups
+
+    @property
+    def telemetry(self) -> Dict[str, Any]:
+        """Aggregated observability snapshot: per-member ``Metric.telemetry`` plus totals.
+
+        Group-fused launches are attributed to each group's leader (``group_forward_calls``),
+        so a collection whose k members ride one dispatch reports k-fold fewer dispatches
+        than k independent metrics would — exactly the saving compute groups exist for.
+        """
+        per = {name: m.telemetry for name, m in self._modules.items()}
+        return {
+            "metrics": per,
+            "dispatches": sum(t["dispatches"] for t in per.values()),
+            "retraces_total": sum(t["retraces_total"] for t in per.values()),
+            "compute_groups": {i: list(v) for i, v in self._groups.items()},
+        }
 
     # -------------------------------------------------------------- dict-likes
     def _flatten_collection(self, name: Optional[str], coll: "MetricCollection") -> Iterator[Tuple[str, Metric]]:

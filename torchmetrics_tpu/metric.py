@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from torchmetrics_tpu import obs
 from torchmetrics_tpu.parallel.sync import process_sync
 from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.data import dim_zero_cat
@@ -144,6 +145,10 @@ class Metric:
         self._is_synced = False
         self._cache: Optional[Dict[str, Any]] = None
         self._jit_cache: Dict[str, Callable] = {}
+        # telemetry (obs): always-on integer counts + (when tracing) accumulated wall times
+        self._tm_counts: Dict[str, int] = {}
+        self._tm_times: Dict[str, float] = {}
+        self._tm_retrace_warned = False
 
     # ------------------------------------------------------------------ state
     @property
@@ -166,6 +171,27 @@ class Metric:
     def metric_state(self) -> Dict[str, Any]:
         """Current state values (reference ``metric.py:186``)."""
         return self._state.snapshot()
+
+    @property
+    def telemetry(self) -> Dict[str, Any]:
+        """Per-instance observability snapshot: call counts, jit (re)trace counts per kernel,
+        device dispatches, and (when tracing was enabled) accumulated wall times.
+
+        ``retraces`` counts compilations beyond each kernel's first — nonzero after any
+        shape/dtype change in the inputs (the recompile-churn signal).
+        """
+        counts = dict(self.__dict__.get("_tm_counts") or {})
+        times = self.__dict__.get("_tm_times") or {}
+        traces = {k.split(".", 1)[1]: v for k, v in counts.items() if k.startswith("traces.")}
+        retraces = {k: max(0, v - 1) for k, v in traces.items()}
+        return {
+            "calls": {k[: -len("_calls")]: v for k, v in counts.items() if k.endswith("_calls")},
+            "dispatches": counts.get("dispatches", 0),
+            "traces": traces,
+            "retraces": retraces,
+            "retraces_total": sum(retraces.values()),
+            "time_s": {k: round(v, 6) for k, v in times.items()},
+        }
 
     def add_state(
         self,
@@ -236,22 +262,35 @@ class Metric:
     def _jitted_update(self) -> Callable:
         fn = self._jit_cache.get("update")
         if fn is None:
-            fn = jax.jit(self._update) if self.jit_update else self._update
+            # the trace hook fires once per XLA compilation (jit only executes the Python
+            # body on a cache miss) — the retrace/recompile-churn counter costs nothing per call
+            fn = jax.jit(obs.instrument_trace(self._update, self, "update")) if self.jit_update else self._update
             self._jit_cache["update"] = fn
         return fn
 
     def _jitted_compute(self) -> Callable:
         fn = self._jit_cache.get("compute")
         if fn is None:
-            fn = jax.jit(self._compute) if self.jit_compute else self._compute
+            fn = jax.jit(obs.instrument_trace(self._compute, self, "compute")) if self.jit_compute else self._compute
             self._jit_cache["compute"] = fn
         return fn
 
     def _coerce(self, args: tuple, kwargs: dict) -> tuple:
-        conv = lambda x: jnp.asarray(x) if isinstance(x, (np.ndarray, int, float, bool, np.generic)) or (
-            isinstance(x, (list, tuple)) and len(x) and isinstance(x[0], (int, float, bool))
-        ) else x
-        return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+        converted = 0
+
+        def conv(x):
+            nonlocal converted
+            if isinstance(x, (np.ndarray, int, float, bool, np.generic)) or (
+                isinstance(x, (list, tuple)) and len(x) and isinstance(x[0], (int, float, bool))
+            ):
+                converted += 1
+                return jnp.asarray(x)
+            return x
+
+        out = tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+        if converted:
+            obs.telemetry.counter("transfer.host_to_device").inc(converted)
+        return out
 
     def _validate(self, *args: Any, **kwargs: Any) -> None:
         """Host-side value checks (overridden by subclasses when ``validate_args``)."""
@@ -274,11 +313,14 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
-        args, kwargs = self._coerce(args, kwargs)
-        if self._should_validate():
-            self._validate(*args, **kwargs)
-        out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
-        self._apply_update_result(out)
+        obs.bump(self, "update_calls")
+        obs.count_dispatch(self)
+        with obs.metric_span(self, "update"):
+            args, kwargs = self._coerce(args, kwargs)
+            if self._should_validate():
+                self._validate(*args, **kwargs)
+            out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
+            self._apply_update_result(out)
         self._update_count += 1
         self._update_called = True
         self._computed = None
@@ -298,6 +340,7 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
+        obs.bump(self, "update_batches_calls")
         args, kwargs = self._coerce(args, kwargs)
         n_batches = jnp.shape(args[0] if args else next(iter(kwargs.values())))[0]
         if self._state.lists or not self.scan_update:
@@ -322,9 +365,11 @@ class Metric:
                     return {k: out.get(k, st[k]) for k in st}, None
                 final, _ = jax.lax.scan(body, tensors, (stacked_args, stacked_kwargs))
                 return final
-            scan_fn = jax.jit(_scan) if self.jit_update else _scan
+            scan_fn = jax.jit(obs.instrument_trace(_scan, self, "update_scan")) if self.jit_update else _scan
             self._jit_cache["update_scan"] = scan_fn
-        out = scan_fn(dict(self._state.tensors), args, kwargs)
+        obs.count_dispatch(self)
+        with obs.metric_span(self, "update_batches"):
+            out = scan_fn(dict(self._state.tensors), args, kwargs)
         for name in self._state.tensors:
             self._state.tensors[name] = out[name]
         self._update_count += int(n_batches)
@@ -343,6 +388,7 @@ class Metric:
                     entries = list(entry) if isinstance(entry, (list, tuple)) else [entry]
                     if cpu is not None:  # offload unbounded cat-states to host RAM (metric.py:482-487)
                         entries = [jax.device_put(e, cpu) for e in entries]
+                        obs.telemetry.counter("transfer.device_put").inc(len(entries))
                     self._state.lists[name].extend(entries)
 
     def _default_tensor_state(self) -> Dict[str, Array]:
@@ -392,9 +438,11 @@ class Metric:
         """
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing `forward`.")
-        if self.full_state_update or self.dist_sync_on_step:
-            return self._forward_full_state_update(*args, **kwargs)
-        return self._forward_reduce_state_update(*args, **kwargs)
+        obs.bump(self, "forward_calls")
+        with obs.metric_span(self, "forward"):
+            if self.full_state_update or self.dist_sync_on_step:
+                return self._forward_full_state_update(*args, **kwargs)
+            return self._forward_reduce_state_update(*args, **kwargs)
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:307-350``: update global, then compute on batch-only state."""
@@ -480,7 +528,7 @@ class Metric:
                 merged = self._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
                 return batch_val, merged
 
-            fn = jax.jit(step)
+            fn = jax.jit(obs.instrument_trace(step, self, "forward_step"))
             self._jit_cache["forward_step"] = fn
         return fn
 
@@ -490,6 +538,7 @@ class Metric:
         if self._should_validate():
             self._validate(*args, **kwargs)
         if self._fusable_forward():
+            obs.count_dispatch(self)
             batch_val, merged = self._jitted_forward_step()(
                 # np scalar, NOT jnp: jnp.asarray would eagerly dispatch a device op per step
                 dict(self._state.tensors), np.float32(self._update_count + 1), *args, **kwargs
@@ -500,6 +549,7 @@ class Metric:
             self._computed = None
             self._state.tensors.update(merged)
             return self._squeeze_if_scalar(batch_val)
+        obs.count_dispatch(self, 2)  # update kernel + batch-local compute launch
         batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)
         self._update_count += 1
         self._update_called = True
@@ -520,6 +570,7 @@ class Metric:
     # ------------------------------------------------------------------- sync
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
         """Gather+reduce every state across the world (reference ``metric.py:426-456``)."""
+        obs.bump(self, "sync_calls")
         synced = process_sync(
             self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn, group=process_group
         )
@@ -604,19 +655,22 @@ class Metric:
                 " which may lead to errors, as metric states have not yet been updated.",
                 UserWarning,
             )
+        obs.bump(self, "compute_calls")
         if self.compute_with_cache and self._computed is not None:
             return self._computed
-        with self.sync_context(
-            dist_sync_fn=self.dist_sync_fn,
-            should_sync=self._to_sync,
-            should_unsync=self._should_unsync,
-        ):
-            state = self._computable_state()
-            has_empty_list = any(
-                isinstance(v, list) and not len(v) for v in state.values()
-            )
-            compute_fn = self._compute if has_empty_list else self._jitted_compute()
-            value = self._squeeze_if_scalar(compute_fn(state))
+        obs.count_dispatch(self)
+        with obs.metric_span(self, "compute"):
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                state = self._computable_state()
+                has_empty_list = any(
+                    isinstance(v, list) and not len(v) for v in state.values()
+                )
+                compute_fn = self._compute if has_empty_list else self._jitted_compute()
+                value = self._squeeze_if_scalar(compute_fn(state))
         if self.compute_with_cache:
             self._computed = value
         return value
@@ -742,6 +796,16 @@ class Metric:
     # --------------------------------------------------------------- placement
     def to(self, device) -> "Metric":
         """Move all states to ``device`` (reference ``_apply``, ``metric.py:776-824``)."""
+        n_moved = (
+            len(self._state.tensors)
+            + sum(len(v) for v in self._state.lists.values())
+            + sum(1 for v in self._defaults.values() if not isinstance(v, list))
+        )
+        obs.telemetry.counter("transfer.device_put").inc(n_moved)
+        obs.telemetry.event(
+            "metric.to", cat="transfer",
+            args={"metric": type(self).__name__, "device": str(device), "arrays": n_moved},
+        )
         for name, v in self._state.tensors.items():
             self._state.tensors[name] = jax.device_put(v, device)
         for name, entries in self._state.lists.items():

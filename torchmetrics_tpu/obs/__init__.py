@@ -1,0 +1,84 @@
+"""torchmetrics_tpu.obs — runtime telemetry and trace export for the metric engine.
+
+Everything the engine's hot paths were blind to becomes recorded evidence: per-metric
+update/forward/compute call counts and wall times, jit retrace/compile counters (the
+recompile-churn detector), host↔device transfer and blocking-sync counts, and per-collective
+latency/bytes/mesh-size from ``parallel/sync.py``. Exporters turn a recorded run into a
+structured JSONL log or a Perfetto-loadable Chrome trace (:func:`export_trace`).
+
+Quick start::
+
+    from torchmetrics_tpu import obs
+
+    with obs.enabled():              # or: TM_TPU_TELEMETRY=1 in the environment
+        metric.update(preds, target)
+        metric.compute()
+        obs.export_trace("run_trace.json")   # open in ui.perfetto.dev
+    print(metric.telemetry)          # per-instance calls / retraces / dispatches
+    obs.print_summary()              # rank-zero table of the whole registry
+
+Cost model: *counting* (retraces, dispatches, transfers) is always on — integer bumps that
+are noise next to an XLA dispatch. *Tracing* (events, spans, timers) only records while
+enabled and no-ops through a shared null scope otherwise. See ``docs/observability.md``.
+"""
+from torchmetrics_tpu.obs.telemetry import (
+    ENV_FLAG,
+    ENV_RETRACE_THRESHOLD,
+    Counter,
+    Histogram,
+    Telemetry,
+    Timer,
+    bump,
+    count_dispatch,
+    describe_abstract,
+    device_sync,
+    disable,
+    enable,
+    enabled,
+    instrument_trace,
+    is_enabled,
+    metric_span,
+    record_trace,
+    retrace_warn_threshold,
+    set_retrace_warn_threshold,
+    telemetry,
+    tree_bytes,
+)
+from torchmetrics_tpu.obs.export import (
+    bench_extras,
+    export_jsonl,
+    export_trace,
+    print_summary,
+    snapshot,
+    summary,
+)
+
+__all__ = [
+    "ENV_FLAG",
+    "ENV_RETRACE_THRESHOLD",
+    "Counter",
+    "Histogram",
+    "Telemetry",
+    "Timer",
+    "bench_extras",
+    "bump",
+    "count_dispatch",
+    "describe_abstract",
+    "device_sync",
+    "disable",
+    "enable",
+    "enabled",
+    "export_jsonl",
+    "export_trace",
+    "instrument_trace",
+    "is_enabled",
+    "metric_span",
+    "print_summary",
+    "record_trace",
+    "retrace_warn_threshold",
+    "set_retrace_warn_threshold",
+    "snapshot",
+    "summary",
+    "telemetry",
+    "tree_bytes",
+]

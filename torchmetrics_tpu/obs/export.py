@@ -1,0 +1,126 @@
+"""Telemetry exporters and reporting: JSONL event log, Perfetto trace, summary table.
+
+The event log already stores Chrome ``trace_event``-shaped dicts (see
+:mod:`torchmetrics_tpu.obs.telemetry`), so :func:`export_trace` is a schema wrapper —
+the output opens directly in https://ui.perfetto.dev (or ``chrome://tracing``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
+from torchmetrics_tpu.utils.prints import rank_zero_only
+
+
+def export_trace(path: Any, registry: Optional[Telemetry] = None) -> str:
+    """Write the recorded events as a Chrome/Perfetto ``trace_event`` JSON file.
+
+    Returns the written path. The file is a JSON object with a ``traceEvents`` list; every
+    event carries the required ``ph``/``ts``/``pid`` keys, plus a process-name metadata
+    record so the track is labeled in the Perfetto UI.
+    """
+    tel = registry if registry is not None else telemetry
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "ts": 0,
+            "pid": tel.pid,
+            "tid": 0,
+            "args": {"name": "torchmetrics_tpu"},
+        }
+    ]
+    payload = {
+        "traceEvents": meta + tel.events(),
+        "displayTimeUnit": "ms",
+        "otherData": {"dropped_events": tel.dropped_events},
+    }
+    path = os.fspath(path)
+    with open(path, "w") as fh:
+        json.dump(payload, fh)
+    return path
+
+
+def export_jsonl(path: Any, registry: Optional[Telemetry] = None) -> str:
+    """Write one JSON object per line: every recorded event, then a final snapshot record."""
+    tel = registry if registry is not None else telemetry
+    path = os.fspath(path)
+    with open(path, "w") as fh:
+        for evt in tel.events():
+            fh.write(json.dumps(evt) + "\n")
+        fh.write(json.dumps({"type": "snapshot", **tel.snapshot()}) + "\n")
+    return path
+
+
+def snapshot(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
+    """Point-in-time dict of every instrument in the (global) registry."""
+    tel = registry if registry is not None else telemetry
+    return tel.snapshot()
+
+
+def summary(registry: Optional[Telemetry] = None) -> str:
+    """Fixed-width table of every counter, timer, and histogram in the registry."""
+    tel = registry if registry is not None else telemetry
+    snap = tel.snapshot()
+    rows = [("name", "kind", "count", "total/percentiles")]
+    for name in sorted(snap["counters"]):
+        rows.append((name, "counter", str(snap["counters"][name]), ""))
+    for name in sorted(snap["timers"]):
+        t = snap["timers"][name]
+        rows.append((name, "timer", str(t["count"]), f"{t['total_s']:.6f}s (mean {t['mean_s']:.9f}s)"))
+    for name in sorted(snap["histograms"]):
+        h = snap["histograms"][name]
+        if h.get("count"):
+            detail = f"p50={h.get('p50', 0):.1f} p99={h.get('p99', 0):.1f} max={h.get('max', 0):.1f}"
+        else:
+            detail = "(empty)"
+        rows.append((name, "histogram", str(h.get("count", 0)), detail))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    lines = ["  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip() for row in rows]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    header = (
+        f"telemetry summary (enabled={snap['enabled']}, events={snap['events_recorded']},"
+        f" dropped={snap['events_dropped']})"
+    )
+    return "\n".join([header] + lines)
+
+
+@rank_zero_only
+def print_summary(registry: Optional[Telemetry] = None) -> None:
+    """Print :func:`summary` on rank zero only (silent on every other process)."""
+    print(summary(registry))
+
+
+def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
+    """Compact diagnostics block for ``bench.py`` extras — makes BENCH_*.json self-diagnosing.
+
+    Reports per-(class, kernel) jit trace counts with the implied retrace total (traces
+    beyond the first compile of each kernel), dispatch/sync/transfer counters, and p50/p99
+    of any recorded sync-latency histogram.
+    """
+    tel = registry if registry is not None else telemetry
+    snap = tel.snapshot()
+    counters = snap["counters"]
+    traces = {n[len("jit.trace."):]: v for n, v in counters.items() if n.startswith("jit.trace.")}
+    retraces = {n[len("jit.retrace."):]: v for n, v in counters.items() if n.startswith("jit.retrace.")}
+    out: Dict[str, Any] = {
+        "telemetry_enabled": snap["enabled"],
+        "jit_trace_counts": traces,
+        "jit_retrace_counts": retraces,
+        "jit_retraces_total": sum(retraces.values()),
+        "engine_dispatches": counters.get("engine.dispatches", 0),
+        "sync_state_traces": counters.get("sync.sync_state.traces", 0),
+        "process_sync_calls": counters.get("sync.process_sync.calls", 0),
+        "device_transfers": counters.get("transfer.device_put", 0)
+        + counters.get("transfer.host_to_device", 0),
+        "events_recorded": snap["events_recorded"],
+    }
+    hist = tel.get_histogram("sync.latency_us")
+    if hist is not None and hist.count:
+        s = hist.summary()
+        out["sync_latency_us_p50"] = round(s["p50"], 1)
+        out["sync_latency_us_p99"] = round(s["p99"], 1)
+        out["sync_latency_samples"] = s["count"]
+    return out

@@ -1,0 +1,526 @@
+"""Process-global telemetry registry: counters, timers, histograms, and a trace-event log.
+
+Zero-dependency (stdlib only at import time; jax is touched lazily and only for abstract
+shape/dtype pretty-printing). The design splits instrumentation into two cost tiers:
+
+- **counting** — plain integer bumps (per-metric dicts + registry :class:`Counter` objects).
+  Always on: a bump is ~100ns next to a multi-microsecond XLA dispatch, and retrace/dispatch
+  counts are exactly the evidence the r02→r03 regression hunt was missing. Safe to leave
+  enabled in production.
+- **tracing** — wall-clock spans, the event log, and timers. Gated on the global enabled flag
+  (:func:`enable` / the ``TM_TPU_TELEMETRY`` env var / the :func:`enabled` context manager);
+  when disabled every tracing entry point returns through a no-allocation fast path.
+
+Activation:
+
+    >>> from torchmetrics_tpu import obs
+    >>> with obs.enabled():
+    ...     with obs.telemetry.span("demo.work", cat="demo"):
+    ...         pass
+    >>> any(e["name"] == "demo.work" for e in obs.telemetry.events())
+    True
+
+The event log stores Chrome ``trace_event``-shaped dicts directly (``name``/``cat``/``ph``/
+``ts``/``pid``/``tid``[/``dur``/``args``]) so the Perfetto exporter is a plain JSON dump —
+see :mod:`torchmetrics_tpu.obs.export`.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+ENV_FLAG = "TM_TPU_TELEMETRY"
+ENV_RETRACE_THRESHOLD = "TM_TPU_RETRACE_WARN_THRESHOLD"
+ENV_MAX_EVENTS = "TM_TPU_TELEMETRY_MAX_EVENTS"
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _env_enabled(environ: Optional[Dict[str, str]] = None) -> bool:
+    env = os.environ if environ is None else environ
+    return str(env.get(ENV_FLAG, "")).strip().lower() in _TRUTHY
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# --------------------------------------------------------------------------- instruments
+class Counter:
+    """Monotonic event count. Thread-safe; cheap enough to stay always-on."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Timer:
+    """Accumulated wall time + call count for one instrumented operation."""
+
+    __slots__ = ("name", "_count", "_total_s", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._count = 0
+        self._total_s = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, dt_s: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_s += dt_s
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total_s(self) -> float:
+        return self._total_s
+
+    @property
+    def mean_s(self) -> float:
+        return self._total_s / self._count if self._count else 0.0
+
+
+class Histogram:
+    """Bounded reservoir of raw observations with nearest-rank percentiles.
+
+    Keeps the most recent ``maxlen`` samples (deque) — enough for p50/p99 of latency
+    distributions without unbounded growth in long-running loops.
+    """
+
+    __slots__ = ("name", "_values", "_count", "_lock")
+
+    def __init__(self, name: str, maxlen: int = 4096) -> None:
+        self.name = name
+        self._values: deque = deque(maxlen=maxlen)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        with self._lock:
+            self._values.append(float(value))
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile over the retained reservoir; None when empty."""
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return None
+        rank = max(0, min(len(vals) - 1, int(round(p / 100.0 * (len(vals) - 1)))))
+        return vals[rank]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return {"count": self._count}
+        n = len(vals)
+
+        def at(p: float) -> float:
+            return vals[max(0, min(n - 1, int(round(p / 100.0 * (n - 1)))))]
+
+        return {
+            "count": self._count,
+            "min": vals[0],
+            "p50": at(50),
+            "p90": at(90),
+            "p99": at(99),
+            "max": vals[-1],
+        }
+
+
+# ------------------------------------------------------------------------------ registry
+class _NullScope:
+    """Disabled-mode span: a shared singleton so the fast path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+class _Span:
+    """Wall-clock scope recorded as one complete ('X') trace event + a Timer observation."""
+
+    __slots__ = ("_tel", "name", "cat", "args", "owner", "op", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str, cat: str, args: Optional[dict],
+                 owner: Any = None, op: Optional[str] = None) -> None:
+        self._tel = tel
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.owner = owner
+        self.op = op
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        t1 = time.perf_counter()
+        dur_s = t1 - self._t0
+        tel = self._tel
+        tel.timer(self.name).observe(dur_s)
+        tel.event(
+            self.name, ph="X", cat=self.cat,
+            ts_us=(self._t0 - tel._epoch) * 1e6, dur_us=dur_s * 1e6, args=self.args,
+        )
+        if self.owner is not None and self.op is not None:
+            times = self.owner.__dict__.setdefault("_tm_times", {})
+            times[self.op] = times.get(self.op, 0.0) + dur_s
+        return False
+
+
+class Telemetry:
+    """Registry of named instruments plus a bounded trace-event log.
+
+    One process-global instance lives at :data:`telemetry`; fresh instances are cheap and
+    handy for tests:
+
+        >>> t = Telemetry()
+        >>> t.counter("x").inc(2)
+        >>> t.counter("x").value
+        2
+        >>> t.event("ignored-while-disabled")
+        >>> len(t.events())
+        0
+    """
+
+    def __init__(self, enabled: Optional[bool] = None, max_events: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._events: deque = deque(maxlen=max_events or _env_int(ENV_MAX_EVENTS, 200_000))
+        self._dropped_events = 0
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+        self.enabled = _env_enabled() if enabled is None else enabled
+
+    # -- instrument access (get-or-create, thread-safe) ---------------------------------
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def timer(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            with self._lock:
+                t = self._timers.setdefault(name, Timer(name))
+        return t
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name))
+        return h
+
+    def get_histogram(self, name: str) -> Optional[Histogram]:
+        return self._histograms.get(name)
+
+    # -- event log ----------------------------------------------------------------------
+    def now_us(self) -> float:
+        return (time.perf_counter() - self._epoch) * 1e6
+
+    def event(
+        self,
+        name: str,
+        ph: str = "i",
+        cat: str = "tm",
+        ts_us: Optional[float] = None,
+        dur_us: Optional[float] = None,
+        args: Optional[dict] = None,
+        tid: Optional[int] = None,
+    ) -> None:
+        """Append one Chrome trace_event-shaped record (no-op while disabled)."""
+        if not self.enabled:
+            return
+        evt: Dict[str, Any] = {
+            "name": name,
+            "cat": cat,
+            "ph": ph,
+            "ts": round(self.now_us() if ts_us is None else ts_us, 3),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFF if tid is None else tid,
+        }
+        if ph == "i":
+            evt["s"] = "t"  # thread-scoped instant
+        if dur_us is not None:
+            evt["dur"] = round(dur_us, 3)
+        if args:
+            evt["args"] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped_events += 1
+            self._events.append(evt)
+
+    def span(self, name: str, cat: str = "tm", args: Optional[dict] = None):
+        """Timed scope → one 'X' event + a Timer observation; null scope while disabled."""
+        if not self.enabled:
+            return _NULL_SCOPE
+        return _Span(self, name, cat, args)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped_events(self) -> int:
+        return self._dropped_events
+
+    @property
+    def pid(self) -> int:
+        return self._pid
+
+    # -- lifecycle ----------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time view of every instrument (JSON-serialisable)."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            timers = {
+                n: {"count": t.count, "total_s": round(t.total_s, 6), "mean_s": round(t.mean_s, 9)}
+                for n, t in self._timers.items()
+            }
+            hists = {n: h.summary() for n, h in self._histograms.items()}
+            n_events = len(self._events)
+        return {
+            "enabled": self.enabled,
+            "counters": counters,
+            "timers": timers,
+            "histograms": hists,
+            "events_recorded": n_events,
+            "events_dropped": self._dropped_events,
+        }
+
+    def reset(self, clear_events: bool = True) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._histograms.clear()
+            if clear_events:
+                self._events.clear()
+                self._dropped_events = 0
+
+
+#: The process-global registry every built-in hook records into.
+telemetry = Telemetry()
+
+
+def is_enabled() -> bool:
+    return telemetry.enabled
+
+
+def enable() -> None:
+    telemetry.enabled = True
+
+
+def disable() -> None:
+    telemetry.enabled = False
+
+
+@contextmanager
+def enabled(flag: bool = True) -> Iterator[Telemetry]:
+    """Scoped activation: ``with obs.enabled(): ...`` (restores the prior state on exit)."""
+    prev = telemetry.enabled
+    telemetry.enabled = flag
+    try:
+        yield telemetry
+    finally:
+        telemetry.enabled = prev
+
+
+# ------------------------------------------------------------------- engine-facing hooks
+def bump(owner: Any, key: str, n: int = 1) -> None:
+    """Increment a per-instance counter dict on ``owner`` (lazily created, always-on)."""
+    counts = owner.__dict__.get("_tm_counts")
+    if counts is None:
+        counts = {}
+        object.__setattr__(owner, "_tm_counts", counts)
+    counts[key] = counts.get(key, 0) + n
+
+
+def count_dispatch(owner: Any, n: int = 1) -> None:
+    """Record ``n`` device-program launches attributed to ``owner``."""
+    bump(owner, "dispatches", n)
+    telemetry.counter("engine.dispatches").inc(n)
+
+
+def metric_span(owner: Any, op: str):
+    """Timed scope for one metric operation; null scope while tracing is disabled.
+
+    Records a ``{Class}.{op}`` complete event, a ``metric.{Class}.{op}`` timer observation,
+    and accumulates per-instance wall time (surfaced by ``Metric.telemetry``).
+    """
+    if not telemetry.enabled:
+        return _NULL_SCOPE
+    name = f"{type(owner).__name__}.{op}"
+    return _Span(telemetry, f"metric.{name}", "metric", None, owner=owner, op=op)
+
+
+# ------------------------------------------------------------------- retrace detection
+_retrace_warn_threshold = _env_int(ENV_RETRACE_THRESHOLD, 3)
+
+
+def retrace_warn_threshold() -> int:
+    return _retrace_warn_threshold
+
+
+def set_retrace_warn_threshold(n: int) -> None:
+    """Retraces-per-kernel above which the one-shot recompile-churn warning fires."""
+    global _retrace_warn_threshold
+    _retrace_warn_threshold = int(n)
+
+
+def describe_abstract(*trees: Any) -> str:
+    """Compact dtype/shape signature of a pytree of (possibly traced) arrays.
+
+    This is the jit cache key surrogate logged on every new trace: two different signatures
+    for the same kernel mean XLA compiled it twice.
+    """
+    import numpy as np
+
+    try:
+        from jax.tree_util import tree_leaves
+    except Exception:  # pragma: no cover - jax always present in this package
+        def tree_leaves(x):
+            return [x]
+
+    parts = []
+    for leaf in tree_leaves(trees):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            parts.append(type(leaf).__name__)
+            continue
+        try:
+            d = np.dtype(dtype)
+            parts.append(f"{d.kind}{d.itemsize * 8}[{','.join(str(s) for s in shape)}]")
+        except TypeError:
+            parts.append(f"{dtype}[{','.join(str(s) for s in shape)}]")
+    return ";".join(parts)
+
+
+def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict) -> None:
+    """Record one jit (re)trace of ``owner``'s ``kind`` kernel.
+
+    Called from inside the traced Python callable, so it fires exactly once per XLA
+    compilation (jax only executes the Python body on a cache miss). Counting is always-on;
+    the cache-key event needs tracing enabled; the churn warning is one-shot per instance.
+    """
+    counts = owner.__dict__.get("_tm_counts")
+    if counts is None:
+        counts = {}
+        object.__setattr__(owner, "_tm_counts", counts)
+    key = f"traces.{kind}"
+    counts[key] = counts.get(key, 0) + 1
+    cls = type(owner).__name__
+    telemetry.counter(f"jit.trace.{cls}.{kind}").inc()
+    if counts[key] > 1:
+        # instance-accurate: the class-level trace counter alone can't distinguish "two
+        # instances compiled once each" from "one instance recompiled" — this one can
+        telemetry.counter(f"jit.retrace.{cls}.{kind}").inc()
+    sig = describe_abstract(args, kwargs)
+    if telemetry.enabled:
+        telemetry.event(
+            f"jit.trace.{cls}.{kind}", ph="i", cat="jit",
+            args={"cache_key": sig, "trace_index": counts[key]},
+        )
+    retraces = counts[key] - 1
+    if retraces > _retrace_warn_threshold and not owner.__dict__.get("_tm_retrace_warned", False):
+        object.__setattr__(owner, "_tm_retrace_warned", True)
+        rank_zero_warn(
+            f"Metric {cls} retraced its jitted {kind!r} kernel {retraces} times (threshold"
+            f" {_retrace_warn_threshold}) — recompile churn, usually shape/dtype-polymorphic"
+            " inputs. Pad batches to a fixed shape, or raise the threshold via"
+            f" obs.set_retrace_warn_threshold / ${ENV_RETRACE_THRESHOLD}. Latest cache key: {sig}",
+            UserWarning,
+        )
+
+
+def instrument_trace(fn: Callable, owner: Any, kind: str) -> Callable:
+    """Wrap a to-be-jitted callable so every trace is recorded via :func:`record_trace`."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any):
+        record_trace(owner, kind, args, kwargs)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+# ----------------------------------------------------------------------------- helpers
+def tree_bytes(tree: Any) -> int:
+    """Total byte size of every array-like leaf in a pytree (works on tracers: shape/dtype only)."""
+    import numpy as np
+
+    try:
+        from jax.tree_util import tree_leaves
+    except Exception:  # pragma: no cover
+        def tree_leaves(x):
+            return [x]
+
+    total = 0
+    for leaf in tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for s in shape:
+            n *= int(s)
+        try:
+            total += n * np.dtype(dtype).itemsize
+        except TypeError:
+            continue
+    return total
+
+
+def device_sync(x: Any) -> Any:
+    """``jax.block_until_ready`` with the host-blocking round-trip counted and (when tracing
+    is on) recorded as a span — use in driver code where blocking is part of the protocol."""
+    import jax
+
+    telemetry.counter("host.block_until_ready").inc()
+    if not telemetry.enabled:
+        return jax.block_until_ready(x)
+    with telemetry.span("host.block_until_ready", cat="host"):
+        return jax.block_until_ready(x)

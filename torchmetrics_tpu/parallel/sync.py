@@ -15,6 +15,7 @@ uneven shapes        gather sizes → pad → gather → trim         static pad
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
@@ -22,7 +23,26 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
+from torchmetrics_tpu import obs
+
 ReduceFx = Union[str, Callable, None]
+
+
+def _axis_size(axis_name: str) -> Optional[int]:
+    """Static size of a mesh axis from inside a traced computation; None if unresolvable.
+
+    ``lax.axis_size`` only exists on newer JAX; ``psum(1, axis)`` constant-folds to the axis
+    size as a concrete int on every release this repo supports.
+    """
+    try:
+        return int(lax.axis_size(axis_name))
+    except Exception:
+        pass
+    try:
+        size = lax.psum(1, axis_name)
+        return int(size) if isinstance(size, int) else None
+    except Exception:
+        return None
 
 
 def _reduce_one(value: Array, reduce_fx: ReduceFx, axis_name: str) -> Array:
@@ -55,7 +75,23 @@ def sync_state(
 
     List states (Python lists of arrays) are pre-concatenated along dim 0 — mirroring
     ``metric.py:431-432`` — then treated as ``cat``.
+
+    Telemetry: this body runs at TRACE time (the collectives execute inside the compiled
+    program, where wall-clock timing is impossible), so the recorded event carries what IS
+    known at trace time — state names, reduce-fx, payload bytes, and mesh-axis size. Executed
+    latency is measured by the eager paths (``process_sync``) and the bench sync probes.
     """
+    obs.telemetry.counter("sync.sync_state.traces").inc()
+    obs.telemetry.event(
+        "sync.sync_state", cat="sync",
+        args={
+            "axis": axis_name,
+            "mesh_size": _axis_size(axis_name),
+            "states": sorted(state),
+            "bytes": obs.tree_bytes(state),
+            "reductions": {k: getattr(v, "__name__", str(v)) for k, v in reductions.items()},
+        },
+    )
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions.get(name, "sum")
@@ -89,6 +125,7 @@ def gather_all_arrays(value: Array, group: Optional[str] = None) -> List[Array]:
     ``distributed.py:97-147``). No-op single-element list when world size is 1.
     """
     del group
+    obs.telemetry.counter("sync.gather.calls").inc()
     if jax.process_count() == 1:
         return [value]
     from jax.experimental import multihost_utils
@@ -115,6 +152,8 @@ def process_sync(
     """
     import inspect
 
+    obs.telemetry.counter("sync.process_sync.calls").inc()
+    t0 = time.perf_counter() if obs.telemetry.enabled else 0.0
     gather = gather_fn or gather_all_arrays
     takes_name = False
     try:
@@ -154,6 +193,18 @@ def process_sync(
                 out[name] = fx(jnp.stack(gathered))
             else:
                 raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+    if obs.telemetry.enabled:
+        dur_us = (time.perf_counter() - t0) * 1e6
+        try:
+            world = jax.process_count()
+        except Exception:
+            world = 1
+        obs.telemetry.histogram("sync.process_sync.latency_us").record(dur_us)
+        obs.telemetry.event(
+            "sync.process_sync", ph="X", cat="sync",
+            ts_us=obs.telemetry.now_us() - dur_us, dur_us=dur_us,
+            args={"world": world, "states": sorted(state), "bytes": obs.tree_bytes(state)},
+        )
     return out
 
 

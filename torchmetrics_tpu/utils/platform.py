@@ -16,25 +16,76 @@ from __future__ import annotations
 
 import subprocess
 import sys
-from typing import Iterable, Optional
+import time
+from typing import Dict, Iterable, Optional
+
+# Per-process probe memo: each probe subprocess pays a full interpreter + jax import (a known
+# test-flake and wall-clock tax when several entry points re-probe the same platform), and a
+# platform's health does not change within one process lifetime. ``refresh=True`` re-probes.
+_PROBE_CACHE: Dict[str, bool] = {}
 
 
-def platform_responds(platform: str, timeout_s: float = 25.0) -> bool:
-    """True iff a fresh process can init the backend AND run one jitted op on ``platform``."""
+def probe_cache_clear() -> None:
+    """Drop all memoised probe results (tests / long-lived drivers that must re-check)."""
+    _PROBE_CACHE.clear()
+
+
+def _telemetry():
+    """The obs registry, or None if the package (with its jax import) isn't loadable yet."""
+    try:
+        from torchmetrics_tpu.obs import telemetry
+
+        return telemetry
+    except Exception:
+        return None
+
+
+def platform_responds(platform: str, timeout_s: float = 25.0, refresh: bool = False) -> bool:
+    """True iff a fresh process can init the backend AND run one jitted op on ``platform``.
+
+    Results are memoised per process (the probe costs a full interpreter + jax import);
+    pass ``refresh=True`` to force a re-probe. Every attempt and outcome — including cache
+    hits — lands in telemetry under ``platform.probe.*``.
+    """
+    tel = _telemetry()
+    if not refresh and platform in _PROBE_CACHE:
+        healthy = _PROBE_CACHE[platform]
+        if tel is not None:
+            tel.counter("platform.probe.cache_hits").inc()
+            tel.event(
+                "platform.probe", cat="platform",
+                args={"platform": platform, "outcome": "cached", "healthy": healthy},
+            )
+        return healthy
     code = (
         "import jax; jax.config.update('jax_platforms', %r);"
         " import jax.numpy as jnp;"
         " jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.zeros(8)))" % platform
     )
+    t0 = time.perf_counter()
     try:
-        return (
+        healthy = (
             subprocess.run(
                 [sys.executable, "-c", code], timeout=timeout_s, capture_output=True
             ).returncode
             == 0
         )
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        outcome = "ok" if healthy else "probe_failed"
+    except (subprocess.TimeoutExpired, OSError) as err:
+        healthy = False
+        outcome = type(err).__name__
+    dur_us = (time.perf_counter() - t0) * 1e6
+    _PROBE_CACHE[platform] = healthy
+    if tel is not None:
+        tel.counter("platform.probe.attempts").inc()
+        if not healthy:
+            tel.counter("platform.probe.failures").inc()
+        tel.event(
+            "platform.probe", ph="X", cat="platform",
+            ts_us=tel.now_us() - dur_us, dur_us=dur_us,
+            args={"platform": platform, "outcome": outcome, "healthy": healthy},
+        )
+    return healthy
 
 
 def resolve_healthy_platform(

@@ -14,6 +14,17 @@ from typing import Any, Callable
 
 log = logging.getLogger("torchmetrics_tpu")
 
+# One-shot warning semantics: a (message, category) pair fires at most once per process, so
+# per-step warnings (e.g. the obs retrace-churn detector, compute-before-update) cannot spam a
+# training loop. Tests reset via reset_warning_cache() (autouse fixture in the suite).
+_SEEN_WARNINGS: set = set()
+_SEEN_WARNINGS_CAP = 10_000  # bound memory for pathological message churn
+
+
+def reset_warning_cache() -> None:
+    """Clear the one-shot warning memo so deduplicated warnings can fire again."""
+    _SEEN_WARNINGS.clear()
+
 
 def _get_rank() -> int:
     for env in ("LOCAL_RANK", "RANK", "PROCESS_ID"):
@@ -44,6 +55,12 @@ def rank_zero_only(fn: Callable) -> Callable:
 
 @rank_zero_only
 def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 5, **kwargs: Any) -> None:
+    key = (str(message), category)
+    if key in _SEEN_WARNINGS:
+        return
+    if len(_SEEN_WARNINGS) >= _SEEN_WARNINGS_CAP:
+        _SEEN_WARNINGS.clear()
+    _SEEN_WARNINGS.add(key)
     warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
 
 

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench telemetry-smoke clean
+.PHONY: test test-unit test-integration doctest bench telemetry-smoke jaxlint clean
 
-test: test-unit test-integration
+test: jaxlint test-unit test-integration
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -14,6 +14,12 @@ doctest:
 
 bench:
 	python bench.py
+
+# static JAX/TPU hazard analysis (rules TPU001-TPU006, docs/static-analysis.md): exits
+# nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
+# with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`
+jaxlint:
+	python -m torchmetrics_tpu._lint torchmetrics_tpu --strict-baseline
 
 # tier-1 guard for the observability exporter: one fused-sweep iteration with telemetry on,
 # trace exported and schema-checked (also runs as part of test-integration / the tier-1 lane)

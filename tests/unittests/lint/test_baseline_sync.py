@@ -1,0 +1,50 @@
+"""Self-check: the shipped jaxlint baseline is exactly in sync with the package.
+
+Fails when the package grows a non-baselined finding (fix it or re-run
+``python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline``) AND when a
+baselined finding no longer occurs (stale entry — regenerate so the waived set never rots).
+This is the same gate ``make jaxlint`` enforces in CI.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import torchmetrics_tpu
+from torchmetrics_tpu._lint import (
+    DEFAULT_BASELINE_PATH,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    package_lint_status,
+)
+
+
+def test_shipped_baseline_is_in_sync():
+    package_root = Path(torchmetrics_tpu.__file__).resolve().parent
+    findings = analyze_paths([package_root])
+    entries = load_baseline(DEFAULT_BASELINE_PATH)
+    assert entries, "shipped baseline is missing or empty — run --write-baseline"
+    new, _waived, stale = apply_baseline(findings, entries)
+    assert not new, (
+        "non-baselined jaxlint finding(s) — fix them or regenerate the baseline:\n"
+        + "\n".join(f.render() for f in new)
+    )
+    assert not stale, (
+        "stale jaxlint baseline entr(ies) — the flagged code changed; regenerate the baseline:\n"
+        + "\n".join(f"{e['rule']} {e['path']} :: {e['fingerprint']!r}" for e in stale)
+    )
+
+
+def test_package_lint_status_matches_direct_analysis():
+    status = package_lint_status()
+    assert status["new"] == 0 and status["stale"] == 0
+    assert status["findings"] == status["baselined"] > 0
+
+
+def test_bench_extras_embeds_lint_status():
+    from torchmetrics_tpu import obs
+
+    extras = obs.bench_extras()
+    assert extras["lint_findings"] == 0
+    assert extras["lint_baselined"] > 0
+    assert extras["lint_stale_baseline"] == 0

@@ -1,0 +1,363 @@
+"""Per-rule fixture tests for jaxlint (TPU001-TPU006).
+
+Every rule gets at least one failing snippet and one clean snippet — the clean twins pin
+down the false-positive boundaries (sanctioned ``jax.device_get`` syncs, static-shape
+branching, declared static_argnames, jit-baked constants) so rule tightening that would
+flood the codebase with noise fails here first.
+"""
+from __future__ import annotations
+
+import textwrap
+
+from torchmetrics_tpu._lint import analyze_source
+
+
+def _rules(snippet: str, path: str = "fixture.py"):
+    return [f.rule for f in analyze_source(textwrap.dedent(snippet), path=path)]
+
+
+# ------------------------------------------------------------------------------- TPU001
+class TestTPU001HostSync:
+    def test_item_flags(self):
+        assert "TPU001" in _rules(
+            """
+            def read_scalar(metric):
+                total = jnp.sum(metric)
+                return total.item()
+            """
+        )
+
+    def test_float_on_jnp_call_flags(self):
+        assert "TPU001" in _rules(
+            """
+            def loss_value(x):
+                return float(jnp.mean(x))
+            """
+        )
+
+    def test_bool_of_jitted_callable_result_flags(self):
+        # the retrieval/base.py shape: a locally jit-wrapped callable's result is a device
+        # array, and bool() on it forces a blocking sync
+        assert "TPU001" in _rules(
+            """
+            def compute(x):
+                fn = jax.jit(kernel)
+                flag = fn(x)
+                if bool(flag):
+                    raise ValueError("boom")
+            """
+        )
+
+    def test_inside_jit_flags(self):
+        assert "TPU001" in _rules(
+            """
+            @jax.jit
+            def f(x):
+                return int(jnp.argmax(x))
+            """
+        )
+
+    def test_device_get_is_clean(self):
+        assert _rules(
+            """
+            def compute(x):
+                return bool(jax.device_get(jnp.any(x)))
+            """
+        ) == []
+
+    def test_int_on_shape_is_clean(self):
+        assert _rules(
+            """
+            def pad(x):
+                n = int(x.shape[0])
+                return n + int(jnp.shape(x)[0])
+            """
+        ) == []
+
+
+# ------------------------------------------------------------------------------- TPU002
+class TestTPU002DataDependentBranch:
+    def test_if_on_traced_param_flags(self):
+        assert "TPU002" in _rules(
+            """
+            @jax.jit
+            def f(x):
+                if x.sum() > 0:
+                    return x
+                return -x
+            """
+        )
+
+    def test_while_on_traced_flags(self):
+        assert "TPU002" in _rules(
+            """
+            @jax.jit
+            def f(x):
+                while jnp.max(x) > 1.0:
+                    x = x * 0.5
+                return x
+            """
+        )
+
+    def test_shape_branch_is_clean(self):
+        assert _rules(
+            """
+            @jax.jit
+            def f(x):
+                if x.ndim > 1 and x.shape[0] > 2:
+                    return x.reshape(-1)
+                return x
+            """
+        ) == []
+
+    def test_config_string_branch_is_clean(self):
+        # dispatch on a (statically-declared) config parameter is a host decision
+        assert _rules(
+            """
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode="mean", bias=None):
+                if mode == "mean":
+                    return x.mean()
+                if bias is None:
+                    return x.sum()
+                return x
+            """
+        ) == []
+
+    def test_eager_branch_is_clean(self):
+        # TPU002 is a jit-context rule; eager control flow on arrays is TPU001's business
+        assert "TPU002" not in _rules(
+            """
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    return y
+                return -y
+            """
+        )
+
+
+# ------------------------------------------------------------------------------- TPU003
+class TestTPU003HostNumpyInJit:
+    def test_np_on_traced_flags(self):
+        assert "TPU003" in _rules(
+            """
+            @jax.jit
+            def f(x):
+                return np.log(x)
+            """
+        )
+
+    def test_np_via_wrapper_reference_flags(self):
+        # jit context must propagate through jax.jit(fn) call-form wrapping
+        assert "TPU003" in _rules(
+            """
+            def kernel(x):
+                return np.asarray(x) + 1
+            fn = jax.jit(kernel)
+            """
+        )
+
+    def test_np_constant_is_clean(self):
+        assert _rules(
+            """
+            @jax.jit
+            def f(x):
+                return x * np.float32(2.0) + np.pi
+            """
+        ) == []
+
+    def test_jnp_equivalent_is_clean(self):
+        assert _rules(
+            """
+            @jax.jit
+            def f(x):
+                return jnp.log(x)
+            """
+        ) == []
+
+
+# ------------------------------------------------------------------------------- TPU004
+class TestTPU004NonStaticConfig:
+    def test_call_form_missing_static_flags(self):
+        assert "TPU004" in _rules(
+            """
+            def kernel(x, mode="fast"):
+                return x
+            fn = jax.jit(kernel)
+            """
+        )
+
+    def test_decorator_missing_static_flags(self):
+        assert "TPU004" in _rules(
+            """
+            @functools.partial(jax.jit)
+            def kernel(x, interpret=False):
+                return x
+            """
+        )
+
+    def test_declared_static_argnames_is_clean(self):
+        assert _rules(
+            """
+            def kernel(x, mode="fast", interpret=False):
+                return x
+            fn = jax.jit(kernel, static_argnames=("mode", "interpret"))
+            """
+        ) == []
+
+    def test_static_argnums_is_clean(self):
+        assert _rules(
+            """
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def kernel(x, mode="fast"):
+                return x
+            """
+        ) == []
+
+    def test_array_defaults_are_clean(self):
+        # None-defaulted optional arrays are data, not config — must not be flagged
+        assert _rules(
+            """
+            def kernel(x, perm=None, scale=1.0):
+                return x
+            fn = jax.jit(kernel)
+            """
+        ) == []
+
+
+# ------------------------------------------------------------------------------- TPU005
+class TestTPU005StateContract:
+    def test_weak_int_sum_accumulator_flags(self):
+        assert "TPU005" in _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+            """
+        )
+
+    def test_nonzero_sum_default_flags(self):
+        assert "TPU005" in _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", jnp.ones(()), dist_reduce_fx="sum")
+            """
+        )
+
+    def test_zero_default_under_max_flags(self):
+        assert "TPU005" in _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("peak", jnp.zeros(()), dist_reduce_fx="max")
+            """
+        )
+
+    def test_non_additive_sum_update_flags(self):
+        assert "TPU005" in _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+                def _update(self, state, x):
+                    return {"total": jnp.sum(x)}
+            """
+        )
+
+    def test_additive_update_and_float_default_is_clean(self):
+        assert _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+                def _update(self, state, x):
+                    return {"total": state["total"] + jnp.sum(x)}
+            """
+        ) == []
+
+    def test_transitive_state_read_is_clean(self):
+        # accumulation through a helper that receives the previous state still reads it
+        assert _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+                def _update(self, state, x):
+                    new_total = _helper(x, prev=state["total"])
+                    return {"total": new_total}
+            """
+        ) == []
+
+    def test_multi_registration_state_is_skipped(self):
+        # config-dependent __init__ branches register the same state under different
+        # contracts — no single contract to check, so neither branch may be flagged
+        assert _rules(
+            """
+            class M(Metric):
+                def __init__(self, samplewise):
+                    if samplewise:
+                        self.add_state("tp", [], dist_reduce_fx="cat")
+                    else:
+                        self.add_state("tp", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+                def _update(self, state, x):
+                    return {"tp": jnp.sum(x)}
+            """
+        ) == []
+
+
+# ------------------------------------------------------------------------------- TPU006
+class TestTPU006ConstantReupload:
+    def test_constant_in_forward_flags(self):
+        assert "TPU006" in _rules(
+            """
+            class M(Metric):
+                def forward(self, x):
+                    pad = jnp.zeros((4,))
+                    return x + pad
+            """
+        )
+
+    def test_constant_in_update_flags(self):
+        assert "TPU006" in _rules(
+            """
+            class M(Metric):
+                def update(self, x):
+                    self.total = self.total + jnp.asarray(1.0)
+            """
+        )
+
+    def test_constant_inside_jit_is_clean(self):
+        # under jit the constant is baked into the compiled program — uploaded once
+        assert _rules(
+            """
+            @jax.jit
+            def forward(x):
+                return x + jnp.zeros((4,))
+            """
+        ) == []
+
+    def test_data_dependent_array_is_clean(self):
+        assert _rules(
+            """
+            class M(Metric):
+                def forward(self, x):
+                    return jnp.asarray(x) + 1
+            """
+        ) == []
+
+    def test_cold_path_is_clean(self):
+        # __init__ runs once — constants there are not per-step uploads
+        assert "TPU006" not in _rules(
+            """
+            class M(Metric):
+                def __init__(self):
+                    self.offset = jnp.zeros((4,))
+            """
+        )
+
+
+# ------------------------------------------------------------------------------- TPU000
+def test_syntax_error_reports_tpu000():
+    assert _rules("def broken(:\n") == ["TPU000"]

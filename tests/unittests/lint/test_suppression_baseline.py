@@ -1,0 +1,177 @@
+"""Suppression-comment, baseline round-trip, and CLI contract tests for jaxlint."""
+from __future__ import annotations
+
+import json
+import textwrap
+
+from torchmetrics_tpu._lint import (
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from torchmetrics_tpu._lint.__main__ import main as jaxlint_main
+
+BAD_TPU001 = textwrap.dedent(
+    """
+    def compute(x):
+        return float(jnp.mean(x))
+    """
+)
+
+BAD_PER_RULE = {
+    "TPU001": BAD_TPU001,
+    "TPU002": "@jax.jit\ndef f(x):\n    if x.sum() > 0:\n        return x\n    return -x\n",
+    "TPU003": "@jax.jit\ndef f(x):\n    return np.log(x)\n",
+    "TPU004": "def kernel(x, mode='fast'):\n    return x\nfn = jax.jit(kernel)\n",
+    "TPU005": (
+        "class M(Metric):\n"
+        "    def __init__(self):\n"
+        "        self.add_state('count', jnp.asarray(0), dist_reduce_fx='sum')\n"
+    ),
+    "TPU006": "class M(Metric):\n    def forward(self, x):\n        return x + jnp.zeros((4,))\n",
+}
+
+
+# ---------------------------------------------------------------------------- suppression
+class TestSuppression:
+    def test_same_line_rule_suppression(self):
+        src = "def compute(x):\n    return float(jnp.mean(x))  # jaxlint: disable=TPU001\n"
+        assert analyze_source(src) == []
+
+    def test_suppression_of_other_rule_does_not_waive(self):
+        src = "def compute(x):\n    return float(jnp.mean(x))  # jaxlint: disable=TPU002\n"
+        assert [f.rule for f in analyze_source(src)] == ["TPU001"]
+
+    def test_bare_disable_waives_all_rules(self):
+        src = "def compute(x):\n    return float(jnp.mean(x))  # jaxlint: disable\n"
+        assert analyze_source(src) == []
+
+    def test_multi_rule_suppression(self):
+        src = (
+            "@jax.jit\ndef f(x):\n"
+            "    if bool(jnp.any(x)):  # jaxlint: disable=TPU001,TPU002\n"
+            "        return x\n    return -x\n"
+        )
+        assert analyze_source(src) == []
+
+
+# ------------------------------------------------------------------------------- baseline
+class TestBaselineRoundTrip:
+    def test_round_trip_waives_exactly_the_written_set(self, tmp_path):
+        findings = analyze_source(BAD_TPU001, path="mod.py")
+        assert findings
+        bpath = tmp_path / "baseline.json"
+        write_baseline(findings, bpath)
+        new, waived, stale = apply_baseline(findings, load_baseline(bpath))
+        assert new == [] and waived == len(findings) and stale == []
+
+    def test_line_number_drift_does_not_invalidate(self, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        write_baseline(analyze_source(BAD_TPU001, path="mod.py"), bpath)
+        shifted = "# a new leading comment\n\n" + BAD_TPU001  # same code, new line numbers
+        new, waived, stale = apply_baseline(
+            analyze_source(shifted, path="mod.py"), load_baseline(bpath)
+        )
+        assert new == [] and waived == 1 and stale == []
+
+    def test_new_finding_is_not_waived(self, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        write_baseline(analyze_source(BAD_TPU001, path="mod.py"), bpath)
+        grown = BAD_TPU001 + "\ndef compute2(y):\n    return int(jnp.argmax(y))\n"
+        new, waived, stale = apply_baseline(
+            analyze_source(grown, path="mod.py"), load_baseline(bpath)
+        )
+        assert [f.rule for f in new] == ["TPU001"] and waived == 1 and stale == []
+
+    def test_fixed_finding_goes_stale(self, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        write_baseline(analyze_source(BAD_TPU001, path="mod.py"), bpath)
+        fixed = "def compute(x):\n    return jnp.mean(x)\n"
+        new, waived, stale = apply_baseline(
+            analyze_source(fixed, path="mod.py"), load_baseline(bpath)
+        )
+        assert new == [] and waived == 0 and len(stale) == 1
+        assert stale[0]["rule"] == "TPU001"
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json") == []
+
+
+# ------------------------------------------------------------------------------------ CLI
+class TestCli:
+    def _write(self, tmp_path, name, src):
+        p = tmp_path / name
+        p.write_text(src)
+        return str(p)
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        path = self._write(tmp_path, "clean.py", "def f(x):\n    return x\n")
+        assert jaxlint_main([path, "--baseline", "none"]) == 0
+        assert "0 new finding(s)" in capsys.readouterr().out
+
+    def test_each_rule_fixture_exits_nonzero(self, tmp_path, capsys):
+        # the acceptance gate: injecting any of the six rule fixtures must fail the run
+        for rule, src in BAD_PER_RULE.items():
+            path = self._write(tmp_path, f"bad_{rule.lower()}.py", src)
+            rc = jaxlint_main([path, "--baseline", "none"])
+            out = capsys.readouterr().out
+            assert rc == 1, f"{rule} fixture did not fail the run"
+            assert rule in out, f"{rule} not reported:\n{out}"
+
+    def test_write_baseline_then_gate_passes(self, tmp_path, capsys):
+        path = self._write(tmp_path, "bad.py", BAD_TPU001)
+        bpath = str(tmp_path / "baseline.json")
+        assert jaxlint_main([path, "--baseline", bpath, "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert jaxlint_main([path, "--baseline", bpath, "--strict-baseline"]) == 0
+
+    def test_strict_baseline_fails_on_stale(self, tmp_path, capsys):
+        path = self._write(tmp_path, "bad.py", BAD_TPU001)
+        bpath = str(tmp_path / "baseline.json")
+        assert jaxlint_main([path, "--baseline", bpath, "--write-baseline"]) == 0
+        (tmp_path / "bad.py").write_text("def f(x):\n    return x\n")  # fix the finding
+        capsys.readouterr()
+        assert jaxlint_main([path, "--baseline", bpath]) == 0  # lax mode: stale is a warning
+        assert jaxlint_main([path, "--baseline", bpath, "--strict-baseline"]) == 1
+
+    def test_json_format(self, tmp_path, capsys):
+        path = self._write(tmp_path, "bad.py", BAD_TPU001)
+        assert jaxlint_main([path, "--baseline", "none", "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["tool"] == "jaxlint" and payload["new_count"] == 1
+        assert payload["new"][0]["rule"] == "TPU001"
+
+    def test_sarif_format(self, tmp_path, capsys):
+        path = self._write(tmp_path, "bad.py", BAD_TPU001)
+        assert jaxlint_main([path, "--baseline", "none", "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        results = doc["runs"][0]["results"]
+        assert results and results[0]["ruleId"] == "TPU001"
+        assert results[0]["locations"][0]["physicalLocation"]["region"]["startLine"] >= 1
+
+    def test_select_restricts_rules(self, tmp_path, capsys):
+        path = self._write(tmp_path, "bad.py", BAD_PER_RULE["TPU002"])
+        assert jaxlint_main([path, "--baseline", "none", "--select", "TPU001"]) == 0
+        capsys.readouterr()
+        assert jaxlint_main([path, "--baseline", "none", "--select", "TPU002"]) == 1
+
+    def test_unknown_rule_and_missing_path_are_usage_errors(self, tmp_path):
+        path = self._write(tmp_path, "clean.py", "x = 1\n")
+        assert jaxlint_main([path, "--select", "TPU999"]) == 2
+        assert jaxlint_main([str(tmp_path / "missing.py")]) == 2
+
+    def test_list_rules(self, capsys):
+        assert jaxlint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006"):
+            assert rule in out
+
+    def test_directory_display_paths_are_root_relative(self, tmp_path):
+        pkg = tmp_path / "pkg" / "sub"
+        pkg.mkdir(parents=True)
+        (pkg / "mod.py").write_text(BAD_TPU001)
+        findings = analyze_paths([tmp_path / "pkg"])
+        assert [f.path for f in findings] == ["pkg/sub/mod.py"]

@@ -1,0 +1,62 @@
+"""torchmetrics_tpu._lint — **jaxlint**, the AST-based JAX/TPU hazard analyzer.
+
+Static twin of the runtime ``obs`` telemetry: hazards that ``obs`` counts when a program
+executes (retrace churn, host syncs, dispatch storms) are visible in the source long before
+any accelerator runs — this package flags them at lint time, with a checked-in baseline so
+CI gates only on *new* findings. Stdlib-only: importing or running the analyzer never
+initialises jax or touches a device.
+
+Usage::
+
+    python -m torchmetrics_tpu._lint torchmetrics_tpu            # lint the package
+    make jaxlint                                                 # CI gate (strict baseline)
+
+Rules TPU001–TPU006 are documented with bad/good examples in ``docs/static-analysis.md``;
+per-line suppression is ``# jaxlint: disable=TPU00X``.
+"""
+from torchmetrics_tpu._lint.baseline import (
+    DEFAULT_BASELINE_PATH,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from torchmetrics_tpu._lint.core import Finding, analyze_paths, analyze_source
+from torchmetrics_tpu._lint.rules import RULES
+
+__all__ = [
+    "DEFAULT_BASELINE_PATH",
+    "Finding",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "apply_baseline",
+    "load_baseline",
+    "package_lint_status",
+    "write_baseline",
+]
+
+
+def package_lint_status() -> dict:
+    """One-shot analyzer status over the installed package, against the shipped baseline.
+
+    Returns ``{"findings", "new", "baselined", "stale"}`` counts. Cached after the first
+    call (the tree is re-parsed only once per process) — cheap enough for
+    ``obs.bench_extras()`` to embed in every BENCH JSON.
+    """
+    global _STATUS_CACHE
+    if _STATUS_CACHE is None:
+        from pathlib import Path
+
+        package_root = Path(__file__).resolve().parent.parent
+        findings = analyze_paths([package_root])
+        new, waived, stale = apply_baseline(findings, load_baseline(DEFAULT_BASELINE_PATH))
+        _STATUS_CACHE = {
+            "findings": len(findings),
+            "new": len(new),
+            "baselined": waived,
+            "stale": len(stale),
+        }
+    return dict(_STATUS_CACHE)
+
+
+_STATUS_CACHE = None

@@ -1,0 +1,97 @@
+"""jaxlint CLI: ``python -m torchmetrics_tpu._lint [paths ...]``.
+
+Exit codes: 0 clean (all findings baselined), 1 new findings (or stale baseline entries
+under ``--strict-baseline``), 2 usage error. ``--write-baseline`` regenerates the baseline
+from the current finding set and always exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from torchmetrics_tpu._lint.baseline import (
+    DEFAULT_BASELINE_PATH,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from torchmetrics_tpu._lint.core import analyze_paths, render_json, render_sarif, render_text
+from torchmetrics_tpu._lint.rules import RULES
+
+
+def _default_paths() -> List[str]:
+    """Prefer a source checkout's ``torchmetrics_tpu/`` in cwd; else the installed package."""
+    if Path("torchmetrics_tpu").is_dir():
+        return ["torchmetrics_tpu"]
+    return [str(Path(__file__).resolve().parent.parent)]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu._lint",
+        description="jaxlint: AST-based JAX/TPU hazard analyzer (rules TPU001-TPU006)",
+    )
+    parser.add_argument("paths", nargs="*", help="files/directories to lint (default: the package)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text")
+    parser.add_argument(
+        "--baseline", default=str(DEFAULT_BASELINE_PATH),
+        help="baseline file of waived findings; pass 'none' to disable (default: the shipped baseline)",
+    )
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from the current finding set and exit 0")
+    parser.add_argument("--strict-baseline", action="store_true",
+                        help="also fail on stale baseline entries (the CI mode)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid]}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in select if r not in RULES]
+        if unknown:
+            print(f"jaxlint: unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"jaxlint: path(s) not found: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = analyze_paths(paths, select=select)
+
+    if args.write_baseline:
+        target = DEFAULT_BASELINE_PATH if args.baseline == "none" else Path(args.baseline)
+        payload = write_baseline(findings, target)
+        print(f"jaxlint: wrote {len(payload['entries'])} baseline entr"
+              f"{'y' if len(payload['entries']) == 1 else 'ies'} to {target}")
+        return 0
+
+    entries = [] if args.baseline == "none" else load_baseline(args.baseline)
+    new, waived, stale = apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(render_json(new, waived, stale))
+    elif args.format == "sarif":
+        print(render_sarif(new, RULES))
+    else:
+        print(render_text(new, waived, stale))
+
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""jaxlint baseline: waived legacy findings, checked in next to the analyzer.
+
+A baseline entry waives findings by ``(rule, path, fingerprint)`` — the fingerprint is the
+whitespace-normalised source line, NOT the line number, so edits elsewhere in a file never
+invalidate the baseline. ``count`` waives up to that many identical findings per key
+(several structurally-identical hazards can share one normalised line).
+
+Workflow::
+
+    python -m torchmetrics_tpu._lint torchmetrics_tpu           # gate: new findings fail
+    python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline   # re-waive current set
+
+Stale entries (baselined findings that no longer occur) are reported on every run and fail
+the gate under ``--strict-baseline`` (the ``make jaxlint`` mode), so the waived set can only
+shrink silently, never rot.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Sequence, Tuple
+
+from torchmetrics_tpu._lint.core import Finding
+
+#: The baseline shipped with the package (valid for source checkouts and installs alike).
+DEFAULT_BASELINE_PATH = Path(__file__).with_name("baseline.json")
+
+_Key = Tuple[str, str, str]
+
+
+def _keyed(findings: Sequence[Finding]) -> Dict[_Key, List[Finding]]:
+    keyed: Dict[_Key, List[Finding]] = {}
+    for f in findings:
+        keyed.setdefault(f.key, []).append(f)
+    return keyed
+
+
+def write_baseline(findings: Sequence[Finding], path: Any) -> Dict[str, Any]:
+    """Serialise the current finding set as the new baseline; returns the written payload."""
+    entries = []
+    for (rule, fpath, fingerprint), group in sorted(_keyed(findings).items()):
+        entries.append(
+            {
+                "rule": rule,
+                "path": fpath,
+                "fingerprint": fingerprint,
+                "count": len(group),
+                "lines": [f.line for f in group],  # informational only — never matched on
+            }
+        )
+    payload = {"version": 1, "tool": "jaxlint", "entries": entries}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+    return payload
+
+
+def load_baseline(path: Any) -> List[Dict[str, Any]]:
+    """Baseline entries from ``path``; empty list when the file does not exist."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    payload = json.loads(p.read_text(encoding="utf-8"))
+    if not isinstance(payload, dict) or payload.get("tool") != "jaxlint":
+        raise ValueError(f"{p}: not a jaxlint baseline file")
+    return list(payload.get("entries", []))
+
+
+def apply_baseline(
+    findings: Sequence[Finding], entries: Sequence[Dict[str, Any]]
+) -> Tuple[List[Finding], int, List[Dict[str, Any]]]:
+    """Split findings into (new, waived_count, stale_entries) against baseline entries.
+
+    Per key, ``min(current, baselined)`` findings are waived; current findings beyond the
+    baselined count are new; baseline capacity beyond the current count marks the entry stale
+    (its ``count`` is adjusted in the returned stale record for partial staleness).
+    """
+    remaining: Dict[_Key, int] = {}
+    for e in entries:
+        key = (e["rule"], e["path"], e["fingerprint"])
+        remaining[key] = remaining.get(key, 0) + int(e.get("count", 1))
+    new: List[Finding] = []
+    waived = 0
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+            waived += 1
+        else:
+            new.append(f)
+    stale = [
+        {"rule": k[0], "path": k[1], "fingerprint": k[2], "count": n}
+        for k, n in sorted(remaining.items())
+        if n > 0
+    ]
+    return new, waived, stale

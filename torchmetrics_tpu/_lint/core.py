@@ -1,0 +1,215 @@
+"""jaxlint analysis driver: file walking, suppression comments, output formats.
+
+The driver is deliberately stdlib-only (``ast`` + ``json``) so the analyzer imports in
+milliseconds, runs in any environment the package installs into (no jax initialisation —
+a lint pass must never touch an accelerator), and can execute inside CI sandboxes that
+have no device at all. Rule logic lives in :mod:`torchmetrics_tpu._lint.rules`; baseline
+bookkeeping in :mod:`torchmetrics_tpu._lint.baseline`.
+
+Suppression: a finding is waived when its source line carries a marker comment —
+
+    value = float(result)  # jaxlint: disable=TPU001
+    value = float(result)  # jaxlint: disable=TPU001,TPU003
+    value = float(result)  # jaxlint: disable
+
+A bare ``disable`` (no ``=``) waives every rule on that line. Suppressions are counted in
+the run summary so a sweep of blanket-disables stays visible.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable(?:=(?P<rules>[A-Z0-9, ]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer diagnostic, anchored to a source location.
+
+    ``fingerprint`` (the normalised source line) — not the line number — is the baseline
+    matching key, so unrelated edits that renumber a file do not invalidate the baseline.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    @property
+    def fingerprint(self) -> str:
+        return " ".join(self.snippet.split())
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.fingerprint)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _suppressed_rules(line: str) -> Optional[set]:
+    """Rule ids waived on ``line``; empty set means 'all rules'; None means no marker."""
+    m = _SUPPRESS_RE.search(line)
+    if m is None:
+        return None
+    rules = m.group("rules")
+    if rules is None:
+        return set()
+    return {r.strip() for r in rules.split(",") if r.strip()}
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every (selected) rule over one Python source string.
+
+    Returns findings sorted by location, with line-level suppression comments applied.
+
+        >>> fs = analyze_source("def f(preds):\\n    return preds.item()\\n", path="snippet.py")
+        >>> [f.rule for f in fs]
+        ['TPU001']
+        >>> analyze_source("def f(preds):\\n    return preds.item()  # jaxlint: disable=TPU001\\n")
+        []
+    """
+    from torchmetrics_tpu._lint.rules import run_rules
+
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as err:
+        line = err.lineno or 1
+        return [
+            Finding(
+                rule="TPU000",
+                path=path,
+                line=line,
+                col=(err.offset or 1) - 1,
+                message=f"file does not parse: {err.msg}",
+                snippet=(source.splitlines()[line - 1] if source.splitlines() else "").strip(),
+            )
+        ]
+    lines = source.splitlines()
+    findings = []
+    for f in run_rules(tree, lines, path):
+        if select and f.rule not in select:
+            continue
+        src_line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        waived = _suppressed_rules(src_line)
+        if waived is not None and (not waived or f.rule in waived):
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(roots: Sequence[Any]) -> Iterable[Tuple[Path, str]]:
+    """Yield ``(file_path, display_path)`` for every ``.py`` under the given roots.
+
+    Display paths are rooted at each root's basename (``torchmetrics_tpu/metric.py``)
+    so results are identical whether the tree is scanned from a source checkout or from
+    site-packages — which keeps one baseline valid for both.
+    """
+    for root in roots:
+        root = Path(root)
+        if root.is_file():
+            yield root, root.name
+            continue
+        for fp in sorted(root.rglob("*.py")):
+            yield fp, (Path(root.name) / fp.relative_to(root)).as_posix()
+
+
+def analyze_paths(roots: Sequence[Any], select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze every Python file under ``roots``; findings sorted by path/line."""
+    findings: List[Finding] = []
+    for fp, display in iter_python_files(roots):
+        try:
+            source = fp.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            continue
+        findings.extend(analyze_source(source, path=display, select=select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ------------------------------------------------------------------------ output formats
+def render_text(new: List[Finding], baselined: int, stale: List[Dict[str, Any]]) -> str:
+    lines = [f.render() for f in new]
+    per_rule: Dict[str, int] = {}
+    for f in new:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    rule_part = ", ".join(f"{k}={v}" for k, v in sorted(per_rule.items())) or "none"
+    lines.append(
+        f"jaxlint: {len(new)} new finding(s) [{rule_part}], {baselined} baselined,"
+        f" {len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    )
+    for entry in stale:
+        lines.append(
+            f"  stale baseline entry: {entry['rule']} {entry['path']} :: {entry['fingerprint']!r}"
+        )
+    return "\n".join(lines)
+
+
+def render_json(new: List[Finding], baselined: int, stale: List[Dict[str, Any]]) -> str:
+    return json.dumps(
+        {
+            "tool": "jaxlint",
+            "new": [f.to_dict() for f in new],
+            "new_count": len(new),
+            "baselined_count": baselined,
+            "stale_baseline_entries": stale,
+        },
+        indent=2,
+    )
+
+
+def render_sarif(new: List[Finding], rule_index: Dict[str, str]) -> str:
+    """Minimal SARIF 2.1.0 document (one run, one result per new finding)."""
+    rules = [
+        {"id": rid, "shortDescription": {"text": desc}}
+        for rid, desc in sorted(rule_index.items())
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line, "startColumn": f.col + 1},
+                    }
+                }
+            ],
+        }
+        for f in new
+    ]
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {"driver": {"name": "jaxlint", "rules": rules}},
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,830 @@
+"""jaxlint rule registry: the six TPU hazard rules over a shared per-module inference pass.
+
+All rules consume one :class:`_ModuleModel` built per file:
+
+- **jit-context detection** — which functions execute under ``jax.jit`` tracing. Roots are
+  (1) ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators, (2) functions referenced
+  inside a ``jax.jit`` / ``vmap`` / ``lax.scan`` / ``lax.cond`` /… wrapper call, and (3) this
+  repo's engine convention: ``_update`` / ``_compute`` / ``_metric_kernel`` / ``_flat_values``
+  methods are jitted by ``Metric`` unless the class sets ``jit_update``/``jit_compute`` to
+  False. Context propagates through the intra-module call graph (plain calls and
+  ``self.method`` calls) and into nested helper defs.
+- **traced-name dataflow** — per function, which local names hold (possibly) device/traced
+  array values: non-static parameters of jit functions, plus anything assigned from a
+  ``jnp.*`` / ``lax.*`` / ``jax.*`` device-producing call or from calling a locally
+  ``jax.jit``-wrapped callable. Parameters declared in ``static_argnames`` and parameters
+  with constant (str/bool/number) defaults are static; free (closure) variables are assumed
+  static — under-reporting beats drowning real findings in noise.
+
+The rules (documented with examples in ``docs/static-analysis.md``):
+
+========  ======================================================================
+TPU001    host-sync coercion: ``.item()`` / ``float()`` / ``int()`` / ``bool()``
+          on a device value — blocking D2H sync eagerly, trace error under jit
+TPU002    data-dependent Python ``if``/``while`` on a traced value inside jit
+TPU003    host ``numpy`` op applied to a traced value inside jit
+TPU004    jit wrap leaving str/bool config parameters non-static (retrace churn)
+TPU005    ``add_state`` reduction/dtype mismatch (overflow, non-additive sum)
+TPU006    fresh ``jnp`` constant built inside a per-step hot path (re-upload)
+========  ======================================================================
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu._lint.core import Finding
+
+#: rule id -> one-line description (surfaced by ``--list-rules`` and the SARIF export).
+RULES: Dict[str, str] = {
+    "TPU000": "file does not parse (analyzer cannot run)",
+    "TPU001": "host-sync coercion (.item()/float()/int()/bool()) on a device array value",
+    "TPU002": "data-dependent Python if/while on a traced array inside jit",
+    "TPU003": "host numpy op applied to a traced value inside jit",
+    "TPU004": "jit call-site leaves config parameters non-static (retrace churn)",
+    "TPU005": "add_state reduction/dtype mismatch (overflow or non-additive update)",
+    "TPU006": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
+}
+
+# wrapper callables whose function arguments execute under tracing
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "scan", "cond", "while_loop", "fori_loop", "switch", "associated_scan", "map",
+    "shard_map", "custom_jvp", "custom_vjp", "filter_jit",
+}
+# attribute accesses that yield static (trace-time) metadata, never a traced value
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+# jnp/lax attributes that return host/static values, not device arrays
+_HOST_FINAL = {"shape", "ndim", "size", "result_type", "dtype", "iinfo", "finfo", "issubdtype"}
+# jax.* attributes that return host values or callables (not device arrays)
+_JAX_HOST_FINAL = {
+    "device_get", "block_until_ready", "jit", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "process_count", "process_index", "device_count",
+    "local_device_count", "devices", "local_devices", "default_backend", "tree_map",
+    "tree_leaves", "tree_flatten", "tree_unflatten", "named_scope", "eval_shape",
+}
+# host-side predicates/introspection whose results are static w.r.t. tracing
+_STATIC_CALLS = {"len", "isinstance", "callable", "hasattr", "getattr", "type", "is_traced"}
+# engine-convention methods jitted by the Metric shell (see metric.py _jitted_update/_compute)
+_CONVENTION_JIT = {"_update": "jit_update", "_compute": "jit_compute",
+                   "_metric_kernel": None, "_flat_values": None}
+# eager per-step entry points for TPU006 (the engine calls these once per batch)
+_HOT_PREFIXES = ("update", "forward", "_forward", "_update_")
+_HOT_EXACT = {"update", "forward", "__call__"}
+# jnp constructors whose all-constant calls re-upload a host constant every execution
+_CONST_BUILDERS = {"array", "asarray", "zeros", "ones", "full", "arange", "eye", "linspace"}
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` → ["a", "b", "c"]; None for anything that is not a pure name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _final_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _scoped_walk(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root``'s subtree without descending into nested function/class scopes."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _const_value(node: ast.AST) -> Any:
+    """Python value of a (possibly negated) literal; ``_NOT_CONST`` sentinel otherwise."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_value(node.operand)
+        if isinstance(inner, (int, float)):
+            return -inner
+    return _NOT_CONST
+
+
+_NOT_CONST = object()
+
+
+class _FuncInfo:
+    __slots__ = ("node", "name", "parent", "cls", "jit", "static_params", "children")
+
+    def __init__(self, node, name, parent, cls):
+        self.node = node
+        self.name = name
+        self.parent: Optional["_FuncInfo"] = parent
+        self.cls: Optional[str] = cls
+        self.jit = False
+        self.static_params: Set[str] = set()
+        self.children: List["_FuncInfo"] = []
+
+
+class _ModuleModel:
+    """Per-file inference shared by every rule: functions, classes, jit context, call graph."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.tree = tree
+        self.functions: List[_FuncInfo] = []
+        self.by_name: Dict[str, List[_FuncInfo]] = {}
+        self.class_nodes: Dict[str, ast.ClassDef] = {}
+        self.class_flags_off: Dict[str, Set[str]] = {}  # class -> {"jit_update", ...} set False
+        self._collect(tree, parent=None, cls=None)
+        self._detect_class_flags()
+        self._mark_jit_roots()
+        self._propagate_jit()
+
+    # ---------------------------------------------------------------- model construction
+    def _collect(self, node: ast.AST, parent: Optional[_FuncInfo], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FuncInfo(child, child.name, parent, cls)
+                self.functions.append(info)
+                self.by_name.setdefault(child.name, []).append(info)
+                if parent is not None:
+                    parent.children.append(info)
+                self._collect(child, parent=info, cls=cls)
+            elif isinstance(child, ast.ClassDef):
+                self.class_nodes[child.name] = child
+                self._collect(child, parent=None, cls=child.name)
+            else:
+                self._collect(child, parent=parent, cls=cls)
+
+    def _detect_class_flags(self) -> None:
+        """Find ``jit_update = False`` / ``self.jit_compute = False`` per class.
+
+        Flags inherit through base classes defined in the same module (cross-module bases
+        are invisible to a per-file pass — classes relying on an imported base's flag can
+        restate it as a class attribute to make the intent statically checkable).
+        """
+        for cname, cnode in self.class_nodes.items():
+            off: Set[str] = set()
+            for node in ast.walk(cnode):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if not (isinstance(value, ast.Constant) and value.value is False):
+                    continue
+                for t in targets:
+                    name = None
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) and t.value.id == "self":
+                        name = t.attr
+                    if name in ("jit_update", "jit_compute"):
+                        off.add(name)
+            self.class_flags_off[cname] = off
+        # one inheritance sweep per depth level (module class chains are shallow)
+        for _ in range(len(self.class_nodes)):
+            changed = False
+            for cname, cnode in self.class_nodes.items():
+                for base in cnode.bases:
+                    bname = _final_name(base)
+                    if bname in self.class_flags_off:
+                        merged = self.class_flags_off[cname] | self.class_flags_off[bname]
+                        if merged != self.class_flags_off[cname]:
+                            self.class_flags_off[cname] = merged
+                            changed = True
+            if not changed:
+                break
+
+    def _resolve_refs(self, call: ast.Call) -> List[_FuncInfo]:
+        """Local function defs referenced (by name or ``self.attr``) inside a wrapper call."""
+        refs: List[_FuncInfo] = []
+        for sub in ast.walk(call):
+            if isinstance(sub, ast.Name) and sub.id in self.by_name:
+                refs.extend(self.by_name[sub.id])
+            elif (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in self.by_name
+            ):
+                refs.extend(fi for fi in self.by_name[sub.attr] if fi.cls is not None)
+        return refs
+
+    @staticmethod
+    def _statics_from_keywords(keywords: Sequence[ast.keyword]) -> Set[str]:
+        names: Set[str] = set()
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                    for el in v.elts:
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            names.add(el.value)
+        return names
+
+    @staticmethod
+    def _static_nums_from_keywords(keywords: Sequence[ast.keyword]) -> Set[int]:
+        nums: Set[int] = set()
+        for kw in keywords:
+            if kw.arg == "static_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    for el in v.elts:
+                        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                            nums.add(el.value)
+        return nums
+
+    def _jit_wrap_of_decorator(self, dec: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+        """(static_argnames, static_argnums) when ``dec`` is a jit-ish decorator, else None."""
+        if _final_name(dec) in ("jit", "pjit", "filter_jit"):
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            fn = _final_name(dec.func)
+            if fn in ("jit", "pjit", "filter_jit"):
+                return self._statics_from_keywords(dec.keywords), self._static_nums_from_keywords(dec.keywords)
+            if fn == "partial" and dec.args and _final_name(dec.args[0]) in ("jit", "pjit"):
+                return self._statics_from_keywords(dec.keywords), self._static_nums_from_keywords(dec.keywords)
+        return None
+
+    def _mark_jit_roots(self) -> None:
+        # (1) decorator roots
+        for info in self.functions:
+            for dec in info.node.decorator_list:
+                wrap = self._jit_wrap_of_decorator(dec)
+                if wrap is not None:
+                    info.jit = True
+                    info.static_params |= wrap[0]
+                    info.static_params |= self._argnums_to_names(info.node, wrap[1])
+        # (2) wrapper-call roots: jax.jit(f, ...), jax.vmap(f), lax.scan(body, ...), ...
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _final_name(node.func)
+            if fn not in _TRACE_WRAPPERS:
+                continue
+            statics = self._statics_from_keywords(node.keywords) if fn in ("jit", "pjit") else set()
+            for ref in self._resolve_refs(node):
+                ref.jit = True
+                ref.static_params |= statics
+        # (3) engine-convention roots (Metric shell jits these)
+        for info in self.functions:
+            if info.cls is None or info.name not in _CONVENTION_JIT:
+                continue
+            flag = _CONVENTION_JIT[info.name]
+            if flag is not None and flag in self.class_flags_off.get(info.cls, set()):
+                continue
+            info.jit = True
+
+    @staticmethod
+    def _argnums_to_names(node: ast.AST, nums: Set[int]) -> Set[str]:
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        return {params[i] for i in nums if 0 <= i < len(params)}
+
+    def _propagate_jit(self) -> None:
+        """Flow jit context through plain / ``self.method`` calls and into nested defs."""
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions:
+                if not info.jit:
+                    continue
+                for child in info.children:
+                    if not child.jit:
+                        child.jit = True
+                        changed = True
+                for node in _scoped_walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callees: List[_FuncInfo] = []
+                    if isinstance(node.func, ast.Name) and node.func.id in self.by_name:
+                        callees = [fi for fi in self.by_name[node.func.id] if fi.cls is None or fi.cls == info.cls]
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in self.by_name
+                    ):
+                        callees = [fi for fi in self.by_name[node.func.attr] if fi.cls == info.cls]
+                    for callee in callees:
+                        if not callee.jit:
+                            callee.jit = True
+                            changed = True
+
+    # ------------------------------------------------------------------- per-function facts
+    def traced_names(self, info: _FuncInfo) -> Tuple[Set[str], Set[str]]:
+        """(traced value names, locally-jitted callable names) for one function body.
+
+        Traced seeds: in jit context, every parameter that is not ``self``/``cls``, not in
+        ``static_argnames``, and has no constant (str/bool/number) default. In eager context
+        parameters are NOT assumed traced — only dataflow from device-producing calls is.
+        """
+        traced: Set[str] = set()
+        jit_callables: Set[str] = set()
+        args = info.node.args
+        if info.jit:
+            params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+            if args.vararg:
+                params.append(args.vararg.arg)
+            if args.kwarg:
+                params.append(args.kwarg.arg)
+            defaulted: Set[str] = set()
+            pos = args.posonlyargs + args.args
+            for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+                if _const_value(d) is not _NOT_CONST:
+                    defaulted.add(a.arg)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None and _const_value(d) is not _NOT_CONST:
+                    defaulted.add(a.arg)
+            traced = {
+                p for p in params
+                if p not in ("self", "cls") and p not in info.static_params and p not in defaulted
+            }
+        # dataflow fixpoint over assignments (source order is irrelevant to the fixpoint)
+        assigns: List[Tuple[List[ast.AST], ast.AST]] = []
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Assign):
+                assigns.append((list(node.targets), node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                assigns.append(([node.target], node.value))
+            elif isinstance(node, ast.AugAssign):
+                assigns.append(([node.target], node.value))
+            elif isinstance(node, ast.For):
+                assigns.append(([node.target], node.iter))
+        for _ in range(4):  # small fixpoint: chains deeper than 4 hops are vanishingly rare
+            changed = False
+            for targets, value in assigns:
+                if isinstance(value, ast.Call) and _final_name(value.func) in ("jit", "pjit"):
+                    for name in self._target_names(targets):
+                        if name not in jit_callables:
+                            jit_callables.add(name)
+                            changed = True
+                    continue
+                if _is_device_expr(value, traced, jit_callables):
+                    for name in self._target_names(targets):
+                        if name not in traced:
+                            traced.add(name)
+                            changed = True
+            if not changed:
+                break
+        return traced, jit_callables
+
+    @staticmethod
+    def _target_names(targets: Sequence[ast.AST]) -> Iterator[str]:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    if isinstance(el, ast.Name):
+                        yield el.id
+                    elif isinstance(el, ast.Starred) and isinstance(el.value, ast.Name):
+                        yield el.value.id
+
+
+def _is_device_expr(node: ast.AST, traced: Set[str], jit_callables: Set[str]) -> bool:
+    """Could this expression evaluate to a device array / tracer?"""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _is_device_expr(node.value, traced, jit_callables)
+    if isinstance(node, ast.Subscript):
+        return _is_device_expr(node.value, traced, jit_callables)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        dotted = _dotted(fn)
+        if dotted is not None:
+            root, final = dotted[0], dotted[-1]
+            if root in ("jnp", "lax") and final not in _HOST_FINAL:
+                return True
+            if root == "jax" and len(dotted) > 1 and dotted[1] == "numpy" and final not in _HOST_FINAL:
+                return True
+            if root == "jax" and final not in _JAX_HOST_FINAL and final not in _HOST_FINAL:
+                return True
+            if root in ("np", "numpy", "math"):
+                return False
+            if final in _STATIC_CALLS:
+                return False
+        if isinstance(fn, ast.Name) and fn.id in jit_callables:
+            return True
+        if isinstance(fn, ast.Attribute):
+            # method call on a traced value (x.astype(...), x.at[...].set(...), x.sum())
+            return _is_device_expr(fn.value, traced, jit_callables)
+        return False
+    if isinstance(node, (ast.BinOp,)):
+        return any(_is_device_expr(c, traced, jit_callables) for c in (node.left, node.right))
+    if isinstance(node, ast.UnaryOp):
+        return _is_device_expr(node.operand, traced, jit_callables)
+    if isinstance(node, ast.Compare):
+        return any(_is_device_expr(c, traced, jit_callables) for c in [node.left, *node.comparators])
+    if isinstance(node, ast.IfExp):
+        return any(_is_device_expr(c, traced, jit_callables) for c in (node.body, node.orelse))
+    return False
+
+
+def _branches_on_traced(node: ast.AST, traced: Set[str], jit_callables: Set[str]) -> bool:
+    """Does this if/while test make a data-dependent decision on a traced value?
+
+    Trace-safe constructs are excluded: ``is``/``in`` comparisons (identity and dict-key
+    membership are host decisions), comparisons against string literals (config dispatch),
+    shape/dtype attribute reads, and host predicates (``len``/``isinstance``/…).
+    """
+    if isinstance(node, ast.BoolOp):
+        return any(_branches_on_traced(v, traced, jit_callables) for v in node.values)
+    if isinstance(node, ast.UnaryOp):
+        return _branches_on_traced(node.operand, traced, jit_callables)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+            return False
+        operands = [node.left, *node.comparators]
+        if any(isinstance(c, ast.Constant) and isinstance(c.value, str) for c in operands):
+            return False
+        return any(_branches_on_traced(c, traced, jit_callables) for c in operands)
+    if isinstance(node, ast.Call):
+        fn = _final_name(node.func)
+        if fn in _STATIC_CALLS or fn in _HOST_FINAL:
+            return False
+        if _is_device_expr(node, traced, jit_callables):  # covers x.sum(), jnp.any(x), ...
+            return True
+        return any(
+            _branches_on_traced(a, traced, jit_callables)
+            for a in [*node.args, *(kw.value for kw in node.keywords)]
+        )
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript, ast.BinOp, ast.IfExp)):
+        return _is_device_expr(node, traced, jit_callables)
+    return False
+
+
+def _finding(rule: str, path: str, node: ast.AST, lines: Sequence[str], message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    snippet = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+    return Finding(rule=rule, path=path, line=line, col=getattr(node, "col_offset", 0),
+                   message=message, snippet=snippet)
+
+
+# ================================================================================= rules
+def _rule_tpu001(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for info in model.functions:
+        traced, jit_callables = model.traced_names(info)
+        where = "inside jit-traced code (fails or constant-folds at trace time)" if info.jit \
+            else "in eager per-call code (blocking device→host round-trip)"
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item()
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+                base = node.func.value
+                dotted = _dotted(base)
+                host_rooted = dotted is not None and dotted[0] in ("np", "numpy")
+                if not host_rooted:
+                    out.append(_finding(
+                        "TPU001", path, node, lines,
+                        f".item() on an array value {where}; read once via jax.device_get(...)"
+                        " and keep per-step code device-only",
+                    ))
+                continue
+            # float(x) / int(x) / bool(x) / complex(x)
+            if isinstance(node.func, ast.Name) and node.func.id in ("float", "int", "bool", "complex") \
+                    and len(node.args) == 1 and not node.keywords:
+                arg = node.args[0]
+                if _is_device_expr(arg, traced, jit_callables):
+                    out.append(_finding(
+                        "TPU001", path, node, lines,
+                        f"{node.func.id}() coerces a device array value to a host scalar {where};"
+                        " use jax.device_get(...) for a deliberate, counted sync",
+                    ))
+    return out
+
+
+def _rule_tpu002(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for info in model.functions:
+        if not info.jit:
+            continue
+        traced, jit_callables = model.traced_names(info)
+        if not traced:
+            continue
+        for node in _scoped_walk(info.node):
+            if isinstance(node, (ast.If, ast.While)) and _branches_on_traced(node.test, traced, jit_callables):
+                kw = "while" if isinstance(node, ast.While) else "if"
+                out.append(_finding(
+                    "TPU002", path, node, lines,
+                    f"data-dependent Python `{kw}` on a traced value inside jit-traced"
+                    f" {info.name!r}; use jnp.where/lax.cond (or declare the driving argument"
+                    " in static_argnames)",
+                ))
+    return out
+
+
+def _rule_tpu003(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for info in model.functions:
+        if not info.jit:
+            continue
+        traced, jit_callables = model.traced_names(info)
+        if not traced:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or dotted[0] not in ("np", "numpy") or len(dotted) < 2:
+                continue
+            arg_nodes = [*node.args, *(kw.value for kw in node.keywords)]
+            if any(_is_device_expr(a, traced, jit_callables) for a in arg_nodes):
+                out.append(_finding(
+                    "TPU003", path, node, lines,
+                    f"host numpy op {'.'.join(dotted)}(...) applied to a traced value inside"
+                    f" jit-traced {info.name!r}; use the jnp equivalent or hoist the op out of"
+                    " the traced region",
+                ))
+    return out
+
+
+def _rule_tpu004(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+
+    def config_params(fnode: ast.AST) -> List[str]:
+        """Parameters whose default/annotation says 'host config': str or bool."""
+        args = fnode.args
+        named: List[str] = []
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            v = _const_value(d)
+            if isinstance(v, (str, bool)):
+                named.append(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and isinstance(_const_value(d), (str, bool)):
+                named.append(a.arg)
+        for a in pos + args.kwonlyargs:
+            if a.arg not in named and a.annotation is not None \
+                    and _final_name(a.annotation) in ("str", "bool"):
+                named.append(a.arg)
+        return named
+
+    def check(site: ast.AST, target: _FuncInfo, statics: Set[str], argnums: Set[int]) -> None:
+        statics = statics | model._argnums_to_names(target.node, argnums)
+        missing = [p for p in config_params(target.node) if p not in statics]
+        if missing:
+            out.append(_finding(
+                "TPU004", path, site, lines,
+                f"jax.jit of {target.name!r} leaves config parameter(s)"
+                f" {', '.join(repr(m) for m in missing)} non-static — every distinct value"
+                " retraces the kernel (recompile churn; the runtime twin is obs' TPU004"
+                " recompile-churn warning). Declare them in static_argnames",
+            ))
+
+    # decorator form
+    for info in model.functions:
+        for dec in info.node.decorator_list:
+            wrap = model._jit_wrap_of_decorator(dec)
+            if wrap is not None:
+                check(dec, info, wrap[0], wrap[1])
+    # call form: jax.jit(fn_name, ...)
+    for node in ast.walk(model.tree):
+        if not (isinstance(node, ast.Call) and _final_name(node.func) in ("jit", "pjit")):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        candidates = model.by_name.get(node.args[0].id, [])
+        if len(candidates) != 1:  # ambiguous resolution — do not guess
+            continue
+        check(
+            node, candidates[0],
+            model._statics_from_keywords(node.keywords),
+            model._static_nums_from_keywords(node.keywords),
+        )
+    return out
+
+
+def _default_spec(node: ast.AST) -> Dict[str, Any]:
+    """dtype/value facts about an ``add_state`` default expression (best-effort)."""
+    spec: Dict[str, Any] = {"dtype": None, "value": _NOT_CONST, "is_list": False}
+    v = _const_value(node)
+    if v is not _NOT_CONST:
+        spec["value"] = v
+        spec["dtype"] = "int" if isinstance(v, int) and not isinstance(v, bool) else "float"
+        return spec
+    if isinstance(node, (ast.List, ast.Tuple)):
+        spec["is_list"] = True
+        return spec
+    if not isinstance(node, ast.Call):
+        return spec
+    final = _final_name(node.func)
+    dtype_node = None
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            dtype_node = kw.value
+    if final in ("zeros", "ones") :
+        spec["value"] = 0.0 if final == "zeros" else 1.0
+        if dtype_node is None and len(node.args) > 1:
+            dtype_node = node.args[1]
+    elif final == "full":
+        if len(node.args) > 1:
+            spec["value"] = _const_value(node.args[1])
+        if dtype_node is None and len(node.args) > 2:
+            dtype_node = node.args[2]
+    elif final in ("array", "asarray"):
+        if node.args:
+            spec["value"] = _const_value(node.args[0])
+            if dtype_node is None:
+                inner = spec["value"]
+                if isinstance(inner, int) and not isinstance(inner, bool):
+                    spec["dtype"] = "int"  # weak-typed: lands as int32 on device
+        if dtype_node is None and len(node.args) > 1:
+            dtype_node = node.args[1]
+    if dtype_node is not None:
+        dname = _final_name(dtype_node) or (
+            dtype_node.value if isinstance(dtype_node, ast.Constant) else None
+        )
+        if isinstance(dname, str):
+            spec["dtype"] = dname
+    return spec
+
+
+def _rule_tpu005(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    sum_states_by_class: Dict[str, Set[str]] = {}
+    fx_by_class_state: Dict[Tuple[str, str], Set[Any]] = {}
+    calls: List[Tuple[ast.Call, str, Any, Dict[str, Any], Optional[str]]] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func) != ["self", "add_state"]:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue
+        state_name = node.args[0].value
+        fx_node = node.args[2] if len(node.args) > 2 else None
+        for kw in node.keywords:
+            if kw.arg == "dist_reduce_fx":
+                fx_node = kw.value
+        if fx_node is None or not isinstance(fx_node, ast.Constant):
+            continue
+        fx = fx_node.value
+        if len(node.args) < 2:
+            continue
+        spec = _default_spec(node.args[1])
+        owner = _owning_class(model, node)
+        if owner is not None:
+            fx_by_class_state.setdefault((owner, state_name), set()).add(
+                ("list", fx) if spec["is_list"] else ("tensor", fx)
+            )
+        calls.append((node, state_name, fx, spec, owner))
+    for node, state_name, fx, spec, owner in calls:
+        if spec["is_list"]:
+            continue
+        # a state registered under several reduce-fx/shape variants (config-dependent
+        # __init__ branches) has no single contract to check against — skip it
+        if owner is not None and len(fx_by_class_state.get((owner, state_name), set())) > 1:
+            continue
+        dtype, value = spec["dtype"], spec["value"]
+        if fx == "sum":
+            if owner is not None:
+                sum_states_by_class.setdefault(owner, set()).add(state_name)
+            if isinstance(dtype, str) and "int" in dtype and "64" not in dtype and "uint64" not in dtype:
+                width = dtype if dtype != "int" else "int32 (weak-typed int default)"
+                out.append(_finding(
+                    "TPU005", path, node, lines,
+                    f"state {state_name!r} is a {width} accumulator under dist_reduce_fx='sum' —"
+                    " overflows silently at ~2.1e9 accumulated count; use a float or int64 default",
+                ))
+            if isinstance(value, (int, float)) and value != 0:
+                out.append(_finding(
+                    "TPU005", path, node, lines,
+                    f"state {state_name!r} has non-zero default {value!r} under"
+                    " dist_reduce_fx='sum' — replica sum adds the default once per device;"
+                    " sum-reduced states need zero defaults",
+                ))
+        elif fx in ("min", "max") and isinstance(value, (int, float)) and value == 0:
+            bound = "floor" if fx == "max" else "ceiling"
+            out.append(_finding(
+                "TPU005", path, node, lines,
+                f"state {state_name!r} has zero default under dist_reduce_fx={fx!r} — zero acts"
+                f" as a hidden {bound} for {'negative' if fx == 'max' else 'positive'} values;"
+                " initialise with -inf/+inf (or the identity of the reduction)",
+            ))
+    # sum-reduced states assigned non-additively inside _update
+    for info in model.functions:
+        if info.name != "_update" or info.cls not in sum_states_by_class:
+            continue
+        state_param = _state_param_name(info.node)
+        if state_param is None:
+            continue
+        # names that (transitively) carry a read of the previous state: direct uses of the
+        # state param plus locals assigned from expressions that reference one
+        state_reading: Set[str] = {state_param}
+        assigns: List[Tuple[List[ast.AST], ast.AST]] = []
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Assign):
+                assigns.append((list(node.targets), node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                assigns.append(([node.target], node.value))
+            elif isinstance(node, ast.AugAssign):
+                assigns.append(([node.target], node.value))
+        for _ in range(4):
+            changed = False
+            for targets, value in assigns:
+                if any(isinstance(s, ast.Name) and s.id in state_reading for s in ast.walk(value)):
+                    for name in model._target_names(targets):
+                        if name not in state_reading:
+                            state_reading.add(name)
+                            changed = True
+            if not changed:
+                break
+        for node in _scoped_walk(info.node):
+            if not (isinstance(node, ast.Return) and isinstance(node.value, ast.Dict)):
+                continue
+            for key, val in zip(node.value.keys, node.value.values):
+                if not (isinstance(key, ast.Constant) and key.value in sum_states_by_class[info.cls]):
+                    continue
+                reads_state = any(
+                    isinstance(sub, ast.Name) and sub.id in state_reading for sub in ast.walk(val)
+                )
+                if not reads_state:
+                    out.append(_finding(
+                        "TPU005", path, val, lines,
+                        f"sum-reduced state {key.value!r} is returned without reading the"
+                        f" previous state ({state_param!r}) — assignment replaces instead of"
+                        " accumulating, which breaks multi-batch and cross-replica sums",
+                    ))
+    return out
+
+
+def _owning_class(model: _ModuleModel, node: ast.AST) -> Optional[str]:
+    for cname, cnode in model.class_nodes.items():
+        for sub in ast.walk(cnode):
+            if sub is node:
+                return cname
+    return None
+
+
+def _state_param_name(fnode: ast.AST) -> Optional[str]:
+    params = [a.arg for a in fnode.args.posonlyargs + fnode.args.args if a.arg not in ("self", "cls")]
+    return params[0] if params else None
+
+
+def _is_const_arg(node: ast.AST) -> bool:
+    if _const_value(node) is not _NOT_CONST:
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_const_arg(el) for el in node.elts)
+    dotted = _dotted(node)
+    if dotted is not None and dotted[0] in ("jnp", "np", "numpy", "jax"):
+        return True  # dtype references like jnp.float32
+    return False
+
+
+def _rule_tpu006(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for info in model.functions:
+        if info.jit:
+            continue  # inside jit, constants are baked into the compiled program — free
+        hot = info.name in _HOT_EXACT or info.name.startswith(_HOT_PREFIXES)
+        if not hot:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or dotted[0] != "jnp" or dotted[-1] not in _CONST_BUILDERS:
+                continue
+            arg_nodes = [*node.args, *(kw.value for kw in node.keywords)]
+            if arg_nodes and all(_is_const_arg(a) for a in arg_nodes):
+                out.append(_finding(
+                    "TPU006", path, node, lines,
+                    f"fresh device constant {'.'.join(dotted)}(...) built inside per-step hot"
+                    f" path {info.name!r} — one host→device upload per call; hoist it to a"
+                    " module/instance-level constant built once",
+                ))
+    return out
+
+
+_RULE_FUNCS = (
+    _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
+)
+
+
+def run_rules(tree: ast.Module, lines: Sequence[str], path: str) -> List[Finding]:
+    """Run every registered rule over one parsed module."""
+    model = _ModuleModel(tree)
+    findings: List[Finding] = []
+    for rule in _RULE_FUNCS:
+        findings.extend(rule(model, lines, path))
+    return findings

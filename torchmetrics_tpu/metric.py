@@ -57,6 +57,15 @@ def jit_distributed_available() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=None)
+def _empty_batch_entry() -> Array:
+    """Shared zero-length placeholder for list states absent from a batch contribution.
+
+    Built once per process: constructing it inline in the per-step forward path re-uploads
+    the same constant to the device every call (jaxlint TPU006)."""
+    return jnp.zeros((0,))
+
+
 class StateStore:
     """Host-level container for a metric's state, mutated in place.
 
@@ -561,7 +570,7 @@ class Metric:
                 e = batch_out[n]
                 batch_state[n] = dim_zero_cat([*e] if isinstance(e, (list, tuple)) else [e])
             else:
-                batch_state[n] = jnp.zeros((0,))
+                batch_state[n] = _empty_batch_entry()
         batch_val = self._squeeze_if_scalar(self._jitted_compute()(batch_state))
         # merge into global
         self._reduce_states(dict(self._state.tensors), batch_out)

@@ -123,4 +123,17 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["sync_latency_us_p50"] = round(s["p50"], 1)
         out["sync_latency_us_p99"] = round(s["p99"], 1)
         out["sync_latency_samples"] = s["count"]
+    # static-analysis status (jaxlint, the compile-time twin of these runtime counters):
+    # non-baselined finding count over the installed package, so every BENCH JSON records
+    # whether the benched tree was hazard-clean. Cached after the first call; None if the
+    # analyzer itself failed (a lint crash must never take the bench down with it).
+    try:
+        from torchmetrics_tpu._lint import package_lint_status
+
+        status = package_lint_status()
+        out["lint_findings"] = status["new"]
+        out["lint_baselined"] = status["baselined"]
+        out["lint_stale_baseline"] = status["stale"]
+    except Exception:  # pragma: no cover - defensive: bench extras are best-effort
+        out["lint_findings"] = None
     return out

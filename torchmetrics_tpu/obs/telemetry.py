@@ -470,7 +470,9 @@ def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict) -> None:
         rank_zero_warn(
             f"Metric {cls} retraced its jitted {kind!r} kernel {retraces} times (threshold"
             f" {_retrace_warn_threshold}) — recompile churn, usually shape/dtype-polymorphic"
-            " inputs. Pad batches to a fixed shape, or raise the threshold via"
+            " inputs or non-static config arguments (the static twin of this warning is"
+            " jaxlint rule TPU004; see docs/static-analysis.md). Pad batches to a fixed"
+            " shape, declare config arguments in static_argnames, or raise the threshold via"
             f" obs.set_retrace_warn_threshold / ${ENV_RETRACE_THRESHOLD}. Latest cache key: {sig}",
             UserWarning,
         )

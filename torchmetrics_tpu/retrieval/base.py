@@ -236,9 +236,10 @@ class RetrievalMetric(Metric):
             fn = jax.jit(run, static_argnames=("q_pad", "l_max", "q"))
             self._jit_cache[cache_key] = fn
         result, any_empty = fn(indexes, preds, target, valid, q_pad=q_pad, l_max=l_max, q=q)
-        if self.empty_target_action == "error":
-            if bool(any_empty):
-                raise ValueError(no_target_msg)
+        if self.empty_target_action == "error" and bool(jax.device_get(any_empty)):
+            # explicit one-shot D2H read (TPU001): only the "error" action needs this flag on
+            # host; the other actions impute inside the fused kernel and never block here
+            raise ValueError(no_target_msg)
         return result
 
     # ------------------------------------------------------------ flat (segment-reduce) path
@@ -314,7 +315,8 @@ class RetrievalMetric(Metric):
             result, any_empty = fn(indexes, preds, target, valid, *extra)
         else:
             result, any_empty = fn(indexes, preds, target, valid)
-        if self.empty_target_action == "error" and bool(any_empty):
+        if self.empty_target_action == "error" and bool(jax.device_get(any_empty)):
+            # explicit one-shot D2H read (TPU001), paid only under the "error" action
             raise ValueError(no_target_msg)
         return result
 

@@ -293,6 +293,11 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         [1, 2, 3]
     """
 
+    # restates the flag RetrievalMetric.__init__ sets on every instance: the curve compute is
+    # eager (host max_k sizes the result), and the class attribute makes that visible to
+    # static analysis (jaxlint's per-file pass cannot see the cross-module instance assignment)
+    jit_compute = False
+
     def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False,
                  empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  aggregation="mean", **kwargs: Any) -> None:
@@ -368,7 +373,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
                            for k in range(requested_k)])
             r = jnp.stack([jnp.asarray(self.aggregation(jnp.asarray(rv_np[:, k])))
                            for k in range(requested_k)])
-        if self.empty_target_action == "error" and bool(any_empty):
+        if self.empty_target_action == "error" and bool(jax.device_get(any_empty)):
+            # explicit one-shot D2H read (TPU001): only the "error" action needs this flag on host
             raise ValueError("`compute` method was provided with a query with no positive target.")
         return p[:requested_k], r[:requested_k]
 

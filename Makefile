@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench telemetry-smoke jaxlint clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint clean
 
-test: jaxlint test-unit test-integration
+test: jaxlint test-unit test-integration bench-smoke
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -14,6 +14,12 @@ doctest:
 
 bench:
 	python bench.py
+
+# tiny-N bench lane: same code paths and JSON schema as the real bench, seconds of wall
+# time; fails the build if bench.py exits nonzero or stops emitting parseable JSON
+bench-smoke:
+	python bench.py --smoke > /tmp/tm_bench_smoke.json
+	python -c "import json; d=[l for l in open('/tmp/tm_bench_smoke.json').read().strip().splitlines() if l][-1]; p=json.loads(d); assert 'metric' in p and 'extras' in p, p; print('bench-smoke ok:', p['metric'])"
 
 # static JAX/TPU hazard analysis (rules TPU001-TPU006, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline

@@ -18,6 +18,18 @@ BATCH = 10_000
 NUM_CLASSES = 5
 N_BATCHES = TOTAL_SAMPLES // BATCH
 
+#: --smoke: tiny-N CI mode (make bench-smoke) — same code paths and JSON schema, seconds of
+#: wall time, no reference/mesh subprocesses. Guards against bench.py rotting between rounds.
+SMOKE = False
+
+
+def _apply_smoke_sizes() -> None:
+    global TOTAL_SAMPLES, BATCH, N_BATCHES, SMOKE
+    SMOKE = True
+    TOTAL_SAMPLES = 20_000
+    BATCH = 1_000
+    N_BATCHES = TOTAL_SAMPLES // BATCH
+
 
 def _gen_data():
     rng = np.random.RandomState(7)
@@ -107,12 +119,7 @@ def bench_ours(preds: np.ndarray, target: np.ndarray) -> dict:
     }
 
 
-def bench_ours_per_step(preds: np.ndarray, target: np.ndarray, n_meas: int = 100) -> float:
-    """updates/sec through per-batch ``forward`` — the SAME protocol the reference loop uses
-    (one dispatch per batch, batch value returned), so `vs_baseline` compares like with like."""
-    import jax
-    import jax.numpy as jnp
-
+def _make_collection():
     from torchmetrics_tpu import MetricCollection
     from torchmetrics_tpu.classification import (
         MulticlassAccuracy,
@@ -121,7 +128,7 @@ def bench_ours_per_step(preds: np.ndarray, target: np.ndarray, n_meas: int = 100
         MulticlassRecall,
     )
 
-    mc = MetricCollection(
+    return MetricCollection(
         [
             MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
             MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
@@ -129,25 +136,98 @@ def bench_ours_per_step(preds: np.ndarray, target: np.ndarray, n_meas: int = 100
             MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
         ]
     )
-    dev_preds = jnp.asarray(preds)
-    dev_target = jnp.asarray(target)
-    jax.block_until_ready((dev_preds, dev_target))
+
+
+def _presplit_batches(preds: np.ndarray, target: np.ndarray):
+    """Per-batch device arrays, materialised OUTSIDE the timed window.
+
+    Protocol parity with the reference bench, which iterates a pre-built list of per-batch
+    torch tensors: slicing ``stack[i]`` inside the loop is an extra eager device op per
+    step (two per batch — it was ~2/3 of the measured per-step cost on CPU) that a real
+    training loop, receiving each batch as its own array, never pays.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    stack_p, stack_t = jnp.asarray(preds), jnp.asarray(target)
+    plist = [stack_p[i] for i in range(N_BATCHES)]
+    tlist = [stack_t[i] for i in range(N_BATCHES)]
+    jax.block_until_ready((plist, tlist))
+    return plist, tlist
+
+
+def bench_ours_per_step(preds: np.ndarray, target: np.ndarray, n_meas: int = 100) -> dict:
+    """updates/sec through per-batch ``forward`` — the SAME protocol the reference loop uses
+    (one dispatch per batch, batch value returned, per-batch arrays pre-built like the
+    reference's tensor list), so `vs_baseline` compares like with like.
+
+    Also reports ``per_step_host_overhead_us``: the ``dispatch.host_overhead`` timer mean
+    from a short telemetry-enabled window — the wall time a fast-dispatch step spends
+    OUTSIDE the compiled executable (the quantity the AOT tier exists to minimise).
+    """
+    import jax
+
+    from torchmetrics_tpu import obs
+
+    mc = _make_collection()
+    plist, tlist = _presplit_batches(preds, target)
     for i in range(2):  # group formation + compile
-        mc(dev_preds[i], dev_target[i])
+        mc(plist[i], tlist[i])
     mc.reset()
 
     n_meas = min(n_meas, N_BATCHES)
 
     def _window():
         mc.reset()
-        out = [mc(dev_preds[i % N_BATCHES], dev_target[i % N_BATCHES]) for i in range(n_meas)]
+        out = [mc(plist[i % N_BATCHES], tlist[i % N_BATCHES]) for i in range(n_meas)]
         jax.block_until_ready(list(out[-1].values()))
 
     # the tunnel occasionally stalls a whole window (~100ms hiccups); more windows give the
     # best-of a real chance to see an unstalled pass
     best = _best_of(_window, windows=6)
     print(f"ours (per-step forward): {n_meas} updates in {best:.4f}s", file=sys.stderr)
-    return n_meas / best
+
+    host_overhead_us = None
+    with obs.enabled():
+        mc.reset()
+        out = [mc(plist[i % N_BATCHES], tlist[i % N_BATCHES]) for i in range(min(50, n_meas))]
+        jax.block_until_ready(list(out[-1].values()))
+        timer = obs.telemetry._timers.get("dispatch.host_overhead")
+        if timer is not None and timer.count:
+            host_overhead_us = round(timer.mean_s * 1e6, 2)
+    return {"rate": n_meas / best, "host_overhead_us": host_overhead_us}
+
+
+def bench_buffered_updates(preds: np.ndarray, target: np.ndarray, k: int = 16) -> float:
+    """updates/sec through ``MetricCollection.buffered(k)`` — the deferred micro-batch
+    accumulator: k host-side appends, then ONE stacked ``update_scan`` launch. This is the
+    update-only-loop protocol (no per-batch value), the shape where the accumulator turns
+    k dispatches into one."""
+    import jax
+
+    mc = _make_collection()
+    plist, tlist = _presplit_batches(preds, target)
+    mc(plist[0], tlist[0])  # group formation + compile
+    mc.reset()
+    buf = mc.buffered(k)
+    # compile both stacked-scan signatures (full-k flush + the N%k remainder) out of window
+    for i in range(k):
+        buf.update(plist[i % N_BATCHES], tlist[i % N_BATCHES])
+    for i in range(N_BATCHES % k):
+        buf.update(plist[i], tlist[i])
+    buf.flush()
+    buf.reset()
+
+    def _window():
+        buf.reset()
+        for i in range(N_BATCHES):
+            buf.update(plist[i], tlist[i])
+        buf.flush()
+        jax.block_until_ready(list(mc.compute().values()))
+
+    best = _best_of(_window, windows=4)
+    print(f"ours (buffered k={k} updates): {N_BATCHES} updates in {best:.4f}s", file=sys.stderr)
+    return N_BATCHES / best
 
 
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
@@ -685,15 +765,26 @@ def main() -> None:
     preds, target = _gen_data()
     ours = bench_ours(preds, target)
     try:
-        ours_per_step = bench_ours_per_step(preds, target)
+        per_step = bench_ours_per_step(preds, target)
+        ours_per_step = per_step["rate"]
+        host_overhead_us = per_step["host_overhead_us"]
     except Exception as err:
         print(f"per-step bench failed: {err!r}", file=sys.stderr)
         ours_per_step = float("nan")
+        host_overhead_us = None
     try:
-        ref = bench_reference(preds, target)
-    except Exception as err:  # reference unavailable -> report absolute number only
-        print(f"reference bench failed: {err!r}", file=sys.stderr)
-        ref = float("nan")
+        buffered_rate = bench_buffered_updates(preds, target)
+    except Exception as err:
+        print(f"buffered bench failed: {err!r}", file=sys.stderr)
+        buffered_rate = float("nan")
+    if SMOKE:
+        ref = float("nan")  # the torch reference import alone dwarfs a smoke budget
+    else:
+        try:
+            ref = bench_reference(preds, target)
+        except Exception as err:  # reference unavailable -> report absolute number only
+            print(f"reference bench failed: {err!r}", file=sys.stderr)
+            ref = float("nan")
     ours_fused = ours["device_rate"]
     # like-for-like TASK comparison: wall-clock to fold 1M samples into the 4-metric collection
     # and read the values back, best API of each framework, all latencies included
@@ -705,18 +796,27 @@ def main() -> None:
         "wall_1M_sweep_reference_s": round(ref_wall, 4) if ref_wall == ref_wall else None,
         "host_api_sweep_updates_per_sec": round(ours["host_api_rate"], 2),
         "updates_per_sec_per_step_forward": round(ours_per_step, 2) if ours_per_step == ours_per_step else None,
+        # r06+: per-batch arrays are pre-built OUTSIDE the window (protocol parity with the
+        # reference's tensor list); r01-r05 sliced the device stack in-loop, paying two
+        # extra eager dispatches per step — trajectory comparisons must account for this
+        "per_step_protocol": "presplit-batch-list",
+        "per_step_host_overhead_us": host_overhead_us,
+        "buffered_updates_per_sec": round(buffered_rate, 2) if buffered_rate == buffered_rate else None,
         "updates_per_sec_reference_per_step": round(ref, 2) if ref == ref else None,
         "per_step_vs_reference": round(ours_per_step / ref, 3) if ref == ref and ours_per_step == ours_per_step else None,
     }
     extras["fused_samples_per_sec"] = round(ours_fused * BATCH, 0)
-    for name, fn in (
+    extra_benches = (
         ("dispatch_latency", bench_dispatch_latency),
         ("functional_stat_scores", bench_functional_stat_scores),
         ("binned_curves", bench_binned_curves),
         ("retrieval_cat_state", bench_retrieval_cat),
         ("sync_single_chip", bench_sync_latency),
         ("sync_mesh8", bench_sync_mesh8),
-    ):
+    )
+    if SMOKE:  # keep only the cheap launch-floor probe; the rest are minutes-scale
+        extra_benches = (("dispatch_latency", bench_dispatch_latency),)
+    for name, fn in extra_benches:
         try:
             extras.update(fn())
         except Exception as err:
@@ -738,7 +838,7 @@ def main() -> None:
             {
                 "metric": "metric_updates_per_sec_1M_sample_multiclass_sweep",
                 "value": round(ours_fused, 2),
-                "unit": (
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if SMOKE else "") + (
                     "updates/s (batch=10k, MetricCollection[Acc,P,R,F1] one-launch fused sweep,"
                     " DEVICE RATE from a two-point K-sweep slope — constant tunnel dispatch/latency"
                     " cancelled; vs_baseline = reference torch-CPU wall-clock for one full 1M-sample"
@@ -753,7 +853,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+    if "--smoke" in sys.argv:
+        # CI smoke lane (make bench-smoke): tiny sizes, CPU pinned via the config API (the
+        # env-var route can wedge on a dead tunnel plugin), no subprocess orchestration —
+        # one parseable JSON line in seconds or a nonzero rc
+        import jax
+
+        _apply_smoke_sizes()
+        jax.config.update("jax_platforms", "cpu")
+        main()
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         import jax
 
         jax.config.update("jax_platforms", sys.argv[2])

@@ -1,0 +1,339 @@
+"""Equivalence + guard suite for the fast-dispatch layer (ISSUE 3 tentpole).
+
+Covers: bit-identical state and batch values across the dispatch tiers (eager merge vs
+fused jit vs AOT+donation vs buffered) for sum/mean/max/min reductions and a real
+compute-group collection; the donated-buffer state-generation guard; the buffered
+mid-flight guard; the cached full-state-update batch-value kernel; and the obs counters
+(`aot_compiles`/`aot_cache_hits`/`donated_steps`/`buffered_flushes`/host-overhead timer).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, obs
+from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+
+
+class _ReduceProbe(Metric):
+    """Minimal fusable metric with a configurable reduction — exercises every branch of
+    the merge ladder under all dispatch tiers (full_state_update stays False so the
+    reduce-state forward path engages, unlike Max/MinMetric)."""
+
+    full_state_update = False
+
+    def __init__(self, fx: str, **kwargs):
+        super().__init__(**kwargs)
+        init = {"sum": 0.0, "mean": 0.0, "max": -jnp.inf, "min": jnp.inf}[fx]
+        self.add_state("acc", jnp.asarray(init, jnp.float32), dist_reduce_fx=fx)
+        self.add_state("count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self._fx = fx
+
+    def _update(self, state, value):
+        if self._fx == "max":
+            acc = jnp.maximum(state["acc"], jnp.max(value))
+        elif self._fx == "min":
+            acc = jnp.minimum(state["acc"], jnp.min(value))
+        elif self._fx == "mean":
+            acc = state["acc"] + jnp.mean(value)
+        else:
+            acc = state["acc"] + jnp.sum(value)
+        return {"acc": acc, "count": state["count"] + 1.0}
+
+    def _compute(self, state):
+        return state["acc"]
+
+
+def _batches(n=7, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(size).astype(np.float32)) for _ in range(n)]
+
+
+def _force_eager_merge(m: Metric) -> Metric:
+    """Pin the tier-1 eager merge path (the `_fusable_forward() is False` branch)."""
+    m._jit_cache["forward_fusable"] = False
+    return m
+
+
+def _force_jit_step(m: Metric) -> Metric:
+    """Pin the tier-2 fused jit path (fast dispatch off, fusable on)."""
+    m.fast_dispatch = False
+    return m
+
+
+# -------------------------------------------------------------------------- equivalence
+class TestTierEquivalence:
+    @pytest.mark.parametrize("fx", ["sum", "mean", "max", "min"])
+    def test_forward_tiers_bit_identical(self, fx):
+        fast, jit_, eager = _ReduceProbe(fx), _force_jit_step(_ReduceProbe(fx)), _force_eager_merge(_ReduceProbe(fx))
+        for x in _batches():
+            vf, vj, ve = fast(x), jit_(x), eager(x)
+            assert np.array_equal(np.asarray(vf), np.asarray(vj))
+            assert np.array_equal(np.asarray(vf), np.asarray(ve))
+        for name in fast._state.tensors:
+            sf = np.asarray(fast._state.tensors[name])
+            assert np.array_equal(sf, np.asarray(jit_._state.tensors[name]))
+            assert np.array_equal(sf, np.asarray(eager._state.tensors[name]))
+        assert np.array_equal(np.asarray(fast.compute()), np.asarray(jit_.compute()))
+        assert np.array_equal(np.asarray(fast.compute()), np.asarray(eager.compute()))
+
+    @pytest.mark.parametrize("fx", ["sum", "mean", "max", "min"])
+    def test_buffered_state_matches_per_step_updates(self, fx):
+        buffered, stepped = _ReduceProbe(fx), _ReduceProbe(fx)
+        buf = buffered.buffered(3)
+        for x in _batches():
+            buf.update(x)
+            stepped.update(x)
+        buf.flush()
+        for name in buffered._state.tensors:
+            assert np.array_equal(
+                np.asarray(buffered._state.tensors[name]), np.asarray(stepped._state.tensors[name])
+            ), name
+        assert np.allclose(np.asarray(buf.compute()), np.asarray(stepped.compute()))
+
+    def test_collection_group_forward_tiers(self):
+        def make():
+            return MetricCollection([
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            ])
+
+        fast, slow = make(), make()
+        for m in slow.values(copy_state=False):
+            m.fast_dispatch = False
+        rng = np.random.RandomState(3)
+        for i in range(6):
+            p = jnp.asarray(rng.randint(0, NUM_CLASSES, 64).astype(np.int32))
+            t = jnp.asarray(rng.randint(0, NUM_CLASSES, 64).astype(np.int32))
+            vf, vs = fast(p, t), slow(p, t)
+            for k in vf:
+                assert np.array_equal(np.asarray(vf[k]), np.asarray(vs[k])), (i, k)
+        cf, cs = fast.compute(), slow.compute()
+        for k in cf:
+            assert np.array_equal(np.asarray(cf[k]), np.asarray(cs[k]))
+
+    def test_shape_change_recompiles_and_stays_identical(self):
+        fast, slow = _ReduceProbe("sum"), _force_jit_step(_ReduceProbe("sum"))
+        for size in (16, 16, 9, 16, 9):
+            x = jnp.asarray(np.full(size, 2.0, np.float32))
+            assert np.array_equal(np.asarray(fast(x)), np.asarray(slow(x)))
+
+    def test_update_batches_aot_matches_jit_scan(self):
+        fast, slow = _ReduceProbe("sum"), _force_jit_step(_ReduceProbe("sum"))
+        stack = jnp.asarray(np.random.RandomState(5).randn(6, 12).astype(np.float32))
+        fast.update_batches(stack)
+        slow.update_batches(stack)
+        for name in fast._state.tensors:
+            assert np.array_equal(
+                np.asarray(fast._state.tensors[name]), np.asarray(slow._state.tensors[name])
+            )
+
+
+# ------------------------------------------------------------------------------- guards
+class TestDonationGuards:
+    def test_donated_step_bumps_generation_and_deletes_old_buffers(self):
+        m = SumMetric()
+        m(jnp.ones(4))
+        gen0 = m.state_generation
+        old = m._state.tensors["sum_value"]
+        m(jnp.ones(4))
+        assert m.state_generation == gen0 + 1
+        if old.is_deleted():  # donation took effect on this backend
+            with pytest.raises(RuntimeError, match="deleted"):
+                np.asarray(old)
+
+    def test_mid_flight_state_read_raises_cleanly(self):
+        m = SumMetric()
+        m(jnp.ones(4))
+        m._state.begin_donated_dispatch()
+        try:
+            with pytest.raises(TorchMetricsUserError, match="mid-flight"):
+                _ = m.metric_state
+            with pytest.raises(TorchMetricsUserError, match="mid-flight"):
+                _ = m.sum_value
+        finally:
+            m._state.abort_donated()
+        _ = m.metric_state  # readable again after the dispatch window closes
+
+    def test_defaults_survive_donated_steps_across_resets(self):
+        m = MeanMetric()
+        for _ in range(3):
+            m(jnp.ones(8))
+            m(jnp.full((8,), 3.0))
+            val = float(m.compute())
+            assert val == 2.0
+            m.reset()
+
+    def test_donation_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv(_dispatch.ENV_DONATION, "0")
+        m = SumMetric()
+        m(jnp.ones(4))
+        old = m._state.tensors["sum_value"]
+        m(jnp.ones(4))
+        assert not old.is_deleted()
+        assert m.state_generation == 0
+
+    def test_fast_dispatch_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv(_dispatch.ENV_FAST_DISPATCH, "0")
+        m = SumMetric()
+        m(jnp.ones(4))
+        m(jnp.ones(4))
+        assert "aot_forward" not in m._jit_cache
+        assert float(m.compute()) == 8.0
+
+
+class TestBufferedGuards:
+    def test_pending_buffer_blocks_direct_access(self):
+        m = SumMetric()
+        buf = m.buffered(4)
+        buf.update(jnp.ones(4))
+        for op in (m.compute, lambda: m.update(jnp.ones(4)), lambda: m(jnp.ones(4))):
+            with pytest.raises(TorchMetricsUserError, match="pending"):
+                op()
+        with pytest.raises(TorchMetricsUserError, match="pending"):
+            _ = m.metric_state
+        buf.flush()
+        assert float(m.compute()) == 4.0
+
+    def test_auto_flush_at_k_and_context_manager(self):
+        m = SumMetric()
+        with m.buffered(2) as buf:
+            buf.update(jnp.ones(4))
+            assert buf.pending == 1
+            buf.update(jnp.ones(4))
+            assert buf.pending == 0  # k reached -> flushed
+            buf.update(jnp.ones(4))
+        assert buf.pending == 0  # context exit flushed the tail
+        assert float(m.compute()) == 12.0
+
+    def test_shape_change_flushes_pending_stack(self):
+        m = SumMetric()
+        buf = m.buffered(8)
+        buf.update(jnp.ones(4))
+        buf.update(jnp.ones(6))  # ragged: previous stack must flush first
+        assert buf.pending == 1
+        buf.flush()
+        assert float(m.compute()) == 10.0
+
+    def test_error_exit_drops_pending_batches(self):
+        m = SumMetric()
+        with pytest.raises(ValueError, match="boom"):
+            with m.buffered(8) as buf:
+                buf.update(jnp.ones(4))
+                raise ValueError("boom")
+        assert buf.pending == 0
+        assert float(m.compute()) == 0.0  # half-window was not flushed into state
+
+    def test_collection_buffered_matches_updates(self):
+        def make():
+            return MetricCollection([
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+                MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            ])
+
+        buffered, stepped = make(), make()
+        rng = np.random.RandomState(11)
+        batches = [
+            (jnp.asarray(rng.randint(0, NUM_CLASSES, 32).astype(np.int32)),
+             jnp.asarray(rng.randint(0, NUM_CLASSES, 32).astype(np.int32)))
+            for _ in range(5)
+        ]
+        buf = buffered.buffered(3)
+        for p, t in batches:
+            buf.update(p, t)
+            stepped.update(p, t)
+        cb, cs = buf.compute(), stepped.compute()
+        for k in cb:
+            assert np.allclose(np.asarray(cb[k]), np.asarray(cs[k])), k
+
+
+# ------------------------------------------------------------- full-state-update caching
+class TestFullStateForward:
+    class _FullState(Metric):
+        full_state_update = True
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+        def _update(self, state, value):
+            return {"total": state["total"] + jnp.sum(value)}
+
+        def _compute(self, state):
+            return state["total"]
+
+    def test_fused_batch_value_matches_slow_dance(self):
+        fast = self._FullState()
+        slow = self._FullState()
+        slow._jit_cache["batch_value_fusable"] = False  # pin the snapshot/restore dance
+        for x in _batches(5):
+            vf, vs = fast(x), slow(x)
+            assert np.array_equal(np.asarray(vf), np.asarray(vs))
+        assert np.array_equal(np.asarray(fast.compute()), np.asarray(slow.compute()))
+        assert "batch_value" in fast._jit_cache
+        # the fused path never takes the counted slow path; the pinned one always does
+        assert fast.telemetry["calls"].get("full_state_slow_path", 0) == 0
+        assert slow.telemetry["calls"]["full_state_slow_path"] == 5
+
+    def test_max_min_metrics_still_correct(self):
+        m = MaxMetric()
+        m(1.0)
+        m(np.array([2.0, 0.5], np.float32))
+        assert float(m.compute()) == 2.0
+        m = MinMetric()
+        m(1.0)
+        m(np.array([2.0, 0.5], np.float32))
+        assert float(m.compute()) == 0.5
+
+
+# ------------------------------------------------------------------------------ counters
+class TestDispatchTelemetry:
+    def test_counters_move_and_host_overhead_records(self):
+        c0 = {
+            k: obs.telemetry.counter(f"dispatch.{k}").value
+            for k in ("aot_compiles", "aot_cache_hits", "donated_steps", "buffered_flushes")
+        }
+        m = _ReduceProbe("sum")
+        with obs.enabled():
+            for x in _batches(5):
+                m(x)
+            buf = m.buffered(2)
+            buf.update(_batches(1)[0])
+            buf.update(_batches(1)[0])
+        obs.disable()
+        snap = obs.telemetry.snapshot()
+        assert snap["counters"]["dispatch.aot_compiles"] > c0["aot_compiles"]
+        assert snap["counters"]["dispatch.aot_cache_hits"] > c0["aot_cache_hits"]
+        assert snap["counters"]["dispatch.donated_steps"] > c0["donated_steps"]
+        assert snap["counters"]["dispatch.buffered_flushes"] > c0["buffered_flushes"]
+        ho = snap["timers"].get("dispatch.host_overhead")
+        assert ho is not None and ho["count"] >= 1
+        extras = obs.bench_extras()
+        for key in ("aot_compiles", "aot_cache_hits", "donated_steps", "buffered_flushes",
+                    "per_step_host_overhead_us"):
+            assert key in extras
+
+    def test_steady_state_hits_cache_not_compiler(self):
+        m = _ReduceProbe("sum")
+        xs = _batches(12)
+        m(xs[0])
+        m(xs[1])  # weak->strong state dtype flip recompile happens here
+        compiles = obs.telemetry.counter("dispatch.aot_compiles").value
+        for x in xs[2:]:
+            m(x)
+        assert obs.telemetry.counter("dispatch.aot_compiles").value == compiles

@@ -197,7 +197,9 @@ class TestMetricTelemetry:
         m.update_batches(preds, target)
         t = m.telemetry
         assert t["calls"]["update_batches"] == 1
-        assert t["traces"]["update_scan"] == 1
+        # the steady-state scan kernel is the AOT executable (ops/dispatch.py); the jit
+        # twin 'update_scan' only traces on the fallback path
+        assert t["traces"].get("aot_update_scan", 0) + t["traces"].get("update_scan", 0) == 1
 
     def test_telemetry_survives_clone_and_pickle(self):
         import pickle
@@ -222,7 +224,9 @@ class TestCollectionTelemetry:
         t = mc.telemetry
         leader = t["metrics"]["MulticlassAccuracy"]
         assert leader["calls"]["group_forward"] == 2
-        assert leader["traces"].get("group_forward") == 1
+        # the group step compiles once, as the AOT executable (fast path) or the jit twin
+        traces = leader["traces"]
+        assert traces.get("aot_group_forward", 0) + traces.get("group_forward", 0) == 1
         assert t["compute_groups"] == {0: ["MulticlassAccuracy", "MulticlassF1Score"]}
         assert t["retraces_total"] == 0
 

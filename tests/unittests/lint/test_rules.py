@@ -358,6 +358,82 @@ class TestTPU006ConstantReupload:
         )
 
 
+# ------------------------------------------------------------------------------- TPU007
+class TestTPU007DonatedRead:
+    def test_read_after_donated_call_flags(self):
+        assert "TPU007" in _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(x, y)
+                return x + out
+            """
+        )
+
+    def test_aot_lower_compile_chain_flags(self):
+        assert "TPU007" in _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step, donate_argnums=(0, 1)).lower(x, y).compile()
+                out = f(x, y)
+                return y + out
+            """
+        )
+
+    def test_rebound_name_is_clean(self):
+        assert _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step, donate_argnums=(0,))
+                x = f(x, y)
+                return x + 1
+            """
+        ) == []
+
+    def test_non_donated_position_is_clean(self):
+        # only argument 0 is donated; y stays readable
+        assert _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(x, y)
+                return y + out
+            """
+        ) == []
+
+    def test_plain_jit_is_clean(self):
+        assert _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step)
+                out = f(x, y)
+                return x + out
+            """
+        ) == []
+
+    def test_variable_donate_argnums_tracks_nothing(self):
+        # donation declared through an expression: known-donating, positions unknown —
+        # under-reporting beats guessing (this is the engine's own aot_compile shape)
+        assert _rules(
+            """
+            def run(x, y, nums):
+                f = jax.jit(step, donate_argnums=nums)
+                out = f(x, y)
+                return x + out
+            """
+        ) == []
+
+    def test_suppression_comment_waives(self):
+        assert _rules(
+            """
+            def run(x, y):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(x, y)
+                return x + out  # jaxlint: disable=TPU007
+            """
+        ) == []
+
+
 # ------------------------------------------------------------------------------- TPU000
 def test_syntax_error_reports_tpu000():
     assert _rules("def broken(:\n") == ["TPU000"]

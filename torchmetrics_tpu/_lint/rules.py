@@ -26,6 +26,7 @@ TPU003    host ``numpy`` op applied to a traced value inside jit
 TPU004    jit wrap leaving str/bool config parameters non-static (retrace churn)
 TPU005    ``add_state`` reduction/dtype mismatch (overflow, non-additive sum)
 TPU006    fresh ``jnp`` constant built inside a per-step hot path (re-upload)
+TPU007    value read after being donated to a compiled dispatch (deleted buffer)
 ========  ======================================================================
 """
 from __future__ import annotations
@@ -44,6 +45,7 @@ RULES: Dict[str, str] = {
     "TPU004": "jit call-site leaves config parameters non-static (retrace churn)",
     "TPU005": "add_state reduction/dtype mismatch (overflow or non-additive update)",
     "TPU006": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
+    "TPU007": "value read after being donated to a compiled dispatch (deleted buffer)",
 }
 
 # wrapper callables whose function arguments execute under tracing
@@ -816,8 +818,101 @@ def _rule_tpu006(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+def _donating_argnums(node: ast.AST) -> Optional[Set[int]]:
+    """Literal ``donate_argnums`` positions of a jit-producing expression, or None.
+
+    Unwraps ``jax.jit(f, donate_argnums=...)``, the AOT chain ``jax.jit(f, donate_argnums=
+    ...).lower(...).compile()``, and ``functools.partial(jax.jit, donate_argnums=...)``.
+    Returns an empty set when donation is declared but the positions are not literal —
+    the callable is known-donating, but no specific argument can be tracked.
+    """
+    while (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("lower", "compile")
+    ):
+        node = node.func.value
+    if not isinstance(node, ast.Call):
+        return None
+    fn = _final_name(node.func)
+    if fn == "partial" and node.args and _final_name(node.args[0]) in ("jit", "pjit"):
+        pass
+    elif fn not in ("jit", "pjit"):
+        return None
+    nums: Set[int] = set()
+    found = False
+    for kw in node.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        found = True
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            nums.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    nums.add(el.value)
+        else:  # declared via a variable/expression: donating, positions unknown
+            return set()
+    return nums if found else None
+
+
+def _rule_tpu007(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for info in model.functions:
+        # (1) locally-bound donating callables: f = jax.jit(step, donate_argnums=(0,))[...]
+        donators: Dict[str, Set[int]] = {}
+        rebinds: Dict[str, List[int]] = {}
+        for node in _scoped_walk(info.node):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for name in model._target_names(targets):
+                rebinds.setdefault(name, []).append(node.lineno)
+            nums = _donating_argnums(value)
+            if nums is not None:
+                for name in model._target_names(targets):
+                    donators[name] = nums
+        if not donators:
+            continue
+        # (2) donation sites: names handed to a donating callable at a donated position
+        donated_at: Dict[str, int] = {}
+        for node in _scoped_walk(info.node):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            for idx in donators.get(node.func.id, ()):
+                if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+                    name = node.args[idx].id
+                    donated_at[name] = max(node.lineno, donated_at.get(name, 0))
+        if not donated_at:
+            continue
+        # (3) reads after the donation site with no intervening rebind: the buffer is gone
+        for node in _scoped_walk(info.node):
+            if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+                continue
+            dline = donated_at.get(node.id)
+            if dline is None or node.lineno <= dline:
+                continue
+            if any(dline <= rl <= node.lineno for rl in rebinds.get(node.id, ())):
+                continue
+            out.append(_finding(
+                "TPU007", path, node, lines,
+                f"{node.id!r} was donated to a compiled dispatch on line {dline} and is read"
+                " afterwards — donated buffers are deleted (reads raise or return garbage);"
+                " rebind the name to the dispatch output or drop donate_argnums for it",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
+    _rule_tpu007,
 )
 
 

@@ -13,12 +13,16 @@ its jitted ``_update``.
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+import numpy as np
+
 from torchmetrics_tpu import obs
-from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.metric import Metric, _MISS
+from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.utils.data import allclose
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -103,7 +107,6 @@ class MetricCollection:
     def _forward_groups(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-group fused forward; per-metric fallback for non-fusable groups."""
         import jax
-        import numpy as np
 
         result: Dict[str, Any] = {}
         for cg in self._groups.values():
@@ -115,6 +118,15 @@ class MetricCollection:
                 for name, m in members:
                     result[name] = m(*args, **m._filter_kwargs(**kwargs))
                 continue
+            if leader.fast_dispatch and _dispatch.fast_dispatch_enabled():
+                f_kwargs = leader._filter_kwargs(**kwargs)
+                coerced_args, coerced_kwargs = leader._coerce(args, f_kwargs)
+                if leader._should_validate():
+                    leader._validate(*coerced_args, **coerced_kwargs)
+                vals = self._fast_group_forward(leader, members, coerced_args, coerced_kwargs)
+                if vals is not _MISS:
+                    result.update(vals)
+                    continue
             fn = leader._jit_cache.get("group_forward")
             if fn is None:
                 defaults = {k: leader._defaults[k] for k in leader._state.tensors}
@@ -155,6 +167,122 @@ class MetricCollection:
             self._compute_groups_create_state_ref()
             self._state_is_copy = False
         return result
+
+    def _build_aot_group_forward(
+        self, leader: Metric, members: List[Tuple[str, Metric]], arg_leaves: List[Any], treedef: Any
+    ) -> "_dispatch.AotEntry":
+        """Compile one group's fused forward step for one abstract input signature.
+
+        Same flat positional calling convention as ``Metric._build_aot_forward`` but the
+        value output is a dict of every member's batch value (squeezed in-graph). The
+        leader's state argnums are donated even though members alias the buffers: the
+        group step is the only writer, and the caller re-aliases every member to the fresh
+        arrays before anything can read the donated ones.
+        """
+        import jax
+        from jax.tree_util import tree_unflatten
+
+        names = tuple(leader._state.tensors)
+        defaults = {k: leader._defaults[k] for k in names}
+        reductions = {k: leader._reductions[k] for k in names}
+        computes = tuple((name, m._compute) for name, m in members)
+        n_state = len(names)
+
+        def step_flat(*leaves):
+            st = dict(zip(names, leaves[:n_state]))
+            n = leaves[n_state]
+            f_args, f_kwargs = tree_unflatten(treedef, leaves[n_state + 1 :])
+            batch_out = leader._update(dict(defaults), *f_args, **f_kwargs)
+            batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
+            vals = {name: _dispatch.graph_squeeze(compute(batch_state)) for name, compute in computes}
+            merged = leader._merge_tensor_ladder(st, batch_out, defaults, reductions, n)
+            return vals, tuple(merged[k] for k in names)
+
+        donated = _dispatch.donation_enabled()
+        example = (
+            *leader._state_leaves_for_donation(names),
+            np.float32(1.0),
+            *arg_leaves,
+        )
+        compiled = _dispatch.aot_compile(
+            obs.instrument_trace(step_flat, leader, "aot_group_forward"),
+            example,
+            donate_argnums=tuple(range(n_state)) if donated else (),
+        )
+        return _dispatch.AotEntry(compiled, names, donated)
+
+    def _fast_group_forward(
+        self, leader: Metric, members: List[Tuple[str, Metric]], args: tuple, kwargs: dict
+    ) -> Any:
+        """Steady-state group forward through an AOT executable; ``_MISS`` on fallback."""
+        import jax
+
+        donate_now = _dispatch.donation_enabled()
+        cache = leader._jit_cache.get("aot_group_forward")
+        if cache is None or cache.donate != donate_now:
+            cache = _dispatch.FastStepCache(donate_now)
+            leader._jit_cache["aot_group_forward"] = cache
+        if cache.broken:
+            return _MISS
+        tracing = obs.telemetry.enabled
+        t0 = time.perf_counter() if tracing else 0.0
+        state = leader._state
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            state_leaves = leader._state_leaves_for_donation(tuple(state.tensors))
+            obs.bump(leader, "group_forward_calls")
+            obs.count_dispatch(leader)  # k metrics in the group, ONE fused launch
+            state.begin_donated_dispatch()
+            t1 = time.perf_counter() if tracing else 0.0
+            entry, (vals, merged) = _dispatch.dispatch_step(
+                cache,
+                lambda lv, td: self._build_aot_group_forward(leader, members, lv, td),
+                state_leaves,
+                (np.float32(leader._update_count + 1),),
+                leaves,
+                treedef,
+            )
+            t2 = time.perf_counter() if tracing else 0.0
+            if entry.donated:
+                state.commit_donated(entry.state_names, merged)
+                obs.telemetry.counter("dispatch.donated_steps").inc()
+            else:
+                for name, arr in zip(entry.state_names, merged):
+                    state.tensors[name] = arr
+                state.abort_donated()
+        except Exception:
+            state.abort_donated()
+            if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
+                for name in state.tensors:
+                    state.tensors[name] = leader._defaults[name]
+                rank_zero_warn(
+                    f"A donated group forward dispatch (leader {type(leader).__name__}) failed"
+                    " mid-flight; the group state was reset to defaults.",
+                    UserWarning,
+                )
+            cache.mark_broken()
+            return _MISS
+        n_int = leader._update_count + 1
+        tensors = state.tensors
+        for _, m in members:
+            m._update_count = n_int
+            m._update_called = True
+            m._computed = None
+            if m is not leader:
+                # re-alias NOW: the member's old aliases point at donated (deleted) buffers
+                for s in entry.state_names:
+                    m._state.tensors[s] = tensors[s]
+        if tracing:
+            obs.telemetry.timer("dispatch.host_overhead").observe(
+                (t1 - t0) + (time.perf_counter() - t2)
+            )
+        return vals
+
+    def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
+        """Deferred accumulator over the whole collection: buffer up to ``k`` ``update``
+        batches host-side and flush them through one ``update_batches`` scan per compute
+        group (k·groups dispatches → groups). See :meth:`Metric.buffered`."""
+        return _dispatch.BufferedUpdater(self, k)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -370,6 +498,12 @@ class MetricCollection:
         if not self._state_is_copy:
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
+                if len(cg) > 1 and not m0._state_shared:
+                    # gates metric-LEVEL donation: a member's donated step would delete
+                    # buffers its siblings alias. The group-level fast path donates anyway
+                    # (it is the only writer and re-aliases members before any read).
+                    for name in cg:
+                        self._modules[name]._state_shared = True
                 for i in range(1, len(cg)):
                     mi = self._modules[cg[i]]
                     for state in m0._defaults:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time
 from contextlib import contextmanager
 from copy import deepcopy
 from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
@@ -42,6 +43,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu import obs
+from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.parallel.sync import process_sync
 from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.data import dim_zero_cat
@@ -55,6 +57,10 @@ def jit_distributed_available() -> bool:
         return jax.process_count() > 1
     except Exception:
         return False
+
+
+#: sentinel distinguishing "fast path declined" from a legitimate None batch value
+_MISS = object()
 
 
 @functools.lru_cache(maxsize=None)
@@ -72,15 +78,49 @@ class StateStore:
     Arrays themselves are immutable (functional updates swap dict entries); sharing the *store*
     object is how ``MetricCollection`` compute groups alias state across metrics
     (reference ``collections.py:289`` shares tensors by reference).
+
+    ``generation`` counts donated dispatches: each AOT step that donates the tensor buffers
+    into its output invalidates every array snapshotted from an earlier generation (the
+    buffers are deleted by XLA). ``inflight`` is True only inside the donated-dispatch
+    window — between handing the buffers to the executable and committing its outputs —
+    when the stored tensors are already dead; any read in that window raises cleanly
+    instead of surfacing a deleted-buffer RuntimeError from deep inside jax.
     """
 
-    __slots__ = ("tensors", "lists")
+    __slots__ = ("tensors", "lists", "generation", "inflight", "maybe_aliased")
 
     def __init__(self) -> None:
         self.tensors: Dict[str, Array] = {}
         self.lists: Dict[str, List[Array]] = {}
+        self.generation = 0
+        self.inflight = False
+        # True whenever the tensors may alias the defaults or each other (fresh store,
+        # after reset/restore); cleared once a donated commit installs fresh buffers
+        self.maybe_aliased = True
+
+    def guard_readable(self) -> None:
+        if self.inflight:
+            raise TorchMetricsUserError(
+                "Metric state read mid-flight: the state buffers were donated to an"
+                " in-progress dispatch and their contents are gone until the step commits."
+                " Do not read state from callbacks that run inside a forward step."
+            )
+
+    def begin_donated_dispatch(self) -> None:
+        self.inflight = True
+
+    def commit_donated(self, names: Sequence[str], arrays: Sequence[Array]) -> None:
+        for name, arr in zip(names, arrays):
+            self.tensors[name] = arr
+        self.generation += 1
+        self.inflight = False
+        self.maybe_aliased = False  # executable outputs are distinct fresh buffers
+
+    def abort_donated(self) -> None:
+        self.inflight = False
 
     def snapshot(self) -> Dict[str, Any]:
+        self.guard_readable()
         return {**self.tensors, **{k: list(v) for k, v in self.lists.items()}}
 
     def restore(self, snap: Dict[str, Any]) -> None:
@@ -88,6 +128,7 @@ class StateStore:
             self.tensors[k] = snap[k]
         for k in self.lists:
             self.lists[k] = list(snap[k])
+        self.maybe_aliased = True
 
 
 class Metric:
@@ -117,6 +158,7 @@ class Metric:
     jit_update: bool = True
     jit_compute: bool = True
     scan_update: bool = True  # False for host-computation metrics: update_batches loops instead of lax.scan
+    fast_dispatch: bool = True  # False opts this class out of the AOT+donation per-step tier
 
     def __init__(self, **kwargs: Any) -> None:
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
@@ -153,7 +195,9 @@ class Metric:
         self._should_unsync = True
         self._is_synced = False
         self._cache: Optional[Dict[str, Any]] = None
-        self._jit_cache: Dict[str, Callable] = {}
+        self._jit_cache: Dict[str, Any] = {}
+        self._buffered_pending = 0  # batches held by a BufferedUpdater (state stale until flush)
+        self._state_shared = False  # True while compute-group members alias this state (gates donation)
         # telemetry (obs): always-on integer counts + (when tracing) accumulated wall times
         self._tm_counts: Dict[str, int] = {}
         self._tm_times: Dict[str, float] = {}
@@ -179,7 +223,18 @@ class Metric:
     @property
     def metric_state(self) -> Dict[str, Any]:
         """Current state values (reference ``metric.py:186``)."""
+        _dispatch.guard_buffered_pending(self, "metric_state")
         return self._state.snapshot()
+
+    @property
+    def state_generation(self) -> int:
+        """Donated-dispatch generation of the state buffers.
+
+        Each AOT step that donates the state tensors bumps this; arrays snapshotted at an
+        earlier generation are DELETED (reading them raises jax's deleted-buffer error).
+        Holders of long-lived snapshots can compare generations to detect staleness.
+        """
+        return self._state.generation
 
     @property
     def telemetry(self) -> Dict[str, Any]:
@@ -246,6 +301,7 @@ class Metric:
         state = self.__dict__.get("_state")
         if state is not None:
             if name in state.tensors:
+                state.guard_readable()
                 return state.tensors[name]
             if name in state.lists:
                 return state.lists[name]
@@ -255,6 +311,7 @@ class Metric:
         state = self.__dict__.get("_state")
         if state is not None and name in state.tensors:
             state.tensors[name] = jnp.asarray(value)
+            state.maybe_aliased = True  # user assignment may alias another live array
         elif state is not None and name in state.lists:
             state.lists[name] = list(value)
         else:
@@ -322,6 +379,7 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
+        _dispatch.guard_buffered_pending(self, "update")
         obs.bump(self, "update_calls")
         obs.count_dispatch(self)
         with obs.metric_span(self, "update"):
@@ -349,6 +407,7 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
+        _dispatch.guard_buffered_pending(self, "update_batches")
         obs.bump(self, "update_batches_calls")
         args, kwargs = self._coerce(args, kwargs)
         n_batches = jnp.shape(args[0] if args else next(iter(kwargs.values())))[0]
@@ -365,6 +424,16 @@ class Metric:
             np_kwargs = {k: np.asarray(v) for k, v in kwargs.items()}
             for i in range(n_batches):
                 self._validate(*(a[i] for a in np_args), **{k: v[i] for k, v in np_kwargs.items()})
+        if (
+            self.jit_update
+            and self.fast_dispatch
+            and _dispatch.fast_dispatch_enabled()
+            and self._fast_update_scan(args, kwargs)
+        ):
+            self._update_count += int(n_batches)
+            self._update_called = True
+            self._computed = None
+            return
         scan_fn = self._jit_cache.get("update_scan")
         if scan_fn is None:
             def _scan(tensors: Dict[str, Array], stacked_args: tuple, stacked_kwargs: dict):
@@ -384,6 +453,75 @@ class Metric:
         self._update_count += int(n_batches)
         self._update_called = True
         self._computed = None
+
+    def _build_aot_update_scan(self, arg_leaves: List[Any], treedef: Any) -> "_dispatch.AotEntry":
+        """Compile the whole-stack scan for one abstract stacked-input signature (flat
+        positional calling convention and donated state, exactly like the forward step)."""
+        from jax.tree_util import tree_unflatten
+
+        names = tuple(self._state.tensors)
+        n_state = len(names)
+
+        def scan_flat(*leaves):
+            st = dict(zip(names, leaves[:n_state]))
+            s_args, s_kwargs = tree_unflatten(treedef, leaves[n_state:])
+
+            def body(s, batch):
+                b_args, b_kwargs = batch
+                out = self._update(s, *b_args, **b_kwargs)
+                return {k: out.get(k, s[k]) for k in s}, None
+
+            final, _ = jax.lax.scan(body, st, (s_args, s_kwargs))
+            return tuple(final[k] for k in names)
+
+        donated = self._donation_ok()
+        example = (*self._state_leaves_for_donation(names), *arg_leaves)
+        compiled = _dispatch.aot_compile(
+            obs.instrument_trace(scan_flat, self, "aot_update_scan"),
+            example,
+            donate_argnums=tuple(range(n_state)) if donated else (),
+        )
+        return _dispatch.AotEntry(compiled, names, donated)
+
+    def _fast_update_scan(self, args: tuple, kwargs: dict) -> bool:
+        """AOT whole-stack scan; returns False to fall back to the jit scan path."""
+        donate_now = self._donation_ok()
+        cache = self._jit_cache.get("aot_update_scan")
+        if cache is None or cache.donate != donate_now:
+            cache = _dispatch.FastStepCache(donate_now)
+            self._jit_cache["aot_update_scan"] = cache
+        if cache.broken:
+            return False
+        state = self._state
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            state_leaves = self._state_leaves_for_donation(tuple(state.tensors))
+            obs.count_dispatch(self)
+            state.begin_donated_dispatch()
+            with obs.metric_span(self, "update_batches"):
+                entry, out = _dispatch.dispatch_step(
+                    cache, self._build_aot_update_scan, state_leaves, (), leaves, treedef
+                )
+            if entry.donated:
+                state.commit_donated(entry.state_names, out)
+                obs.telemetry.counter("dispatch.donated_steps").inc()
+            else:
+                for name, arr in zip(entry.state_names, out):
+                    state.tensors[name] = arr
+                state.abort_donated()
+        except Exception:
+            state.abort_donated()
+            if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
+                for name in state.tensors:
+                    state.tensors[name] = self._defaults[name]
+                rank_zero_warn(
+                    f"A donated update_batches dispatch of {type(self).__name__} failed"
+                    " mid-flight; the metric state was reset to defaults.",
+                    UserWarning,
+                )
+            cache.mark_broken()
+            return False
+        return True
 
     def _apply_update_result(self, out: Dict[str, Any]) -> None:
         for name in self._state.tensors:
@@ -447,15 +585,51 @@ class Metric:
         """
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing `forward`.")
+        _dispatch.guard_buffered_pending(self, "forward")
         obs.bump(self, "forward_calls")
         with obs.metric_span(self, "forward"):
             if self.full_state_update or self.dist_sync_on_step:
                 return self._forward_full_state_update(*args, **kwargs)
             return self._forward_reduce_state_update(*args, **kwargs)
 
+    def _fusable_batch_value(self) -> bool:
+        """True when the batch-only value of a full-state-update forward can be ONE kernel
+        (jittable update+compute over tensor-only state) instead of the reset/re-update/
+        compute/restore dance."""
+        flag = self._jit_cache.get("batch_value_fusable")
+        if flag is None:
+            flag = self.jit_update and self.jit_compute and not self._state.lists
+            self._jit_cache["batch_value_fusable"] = flag
+        return flag
+
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        """Reference ``metric.py:307-350``: update global, then compute on batch-only state."""
+        """Reference ``metric.py:307-350``: update global, then compute on batch-only state.
+
+        When the metric is fusable and no per-step sync is requested, the second update
+        path collapses into one cached batch-value kernel — ``compute(update(defaults,
+        batch))`` — instead of two extra eager dispatches plus a snapshot/restore; the
+        remaining slow path counts its extra dispatches in obs so it stays visible in
+        ``telemetry()``.
+        """
+        args, kwargs = self._coerce(args, kwargs)
         self.update(*args, **kwargs)
+        if not self.dist_sync_on_step and self._fusable_batch_value():
+            fn = self._jit_cache.get("batch_value")
+            if fn is None:
+                defaults = {k: self._defaults[k] for k in self._state.tensors}
+
+                def batch_value(*b_args, **b_kwargs):
+                    out = self._update(dict(defaults), *b_args, **b_kwargs)
+                    st = {k: out.get(k, defaults[k]) for k in defaults}
+                    return _dispatch.graph_squeeze(self._compute(st))
+
+                fn = jax.jit(obs.instrument_trace(batch_value, self, "batch_value"))
+                self._jit_cache["batch_value"] = fn
+            obs.count_dispatch(self)
+            self._computed = None
+            return self._squeeze_if_scalar(fn(*args, **kwargs))
+        obs.bump(self, "full_state_slow_path_calls")
+        obs.telemetry.counter("engine.full_state_forward.extra_dispatches").inc(2)
         update_count = self._update_count
         cache = self._state.snapshot()
         self._to_sync = self.dist_sync_on_step
@@ -541,12 +715,161 @@ class Metric:
             self._jit_cache["forward_step"] = fn
         return fn
 
+    # ------------------------------------------------------------- fast dispatch (AOT)
+    def _donation_ok(self) -> bool:
+        """Donation needs exclusively-owned state: compute-group members alias the leader's
+        arrays, so a member-level donated step would delete buffers its siblings still hold."""
+        return _dispatch.donation_enabled() and not self._state_shared
+
+    def _state_leaves_for_donation(self, names: Sequence[str]) -> List[Array]:
+        """Current tensor leaves in ``names`` order, copy-on-alias.
+
+        Donated buffers are deleted, so no leaf may alias (a) a default array — right
+        after ``__init__``/``reset`` the store holds the defaults themselves, and deleting
+        those would corrupt every later reset — or (b) another leaf in the same call
+        (``deepcopy`` of an immutable ``jax.Array`` returns the SAME object, so sibling
+        states registered from one template share a buffer; XLA rejects a twice-donated
+        buffer). The copies cost one device op each on the first step after a reset and
+        nothing afterwards: merged outputs are always distinct fresh buffers.
+        """
+        tensors = self._state.tensors
+        if not self._state.maybe_aliased:
+            return [tensors[name] for name in names]
+        defaults = self._defaults
+        leaves: List[Array] = []
+        seen: set = set()
+        for name in names:
+            arr = tensors[name]
+            if arr is defaults[name] or id(arr) in seen:
+                arr = jnp.asarray(arr).copy()
+            seen.add(id(arr))
+            leaves.append(arr)
+        return leaves
+
+    def _build_aot_forward(self, arg_leaves: List[Any], treedef: Any) -> "_dispatch.AotEntry":
+        """Compile the fused forward step for one abstract input signature.
+
+        The executable takes FLAT positional leaves — ``(*state, n, *batch_leaves)`` — and
+        returns ``(batch_val, merged_state_tuple)``; flat positional calling is the only
+        layout whose ``Compiled.__call__`` overhead matches jit's C++ fast path. The state
+        argnums are donated (buffer reuse) unless the state is group-shared.
+        """
+        from jax.tree_util import tree_unflatten
+
+        names = tuple(self._state.tensors)
+        defaults = {k: self._defaults[k] for k in names}
+        reductions = {k: self._reductions[k] for k in names}
+        n_state = len(names)
+
+        def step_flat(*leaves):
+            st = dict(zip(names, leaves[:n_state]))
+            n = leaves[n_state]
+            f_args, f_kwargs = tree_unflatten(treedef, leaves[n_state + 1 :])
+            batch_out = self._update(dict(defaults), *f_args, **f_kwargs)
+            batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
+            batch_val = _dispatch.graph_squeeze(self._compute(batch_state))
+            merged = self._merge_tensor_ladder(st, batch_out, defaults, reductions, n)
+            return batch_val, tuple(merged[k] for k in names)
+
+        donated = self._donation_ok()
+        example = (
+            *self._state_leaves_for_donation(names),
+            np.float32(1.0),
+            *arg_leaves,
+        )
+        compiled = _dispatch.aot_compile(
+            obs.instrument_trace(step_flat, self, "aot_forward_step"),
+            example,
+            donate_argnums=tuple(range(n_state)) if donated else (),
+        )
+        return _dispatch.AotEntry(compiled, names, donated)
+
+    def _fast_forward_step(self, args: tuple, kwargs: dict) -> Any:
+        """Steady-state fused forward through an AOT executable; ``_MISS`` on fallback.
+
+        Per step this does: one pytree flatten of the batch, one tuple signature compare
+        (last-hit cache), one executable call, and a dict-entry swap per state — no jit
+        argument processing, no fresh output buffers when donation is on.
+        """
+        donate_now = self._donation_ok()
+        cache = self._jit_cache.get("aot_forward")
+        if cache is None or cache.donate != donate_now:
+            # policy flip (state became group-shared, or env toggled): entries built under
+            # the old donation policy would donate buffers siblings still alias — drop them
+            cache = _dispatch.FastStepCache(donate_now)
+            self._jit_cache["aot_forward"] = cache
+        if cache.broken:
+            return _MISS
+        tracing = obs.telemetry.enabled
+        t0 = time.perf_counter() if tracing else 0.0
+        state = self._state
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            state_leaves = self._state_leaves_for_donation(tuple(state.tensors))
+            obs.count_dispatch(self)
+            state.begin_donated_dispatch()
+            t1 = time.perf_counter() if tracing else 0.0
+            entry, (batch_val, merged) = _dispatch.dispatch_step(
+                cache, self._build_aot_forward, state_leaves,
+                (np.float32(self._update_count + 1),), leaves, treedef,
+            )
+            t2 = time.perf_counter() if tracing else 0.0
+            if entry.donated:
+                state.commit_donated(entry.state_names, merged)
+                obs.telemetry.counter("dispatch.donated_steps").inc()
+            else:
+                for name, arr in zip(entry.state_names, merged):
+                    state.tensors[name] = arr
+                state.abort_donated()
+        except Exception:
+            state.abort_donated()
+            if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
+                # the dispatch died AFTER donating: the old buffers are gone and nothing
+                # replaced them — restore defaults so the metric stays usable
+                for name in state.tensors:
+                    state.tensors[name] = self._defaults[name]
+                rank_zero_warn(
+                    f"A donated forward dispatch of {type(self).__name__} failed mid-flight;"
+                    " the metric state was reset to defaults.",
+                    UserWarning,
+                )
+            cache.mark_broken()
+            return _MISS
+        self._update_count += 1
+        self._update_called = True
+        self._computed = None
+        if tracing:
+            obs.telemetry.timer("dispatch.host_overhead").observe(
+                (t1 - t0) + (time.perf_counter() - t2)
+            )
+        return batch_val
+
+    def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
+        """Deferred accumulator: buffer up to ``k`` ``update`` batches host-side and flush
+        them through the compiled ``update_scan`` program in ONE launch (k dispatches → 1).
+
+        Opt-in, for update-only loops (no per-batch value). While batches are pending the
+        metric's own ``update``/``forward``/``compute``/``metric_state`` raise cleanly —
+        the state is stale mid-flight until ``flush()``. Works as a context manager
+        (flushes on clean exit)::
+
+            with metric.buffered(32) as buf:
+                for preds, target in loader:
+                    buf.update(preds, target)
+            value = metric.compute()
+        """
+        return _dispatch.BufferedUpdater(self, k)
+
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
         args, kwargs = self._coerce(args, kwargs)
         if self._should_validate():
             self._validate(*args, **kwargs)
         if self._fusable_forward():
+            if self.fast_dispatch and _dispatch.fast_dispatch_enabled():
+                out = self._fast_forward_step(args, kwargs)
+                if out is not _MISS:
+                    return out
             obs.count_dispatch(self)
             batch_val, merged = self._jitted_forward_step()(
                 # np scalar, NOT jnp: jnp.asarray would eagerly dispatch a device op per step
@@ -588,6 +911,7 @@ class Metric:
         for name in list(self._state.lists):
             v = synced[name]
             self._state.lists[name] = list(v) if isinstance(v, (list, tuple)) else [v]
+        self._state.maybe_aliased = True  # a world-size-1 gather can return the input arrays
 
     def sync(
         self,
@@ -599,6 +923,7 @@ class Metric:
         """Snapshot local state and replace it with the world-synced state (reference ``metric.py:489``)."""
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
+        _dispatch.guard_buffered_pending(self, "sync")
         if distributed_available is None and self.distributed_available_fn is not None:
             distributed_available = self.distributed_available_fn
         is_distributed = distributed_available() if callable(distributed_available) else False
@@ -658,6 +983,7 @@ class Metric:
 
     def compute(self) -> Any:
         """Finalise the accumulated state to the metric value (reference ``metric.py:592-622``)."""
+        _dispatch.guard_buffered_pending(self, "compute")
         if not self._update_called:
             rank_zero_warn(
                 f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` method"
@@ -693,6 +1019,7 @@ class Metric:
             self._state.tensors[name] = self._defaults[name]
         for name in self._state.lists:
             self._state.lists[name] = []
+        self._state.maybe_aliased = True  # tensors alias the defaults again
         self._cache = None
         self._is_synced = False
 
@@ -819,6 +1146,7 @@ class Metric:
             self._state.tensors[name] = jax.device_put(v, device)
         for name, entries in self._state.lists.items():
             self._state.lists[name] = [jax.device_put(e, device) for e in entries]
+        self._state.maybe_aliased = True  # same-device device_put can return the input array
         self._defaults = {
             k: (jax.device_put(v, device) if not isinstance(v, list) else v) for k, v in self._defaults.items()
         }
@@ -833,6 +1161,7 @@ class Metric:
             self._state.tensors[name] = cast(v)
         for name, entries in self._state.lists.items():
             self._state.lists[name] = [cast(e) for e in entries]
+        self._state.maybe_aliased = True  # the cast is an identity for non-float states
         self._defaults = {k: (cast(v) if not isinstance(v, list) else v) for k, v in self._defaults.items()}
         self._jit_cache = {}
         return self
@@ -848,16 +1177,27 @@ class Metric:
 
     # ----------------------------------------------------------------- helpers
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
-        """Keep only kwargs accepted by this metric's ``update`` (reference ``metric.py:882-901``)."""
-        sig = inspect.signature(self.update if type(self).update is not Metric.update else self._update)
-        params = sig.parameters
-        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+        """Keep only kwargs accepted by this metric's ``update`` (reference ``metric.py:882-901``).
+
+        The signature inspection is memoised per instance: ``inspect.signature`` costs tens
+        of microseconds, which the per-step forward path pays once instead of every batch.
+        """
+        if not kwargs:
+            return kwargs
+        cached = self.__dict__.get("_fk_cache")
+        if cached is None:
+            sig = inspect.signature(self.update if type(self).update is not Metric.update else self._update)
+            params = sig.parameters
+            has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+            names = frozenset(
+                n for n, p in params.items()
+                if n not in ("self", "state") and p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+            )
+            cached = (has_var_kw, names)
+            object.__setattr__(self, "_fk_cache", cached)
+        has_var_kw, names = cached
         if has_var_kw:
             return kwargs
-        names = {
-            n for n, p in params.items()
-            if n not in ("self", "state") and p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
-        }
         return {k: v for k, v in kwargs.items() if k in names}
 
     def __repr__(self) -> str:

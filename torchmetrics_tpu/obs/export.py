@@ -111,6 +111,13 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "jit_retrace_counts": retraces,
         "jit_retraces_total": sum(retraces.values()),
         "engine_dispatches": counters.get("engine.dispatches", 0),
+        # fast-dispatch tier (docs/performance.md "Dispatch tiers"): AOT executable cache
+        # behaviour, donated-buffer steps, and deferred-accumulator flushes
+        "aot_compiles": counters.get("dispatch.aot_compiles", 0),
+        "aot_cache_hits": counters.get("dispatch.aot_cache_hits", 0),
+        "aot_fallbacks": counters.get("dispatch.aot_fallbacks", 0),
+        "donated_steps": counters.get("dispatch.donated_steps", 0),
+        "buffered_flushes": counters.get("dispatch.buffered_flushes", 0),
         "sync_state_traces": counters.get("sync.sync_state.traces", 0),
         "process_sync_calls": counters.get("sync.process_sync.calls", 0),
         "device_transfers": counters.get("transfer.device_put", 0)
@@ -123,6 +130,9 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["sync_latency_us_p50"] = round(s["p50"], 1)
         out["sync_latency_us_p99"] = round(s["p99"], 1)
         out["sync_latency_samples"] = s["count"]
+    ho = snap["timers"].get("dispatch.host_overhead")
+    if ho and ho["count"]:  # recorded only while tracing was enabled
+        out["per_step_host_overhead_us"] = round(ho["mean_s"] * 1e6, 2)
     # static-analysis status (jaxlint, the compile-time twin of these runtime counters):
     # non-baselined finding count over the installed package, so every BENCH JSON records
     # whether the benched tree was hazard-clean. Cached after the first call; None if the

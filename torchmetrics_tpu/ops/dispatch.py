@@ -1,0 +1,344 @@
+"""Zero-overhead per-step dispatch: AOT executables, buffer donation, deferred accumulation.
+
+The per-step ``forward`` protocol — the shape every real training loop uses — pays host-side
+costs the fused sweep never sees: jit's per-call argument processing (pytree flattening,
+signature hashing, cache lookup), dict rebuilds of the state, and a fresh set of output
+buffers every step. BENCH r01–r05 put the fused sweep at 16.8x the torch-CPU reference but
+per-step ``forward`` at only 2.1x; the gap is pure dispatch overhead. This module is the
+host-side machinery that closes it, in three tiers (see ``docs/performance.md``):
+
+- **AOT executables** (:func:`aot_compile`, :class:`FastStepCache`): the fused step program is
+  lowered and compiled ONCE per abstract input signature via ``jax.jit(...).lower(...)
+  .compile()`` and dispatched through the compiled executable with pre-flattened positional
+  leaves — steady-state steps skip jit's argument-processing path entirely. Dict/kwarg
+  arguments are deliberately excluded from the executable's calling convention: flat
+  positional leaves are the only layout whose ``Compiled.__call__`` cost matches the jit
+  C++ fast path (measured ~3x slower for dict-shaped args).
+- **Buffer donation**: the global state tensors are donated into the merged output
+  (``donate_argnums``) so each step reuses device buffers instead of allocating. Donated
+  buffers are DELETED — the engine guards this with a state-generation counter and an
+  in-flight flag on ``StateStore`` (reads mid-dispatch raise cleanly), copy-on-alias for
+  default tensors, and a shared-state gate for compute-group members (jaxlint rule TPU007
+  is the static twin: reading a donated buffer after dispatch).
+- **Deferred accumulation** (:class:`BufferedUpdater`, via ``Metric.buffered(k)`` /
+  ``MetricCollection.buffered(k)``): stacks up to ``k`` update batches host-side and flushes
+  them through the existing ``update_scan`` program in one launch — k dispatches become one
+  (plus the stack) for update-only loops.
+
+Telemetry (always-on counters in the global ``obs`` registry): ``dispatch.aot_compiles``,
+``dispatch.aot_cache_hits``, ``dispatch.aot_fallbacks``, ``dispatch.donated_steps``,
+``dispatch.buffered_flushes``; the per-step host-overhead timer ``dispatch.host_overhead``
+records (while tracing is enabled) the wall time a fast step spends OUTSIDE the compiled
+executable.
+
+Opt-outs: ``TM_TPU_FAST_DISPATCH=0`` disables the AOT tier (jit paths remain),
+``TM_TPU_DONATION=0`` keeps AOT but disables donation.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchmetrics_tpu.obs import telemetry
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+ENV_FAST_DISPATCH = "TM_TPU_FAST_DISPATCH"
+ENV_DONATION = "TM_TPU_DONATION"
+_FALSY = frozenset(
+    v
+    for base in ("0", "false", "no", "off")
+    for v in (base, base.upper(), base.capitalize())
+)
+
+
+def fast_dispatch_enabled() -> bool:
+    """AOT fast dispatch is opt-out: on unless ``TM_TPU_FAST_DISPATCH`` is falsy.
+
+    Deliberately one dict lookup — this runs once per forward step.
+    """
+    return os.environ.get(ENV_FAST_DISPATCH, "1") not in _FALSY
+
+
+def donation_enabled() -> bool:
+    """Buffer donation is opt-out: on unless ``TM_TPU_DONATION`` is falsy."""
+    return os.environ.get(ENV_DONATION, "1") not in _FALSY
+
+
+def leaf_signature(leaves: List[Any]) -> Tuple:
+    """Hashable abstract signature of a flat leaf list (shape, dtype, weak-type per leaf).
+
+    Only computed on the SLOW path (first call per shape, or after an aval mismatch);
+    steady-state steps never pay for it — they key on the pytree structure alone and let
+    the executable's own aval check catch shape drift. Dtype objects are kept raw
+    (``np.dtype`` hashes fast; ``str(dtype)`` measured ~10x slower per leaf).
+    """
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            # non-array leaf (str/None/object): not AOT-compilable — poison the signature
+            # with the value's type so the builder fails fast and the caller falls back
+            sig.append((type(leaf).__name__,))
+            continue
+        sig.append((shape, dtype, bool(getattr(leaf, "weak_type", False))))
+    return tuple(sig)
+
+
+def _cpp_call(compiled: Any) -> Callable:
+    """The executable's cached C++ fast call — what ``Compiled.__call__`` builds lazily on
+    its first invocation, resolved eagerly so steady-state steps skip the lazy-init check
+    and one Python frame. Falls back to the ``Compiled`` object itself (same semantics)."""
+    try:
+        call = compiled._executable.create_cpp_call(
+            compiled._no_kwargs, compiled.in_tree, compiled.out_tree
+        )
+        return call if call is not None else compiled
+    except Exception:  # pragma: no cover - private-API drift: __call__ still works
+        return compiled
+
+
+def aot_compile(fn: Callable, example_args: Tuple, donate_argnums: Tuple[int, ...] = ()):
+    """``jax.jit(fn).lower(*example).compile()`` with the compile counted in telemetry.
+
+    Returns the ``Compiled`` executable. ``example_args`` are concrete arrays (or
+    ``ShapeDtypeStruct``s) fixing the abstract signature; donation is declared here so the
+    executable aliases the donated inputs into its outputs.
+    """
+    import jax
+
+    lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*example_args)
+    compiled = lowered.compile()
+    telemetry.counter("dispatch.aot_compiles").inc()
+    return compiled
+
+
+class AotEntry:
+    """One compiled step executable plus the layout facts needed to call it flat."""
+
+    __slots__ = ("compiled", "call", "state_names", "donated")
+
+    def __init__(self, compiled: Any, state_names: Tuple[str, ...], donated: bool) -> None:
+        self.compiled = compiled
+        self.call = _cpp_call(compiled)
+        self.state_names = state_names
+        self.donated = donated
+
+
+class FastStepCache:
+    """Cache of AOT entries: structure-keyed fast path, signature-keyed slow path.
+
+    Per-step loops have stable shapes, so the hot path checks only the input pytree
+    structure (treedef equality, one C comparison) and dispatches the last entry — the
+    executable's own aval check is the shape guard. A mismatch (new batch shape, weak→
+    strong dtype flip after the first merge) drops to the signature-keyed dict and
+    compiles at most once per distinct signature. ``broken`` latches True after a build
+    failure so a non-compilable workload pays the probe exactly once and then stays on
+    the jit path.
+    """
+
+    __slots__ = ("entries", "_last_treedef", "_last_entry", "broken", "donate")
+
+    def __init__(self, donate: bool = False) -> None:
+        self.entries: Dict[Any, AotEntry] = {}
+        self._last_treedef: Any = None
+        self._last_entry: Optional[AotEntry] = None
+        self.broken = False
+        #: donation policy the entries were built under; the owner drops the cache when its
+        #: policy flips (e.g. a metric's state becomes compute-group shared after formation)
+        self.donate = donate
+
+    def fast_entry(self, treedef: Any) -> Optional[AotEntry]:
+        """The last-dispatched entry, iff the input structure matches (hot path)."""
+        # PyTreeDef.__eq__ rejects non-PyTreeDef operands, so the None check comes first
+        if self._last_entry is not None and treedef == self._last_treedef:
+            return self._last_entry
+        return None
+
+    def keyed_entry(self, key: Any) -> Optional[AotEntry]:
+        return self.entries.get(key)
+
+    def store(self, key: Any, treedef: Any, entry: AotEntry) -> None:
+        self.entries[key] = entry
+        self._last_treedef, self._last_entry = treedef, entry
+
+    def promote(self, treedef: Any, entry: AotEntry) -> None:
+        self._last_treedef, self._last_entry = treedef, entry
+
+    def mark_broken(self) -> None:
+        self.broken = True
+        telemetry.counter("dispatch.aot_fallbacks").inc()
+
+
+def dispatch_step(
+    cache: FastStepCache,
+    builder: Callable[[List[Any], Any], AotEntry],
+    state_leaves: List[Any],
+    prefix: Tuple,
+    leaves: List[Any],
+    treedef: Any,
+) -> Tuple[AotEntry, Any]:
+    """Dispatch one fused step through the fastest matching executable.
+
+    Hot path: treedef check + one C++ executable call — no Python-side signature
+    hashing, no jit argument processing. An aval mismatch from the executable (shape
+    change, dtype flip) is caught ONLY if the state buffers are still alive (the aval
+    check runs before donation; a post-donation failure must propagate to the caller's
+    recovery) and resolved through the signature-keyed slow path, compiling on miss.
+    """
+    entry = cache.fast_entry(treedef)
+    if entry is not None:
+        try:
+            out = entry.call(*state_leaves, *prefix, *leaves)
+            telemetry.counter("dispatch.aot_cache_hits").inc()
+            return entry, out
+        except Exception:
+            if any(
+                getattr(leaf, "is_deleted", _never)() for leaf in state_leaves
+            ):  # donated and dead: not a shape miss — the caller must recover
+                raise
+    key = (treedef, leaf_signature(state_leaves), leaf_signature(leaves))
+    entry = cache.keyed_entry(key)
+    if entry is None:
+        entry = builder(leaves, treedef)
+        cache.store(key, treedef, entry)
+    else:
+        telemetry.counter("dispatch.aot_cache_hits").inc()
+        cache.promote(treedef, entry)
+    return entry, entry.call(*state_leaves, *prefix, *leaves)
+
+
+def _never() -> bool:
+    return False
+
+
+def graph_squeeze(value: Any) -> Any:
+    """Trace-time twin of ``Metric._squeeze_if_scalar``: fold the shape-(1,) squeeze into
+    the compiled program so the host never pays an eager squeeze dispatch per step."""
+    import jax.numpy as jnp
+
+    if getattr(value, "shape", None) == (1,):
+        return jnp.squeeze(value)
+    return value
+
+
+def _batch_key(args: tuple, kwargs: dict) -> Tuple:
+    """Cheap structural key of one buffered batch: arity, kwarg names, leaf shapes/dtypes."""
+    return (
+        tuple((getattr(a, "shape", None), str(getattr(a, "dtype", ""))) for a in args),
+        tuple(sorted((k, getattr(v, "shape", None), str(getattr(v, "dtype", ""))) for k, v in kwargs.items())),
+    )
+
+
+class BufferedUpdater:
+    """Deferred micro-batch accumulator: stack up to ``k`` batches, flush in one launch.
+
+    Returned by ``Metric.buffered(k)`` / ``MetricCollection.buffered(k)``. ``update``
+    appends host-side (no dispatch); when ``k`` batches are pending — or on
+    :meth:`flush` / :meth:`compute` / context exit — the stack is folded through the
+    target's ``update_batches`` (the compiled ``update_scan`` program) in one launch.
+
+    While batches are pending, the target's state is stale mid-flight: the wrapped
+    metrics guard direct ``update``/``forward``/``compute``/``metric_state`` access with
+    a clean :class:`TorchMetricsUserError` until the buffer flushes. A shape/structure
+    change between buffered batches flushes the pending stack first (stacking requires
+    uniform shapes), so ragged tails degrade gracefully instead of erroring.
+    """
+
+    def __init__(self, target: Any, k: int) -> None:
+        if int(k) < 1:
+            raise ValueError(f"buffered(k) needs k >= 1, got {k}")
+        self._target = target
+        self._k = int(k)
+        self._pending: List[Tuple[tuple, dict]] = []
+        self._pending_key: Optional[Tuple] = None
+
+    # ------------------------------------------------------------------ target plumbing
+    def _metrics(self) -> List[Any]:
+        values = getattr(self._target, "values", None)
+        if callable(values):  # MetricCollection
+            return list(self._target.values(copy_state=False))
+        return [self._target]
+
+    def _set_pending(self, n: int) -> None:
+        for m in self._metrics():
+            object.__setattr__(m, "_buffered_pending", n)
+
+    # -------------------------------------------------------------------------- protocol
+    @property
+    def pending(self) -> int:
+        """Number of batches buffered and not yet flushed."""
+        return len(self._pending)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Buffer one batch; flushes automatically when ``k`` batches are pending."""
+        key = _batch_key(args, kwargs)
+        if self._pending and key != self._pending_key:
+            self.flush()
+        self._pending_key = key
+        self._pending.append((args, kwargs))
+        self._set_pending(len(self._pending))
+        if len(self._pending) >= self._k:
+            self.flush()
+
+    def flush(self) -> None:
+        """Fold every pending batch into the target state with one scan launch."""
+        if not self._pending:
+            return
+        import jax.numpy as jnp
+
+        batches = self._pending
+        self._pending = []
+        self._pending_key = None
+        self._set_pending(0)
+        if len(batches) == 1:
+            args, kwargs = batches[0]
+            self._target.update(*args, **kwargs)
+        else:
+            first_args, first_kwargs = batches[0]
+            stacked_args = tuple(
+                jnp.stack([b[0][i] for b in batches]) for i in range(len(first_args))
+            )
+            stacked_kwargs = {
+                name: jnp.stack([b[1][name] for b in batches]) for name in first_kwargs
+            }
+            self._target.update_batches(*stacked_args, **stacked_kwargs)
+        telemetry.counter("dispatch.buffered_flushes").inc()
+
+    def compute(self) -> Any:
+        """Flush pending batches, then compute the target."""
+        self.flush()
+        return self._target.compute()
+
+    def reset(self) -> None:
+        """Drop pending batches and reset the target."""
+        self._pending.clear()
+        self._pending_key = None
+        self._set_pending(0)
+        self._target.reset()
+
+    def __enter__(self) -> "BufferedUpdater":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if exc_type is None:
+            self.flush()
+        else:  # an erroring loop must not flush half a window into the state
+            self._pending.clear()
+            self._pending_key = None
+            self._set_pending(0)
+        return False
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+
+def guard_buffered_pending(metric: Any, op: str) -> None:
+    """Raise cleanly when ``metric`` is touched while a BufferedUpdater holds its batches."""
+    pending = metric.__dict__.get("_buffered_pending", 0)
+    if pending:
+        raise TorchMetricsUserError(
+            f"Cannot run {op!r} on {type(metric).__name__}: {pending} batch(es) are pending"
+            " in a buffered accumulator, so the metric state is stale mid-flight. Call"
+            " flush() on the buffer (or use its compute(), which flushes first)."
+        )

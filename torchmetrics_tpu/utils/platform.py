@@ -25,9 +25,16 @@ from typing import Dict, Iterable, Optional
 _PROBE_CACHE: Dict[str, bool] = {}
 
 
+# Platforms whose probe-failure note was already printed this process: the memoised probe
+# answers instantly on every later resolve, and re-printing "failed its health probe" once
+# per entry point turned bench stderr into a wall of the same line (r05 artifacts).
+_SKIP_NOTED: set = set()
+
+
 def probe_cache_clear() -> None:
     """Drop all memoised probe results (tests / long-lived drivers that must re-check)."""
     _PROBE_CACHE.clear()
+    _SKIP_NOTED.clear()
 
 
 def _telemetry():
@@ -91,12 +98,21 @@ def platform_responds(platform: str, timeout_s: float = 25.0, refresh: bool = Fa
 def resolve_healthy_platform(
     candidates: Iterable[str], probe_timeout_s: float = 90.0, log=None
 ) -> str:
-    """First candidate that passes :func:`platform_responds`; ``"cpu"`` when none do."""
+    """First candidate that passes :func:`platform_responds`; ``"cpu"`` when none do.
+
+    The probe-failure note prints ONCE per platform per process, rank zero only — every
+    retry still records its probe outcome in telemetry (``platform.probe.*``).
+    """
+    from torchmetrics_tpu.utils.prints import rank_zero_only
+
     for cand in candidates:
         if platform_responds(cand, probe_timeout_s):
             return cand
-        if log is not None:
-            log(f"platform {cand!r} failed its health probe — skipping")
+        if log is not None and cand not in _SKIP_NOTED:
+            _SKIP_NOTED.add(cand)
+            rank_zero_only(log)(
+                f"platform {cand!r} failed its health probe — skipping (noted once per process)"
+            )
     return "cpu"
 
 

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos clean
 
-test: jaxlint test-unit test-integration bench-smoke
+test: jaxlint test-unit test-integration bench-smoke chaos
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -21,7 +21,7 @@ bench-smoke:
 	python bench.py --smoke > /tmp/tm_bench_smoke.json
 	python -c "import json; d=[l for l in open('/tmp/tm_bench_smoke.json').read().strip().splitlines() if l][-1]; p=json.loads(d); assert 'metric' in p and 'extras' in p, p; print('bench-smoke ok:', p['metric'])"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU006, docs/static-analysis.md): exits
+# static JAX/TPU hazard analysis (rules TPU001-TPU008, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`
 jaxlint:
@@ -31,6 +31,12 @@ jaxlint:
 # trace exported and schema-checked (also runs as part of test-integration / the tier-1 lane)
 telemetry-smoke:
 	TM_TPU_TELEMETRY=1 python -m pytest tests/integrations/test_telemetry_smoke.py -q
+
+# fault-injection lane (docs/robustness.md): drives every recovery latch — forced AOT
+# compile failure, post-donation dispatch death, collective timeout, preemption,
+# NaN-poisoned batches — under a FIXED seed and asserts recovery to bit-identical state
+chaos:
+	TM_TPU_CHAOS_SEED=1234 python -m pytest tests/unittests/robust -q
 
 clean:
 	find . -type d -name __pycache__ -prune -exec rm -rf {} +

@@ -81,3 +81,44 @@ def test_min_max_empty_update_is_noop():
     mn.update(jnp.zeros((0,)))
     mn.update(jnp.asarray([-2.0]))
     assert float(mn.compute()) == -2.0
+
+
+def test_mean_zero_observations_is_well_defined():
+    """ISSUE 4 satellite: an untouched MeanMetric computes `empty_result` (default 0.0)
+    through _safe_divide — never an epsilon-clamped quotient or a surprise NaN."""
+    import warnings
+
+    m = MeanMetric()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)  # the compute-before-update notice
+        assert float(m.compute()) == 0.0
+
+
+def test_mean_empty_result_nan_opt_in():
+    import warnings
+
+    m = MeanMetric(empty_result=float("nan"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        assert np.isnan(float(m.compute()))
+    # once fed, the configured empty_result is irrelevant
+    m.update(jnp.asarray([2.0, 4.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_mean_all_nan_ignored_hits_empty_result():
+    m = MeanMetric(nan_strategy="ignore", empty_result=0.0)
+    m.update(jnp.asarray([float("nan"), float("nan")]))  # weight stays 0 after masking
+    assert float(m.compute()) == 0.0
+
+
+def test_mean_empty_result_validation():
+    with pytest.raises(ValueError, match="empty_result"):
+        MeanMetric(empty_result="zero")
+
+
+def test_running_mean_passes_empty_result_through():
+    from torchmetrics_tpu.aggregation import RunningMean
+
+    m = RunningMean(window=2, empty_result=float("nan"))
+    assert np.isnan(float(m.compute()))

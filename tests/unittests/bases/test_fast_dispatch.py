@@ -8,6 +8,8 @@ mid-flight guard; the cached full-state-update batch-value kernel; and the obs c
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -238,6 +240,51 @@ class TestBufferedGuards:
                 raise ValueError("boom")
         assert buf.pending == 0
         assert float(m.compute()) == 0.0  # half-window was not flushed into state
+
+    def test_error_exit_warns_and_leaves_metric_usable(self):
+        """ISSUE 4 satellite: an exception inside the context must never leave the
+        pending guard armed — the discard is explicit (warning) and the metric keeps
+        working afterwards."""
+        m = SumMetric()
+        m.update(jnp.ones(4))  # pre-error content survives
+        with pytest.warns(UserWarning, match="discarded 2 pending"):
+            with pytest.raises(RuntimeError, match="loop died"):
+                with m.buffered(8) as buf:
+                    buf.update(jnp.ones(4))
+                    buf.update(jnp.ones(4))
+                    raise RuntimeError("loop died")
+        # guard disarmed: every direct operation works again
+        assert m._buffered_pending == 0
+        m.update(jnp.ones(4))
+        assert float(m.compute()) == 8.0
+        _ = m.metric_state
+
+    def test_failed_flush_on_clean_exit_disarms_guard(self):
+        m = SumMetric()
+
+        def explode(*a, **k):
+            raise RuntimeError("injected flush failure")
+
+        with pytest.raises(RuntimeError, match="injected flush failure"):
+            with m.buffered(8) as buf:
+                buf.update(jnp.ones(4))
+                buf.update(jnp.ones(4))
+                m.update_batches = explode  # the flush dispatch itself dies
+        assert m._buffered_pending == 0  # guard must not stay armed behind the error
+        del m.__dict__["update_batches"]
+        m.update(jnp.ones(4))
+        assert float(m.compute()) == 4.0
+
+    def test_error_exit_with_no_pending_does_not_warn(self):
+        m = SumMetric()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any warning would fail the test
+            with pytest.raises(ValueError, match="boom"):
+                with m.buffered(2) as buf:
+                    buf.update(jnp.ones(4))
+                    buf.update(jnp.ones(4))  # k reached -> auto-flushed, nothing pending
+                    raise ValueError("boom")
+        assert float(m.compute()) == 8.0  # flushed window kept, nothing discarded
 
     def test_collection_buffered_matches_updates(self):
         def make():

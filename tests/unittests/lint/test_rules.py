@@ -434,6 +434,72 @@ class TestTPU007DonatedRead:
         ) == []
 
 
+# ------------------------------------------------------------------------------- TPU008
+class TestTPU008BareAssertInJit:
+    def test_assert_on_traced_param_flags(self):
+        assert "TPU008" in _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                assert jnp.all(x >= 0)
+                return jnp.sqrt(x)
+            """
+        )
+
+    def test_assert_on_traced_comparison_flags(self):
+        assert "TPU008" in _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                total = jnp.sum(x)
+                assert total > 0
+                return total
+            """
+        )
+
+    def test_engine_convention_update_flags(self):
+        # _update is jitted by the Metric shell: the same no-op-validation hazard
+        assert "TPU008" in _rules(
+            """
+            class M:
+                def _update(self, state, value):
+                    assert value.sum() > 0
+                    return {"total": state["total"] + jnp.sum(value)}
+            """
+        )
+
+    def test_shape_assert_is_clean(self):
+        # static-metadata asserts are legitimate trace-time contracts
+        assert _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                assert x.ndim == 1
+                assert x.shape[0] > 0
+                return jnp.sqrt(x)
+            """
+        ) == []
+
+    def test_eager_assert_is_clean(self):
+        assert _rules(
+            """
+            def host_check(x):
+                assert np.all(np.asarray(x) >= 0)
+                return x
+            """
+        ) == []
+
+    def test_suppression_comment_waives(self):
+        assert _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                assert jnp.all(x >= 0)  # jaxlint: disable=TPU008
+                return jnp.sqrt(x)
+            """
+        ) == []
+
+
 # ------------------------------------------------------------------------------- TPU000
 def test_syntax_error_reports_tpu000():
     assert _rules("def broken(:\n") == ["TPU000"]

@@ -1,0 +1,186 @@
+"""Chaos suite: every injected fault recovers to state bit-identical with the unfaulted run.
+
+Acceptance (ISSUE 4): forced AOT compile failure, donation hazard, collective timeout
+(covered in ``test_sync_bounded.py``), preemption mid-accumulation, and NaN-poisoned
+batches each recover — or degrade with an explicit signal — to bit-identical state for
+sum/mean/max/min/cat reductions. Seed fixed via ``TM_TPU_CHAOS_SEED`` (``make chaos``).
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import CatMetric
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.robust import chaos
+
+SEED = int(os.environ.get(chaos.ENV_CHAOS_SEED, chaos.DEFAULT_SEED))
+
+
+class _ReduceProbe(Metric):
+    """Fusable probe with a configurable reduction — drives every merge-ladder branch
+    through the fast-dispatch tiers the injectors target."""
+
+    full_state_update = False
+
+    def __init__(self, fx: str, **kwargs):
+        super().__init__(**kwargs)
+        init = {"sum": 0.0, "mean": 0.0, "max": -jnp.inf, "min": jnp.inf}[fx]
+        self.add_state("acc", jnp.asarray(init, jnp.float32), dist_reduce_fx=fx)
+        self.add_state("count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self._fx = fx
+
+    def _update(self, state, value):
+        if self._fx == "max":
+            acc = jnp.maximum(state["acc"], jnp.max(value))
+        elif self._fx == "min":
+            acc = jnp.minimum(state["acc"], jnp.min(value))
+        elif self._fx == "mean":
+            acc = state["acc"] + jnp.mean(value)
+        else:
+            acc = state["acc"] + jnp.sum(value)
+        return {"acc": acc, "count": state["count"] + 1.0}
+
+    def _compute(self, state):
+        return state["acc"]
+
+
+def _batches(n=7, seed=SEED):
+    rng = np.random.RandomState(seed % (2**31))
+    return [(rng.randn(12).astype(np.float32),) for _ in range(n)]
+
+
+def _state_bytes(m):
+    return {
+        **{k: np.asarray(v).tobytes() for k, v in m._state.tensors.items()},
+        **{k: tuple(np.asarray(e).tobytes() for e in v) for k, v in m._state.lists.items()},
+    }
+
+
+def _assert_identical(faulted: Metric, clean: Metric):
+    assert _state_bytes(faulted) == _state_bytes(clean)
+    assert np.asarray(faulted.compute()).tobytes() == np.asarray(clean.compute()).tobytes()
+    assert faulted.update_count == clean.update_count
+
+
+FXES = ["sum", "mean", "max", "min"]
+
+
+class TestAotCompileFailure:
+    @pytest.mark.parametrize("fx", FXES)
+    def test_recovers_bit_identical(self, fx):
+        batches = _batches()
+        runner = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED)
+        fault_step = runner.pick_fault_step(len(batches))
+        injector = chaos.AotCompileFailure()
+        faulted = runner.run(batches, injector=injector, fault_steps=[fault_step])
+        clean = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED).run(batches)
+        assert injector.fired >= 1  # the fault actually hit the AOT probe
+        _assert_identical(faulted, clean)
+
+
+class TestDonationHazard:
+    @pytest.mark.parametrize("fx", FXES)
+    def test_recovers_bit_identical(self, fx):
+        batches = _batches()
+        runner = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED)
+        fault_step = runner.pick_fault_step(len(batches))
+        injector = chaos.DonationHazard()
+        faulted = runner.run(batches, injector=injector, fault_steps=[fault_step])
+        clean = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED).run(batches)
+        assert injector.fired >= 1
+        _assert_identical(faulted, clean)
+
+    def test_engine_reset_is_detected_and_replayed(self):
+        """At steady state the hazard kills donated buffers: the engine resets to defaults
+        with its explicit warning, and the harness must replay from the snapshot."""
+        batches = _batches()
+        runner = chaos.ChaosRunner(lambda: _ReduceProbe("sum"), seed=SEED)
+        injector = chaos.DonationHazard()
+        faulted = runner.run(batches, injector=injector, fault_steps=[3])
+        clean = chaos.ChaosRunner(lambda: _ReduceProbe("sum"), seed=SEED).run(batches)
+        assert injector.fired == 1
+        assert runner.replays >= 1  # silent defaults-reset would otherwise corrupt the sum
+        _assert_identical(faulted, clean)
+
+
+class TestPreemption:
+    @pytest.mark.parametrize("fx", FXES)
+    def test_preempt_between_update_and_compute(self, fx):
+        batches = _batches()
+        runner = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED)
+        preempt_at = runner.pick_fault_step(len(batches))
+        faulted = runner.run(batches, preempt_steps=[preempt_at])
+        clean = chaos.ChaosRunner(lambda: _ReduceProbe(fx), seed=SEED).run(batches)
+        _assert_identical(faulted, clean)
+
+    def test_preempt_cat_reduction(self):
+        batches = _batches()
+        runner = chaos.ChaosRunner(CatMetric, seed=SEED)
+        faulted = runner.run(batches, preempt_steps=[2, 4])
+        clean = chaos.ChaosRunner(CatMetric, seed=SEED).run(batches)
+        _assert_identical(faulted, clean)
+
+
+class TestNaNPoison:
+    @pytest.mark.parametrize("fx", FXES)
+    def test_masked_run_matches_zeroed_reference(self, fx):
+        poisoner = chaos.NaNPoison(seed=SEED, rate=0.15)
+        poisoned, zeroed = poisoner.poison(_batches())
+        assert poisoner.poisoned_elements >= 1
+        masked = _ReduceProbe(fx, nan_policy="mask")
+        reference = _ReduceProbe(fx)
+        for p, z in zip(poisoned, zeroed):
+            masked(*p)
+            reference(*z)
+        assert np.asarray(masked.compute()).tobytes() == np.asarray(reference.compute()).tobytes()
+        assert masked.nan_poison_count == poisoner.poisoned_elements
+
+    def test_cat_reduction_masked(self):
+        poisoner = chaos.NaNPoison(seed=SEED + 1, rate=0.2)
+        poisoned, zeroed = poisoner.poison(_batches(5))
+        # nan_strategy="ignore": the aggregator's own host-side NaN warning would fire on
+        # the raw batch before the in-graph mask runs; the guard leaves no NaN to drop
+        masked = CatMetric(nan_strategy="ignore", nan_policy="mask")
+        reference = CatMetric(nan_strategy="ignore")
+        for p, z in zip(poisoned, zeroed):
+            masked.update(*p)
+            reference.update(*z)
+        assert np.asarray(masked.compute()).tobytes() == np.asarray(reference.compute()).tobytes()
+        assert masked.nan_poison_count == poisoner.poisoned_elements
+
+    def test_raise_policy_signals_explicitly(self):
+        from torchmetrics_tpu.utils.exceptions import NumericPoisonError
+
+        poisoner = chaos.NaNPoison(seed=SEED, rate=0.3)
+        poisoned, _ = poisoner.poison(_batches(3))
+        m = _ReduceProbe("sum", nan_policy="raise")
+        for p in poisoned:
+            m(*p)  # hot path never raises
+        with pytest.raises(NumericPoisonError):
+            m.compute()
+
+
+class TestCounterAuditTrail:
+    def test_counters_and_bench_extras_record_the_run(self):
+        before = chaos.counters()
+        batches = _batches()
+        runner = chaos.ChaosRunner(lambda: _ReduceProbe("sum"), seed=SEED)
+        runner.run(batches, injector=chaos.DonationHazard(), fault_steps=[2])
+        after = chaos.counters()
+        assert after["robust.injected_faults"] > before["robust.injected_faults"]
+        assert after["robust.recovered"] > before["robust.recovered"]
+        assert after["robust.snapshots"] > before["robust.snapshots"]
+        extras = obs.bench_extras()
+        for key in ("robust_injected_faults", "robust_recovered", "robust_degraded_syncs"):
+            assert key in extras
+        assert extras["robust_injected_faults"] == after["robust.injected_faults"]
+
+    def test_runner_is_deterministic_for_a_seed(self):
+        r1 = chaos.ChaosRunner(lambda: _ReduceProbe("sum"), seed=77)
+        r2 = chaos.ChaosRunner(lambda: _ReduceProbe("sum"), seed=77)
+        assert [r1.pick_fault_step(9) for _ in range(4)] == [r2.pick_fault_step(9) for _ in range(4)]

@@ -1,0 +1,217 @@
+"""Snapshot/restore suite: bit-identical round-trips and hard rejection of bad blobs.
+
+Acceptance (ISSUE 4): the round-trip is bit-identical across dispatch tiers (jit, AOT,
+buffered); corrupted/version-mismatched blobs are rejected with a clear error; mid-flight
+and buffered-pending snapshots raise cleanly; ``MetricCollection`` round-trips including
+compute-group re-aliasing.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.robust import checkpoint
+from torchmetrics_tpu.utils.exceptions import SnapshotError
+
+NUM_CLASSES = 5
+
+
+def _state_bytes(m):
+    return {
+        **{k: np.asarray(v).tobytes() for k, v in m._state.tensors.items()},
+        **{k: tuple(np.asarray(e).tobytes() for e in v) for k, v in m._state.lists.items()},
+    }
+
+
+def _batches(n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(8).astype(np.float32) for _ in range(n)]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("tier", ["aot", "jit", "buffered"])
+    def test_bit_identical_across_tiers(self, tier):
+        m = MeanMetric()
+        if tier == "jit":
+            m.fast_dispatch = False
+        if tier == "buffered":
+            with m.buffered(2) as buf:
+                for b in _batches():
+                    buf.update(b)
+        else:
+            for b in _batches():
+                m(b)
+        blob = m.snapshot()
+        fresh = MeanMetric()
+        fresh.restore(blob)
+        assert _state_bytes(fresh) == _state_bytes(m)
+        assert fresh.update_count == m.update_count
+        assert np.asarray(fresh.compute()).tobytes() == np.asarray(m.compute()).tobytes()
+
+    def test_restored_metric_keeps_accumulating_identically(self):
+        a, b = SumMetric(), SumMetric()
+        stream = _batches(6, seed=3)
+        for x in stream[:3]:
+            a(x)
+            b(x)
+        blob = a.snapshot()
+        a2 = SumMetric()
+        a2.restore(blob)
+        for x in stream[3:]:
+            a2(x)
+            b(x)
+        assert np.asarray(a2.compute()).tobytes() == np.asarray(b.compute()).tobytes()
+
+    def test_list_state_round_trip(self):
+        m = CatMetric()
+        m.update(np.array([1.0, 2.0], np.float32))
+        m.update(np.array([3.0], np.float32))
+        blob = m.snapshot()
+        fresh = CatMetric()
+        fresh.restore(blob)
+        assert np.array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+    def test_blob_is_picklable_and_survives_pickling(self):
+        m = SumMetric()
+        m(np.ones(4, np.float32))
+        blob = pickle.loads(pickle.dumps(m.snapshot()))
+        fresh = SumMetric()
+        fresh.restore(blob)
+        assert float(fresh.compute()) == 4.0
+
+    def test_snapshot_survives_donation_of_source_buffers(self):
+        """The blob is host numpy: later donated steps must not invalidate it."""
+        m = SumMetric()
+        m(np.ones(4, np.float32))
+        blob = m.snapshot()
+        gen = blob["state_generation"]
+        for _ in range(3):
+            m(np.ones(4, np.float32))  # donated steps delete the old device buffers
+        assert m.state_generation > gen or not m._jit_cache  # donation advanced (or env off)
+        fresh = SumMetric()
+        fresh.restore(blob)
+        assert float(fresh.compute()) == 4.0
+
+
+class TestRejection:
+    def _blob(self):
+        m = MeanMetric()
+        m(np.ones(4, np.float32))
+        return m, m.snapshot()
+
+    def test_crc_mismatch_rejected(self):
+        _, blob = self._blob()
+        blob["tensors"]["mean_value"] = blob["tensors"]["mean_value"] + 1.0
+        with pytest.raises(SnapshotError, match="checksum"):
+            MeanMetric().restore(blob)
+
+    def test_version_mismatch_rejected(self):
+        _, blob = self._blob()
+        blob["version"] = 999
+        with pytest.raises(SnapshotError, match="version"):
+            MeanMetric().restore(blob)
+
+    def test_wrong_format_rejected(self):
+        with pytest.raises(SnapshotError, match="format"):
+            MeanMetric().restore({"format": "something-else"})
+        with pytest.raises(SnapshotError, match="format"):
+            MeanMetric().restore("not a blob")
+
+    def test_wrong_class_rejected(self):
+        _, blob = self._blob()
+        with pytest.raises(SnapshotError, match="restored into"):
+            SumMetric().restore(blob)
+
+    def test_state_name_mismatch_rejected(self):
+        _, blob = self._blob()
+        blob["tensors"]["rogue"] = blob["tensors"].pop("weight")
+        blob["crc"] = checkpoint._checksum(blob["tensors"], blob["lists"])
+        with pytest.raises(SnapshotError, match="registered states"):
+            MeanMetric().restore(blob)
+
+    def test_shape_mismatch_rejected(self):
+        _, blob = self._blob()
+        blob["tensors"]["mean_value"] = np.zeros((3,), np.float32)
+        blob["crc"] = checkpoint._checksum(blob["tensors"], blob["lists"])
+        with pytest.raises(SnapshotError, match="shape/dtype"):
+            MeanMetric().restore(blob)
+
+
+class TestCrashConsistency:
+    def test_buffered_pending_snapshot_raises(self):
+        m = SumMetric()
+        buf = m.buffered(4)
+        buf.update(np.ones(4, np.float32))
+        with pytest.raises(SnapshotError, match="pending"):
+            m.snapshot()
+        buf.flush()
+        m.snapshot()  # consistent again after the flush
+
+    def test_mid_flight_snapshot_raises(self):
+        m = SumMetric()
+        m(np.ones(4, np.float32))
+        m._state.begin_donated_dispatch()
+        try:
+            with pytest.raises(SnapshotError, match="mid-flight"):
+                m.snapshot()
+        finally:
+            m._state.abort_donated()
+        m.snapshot()
+
+
+class TestCollectionRoundTrip:
+    def _make(self):
+        return MetricCollection([
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+        ])
+
+    def _feed(self, mc, n=4, seed=11):
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            p = rng.randint(0, NUM_CLASSES, 32).astype(np.int32)
+            t = rng.randint(0, NUM_CLASSES, 32).astype(np.int32)
+            mc.update(p, t)
+
+    def test_collection_round_trip_bit_identical(self):
+        mc = self._make()
+        self._feed(mc)
+        blob = mc.snapshot()
+        fresh = self._make()
+        fresh.update(np.zeros(4, np.int32), np.zeros(4, np.int32))  # form groups first
+        fresh.restore(blob)
+        ref, got = mc.compute(), fresh.compute()
+        for k in ref:
+            assert np.asarray(ref[k]).tobytes() == np.asarray(got[k]).tobytes(), k
+
+    def test_collection_restore_realigns_compute_groups(self):
+        mc = self._make()
+        self._feed(mc)
+        blob = mc.snapshot()
+        fresh = self._make()
+        self._feed(fresh, n=2, seed=99)  # different content, groups formed
+        fresh.restore(blob)
+        # group members must alias the (restored) leader arrays again
+        for cg in fresh._groups.values():
+            leader = fresh._modules[cg[0]]
+            for name in cg[1:]:
+                member = fresh._modules[name]
+                for s in leader._state.tensors:
+                    assert member._state.tensors[s] is leader._state.tensors[s]
+        ref, got = mc.compute(), fresh.compute()
+        for k in ref:
+            assert np.asarray(ref[k]).tobytes() == np.asarray(got[k]).tobytes(), k
+
+    def test_collection_member_mismatch_rejected(self):
+        mc = self._make()
+        self._feed(mc)
+        blob = mc.snapshot()
+        other = MetricCollection([SumMetric()])
+        with pytest.raises(SnapshotError, match="members"):
+            other.restore(blob)

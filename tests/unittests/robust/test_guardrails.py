@@ -1,0 +1,240 @@
+"""Numeric-guardrail suite: the ``nan_policy`` matrix across every dispatch tier.
+
+Pins the contract of ``torchmetrics_tpu.robust.guardrails``: in-graph counting/masking
+(bit-identical with a host-side zeroed reference), policy behaviour at ``compute()``
+(raise/warn/mask), the hot-path no-host-sync guarantee, and tier equivalence
+(eager jit / AOT fast dispatch / update_scan / buffered).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, obs
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.robust import guardrails
+from torchmetrics_tpu.utils.exceptions import NumericPoisonError, TorchMetricsUserWarning
+
+
+class _SumProbe(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, value):
+        return {"total": state["total"] + jnp.sum(value), "count": state["count"] + 1.0}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+def _poisoned_batch():
+    return np.array([1.0, np.nan, 3.0, np.inf, 5.0], np.float32)
+
+
+def _zeroed_batch():
+    return np.array([1.0, 0.0, 3.0, 0.0, 5.0], np.float32)
+
+
+class TestPolicyMatrix:
+    def test_propagate_is_default_and_noop(self):
+        m = _SumProbe()
+        assert m.nan_policy == "propagate"
+        assert guardrails.POISON_STATE not in m._state.tensors
+        m.update(_poisoned_batch())
+        assert np.isnan(float(m.compute()))
+        assert m.nan_poison_count == 0
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="nan_policy"):
+            _SumProbe(nan_policy="explode")
+
+    def test_raise_defers_to_compute(self):
+        m = _SumProbe(nan_policy="raise")
+        m.update(_poisoned_batch())  # the hot path never raises
+        m.update(np.ones(5, np.float32))
+        with pytest.raises(NumericPoisonError, match="2 non-finite"):
+            m.compute()
+
+    def test_warn_computes_with_warning(self):
+        m = _SumProbe(nan_policy="warn")
+        m.update(_poisoned_batch())
+        with pytest.warns(TorchMetricsUserWarning, match="non-finite"):
+            val = m.compute()
+        assert np.isnan(float(val))  # warn does not mask; the value is what it is
+
+    def test_mask_neutralises_and_counts(self):
+        m = _SumProbe(nan_policy="mask")
+        clean = _SumProbe()
+        m.update(_poisoned_batch())
+        clean.update(_zeroed_batch())
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(clean.compute()))
+        assert m.nan_poison_count == 2
+
+    def test_reset_clears_poison(self):
+        m = _SumProbe(nan_policy="mask")
+        m.update(_poisoned_batch())
+        assert m.nan_poison_count == 2
+        m.reset()
+        assert m.nan_poison_count == 0
+
+    def test_clean_inputs_never_flag(self):
+        m = _SumProbe(nan_policy="raise")
+        for _ in range(4):
+            m.update(np.ones(5, np.float32))
+        assert float(m.compute()) == 20.0
+        assert m.nan_poison_count == 0
+
+
+class TestTierEquivalence:
+    """The guardrail must count/mask identically in every dispatch tier."""
+
+    def _batches(self, n=6):
+        rng = np.random.RandomState(7)
+        out = []
+        for i in range(n):
+            b = rng.randn(8).astype(np.float32)
+            if i % 2:
+                b[i % 8] = np.nan
+            out.append(b)
+        return out
+
+    def test_forward_fast_vs_jit_vs_eager(self):
+        fast = _SumProbe(nan_policy="mask")
+        jit_ = _SumProbe(nan_policy="mask")
+        jit_.fast_dispatch = False
+        eager = _SumProbe(nan_policy="mask")
+        eager._jit_cache["forward_fusable"] = False
+        for b in self._batches():
+            vf, vj, ve = fast(b), jit_(b), eager(b)
+            assert np.array_equal(np.asarray(vf), np.asarray(vj))
+            assert np.array_equal(np.asarray(vf), np.asarray(ve))
+        assert fast.nan_poison_count == jit_.nan_poison_count == eager.nan_poison_count == 3
+
+    def test_update_scan_and_buffered_count_poison(self):
+        stack = np.stack(self._batches())
+        scanned = _SumProbe(nan_policy="mask")
+        scanned.update_batches(jnp.asarray(stack))
+        stepped = _SumProbe(nan_policy="mask")
+        for b in self._batches():
+            stepped.update(b)
+        buffered = _SumProbe(nan_policy="mask")
+        with buffered.buffered(3) as buf:
+            for b in self._batches():
+                buf.update(b)
+        assert scanned.nan_poison_count == stepped.nan_poison_count == buffered.nan_poison_count == 3
+        for name in stepped._state.tensors:
+            assert np.array_equal(
+                np.asarray(scanned._state.tensors[name]), np.asarray(stepped._state.tensors[name])
+            ), name
+            assert np.array_equal(
+                np.asarray(buffered._state.tensors[name]), np.asarray(stepped._state.tensors[name])
+            ), name
+
+    def test_cat_metric_masks_list_state(self):
+        m = CatMetric(nan_strategy="ignore", nan_policy="mask")
+        m.update(np.array([1.0, np.nan, 2.0], np.float32))
+        out = np.asarray(m.compute())
+        assert np.array_equal(out, np.array([1.0, 0.0, 2.0], np.float32))
+        assert m.nan_poison_count == 1
+
+
+class TestHotPathContract:
+    def test_no_host_sync_in_update_or_forward(self, monkeypatch):
+        """The ONE deferred host read happens at compute(), never per step."""
+        m = _SumProbe(nan_policy="mask")
+        m(np.ones(8, np.float32))  # compile outside the counted window
+        reads = []
+        real = jax.device_get
+        monkeypatch.setattr(jax, "device_get", lambda x: (reads.append(1), real(x))[1])
+        for _ in range(5):
+            m(np.ones(8, np.float32))
+            m.update(np.ones(8, np.float32))
+        assert reads == []
+        m.compute()
+        assert len(reads) >= 1
+
+    def test_full_state_slow_dance_survives_poison_raise(self):
+        """The snapshot/restore dance of a non-fusable full-state forward must restore
+        the global state even when the batch-local poison check raises mid-dance."""
+
+        class _FullState(Metric):
+            full_state_update = True
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+            def _update(self, state, value):
+                return {"total": state["total"] + jnp.sum(value)}
+
+            def _compute(self, state):
+                return state["total"]
+
+        m = _FullState(nan_policy="raise")
+        m._jit_cache["batch_value_fusable"] = False  # pin the snapshot/restore dance
+        m(np.ones(4, np.float32))
+        with pytest.raises(NumericPoisonError):
+            m(_poisoned_batch())  # the dance's batch-local compute() fires the check
+        # global state restored, not stranded on the reset batch-only state
+        assert m.update_count == 2
+        assert m.nan_poison_count == 2  # the poisoned batch is counted in global state
+        m.reset()
+        m(np.ones(4, np.float32))
+        assert float(m.compute()) == 4.0
+
+    def test_integer_inputs_pass_untouched(self):
+        class _IntProbe(Metric):
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+            def _update(self, state, value):
+                return {"total": state["total"] + jnp.sum(value.astype(jnp.float32))}
+
+            def _compute(self, state):
+                return state["total"]
+
+        m = _IntProbe(nan_policy="raise")
+        m.update(np.array([1, 2, 3], np.int32))
+        assert float(m.compute()) == 6.0
+
+
+class TestCollectionAndObs:
+    def test_collection_group_forward_counts_poison(self):
+        mc = MetricCollection({
+            "a": _SumProbe(nan_policy="mask"),
+            "b": _SumProbe(nan_policy="mask"),
+        })
+        b = _poisoned_batch()
+        mc(b)  # formation forward
+        mc(b)  # fused group forward
+        vals = mc.compute()
+        assert set(vals) == {"a", "b"}
+        for m in mc.values(copy_state=False):
+            assert m.nan_poison_count == 4  # 2 per batch, 2 batches, shared state
+
+    def test_obs_counter_bumps_on_detection(self):
+        c0 = obs.telemetry.counter("robust.nonfinite_detected").value
+        m = _SumProbe(nan_policy="warn")
+        m.update(_poisoned_batch())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.compute()
+        assert obs.telemetry.counter("robust.nonfinite_detected").value == c0 + 2
+
+    def test_mean_metric_with_mask_policy(self):
+        m = MeanMetric(nan_strategy="ignore", nan_policy="mask")
+        m.update(np.array([2.0, np.nan, 4.0], np.float32))
+        # the guard zeroes the NaN before MeanMetric's own nan handling sees it, so the
+        # zero participates with weight 1: mean(2, 0, 4)
+        assert float(m.compute()) == pytest.approx(2.0)
+        assert m.nan_poison_count == 1

@@ -1,0 +1,122 @@
+"""Bounded multi-process sync: deadline, exponential backoff, retry, degraded mode.
+
+Drives ``process_sync``'s bounding machinery with injected gathers (the chaos
+``CollectiveTimeout``), both directly and end-to-end through ``Metric.compute()`` with a
+``dist_sync_fn`` — the same seam the reference's DDP tests inject through.
+"""
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.robust import chaos
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+FAST = sync_mod.SyncOptions(timeout_s=0.5, retries=1, backoff_s=0.01, degraded_mode=True)
+STRICT = sync_mod.SyncOptions(timeout_s=0.5, retries=1, backoff_s=0.01, degraded_mode=False)
+
+
+def _state():
+    return {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"}
+
+
+class TestBoundedProcessSync:
+    def test_unbounded_default_is_passthrough(self):
+        state, red = _state()
+        out = sync_mod.process_sync(state, red)
+        assert float(out["total"]) == 5.0
+        assert out.world_consistent
+
+    def test_retry_recovers_from_transient_failure(self):
+        state, red = _state()
+        gather = chaos.CollectiveTimeout(fail_attempts=1, hang_s=None)
+        c0 = obs.telemetry.counter("robust.sync_retries").value
+        out = sync_mod.process_sync(state, red, gather_fn=gather, options=FAST)
+        assert out.world_consistent
+        assert float(out["total"]) == 5.0
+        assert gather.calls == 2  # failed once, succeeded on retry
+        assert obs.telemetry.counter("robust.sync_retries").value == c0 + 1
+
+    def test_exhaustion_degrades_to_local_state(self):
+        state, red = _state()
+        gather = chaos.CollectiveTimeout(fail_attempts=99, hang_s=None)
+        c0 = obs.telemetry.counter("robust.degraded_syncs").value
+        with pytest.warns(UserWarning, match="non-world-consistent"):
+            out = sync_mod.process_sync(state, red, gather_fn=gather, options=FAST)
+        assert not out.world_consistent
+        assert out.degraded_states == ("total",)
+        assert float(out["total"]) == 5.0  # local value survived
+        assert obs.telemetry.counter("robust.degraded_syncs").value == c0 + 1
+
+    def test_exhaustion_raises_when_degraded_mode_off(self):
+        state, red = _state()
+        gather = chaos.CollectiveTimeout(fail_attempts=99, hang_s=None)
+        with pytest.raises(SyncTimeoutError, match="total"):
+            sync_mod.process_sync(state, red, gather_fn=gather, options=STRICT)
+
+    def test_hung_gather_does_not_wedge_the_caller(self):
+        """A gather that sleeps past the deadline is abandoned, not joined forever."""
+        state, red = _state()
+
+        def hanging(value, group=None, **kw):
+            time.sleep(5.0)
+            return [value]
+
+        opts = sync_mod.SyncOptions(timeout_s=0.15, retries=0, backoff_s=0.01, degraded_mode=True)
+        t0 = time.monotonic()
+        with pytest.warns(UserWarning, match="non-world-consistent"):
+            out = sync_mod.process_sync(state, red, gather_fn=hanging, options=opts)
+        assert time.monotonic() - t0 < 2.0  # bounded, nowhere near the 5 s hang
+        assert not out.world_consistent
+
+    def test_list_state_degrades_to_local_entries(self):
+        state = {"vals": [jnp.asarray([1.0, 2.0], jnp.float32)]}
+        red = {"vals": "cat"}
+        gather = chaos.CollectiveTimeout(fail_attempts=99, hang_s=None)
+        with pytest.warns(UserWarning, match="non-world-consistent"):
+            out = sync_mod.process_sync(state, red, gather_fn=gather, options=FAST)
+        assert not out.world_consistent
+        assert np.array_equal(np.asarray(out["vals"][0]), np.array([1.0, 2.0], np.float32))
+
+    def test_env_options_parse(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_TIMEOUT, "1.5")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_RETRIES, "4")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_BACKOFF, "0.2")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_DEGRADED, "off")
+        opts = sync_mod.sync_options_from_env()
+        assert opts.timeout_s == 1.5 and opts.retries == 4
+        assert opts.backoff_s == 0.2 and not opts.degraded_mode
+        assert opts.bounded
+
+
+class TestMetricLevelDegradation:
+    def test_compute_survives_dead_peer_and_flags_inconsistency(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_TIMEOUT, "0.3")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_RETRIES, "1")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_BACKOFF, "0.01")
+        gather = chaos.CollectiveTimeout(fail_attempts=99, hang_s=None)
+        m = SumMetric(dist_sync_fn=gather, distributed_available_fn=lambda: True)
+        m.update(np.ones(4, np.float32))
+        assert m.world_consistent
+        with pytest.warns(UserWarning, match="non-world-consistent"):
+            val = m.compute()
+        assert float(val) == 4.0  # local state, not a hang and not garbage
+        assert not m.world_consistent
+        m.reset()
+        assert m.world_consistent
+
+    def test_compute_recovers_via_retry(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_TIMEOUT, "0.5")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_RETRIES, "2")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_BACKOFF, "0.01")
+        gather = chaos.CollectiveTimeout(fail_attempts=1, hang_s=None)
+        m = SumMetric(dist_sync_fn=gather, distributed_available_fn=lambda: True)
+        m.update(np.ones(4, np.float32))
+        assert float(m.compute()) == 4.0
+        assert m.world_consistent  # the straggler answered on retry

@@ -1,0 +1,136 @@
+"""Uneven-shape pad-and-mask gather coverage (``parallel/sync.py``).
+
+The multi-process eager gather handles ragged per-replica dim-0 sizes by
+gather-shapes → pad-to-capacity → allgather → trim (reference ``distributed.py:97-147``).
+The real 2-process drive lives in the slow lane (``test_multiprocess_sync.py``); this
+suite pins the pad/trim arithmetic itself — ragged lengths, empty shards, >1-D payloads,
+and the cat-reduction assembly in ``process_sync`` — by emulating a 3-process world at
+the ``process_allgather`` seam, so the logic is exercised in the default (fast) lane.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import multihost_utils
+
+from torchmetrics_tpu.parallel import sync as sync_mod
+
+
+class _FakeWorld:
+    """Emulate ``jax.process_count``/``process_allgather`` for rank 0 of an N-rank world.
+
+    ``rank_arrays[0]`` must equal the local value handed to ``gather_all_arrays``; the
+    fake allgather pads every rank's array to the incoming (already padded) capacity and
+    stacks — byte-compatible with ``multihost_utils.process_allgather`` output.
+    """
+
+    def __init__(self, rank_arrays: List[np.ndarray]) -> None:
+        self.ranks = [np.asarray(a) for a in rank_arrays]
+
+    def process_allgather(self, local):
+        local = np.asarray(local)
+        if local.dtype == np.int32 and local.ndim == 1:  # the shape gather
+            return np.stack([np.asarray(r.shape, np.int32) for r in self.ranks])
+        if local.ndim == 0:  # scalar payload: no dim-0 to pad
+            return np.stack(self.ranks)
+        out = []
+        for r in self.ranks:
+            pad = local.shape[0] - r.shape[0]
+            out.append(np.pad(r, [(0, pad)] + [(0, 0)] * (r.ndim - 1)))
+        return np.stack(out)
+
+    def install(self, monkeypatch) -> None:
+        monkeypatch.setattr(jax, "process_count", lambda: len(self.ranks))
+        monkeypatch.setattr(multihost_utils, "process_allgather", self.process_allgather)
+
+
+class TestPadAndTrimGather:
+    def test_ragged_lengths_round_trip(self, monkeypatch):
+        ranks = [
+            np.array([0.0, 1.0], np.float32),
+            np.array([10.0, 11.0, 12.0, 13.0], np.float32),
+            np.array([20.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        got = sync_mod.gather_all_arrays(jnp.asarray(ranks[0]))
+        assert len(got) == 3
+        for g, r in zip(got, ranks):
+            assert np.array_equal(np.asarray(g), r)  # padded, gathered, trimmed exactly
+
+    def test_empty_local_shard(self, monkeypatch):
+        ranks = [
+            np.zeros((0,), np.float32),
+            np.array([5.0, 6.0], np.float32),
+            np.array([7.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        got = sync_mod.gather_all_arrays(jnp.asarray(ranks[0]))
+        assert np.asarray(got[0]).shape == (0,)
+        assert np.array_equal(np.asarray(got[1]), ranks[1])
+        assert np.array_equal(np.asarray(got[2]), ranks[2])
+
+    def test_empty_remote_shard(self, monkeypatch):
+        ranks = [
+            np.array([1.0, 2.0], np.float32),
+            np.zeros((0,), np.float32),
+            np.array([3.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        got = sync_mod.gather_all_arrays(jnp.asarray(ranks[0]))
+        assert np.asarray(got[1]).shape == (0,)
+        assert np.array_equal(np.asarray(got[0]), ranks[0])
+
+    def test_multidim_payload_pads_dim0_only(self, monkeypatch):
+        ranks = [
+            np.arange(6, dtype=np.float32).reshape(2, 3),
+            np.arange(9, dtype=np.float32).reshape(3, 3) + 100,
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        got = sync_mod.gather_all_arrays(jnp.asarray(ranks[0]))
+        for g, r in zip(got, ranks):
+            assert np.array_equal(np.asarray(g), r)
+
+    def test_scalar_payload(self, monkeypatch):
+        ranks = [np.float32(3.0), np.float32(4.0)]
+        _FakeWorld(ranks).install(monkeypatch)
+        got = sync_mod.gather_all_arrays(jnp.asarray(3.0, jnp.float32))
+        assert [float(g) for g in got] == [3.0, 4.0]
+
+
+class TestCatSyncAssembly:
+    def test_process_sync_cat_state_ragged(self, monkeypatch):
+        """End to end: ragged list-state entries concatenate in rank order."""
+        local = [jnp.asarray([0.0, 1.0], jnp.float32)]
+        ranks = [
+            np.array([0.0, 1.0], np.float32),
+            np.array([100.0, 101.0, 102.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        out = sync_mod.process_sync({"vals": local}, {"vals": "cat"})
+        flat = np.concatenate([np.asarray(v) for v in out["vals"]])
+        assert np.array_equal(flat, np.array([0.0, 1.0, 100.0, 101.0, 102.0], np.float32))
+
+    def test_process_sync_tensor_cat_ragged(self, monkeypatch):
+        ranks = [
+            np.array([1.0], np.float32),
+            np.array([2.0, 3.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        out = sync_mod.process_sync({"vals": jnp.asarray(ranks[0])}, {"vals": "cat"})
+        assert np.array_equal(np.asarray(out["vals"]), np.array([1.0, 2.0, 3.0], np.float32))
+
+    def test_process_sync_empty_local_cat_list(self, monkeypatch):
+        """An empty local shard still participates: the zeros((0,)) placeholder is padded,
+        gathered, and trimmed away while the peers' entries survive."""
+        ranks = [
+            np.zeros((0,), np.float32),
+            np.array([9.0, 8.0], np.float32),
+        ]
+        _FakeWorld(ranks).install(monkeypatch)
+        out = sync_mod.process_sync({"vals": []}, {"vals": "cat"})
+        flat = np.concatenate([np.asarray(v) for v in out["vals"]]) if out["vals"] else np.zeros(0)
+        assert np.array_equal(flat, np.array([9.0, 8.0], np.float32))

@@ -318,6 +318,7 @@ def _spec():
     spec["__version__"] = None
     spec["functional"] = None
     spec["obs"] = None             # telemetry subsystem, not a metric (tests: bases/test_telemetry.py)
+    spec["robust"] = None          # fault-tolerance subsystem, not a metric (tests: robust/)
     return spec, mextra
 
 

@@ -11,7 +11,7 @@ Usage::
     python -m torchmetrics_tpu._lint torchmetrics_tpu            # lint the package
     make jaxlint                                                 # CI gate (strict baseline)
 
-Rules TPU001–TPU006 are documented with bad/good examples in ``docs/static-analysis.md``;
+Rules TPU001–TPU008 are documented with bad/good examples in ``docs/static-analysis.md``;
 per-line suppression is ``# jaxlint: disable=TPU00X``.
 """
 from torchmetrics_tpu._lint.baseline import (

@@ -31,7 +31,7 @@ def _default_paths() -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m torchmetrics_tpu._lint",
-        description="jaxlint: AST-based JAX/TPU hazard analyzer (rules TPU001-TPU006)",
+        description="jaxlint: AST-based JAX/TPU hazard analyzer (rules TPU001-TPU008)",
     )
     parser.add_argument("paths", nargs="*", help="files/directories to lint (default: the package)")
     parser.add_argument("--format", choices=("text", "json", "sarif"), default="text")

@@ -1,4 +1,4 @@
-"""jaxlint rule registry: the six TPU hazard rules over a shared per-module inference pass.
+"""jaxlint rule registry: the TPU hazard rules over a shared per-module inference pass.
 
 All rules consume one :class:`_ModuleModel` built per file:
 
@@ -27,6 +27,7 @@ TPU004    jit wrap leaving str/bool config parameters non-static (retrace churn)
 TPU005    ``add_state`` reduction/dtype mismatch (overflow, non-additive sum)
 TPU006    fresh ``jnp`` constant built inside a per-step hot path (re-upload)
 TPU007    value read after being donated to a compiled dispatch (deleted buffer)
+TPU008    bare ``assert`` on a traced value inside jit (a validation no-op)
 ========  ======================================================================
 """
 from __future__ import annotations
@@ -46,6 +47,7 @@ RULES: Dict[str, str] = {
     "TPU005": "add_state reduction/dtype mismatch (overflow or non-additive update)",
     "TPU006": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
     "TPU007": "value read after being donated to a compiled dispatch (deleted buffer)",
+    "TPU008": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
 }
 
 # wrapper callables whose function arguments execute under tracing
@@ -910,9 +912,39 @@ def _rule_tpu007(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+def _rule_tpu008(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Bare ``assert`` whose test depends on a traced value, inside a jit context.
+
+    Such an assert cannot validate anything at runtime: if the test stays abstract it
+    either fails at trace time (TracerBoolConversionError — a crash, not a check) or, when
+    the expression constant-folds, is baked away entirely; and under ``python -O`` asserts
+    vanish altogether. Shape/dtype asserts (static metadata) are trace-time checks and
+    stay clean.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        if not info.jit:
+            continue
+        traced, jit_callables = model.traced_names(info)
+        if not traced:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Assert):
+                continue
+            if _branches_on_traced(node.test, traced, jit_callables):
+                out.append(_finding(
+                    "TPU008", path, node, lines,
+                    f"bare `assert` on a traced value inside jit-traced {info.name!r} — the"
+                    " test is compiled away (or crashes the trace), so it validates nothing"
+                    " at runtime; hoist the check to the eager host path or fold it into the"
+                    " graph (jnp.where / a counted guard state)",
+                ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
-    _rule_tpu007,
+    _rule_tpu007, _rule_tpu008,
 )
 
 

@@ -19,6 +19,7 @@ from jax import Array
 
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.checks import is_traced
+from torchmetrics_tpu.utils.compute import _safe_divide
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 from torchmetrics_tpu.wrappers.running import Running as _Running
@@ -186,6 +187,12 @@ class CatMetric(BaseAggregator):
 class MeanMetric(BaseAggregator):
     """Weighted running mean of a stream of values (reference ``aggregation.py:493``).
 
+    ``empty_result`` defines ``compute()`` on zero observations (an untouched metric, or
+    one whose every input was NaN-masked away): the division routes through
+    ``_safe_divide`` so a zero total weight yields ``empty_result`` exactly — ``0.0`` by
+    default, or ``float("nan")`` for reference-torchmetrics semantics — instead of an
+    epsilon-clamped quotient.
+
     Example:
         >>> import numpy as np
         >>> from torchmetrics_tpu.aggregation import MeanMetric
@@ -194,10 +201,20 @@ class MeanMetric(BaseAggregator):
         >>> metric.update(np.array([2.0, 3.0]))
         >>> float(metric.compute())
         2.0
+        >>> float(MeanMetric().compute())  # zero observations: well-defined, not NaN
+        0.0
     """
 
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+    def __init__(
+        self,
+        nan_strategy: Union[str, float] = "warn",
+        empty_result: float = 0.0,
+        **kwargs: Any,
+    ) -> None:
         super().__init__("sum", jnp.asarray(0.0, jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        if not isinstance(empty_result, (int, float)):
+            raise ValueError(f"Arg `empty_result` should be a float (0.0 or nan), but got {empty_result!r}")
+        self.empty_result = float(empty_result)
         self.add_state("weight", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
 
     def _update(self, state: Dict[str, Array], value: Array, weight: Optional[Array] = None) -> Dict[str, Array]:
@@ -218,7 +235,9 @@ class MeanMetric(BaseAggregator):
         }
 
     def _compute(self, state: Dict[str, Any]) -> Array:
-        return state["mean_value"] / jnp.maximum(state["weight"], 1e-38)
+        # _safe_divide, not an epsilon clamp: weight == 0 (zero observations) returns
+        # `empty_result` exactly, and tiny-but-real weights divide undistorted
+        return _safe_divide(state["mean_value"], state["weight"], zero_division=self.empty_result)
 
 
 class RunningMean(_Running):

@@ -132,9 +132,10 @@ class MetricCollection:
                 defaults = {k: leader._defaults[k] for k in leader._state.tensors}
                 reductions = {k: leader._reductions[k] for k in leader._state.tensors}
                 computes = [(name, m._compute) for name, m in members]
+                upd = leader._effective_update()
 
                 def step(global_tensors, n, *f_args, _computes=tuple(computes), **f_kwargs):
-                    batch_out = leader._update(dict(defaults), *f_args, **f_kwargs)
+                    batch_out = upd(dict(defaults), *f_args, **f_kwargs)
                     batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
                     vals = {name: compute(batch_state) for name, compute in _computes}
                     merged = leader._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
@@ -187,12 +188,13 @@ class MetricCollection:
         reductions = {k: leader._reductions[k] for k in names}
         computes = tuple((name, m._compute) for name, m in members)
         n_state = len(names)
+        upd = leader._effective_update()
 
         def step_flat(*leaves):
             st = dict(zip(names, leaves[:n_state]))
             n = leaves[n_state]
             f_args, f_kwargs = tree_unflatten(treedef, leaves[n_state + 1 :])
-            batch_out = leader._update(dict(defaults), *f_args, **f_kwargs)
+            batch_out = upd(dict(defaults), *f_args, **f_kwargs)
             batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
             vals = {name: _dispatch.graph_squeeze(compute(batch_state)) for name, compute in computes}
             merged = leader._merge_tensor_ladder(st, batch_out, defaults, reductions, n)
@@ -386,9 +388,9 @@ class MetricCollection:
                 defaults = {k: leader._defaults[k] for k in leader._state.tensors}
                 f_kwargs = leader._filter_kwargs(**kwargs)
 
-                def body(st, batch, _leader=leader):
+                def body(st, batch, _upd=leader._effective_update()):
                     b_args, b_kw = batch
-                    out = _leader._update(st, *b_args, **b_kw)
+                    out = _upd(st, *b_args, **b_kw)
                     return {k: out.get(k, st[k]) for k in st}, None
 
                 final, _ = jax.lax.scan(body, defaults, (args, f_kwargs))
@@ -672,6 +674,23 @@ class MetricCollection:
         for name, m in self.items(keep_base=True, copy_state=False):
             m.state_dict(destination=destination, prefix=f"{name}.")
         return destination
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Durable host-side blob of every member's full state (see ``Metric.snapshot``).
+
+        Compute-group members alias their leader's arrays, so member blobs within a group
+        hold identical (numpy-copied) payloads; :meth:`restore` re-establishes the aliasing.
+        """
+        from torchmetrics_tpu.robust import checkpoint as _ckpt
+
+        return _ckpt.snapshot_collection(self)
+
+    def restore(self, blob: Dict[str, Any]) -> None:
+        """Restore every member from a :meth:`snapshot` blob (validated per member) and
+        re-alias compute-group state to the freshly restored leader buffers."""
+        from torchmetrics_tpu.robust import checkpoint as _ckpt
+
+        _ckpt.restore_collection(self, blob)
 
     def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
         for name, m in self.items(keep_base=True, copy_state=False):

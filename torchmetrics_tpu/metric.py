@@ -45,9 +45,15 @@ from jax import Array
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.parallel.sync import process_sync
+from torchmetrics_tpu.robust import checkpoint as _checkpoint
+from torchmetrics_tpu.robust import guardrails as _guardrails
 from torchmetrics_tpu.utils.checks import is_traced
 from torchmetrics_tpu.utils.data import dim_zero_cat
-from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.exceptions import (
+    NumericPoisonError,
+    TorchMetricsUserError,
+    TorchMetricsUserWarning,
+)
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -176,6 +182,7 @@ class Metric:
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         if not isinstance(self.compute_with_cache, bool):
             raise ValueError("Expected keyword argument `compute_with_cache` to be a `bool`")
+        self._nan_policy = _guardrails.validate_policy(kwargs.pop("nan_policy", "propagate"))
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -198,6 +205,12 @@ class Metric:
         self._jit_cache: Dict[str, Any] = {}
         self._buffered_pending = 0  # batches held by a BufferedUpdater (state stale until flush)
         self._state_shared = False  # True while compute-group members alias this state (gates donation)
+        self._world_consistent = True  # False after a degraded (local-only) multi-process sync
+        if self._nan_policy != "propagate":
+            # in-graph poison counter rides the normal state machinery: sum-reduced, reset
+            # with reset(), donated/scanned/buffered like any accumulator — update/forward
+            # never touch the host over it (the single deferred read happens at compute())
+            self.add_state(_guardrails.POISON_STATE, jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         # telemetry (obs): always-on integer counts + (when tracing) accumulated wall times
         self._tm_counts: Dict[str, int] = {}
         self._tm_times: Dict[str, float] = {}
@@ -325,12 +338,23 @@ class Metric:
         raise NotImplementedError
 
     # ------------------------------------------------------------------ engine
+    def _effective_update(self) -> Callable:
+        """The update kernel every dispatch tier builds from: ``_update`` itself, or —
+        when a ``nan_policy`` is active — its in-graph numeric guardrail wrapper
+        (non-finite counting + optional masking, traced into the same XLA program; see
+        ``torchmetrics_tpu.robust.guardrails``). Resolved once per kernel build, so the
+        disabled path costs nothing per step."""
+        if self._nan_policy == "propagate":
+            return self._update
+        return _guardrails.guarded_update(self._update, self._nan_policy)
+
     def _jitted_update(self) -> Callable:
         fn = self._jit_cache.get("update")
         if fn is None:
+            upd = self._effective_update()
             # the trace hook fires once per XLA compilation (jit only executes the Python
             # body on a cache miss) — the retrace/recompile-churn counter costs nothing per call
-            fn = jax.jit(obs.instrument_trace(self._update, self, "update")) if self.jit_update else self._update
+            fn = jax.jit(obs.instrument_trace(upd, self, "update")) if self.jit_update else upd
             self._jit_cache["update"] = fn
         return fn
 
@@ -436,10 +460,12 @@ class Metric:
             return
         scan_fn = self._jit_cache.get("update_scan")
         if scan_fn is None:
+            upd = self._effective_update()
+
             def _scan(tensors: Dict[str, Array], stacked_args: tuple, stacked_kwargs: dict):
                 def body(st, batch):
                     b_args, b_kwargs = batch
-                    out = self._update(st, *b_args, **b_kwargs)
+                    out = upd(st, *b_args, **b_kwargs)
                     return {k: out.get(k, st[k]) for k in st}, None
                 final, _ = jax.lax.scan(body, tensors, (stacked_args, stacked_kwargs))
                 return final
@@ -461,6 +487,7 @@ class Metric:
 
         names = tuple(self._state.tensors)
         n_state = len(names)
+        upd = self._effective_update()
 
         def scan_flat(*leaves):
             st = dict(zip(names, leaves[:n_state]))
@@ -468,7 +495,7 @@ class Metric:
 
             def body(s, batch):
                 b_args, b_kwargs = batch
-                out = self._update(s, *b_args, **b_kwargs)
+                out = upd(s, *b_args, **b_kwargs)
                 return {k: out.get(k, s[k]) for k in s}, None
 
             final, _ = jax.lax.scan(body, st, (s_args, s_kwargs))
@@ -617,9 +644,10 @@ class Metric:
             fn = self._jit_cache.get("batch_value")
             if fn is None:
                 defaults = {k: self._defaults[k] for k in self._state.tensors}
+                upd = self._effective_update()
 
                 def batch_value(*b_args, **b_kwargs):
-                    out = self._update(dict(defaults), *b_args, **b_kwargs)
+                    out = upd(dict(defaults), *b_args, **b_kwargs)
                     st = {k: out.get(k, defaults[k]) for k in defaults}
                     return _dispatch.graph_squeeze(self._compute(st))
 
@@ -635,16 +663,20 @@ class Metric:
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
         self.reset()
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-        # restore global state
-        self._state.restore(cache)
-        self._update_count = update_count
-        self._is_synced = False
-        self._should_unsync = True
-        self._to_sync = self.sync_on_compute
-        self._computed = None
-        self._update_called = True
+        try:
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+        finally:
+            # restore global state even when the batch-local compute raises (e.g. a
+            # nan_policy="raise" poison check): the dance must never strand the metric
+            # on the reset batch-only state
+            self._state.restore(cache)
+            self._update_count = update_count
+            self._is_synced = False
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self._update_called = True
         return batch_val
 
     def _fusable_forward(self) -> bool:
@@ -703,9 +735,10 @@ class Metric:
         if fn is None:
             defaults = {k: self._defaults[k] for k in self._state.tensors}
             reductions = {k: self._reductions[k] for k in self._state.tensors}
+            upd = self._effective_update()
 
             def step(global_tensors, n, *args, **kwargs):
-                batch_out = self._update(dict(defaults), *args, **kwargs)
+                batch_out = upd(dict(defaults), *args, **kwargs)
                 batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
                 batch_val = self._compute(batch_state)
                 merged = self._merge_tensor_ladder(global_tensors, batch_out, defaults, reductions, n)
@@ -760,12 +793,13 @@ class Metric:
         defaults = {k: self._defaults[k] for k in names}
         reductions = {k: self._reductions[k] for k in names}
         n_state = len(names)
+        upd = self._effective_update()
 
         def step_flat(*leaves):
             st = dict(zip(names, leaves[:n_state]))
             n = leaves[n_state]
             f_args, f_kwargs = tree_unflatten(treedef, leaves[n_state + 1 :])
-            batch_out = self._update(dict(defaults), *f_args, **f_kwargs)
+            batch_out = upd(dict(defaults), *f_args, **f_kwargs)
             batch_state = {k: batch_out.get(k, defaults[k]) for k in defaults}
             batch_val = _dispatch.graph_squeeze(self._compute(batch_state))
             merged = self._merge_tensor_ladder(st, batch_out, defaults, reductions, n)
@@ -906,6 +940,8 @@ class Metric:
         synced = process_sync(
             self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn, group=process_group
         )
+        # a bounded sync may have degraded to local-only state (docs/robustness.md)
+        self._world_consistent = bool(getattr(synced, "world_consistent", True))
         for name in list(self._state.tensors):
             self._state.tensors[name] = synced[name]
         for name in list(self._state.lists):
@@ -993,6 +1029,7 @@ class Metric:
         obs.bump(self, "compute_calls")
         if self.compute_with_cache and self._computed is not None:
             return self._computed
+        self._guard_poison()
         obs.count_dispatch(self)
         with obs.metric_span(self, "compute"):
             with self.sync_context(
@@ -1022,6 +1059,77 @@ class Metric:
         self._state.maybe_aliased = True  # tensors alias the defaults again
         self._cache = None
         self._is_synced = False
+        self._world_consistent = True
+
+    # -------------------------------------------------------------- fault tolerance
+    @property
+    def nan_policy(self) -> str:
+        """Active numeric guardrail policy (``propagate``/``raise``/``warn``/``mask``)."""
+        return self._nan_policy
+
+    @property
+    def nan_poison_count(self) -> int:
+        """Non-finite input values detected by the in-graph guardrail so far.
+
+        Always 0 with ``nan_policy="propagate"`` (no counter state exists). This is the
+        ONE deliberate host read of the poison accumulator — ``update``/``forward`` only
+        ever touch it in-graph.
+        """
+        if self._nan_policy == "propagate":
+            return 0
+        self._state.guard_readable()
+        return int(jax.device_get(self._state.tensors[_guardrails.POISON_STATE]))
+
+    def _guard_poison(self) -> None:
+        """Deferred numeric-guardrail check at finalisation (docs/robustness.md)."""
+        policy = self._nan_policy
+        if policy == "propagate":
+            return
+        cnt = self.nan_poison_count
+        if not cnt:
+            return
+        obs.telemetry.counter("robust.nonfinite_detected").inc(cnt)
+        msg = (
+            f"{type(self).__name__} accumulated {cnt} non-finite input value(s)"
+            f" (nan_policy={policy!r})."
+        )
+        if policy == "raise":
+            raise NumericPoisonError(
+                msg + " The accumulator state is poisoned; reset() or restore() a clean snapshot."
+            )
+        if policy == "warn":
+            rank_zero_warn(
+                msg + " The computed value may be numerically poisoned.", TorchMetricsUserWarning
+            )
+        # "mask": the values never reached the accumulators; the count is informational
+
+    @property
+    def world_consistent(self) -> bool:
+        """False when the last multi-process sync degraded to local-only state.
+
+        Set by ``process_sync`` running with a bounded :class:`SyncOptions` whose
+        deadline/retry budget was exhausted under ``degraded_mode``; reset() restores True.
+        """
+        return self.__dict__.get("_world_consistent", True)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Durable, versioned, CRC-checksummed host-side state blob (full fidelity).
+
+        Unlike :meth:`state_dict` (torchmetrics checkpoint parity: persistent states
+        only), this captures every state as numpy plus the update count and state
+        generation — see ``torchmetrics_tpu.robust.checkpoint`` and ``docs/robustness.md``.
+        Raises :class:`~torchmetrics_tpu.utils.exceptions.SnapshotError` mid-flight or
+        with buffered batches pending.
+        """
+        return _checkpoint.snapshot_metric(self)
+
+    def restore(self, blob: Dict[str, Any]) -> None:
+        """Restore state from a :meth:`snapshot` blob, validating format/version/CRC.
+
+        Bit-identical round-trip across dispatch tiers; rejects corrupted or
+        version-mismatched blobs with :class:`SnapshotError`.
+        """
+        _checkpoint.restore_metric(self, blob)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self.forward(*args, **kwargs)

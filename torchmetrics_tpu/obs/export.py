@@ -120,6 +120,12 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "buffered_flushes": counters.get("dispatch.buffered_flushes", 0),
         "sync_state_traces": counters.get("sync.sync_state.traces", 0),
         "process_sync_calls": counters.get("sync.process_sync.calls", 0),
+        # robustness layer (docs/robustness.md): chaos-injected fault/recovery audit trail
+        # plus degraded (local-only) sync fallbacks — a bench that ran through faults or
+        # lost world consistency must say so in its own JSON
+        "robust_injected_faults": counters.get("robust.injected_faults", 0),
+        "robust_recovered": counters.get("robust.recovered", 0),
+        "robust_degraded_syncs": counters.get("robust.degraded_syncs", 0),
         "device_transfers": counters.get("transfer.device_put", 0)
         + counters.get("transfer.host_to_device", 0),
         "events_recorded": snap["events_recorded"],

@@ -312,21 +312,46 @@ class BufferedUpdater:
 
     def reset(self) -> None:
         """Drop pending batches and reset the target."""
+        self._discard()
+        self._target.reset()
+
+    def _discard(self) -> int:
+        """Drop pending batches and disarm the stale-state guard; returns the drop count."""
+        n = len(self._pending)
         self._pending.clear()
         self._pending_key = None
         self._set_pending(0)
-        self._target.reset()
+        if n:
+            telemetry.counter("dispatch.buffered_discards").inc(n)
+        return n
 
     def __enter__(self) -> "BufferedUpdater":
         return self
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        """Flush on clean exit; discard-and-warn on error exit.
+
+        Either way the pending guard is DISARMED before control leaves the block — an
+        exception (from the loop body, or from the flush itself) must never leave the
+        metric latched unusable behind the buffered-pending guard.
+        """
         if exc_type is None:
-            self.flush()
-        else:  # an erroring loop must not flush half a window into the state
-            self._pending.clear()
-            self._pending_key = None
-            self._set_pending(0)
+            try:
+                self.flush()
+            except BaseException:
+                self._discard()  # a failed flush must not leave the guard armed
+                raise
+            return False
+        dropped = self._discard()  # an erroring loop must not flush half a window into the state
+        if dropped:
+            from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"BufferedUpdater context exited with {exc_type.__name__}: discarded"
+                f" {dropped} pending batch(es). The metric state holds only the batches"
+                " flushed before the error; the metric remains usable.",
+                UserWarning,
+            )
         return False
 
     def __len__(self) -> int:

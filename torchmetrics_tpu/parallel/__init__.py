@@ -22,17 +22,23 @@ Three sync contexts are supported:
    torch.distributed-style one-replica-per-process layout.
 """
 from torchmetrics_tpu.parallel.sync import (
+    SyncedState,
+    SyncOptions,
     all_gather_object_shapes,
     gather_all_arrays,
     process_sync,
+    sync_options_from_env,
     sync_state,
 )
 from torchmetrics_tpu.parallel.mesh import local_mesh
 
 __all__ = [
+    "SyncOptions",
+    "SyncedState",
     "sync_state",
     "gather_all_arrays",
     "process_sync",
+    "sync_options_from_env",
     "all_gather_object_shapes",
     "local_mesh",
 ]

@@ -15,8 +15,11 @@ uneven shapes        gather sizes → pad → gather → trim         static pad
 """
 from __future__ import annotations
 
+import dataclasses
+import os
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,8 +27,118 @@ import numpy as np
 from jax import Array, lax
 
 from torchmetrics_tpu import obs
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 ReduceFx = Union[str, Callable, None]
+
+# ------------------------------------------------------------------ bounded-sync options
+ENV_SYNC_TIMEOUT = "TM_TPU_SYNC_TIMEOUT_S"
+ENV_SYNC_RETRIES = "TM_TPU_SYNC_RETRIES"
+ENV_SYNC_BACKOFF = "TM_TPU_SYNC_BACKOFF_S"
+ENV_SYNC_DEGRADED = "TM_TPU_SYNC_DEGRADED"
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncOptions:
+    """Bounding policy for the eager multi-process sync path (``process_sync``).
+
+    ``timeout_s == 0`` (the default) disables bounding entirely — gathers run inline on
+    the calling thread with zero added overhead, exactly the pre-PR-4 behaviour. With a
+    positive timeout each gather runs on a worker thread against a *whole-sync* deadline;
+    a timed-out or crashed gather is retried up to ``retries`` times with exponential
+    backoff (``backoff_s * 2**attempt``), and on exhaustion the sync either falls back to
+    the local state (``degraded_mode=True``: result marked non-world-consistent, rank-zero
+    warning, ``robust.degraded_syncs`` counter) or raises :class:`SyncTimeoutError`.
+    """
+
+    timeout_s: float = 0.0
+    retries: int = 2
+    backoff_s: float = 0.05
+    degraded_mode: bool = True
+
+    @property
+    def bounded(self) -> bool:
+        return self.timeout_s > 0
+
+
+def sync_options_from_env() -> SyncOptions:
+    """Build :class:`SyncOptions` from the ``TM_TPU_SYNC_*`` environment knobs."""
+
+    def _f(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
+    return SyncOptions(
+        timeout_s=_f(ENV_SYNC_TIMEOUT, 0.0),
+        retries=int(_f(ENV_SYNC_RETRIES, 2)),
+        backoff_s=_f(ENV_SYNC_BACKOFF, 0.05),
+        degraded_mode=str(os.environ.get(ENV_SYNC_DEGRADED, "1")).strip().lower()
+        not in ("0", "false", "no", "off"),
+    )
+
+
+class SyncedState(dict):
+    """``process_sync`` result: a plain state dict plus world-consistency metadata.
+
+    ``world_consistent`` is False when any state fell back to its local value because the
+    collective could not complete within its deadline; ``degraded_states`` names them.
+    """
+
+    world_consistent: bool = True
+    degraded_states: Tuple[str, ...] = ()
+
+
+def _bounded_gather(
+    gather: Callable, value: Any, group: Optional[str], kw: Dict[str, Any],
+    opts: SyncOptions, deadline: float, state_name: str,
+) -> List[Any]:
+    """Run one gather against the sync deadline, retrying with exponential backoff.
+
+    The gather runs on a daemon worker thread so a peer that never answers cannot wedge
+    the training process — the thread is abandoned at timeout (there is no portable way
+    to cancel a blocked collective; abandonment + retry/degrade is the honest contract).
+    Raises :class:`SyncTimeoutError` when the deadline/retry budget is exhausted.
+    """
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise SyncTimeoutError(
+                f"sync of state {state_name!r} exhausted its {opts.timeout_s:g}s deadline"
+                f" after {attempt} attempt(s)"
+            )
+        result: List[Any] = []
+        error: List[BaseException] = []
+        done = threading.Event()
+
+        def _work() -> None:
+            try:
+                result.append(gather(value, group, **kw))
+            except BaseException as err:  # noqa: BLE001 - must cross the thread boundary
+                error.append(err)
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=_work, daemon=True, name="tm-tpu-sync-gather")
+        worker.start()
+        finished = done.wait(remaining)
+        if finished and result:
+            return result[0]
+        attempt += 1
+        obs.telemetry.counter("robust.sync_retries").inc()
+        if attempt > opts.retries:
+            detail = f"last error: {error[0]!r}" if (finished and error) else "gather hung past the deadline"
+            raise SyncTimeoutError(
+                f"sync of state {state_name!r} failed after {attempt} attempt(s)"
+                f" within its {opts.timeout_s:g}s deadline ({detail})"
+            )
+        # exponential backoff, capped so the sleep never outlives the deadline
+        pause = min(opts.backoff_s * (2 ** (attempt - 1)), max(0.0, deadline - time.monotonic()))
+        if pause > 0:
+            time.sleep(pause)
 
 
 def _axis_size(axis_name: str) -> Optional[int]:
@@ -145,16 +258,24 @@ def process_sync(
     reductions: Dict[str, ReduceFx],
     gather_fn: Optional[Callable] = None,
     group: Optional[str] = None,
-) -> Dict[str, Any]:
+    options: Optional[SyncOptions] = None,
+) -> "SyncedState":
     """Eager cross-process sync of a state dict; identity when world size is 1.
 
     A ``gather_fn`` that accepts a ``name`` keyword receives the state's name — gathers are then
     keyed by identity instead of having to match tensors by value (the reference's injected
     test gathers need this; value matching can mis-map states that happen to be equal).
+
+    With a bounded :class:`SyncOptions` (explicit argument, or the ``TM_TPU_SYNC_*`` env
+    knobs) each gather races a deadline with retry+backoff; exhausted states fall back to
+    their LOCAL value under degraded mode — the returned :class:`SyncedState` then has
+    ``world_consistent=False`` and lists them in ``degraded_states`` — or raise
+    :class:`SyncTimeoutError` when degraded mode is off. See ``docs/robustness.md``.
     """
     import inspect
 
     obs.telemetry.counter("sync.process_sync.calls").inc()
+    opts = options if options is not None else sync_options_from_env()
     t0 = time.perf_counter() if obs.telemetry.enabled else 0.0
     gather = gather_fn or gather_all_arrays
     takes_name = False
@@ -162,7 +283,15 @@ def process_sync(
         takes_name = "name" in inspect.signature(gather).parameters
     except (TypeError, ValueError):
         pass
-    out: Dict[str, Any] = {}
+    deadline = time.monotonic() + opts.timeout_s if opts.bounded else 0.0
+    degraded: List[str] = []
+
+    def run_gather(payload: Any, name: str, kw: Dict[str, Any]) -> List[Any]:
+        if not opts.bounded:
+            return gather(payload, group, **kw)
+        return _bounded_gather(gather, payload, group, kw, opts, deadline, name)
+
+    out: SyncedState = SyncedState()
     for name, value in state.items():
         fx = reductions.get(name, "sum")
         kw = {"name": name} if takes_name else {}
@@ -171,10 +300,24 @@ def process_sync(
                 out[name] = list(value)
                 continue
             cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
-            gathered = gather(cat, group, **kw)
+            try:
+                gathered = run_gather(cat, name, kw)
+            except SyncTimeoutError:
+                if not opts.degraded_mode:
+                    raise
+                degraded.append(name)
+                out[name] = list(value)
+                continue
             out[name] = [g for g in gathered]
         else:
-            gathered = gather(value, group, **kw)
+            try:
+                gathered = run_gather(value, name, kw)
+            except SyncTimeoutError:
+                if not opts.degraded_mode:
+                    raise
+                degraded.append(name)
+                out[name] = value
+                continue
             if len(gathered) == 1:
                 out[name] = gathered[0]
                 continue
@@ -195,6 +338,21 @@ def process_sync(
                 out[name] = fx(jnp.stack(gathered))
             else:
                 raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+    if degraded:
+        out.world_consistent = False
+        out.degraded_states = tuple(degraded)
+        obs.telemetry.counter("robust.degraded_syncs").inc()
+        obs.telemetry.event(
+            "sync.degraded", cat="sync",
+            args={"states": degraded, "timeout_s": opts.timeout_s, "retries": opts.retries},
+        )
+        rank_zero_warn(
+            f"process_sync degraded: state(s) {sorted(degraded)} could not be gathered within"
+            f" the {opts.timeout_s:g}s deadline ({opts.retries} retr{'y' if opts.retries == 1 else 'ies'});"
+            " falling back to LOCAL state. The next compute() reflects this process only"
+            " (non-world-consistent).",
+            UserWarning,
+        )
     if obs.telemetry.enabled:
         dur_us = (time.perf_counter() - t0) * 1e6
         try:

@@ -1,0 +1,49 @@
+"""torchmetrics_tpu.robust — fault tolerance for the metric engine.
+
+Production-scale metric accumulation fails in three characteristic ways, and this package
+owns the defence for each (ISSUE 4; full guide in ``docs/robustness.md``):
+
+- **numeric poisoning** → :mod:`~torchmetrics_tpu.robust.guardrails`: opt-in
+  ``Metric(nan_policy=...)`` with in-graph ``jnp.isfinite`` counting/masking and one
+  deferred host read at ``compute()`` — never a sync on the update/forward hot path,
+- **preemption / crashes** → :mod:`~torchmetrics_tpu.robust.checkpoint`: versioned,
+  CRC-checksummed host-side snapshots (``Metric.snapshot()`` / ``Metric.restore()``,
+  ``MetricCollection`` round-trip included), crash-consistent against buffer donation
+  and buffered accumulation,
+- **stragglers / dead peers** → bounded multi-process sync in
+  ``torchmetrics_tpu.parallel.sync`` (deadline + exponential backoff + retry, degraded
+  local-only fallback marked via ``Metric.world_consistent``),
+
+plus :mod:`~torchmetrics_tpu.robust.chaos` — the deterministic fault-injection harness
+that drives every latch and guard through its failure path (``make chaos``).
+"""
+from torchmetrics_tpu.robust import checkpoint, guardrails
+from torchmetrics_tpu.robust.checkpoint import (
+    restore_collection,
+    restore_metric,
+    snapshot_collection,
+    snapshot_metric,
+)
+from torchmetrics_tpu.robust.guardrails import POISON_STATE, POLICIES
+
+__all__ = [
+    "POISON_STATE",
+    "POLICIES",
+    "chaos",
+    "checkpoint",
+    "guardrails",
+    "restore_collection",
+    "restore_metric",
+    "snapshot_collection",
+    "snapshot_metric",
+]
+
+
+def __getattr__(name: str):
+    # the chaos harness pulls in ops.dispatch; load it lazily so importing the engine
+    # (metric.py -> robust.guardrails) never depends on the dispatch layer's import order
+    if name == "chaos":
+        import importlib
+
+        return importlib.import_module("torchmetrics_tpu.robust.chaos")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

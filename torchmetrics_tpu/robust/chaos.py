@@ -1,0 +1,305 @@
+"""Deterministic fault injection for the metric engine — the chaos harness.
+
+PR 3's fast-dispatch layer grew a set of recovery latches (AOT→jit fallback on compile
+failure, defaults-reset on mid-flight donated-dispatch death, buffered-pending guards) and
+PR 4 adds more (bounded sync with degraded mode, snapshot/restore). None of them is worth
+anything untested: a latch that has never been driven through its failure path is a latch
+that fires for the first time in production. This module makes every failure class a
+first-class, *seeded* injector:
+
+========================  ============================================================
+:class:`AotCompileFailure`  ``aot_compile`` raises → engine must latch broken and fall
+                            back to the jit tier with state intact
+:class:`DonationHazard`     dispatch dies AFTER donating (state buffers deleted) →
+                            engine must reset-to-defaults with an explicit warning;
+                            the harness restores the last snapshot and replays
+:class:`CollectiveTimeout`  a gather hangs/raises for the first N attempts → bounded
+                            sync must retry with backoff, then succeed or degrade
+:class:`NaNPoison`          seeded batch elements become NaN/Inf → ``nan_policy`` must
+                            count (and under "mask" neutralise) every one in-graph
+preemption                  :meth:`ChaosRunner.run` kills the metric instance between
+                            steps and restores a fresh one from the snapshot blob
+========================  ============================================================
+
+Injectors are context managers patching the REAL seams (``ops.dispatch.aot_compile``,
+``ops.dispatch.dispatch_step``, the metric's ``dist_sync_fn``) — no test doubles of the
+engine itself. Every firing bumps ``robust.injected_faults``; every absorbed fault bumps
+``robust.recovered`` (both embedded in ``obs.bench_extras()``), so a chaos run leaves an
+auditable counter trail.
+
+:class:`ChaosRunner` is the reference drive loop: forward a batch stream, snapshot after
+every committed step, detect a fault (exception OR the engine's mid-flight reset warning),
+restore + replay. Its contract — proven by ``tests/unittests/robust/`` — is that the final
+state is **bit-identical** to the unfaulted run for sum/mean/max/min/cat reductions.
+"""
+from __future__ import annotations
+
+import random
+import time
+import warnings
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+#: env knob the chaos CI lane pins (``make chaos``); tests default to it for determinism.
+ENV_CHAOS_SEED = "TM_TPU_CHAOS_SEED"
+DEFAULT_SEED = 1234
+
+
+def counters() -> Dict[str, int]:
+    """Current chaos/robustness counter values (the ``bench_extras`` trio and friends)."""
+    names = (
+        "robust.injected_faults",
+        "robust.recovered",
+        "robust.degraded_syncs",
+        "robust.sync_retries",
+        "robust.snapshots",
+        "robust.restores",
+    )
+    return {n: obs.telemetry.counter(n).value for n in names}
+
+
+@contextmanager
+def _patched(obj: Any, attr: str, value: Any) -> Iterator[None]:
+    original = getattr(obj, attr)
+    setattr(obj, attr, value)
+    try:
+        yield
+    finally:
+        setattr(obj, attr, original)
+
+
+class Injector:
+    """Base fault injector: a reusable context manager that records firings.
+
+    ``fired`` counts how many times the fault actually triggered inside the ``with`` block;
+    each firing bumps the global ``robust.injected_faults`` counter.
+    """
+
+    name = "fault"
+
+    def __init__(self) -> None:
+        self.fired = 0
+
+    def _fire(self) -> None:
+        self.fired += 1
+        obs.telemetry.counter("robust.injected_faults").inc()
+
+    def __enter__(self) -> "Injector":  # pragma: no cover - subclasses override
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+class AotCompileFailure(Injector):
+    """Force ``aot_compile`` to raise, driving the FastStepCache broken-latch jit fallback.
+
+    Steady-state steps hit cached executables and never reach the compiler, so the
+    injector also blanks the cache lookups while armed — the dispatch is forced down the
+    build path, where the injected compile failure fires and the engine must latch broken
+    and fall back to the jit tier with state intact.
+    """
+
+    name = "aot_compile_failure"
+
+    def __enter__(self) -> "AotCompileFailure":
+        def boom(*args: Any, **kwargs: Any) -> Any:
+            self._fire()
+            raise RuntimeError("chaos: injected AOT compile failure")
+
+        self._cms = [
+            _patched(_dispatch, "aot_compile", boom),
+            _patched(_dispatch.FastStepCache, "fast_entry", lambda cache, treedef: None),
+            _patched(_dispatch.FastStepCache, "keyed_entry", lambda cache, key: None),
+        ]
+        for cm in self._cms:
+            cm.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        for cm in reversed(self._cms):
+            cm.__exit__(*exc)
+        return False
+
+
+class DonationHazard(Injector):
+    """Kill a fast dispatch AFTER its state buffers were donated.
+
+    Deletes the state leaves (exactly what XLA does to donated inputs) and then raises, so
+    the engine's recovery path sees dead buffers and must reset-to-defaults with its
+    explicit mid-flight warning — the worst-case donation failure.
+    """
+
+    name = "donation_hazard"
+
+    def __enter__(self) -> "DonationHazard":
+        def sabotage(cache: Any, builder: Any, state_leaves: Any, *rest: Any) -> Any:
+            self._fire()
+            for leaf in state_leaves:
+                delete = getattr(leaf, "delete", None)
+                if callable(delete):
+                    delete()
+            raise RuntimeError("chaos: injected post-donation dispatch failure")
+
+        self._cm = _patched(_dispatch, "dispatch_step", sabotage)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return self._cm.__exit__(*exc)
+
+
+class CollectiveTimeout:
+    """A ``dist_sync_fn`` whose first ``fail_attempts`` gather calls hang (or raise).
+
+    Drives the bounded-sync deadline/retry/degraded machinery end to end. Not a patcher:
+    pass the instance as ``dist_sync_fn=...`` (or ``gather_fn``). ``hang_s=None`` raises a
+    ``TimeoutError`` immediately instead of sleeping — faster for retry-path tests.
+    """
+
+    def __init__(self, fail_attempts: int = 1, hang_s: Optional[float] = 0.25) -> None:
+        self.fail_attempts = fail_attempts
+        self.hang_s = hang_s
+        self.calls = 0
+        self.fired = 0
+
+    def __call__(self, value: Any, group: Any = None, **kwargs: Any) -> List[Any]:
+        self.calls += 1
+        if self.fired < self.fail_attempts:
+            self.fired += 1
+            obs.telemetry.counter("robust.injected_faults").inc()
+            if self.hang_s is not None:
+                time.sleep(self.hang_s)  # outlive the caller's deadline: a straggler peer
+                raise TimeoutError("chaos: straggler gather outlived its deadline")
+            raise TimeoutError("chaos: injected collective timeout")
+        return [value]  # healthy world-of-one gather
+
+
+class NaNPoison:
+    """Seeded NaN/Inf poisoning of a batch stream.
+
+    ``poison(batches)`` returns ``(poisoned, zeroed)`` where ``poisoned`` has a seeded
+    subset of float elements replaced by NaN (or ±Inf) and ``zeroed`` is the *reference*
+    stream with those same elements replaced by ``0.0`` — exactly what ``nan_policy="mask"``
+    must reduce the poisoned stream to, making bit-identical comparison meaningful.
+    """
+
+    def __init__(self, seed: int, rate: float = 0.1, values: Sequence[float] = (float("nan"), float("inf"), float("-inf"))) -> None:
+        self.rng = random.Random(seed)
+        self.rate = rate
+        self.values = tuple(values)
+        self.poisoned_elements = 0
+
+    def _poison_array(self, arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        flat = np.array(arr, dtype=np.float32).reshape(-1)
+        zeroed = flat.copy()
+        for i in range(flat.size):
+            if self.rng.random() < self.rate:
+                flat[i] = self.rng.choice(self.values)
+                zeroed[i] = 0.0
+                self.poisoned_elements += 1
+                obs.telemetry.counter("robust.injected_faults").inc()
+        return flat.reshape(arr.shape), zeroed.reshape(arr.shape)
+
+    def poison(self, batches: Sequence[Tuple[Any, ...]]) -> Tuple[List[Tuple[Any, ...]], List[Tuple[Any, ...]]]:
+        poisoned: List[Tuple[Any, ...]] = []
+        zeroed: List[Tuple[Any, ...]] = []
+        for batch in batches:
+            p_parts, z_parts = [], []
+            for part in batch:
+                arr = np.asarray(part)
+                if np.issubdtype(arr.dtype, np.floating):
+                    p, z = self._poison_array(arr)
+                else:
+                    p = z = arr
+                p_parts.append(p)
+                z_parts.append(z)
+            poisoned.append(tuple(p_parts))
+            zeroed.append(tuple(z_parts))
+        return poisoned, zeroed
+
+
+class ChaosRunner:
+    """Drive a metric through a batch stream with faults, snapshots, and replay recovery.
+
+    The drive loop is checkpoint-based crash recovery in miniature: snapshot after every
+    committed step; when a step faults — an exception escapes, or the engine's
+    "failed mid-flight" reset warning fires (state silently back at defaults) — build a
+    fresh instance via ``factory`` (the preemption model: the old process is gone), restore
+    the last snapshot, and replay the step without the fault. ``via="update"`` drives the
+    update/scan tiers instead of per-step forward.
+    """
+
+    def __init__(self, factory: Callable[[], Any], seed: Optional[int] = None) -> None:
+        self.factory = factory
+        self.seed = DEFAULT_SEED if seed is None else seed
+        self.rng = random.Random(self.seed)
+        self.faults_seen = 0
+        self.replays = 0
+
+    def pick_fault_step(self, n_batches: int) -> int:
+        """Seeded choice of the step to fault at (never the formation step 0: compute
+        groups and the first compile must already exist for the latches to matter)."""
+        return self.rng.randrange(1, max(2, n_batches))
+
+    def _step(self, metric: Any, batch: Tuple[Any, ...], via: str) -> None:
+        if via == "forward":
+            metric(*batch)
+        else:
+            metric.update(*batch)
+
+    def run(
+        self,
+        batches: Sequence[Tuple[Any, ...]],
+        injector: Optional[Injector] = None,
+        fault_steps: Sequence[int] = (),
+        preempt_steps: Sequence[int] = (),
+        via: str = "forward",
+    ) -> Any:
+        """Run the stream; returns the final metric instance (compute()-ready)."""
+        metric = self.factory()
+        snap = metric.snapshot()
+        fault_at = set(fault_steps)
+        preempt_at = set(preempt_steps)
+        for i, batch in enumerate(batches):
+            armed = injector is not None and i in fault_at
+            faulted = False
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                reset_warning_cache()  # the mid-flight warning is one-shot per process
+                try:
+                    if armed:
+                        with injector:
+                            self._step(metric, batch, via)
+                    else:
+                        self._step(metric, batch, via)
+                except Exception:
+                    faulted = True
+                if any("failed mid-flight" in str(w.message) for w in caught):
+                    # the engine absorbed a donated-dispatch death by resetting state to
+                    # defaults — usable but WRONG relative to the stream; must replay
+                    faulted = True
+            if faulted:
+                self.faults_seen += 1
+                metric = self.factory()
+                metric.restore(snap)
+                self._step(metric, batch, via)  # replay without the fault
+                self.replays += 1
+                obs.telemetry.counter("robust.recovered").inc()
+            elif armed and getattr(injector, "fired", 0):
+                # fault fired but the engine recovered transparently (e.g. AOT latch→jit)
+                obs.telemetry.counter("robust.recovered").inc()
+            if i in preempt_at:
+                # preemption between update and compute: the process dies with only the
+                # blob surviving; a fresh instance restores from it
+                blob = metric.snapshot()
+                metric = self.factory()
+                metric.restore(blob)
+            snap = metric.snapshot()
+        return metric

@@ -1,0 +1,207 @@
+"""Durable metric-state snapshots: versioned, CRC-checksummed, host-side blobs.
+
+Long-running multi-host jobs get preempted; a metric accumulated over hours of stream must
+survive the restart. ``Metric.state_dict`` (torchmetrics parity) only covers *persistent*
+states and carries no integrity information. The snapshot format here is the full-fidelity,
+crash-consistent twin:
+
+- **host-side numpy** — every tensor/list state is ``jax.device_get``'ed once, so the blob
+  survives buffer donation (device arrays snapshotted at an earlier state generation are
+  DELETED by later donated steps; numpy copies are not),
+- **structure ("treedef")** — tensor vs list split plus per-entry dtype/shape, validated on
+  restore against the receiving metric's registered states,
+- **versioned + checksummed** — ``version`` gates format evolution; ``crc`` (zlib.crc32 over
+  a canonical byte serialisation of names, dtypes, shapes, and raw array bytes) rejects
+  torn/corrupted blobs with a clear :class:`~torchmetrics_tpu.utils.exceptions.SnapshotError`
+  instead of silently restoring garbage,
+- **crash-consistent against fast dispatch** — snapshotting mid-flight (state buffers
+  donated to an in-progress dispatch) or with batches pending in a buffered accumulator
+  raises cleanly; the blob records the ``state_generation`` it was taken at.
+
+Blobs are plain dicts of numpy arrays + ints — picklable, ``np.savez``-able, JSON-able
+after a base64 hop. See ``docs/robustness.md`` for the format table.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.utils.exceptions import SnapshotError
+
+FORMAT = "tm-tpu-metric-snapshot"
+COLLECTION_FORMAT = "tm-tpu-collection-snapshot"
+VERSION = 1
+
+
+def _canonical_bytes(tensors: Dict[str, np.ndarray], lists: Dict[str, List[np.ndarray]]) -> bytes:
+    """Deterministic byte serialisation of the state payload — the CRC input.
+
+    Covers names, kinds, dtypes, shapes, AND raw array bytes, so any bit flip in either
+    metadata or data changes the checksum.
+    """
+    chunks: List[bytes] = []
+    for name in sorted(tensors):
+        arr = tensors[name]
+        chunks.append(f"T:{name}:{arr.dtype.str}:{arr.shape}".encode())
+        chunks.append(np.ascontiguousarray(arr).tobytes())
+    for name in sorted(lists):
+        chunks.append(f"L:{name}:{len(lists[name])}".encode())
+        for arr in lists[name]:
+            chunks.append(f"E:{arr.dtype.str}:{arr.shape}".encode())
+            chunks.append(np.ascontiguousarray(arr).tobytes())
+    return b"\x00".join(chunks)
+
+
+def _checksum(tensors: Dict[str, np.ndarray], lists: Dict[str, List[np.ndarray]]) -> int:
+    return zlib.crc32(_canonical_bytes(tensors, lists)) & 0xFFFFFFFF
+
+
+def snapshot_metric(metric: Any) -> Dict[str, Any]:
+    """Build a durable host-side snapshot blob of ``metric``'s full state.
+
+    Raises :class:`SnapshotError` when the state is not readable at a consistent point:
+    buffers donated to an in-flight dispatch, or batches pending in a buffered accumulator
+    (flush or discard them first — a snapshot must never capture half a window).
+    """
+    pending = metric.__dict__.get("_buffered_pending", 0)
+    if pending:
+        raise SnapshotError(
+            f"Cannot snapshot {type(metric).__name__}: {pending} batch(es) are pending in a"
+            " buffered accumulator, so the state is stale mid-window. Call flush() on the"
+            " buffer (or let its context manager exit) before snapshotting."
+        )
+    state = metric._state
+    if state.inflight:
+        raise SnapshotError(
+            f"Cannot snapshot {type(metric).__name__} mid-flight: the state buffers were"
+            " donated to an in-progress dispatch. Snapshot from the training loop, not from"
+            " callbacks that run inside a forward step."
+        )
+    # one batched transfer for the tensor states (device_get of a dict is a single fetch)
+    tensors = {k: np.asarray(v) for k, v in jax.device_get(dict(state.tensors)).items()}
+    lists = {k: [np.asarray(e) for e in jax.device_get(list(v))] for k, v in state.lists.items()}
+    obs.telemetry.counter("robust.snapshots").inc()
+    return {
+        "format": FORMAT,
+        "version": VERSION,
+        "class": type(metric).__name__,
+        "tensors": tensors,
+        "lists": lists,
+        "update_count": int(metric._update_count),
+        "update_called": bool(metric._update_called),
+        "state_generation": int(state.generation),
+        "crc": _checksum(tensors, lists),
+    }
+
+
+def _validate_blob(metric: Any, blob: Any) -> None:
+    if not isinstance(blob, dict) or blob.get("format") not in (FORMAT,):
+        raise SnapshotError(
+            f"Not a metric snapshot blob: expected format {FORMAT!r},"
+            f" got {blob.get('format') if isinstance(blob, dict) else type(blob).__name__!r}"
+        )
+    if blob.get("version") != VERSION:
+        raise SnapshotError(
+            f"Snapshot version mismatch: blob is v{blob.get('version')!r}, this build reads"
+            f" v{VERSION}. Re-snapshot with the current build (format evolution is gated on"
+            " this field precisely so stale blobs fail loudly)."
+        )
+    if blob.get("class") != type(metric).__name__:
+        raise SnapshotError(
+            f"Snapshot was taken from {blob.get('class')!r} but is being restored into"
+            f" {type(metric).__name__!r}"
+        )
+    tensors, lists = blob.get("tensors"), blob.get("lists")
+    if not isinstance(tensors, dict) or not isinstance(lists, dict):
+        raise SnapshotError("Snapshot blob is missing its tensors/lists payload")
+    crc = _checksum(
+        {k: np.asarray(v) for k, v in tensors.items()},
+        {k: [np.asarray(e) for e in v] for k, v in lists.items()},
+    )
+    if crc != blob.get("crc"):
+        raise SnapshotError(
+            f"Snapshot checksum mismatch (stored {blob.get('crc')!r}, computed {crc}):"
+            " the blob was corrupted or truncated in storage. Refusing to restore."
+        )
+    state = metric._state
+    if set(tensors) != set(state.tensors) or set(lists) != set(state.lists):
+        raise SnapshotError(
+            f"Snapshot state names do not match {type(metric).__name__}'s registered states:"
+            f" blob has tensors={sorted(tensors)} lists={sorted(lists)}, metric has"
+            f" tensors={sorted(state.tensors)} lists={sorted(state.lists)}"
+        )
+    for name, arr in tensors.items():
+        cur = state.tensors[name]
+        arr = np.asarray(arr)
+        if tuple(arr.shape) != tuple(cur.shape) or np.dtype(arr.dtype) != np.dtype(cur.dtype):
+            raise SnapshotError(
+                f"Snapshot state {name!r} has shape/dtype {arr.shape}/{arr.dtype}, metric"
+                f" expects {tuple(cur.shape)}/{cur.dtype}"
+            )
+
+
+def restore_metric(metric: Any, blob: Dict[str, Any]) -> None:
+    """Restore ``metric`` from a :func:`snapshot_metric` blob, after full validation.
+
+    Installs fresh device buffers (never aliases the blob), resets the sync/compute caches,
+    and restores the update count so mean-reduce weighting and no-update warnings stay
+    correct — bit-identical round-trip across dispatch tiers (jit, AOT+donation, buffered).
+    """
+    _validate_blob(metric, blob)
+    state = metric._state
+    for name, arr in blob["tensors"].items():
+        # preserve the registered dtype exactly (np round-trips weak-typed scalars wide)
+        state.tensors[name] = jnp.asarray(arr, state.tensors[name].dtype)
+    for name, entries in blob["lists"].items():
+        state.lists[name] = [jnp.asarray(e) for e in entries]
+    state.maybe_aliased = True  # fresh uploads may be deduped against live arrays
+    state.inflight = False
+    metric._update_count = int(blob["update_count"])
+    metric._update_called = bool(blob["update_called"])
+    metric._computed = None
+    metric._cache = None
+    metric._is_synced = False
+    obs.telemetry.counter("robust.restores").inc()
+
+
+def snapshot_collection(collection: Any) -> Dict[str, Any]:
+    """Snapshot every member of a ``MetricCollection`` under its registration name."""
+    blobs = {
+        name: snapshot_metric(m)
+        for name, m in collection.items(keep_base=True, copy_state=False)
+    }
+    return {"format": COLLECTION_FORMAT, "version": VERSION, "metrics": blobs}
+
+
+def restore_collection(collection: Any, blob: Any) -> None:
+    """Restore a collection from :func:`snapshot_collection`; members must match by name."""
+    if not isinstance(blob, dict) or blob.get("format") != COLLECTION_FORMAT:
+        raise SnapshotError(
+            f"Not a collection snapshot blob: expected format {COLLECTION_FORMAT!r},"
+            f" got {blob.get('format') if isinstance(blob, dict) else type(blob).__name__!r}"
+        )
+    if blob.get("version") != VERSION:
+        raise SnapshotError(
+            f"Collection snapshot version mismatch: blob is v{blob.get('version')!r},"
+            f" this build reads v{VERSION}"
+        )
+    members = dict(collection.items(keep_base=True, copy_state=False))
+    blobs = blob.get("metrics")
+    if not isinstance(blobs, dict) or set(blobs) != set(members):
+        got = sorted(blobs) if isinstance(blobs, dict) else blobs
+        raise SnapshotError(
+            f"Collection snapshot members {got} do not match collection members"
+            f" {sorted(members)}"
+        )
+    for name, m in members.items():
+        restore_metric(m, blobs[name])
+    # compute-group members alias their leader's arrays; re-establish the aliasing against
+    # the freshly restored leader buffers
+    if collection._enable_compute_groups and collection._groups_checked:
+        collection._state_is_copy = False
+        collection._compute_groups_create_state_ref()

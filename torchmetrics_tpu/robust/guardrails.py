@@ -1,0 +1,89 @@
+"""In-graph numeric guardrails: ``Metric(nan_policy=...)``.
+
+At production scale the dominant numeric failure is NaN/Inf poisoning: one bad batch
+(overflowed loss, a div-by-zero upstream, a corrupted shard) silently contaminates a
+sum/mean accumulator and every later ``compute()`` reports garbage. The classic guard —
+host-side ``np.isnan`` checks per batch — is exactly what this engine cannot afford: it
+forces a device→host sync on the per-step hot path (jaxlint TPU001).
+
+The guardrail here is fully in-graph. When a metric opts in (``nan_policy != "propagate"``)
+the engine routes every update through :func:`guarded_update`, which
+
+- counts non-finite values across all floating-point batch leaves with ``jnp.isfinite``
+  into an extra ``sum``-reduced state (:data:`POISON_STATE`, registered by the engine), and
+- under ``nan_policy="mask"`` additionally replaces non-finite entries with ``0.0``
+  before the metric's own ``_update`` sees them.
+
+Both operations are pure jnp and fuse into the same XLA program as the update kernel —
+across every dispatch tier (eager jit, AOT+donation, ``update_scan``, buffered). No host
+sync happens until ``compute()``, where the engine does ONE deferred ``jax.device_get``
+of the poison counter and raises/warns/reports per the policy (see ``Metric._guard_poison``
+and ``docs/robustness.md`` for the full policy matrix).
+
+Masking substitutes ``0.0`` — the identity of sums/means, but a value like any other for
+order statistics (max/min) and cat states. Metrics that need identity-element NaN handling
+(the aggregation stack's ``nan_strategy``) keep their own masking; the policies compose.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: name of the in-graph poison-counter state the engine registers when a policy is active.
+POISON_STATE = "nan_poison_total"
+
+#: accepted ``nan_policy`` values. "propagate" (default) is a true no-op: no extra state,
+#: no wrapper, no per-step cost.
+POLICIES = ("propagate", "raise", "warn", "mask")
+
+
+def validate_policy(policy: Any) -> str:
+    if policy not in POLICIES:
+        raise ValueError(f"Expected keyword argument `nan_policy` to be one of {POLICIES} but got {policy!r}")
+    return policy
+
+
+def scrub_nonfinite(args: tuple, kwargs: dict, mask: bool) -> Tuple[tuple, dict, Any]:
+    """Count (and optionally zero out) non-finite entries across all float batch leaves.
+
+    Returns ``(args, kwargs, bad_count)`` where ``bad_count`` is a float32 scalar (traced
+    inside jit, concrete eagerly). Non-float leaves (ints, bools, None, strings) pass
+    through untouched — integer arrays cannot hold NaN/Inf.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    bad = jnp.asarray(0.0, jnp.float32)
+    out = []
+    for leaf in leaves:
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+            finite = jnp.isfinite(leaf)
+            bad = bad + jnp.sum((~finite).astype(jnp.float32))
+            if mask:
+                leaf = jnp.where(finite, leaf, jnp.zeros((), dtype))
+        out.append(leaf)
+    args, kwargs = jax.tree_util.tree_unflatten(treedef, out)
+    return args, kwargs, bad
+
+
+def guarded_update(update_fn: Callable, policy: str) -> Callable:
+    """Wrap a metric's ``_update`` with the in-graph poison counter (and mask, if asked).
+
+    The wrapper preserves the functional-core contract — ``(state, *batch) -> state`` —
+    and adds :data:`POISON_STATE` to the returned dict when the incoming state carries it
+    (fused forward paths hand in the defaults dict, which does). Traced exactly like the
+    inner update: zero per-step host work.
+    """
+
+    do_mask = policy == "mask"
+
+    def guarded(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        args, kwargs, bad = scrub_nonfinite(args, kwargs, do_mask)
+        out = dict(update_fn(state, *args, **kwargs))
+        prev = state.get(POISON_STATE)
+        if prev is not None:
+            out[POISON_STATE] = prev + bad
+        return out
+
+    return guarded

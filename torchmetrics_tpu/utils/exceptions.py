@@ -10,3 +10,29 @@ class TorchMetricsUserError(Exception):
 
 class TorchMetricsUserWarning(UserWarning):
     """Warning raised on questionable usage of the metric API."""
+
+
+class NumericPoisonError(TorchMetricsUserError):
+    """Raised at ``compute()`` when ``nan_policy="raise"`` detected non-finite inputs.
+
+    The detection itself is in-graph (a poison-counter state accumulated alongside the
+    metric state) so ``update``/``forward`` never pay a host sync; the single deferred
+    host read happens here, at finalisation.
+    """
+
+
+class SnapshotError(TorchMetricsUserError):
+    """Raised when a metric state snapshot cannot be taken or restored.
+
+    Covers mid-flight snapshots (state buffers donated to an in-progress dispatch),
+    snapshots with batches pending in a buffered accumulator, and restores of blobs
+    that fail format/version/CRC/shape validation.
+    """
+
+
+class SyncTimeoutError(TorchMetricsUserError):
+    """Raised when a bounded multi-process sync exhausts its deadline and retries.
+
+    Only raised when degraded mode is off; with ``degraded_mode=True`` the sync instead
+    falls back to local state and marks the result non-world-consistent.
+    """

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke chaos
+test: jaxlint test-unit test-integration bench-smoke chaos perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -37,6 +37,18 @@ telemetry-smoke:
 # NaN-poisoned batches — under a FIXED seed and asserts recovery to bit-identical state
 chaos:
 	TM_TPU_CHAOS_SEED=1234 python -m pytest tests/unittests/robust -q
+
+# perf regression gate (docs/observability.md "Cost profiling & perf gate"): re-captures
+# the XLA cost ledger for the fixed aggregation workload and diffs it — plus the latest
+# BENCH_*.json headline numbers — against the committed PERF_LEDGER.json baseline. Exits
+# nonzero on regression (1) or a missing baseline (2); skips with a notice on backends
+# without cost_analysis(). For an INTENTIONAL change, run `make perf-baseline` and commit
+# the refreshed PERF_LEDGER.json alongside the change that moved the numbers.
+perf-gate:
+	python -m torchmetrics_tpu.obs.gate
+
+perf-baseline:
+	python -m torchmetrics_tpu.obs.gate --update-baseline
 
 clean:
 	find . -type d -name __pycache__ -prune -exec rm -rf {} +

@@ -833,6 +833,21 @@ def main() -> None:
     except Exception as err:
         extras["telemetry_error"] = repr(err)
 
+    # XLA cost ledger: compiler-level FLOPs / bytes-accessed / memory footprint per benched
+    # metric kernel (docs/observability.md "Cost profiling & perf gate"). Resolving the
+    # jit-tier rows compiles each remaining kernel once — outside every timed window — and
+    # makes the BENCH file diffable by the perf gate and `bench.py --compare`.
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["cost_ledger"] = [
+            {k: r[k] for k in ("key", "metric", "kernel", "tier", "flops",
+                               "bytes_accessed", "temp_bytes", "argument_bytes", "available")}
+            for r in obs.cost_ledger()
+        ]
+    except Exception as err:
+        extras["cost_ledger_error"] = repr(err)
+
     print(
         json.dumps(
             {
@@ -852,7 +867,56 @@ def main() -> None:
     )
 
 
+def compare_main(path_a: str, path_b: str) -> int:
+    """``bench.py --compare A.json B.json``: per-metric delta table between two BENCH files.
+
+    Reuses the perf gate's tolerance logic (``torchmetrics_tpu.obs.ledger``): throughput
+    numbers regress when B falls below A by more than the bench tolerance, latency/overhead
+    numbers when they rise above it, and embedded ``cost_ledger`` rows are diffed field by
+    field with the flops/bytes/memory tolerances. Exit code 1 when anything regresses —
+    jax is never initialised, so this runs anywhere the JSON files do.
+    """
+    from torchmetrics_tpu.obs import ledger as _ledger
+
+    a = _ledger.load_bench_payload(path_a)
+    b = _ledger.load_bench_payload(path_b)
+    if not a or not b:
+        print(f"bench --compare: no bench payload found in {path_a if not a else path_b}",
+              file=sys.stderr)
+        return 2
+
+    def numbers(payload: dict) -> dict:
+        out = {}
+        if isinstance(payload.get("value"), (int, float)):
+            out["value"] = payload["value"]
+        for k, v in (payload.get("extras") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = v
+        return out
+
+    nums_a, nums_b = numbers(a), numbers(b)
+    shared = sorted(set(nums_a) & set(nums_b))
+    deltas = _ledger.compare_bench(nums_a, nums_b, keys=shared)
+    print(_ledger.render_deltas(deltas, title=f"bench compare: {path_a} -> {path_b}"))
+
+    rows_a = {r["key"]: r for r in (a.get("extras") or {}).get("cost_ledger") or []}
+    rows_b = {r["key"]: r for r in (b.get("extras") or {}).get("cost_ledger") or []}
+    ledger_deltas = []
+    if rows_a and rows_b:
+        ledger_deltas = _ledger.compare_ledger(rows_a, rows_b)
+        print(_ledger.render_deltas(ledger_deltas, title="cost-ledger deltas"))
+    else:
+        print("cost-ledger deltas: skipped (one or both files carry no cost_ledger extras)")
+    return 1 if _ledger.regressions(deltas) or _ledger.regressions(ledger_deltas) else 0
+
+
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        idx = sys.argv.index("--compare")
+        if len(sys.argv) < idx + 3:
+            print("usage: bench.py --compare A.json B.json", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(compare_main(sys.argv[idx + 1], sys.argv[idx + 2]))
     if "--smoke" in sys.argv:
         # CI smoke lane (make bench-smoke): tiny sizes, CPU pinned via the config API (the
         # env-var route can wedge on a dead tunnel plugin), no subprocess orchestration —

@@ -500,6 +500,74 @@ class TestTPU008BareAssertInJit:
         ) == []
 
 
+# ------------------------------------------------------------------------------- TPU009
+class TestTPU009TelemetryInJit:
+    def test_counter_inc_inside_jit_flags(self):
+        assert "TPU009" in _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                obs.telemetry.counter("kernel.calls").inc()
+                return jnp.sum(x)
+            """
+        )
+
+    def test_obs_bump_inside_engine_update_flags(self):
+        # _update is jitted by the Metric shell: the bump fires once per COMPILE, so the
+        # per-step count silently freezes after the first trace
+        assert "TPU009" in _rules(
+            """
+            class M:
+                def _update(self, state, value):
+                    obs.bump(self, "update_calls")
+                    return {"total": state["total"] + jnp.sum(value)}
+            """
+        )
+
+    def test_span_inside_traced_body_flags(self):
+        assert "TPU009" in _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                with telemetry.span("kernel.work"):
+                    return jnp.sum(x)
+            """
+        )
+
+    def test_eager_caller_is_clean(self):
+        # the engine idiom: instrument in the eager shell, dispatch the jitted kernel
+        assert _rules(
+            """
+            def forward(metric, x):
+                obs.bump(metric, "forward_calls")
+                obs.telemetry.counter("engine.dispatches").inc()
+                with obs.metric_span(metric, "forward"):
+                    return metric._jitted(x)
+            """
+        ) == []
+
+    def test_trace_time_recorder_outside_jit_is_clean(self):
+        # deliberate trace-time recording lives in helpers that are not jit roots
+        # (the engine's record_trace / sync_state shape) — not flagged
+        assert _rules(
+            """
+            def sync_state(state, reductions, axis_name):
+                obs.telemetry.counter("sync.sync_state.traces").inc()
+                return {k: lax.psum(v, axis_name) for k, v in state.items()}
+            """
+        ) == []
+
+    def test_suppression_comment_waives(self):
+        assert _rules(
+            """
+            @jax.jit
+            def kernel(x):
+                obs.telemetry.counter("deliberate.trace_count").inc()  # jaxlint: disable=TPU009
+                return jnp.sum(x)
+            """
+        ) == []
+
+
 # ------------------------------------------------------------------------------- TPU000
 def test_syntax_error_reports_tpu000():
     assert _rules("def broken(:\n") == ["TPU000"]

@@ -1,0 +1,341 @@
+"""Cost profiler / perf ledger / gate tests (ISSUE 5 tentpole).
+
+Covers: ledger capture across all three dispatch tiers with signature-stable keys (same
+metric + same shapes ⇒ ONE row per kernel/signature), graceful ``None``-cost degradation
+when a backend exposes no ``cost_analysis()``, gate exit codes (pass / regress /
+missing-baseline / injected bench regression), Perfetto counter-track schema validity for
+the sampled-timing mode, the cross-rank skew report, and the ``obs.summary()`` robust.*
+counter-family fix.
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, obs
+from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.obs import gate as gate_mod
+from torchmetrics_tpu.obs import ledger as ledger_mod
+from torchmetrics_tpu.obs import profiler as profiler_mod
+from torchmetrics_tpu.parallel import sync as sync_mod
+
+X = jnp.asarray(np.linspace(0.5, 2.0, 64, dtype=np.float32))
+STACK = jnp.asarray(np.linspace(0.1, 1.0, 4 * 64, dtype=np.float32).reshape(4, 64))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_profiler():
+    obs.reset_ledger()
+    obs.set_profiling(False)
+    yield
+    obs.reset_ledger()
+    obs.set_profiling(None)  # restore the env-derived default for later suites
+
+
+def _rows_by(rows, **match):
+    return [r for r in rows if all(r[k] == v for k, v in match.items())]
+
+
+# ------------------------------------------------------------------------ ledger capture
+class TestLedgerCapture:
+    def test_rows_for_all_three_tiers(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X)             # jit update kernel
+        m(X)                    # AOT fused forward (reduce-state metric)
+        m.update_batches(STACK)  # AOT whole-stack scan (the buffered tier's launch shape)
+        m.compute()
+        rows = obs.cost_ledger()
+        kernels = {(r["kernel"], r["tier"]) for r in _rows_by(rows, metric="SumMetric")}
+        assert ("update", "jit") in kernels
+        assert ("aot_forward_step", "aot") in kernels
+        assert ("aot_update_scan", "aot") in kernels
+
+    def test_aggregation_metrics_have_nonempty_cost_rows(self):
+        # acceptance: sum/mean/max carry real FLOPs/bytes/memory numbers under jit AND aot
+        for cls in (SumMetric, MeanMetric, MaxMetric):
+            m = cls(nan_strategy="ignore")
+            m.update(X)
+            m(X)
+            m.update_batches(STACK)
+            m.compute()
+        rows = obs.cost_ledger()
+        for cls_name in ("SumMetric", "MeanMetric", "MaxMetric"):
+            tiers = {r["tier"] for r in _rows_by(rows, metric=cls_name, available=True)}
+            assert {"jit", "aot"} <= tiers, f"{cls_name}: missing tier rows ({tiers})"
+            update_rows = _rows_by(rows, metric=cls_name, kernel="update", available=True)
+            assert update_rows and update_rows[0]["flops"] and update_rows[0]["flops"] > 0
+            assert update_rows[0]["bytes_accessed"] and update_rows[0]["bytes_accessed"] > 0
+            assert update_rows[0]["temp_bytes"] is not None
+
+    def test_signature_stable_same_shape_one_row(self):
+        # two instances, many steps, SAME shapes -> exactly one row per (kernel, signature)
+        for _ in range(2):
+            m = SumMetric(nan_strategy="ignore")
+            for _ in range(3):
+                m(X)
+        rows = _rows_by(obs.cost_ledger(), metric="SumMetric", kernel="aot_forward_step")
+        assert len(rows) == 1
+        assert rows[0]["compile_count"] >= 2  # both instances compiled; one ledger row
+
+    def test_distinct_shapes_distinct_rows(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X)
+        m.update(jnp.ones((128,), jnp.float32))
+        rows = _rows_by(obs.cost_ledger(), metric="SumMetric", kernel="update")
+        assert len(rows) == 2
+        assert len({r["signature"] for r in rows}) == 2
+
+    def test_cost_profile_property_filters_by_class(self):
+        ms, mm = SumMetric(nan_strategy="ignore"), MeanMetric(nan_strategy="ignore")
+        ms(X)
+        mm(X)
+        assert all(r["metric"] == "SumMetric" for r in ms.cost_profile)
+        assert ms.cost_profile and mm.cost_profile
+        mc = MetricCollection([SumMetric(nan_strategy="ignore")])
+        mc(X)
+        assert set(mc.cost_profile) == {"SumMetric"}
+
+    def test_group_forward_row_attributed_to_leader(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+
+        mc = MetricCollection([
+            MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+            MulticlassPrecision(num_classes=3, average="macro", validate_args=False),
+        ])
+        preds = jnp.asarray(np.array([0, 1, 2, 1], np.int32))
+        target = jnp.asarray(np.array([0, 1, 1, 1], np.int32))
+        mc(preds, target)  # group formation (per-metric forward)
+        mc(preds, target)  # fused group AOT step
+        rows = _rows_by(obs.cost_ledger(), kernel="aot_group_forward")
+        assert rows and rows[0]["tier"] == "aot"
+
+
+# -------------------------------------------------------------- degradation to None-cost
+class TestDegradation:
+    def test_record_compiled_with_broken_cost_analysis(self):
+        class BrokenCompiled:
+            def cost_analysis(self):
+                raise NotImplementedError("no cost analysis on this backend")
+
+            def memory_analysis(self):
+                return None
+
+        profiler_mod.record_compiled("FakeMetric", "update", "aot", "f32[8]", BrokenCompiled())
+        rows = _rows_by(obs.cost_ledger(), metric="FakeMetric")
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["available"] is False
+        assert row["flops"] is None and row["bytes_accessed"] is None
+        assert row["temp_bytes"] is None
+        assert "cost_analysis failed" in row["reason"]
+
+    def test_jit_resolution_failure_degrades_not_raises(self):
+        def unlowerable(state, x):  # closes over nothing jax can lower against a bad sds
+            raise RuntimeError("boom at trace time")
+
+        profiler_mod.note_jit_trace(
+            SumMetric(nan_strategy="ignore"), "update", unlowerable, (X,), {}, "f32[64]"
+        )
+        rows = _rows_by(obs.cost_ledger(), kernel="update", tier="jit", metric="SumMetric")
+        assert len(rows) == 1
+        assert rows[0]["available"] is False
+        assert "lowering for analysis failed" in rows[0]["reason"]
+
+    def test_cost_analysis_without_flops_key_stays_available(self):
+        class NoFlops:
+            def cost_analysis(self):
+                return {"bytes accessed": 16.0}
+
+            def memory_analysis(self):
+                return None
+
+        profiler_mod.record_compiled("FakeMetric2", "compute", "aot", "f32[]", NoFlops())
+        (row,) = _rows_by(obs.cost_ledger(), metric="FakeMetric2")
+        assert row["available"] is True and row["flops"] is None
+        assert row["bytes_accessed"] == 16.0
+
+
+# ----------------------------------------------------------------------------- the gate
+class TestGate:
+    def _capture(self, tmp_path, monkeypatch, bench_payload=None):
+        monkeypatch.chdir(tmp_path)
+        if bench_payload is not None:
+            (tmp_path / "BENCH_r99.json").write_text(json.dumps(bench_payload))
+        return tmp_path / "PERF_LEDGER.json"
+
+    def test_missing_baseline_exits_2(self, tmp_path, monkeypatch):
+        baseline = self._capture(tmp_path, monkeypatch)
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 2
+
+    def test_update_then_pass_exits_0(self, tmp_path, monkeypatch):
+        baseline = self._capture(tmp_path, monkeypatch)
+        assert gate_mod.run_gate(baseline_path=str(baseline), update_baseline=True) == 0
+        obs.reset_ledger()
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 0
+
+    def test_injected_ledger_regression_exits_1(self, tmp_path, monkeypatch):
+        baseline = self._capture(tmp_path, monkeypatch)
+        assert gate_mod.run_gate(baseline_path=str(baseline), update_baseline=True) == 0
+        doc = json.loads(baseline.read_text())
+        key = next(k for k in doc["ledger"] if doc["ledger"][k].get("flops"))
+        doc["ledger"][key]["flops"] = doc["ledger"][key]["flops"] / 10.0  # current looks 10x worse
+        baseline.write_text(json.dumps(doc))
+        obs.reset_ledger()
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 1
+
+    def test_missing_row_is_coverage_regression(self, tmp_path, monkeypatch):
+        baseline = self._capture(tmp_path, monkeypatch)
+        assert gate_mod.run_gate(baseline_path=str(baseline), update_baseline=True) == 0
+        doc = json.loads(baseline.read_text())
+        doc["ledger"]["GhostMetric.update[f32[1]]"] = {
+            "key": "GhostMetric.update[f32[1]]", "metric": "GhostMetric", "kernel": "update",
+            "tier": "jit", "signature": "f32[1]", "flops": 1.0, "bytes_accessed": 1.0,
+            "argument_bytes": 4, "output_bytes": 4, "temp_bytes": 0,
+            "generated_code_bytes": 0, "available": True, "reason": None, "compile_count": 1,
+        }
+        baseline.write_text(json.dumps(doc))
+        obs.reset_ledger()
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 1
+
+    def test_bench_regression_exits_1(self, tmp_path, monkeypatch):
+        bench = {"metric": "m", "value": 10000.0, "unit": "updates/s",
+                 "extras": {"per_step_host_overhead_us": 30.0}}
+        baseline = self._capture(tmp_path, monkeypatch, bench_payload=bench)
+        assert gate_mod.run_gate(baseline_path=str(baseline), update_baseline=True) == 0
+        # a 4x throughput collapse + 4x host-overhead blowup in a "newer" BENCH file
+        (tmp_path / "BENCH_r99.json").write_text(json.dumps(
+            {"metric": "m", "value": 2500.0, "unit": "updates/s",
+             "extras": {"per_step_host_overhead_us": 120.0}}
+        ))
+        obs.reset_ledger()
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 1
+
+    def test_skips_cleanly_when_cost_analysis_unavailable(self, tmp_path, monkeypatch):
+        baseline = self._capture(tmp_path, monkeypatch)
+        monkeypatch.setattr(gate_mod, "_probe_cost_analysis", lambda: False)
+        assert gate_mod.run_gate(baseline_path=str(baseline)) == 0  # skip, not rc=2
+
+    def test_compare_tolerance_logic(self):
+        base = {"value": 100.0, "per_step_host_overhead_us": 10.0}
+        good = {"value": 95.0, "per_step_host_overhead_us": 11.0}
+        bad = {"value": 40.0, "per_step_host_overhead_us": 40.0}
+        assert ledger_mod.regressions(ledger_mod.compare_bench(base, good)) == []
+        regs = ledger_mod.regressions(ledger_mod.compare_bench(base, bad))
+        assert {d["key"] for d in regs} == {"value", "per_step_host_overhead_us"}
+
+
+# ------------------------------------------------------- sampled timing + counter tracks
+class TestSampledTiming:
+    def test_disabled_by_default_no_samples(self):
+        before = obs.telemetry.counter("profiler.sampled_steps").value
+        m = SumMetric(nan_strategy="ignore")
+        for _ in range(4):
+            m(X)
+        assert obs.telemetry.counter("profiler.sampled_steps").value == before
+
+    def test_sampling_records_host_device_split(self, monkeypatch):
+        obs.set_profiling(True)
+        monkeypatch.setattr(profiler_mod, "_EVERY", 1)
+        m = SumMetric(nan_strategy="ignore")
+        for _ in range(4):
+            m(X)
+        m.update_batches(STACK)
+        summary = obs.timing_summary()
+        assert "aot" in summary and "scan" in summary
+        assert summary["aot"]["host_us"]["count"] >= 1
+        assert summary["aot"]["device_us"]["count"] >= 1
+
+    def test_perfetto_counter_track_schema(self, tmp_path, monkeypatch):
+        obs.set_profiling(True)
+        monkeypatch.setattr(profiler_mod, "_EVERY", 1)
+        with obs.enabled():
+            m = SumMetric(nan_strategy="ignore")
+            for _ in range(3):
+                m(X)
+            path = obs.export_trace(tmp_path / "trace.json")
+        doc = json.loads(open(path).read())
+        counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        assert counters, "no counter-track events recorded"
+        for evt in counters:
+            assert evt["name"].startswith("profiler.step_time.")
+            assert isinstance(evt["ts"], (int, float))
+            assert "pid" in evt
+            args = evt["args"]
+            assert set(args) == {"device_us", "host_us"}
+            assert all(isinstance(v, (int, float)) for v in args.values())
+
+    def test_jit_tier_sampled_when_fast_dispatch_off(self, monkeypatch):
+        monkeypatch.setenv("TM_TPU_FAST_DISPATCH", "0")
+        obs.set_profiling(True)
+        monkeypatch.setattr(profiler_mod, "_EVERY", 1)
+        m = SumMetric(nan_strategy="ignore")
+        for _ in range(3):
+            m(X)
+        assert "jit" in obs.timing_summary()
+
+
+# --------------------------------------------------------------------------- skew report
+class TestSkewReport:
+    @pytest.fixture(autouse=True)
+    def _fresh_skew(self):
+        sync_mod.reset_skew_state()
+        yield
+        sync_mod.reset_skew_state()
+
+    def test_process_sync_records_gather_latencies(self):
+        state = {"total": jnp.asarray(3.0)}
+        out = sync_mod.process_sync(state, {"total": "sum"}, gather_fn=lambda v, g: [v, v])
+        assert "total" in out.gather_latency_us
+        assert sync_mod.local_gather_stats()["count"] == 1
+
+    def test_skew_report_straggler_index(self):
+        state = {"total": jnp.asarray(3.0)}
+        sync_mod.process_sync(state, {"total": "sum"}, gather_fn=lambda v, g: [v, v])
+
+        def fake_world_gather(payload, group):
+            # three ranks: two in lockstep, one 5x straggler
+            base = float(np.asarray(payload).reshape(-1)[0]) or 1.0
+            return [np.asarray([base]), np.asarray([base * 5.0]), np.asarray([base])]
+
+        report = sync_mod.skew_report(gather_fn=fake_world_gather)
+        assert report["world"] == 3
+        assert report["straggler_rank"] == 1
+        assert report["straggler_index"] == pytest.approx(5.0, rel=0.01)
+        assert sync_mod.last_skew_report() is report
+
+    def test_metric_telemetry_surfaces_sync_block(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X)
+        m.sync(dist_sync_fn=lambda v, g: [v, v], distributed_available=lambda: True)
+        m.unsync()
+        tel = m.telemetry
+        assert "sync" in tel
+        assert tel["sync"]["world_consistent"] is True
+        assert "sum_value" in tel["sync"]["gather_latency_us"]
+
+    def test_summary_shows_skew_tail(self):
+        state = {"total": jnp.asarray(1.0)}
+        sync_mod.process_sync(state, {"total": "sum"}, gather_fn=lambda v, g: [v, v])
+        sync_mod.skew_report(gather_fn=lambda p, g: [np.asarray(p).reshape(-1)])
+        text = obs.summary()
+        assert "sync skew:" in text
+        assert "straggler_index" in text
+
+
+# ----------------------------------------------------------------- summary counter fix
+def test_summary_always_tabulates_robust_family():
+    fresh = obs.summary()
+    for name in ("robust.degraded_syncs", "robust.nonfinite_detected",
+                 "robust.injected_faults", "robust.recovered"):
+        assert name in fresh, f"{name} missing from obs.summary()"
+
+
+def test_bench_extras_carries_profiler_and_nonfinite_counters():
+    extras = obs.bench_extras()
+    for key in ("robust_nonfinite_detected", "profiler_rows_recorded",
+                "profiler_lazy_compiles", "profiler_sampled_steps"):
+        assert key in extras

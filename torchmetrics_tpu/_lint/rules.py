@@ -28,6 +28,8 @@ TPU005    ``add_state`` reduction/dtype mismatch (overflow, non-additive sum)
 TPU006    fresh ``jnp`` constant built inside a per-step hot path (re-upload)
 TPU007    value read after being donated to a compiled dispatch (deleted buffer)
 TPU008    bare ``assert`` on a traced value inside jit (a validation no-op)
+TPU009    telemetry/``obs`` registry call inside a jit-traced function (the host
+          side effect runs at trace time only — silently dropped per step)
 ========  ======================================================================
 """
 from __future__ import annotations
@@ -48,6 +50,7 @@ RULES: Dict[str, str] = {
     "TPU006": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
     "TPU007": "value read after being donated to a compiled dispatch (deleted buffer)",
     "TPU008": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
+    "TPU009": "telemetry/obs registry call inside jit-traced code (runs at trace time only)",
 }
 
 # wrapper callables whose function arguments execute under tracing
@@ -942,9 +945,54 @@ def _rule_tpu008(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+#: obs module-level hooks that are host side effects (counters/state mutation per call)
+_OBS_HOOK_NAMES = {"bump", "count_dispatch", "device_sync", "record_trace", "metric_span"}
+#: telemetry registry methods whose call sites are per-call side effects
+_TELEMETRY_METHODS = {"counter", "timer", "histogram", "event", "span", "inc", "observe", "record"}
+
+
+def _rule_tpu009(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Telemetry/``obs`` registry calls inside jit-traced code.
+
+    A counter bump or span inside a traced function executes while jax TRACES the Python
+    body — once per compilation, never per step. The instrument silently reads as "this
+    hot path fired N times" when it really means "this kernel compiled N times"; worse, a
+    span's wall time measures tracing, not execution. Deliberate trace-time recording
+    (the engine's ``record_trace`` hook, ``sync_state``'s trace-time event) belongs in
+    functions that are NOT themselves jit roots — this rule flags instruments reachable
+    from a jit context, where per-step counting silently stops counting.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        if not info.jit:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            hit = None
+            if dotted[0] in ("obs", "telemetry"):
+                if dotted[0] == "obs" and len(dotted) == 2 and dotted[1] in _OBS_HOOK_NAMES:
+                    hit = ".".join(dotted)
+                elif "telemetry" in dotted[:2] and dotted[-1] in _TELEMETRY_METHODS:
+                    hit = ".".join(dotted)
+            if hit is None:
+                continue
+            out.append(_finding(
+                "TPU009", path, node, lines,
+                f"telemetry call {hit}(...) inside jit-traced {info.name!r} executes at"
+                " TRACE time only (once per compilation, not per step) — the count/span"
+                " silently stops recording on cached executions; hoist the instrument to"
+                " the eager caller or fold the quantity into the program as a state output",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
-    _rule_tpu007, _rule_tpu008,
+    _rule_tpu007, _rule_tpu008, _rule_tpu009,
 )
 
 

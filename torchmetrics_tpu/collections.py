@@ -22,6 +22,7 @@ import numpy as np
 
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.metric import Metric, _MISS
+from torchmetrics_tpu.obs import profiler as _profiler
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.utils.data import allclose
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -210,6 +211,7 @@ class MetricCollection:
             obs.instrument_trace(step_flat, leader, "aot_group_forward"),
             example,
             donate_argnums=tuple(range(n_state)) if donated else (),
+            owner=leader, kind="aot_group_forward",
         )
         return _dispatch.AotEntry(compiled, names, donated)
 
@@ -227,7 +229,9 @@ class MetricCollection:
         if cache.broken:
             return _MISS
         tracing = obs.telemetry.enabled
-        t0 = time.perf_counter() if tracing else 0.0
+        sampled = _profiler.sample_step("group")
+        timed = tracing or sampled
+        t0 = time.perf_counter() if timed else 0.0
         state = leader._state
         try:
             leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
@@ -235,7 +239,7 @@ class MetricCollection:
             obs.bump(leader, "group_forward_calls")
             obs.count_dispatch(leader)  # k metrics in the group, ONE fused launch
             state.begin_donated_dispatch()
-            t1 = time.perf_counter() if tracing else 0.0
+            t1 = time.perf_counter() if timed else 0.0
             entry, (vals, merged) = _dispatch.dispatch_step(
                 cache,
                 lambda lv, td: self._build_aot_group_forward(leader, members, lv, td),
@@ -244,7 +248,7 @@ class MetricCollection:
                 leaves,
                 treedef,
             )
-            t2 = time.perf_counter() if tracing else 0.0
+            t2 = time.perf_counter() if timed else 0.0
             if entry.donated:
                 state.commit_donated(entry.state_names, merged)
                 obs.telemetry.counter("dispatch.donated_steps").inc()
@@ -278,6 +282,10 @@ class MetricCollection:
             obs.telemetry.timer("dispatch.host_overhead").observe(
                 (t1 - t0) + (time.perf_counter() - t2)
             )
+        if sampled:
+            tb = time.perf_counter()
+            jax.block_until_ready(vals)
+            _profiler.record_sample("group", t2 - t0, time.perf_counter() - tb)
         return vals
 
     def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
@@ -541,6 +549,12 @@ class MetricCollection:
             "retraces_total": sum(t["retraces_total"] for t in per.values()),
             "compute_groups": {i: list(v) for i, v in self._groups.items()},
         }
+
+    @property
+    def cost_profile(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Per-member XLA cost ledger rows (see ``Metric.cost_profile``); group-fused
+        kernels appear under each group's LEADER class, mirroring dispatch attribution."""
+        return {name: m.cost_profile for name, m in self._modules.items()}
 
     # -------------------------------------------------------------- dict-likes
     def _flatten_collection(self, name: Optional[str], coll: "MetricCollection") -> Iterator[Tuple[str, Metric]]:

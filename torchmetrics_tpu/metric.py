@@ -43,6 +43,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu import obs
+from torchmetrics_tpu.obs import profiler as _profiler
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.parallel.sync import process_sync
 from torchmetrics_tpu.robust import checkpoint as _checkpoint
@@ -261,7 +262,7 @@ class Metric:
         times = self.__dict__.get("_tm_times") or {}
         traces = {k.split(".", 1)[1]: v for k, v in counts.items() if k.startswith("traces.")}
         retraces = {k: max(0, v - 1) for k, v in traces.items()}
-        return {
+        out = {
             "calls": {k[: -len("_calls")]: v for k, v in counts.items() if k.endswith("_calls")},
             "dispatches": counts.get("dispatches", 0),
             "traces": traces,
@@ -269,6 +270,29 @@ class Metric:
             "retraces_total": sum(retraces.values()),
             "time_s": {k: round(v, 6) for k, v in times.items()},
         }
+        # cross-process sync observability: this instance's last gather latencies plus the
+        # module-level skew report (per-rank latencies → straggler index), when one exists
+        last_sync = self.__dict__.get("_tm_last_sync")
+        if last_sync is not None:
+            from torchmetrics_tpu.parallel import sync as _sync
+
+            out["sync"] = dict(last_sync)
+            skew = _sync.last_skew_report()
+            if skew is not None:
+                out["sync"]["skew"] = skew
+        return out
+
+    @property
+    def cost_profile(self) -> List[Dict[str, Any]]:
+        """XLA cost/memory ledger rows attributed to this metric CLASS.
+
+        One row per (kernel, abstract signature): FLOPs, bytes accessed, and the
+        executable's argument/output/temp byte sizes (HBM quantities on a real TPU), for
+        both the jit and the AOT dispatch tiers. Reading this resolves any lazily-pending
+        jit-tier entries (one off-hot-path compile each) — see ``obs.cost_ledger()`` and
+        ``docs/observability.md``.
+        """
+        return _profiler.cost_profile_for(type(self).__name__)
 
     def add_state(
         self,
@@ -507,6 +531,7 @@ class Metric:
             obs.instrument_trace(scan_flat, self, "aot_update_scan"),
             example,
             donate_argnums=tuple(range(n_state)) if donated else (),
+            owner=self, kind="aot_update_scan",
         )
         return _dispatch.AotEntry(compiled, names, donated)
 
@@ -520,7 +545,9 @@ class Metric:
         if cache.broken:
             return False
         state = self._state
+        sampled = _profiler.sample_step("scan")
         try:
+            ts0 = time.perf_counter() if sampled else 0.0
             leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
             state_leaves = self._state_leaves_for_donation(tuple(state.tensors))
             obs.count_dispatch(self)
@@ -536,6 +563,10 @@ class Metric:
                 for name, arr in zip(entry.state_names, out):
                     state.tensors[name] = arr
                 state.abort_donated()
+            if sampled:
+                tb = time.perf_counter()
+                jax.block_until_ready(out)
+                _profiler.record_sample("scan", tb - ts0, time.perf_counter() - tb)
         except Exception:
             state.abort_donated()
             if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
@@ -815,6 +846,7 @@ class Metric:
             obs.instrument_trace(step_flat, self, "aot_forward_step"),
             example,
             donate_argnums=tuple(range(n_state)) if donated else (),
+            owner=self, kind="aot_forward_step",
         )
         return _dispatch.AotEntry(compiled, names, donated)
 
@@ -835,19 +867,21 @@ class Metric:
         if cache.broken:
             return _MISS
         tracing = obs.telemetry.enabled
-        t0 = time.perf_counter() if tracing else 0.0
+        sampled = _profiler.sample_step("aot")
+        timed = tracing or sampled
+        t0 = time.perf_counter() if timed else 0.0
         state = self._state
         try:
             leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
             state_leaves = self._state_leaves_for_donation(tuple(state.tensors))
             obs.count_dispatch(self)
             state.begin_donated_dispatch()
-            t1 = time.perf_counter() if tracing else 0.0
+            t1 = time.perf_counter() if timed else 0.0
             entry, (batch_val, merged) = _dispatch.dispatch_step(
                 cache, self._build_aot_forward, state_leaves,
                 (np.float32(self._update_count + 1),), leaves, treedef,
             )
-            t2 = time.perf_counter() if tracing else 0.0
+            t2 = time.perf_counter() if timed else 0.0
             if entry.donated:
                 state.commit_donated(entry.state_names, merged)
                 obs.telemetry.counter("dispatch.donated_steps").inc()
@@ -876,6 +910,11 @@ class Metric:
             obs.telemetry.timer("dispatch.host_overhead").observe(
                 (t1 - t0) + (time.perf_counter() - t2)
             )
+        if sampled:
+            # host = entry until the dispatch call returned; device = blocking remainder
+            tb = time.perf_counter()
+            jax.block_until_ready(batch_val)
+            _profiler.record_sample("aot", t2 - t0, time.perf_counter() - tb)
         return batch_val
 
     def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
@@ -905,10 +944,16 @@ class Metric:
                 if out is not _MISS:
                     return out
             obs.count_dispatch(self)
+            sampled = _profiler.sample_step("jit")
+            ts0 = time.perf_counter() if sampled else 0.0
             batch_val, merged = self._jitted_forward_step()(
                 # np scalar, NOT jnp: jnp.asarray would eagerly dispatch a device op per step
                 dict(self._state.tensors), np.float32(self._update_count + 1), *args, **kwargs
             )
+            if sampled:
+                tb = time.perf_counter()
+                jax.block_until_ready(batch_val)
+                _profiler.record_sample("jit", tb - ts0, time.perf_counter() - tb)
             # count bumps only after the kernel call succeeded (a trace error must not skew n)
             self._update_count += 1
             self._update_called = True
@@ -942,6 +987,10 @@ class Metric:
         )
         # a bounded sync may have degraded to local-only state (docs/robustness.md)
         self._world_consistent = bool(getattr(synced, "world_consistent", True))
+        self._tm_last_sync = {
+            "world_consistent": self._world_consistent,
+            "gather_latency_us": dict(getattr(synced, "gather_latency_us", {}) or {}),
+        }
         for name in list(self._state.tensors):
             self._state.tensors[name] = synced[name]
         for name in list(self._state.lists):

@@ -20,6 +20,13 @@ Quick start::
 Cost model: *counting* (retraces, dispatches, transfers) is always on — integer bumps that
 are noise next to an XLA dispatch. *Tracing* (events, spans, timers) only records while
 enabled and no-ops through a shared null scope otherwise. See ``docs/observability.md``.
+
+Compiler-level cost accounting (:mod:`torchmetrics_tpu.obs.profiler`): ``cost_ledger()``
+returns FLOPs / bytes-accessed / memory-footprint rows per metric kernel and signature,
+captured at the AOT compile seam and lazily for the jit tiers; ``TM_TPU_PROFILE=1``
+additionally samples host/device step-time splits per dispatch tier. The committed
+``PERF_LEDGER.json`` baseline plus ``python -m torchmetrics_tpu.obs.gate`` (``make
+perf-gate``) turn both into a CI regression gate.
 """
 from torchmetrics_tpu.obs.telemetry import (
     ENV_FLAG,
@@ -52,15 +59,33 @@ from torchmetrics_tpu.obs.export import (
     snapshot,
     summary,
 )
+from torchmetrics_tpu.obs.profiler import (
+    ENV_PROFILE,
+    CostRow,
+    cost_ledger,
+    cost_profile_for,
+    profiling_enabled,
+    reset_ledger,
+    set_profiling,
+    timing_summary,
+)
 
 __all__ = [
     "ENV_FLAG",
+    "ENV_PROFILE",
     "ENV_RETRACE_THRESHOLD",
+    "CostRow",
     "Counter",
     "Histogram",
     "Telemetry",
     "Timer",
     "bench_extras",
+    "cost_ledger",
+    "cost_profile_for",
+    "profiling_enabled",
+    "reset_ledger",
+    "set_profiling",
+    "timing_summary",
     "bump",
     "count_dispatch",
     "describe_abstract",

@@ -60,13 +60,43 @@ def snapshot(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
     return tel.snapshot()
 
 
+#: counter families ALWAYS tabulated by ``summary()`` (zero rows included), so absence of
+#: a family can never be misread as "nothing to report". The robust.* family (PR 4) was
+#: previously invisible until its first event — a chaos run with zero recoveries and a
+#: registry that never created the counter looked identical.
+_ALWAYS_TABULATED = (
+    # robustness (docs/robustness.md): fault injection, recovery, degraded syncs, guardrails
+    "robust.degraded_syncs",
+    "robust.nonfinite_detected",
+    "robust.injected_faults",
+    "robust.recovered",
+    "robust.sync_retries",
+    # dispatch tiers (docs/performance.md)
+    "dispatch.aot_compiles",
+    "dispatch.aot_fallbacks",
+    "dispatch.donated_steps",
+    "dispatch.buffered_flushes",
+    # cost profiler (docs/observability.md "Cost profiling & perf gate")
+    "profiler.rows_recorded",
+    "profiler.lazy_compiles",
+    "profiler.sampled_steps",
+)
+
+
 def summary(registry: Optional[Telemetry] = None) -> str:
-    """Fixed-width table of every counter, timer, and histogram in the registry."""
+    """Fixed-width table of every counter, timer, and histogram in the registry.
+
+    Known counter families (robust.*, dispatch.*, profiler.*) are tabulated even at zero,
+    and a cross-rank sync-skew section is appended when gather latencies were recorded.
+    """
     tel = registry if registry is not None else telemetry
     snap = tel.snapshot()
+    counters = dict(snap["counters"])
+    for name in _ALWAYS_TABULATED:
+        counters.setdefault(name, 0)
     rows = [("name", "kind", "count", "total/percentiles")]
-    for name in sorted(snap["counters"]):
-        rows.append((name, "counter", str(snap["counters"][name]), ""))
+    for name in sorted(counters):
+        rows.append((name, "counter", str(counters[name]), ""))
     for name in sorted(snap["timers"]):
         t = snap["timers"][name]
         rows.append((name, "timer", str(t["count"]), f"{t['total_s']:.6f}s (mean {t['mean_s']:.9f}s)"))
@@ -84,7 +114,27 @@ def summary(registry: Optional[Telemetry] = None) -> str:
         f"telemetry summary (enabled={snap['enabled']}, events={snap['events_recorded']},"
         f" dropped={snap['events_dropped']})"
     )
-    return "\n".join([header] + lines)
+    tail = []
+    if registry is None:  # the skew/sync section describes process-global state only
+        try:
+            from torchmetrics_tpu.parallel import sync as _sync
+
+            local = _sync.local_gather_stats()
+            if local is not None:
+                tail.append(
+                    f"sync gathers (this rank): n={local['count']} mean={local['mean_us']}us"
+                    f" p50={local['p50_us']}us max={local['max_us']}us"
+                )
+            skew = _sync.last_skew_report()
+            if skew is not None:
+                tail.append(
+                    f"sync skew: world={skew['world']} straggler_rank={skew['straggler_rank']}"
+                    f" straggler_index={skew['straggler_index']}"
+                    f" per_rank_mean_us={skew['per_rank_mean_us']}"
+                )
+        except Exception:  # pragma: no cover - summary must render regardless
+            pass
+    return "\n".join([header] + lines + tail)
 
 
 @rank_zero_only
@@ -126,6 +176,12 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "robust_injected_faults": counters.get("robust.injected_faults", 0),
         "robust_recovered": counters.get("robust.recovered", 0),
         "robust_degraded_syncs": counters.get("robust.degraded_syncs", 0),
+        "robust_nonfinite_detected": counters.get("robust.nonfinite_detected", 0),
+        # cost profiler (docs/observability.md): ledger rows captured during this run and
+        # how many sampled device-timing steps fed the per-tier host/device split
+        "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),
+        "profiler_lazy_compiles": counters.get("profiler.lazy_compiles", 0),
+        "profiler_sampled_steps": counters.get("profiler.sampled_steps", 0),
         "device_transfers": counters.get("transfer.device_put", 0)
         + counters.get("transfer.host_to_device", 0),
         "events_recorded": snap["events_recorded"],

@@ -1,0 +1,210 @@
+"""CI perf-regression gate: ``python -m torchmetrics_tpu.obs.gate`` / ``make perf-gate``.
+
+Runs a fixed, deterministic workload (sum/mean/max/min aggregation metrics at pinned
+shapes, exercising the jit AND the AOT dispatch tiers), captures the XLA cost ledger
+(:mod:`torchmetrics_tpu.obs.profiler`), and diffs it — plus the latest ``BENCH_*.json``
+headline numbers — against the committed ``PERF_LEDGER.json`` baseline with configurable
+relative tolerances (:mod:`torchmetrics_tpu.obs.ledger`).
+
+Exit codes::
+
+    0  pass (or: cost analysis unavailable on this backend — skipped with a notice)
+    1  regression beyond tolerance (cost rows, lost coverage, or bench numbers)
+    2  missing/unreadable baseline (run with --update-baseline to create it)
+
+``--update-baseline`` rewrites ``PERF_LEDGER.json`` from the current run — the intentional-
+change path: commit the refreshed baseline together with the change that moved the numbers.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from torchmetrics_tpu.obs import ledger as _ledger
+
+#: the gate's workload classes; the committed baseline holds exactly their rows
+WORKLOAD_CLASSES = ("SumMetric", "MeanMetric", "MaxMetric", "MinMetric")
+_N = 256  # fixed workload shape: signatures (and therefore ledger keys) must not drift
+
+
+def _probe_cost_analysis() -> bool:
+    """Can this backend report compiler cost analysis at all? (Skip the gate when not.)"""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        compiled = jax.jit(lambda x: x + 1.0).lower(jnp.zeros((4,), jnp.float32)).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        return isinstance(ca, dict) and ca.get("flops") is not None
+    except Exception:
+        return False
+
+
+def run_workload() -> List[Dict[str, Any]]:
+    """Exercise every workload class through the jit and AOT tiers; return its ledger rows.
+
+    Per class: eager ``update`` + ``compute`` (jit kernels), per-step ``forward`` twice
+    (the AOT fused step for reduce-state metrics, the fused batch-value kernel for
+    full-state ones), one ``update_batches`` stack (the AOT whole-stack scan), and one
+    ``forward`` with the AOT tier disabled (the jit fused step) — so every class lands
+    rows under BOTH tiers regardless of its forward flavour.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu import aggregation, obs
+    from torchmetrics_tpu.ops.dispatch import ENV_FAST_DISPATCH
+
+    x = jnp.asarray(np.linspace(0.5, 2.0, _N, dtype=np.float32))
+    stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * _N, dtype=np.float32).reshape(4, _N))
+    for cls_name in WORKLOAD_CLASSES:
+        cls = getattr(aggregation, cls_name)
+        m = cls(nan_strategy="ignore")
+        m.update(x)
+        m(x)
+        m(x)
+        m.update_batches(stack)
+        m.compute()
+        prior = os.environ.get(ENV_FAST_DISPATCH)
+        os.environ[ENV_FAST_DISPATCH] = "0"
+        try:
+            m_jit = cls(nan_strategy="ignore")
+            m_jit(x)
+            m_jit.compute()
+        finally:
+            if prior is None:
+                os.environ.pop(ENV_FAST_DISPATCH, None)
+            else:
+                os.environ[ENV_FAST_DISPATCH] = prior
+    rows = obs.cost_ledger()
+    return [r for r in rows if r["metric"] in WORKLOAD_CLASSES]
+
+
+def run_gate(
+    baseline_path: str = _ledger.DEFAULT_BASELINE,
+    bench_dir: str = ".",
+    update_baseline: bool = False,
+    tolerances: Optional[Dict[str, float]] = None,
+    as_json: bool = False,
+    out=sys.stdout,
+) -> int:
+    """The gate's whole logic, importable for tests; returns the process exit code."""
+    if not _probe_cost_analysis():
+        print(
+            "perf-gate: SKIPPED — this backend exposes no compiler cost analysis"
+            " (cost_analysis() unavailable); the ledger cannot be captured here.",
+            file=out,
+        )
+        return 0
+
+    rows = run_workload()
+    current = _ledger.rows_by_key(rows)
+
+    bench_file = _ledger.latest_bench_file(bench_dir)
+    bench_numbers: Dict[str, Any] = {}
+    if bench_file is not None:
+        try:
+            bench_numbers = _ledger.load_bench_numbers(bench_file)
+            bench_numbers["file"] = os.path.basename(bench_file)
+        except (OSError, ValueError):
+            bench_numbers = {}
+
+    if update_baseline:
+        doc = _ledger.build_document(rows, bench=bench_numbers, tolerances=tolerances)
+        _ledger.write_document(doc, baseline_path)
+        print(
+            f"perf-gate: wrote baseline {baseline_path} ({len(rows)} ledger rows,"
+            f" bench source: {bench_numbers.get('file', 'none')})",
+            file=out,
+        )
+        return 0
+
+    try:
+        baseline = _ledger.load_document(baseline_path)
+    except (OSError, ValueError) as err:
+        print(
+            f"perf-gate: MISSING BASELINE — {err}\n"
+            f"perf-gate: create one with: python -m torchmetrics_tpu.obs.gate"
+            f" --update-baseline --baseline {baseline_path}",
+            file=out,
+        )
+        return 2
+
+    tol = dict(baseline.get("tolerances") or {})
+    tol.update(tolerances or {})
+    deltas = _ledger.compare_ledger(baseline.get("ledger") or {}, current, tol)
+    bench_deltas: List[Dict[str, Any]] = []
+    base_bench = baseline.get("bench") or {}
+    if base_bench and bench_numbers:
+        bench_deltas = _ledger.compare_bench(base_bench, bench_numbers, tol)
+
+    all_regressions = _ledger.regressions(deltas) + _ledger.regressions(bench_deltas)
+    if as_json:
+        print(json.dumps({
+            "ledger_deltas": deltas,
+            "bench_deltas": bench_deltas,
+            "bench_file": bench_numbers.get("file"),
+            "regressions": len(all_regressions),
+            "tolerances": tol,
+        }, indent=2), file=out)
+    else:
+        print(_ledger.render_deltas(deltas, title="perf-gate ledger"), file=out)
+        if bench_deltas:
+            print(_ledger.render_deltas(
+                bench_deltas,
+                title=f"perf-gate bench ({base_bench.get('file')} -> {bench_numbers.get('file')})",
+            ), file=out)
+        verdict = "FAIL" if all_regressions else "PASS"
+        print(f"perf-gate: {verdict} ({len(all_regressions)} regression(s))", file=out)
+    return 1 if all_regressions else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.obs.gate",
+        description="XLA cost-ledger + bench perf-regression gate (docs/observability.md)",
+    )
+    parser.add_argument("--baseline", default=_ledger.DEFAULT_BASELINE,
+                        help="baseline ledger path (default: ./PERF_LEDGER.json)")
+    parser.add_argument("--bench-dir", default=".",
+                        help="directory holding BENCH_*.json files (default: .)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current run and exit 0")
+    parser.add_argument("--json", action="store_true", help="machine-readable delta output")
+    parser.add_argument("--platform", default=os.environ.get("TM_TPU_GATE_PLATFORM", "cpu"),
+                        help="jax platform to pin via the config API (default: cpu)")
+    for knob in ("flops-rtol", "bytes-rtol", "memory-rtol", "bench-rtol"):
+        parser.add_argument(f"--{knob}", type=float, default=None,
+                            help=f"override the baseline's {knob.replace('-', '_')}")
+    args = parser.parse_args(argv)
+
+    # config-API platform pin: env-var selection can wedge backend init on a dead
+    # tunnel plugin in this environment (see bench.py --smoke), the config API is immune
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    tolerances = {
+        name.replace("-", "_"): value
+        for name, value in (
+            ("flops-rtol", args.flops_rtol), ("bytes-rtol", args.bytes_rtol),
+            ("memory-rtol", args.memory_rtol), ("bench-rtol", args.bench_rtol),
+        )
+        if value is not None
+    }
+    return run_gate(
+        baseline_path=args.baseline,
+        bench_dir=args.bench_dir,
+        update_baseline=args.update_baseline,
+        tolerances=tolerances or None,
+        as_json=args.json,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""Perf-ledger serialisation and tolerance-based comparison.
+
+One format, three consumers: the committed ``PERF_LEDGER.json`` baseline, the CI gate
+(:mod:`torchmetrics_tpu.obs.gate`), and ``bench.py --compare``. A ledger document is::
+
+    {
+      "format": "tm-tpu-perf-ledger", "version": 1, "jax_version": "0.4.x",
+      "tolerances": {"flops_rtol": ..., "bytes_rtol": ..., "memory_rtol": ..., "bench_rtol": ...},
+      "ledger": {"<Metric>.<kernel>[<signature>]": {<CostRow fields>}},
+      "bench":  {"file": "BENCH_rNN.json", "value": ..., "<extras numbers>": ...}
+    }
+
+Comparison semantics: compiler cost quantities (flops, bytes accessed, argument/temp/output
+bytes) are *lower-is-better* — a value above ``baseline * (1 + rtol)`` is a regression.
+Bench throughput numbers (``value``, ``*_per_sec``, ``*updates_per_sec*``) are
+*higher-is-better* — below ``baseline * (1 - rtol)`` regresses; latency/overhead numbers
+(``*_us``, ``*_ms``, ``*overhead*``) are lower-is-better. Rows present in the baseline but
+absent from the current ledger count as regressions too (coverage loss is how a silently
+skipped tier would otherwise pass the gate).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+LEDGER_FORMAT = "tm-tpu-perf-ledger"
+LEDGER_VERSION = 1
+DEFAULT_BASELINE = "PERF_LEDGER.json"
+
+#: cost-row fields the gate compares (all lower-is-better, byte/flop counts)
+COST_FIELDS: Tuple[str, ...] = ("flops", "bytes_accessed", "argument_bytes", "temp_bytes")
+
+DEFAULT_TOLERANCES: Dict[str, float] = {
+    # compiler cost estimates are deterministic for a fixed jax/XLA version; the slack
+    # absorbs minor codegen drift across patch releases without hiding a real 2x blowup
+    "flops_rtol": 0.10,
+    "bytes_rtol": 0.10,
+    "memory_rtol": 0.25,
+    # bench numbers come from a contended shared host (BASELINE.md window spreads); the
+    # wide default catches collapse-class regressions (r02→r03 was 3.1x), not noise
+    "bench_rtol": 0.50,
+}
+
+#: BENCH extras keys the gate tracks (beyond the headline "value")
+BENCH_KEYS: Tuple[str, ...] = (
+    "per_step_host_overhead_us",
+    "updates_per_sec_per_step_forward",
+    "buffered_updates_per_sec",
+    "host_api_sweep_updates_per_sec",
+    "fused_samples_per_sec",
+)
+
+
+def _field_rtol(field: str, tolerances: Dict[str, float]) -> float:
+    if field == "flops":
+        return tolerances.get("flops_rtol", DEFAULT_TOLERANCES["flops_rtol"])
+    if field == "bytes_accessed":
+        return tolerances.get("bytes_rtol", DEFAULT_TOLERANCES["bytes_rtol"])
+    return tolerances.get("memory_rtol", DEFAULT_TOLERANCES["memory_rtol"])
+
+
+def rows_by_key(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Index profiler rows by their ``"<Metric>.<kernel>[<signature>]"`` key."""
+    return {r["key"]: r for r in rows}
+
+
+def build_document(
+    rows: List[Dict[str, Any]],
+    bench: Optional[Dict[str, Any]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Assemble a ledger document from profiler rows (+ optional bench numbers)."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # pragma: no cover - jax is always present in this package
+        jax_version = None
+    return {
+        "format": LEDGER_FORMAT,
+        "version": LEDGER_VERSION,
+        "jax_version": jax_version,
+        "tolerances": dict(DEFAULT_TOLERANCES, **(tolerances or {})),
+        "ledger": {r["key"]: r for r in rows},
+        "bench": bench or {},
+    }
+
+
+def load_document(path: Any) -> Dict[str, Any]:
+    """Load and validate a ledger document; raises ``ValueError`` on format mismatch."""
+    with open(os.fspath(path)) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or doc.get("format") != LEDGER_FORMAT:
+        raise ValueError(f"{path}: not a {LEDGER_FORMAT} document")
+    if int(doc.get("version", 0)) > LEDGER_VERSION:
+        raise ValueError(
+            f"{path}: ledger version {doc.get('version')} is newer than this reader"
+            f" (supports <= {LEDGER_VERSION})"
+        )
+    return doc
+
+
+def write_document(doc: Dict[str, Any], path: Any) -> str:
+    path = os.fspath(path)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# ---------------------------------------------------------------------------- comparison
+def _delta(
+    key: str, field: str, base: Optional[float], cur: Optional[float],
+    rtol: float, higher_is_better: bool,
+) -> Optional[Dict[str, Any]]:
+    """One compared quantity → a delta record, or None when incomparable."""
+    if base is None or cur is None or base != base or cur != cur:  # None/NaN on either side
+        return None
+    rel = (cur - base) / base if base else (0.0 if cur == base else float("inf"))
+    if higher_is_better:
+        status = "regression" if cur < base * (1.0 - rtol) else ("improved" if rel > rtol else "ok")
+    else:
+        status = "regression" if cur > base * (1.0 + rtol) else ("improved" if rel < -rtol else "ok")
+    return {
+        "key": key, "field": field, "baseline": base, "current": cur,
+        "rel": round(rel, 4), "rtol": rtol, "status": status,
+        "higher_is_better": higher_is_better,
+    }
+
+
+def compare_ledger(
+    baseline_rows: Dict[str, Dict[str, Any]],
+    current_rows: Dict[str, Dict[str, Any]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Per-row, per-field cost comparison; missing rows regress, new rows inform."""
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    deltas: List[Dict[str, Any]] = []
+    for key, base in sorted(baseline_rows.items()):
+        cur = current_rows.get(key)
+        if cur is None:
+            deltas.append({
+                "key": key, "field": "(row)", "baseline": None, "current": None,
+                "rel": None, "rtol": None, "status": "regression",
+                "note": "row missing from the current ledger (tier/kernel coverage lost)",
+            })
+            continue
+        if not base.get("available", False):
+            # the baseline itself has no numbers for this row; nothing to regress against
+            continue
+        if not cur.get("available", False):
+            deltas.append({
+                "key": key, "field": "(availability)", "baseline": None, "current": None,
+                "rel": None, "rtol": None, "status": "regression",
+                "note": f"cost analysis no longer available: {cur.get('reason')}",
+            })
+            continue
+        for field in COST_FIELDS:
+            d = _delta(key, field, base.get(field), cur.get(field),
+                       _field_rtol(field, tol), higher_is_better=False)
+            if d is not None:
+                deltas.append(d)
+    for key in sorted(set(current_rows) - set(baseline_rows)):
+        deltas.append({
+            "key": key, "field": "(row)", "baseline": None, "current": None,
+            "rel": None, "rtol": None, "status": "new",
+            "note": "row not in baseline (new kernel/signature; --update-baseline to adopt)",
+        })
+    return deltas
+
+
+def _bench_higher_is_better(key: str) -> bool:
+    lowered = key.lower()
+    if lowered.endswith(("_us", "_ms", "_s")) or "overhead" in lowered or "latency" in lowered:
+        return False
+    return True
+
+
+def compare_bench(
+    baseline: Dict[str, Any],
+    current: Dict[str, Any],
+    tolerances: Optional[Dict[str, float]] = None,
+    keys: Optional[List[str]] = None,
+) -> List[Dict[str, Any]]:
+    """Compare two flat dicts of bench numbers (headline ``value`` + selected extras)."""
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    rtol = tol.get("bench_rtol", DEFAULT_TOLERANCES["bench_rtol"])
+    deltas: List[Dict[str, Any]] = []
+    tracked = keys if keys is not None else ["value", *BENCH_KEYS]
+    for key in tracked:
+        base, cur = baseline.get(key), current.get(key)
+        if not isinstance(base, (int, float)) or not isinstance(cur, (int, float)):
+            continue
+        d = _delta(key, key, float(base), float(cur), rtol, _bench_higher_is_better(key))
+        if d is not None:
+            deltas.append(d)
+    return deltas
+
+
+def regressions(deltas: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [d for d in deltas if d["status"] == "regression"]
+
+
+def bench_payload_numbers(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one BENCH_*.json payload into the numbers the ledger tracks."""
+    extras = payload.get("extras") or {}
+    out: Dict[str, Any] = {}
+    if isinstance(payload.get("value"), (int, float)):
+        out["value"] = payload["value"]
+    for key in BENCH_KEYS:
+        v = extras.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = v
+    return out
+
+
+def latest_bench_file(directory: Any = ".", pattern_prefix: str = "BENCH_") -> Optional[str]:
+    """Newest-round ``BENCH_*.json`` in ``directory`` (lexicographic = round order)."""
+    directory = os.fspath(directory)
+    try:
+        names = sorted(
+            n for n in os.listdir(directory)
+            if n.startswith(pattern_prefix) and n.endswith(".json")
+        )
+    except OSError:
+        return None
+    return os.path.join(directory, names[-1]) if names else None
+
+
+def load_bench_payload(path: Any) -> Dict[str, Any]:
+    """The bench payload object from one BENCH_*.json file.
+
+    BENCH files in this repo are either a raw payload object or a driver wrapper with the
+    payload JSON-encoded as the last line of a ``tail`` field; both are handled. Returns
+    an empty dict when no payload can be found.
+    """
+    with open(os.fspath(path)) as fh:
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    if isinstance(doc, dict) and "tail" in doc:
+        for line in reversed(str(doc["tail"]).strip().splitlines()):
+            try:
+                payload = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(payload, dict) and "metric" in payload:
+                return payload
+    # fall back: last parseable payload line of the file
+    for line in reversed(text.strip().splitlines()):
+        try:
+            payload = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(payload, dict) and "metric" in payload:
+            return payload
+    return {}
+
+
+def load_bench_numbers(path: Any) -> Dict[str, Any]:
+    """The tracked numbers from one BENCH_*.json file (see :func:`load_bench_payload`)."""
+    return bench_payload_numbers(load_bench_payload(path))
+
+
+# ------------------------------------------------------------------------------ rendering
+def render_deltas(deltas: List[Dict[str, Any]], title: str = "perf deltas") -> str:
+    """Fixed-width delta table (shared by the gate and ``bench.py --compare``)."""
+    rows = [("status", "key", "field", "baseline", "current", "rel")]
+    for d in deltas:
+        rows.append((
+            d["status"],
+            str(d["key"]),
+            str(d["field"]),
+            "-" if d["baseline"] is None else f"{d['baseline']:g}",
+            "-" if d["current"] is None else f"{d['current']:g}",
+            "-" if d.get("rel") is None else f"{d['rel']:+.1%}",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(widths[i]) for i, c in enumerate(r)).rstrip() for r in rows]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    notes = [f"  note[{d['key']}]: {d['note']}" for d in deltas if d.get("note")]
+    n_reg = len(regressions(deltas))
+    header = f"{title}: {len(deltas)} compared, {n_reg} regression(s)"
+    return "\n".join([header, *lines, *notes])

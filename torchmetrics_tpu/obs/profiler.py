@@ -1,0 +1,366 @@
+"""XLA cost/memory profiler: what the compiler actually produced, per metric kernel.
+
+The dispatch tiers of ``docs/performance.md`` tell you *how* a step launches; this module
+tells you *what* each launch costs at the compiler level — FLOPs, bytes accessed, and the
+executable's memory footprint (argument/output/temp bytes, the HBM quantities on a real
+TPU) — per metric class, per kernel, per abstract input signature. Two capture seams:
+
+- **AOT tier** (``ops/dispatch.aot_compile``): the ``Compiled`` executable is in hand at
+  build time, so ``cost_analysis()`` / ``memory_analysis()`` are read immediately — zero
+  cost on the steady-state step path.
+- **jit tiers** (``metric.py`` / ``collections.py`` kernels): the trace hook
+  (:func:`obs.record_trace`) fires once per XLA compilation with the kernel's abstract
+  signature; the profiler stores a *pending* entry (raw callable + ``ShapeDtypeStruct``
+  pytree — never tracers) and resolves it lazily on the first ledger read by lowering and
+  compiling the uninstrumented callable once per signature. Hot paths never pay for it.
+
+Rows degrade instead of raising: a backend without ``cost_analysis()`` (or a kernel whose
+re-lowering fails) yields a row with ``available=False`` and ``None`` cost fields, so the
+ledger is total over everything that compiled even where the compiler is silent.
+
+Sampled device timing (opt-in, ``TM_TPU_PROFILE=1``): every Nth step
+(``TM_TPU_PROFILE_EVERY``, default 16) the fast dispatch paths block on the step's outputs
+and split the wall time into host overhead vs device execution per tier — recorded in
+always-on histograms (``profiler.host_us.{tier}`` / ``profiler.device_us.{tier}``) and,
+while tracing is enabled, emitted as Perfetto COUNTER tracks (``ph="C"``) that plot as
+time series in ui.perfetto.dev. Disabled cost: one cached-boolean check per step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchmetrics_tpu.obs.telemetry import describe_abstract, telemetry
+
+ENV_PROFILE = "TM_TPU_PROFILE"
+ENV_PROFILE_EVERY = "TM_TPU_PROFILE_EVERY"
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+# ------------------------------------------------------------------------------ ledger
+@dataclasses.dataclass
+class CostRow:
+    """One (metric class, kernel, signature) entry of the process-global cost ledger.
+
+    ``flops``/``bytes_accessed`` come from ``Compiled.cost_analysis()``;
+    ``argument_bytes``/``output_bytes``/``temp_bytes`` from ``memory_analysis()`` (on a
+    TPU these are the HBM quantities — temp is the peak scratch the program allocates).
+    ``available=False`` marks a backend/kernel where the analyses could not be read; the
+    cost fields are then ``None`` and ``reason`` says why.
+    """
+
+    metric: str
+    kernel: str
+    tier: str  # "jit" | "aot"
+    signature: str
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    available: bool = False
+    reason: Optional[str] = None
+    compile_count: int = 1
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.metric, self.kernel, self.signature)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["key"] = f"{self.metric}.{self.kernel}[{self.signature}]"
+        return d
+
+
+class _Pending:
+    """A jit-tier kernel noted at trace time, not yet lowered for analysis."""
+
+    __slots__ = ("metric", "kernel", "signature", "fn", "abstract_args", "abstract_kwargs", "count")
+
+    def __init__(self, metric: str, kernel: str, signature: str, fn: Callable,
+                 abstract_args: tuple, abstract_kwargs: dict) -> None:
+        self.metric = metric
+        self.kernel = kernel
+        self.signature = signature
+        self.fn = fn
+        self.abstract_args = abstract_args
+        self.abstract_kwargs = abstract_kwargs
+        self.count = 1
+
+
+_LOCK = threading.Lock()
+_ROWS: Dict[Tuple[str, str, str], CostRow] = {}
+_PENDING: Dict[Tuple[str, str, str], _Pending] = {}
+_RESOLVING = False  # reentrancy guard: resolution itself traces/compiles
+
+
+def _abstractify(tree: Any) -> Any:
+    """Map every array-like leaf (incl. tracers) to a ``ShapeDtypeStruct``.
+
+    Called from inside a traced body, so tracers MUST NOT survive into stored state —
+    only their shape/dtype metadata does. Non-array leaves pass through unchanged.
+    """
+    import jax
+    from jax.tree_util import tree_map
+
+    def leaf(x: Any) -> Any:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    return tree_map(leaf, tree)
+
+
+def extract_cost(compiled: Any) -> Tuple[Optional[float], Optional[float], Optional[str]]:
+    """(flops, bytes_accessed, failure_reason) from a ``Compiled`` executable.
+
+    ``cost_analysis()`` returns a dict on current JAX and a one-element list of dicts on
+    older releases; both are handled. Any absence/exception degrades to ``None`` costs.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as err:
+        return None, None, f"cost_analysis failed: {err!r}"
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None, f"cost_analysis unavailable (got {type(ca).__name__})"
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    return (
+        float(flops) if flops is not None else None,
+        float(nbytes) if nbytes is not None else None,
+        None,
+    )
+
+
+def extract_memory(compiled: Any) -> Dict[str, Optional[int]]:
+    """argument/output/temp/generated-code byte sizes from ``memory_analysis()``; Nones
+    when the backend does not expose it."""
+    empty: Dict[str, Optional[int]] = {
+        "argument_bytes": None, "output_bytes": None, "temp_bytes": None,
+        "generated_code_bytes": None,
+    }
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return empty
+    if ma is None:
+        return empty
+    def _get(attr: str) -> Optional[int]:
+        v = getattr(ma, attr, None)
+        return int(v) if v is not None else None
+    return {
+        "argument_bytes": _get("argument_size_in_bytes"),
+        "output_bytes": _get("output_size_in_bytes"),
+        "temp_bytes": _get("temp_size_in_bytes"),
+        "generated_code_bytes": _get("generated_code_size_in_bytes"),
+    }
+
+
+def record_compiled(metric: str, kernel: str, tier: str, signature: str, compiled: Any) -> None:
+    """Insert/refresh one ledger row from an in-hand ``Compiled`` executable (AOT seam)."""
+    flops, nbytes, reason = extract_cost(compiled)
+    mem = extract_memory(compiled)
+    row = CostRow(
+        metric=metric, kernel=kernel, tier=tier, signature=signature,
+        flops=flops, bytes_accessed=nbytes, available=reason is None, reason=reason, **mem,
+    )
+    with _LOCK:
+        prior = _ROWS.get(row.key)
+        if prior is not None:
+            row.compile_count = prior.compile_count + 1
+        _ROWS[row.key] = row
+    telemetry.counter("profiler.rows_recorded").inc()
+
+
+def note_jit_trace(owner: Any, kind: str, fn: Optional[Callable],
+                   args: tuple, kwargs: dict, signature: str) -> None:
+    """Register a jit-tier compilation for lazy cost capture (called from the trace hook).
+
+    AOT kernels (``aot_*`` kinds) are skipped — their executables are captured directly at
+    ``aot_compile``. Runs inside tracing, so only abstract shapes are retained.
+    """
+    if _RESOLVING or fn is None or kind.startswith("aot_"):
+        return
+    key = (type(owner).__name__, kind, signature)
+    with _LOCK:
+        if key in _ROWS:
+            _ROWS[key].compile_count += 1
+            return
+        pending = _PENDING.get(key)
+        if pending is not None:
+            pending.count += 1
+            return
+    try:
+        abstract_args = _abstractify(args)
+        abstract_kwargs = _abstractify(kwargs)
+    except Exception:  # pragma: no cover - defensive: profiling must never break a trace
+        return
+    with _LOCK:
+        _PENDING.setdefault(
+            key, _Pending(key[0], kind, signature, fn, abstract_args, abstract_kwargs)
+        )
+
+
+def _resolve_one(pending: _Pending) -> CostRow:
+    """Lower+compile the raw (uninstrumented) kernel once and read its analyses."""
+    import jax
+
+    try:
+        compiled = jax.jit(pending.fn).lower(
+            *pending.abstract_args, **pending.abstract_kwargs
+        ).compile()
+    except Exception as err:
+        return CostRow(
+            metric=pending.metric, kernel=pending.kernel, tier="jit",
+            signature=pending.signature, available=False,
+            reason=f"lowering for analysis failed: {err!r}", compile_count=pending.count,
+        )
+    flops, nbytes, reason = extract_cost(compiled)
+    mem = extract_memory(compiled)
+    return CostRow(
+        metric=pending.metric, kernel=pending.kernel, tier="jit",
+        signature=pending.signature, flops=flops, bytes_accessed=nbytes,
+        available=reason is None, reason=reason, compile_count=pending.count, **mem,
+    )
+
+
+def resolve_pending() -> int:
+    """Materialise every pending jit-tier entry into a ledger row; returns the count.
+
+    Each resolution is one deliberate off-hot-path compile (counted in
+    ``profiler.lazy_compiles``); a kernel that cannot be re-lowered becomes a
+    ``None``-cost row rather than raising.
+    """
+    global _RESOLVING
+    with _LOCK:
+        items = list(_PENDING.items())
+        _PENDING.clear()
+    if not items:
+        return 0
+    _RESOLVING = True
+    try:
+        for key, pending in items:
+            row = _resolve_one(pending)
+            telemetry.counter("profiler.lazy_compiles").inc()
+            with _LOCK:
+                prior = _ROWS.get(key)
+                if prior is not None:
+                    row.compile_count += prior.compile_count
+                _ROWS[key] = row
+    finally:
+        _RESOLVING = False
+    return len(items)
+
+
+def cost_ledger() -> List[Dict[str, Any]]:
+    """The process-global cost ledger: one dict per (metric, kernel, signature) row.
+
+    Resolves any pending jit-tier entries first (lazy compiles, off the hot path), then
+    returns every row sorted by metric/kernel/signature. Rows with ``available=False``
+    mark kernels whose backend exposed no cost analysis.
+    """
+    resolve_pending()
+    with _LOCK:
+        rows = sorted(_ROWS.values(), key=lambda r: r.key)
+    return [r.to_dict() for r in rows]
+
+
+def cost_profile_for(metric_cls: str) -> List[Dict[str, Any]]:
+    """Ledger rows attributed to one metric class (``Metric.cost_profile`` backend)."""
+    resolve_pending()
+    with _LOCK:
+        rows = sorted((r for r in _ROWS.values() if r.metric == metric_cls), key=lambda r: r.key)
+    return [r.to_dict() for r in rows]
+
+
+def reset_ledger() -> None:
+    """Drop every recorded and pending row (tests; process-global state)."""
+    with _LOCK:
+        _ROWS.clear()
+        _PENDING.clear()
+
+
+# ------------------------------------------------------------- sampled device timing
+_SAMPLING: Optional[bool] = None  # None = env not read yet (cached: hot-path checked)
+_EVERY: int = 16
+_TICKS: Dict[str, int] = {}
+
+
+def _read_env() -> bool:
+    global _SAMPLING, _EVERY
+    _SAMPLING = str(os.environ.get(ENV_PROFILE, "")).strip().lower() in _TRUTHY
+    try:
+        _EVERY = max(1, int(os.environ.get(ENV_PROFILE_EVERY, "16")))
+    except (TypeError, ValueError):
+        _EVERY = 16
+    return _SAMPLING
+
+
+def profiling_enabled() -> bool:
+    """Sampled-timing master switch; the env var is read once and cached (hot path)."""
+    if _SAMPLING is None:
+        return _read_env()
+    return _SAMPLING
+
+
+def set_profiling(flag: Optional[bool]) -> None:
+    """Override the sampled-timing switch (``None`` re-reads the environment). Tests."""
+    global _SAMPLING
+    if flag is None:
+        _read_env()
+    else:
+        _SAMPLING = bool(flag)
+
+
+def sample_step(tier: str) -> bool:
+    """True when THIS step should be device-timed (every Nth per tier while profiling)."""
+    if _SAMPLING is None and not _read_env():
+        return False
+    if not _SAMPLING:
+        return False
+    n = _TICKS.get(tier, 0) + 1
+    _TICKS[tier] = n
+    return n % _EVERY == 0 or n == 1
+
+
+def record_sample(tier: str, host_s: float, device_s: float) -> None:
+    """One sampled step's host/device wall split: histograms + Perfetto counter tracks.
+
+    Histograms are always-on instruments (profiling itself is the gate); the counter
+    events additionally need tracing enabled — ``ph="C"`` records plot as a time series
+    per ``args`` key in ui.perfetto.dev.
+    """
+    host_us = host_s * 1e6
+    device_us = device_s * 1e6
+    telemetry.histogram(f"profiler.host_us.{tier}").record(host_us)
+    telemetry.histogram(f"profiler.device_us.{tier}").record(device_us)
+    telemetry.counter("profiler.sampled_steps").inc()
+    if telemetry.enabled:
+        telemetry.event(
+            f"profiler.step_time.{tier}", ph="C", cat="profiler",
+            args={"device_us": round(device_us, 3), "host_us": round(host_us, 3)},
+        )
+
+
+def timing_summary() -> Dict[str, Any]:
+    """Per-tier host/device split of every sampled tier recorded so far."""
+    out: Dict[str, Any] = {}
+    for name, hist in list(telemetry._histograms.items()):
+        if not name.startswith(("profiler.host_us.", "profiler.device_us.")):
+            continue
+        kind, tier = name.rsplit(".", 1)[0].split(".")[-1], name.rsplit(".", 1)[1]
+        if hist.count:
+            out.setdefault(tier, {})[kind] = hist.summary()
+    return out
+
+
+def abstract_signature(*trees: Any) -> str:
+    """Shared signature formatting for ledger keys (the jit cache-key surrogate)."""
+    return describe_abstract(*trees)

@@ -439,12 +439,16 @@ def describe_abstract(*trees: Any) -> str:
     return ";".join(parts)
 
 
-def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict) -> None:
+def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict,
+                 fn: Optional[Callable] = None) -> None:
     """Record one jit (re)trace of ``owner``'s ``kind`` kernel.
 
     Called from inside the traced Python callable, so it fires exactly once per XLA
     compilation (jax only executes the Python body on a cache miss). Counting is always-on;
     the cache-key event needs tracing enabled; the churn warning is one-shot per instance.
+    When ``fn`` (the raw, uninstrumented kernel) is provided, the compilation is also
+    registered with the cost profiler for lazy XLA cost/memory capture — only the abstract
+    shapes are retained (see :mod:`torchmetrics_tpu.obs.profiler`).
     """
     counts = owner.__dict__.get("_tm_counts")
     if counts is None:
@@ -459,6 +463,13 @@ def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict) -> None:
         # instances compiled once each" from "one instance recompiled" — this one can
         telemetry.counter(f"jit.retrace.{cls}.{kind}").inc()
     sig = describe_abstract(args, kwargs)
+    if fn is not None:
+        from torchmetrics_tpu.obs import profiler as _profiler
+
+        try:
+            _profiler.note_jit_trace(owner, kind, fn, args, kwargs, sig)
+        except Exception:  # pragma: no cover - profiling must never break a trace
+            pass
     if telemetry.enabled:
         telemetry.event(
             f"jit.trace.{cls}.{kind}", ph="i", cat="jit",
@@ -483,7 +494,7 @@ def instrument_trace(fn: Callable, owner: Any, kind: str) -> Callable:
 
     @functools.wraps(fn)
     def wrapper(*args: Any, **kwargs: Any):
-        record_trace(owner, kind, args, kwargs)
+        record_trace(owner, kind, args, kwargs, fn=fn)
         return fn(*args, **kwargs)
 
     return wrapper

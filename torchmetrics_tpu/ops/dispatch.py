@@ -98,18 +98,37 @@ def _cpp_call(compiled: Any) -> Callable:
         return compiled
 
 
-def aot_compile(fn: Callable, example_args: Tuple, donate_argnums: Tuple[int, ...] = ()):
+def aot_compile(
+    fn: Callable,
+    example_args: Tuple,
+    donate_argnums: Tuple[int, ...] = (),
+    owner: Any = None,
+    kind: Optional[str] = None,
+):
     """``jax.jit(fn).lower(*example).compile()`` with the compile counted in telemetry.
 
     Returns the ``Compiled`` executable. ``example_args`` are concrete arrays (or
     ``ShapeDtypeStruct``s) fixing the abstract signature; donation is declared here so the
-    executable aliases the donated inputs into its outputs.
+    executable aliases the donated inputs into its outputs. When ``owner``/``kind`` name
+    the metric and kernel, the executable's XLA cost/memory analysis is captured into the
+    process-global cost ledger (``obs.cost_ledger()``) — the AOT tier's profiler seam,
+    paid once per compile and never on the step path.
     """
     import jax
 
     lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*example_args)
     compiled = lowered.compile()
     telemetry.counter("dispatch.aot_compiles").inc()
+    if owner is not None and kind is not None:
+        from torchmetrics_tpu.obs import profiler as _profiler
+
+        try:
+            _profiler.record_compiled(
+                type(owner).__name__, kind, "aot",
+                _profiler.abstract_signature(example_args), compiled,
+            )
+        except Exception:  # pragma: no cover - profiling must never break a compile
+            pass
     return compiled
 
 

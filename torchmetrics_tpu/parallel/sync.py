@@ -19,6 +19,7 @@ import dataclasses
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -85,10 +86,95 @@ class SyncedState(dict):
 
     ``world_consistent`` is False when any state fell back to its local value because the
     collective could not complete within its deadline; ``degraded_states`` names them.
+    ``gather_latency_us`` maps each state name to the wall time its gather took on THIS
+    rank — the raw material of the cross-rank skew report (:func:`skew_report`).
     """
 
     world_consistent: bool = True
     degraded_states: Tuple[str, ...] = ()
+    gather_latency_us: Dict[str, float] = {}
+
+
+# ------------------------------------------------------------------ cross-rank skew report
+#: recent per-gather latencies on this rank (bounded; feeds skew_report / obs.summary)
+_GATHER_LATENCIES_US: "deque" = deque(maxlen=1024)
+_LAST_SKEW: Optional[Dict[str, Any]] = None
+
+
+def _record_gather_latency(dur_s: float) -> None:
+    us = dur_s * 1e6
+    _GATHER_LATENCIES_US.append(us)
+    obs.telemetry.histogram("sync.gather.latency_us").record(us)
+
+
+def local_gather_stats() -> Optional[Dict[str, Any]]:
+    """Mean/p50/max of this rank's recent gather latencies; None before any sync ran."""
+    if not _GATHER_LATENCIES_US:
+        return None
+    vals = sorted(_GATHER_LATENCIES_US)
+    n = len(vals)
+    return {
+        "count": n,
+        "mean_us": round(sum(vals) / n, 1),
+        "p50_us": round(vals[n // 2], 1),
+        "max_us": round(vals[-1], 1),
+    }
+
+
+def skew_report(gather_fn: Optional[Callable] = None) -> Optional[Dict[str, Any]]:
+    """Cross-rank gather-latency skew: per-rank mean latencies → a straggler index.
+
+    Each rank contributes the mean of its recent gather latencies; the report gathers
+    them (ONE tiny extra collective at world > 1 — or ``gather_fn`` injected for tests)
+    and computes ``straggler_index = max / median`` with the offending rank named. An
+    index near 1.0 means the mesh gathers in lockstep; a rank whose collectives
+    consistently take N× the median holds every sync back by the same factor. The result
+    is cached module-wide and surfaced by ``obs.summary()`` and ``Metric.telemetry``.
+    Returns None when no gather latency has been recorded yet.
+    """
+    global _LAST_SKEW
+    local = local_gather_stats()
+    if local is None:
+        return None
+    try:
+        world = jax.process_count()
+        rank = jax.process_index()
+    except Exception:
+        world, rank = 1, 0
+    payload = np.asarray([local["mean_us"]], np.float32)
+    if gather_fn is not None:
+        gathered = [np.asarray(g).reshape(-1) for g in gather_fn(payload, None)]
+    elif world > 1:
+        gathered = [np.asarray(g).reshape(-1) for g in gather_all_arrays(jnp.asarray(payload))]
+    else:
+        gathered = [payload]
+    per_rank = [round(float(g[0]), 1) for g in gathered]
+    ranked = sorted(per_rank)
+    median = ranked[len(ranked) // 2] or 1.0
+    worst = max(per_rank)
+    report = {
+        "world": len(per_rank),
+        "rank": rank,
+        "per_rank_mean_us": per_rank,
+        "straggler_rank": int(per_rank.index(worst)),
+        "straggler_index": round(worst / median, 3) if median else 1.0,
+        "local": local,
+    }
+    _LAST_SKEW = report
+    obs.telemetry.event("sync.skew_report", cat="sync", args=report)
+    return report
+
+
+def last_skew_report() -> Optional[Dict[str, Any]]:
+    """The most recent :func:`skew_report` result (no collective); None if never run."""
+    return _LAST_SKEW
+
+
+def reset_skew_state() -> None:
+    """Drop recorded gather latencies and the cached skew report (tests)."""
+    global _LAST_SKEW
+    _GATHER_LATENCIES_US.clear()
+    _LAST_SKEW = None
 
 
 def _bounded_gather(
@@ -286,10 +372,21 @@ def process_sync(
     deadline = time.monotonic() + opts.timeout_s if opts.bounded else 0.0
     degraded: List[str] = []
 
+    gather_latency_us: Dict[str, float] = {}
+
     def run_gather(payload: Any, name: str, kw: Dict[str, Any]) -> List[Any]:
-        if not opts.bounded:
-            return gather(payload, group, **kw)
-        return _bounded_gather(gather, payload, group, kw, opts, deadline, name)
+        # per-gather wall time on THIS rank: the raw material of the cross-rank skew
+        # report (skew_report / obs.summary). A perf_counter pair is noise next to a
+        # collective, so the timing is always-on.
+        g0 = time.perf_counter()
+        try:
+            if not opts.bounded:
+                return gather(payload, group, **kw)
+            return _bounded_gather(gather, payload, group, kw, opts, deadline, name)
+        finally:
+            dur = time.perf_counter() - g0
+            gather_latency_us[name] = round(dur * 1e6, 1)
+            _record_gather_latency(dur)
 
     out: SyncedState = SyncedState()
     for name, value in state.items():
@@ -338,6 +435,7 @@ def process_sync(
                 out[name] = fx(jnp.stack(gathered))
             else:
                 raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+    out.gather_latency_us = gather_latency_us
     if degraded:
         out.world_consistent = False
         out.degraded_states = tuple(degraded)

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke chaos perf-gate
+test: jaxlint test-unit test-integration bench-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -37,6 +37,13 @@ telemetry-smoke:
 # NaN-poisoned batches — under a FIXED seed and asserts recovery to bit-identical state
 chaos:
 	TM_TPU_CHAOS_SEED=1234 python -m pytest tests/unittests/robust -q
+
+# composite multi-fault sweep (docs/robustness.md "Chaos matrix"): seeded combinations of
+# rank death mid-gather → quorum → rejoin+reconciliation, preemption mid-buffered-flush →
+# journal replay, and flapping rank → eviction → re-admission, asserting bit-identical
+# convergence with the unfaulted world for sum/mean/max/min/cat across dispatch tiers
+chaos-matrix:
+	TM_TPU_CHAOS_SEED=1234 python -m pytest tests/unittests/robust/test_chaos_matrix.py -q
 
 # perf regression gate (docs/observability.md "Cost profiling & perf gate"): re-captures
 # the XLA cost ledger for the fixed aggregation workload and diffs it — plus the latest

@@ -57,5 +57,15 @@ def _fresh_warning_cache():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _fresh_rank_health():
+    # the rank health ledger (circuit breakers) is process-global by design; reset per test
+    # so one test's evictions cannot shrink another test's gather group
+    from torchmetrics_tpu.parallel.sync import reset_health_state
+
+    reset_health_state()
+    yield
+
+
 def use_deterministic_algorithms():  # parity shim with reference conftest
     pass

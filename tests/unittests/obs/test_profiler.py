@@ -314,7 +314,7 @@ class TestSkewReport:
         m.unsync()
         tel = m.telemetry
         assert "sync" in tel
-        assert tel["sync"]["world_consistent"] is True
+        assert tel["sync"]["world_consistent"] == "full"  # tri-state grade (PR 6)
         assert "sum_value" in tel["sync"]["gather_latency_us"]
 
     def test_summary_shows_skew_tail(self):

@@ -1,0 +1,110 @@
+"""Composite multi-fault chaos matrix (``make chaos-matrix``, fixed ``TM_TPU_CHAOS_SEED``).
+
+Sweeps the seeded composite scenarios — rank death mid-gather → quorum → journal-backed
+rejoin → reconciliation, preemption mid-epoch (incl. mid-buffered-window) → ``snapshot +
+replay(journal)``, flapping rank → eviction → probe → re-admission — across
+sum/mean/max/min/cat reductions and the dispatch tiers (AOT default, jit via the env
+opt-out, buffered), asserting the matrix's headline contract: **bit-identical**
+convergence with the never-faulted world.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.robust import chaos
+
+SEED = int(os.environ.get(chaos.ENV_CHAOS_SEED, chaos.DEFAULT_SEED))
+AGGREGATORS = [SumMetric, MeanMetric, MaxMetric, MinMetric, CatMetric]
+
+
+def _assert_all_passed(results):
+    summary = chaos.ChaosMatrix.summarize(results)
+    failed = [r for r in results if not r.get("passed")]
+    assert not failed, f"chaos matrix cells failed: {summary['failed']}\n{failed}"
+    return summary
+
+
+class TestChaosMatrixSweep:
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    def test_full_matrix_bit_identical(self, cls, tmp_path):
+        matrix = chaos.ChaosMatrix(cls, workdir=str(tmp_path), seed=SEED)
+        results = matrix.run(n_batches=6, via=("forward", "update"))
+        summary = _assert_all_passed(results)
+        assert summary["cells"] == len(chaos.ChaosMatrix.SCENARIOS) * 2
+
+    @pytest.mark.parametrize("cls", [SumMetric, MeanMetric, CatMetric])
+    def test_preemption_mid_buffered_window(self, cls, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            cls, workdir=str(tmp_path), seed=SEED, scenarios=("preemption_journal_replay",)
+        )
+        results = matrix.run(n_batches=7, via=("buffered",))
+        _assert_all_passed(results)
+
+    def test_jit_tier_without_fast_dispatch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TM_TPU_FAST_DISPATCH", "0")
+        matrix = chaos.ChaosMatrix(SumMetric, workdir=str(tmp_path), seed=SEED)
+        results = matrix.run(n_batches=6, via=("forward",))
+        _assert_all_passed(results)
+
+    def test_determinism_same_seed_same_fault_steps(self, tmp_path):
+        a = chaos.ChaosMatrix(SumMetric, workdir=str(tmp_path / "a"), seed=SEED).run(n_batches=6)
+        b = chaos.ChaosMatrix(SumMetric, workdir=str(tmp_path / "b"), seed=SEED).run(n_batches=6)
+        keys = ("scenario", "death_step", "preempt_step")
+        assert [{k: r.get(k) for k in keys} for r in a] == [{k: r.get(k) for k in keys} for r in b]
+
+    def test_unknown_scenario_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="Unknown chaos scenario"):
+            chaos.ChaosMatrix(SumMetric, workdir=str(tmp_path), scenarios=("nope",))
+
+
+class TestScenarioEvidence:
+    """The matrix result records must prove the machinery fired, not just that values match."""
+
+    def test_rank_death_leaves_quorum_then_full_trail(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            SumMetric, workdir=str(tmp_path), seed=SEED, scenarios=("rank_death_quorum_rejoin",)
+        )
+        q0 = obs.telemetry.counter("sync.quorum_syncs").value
+        rec0 = obs.telemetry.counter("robust.reconciliations").value
+        (result,) = matrix.run(n_batches=6)
+        assert result["passed"] and result["bit_identical"]
+        assert result["quorum_level"] == "quorum" and result["final_level"] == "full"
+        assert result["journal_recovery"]["replayed"] >= 0
+        assert obs.telemetry.counter("sync.quorum_syncs").value > q0
+        assert obs.telemetry.counter("robust.reconciliations").value == rec0 + 1
+
+    def test_flap_scenario_evicts_and_readmits(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            SumMetric, workdir=str(tmp_path), seed=SEED, scenarios=("flap_evict_readmit",)
+        )
+        (result,) = matrix.run()
+        assert result["passed"]
+        assert result["evicted_ranks"] == (1,)
+        assert result["evictions"] >= 1 and result["readmissions"] >= 1
+        assert result["level_while_open"] == "quorum" and result["final_level"] == "full"
+        assert 1 not in (result["gather_ranks_while_open"] or ())
+
+    def test_preemption_scenario_replays_the_tail(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            MeanMetric, workdir=str(tmp_path), seed=SEED, scenarios=("preemption_journal_replay",)
+        )
+        (result,) = matrix.run(n_batches=7, via=("buffered",))
+        assert result["passed"]
+        # a mid-window preemption must have left batches only the journal saw
+        assert result["pending_at_death"] >= 0 and result["replayed"] >= result["pending_at_death"]
+
+    def test_failing_factory_reports_cell_not_abort(self, tmp_path):
+        class Broken(SumMetric):
+            def compute(self):
+                raise RuntimeError("boom at finalisation")
+
+        matrix = chaos.ChaosMatrix(
+            Broken, workdir=str(tmp_path), seed=SEED, scenarios=("preemption_journal_replay",)
+        )
+        (result,) = matrix.run(n_batches=5)
+        assert result["passed"] is False and "boom" in result["error"]

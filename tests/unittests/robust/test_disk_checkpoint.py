@@ -1,0 +1,128 @@
+"""Durable disk persistence for snapshots + the re-admission reconciliation handshake.
+
+``save_snapshot``/``load_snapshot`` (atomic temp-file + ``os.replace`` + fsync, outer
+container CRC over the serialised blob) and ``reconciliation_offer``/
+``accept_reconciliation`` (the quorum → rejoining-rank handshake, adopt and verify
+modes) — ``robust/checkpoint.py``.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.robust import checkpoint as ckpt
+from torchmetrics_tpu.utils.exceptions import ReconciliationError, SnapshotError
+
+
+class TestDiskSnapshots:
+    def test_metric_blob_round_trips_bit_identical(self, tmp_path):
+        m = MeanMetric()
+        m.update(np.asarray([1.0, 2.0, 3.0], np.float32))
+        path = tmp_path / "m.tmsnap"
+        out = ckpt.save_snapshot(m.snapshot(), path)
+        assert out == os.fspath(path) and os.path.exists(path)
+        fresh = MeanMetric()
+        fresh.restore(ckpt.load_snapshot(path))
+        assert float(fresh.compute()) == float(m.compute())
+        fresh.update(np.float32(4.0))  # accumulation continues after restore
+        assert float(fresh.compute()) == 2.5
+
+    def test_list_state_round_trips(self, tmp_path):
+        m = CatMetric()
+        m.update(np.asarray([1.0, 2.0], np.float32))
+        m.update(np.asarray([3.0], np.float32))
+        ckpt.save_snapshot(m.snapshot(), tmp_path / "c.tmsnap")
+        fresh = CatMetric()
+        fresh.restore(ckpt.load_snapshot(tmp_path / "c.tmsnap"))
+        assert np.array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+    def test_collection_blob_round_trips(self, tmp_path):
+        coll = MetricCollection({"s": SumMetric(), "m": MeanMetric()})
+        coll.update(np.asarray([2.0, 4.0], np.float32))
+        ckpt.save_snapshot(coll.snapshot(), tmp_path / "coll.tmsnap")
+        fresh = MetricCollection({"s": SumMetric(), "m": MeanMetric()})
+        fresh.restore(ckpt.load_snapshot(tmp_path / "coll.tmsnap"))
+        got, want = fresh.compute(), coll.compute()
+        assert {k: float(v) for k, v in got.items()} == {k: float(v) for k, v in want.items()}
+
+    def test_atomic_write_leaves_no_temp_files(self, tmp_path):
+        m = SumMetric()
+        m.update(np.ones(3, np.float32))
+        ckpt.save_snapshot(m.snapshot(), tmp_path / "a.tmsnap")
+        ckpt.save_snapshot(m.snapshot(), tmp_path / "a.tmsnap")  # overwrite is atomic too
+        assert sorted(os.listdir(tmp_path)) == ["a.tmsnap"]
+
+    def test_corrupted_file_rejected(self, tmp_path):
+        m = SumMetric()
+        m.update(np.ones(3, np.float32))
+        path = tmp_path / "x.tmsnap"
+        ckpt.save_snapshot(m.snapshot(), path)
+        raw = bytearray(open(path, "rb").read())
+        raw[-5] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(SnapshotError, match="checksum"):
+            ckpt.load_snapshot(path)
+
+    def test_truncated_file_rejected(self, tmp_path):
+        m = SumMetric()
+        m.update(np.ones(3, np.float32))
+        path = tmp_path / "t.tmsnap"
+        ckpt.save_snapshot(m.snapshot(), path)
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[: len(raw) - 7])
+        with pytest.raises(SnapshotError, match="truncated"):
+            ckpt.load_snapshot(path)
+
+    def test_alien_and_missing_files_rejected(self, tmp_path):
+        alien = tmp_path / "alien.bin"
+        alien.write_bytes(b"definitely not a snapshot")
+        with pytest.raises(SnapshotError, match="magic"):
+            ckpt.load_snapshot(alien)
+        with pytest.raises(SnapshotError, match="Cannot read"):
+            ckpt.load_snapshot(tmp_path / "never-written.tmsnap")
+
+    def test_save_rejects_non_snapshot_blobs(self, tmp_path):
+        with pytest.raises(SnapshotError, match="save_snapshot expects"):
+            ckpt.save_snapshot({"format": "something-else"}, tmp_path / "no.tmsnap")
+
+
+class TestReconciliationHandshake:
+    def test_adopt_mode_installs_merged_state(self):
+        quorum_side = SumMetric()
+        quorum_side.update(np.asarray([10.0], np.float32))
+        offer = ckpt.reconciliation_offer(quorum_side, responding_ranks=(0, 2), epoch=7)
+        cold = SumMetric()  # rejoining rank lost everything
+        meta = ckpt.accept_reconciliation(cold, offer, mode="adopt")
+        assert float(cold.compute()) == 10.0
+        assert meta["responding_ranks"] == (0, 2) and meta["epoch"] == 7
+
+    def test_verify_mode_keeps_recovered_state(self):
+        quorum_side = SumMetric()
+        quorum_side.update(np.asarray([10.0], np.float32))
+        offer = ckpt.reconciliation_offer(quorum_side)
+        warm = SumMetric()  # recovered its own state via snapshot+journal
+        warm.update(np.asarray([5.0], np.float32))
+        ckpt.accept_reconciliation(warm, offer, mode="verify")
+        assert float(warm.compute()) == 5.0  # untouched
+
+    def test_cross_class_offer_rejected(self):
+        offer = ckpt.reconciliation_offer(SumMetric())
+        with pytest.raises(ReconciliationError, match="rejected"):
+            ckpt.accept_reconciliation(MeanMetric(), offer, mode="adopt")
+
+    def test_alien_offer_rejected(self):
+        with pytest.raises(ReconciliationError, match="Not a reconciliation offer"):
+            ckpt.accept_reconciliation(SumMetric(), {"format": "junk"})
+        with pytest.raises(ReconciliationError, match="version"):
+            ckpt.accept_reconciliation(
+                SumMetric(), {"format": ckpt.RECONCILIATION_FORMAT, "version": 99}
+            )
+
+    def test_invalid_mode_raises(self):
+        offer = ckpt.reconciliation_offer(SumMetric())
+        with pytest.raises(ValueError, match="mode"):
+            ckpt.accept_reconciliation(SumMetric(), offer, mode="merge")

@@ -1,0 +1,338 @@
+"""Quorum degraded aggregation + rank health circuit breakers (``parallel/sync.py``).
+
+Drives the elastic sync machinery at both seams: ``process_sync`` directly with injected
+partial-capable gathers (a :class:`SyncTimeoutError` carrying per-rank ``responses``),
+and end-to-end through ``Metric.compute()`` with per-metric ``sync_options``. Pins the
+per-reduce-fx quorum semantics (sum rescale vs exact min/max/cat), the tri-state
+``world_consistent`` grade, degraded-mode re-entry back to ``full``, ragged/empty/
+single-rank edge cases, and the eviction → probe → re-admission breaker cycle.
+"""
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+
+def partial_gather(responses):
+    """A gather whose peers time out, leaving only ``responses`` (the quorum seam)."""
+
+    def gather(value, group=None, *, name=None):
+        resp = dict(responses)
+        # rank 0's payload is the caller's live value, like a real partial collective
+        if 0 in resp and resp[0] is None:
+            resp[0] = value
+        raise SyncTimeoutError("chaos: peers timed out", responses=resp)
+
+    return gather
+
+
+class TestConsistencyLevel:
+    def test_tristate_bool_and_string_semantics(self):
+        assert bool(sync_mod.FULL) is True
+        assert bool(sync_mod.QUORUM) is False
+        assert bool(sync_mod.LOCAL) is False
+        assert sync_mod.QUORUM == "quorum" and sync_mod.FULL == "full" and sync_mod.LOCAL == "local"
+
+    def test_as_consistency_coerces_legacy_bools(self):
+        assert sync_mod.as_consistency(True) == "full"
+        assert sync_mod.as_consistency(False) == "local"
+        assert sync_mod.as_consistency("quorum") == "quorum"
+        assert sync_mod.as_consistency(sync_mod.LOCAL) is sync_mod.LOCAL
+
+    def test_quorum_threshold(self):
+        assert sync_mod.quorum_threshold(None, 4) == 0  # disabled
+        assert sync_mod.quorum_threshold(2, 4) == 2  # absolute count
+        assert sync_mod.quorum_threshold(0.5, 4) == 2  # fraction, ceil
+        assert sync_mod.quorum_threshold(0.51, 4) == 3
+        assert sync_mod.quorum_threshold(99, 4) == 4  # clamped to world
+        assert sync_mod.quorum_threshold(2, 1) == 0  # single-rank world: no-op
+
+    def test_env_quorum_parse(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_QUORUM, "0.75")
+        assert sync_mod.sync_options_from_env().quorum == 0.75
+        monkeypatch.setenv(sync_mod.ENV_SYNC_QUORUM, "3")
+        assert sync_mod.sync_options_from_env().quorum == 3
+        monkeypatch.setenv(sync_mod.ENV_SYNC_QUORUM, "nope")
+        assert sync_mod.sync_options_from_env().quorum is None
+        monkeypatch.setenv(sync_mod.ENV_SYNC_EVICT_AFTER, "5")
+        monkeypatch.setenv(sync_mod.ENV_SYNC_PROBE_BACKOFF, "0.5")
+        opts = sync_mod.sync_options_from_env()
+        assert opts.evict_after == 5 and opts.probe_backoff_s == 0.5
+
+
+class TestQuorumAggregation:
+    def test_sum_rescales_to_full_world_estimate(self):
+        gather = partial_gather({0: None, 1: jnp.asarray(7.0, jnp.float32)})
+        c0 = obs.telemetry.counter("sync.quorum_syncs").value
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=2),
+            )
+        assert float(out["total"]) == (5.0 + 7.0) * 2  # * world/k = 4/2
+        assert out.world_consistent == "quorum" and not out.world_consistent
+        assert out.quorum_states == ("total",)
+        assert out.responding_ranks == {"total": (0, 1)}
+        assert out.degraded_states == ()
+        assert obs.telemetry.counter("sync.quorum_syncs").value == c0 + 1
+
+    def test_sum_exact_partial_when_rescale_off(self):
+        gather = partial_gather({0: None, 1: jnp.asarray(7.0, jnp.float32)})
+        with pytest.warns(UserWarning, match="exact partial sums"):
+            out = sync_mod.process_sync(
+                {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"},
+                gather_fn=gather,
+                options=sync_mod.SyncOptions(world=4, quorum=2, quorum_rescale=False),
+            )
+        assert float(out["total"]) == 12.0
+
+    def test_integer_count_state_keeps_dtype_under_rescale(self):
+        gather = partial_gather({0: None, 1: jnp.asarray(3, jnp.int32)})
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"n": jnp.asarray(5, jnp.int32)}, {"n": "sum"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=3, quorum=1),
+            )
+        assert out["n"].dtype == jnp.int32
+        assert int(out["n"]) == 12  # round((5+3) * 3/2)
+
+    def test_mean_is_responders_mean(self):
+        gather = partial_gather({0: None, 1: jnp.asarray(9.0, jnp.float32)})
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"avg": jnp.asarray(3.0, jnp.float32)}, {"avg": "mean"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=2),
+            )
+        assert float(out["avg"]) == 6.0  # mean over the 2 responders, not /4
+
+    def test_min_max_exact_over_responding_subset(self):
+        gather = partial_gather({0: None, 2: jnp.asarray(11.0, jnp.float32)})
+        with pytest.warns(UserWarning, match="responding subset"):
+            out = sync_mod.process_sync(
+                {"hi": jnp.asarray(4.0, jnp.float32)}, {"hi": "max"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=2),
+            )
+        assert float(out["hi"]) == 11.0  # no rescaling of order statistics
+
+    def test_cat_list_state_assembles_ragged_responders(self):
+        # ragged per-rank shards: rank 0 has 2 elements, rank 2 has 3
+        gather = partial_gather({0: None, 2: jnp.asarray([7.0, 8.0, 9.0], jnp.float32)})
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"vals": [jnp.asarray([1.0, 2.0], jnp.float32)]}, {"vals": "cat"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=3, quorum=2),
+            )
+        assert out.world_consistent == "quorum"
+        got = [np.asarray(v) for v in out["vals"]]
+        assert len(got) == 2
+        assert np.array_equal(got[0], np.array([1.0, 2.0], np.float32))
+        assert np.array_equal(got[1], np.array([7.0, 8.0, 9.0], np.float32))
+
+    def test_quorum_not_met_falls_back_to_local(self):
+        gather = partial_gather({0: None})  # only this rank responded; quorum needs 3
+        with pytest.warns(UserWarning, match="LOCAL state"):
+            out = sync_mod.process_sync(
+                {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=3),
+            )
+        assert out.world_consistent == "local"
+        assert out.degraded_states == ("total",)
+        assert float(out["total"]) == 5.0
+
+    def test_empty_responding_set_never_divides_by_zero(self):
+        # the gather attaches NO responses at all: the local rank's own contribution is
+        # still counted, so mean/rescale arithmetic sees k=1, never k=0
+        def gather(value, group=None, *, name=None):
+            raise SyncTimeoutError("nobody answered", responses={})
+
+        with pytest.warns(UserWarning, match="LOCAL state"):
+            out = sync_mod.process_sync(
+                {"avg": jnp.asarray(5.0, jnp.float32)}, {"avg": "mean"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=2),
+            )
+        assert out.world_consistent == "local"
+        assert float(out["avg"]) == 5.0  # local value, no NaN/ZeroDivision
+        # with quorum=1 the self-response alone meets quorum; mean over k=1 is the value
+        sync_mod.reset_health_state()
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"avg": jnp.asarray(5.0, jnp.float32)}, {"avg": "mean"},
+                gather_fn=gather, options=sync_mod.SyncOptions(world=4, quorum=1),
+            )
+        assert out.world_consistent == "quorum"
+        assert np.isfinite(float(out["avg"])) and float(out["avg"]) == 5.0
+
+    def test_single_rank_world_quorum_is_noop(self):
+        out = sync_mod.process_sync(
+            {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"},
+            options=sync_mod.SyncOptions(quorum=2),
+        )
+        assert out.world_consistent == "full" and bool(out.world_consistent)
+        assert float(out["total"]) == 5.0
+        assert out.quorum_states == () and out.degraded_states == ()
+
+    def test_bounded_retry_path_carries_partial_responses(self):
+        # the partial responses must survive the worker-thread retry machinery
+        gather = partial_gather({0: None, 1: jnp.asarray(7.0, jnp.float32)})
+        opts = sync_mod.SyncOptions(timeout_s=0.5, retries=1, backoff_s=0.01, world=4, quorum=2)
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(
+                {"total": jnp.asarray(5.0, jnp.float32)}, {"total": "sum"},
+                gather_fn=gather, options=opts,
+            )
+        assert out.world_consistent == "quorum"
+        assert float(out["total"]) == 24.0
+
+
+class TestDegradedReentry:
+    """A degraded (local or quorum) sync must NOT be sticky: the next fully successful
+    sync restores ``full`` and clears every stale flag (the PR 6 regression contract)."""
+
+    def test_synced_state_flags_round_trip_local_to_full(self):
+        state = {"total": jnp.asarray(5.0, jnp.float32)}
+        red = {"total": "sum"}
+        bad = partial_gather({0: None})
+        with pytest.warns(UserWarning, match="LOCAL state"):
+            out = sync_mod.process_sync(
+                state, red, gather_fn=bad, options=sync_mod.SyncOptions(world=2, quorum=2)
+            )
+        assert out.world_consistent == "local" and out.degraded_states == ("total",)
+
+        def good(value, group=None, *, name=None):
+            return [value, jnp.asarray(7.0, jnp.float32)]
+
+        out2 = sync_mod.process_sync(
+            state, red, gather_fn=good, options=sync_mod.SyncOptions(world=2, quorum=2)
+        )
+        assert out2.world_consistent == "full" and bool(out2.world_consistent)
+        assert out2.degraded_states == () and out2.quorum_states == ()
+        assert out2.responding_ranks == {"total": (0, 1)}
+        assert float(out2["total"]) == 12.0
+
+    def test_metric_level_quorum_then_full_restores_consistency(self):
+        calls = {"n": 0}
+
+        def flaky(value, group=None, *, name=None):
+            calls["n"] += 1
+            if calls["n"] == 1:  # first sync: peer missing → quorum
+                raise SyncTimeoutError("peer down", responses={0: value})
+            return [value, jnp.zeros_like(value)]  # later syncs: healthy world
+
+        m = SumMetric(
+            dist_sync_fn=flaky,
+            distributed_available_fn=lambda: True,
+            sync_options=sync_mod.SyncOptions(world=2, quorum=1),
+        )
+        m.update(np.ones(4, np.float32))
+        assert m.world_consistent == "full"
+        with pytest.warns(UserWarning, match="QUORUM"):
+            val = m.compute()
+        assert float(val) == 8.0  # 4 local, rescaled *2 estimate
+        assert m.world_consistent == "quorum" and not m.world_consistent
+        assert m.telemetry["sync"]["quorum_states"] == ("sum_value",)
+        m.update(np.ones(2, np.float32))
+        val2 = m.compute()  # peer answers now: full-world sync
+        assert m.world_consistent == "full" and bool(m.world_consistent)
+        assert m.telemetry["sync"]["quorum_states"] == ()
+        assert m.telemetry["sync"]["degraded_states"] == ()
+        assert float(val2) == 6.0
+        m.reset()
+        assert m.world_consistent == "full"
+
+
+class TestHealthLedger:
+    def test_eviction_after_consecutive_failures(self):
+        led = sync_mod.HealthLedger(evict_after=3, probe_backoff_s=60.0)
+        c0 = obs.telemetry.counter("sync.rank_evictions").value
+        assert not led.record_failure(1)
+        assert not led.record_failure(1)
+        with pytest.warns(UserWarning, match="evicted"):
+            assert led.record_failure(1)  # breaker trips on the 3rd
+        assert led.evicted_ranks() == (1,)
+        assert obs.telemetry.counter("sync.rank_evictions").value == c0 + 1
+        group, probes = led.gather_group(world=3)
+        assert group == (0, 2) and probes == ()  # backoff far away: no probe yet
+
+    def test_success_resets_consecutive_failures(self):
+        led = sync_mod.HealthLedger(evict_after=3)
+        led.record_failure(1)
+        led.record_failure(1)
+        led.record_success(1, latency_us=100.0)
+        assert led.record_failure(1) is False  # streak restarted
+        assert led.evicted_ranks() == ()
+
+    def test_probe_backoff_and_readmission(self):
+        led = sync_mod.HealthLedger(evict_after=1, probe_backoff_s=0.05)
+        with pytest.warns(UserWarning, match="evicted"):
+            led.record_failure(2)
+        group, probes = led.gather_group(world=3)
+        assert 2 not in group
+        time.sleep(0.06)
+        group, probes = led.gather_group(world=3)
+        assert 2 in group and probes == (2,)  # backoff expired: half-open probe
+        # failed probe deepens the backoff exponent
+        led.record_failure(2)
+        assert led.ranks[2].failed_probes == 1
+        group, _ = led.gather_group(world=3)
+        assert 2 not in group  # 0.05 * 2**1 not yet elapsed
+        time.sleep(0.11)
+        group, probes = led.gather_group(world=3)
+        assert 2 in group
+        c0 = obs.telemetry.counter("sync.rank_readmissions").value
+        with pytest.warns(UserWarning, match="re-admitted"):
+            assert led.record_success(2, latency_us=50.0) is True
+        assert led.evicted_ranks() == ()
+        assert led.ranks[2].readmissions == 1
+        assert obs.telemetry.counter("sync.rank_readmissions").value == c0 + 1
+
+    def test_latency_ewma(self):
+        led = sync_mod.HealthLedger()
+        led.record_success(0, latency_us=100.0)
+        assert led.ranks[0].latency_ewma_us == 100.0
+        led.record_success(0, latency_us=200.0)
+        assert led.ranks[0].latency_ewma_us == pytest.approx(120.0)  # alpha=0.2
+        led.observe_latencies([150.0])
+        assert led.ranks[0].latency_ewma_us == pytest.approx(126.0)
+
+    def test_skew_report_carries_health(self):
+        sync_mod.reset_skew_state()
+        sync_mod._record_gather_latency(0.001)
+        sync_mod.health_ledger().record_failure(1)
+        report = sync_mod.skew_report(gather_fn=lambda v, g: [v, np.asarray([999.0])])
+        assert report is not None and "health" in report
+        assert report["health"][1]["consecutive_failures"] == 1
+        sync_mod.reset_skew_state()
+
+    def test_process_sync_drives_breaker_through_ranks_kw(self):
+        """End to end: flapping rank → eviction shrinks the gather group → quorum grade."""
+        seen_ranks = []
+
+        def gather(value, group=None, *, name=None, ranks=None):
+            seen_ranks.append(tuple(ranks))
+            responses = {r: value for r in ranks if r != 1}
+            if 1 in ranks:  # rank 1 flaps: never answers while in the group
+                raise SyncTimeoutError("rank 1 flapping", responses=responses)
+            return [responses[r] for r in ranks]
+
+        state = {"total": jnp.asarray(1.0, jnp.float32)}
+        opts = sync_mod.SyncOptions(world=3, quorum=1, evict_after=2, probe_backoff_s=60.0)
+        for _ in range(2):  # two flapping syncs trip the breaker
+            with pytest.warns(UserWarning):
+                sync_mod.process_sync(state, {"total": "sum"}, gather_fn=gather, options=opts)
+        assert sync_mod.health_ledger().evicted_ranks() == (1,)
+        from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+        reset_warning_cache()  # the quorum warning is seen-set deduped per process
+        with pytest.warns(UserWarning, match="QUORUM"):
+            out = sync_mod.process_sync(state, {"total": "sum"}, gather_fn=gather, options=opts)
+        assert seen_ranks[-1] == (0, 2)  # evicted rank no longer stalls the gather
+        assert out.world_consistent == "quorum"  # subgroup success: partial world
+        assert out.responding_ranks["total"] == (0, 2)

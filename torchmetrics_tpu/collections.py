@@ -288,11 +288,38 @@ class MetricCollection:
             _profiler.record_sample("group", t2 - t0, time.perf_counter() - tb)
         return vals
 
-    def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
+    def buffered(self, k: int, journal: Optional[Any] = None) -> "_dispatch.BufferedUpdater":
         """Deferred accumulator over the whole collection: buffer up to ``k`` ``update``
         batches host-side and flush them through one ``update_batches`` scan per compute
-        group (k·groups dispatches → groups). See :meth:`Metric.buffered`."""
-        return _dispatch.BufferedUpdater(self, k)
+        group (k·groups dispatches → groups). See :meth:`Metric.buffered`; ``journal``
+        plugs a write-ahead update journal into the buffered seam."""
+        return _dispatch.BufferedUpdater(self, k, journal=journal)
+
+    def journal(self, path: Any, every_k: int = 64, resume: bool = False) -> Any:
+        """Write-ahead journaled proxy over the whole collection (see :meth:`Metric.journal`).
+
+        One journal covers the collection: each ``update``/``forward`` batch is appended
+        durably before being applied to every member, and the ``every_k`` snapshot cycle
+        persists the member-wise collection blob."""
+        from torchmetrics_tpu.robust import journal as _journal
+
+        return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
+
+    @property
+    def world_consistent(self) -> Any:
+        """Worst member consistency grade: ``full`` only when EVERY member's last sync was.
+
+        Tri-state like :attr:`Metric.world_consistent` — ``local`` if any member degraded
+        to local state, else ``quorum`` if any aggregated over a partial world.
+        """
+        from torchmetrics_tpu.parallel.sync import FULL, LOCAL, QUORUM, as_consistency
+
+        levels = {str(as_consistency(m.world_consistent)) for m in self.values(copy_state=False)}
+        if "local" in levels:
+            return LOCAL
+        if "quorum" in levels:
+            return QUORUM
+        return FULL
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)

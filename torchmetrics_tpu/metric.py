@@ -45,7 +45,7 @@ from jax import Array
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.obs import profiler as _profiler
 from torchmetrics_tpu.ops import dispatch as _dispatch
-from torchmetrics_tpu.parallel.sync import process_sync
+from torchmetrics_tpu.parallel.sync import FULL, SyncOptions, as_consistency, process_sync
 from torchmetrics_tpu.robust import checkpoint as _checkpoint
 from torchmetrics_tpu.robust import guardrails as _guardrails
 from torchmetrics_tpu.utils.checks import is_traced
@@ -184,6 +184,9 @@ class Metric:
         if not isinstance(self.compute_with_cache, bool):
             raise ValueError("Expected keyword argument `compute_with_cache` to be a `bool`")
         self._nan_policy = _guardrails.validate_policy(kwargs.pop("nan_policy", "propagate"))
+        self.sync_options = kwargs.pop("sync_options", None)
+        if self.sync_options is not None and not isinstance(self.sync_options, SyncOptions):
+            raise ValueError("Expected keyword argument `sync_options` to be a SyncOptions or None")
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -206,7 +209,7 @@ class Metric:
         self._jit_cache: Dict[str, Any] = {}
         self._buffered_pending = 0  # batches held by a BufferedUpdater (state stale until flush)
         self._state_shared = False  # True while compute-group members alias this state (gates donation)
-        self._world_consistent = True  # False after a degraded (local-only) multi-process sync
+        self._world_consistent = FULL  # degrades to "quorum"/"local" after a partial sync
         if self._nan_policy != "propagate":
             # in-graph poison counter rides the normal state machinery: sum-reduced, reset
             # with reset(), donated/scanned/buffered like any accumulator — update/forward
@@ -917,7 +920,7 @@ class Metric:
             _profiler.record_sample("aot", t2 - t0, time.perf_counter() - tb)
         return batch_val
 
-    def buffered(self, k: int) -> "_dispatch.BufferedUpdater":
+    def buffered(self, k: int, journal: Optional[Any] = None) -> "_dispatch.BufferedUpdater":
         """Deferred accumulator: buffer up to ``k`` ``update`` batches host-side and flush
         them through the compiled ``update_scan`` program in ONE launch (k dispatches → 1).
 
@@ -930,8 +933,28 @@ class Metric:
                 for preds, target in loader:
                     buf.update(preds, target)
             value = metric.compute()
+
+        ``journal`` plugs a :class:`~torchmetrics_tpu.robust.journal.Journal` into the
+        buffered seam: each batch is appended durably at ``update`` time (write-ahead),
+        so a preemption mid-window loses nothing — recovery replays the journaled tail.
         """
-        return _dispatch.BufferedUpdater(self, k)
+        return _dispatch.BufferedUpdater(self, k, journal=journal)
+
+    def journal(
+        self, path: Any, every_k: int = 64, resume: bool = False
+    ) -> "Any":
+        """Write-ahead journaled proxy: every batch is durable on disk BEFORE it is applied.
+
+        Returns a :class:`~torchmetrics_tpu.robust.journal.MetricJournal` — drive
+        ``update``/``forward`` through it and a preempted process restores
+        ``snapshot + replay(journal)`` bit-identically via ``resume=True`` (or
+        :func:`torchmetrics_tpu.robust.journal.recover`). A durable snapshot is taken and
+        the journal truncated every ``every_k`` appends, bounding disk and replay cost.
+        See ``docs/robustness.md`` ("Preemption-safe update journal").
+        """
+        from torchmetrics_tpu.robust import journal as _journal
+
+        return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
@@ -983,12 +1006,19 @@ class Metric:
         """Gather+reduce every state across the world (reference ``metric.py:426-456``)."""
         obs.bump(self, "sync_calls")
         synced = process_sync(
-            self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn, group=process_group
+            self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
+            group=process_group, options=self.sync_options,
         )
-        # a bounded sync may have degraded to local-only state (docs/robustness.md)
-        self._world_consistent = bool(getattr(synced, "world_consistent", True))
+        # a bounded sync may have degraded to quorum or local-only state; a subsequent
+        # fully successful sync restores "full" and clears the stale flags below — the
+        # grade always reflects the LATEST sync, never a sticky historical one
+        self._world_consistent = as_consistency(getattr(synced, "world_consistent", True))
         self._tm_last_sync = {
-            "world_consistent": self._world_consistent,
+            "world_consistent": str(self._world_consistent),
+            "degraded_states": tuple(getattr(synced, "degraded_states", ()) or ()),
+            "quorum_states": tuple(getattr(synced, "quorum_states", ()) or ()),
+            "responding_ranks": dict(getattr(synced, "responding_ranks", {}) or {}),
+            "readmitted_ranks": tuple(getattr(synced, "readmitted_ranks", ()) or ()),
             "gather_latency_us": dict(getattr(synced, "gather_latency_us", {}) or {}),
         }
         for name in list(self._state.tensors):
@@ -1108,7 +1138,7 @@ class Metric:
         self._state.maybe_aliased = True  # tensors alias the defaults again
         self._cache = None
         self._is_synced = False
-        self._world_consistent = True
+        self._world_consistent = FULL
 
     # -------------------------------------------------------------- fault tolerance
     @property
@@ -1153,13 +1183,19 @@ class Metric:
         # "mask": the values never reached the accumulators; the count is informational
 
     @property
-    def world_consistent(self) -> bool:
-        """False when the last multi-process sync degraded to local-only state.
+    def world_consistent(self) -> "Any":
+        """Tri-state consistency grade of the last multi-process sync: full/quorum/local.
 
-        Set by ``process_sync`` running with a bounded :class:`SyncOptions` whose
-        deadline/retry budget was exhausted under ``degraded_mode``; reset() restores True.
+        A :class:`~torchmetrics_tpu.parallel.sync.ConsistencyLevel` — compares as a
+        string (``m.world_consistent == "quorum"``) and keeps PR-4 bool semantics:
+        truthy ONLY when fully world-consistent. ``quorum`` means at least one state was
+        aggregated over a responding subset (timeout quorum, or an evicted rank missing
+        from the gather group); ``local`` means a state fell back to this process's
+        value. A subsequent fully successful sync — or ``reset()`` — restores ``full``.
+        ``_tm_last_sync`` (surfaced via ``telemetry["sync"]``) carries the detailed
+        flags: degraded/quorum state names, per-state responding ranks, re-admissions.
         """
-        return self.__dict__.get("_world_consistent", True)
+        return self.__dict__.get("_world_consistent", FULL)
 
     def snapshot(self) -> Dict[str, Any]:
         """Durable, versioned, CRC-checksummed host-side state blob (full fidelity).

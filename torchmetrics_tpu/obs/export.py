@@ -71,6 +71,13 @@ _ALWAYS_TABULATED = (
     "robust.injected_faults",
     "robust.recovered",
     "robust.sync_retries",
+    # elastic sync + write-ahead journal (PR 6): quorum degradations, circuit-breaker
+    # evictions/re-admissions, journal append/replay audit trail
+    "sync.quorum_syncs",
+    "sync.rank_evictions",
+    "sync.rank_readmissions",
+    "robust.journal_appends",
+    "robust.journal_replays",
     # dispatch tiers (docs/performance.md)
     "dispatch.aot_compiles",
     "dispatch.aot_fallbacks",
@@ -132,6 +139,14 @@ def summary(registry: Optional[Telemetry] = None) -> str:
                     f" straggler_index={skew['straggler_index']}"
                     f" per_rank_mean_us={skew['per_rank_mean_us']}"
                 )
+            ledger = _sync.health_ledger()
+            if ledger.ranks:
+                per_rank = ", ".join(
+                    f"r{h['rank']}:fail={h['consecutive_failures']}/{h['total_failures']}"
+                    f" ewma={h['latency_ewma_us']}us" + (" EVICTED" if h["evicted"] else "")
+                    for h in ledger.report().values()
+                )
+                tail.append(f"sync rank health: {per_rank}")
         except Exception:  # pragma: no cover - summary must render regardless
             pass
     return "\n".join([header] + lines + tail)
@@ -177,6 +192,13 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "robust_recovered": counters.get("robust.recovered", 0),
         "robust_degraded_syncs": counters.get("robust.degraded_syncs", 0),
         "robust_nonfinite_detected": counters.get("robust.nonfinite_detected", 0),
+        # elastic sync (quorum aggregation + rank circuit breakers) and the write-ahead
+        # journal: a bench that ran through partial worlds or replayed a WAL says so
+        "sync_quorum_syncs": counters.get("sync.quorum_syncs", 0),
+        "sync_rank_evictions": counters.get("sync.rank_evictions", 0),
+        "sync_rank_readmissions": counters.get("sync.rank_readmissions", 0),
+        "robust_journal_appends": counters.get("robust.journal_appends", 0),
+        "robust_journal_replays": counters.get("robust.journal_replays", 0),
         # cost profiler (docs/observability.md): ledger rows captured during this run and
         # how many sampled device-timing steps fed the per-tier host/device split
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),

@@ -262,13 +262,21 @@ class BufferedUpdater:
     a clean :class:`TorchMetricsUserError` until the buffer flushes. A shape/structure
     change between buffered batches flushes the pending stack first (stacking requires
     uniform shapes), so ragged tails degrade gracefully instead of erroring.
+
+    ``journal`` is the robustness layer's write-ahead seam: when set (any object with an
+    ``append(args, kwargs)`` method — canonically
+    :class:`torchmetrics_tpu.robust.journal.Journal`), each batch is journaled durably at
+    ``update`` time, BEFORE it enters the host-side window. A preemption that strikes
+    with batches pending therefore loses nothing: recovery replays the journaled stream,
+    including the un-flushed window (docs/robustness.md).
     """
 
-    def __init__(self, target: Any, k: int) -> None:
+    def __init__(self, target: Any, k: int, journal: Optional[Any] = None) -> None:
         if int(k) < 1:
             raise ValueError(f"buffered(k) needs k >= 1, got {k}")
         self._target = target
         self._k = int(k)
+        self._journal = journal
         self._pending: List[Tuple[tuple, dict]] = []
         self._pending_key: Optional[Tuple] = None
 
@@ -291,6 +299,9 @@ class BufferedUpdater:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Buffer one batch; flushes automatically when ``k`` batches are pending."""
+        if self._journal is not None:
+            # write-ahead: the batch is durable before it is merely pending in memory
+            self._journal.append(args, kwargs)
         key = _batch_key(args, kwargs)
         if self._pending and key != self._pending_key:
             self.flush()

@@ -22,22 +22,44 @@ Three sync contexts are supported:
    torch.distributed-style one-replica-per-process layout.
 """
 from torchmetrics_tpu.parallel.sync import (
+    FULL,
+    LOCAL,
+    QUORUM,
+    ConsistencyLevel,
+    HealthLedger,
+    RankHealth,
     SyncedState,
     SyncOptions,
     all_gather_object_shapes,
+    as_consistency,
     gather_all_arrays,
+    health_ledger,
     process_sync,
+    quorum_threshold,
+    reset_health_state,
+    skew_report,
     sync_options_from_env,
     sync_state,
 )
 from torchmetrics_tpu.parallel.mesh import local_mesh
 
 __all__ = [
+    "FULL",
+    "LOCAL",
+    "QUORUM",
+    "ConsistencyLevel",
+    "HealthLedger",
+    "RankHealth",
     "SyncOptions",
     "SyncedState",
+    "as_consistency",
     "sync_state",
     "gather_all_arrays",
+    "health_ledger",
     "process_sync",
+    "quorum_threshold",
+    "reset_health_state",
+    "skew_report",
     "sync_options_from_env",
     "all_gather_object_shapes",
     "local_mesh",

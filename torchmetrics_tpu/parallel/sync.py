@@ -12,15 +12,27 @@ gather+``cat``        all_gather → concat dim0                  ``lax.all_gath
 ``None``             all_gather → list of replicas              ``lax.all_gather`` (new axis)
 uneven shapes        gather sizes → pad → gather → trim         static pad-to-capacity + mask
 ==================  =========================================  =============================
+
+Elastic degraded modes (docs/robustness.md "Quorum sync and rank health"): a bounded
+``process_sync`` no longer collapses straight to local-only state on timeout. With
+``SyncOptions(quorum=...)`` it aggregates over the ranks that DID respond (sum rescaled
+``world/k``, mean over responders, min/max/cat exact over the responding subset), reports
+``SyncedState.responding_ranks``, and grades ``world_consistent`` as a tri-state
+(``full | quorum | local``). A process-global :class:`HealthLedger` tracks per-rank
+consecutive timeouts and latency EWMA, evicts a flapping rank from the gather group after
+``evict_after`` failures (circuit breaker), probes it with exponential backoff, and
+re-admits it on the first successful probe — re-admission state reconciliation rides
+``torchmetrics_tpu.robust.checkpoint`` blobs.
 """
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,29 +50,93 @@ ENV_SYNC_TIMEOUT = "TM_TPU_SYNC_TIMEOUT_S"
 ENV_SYNC_RETRIES = "TM_TPU_SYNC_RETRIES"
 ENV_SYNC_BACKOFF = "TM_TPU_SYNC_BACKOFF_S"
 ENV_SYNC_DEGRADED = "TM_TPU_SYNC_DEGRADED"
+ENV_SYNC_QUORUM = "TM_TPU_SYNC_QUORUM"
+ENV_SYNC_EVICT_AFTER = "TM_TPU_SYNC_EVICT_AFTER"
+ENV_SYNC_PROBE_BACKOFF = "TM_TPU_SYNC_PROBE_BACKOFF_S"
+
+
+class ConsistencyLevel(str):
+    """Tri-state world-consistency grade of a sync: ``full | quorum | local``.
+
+    A ``str`` subclass so the grade serialises/compares naturally (``level == "quorum"``),
+    with boolean semantics preserved from the PR-4 bool era: ``bool(level)`` is True ONLY
+    for ``full`` — code that did ``if not synced.world_consistent: ...`` still treats any
+    degraded sync (quorum OR local) as non-world-consistent.
+    """
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return str.__eq__(self, "full")
+
+
+FULL = ConsistencyLevel("full")
+QUORUM = ConsistencyLevel("quorum")
+LOCAL = ConsistencyLevel("local")
+
+
+def as_consistency(value: Any) -> ConsistencyLevel:
+    """Coerce a legacy bool (or raw string) consistency flag to its tri-state grade."""
+    if isinstance(value, ConsistencyLevel):
+        return value
+    if isinstance(value, str):
+        if value == "quorum":
+            return QUORUM
+        return FULL if value == "full" else LOCAL
+    return FULL if value else LOCAL
 
 
 @dataclasses.dataclass(frozen=True)
 class SyncOptions:
-    """Bounding policy for the eager multi-process sync path (``process_sync``).
+    """Bounding + elasticity policy for the eager multi-process sync path (``process_sync``).
 
     ``timeout_s == 0`` (the default) disables bounding entirely — gathers run inline on
     the calling thread with zero added overhead, exactly the pre-PR-4 behaviour. With a
     positive timeout each gather runs on a worker thread against a *whole-sync* deadline;
     a timed-out or crashed gather is retried up to ``retries`` times with exponential
-    backoff (``backoff_s * 2**attempt``), and on exhaustion the sync either falls back to
-    the local state (``degraded_mode=True``: result marked non-world-consistent, rank-zero
-    warning, ``robust.degraded_syncs`` counter) or raises :class:`SyncTimeoutError`.
+    backoff (``backoff_s * 2**attempt``). On exhaustion, in order of preference:
+
+    1. **quorum** (``quorum`` set, and the partial responses the gather attached to its
+       :class:`SyncTimeoutError` cover at least the quorum): aggregate over the responding
+       ranks — ``sum`` rescaled by ``world/k`` (``quorum_rescale=False`` keeps the exact
+       partial sum), ``mean`` over responders, ``min``/``max``/``cat`` exact over the
+       responding subset. The result grades ``world_consistent="quorum"``.
+    2. **local fallback** (``degraded_mode=True``): the state keeps its LOCAL value and the
+       result grades ``world_consistent="local"``.
+    3. **strict** (``degraded_mode=False``): :class:`SyncTimeoutError` propagates.
+
+    ``quorum`` is an absolute rank count (int ≥ 1) or a world fraction (float in (0, 1]).
+    ``world`` overrides ``jax.process_count()`` — for simulated worlds driven through an
+    injected ``gather_fn`` (tests, chaos harness); leave None in real deployments.
+    ``evict_after``/``probe_backoff_s`` configure the per-rank circuit breaker
+    (:class:`HealthLedger`): a rank missing ``evict_after`` consecutive syncs is evicted
+    from the gather group and probed with exponential backoff until it answers again.
     """
 
     timeout_s: float = 0.0
     retries: int = 2
     backoff_s: float = 0.05
     degraded_mode: bool = True
+    quorum: Optional[Union[int, float]] = None
+    quorum_rescale: bool = True
+    world: Optional[int] = None
+    evict_after: int = 3
+    probe_backoff_s: float = 1.0
 
     @property
     def bounded(self) -> bool:
         return self.timeout_s > 0
+
+
+def _parse_quorum(raw: Optional[str]) -> Optional[Union[int, float]]:
+    """``"0.5"`` → fraction of world, ``"2"`` → absolute rank count, unset/invalid → None."""
+    if not raw:
+        return None
+    try:
+        val = float(raw) if "." in raw else int(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val > 0 else None
 
 
 def sync_options_from_env() -> SyncOptions:
@@ -78,21 +154,206 @@ def sync_options_from_env() -> SyncOptions:
         backoff_s=_f(ENV_SYNC_BACKOFF, 0.05),
         degraded_mode=str(os.environ.get(ENV_SYNC_DEGRADED, "1")).strip().lower()
         not in ("0", "false", "no", "off"),
+        quorum=_parse_quorum(os.environ.get(ENV_SYNC_QUORUM)),
+        evict_after=int(_f(ENV_SYNC_EVICT_AFTER, 3)),
+        probe_backoff_s=_f(ENV_SYNC_PROBE_BACKOFF, 1.0),
     )
 
 
 class SyncedState(dict):
     """``process_sync`` result: a plain state dict plus world-consistency metadata.
 
-    ``world_consistent`` is False when any state fell back to its local value because the
-    collective could not complete within its deadline; ``degraded_states`` names them.
+    ``world_consistent`` is the tri-state :class:`ConsistencyLevel` — ``full`` when every
+    state gathered from the whole world, ``quorum`` when at least one state aggregated
+    over a responding subset (quorum fallback or a circuit-broken gather group), ``local``
+    when any state fell back to its purely local value. Bool contexts keep the PR-4
+    meaning: truthy only for ``full``. ``degraded_states`` names the local-fallback
+    states, ``quorum_states`` the subset-aggregated ones, ``responding_ranks`` maps each
+    state to the ranks whose contribution its value covers, and ``readmitted_ranks``
+    lists circuit-broken ranks that answered their probe during THIS sync.
     ``gather_latency_us`` maps each state name to the wall time its gather took on THIS
     rank — the raw material of the cross-rank skew report (:func:`skew_report`).
     """
 
-    world_consistent: bool = True
+    world_consistent: ConsistencyLevel = FULL
     degraded_states: Tuple[str, ...] = ()
+    quorum_states: Tuple[str, ...] = ()
+    responding_ranks: Dict[str, Tuple[int, ...]] = {}
+    readmitted_ranks: Tuple[int, ...] = ()
     gather_latency_us: Dict[str, float] = {}
+
+
+# ------------------------------------------------------------------ rank health ledger
+@dataclasses.dataclass
+class RankHealth:
+    """Per-rank health record: consecutive-timeout breaker state + latency EWMA."""
+
+    rank: int
+    consecutive_failures: int = 0
+    total_failures: int = 0
+    successes: int = 0
+    latency_ewma_us: Optional[float] = None
+    evicted: bool = False
+    evicted_at: float = 0.0  # monotonic timestamp of eviction / last failed probe
+    failed_probes: int = 0  # probe attempts since eviction (backoff exponent)
+    readmissions: int = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rank": self.rank,
+            "consecutive_failures": self.consecutive_failures,
+            "total_failures": self.total_failures,
+            "successes": self.successes,
+            "latency_ewma_us": None if self.latency_ewma_us is None else round(self.latency_ewma_us, 1),
+            "evicted": self.evicted,
+            "failed_probes": self.failed_probes,
+            "readmissions": self.readmissions,
+        }
+
+
+class HealthLedger:
+    """Process-global per-rank health: circuit breakers over the eager gather group.
+
+    A rank that misses ``evict_after`` consecutive syncs is **evicted**: subsequent
+    gathers exclude it (so one flapping peer stops stalling every sync at the full
+    deadline) and grade ``quorum``. Evicted ranks are **probed** by re-including them in
+    the gather group once their backoff (``probe_backoff_s * 2**failed_probes``, capped)
+    expires — a successful probe **re-admits** the rank (``sync.rank_readmissions``); a
+    failed one deepens the backoff. Latency EWMA per rank is fed by :func:`skew_report`'s
+    cross-rank mean gathers and surfaced in its output and ``obs.summary()``.
+
+    Rank attribution requires a gather that can name responders (partial ``responses`` on
+    its :class:`SyncTimeoutError`, or a ``ranks=...``-aware subgroup gather). The stock
+    ``multihost_utils.process_allgather`` path is all-or-nothing, so with it the ledger
+    simply never accumulates failures — behaviour is unchanged.
+    """
+
+    EWMA_ALPHA = 0.2
+
+    def __init__(self, evict_after: int = 3, probe_backoff_s: float = 1.0, probe_backoff_cap_s: float = 60.0) -> None:
+        self.evict_after = evict_after
+        self.probe_backoff_s = probe_backoff_s
+        self.probe_backoff_cap_s = probe_backoff_cap_s
+        self.ranks: Dict[int, RankHealth] = {}
+
+    def configure(self, opts: "SyncOptions") -> None:
+        """Adopt the breaker thresholds of the sync options driving the current sync."""
+        self.evict_after = max(1, int(opts.evict_after)) if opts.evict_after else 0
+        self.probe_backoff_s = max(0.0, float(opts.probe_backoff_s))
+
+    def _get(self, rank: int) -> RankHealth:
+        h = self.ranks.get(rank)
+        if h is None:
+            h = self.ranks[rank] = RankHealth(rank=int(rank))
+        return h
+
+    def record_success(self, rank: int, latency_us: Optional[float] = None) -> bool:
+        """Mark a responding rank healthy; returns True when this re-admitted an evictee."""
+        h = self._get(rank)
+        h.successes += 1
+        h.consecutive_failures = 0
+        if latency_us is not None:
+            if h.latency_ewma_us is None:
+                h.latency_ewma_us = float(latency_us)
+            else:
+                h.latency_ewma_us += self.EWMA_ALPHA * (float(latency_us) - h.latency_ewma_us)
+        if h.evicted:
+            h.evicted = False
+            h.failed_probes = 0
+            h.readmissions += 1
+            obs.telemetry.counter("sync.rank_readmissions").inc()
+            obs.telemetry.event("sync.rank_readmitted", cat="sync", args={"rank": h.rank})
+            rank_zero_warn(
+                f"process_sync: rank {h.rank} answered its health probe and was re-admitted"
+                " to the gather group. Reconcile its state before trusting full-world"
+                " results (docs/robustness.md, 'Re-admission handshake').",
+                UserWarning,
+            )
+            return True
+        return False
+
+    def record_failure(self, rank: int) -> bool:
+        """Mark a missing rank; returns True when this call tripped its circuit breaker."""
+        h = self._get(rank)
+        h.total_failures += 1
+        h.consecutive_failures += 1
+        now = time.monotonic()
+        if h.evicted:
+            # a failed probe: deepen the backoff, restart its clock
+            h.failed_probes += 1
+            h.evicted_at = now
+            return False
+        if self.evict_after and h.consecutive_failures >= self.evict_after:
+            h.evicted = True
+            h.evicted_at = now
+            h.failed_probes = 0
+            obs.telemetry.counter("sync.rank_evictions").inc()
+            obs.telemetry.event(
+                "sync.rank_evicted", cat="sync",
+                args={"rank": h.rank, "consecutive_failures": h.consecutive_failures},
+            )
+            rank_zero_warn(
+                f"process_sync: rank {h.rank} missed {h.consecutive_failures} consecutive"
+                " sync(s) and was evicted from the gather group (circuit breaker). It will"
+                f" be probed with exponential backoff (base {self.probe_backoff_s:g}s) and"
+                " re-admitted when it answers.",
+                UserWarning,
+            )
+            return True
+        return False
+
+    def _probe_due(self, h: RankHealth, now: float) -> bool:
+        wait = min(self.probe_backoff_s * (2 ** h.failed_probes), self.probe_backoff_cap_s)
+        return now - h.evicted_at >= wait
+
+    def gather_group(self, world: int) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """(ranks to gather from, subset of those that are backoff-due probes)."""
+        now = time.monotonic()
+        group: List[int] = []
+        probes: List[int] = []
+        for r in range(world):
+            h = self.ranks.get(r)
+            if h is None or not h.evicted:
+                group.append(r)
+            elif self._probe_due(h, now):
+                group.append(r)
+                probes.append(r)
+        return tuple(group), tuple(probes)
+
+    def evicted_ranks(self) -> Tuple[int, ...]:
+        return tuple(sorted(r for r, h in self.ranks.items() if h.evicted))
+
+    def observe_latencies(self, per_rank_mean_us: Sequence[float]) -> None:
+        """Fold a cross-rank latency gather (``skew_report``) into the per-rank EWMAs."""
+        for rank, us in enumerate(per_rank_mean_us):
+            h = self._get(rank)
+            if h.latency_ewma_us is None:
+                h.latency_ewma_us = float(us)
+            else:
+                h.latency_ewma_us += self.EWMA_ALPHA * (float(us) - h.latency_ewma_us)
+
+    def report(self) -> Dict[int, Dict[str, Any]]:
+        return {r: h.as_dict() for r, h in sorted(self.ranks.items())}
+
+    def reset(self) -> None:
+        self.ranks.clear()
+
+
+_HEALTH: Optional[HealthLedger] = None
+
+
+def health_ledger() -> HealthLedger:
+    """The process-global rank health ledger (created on first use)."""
+    global _HEALTH
+    if _HEALTH is None:
+        _HEALTH = HealthLedger()
+    return _HEALTH
+
+
+def reset_health_state() -> None:
+    """Drop all per-rank health records (tests)."""
+    if _HEALTH is not None:
+        _HEALTH.reset()
 
 
 # ------------------------------------------------------------------ cross-rank skew report
@@ -128,9 +389,11 @@ def skew_report(gather_fn: Optional[Callable] = None) -> Optional[Dict[str, Any]
     them (ONE tiny extra collective at world > 1 — or ``gather_fn`` injected for tests)
     and computes ``straggler_index = max / median`` with the offending rank named. An
     index near 1.0 means the mesh gathers in lockstep; a rank whose collectives
-    consistently take N× the median holds every sync back by the same factor. The result
-    is cached module-wide and surfaced by ``obs.summary()`` and ``Metric.telemetry``.
-    Returns None when no gather latency has been recorded yet.
+    consistently take N× the median holds every sync back by the same factor. The
+    per-rank means also feed the :class:`HealthLedger` latency EWMAs, and the ledger's
+    breaker states ride along under ``health``. The result is cached module-wide and
+    surfaced by ``obs.summary()`` and ``Metric.telemetry``. Returns None when no gather
+    latency has been recorded yet.
     """
     global _LAST_SKEW
     local = local_gather_stats()
@@ -152,6 +415,8 @@ def skew_report(gather_fn: Optional[Callable] = None) -> Optional[Dict[str, Any]
     ranked = sorted(per_rank)
     median = ranked[len(ranked) // 2] or 1.0
     worst = max(per_rank)
+    ledger = health_ledger()
+    ledger.observe_latencies(per_rank)
     report = {
         "world": len(per_rank),
         "rank": rank,
@@ -160,6 +425,9 @@ def skew_report(gather_fn: Optional[Callable] = None) -> Optional[Dict[str, Any]
         "straggler_index": round(worst / median, 3) if median else 1.0,
         "local": local,
     }
+    if ledger.ranks:
+        report["health"] = ledger.report()
+        report["evicted_ranks"] = ledger.evicted_ranks()
     _LAST_SKEW = report
     obs.telemetry.event("sync.skew_report", cat="sync", args=report)
     return report
@@ -186,15 +454,19 @@ def _bounded_gather(
     The gather runs on a daemon worker thread so a peer that never answers cannot wedge
     the training process — the thread is abandoned at timeout (there is no portable way
     to cancel a blocked collective; abandonment + retry/degrade is the honest contract).
-    Raises :class:`SyncTimeoutError` when the deadline/retry budget is exhausted.
+    Raises :class:`SyncTimeoutError` when the deadline/retry budget is exhausted,
+    carrying any partial per-rank ``responses`` the last failed gather attached so the
+    caller can attempt quorum aggregation.
     """
     attempt = 0
+    last_error: Optional[BaseException] = None
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise SyncTimeoutError(
                 f"sync of state {state_name!r} exhausted its {opts.timeout_s:g}s deadline"
-                f" after {attempt} attempt(s)"
+                f" after {attempt} attempt(s)",
+                responses=getattr(last_error, "responses", None),
             )
         result: List[Any] = []
         error: List[BaseException] = []
@@ -213,13 +485,16 @@ def _bounded_gather(
         finished = done.wait(remaining)
         if finished and result:
             return result[0]
+        if finished and error:
+            last_error = error[0]
         attempt += 1
         obs.telemetry.counter("robust.sync_retries").inc()
         if attempt > opts.retries:
-            detail = f"last error: {error[0]!r}" if (finished and error) else "gather hung past the deadline"
+            detail = f"last error: {last_error!r}" if last_error is not None else "gather hung past the deadline"
             raise SyncTimeoutError(
                 f"sync of state {state_name!r} failed after {attempt} attempt(s)"
-                f" within its {opts.timeout_s:g}s deadline ({detail})"
+                f" within its {opts.timeout_s:g}s deadline ({detail})",
+                responses=getattr(last_error, "responses", None),
             )
         # exponential backoff, capped so the sleep never outlives the deadline
         pause = min(opts.backoff_s * (2 ** (attempt - 1)), max(0.0, deadline - time.monotonic()))
@@ -339,6 +614,79 @@ def gather_all_arrays(value: Array, group: Optional[str] = None) -> List[Array]:
     return [jnp.asarray(gathered[i][: shapes[i][0]] if value.ndim else gathered[i]) for i in range(len(shapes))]
 
 
+# ------------------------------------------------------------------ quorum aggregation
+def _world_size(opts: SyncOptions) -> int:
+    if opts.world is not None:
+        return max(1, int(opts.world))
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _local_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def quorum_threshold(quorum: Optional[Union[int, float]], world: int) -> int:
+    """Minimum responding-rank count for a quorum; 0 when quorum mode is off.
+
+    A float in (0, 1] is a world fraction (ceil), an int an absolute count (clamped to
+    world). Single-rank worlds never quorum — the semantics are a no-op at world 1.
+    """
+    if not quorum or world <= 1:
+        return 0
+    if isinstance(quorum, float) and quorum <= 1.0:
+        return max(1, math.ceil(quorum * world))
+    return max(1, min(int(quorum), world))
+
+
+def _rescale_sum(value: Array, world: int, k: int) -> Array:
+    """Estimate the full-world sum from ``k`` of ``world`` contributions (``* world/k``).
+
+    The registered state dtype is preserved: integer (count-like) sums are rounded back,
+    float sums cast back from the weak-promoted product. ``k >= world`` is the identity.
+    """
+    if k >= world:
+        return value
+    scaled = value * (world / k)
+    dtype = value.dtype if hasattr(value, "dtype") else jnp.float32
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jnp.round(scaled).astype(dtype)
+    return scaled.astype(dtype)
+
+
+def _reduce_gathered(fx: ReduceFx, vals: List[Any], world: int, opts: SyncOptions) -> Any:
+    """Host-side reduction of per-rank contributions, quorum-aware for partial worlds.
+
+    With ``k = len(vals) < world``: ``sum`` is rescaled to a full-world estimate (unless
+    ``quorum_rescale=False``), ``mean`` is the responders' mean (its divisor already
+    adapts to ``k``), and ``min``/``max``/``cat``/``None``/callable are exact over the
+    responding subset — partial extremes and concatenations are true statements about the
+    ranks that answered, so no rescaling is applied.
+    """
+    k = len(vals)
+    if fx == "sum":
+        total = jnp.sum(jnp.stack(vals), axis=0)
+        return _rescale_sum(total, world, k) if opts.quorum_rescale else total
+    if fx == "mean":
+        return jnp.mean(jnp.stack(vals), axis=0)
+    if fx == "max":
+        return jnp.max(jnp.stack(vals), axis=0)
+    if fx == "min":
+        return jnp.min(jnp.stack(vals), axis=0)
+    if fx == "cat":
+        return jnp.concatenate(vals, axis=0)
+    if fx is None:
+        return jnp.stack(vals)
+    if callable(fx):
+        return fx(jnp.stack(vals))
+    raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+
+
 def process_sync(
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
@@ -350,13 +698,18 @@ def process_sync(
 
     A ``gather_fn`` that accepts a ``name`` keyword receives the state's name — gathers are then
     keyed by identity instead of having to match tensors by value (the reference's injected
-    test gathers need this; value matching can mis-map states that happen to be equal).
+    test gathers need this; value matching can mis-map states that happen to be equal). A
+    ``gather_fn`` that accepts a ``ranks`` keyword receives the circuit-broken gather group
+    (evicted ranks excluded, due probes included) and must answer with one entry per
+    requested rank, in order — the subgroup-gather seam of the :class:`HealthLedger`.
 
     With a bounded :class:`SyncOptions` (explicit argument, or the ``TM_TPU_SYNC_*`` env
-    knobs) each gather races a deadline with retry+backoff; exhausted states fall back to
-    their LOCAL value under degraded mode — the returned :class:`SyncedState` then has
-    ``world_consistent=False`` and lists them in ``degraded_states`` — or raise
-    :class:`SyncTimeoutError` when degraded mode is off. See ``docs/robustness.md``.
+    knobs) each gather races a deadline with retry+backoff; exhausted states aggregate
+    over the quorum of responding ranks when the options and partial responses allow,
+    falling back to their LOCAL value under degraded mode otherwise — the returned
+    :class:`SyncedState` grades the result ``full | quorum | local`` and names the
+    degraded/quorum states — or raise :class:`SyncTimeoutError` when degraded mode is
+    off. See ``docs/robustness.md``.
     """
     import inspect
 
@@ -364,14 +717,28 @@ def process_sync(
     opts = options if options is not None else sync_options_from_env()
     t0 = time.perf_counter() if obs.telemetry.enabled else 0.0
     gather = gather_fn or gather_all_arrays
-    takes_name = False
+    takes_name = takes_ranks = False
     try:
-        takes_name = "name" in inspect.signature(gather).parameters
+        params = inspect.signature(gather).parameters
+        takes_name = "name" in params
+        takes_ranks = "ranks" in params
     except (TypeError, ValueError):
         pass
+    world = _world_size(opts)
+    rank = _local_rank()
+    ledger = health_ledger()
+    ledger.configure(opts)
+    # circuit breakers: evicted ranks leave the gather group until their probe is due
+    gather_group: Tuple[int, ...] = tuple(range(world))
+    if world > 1 and takes_ranks:
+        gather_group, _ = ledger.gather_group(world)
+    quorum_k = quorum_threshold(opts.quorum, world)
     deadline = time.monotonic() + opts.timeout_s if opts.bounded else 0.0
     degraded: List[str] = []
-
+    quorum_states: List[str] = []
+    responding: Dict[str, Tuple[int, ...]] = {}
+    ok_ranks: set = set()
+    failed_ranks: set = set()
     gather_latency_us: Dict[str, float] = {}
 
     def run_gather(payload: Any, name: str, kw: Dict[str, Any]) -> List[Any]:
@@ -388,57 +755,100 @@ def process_sync(
             gather_latency_us[name] = round(dur * 1e6, 1)
             _record_gather_latency(dur)
 
+    def note_responders(name: str, ranks_responded: Any) -> None:
+        resp = tuple(sorted(int(r) for r in ranks_responded))
+        responding[name] = resp
+        if world > 1:
+            ok_ranks.update(resp)
+            failed_ranks.update(r for r in gather_group if r not in resp)
+
     out: SyncedState = SyncedState()
     for name, value in state.items():
         fx = reductions.get(name, "sum")
-        kw = {"name": name} if takes_name else {}
-        if isinstance(value, (list, tuple)):
-            if len(value) == 0 and jax.process_count() == 1:
-                out[name] = list(value)
-                continue
-            cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
-            try:
-                gathered = run_gather(cat, name, kw)
-            except SyncTimeoutError:
-                if not opts.degraded_mode:
-                    raise
-                degraded.append(name)
-                out[name] = list(value)
-                continue
-            out[name] = [g for g in gathered]
+        kw: Dict[str, Any] = {}
+        if takes_name:
+            kw["name"] = name
+        if takes_ranks and world > 1:
+            kw["ranks"] = gather_group
+        is_list = isinstance(value, (list, tuple))
+        if is_list and len(value) == 0 and jax.process_count() == 1 and world == 1:
+            out[name] = list(value)
+            continue
+        if is_list:
+            payload = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
         else:
-            try:
-                gathered = run_gather(value, name, kw)
-            except SyncTimeoutError:
-                if not opts.degraded_mode:
-                    raise
-                degraded.append(name)
-                out[name] = value
+            payload = value
+        try:
+            gathered = run_gather(payload, name, kw)
+        except SyncTimeoutError as err:
+            partial = dict(getattr(err, "responses", None) or {})
+            # this rank's own contribution always "responds" — k >= 1, so the quorum
+            # mean/rescale arithmetic can never divide by zero
+            partial.setdefault(rank, payload)
+            if quorum_k and len(partial) >= quorum_k:
+                vals = [partial[r] for r in sorted(partial)]
+                out[name] = list(vals) if is_list else _reduce_gathered(fx, vals, world, opts)
+                quorum_states.append(name)
+                note_responders(name, partial.keys())
                 continue
-            if len(gathered) == 1:
-                out[name] = gathered[0]
-                continue
-            stacked = jnp.stack(gathered) if fx in ("sum", "mean", "max", "min") else None
-            if fx == "sum":
-                out[name] = jnp.sum(stacked, axis=0)
-            elif fx == "mean":
-                out[name] = jnp.mean(stacked, axis=0)
-            elif fx == "max":
-                out[name] = jnp.max(stacked, axis=0)
-            elif fx == "min":
-                out[name] = jnp.min(stacked, axis=0)
-            elif fx == "cat":
-                out[name] = jnp.concatenate(gathered, axis=0)
-            elif fx is None:
-                out[name] = jnp.stack(gathered)
-            elif callable(fx):
-                out[name] = fx(jnp.stack(gathered))
-            else:
-                raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
+            if not opts.degraded_mode:
+                raise
+            degraded.append(name)
+            out[name] = list(value) if is_list else value
+            note_responders(name, partial.keys())
+            continue
+        # successful gather: attribute the entries to ranks where the layout allows
+        resp: Optional[Tuple[int, ...]] = None
+        if takes_ranks and world > 1 and len(gathered) == len(gather_group):
+            resp = gather_group
+        elif len(gathered) == world:
+            resp = tuple(range(world))
+        if resp is not None:
+            note_responders(name, resp)
+            if len(resp) < world:
+                quorum_states.append(name)  # subgroup gather: evicted ranks not covered
+        if is_list:
+            out[name] = [g for g in gathered]
+            continue
+        if len(gathered) == 1 and world == 1:
+            out[name] = gathered[0]
+            continue
+        out[name] = _reduce_gathered(fx, list(gathered), world, opts)
+
+    # one health mark per rank per sync: any missed state counts as a miss
+    readmitted: List[int] = []
+    if world > 1 and (ok_ranks or failed_ranks):
+        latencies = list(gather_latency_us.values())
+        mean_lat = (sum(latencies) / len(latencies)) if latencies else None
+        for r in sorted(ok_ranks - failed_ranks):
+            if ledger.record_success(r, mean_lat):
+                readmitted.append(r)
+        for r in sorted(failed_ranks):
+            ledger.record_failure(r)
+
+    level = LOCAL if degraded else (QUORUM if quorum_states else FULL)
+    out.world_consistent = level
+    out.degraded_states = tuple(degraded)
+    out.quorum_states = tuple(dict.fromkeys(quorum_states))
+    out.responding_ranks = dict(responding)
+    out.readmitted_ranks = tuple(readmitted)
     out.gather_latency_us = gather_latency_us
+    if quorum_states and not degraded:
+        obs.telemetry.counter("sync.quorum_syncs").inc()
+        obs.telemetry.event(
+            "sync.quorum", cat="sync",
+            args={"states": out.quorum_states, "responding_ranks": {k: list(v) for k, v in responding.items()},
+                  "world": world, "quorum_k": quorum_k},
+        )
+        covered = sorted({r for v in responding.values() for r in v})
+        rank_zero_warn(
+            f"process_sync degraded to QUORUM: state(s) {sorted(out.quorum_states)} aggregated"
+            f" over responding rank(s) {covered} of a {world}-rank world. Sum-reduced values"
+            f" are {'rescaled full-world estimates' if opts.quorum_rescale else 'exact partial sums'};"
+            " min/max/cat cover the responding subset only (docs/robustness.md).",
+            UserWarning,
+        )
     if degraded:
-        out.world_consistent = False
-        out.degraded_states = tuple(degraded)
         obs.telemetry.counter("robust.degraded_syncs").inc()
         obs.telemetry.event(
             "sync.degraded", cat="sync",
@@ -453,15 +863,12 @@ def process_sync(
         )
     if obs.telemetry.enabled:
         dur_us = (time.perf_counter() - t0) * 1e6
-        try:
-            world = jax.process_count()
-        except Exception:
-            world = 1
         obs.telemetry.histogram("sync.process_sync.latency_us").record(dur_us)
         obs.telemetry.event(
             "sync.process_sync", ph="X", cat="sync",
             ts_us=obs.telemetry.now_us() - dur_us, dur_us=dur_us,
-            args={"world": world, "states": sorted(state), "bytes": obs.tree_bytes(state)},
+            args={"world": world, "states": sorted(state), "bytes": obs.tree_bytes(state),
+                  "consistency": str(level)},
         )
     return out
 

@@ -10,17 +10,29 @@ owns the defence for each (ISSUE 4; full guide in ``docs/robustness.md``):
   CRC-checksummed host-side snapshots (``Metric.snapshot()`` / ``Metric.restore()``,
   ``MetricCollection`` round-trip included), crash-consistent against buffer donation
   and buffered accumulation,
-- **stragglers / dead peers** → bounded multi-process sync in
-  ``torchmetrics_tpu.parallel.sync`` (deadline + exponential backoff + retry, degraded
-  local-only fallback marked via ``Metric.world_consistent``),
+- **stragglers / dead peers** → elastic multi-process sync in
+  ``torchmetrics_tpu.parallel.sync``: deadline + exponential backoff + retry, quorum
+  aggregation over the ranks that DID respond, per-rank health circuit breakers with
+  probe/re-admission, and the tri-state ``Metric.world_consistent`` grade
+  (``full | quorum | local``),
+- **lost epoch tails** → :mod:`~torchmetrics_tpu.robust.journal`: a bounded,
+  CRC-checksummed write-ahead journal of update batches between durable snapshots
+  (``Metric.journal(dir, every_k)``), so a preempted process restores
+  ``snapshot + replay(journal)`` bit-identically,
 
 plus :mod:`~torchmetrics_tpu.robust.chaos` — the deterministic fault-injection harness
-that drives every latch and guard through its failure path (``make chaos``).
+(now with composite multi-fault scenarios and the seeded :class:`ChaosMatrix` sweep)
+that drives every latch and guard through its failure path (``make chaos`` /
+``make chaos-matrix``).
 """
 from torchmetrics_tpu.robust import checkpoint, guardrails
 from torchmetrics_tpu.robust.checkpoint import (
+    accept_reconciliation,
+    load_snapshot,
+    reconciliation_offer,
     restore_collection,
     restore_metric,
+    save_snapshot,
     snapshot_collection,
     snapshot_metric,
 )
@@ -29,21 +41,26 @@ from torchmetrics_tpu.robust.guardrails import POISON_STATE, POLICIES
 __all__ = [
     "POISON_STATE",
     "POLICIES",
+    "accept_reconciliation",
     "chaos",
     "checkpoint",
     "guardrails",
+    "journal",
+    "load_snapshot",
+    "reconciliation_offer",
     "restore_collection",
     "restore_metric",
+    "save_snapshot",
     "snapshot_collection",
     "snapshot_metric",
 ]
 
 
 def __getattr__(name: str):
-    # the chaos harness pulls in ops.dispatch; load it lazily so importing the engine
+    # the chaos harness pulls in ops.dispatch; load these lazily so importing the engine
     # (metric.py -> robust.guardrails) never depends on the dispatch layer's import order
-    if name == "chaos":
+    if name in ("chaos", "journal"):
         import importlib
 
-        return importlib.import_module("torchmetrics_tpu.robust.chaos")
+        return importlib.import_module(f"torchmetrics_tpu.robust.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -34,6 +34,7 @@ state is **bit-identical** to the unfaulted run for sum/mean/max/min/cat reducti
 """
 from __future__ import annotations
 
+import os
 import random
 import time
 import warnings
@@ -44,6 +45,7 @@ import numpy as np
 
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 from torchmetrics_tpu.utils.prints import reset_warning_cache
 
 #: env knob the chaos CI lane pins (``make chaos``); tests default to it for determinism.
@@ -60,6 +62,12 @@ def counters() -> Dict[str, int]:
         "robust.sync_retries",
         "robust.snapshots",
         "robust.restores",
+        "robust.journal_appends",
+        "robust.journal_replays",
+        "robust.reconciliations",
+        "sync.quorum_syncs",
+        "sync.rank_evictions",
+        "sync.rank_readmissions",
     )
     return {n: obs.telemetry.counter(n).value for n in names}
 
@@ -303,3 +311,353 @@ class ChaosRunner:
                 metric.restore(blob)
             snap = metric.snapshot()
         return metric
+
+
+# ---------------------------------------------------------------------------
+# Composite multi-fault scenarios + the seeded ChaosMatrix sweep (PR 6)
+# ---------------------------------------------------------------------------
+
+class SimWorld:
+    """Simulated N-rank eager world at the ``process_sync`` gather seam.
+
+    Rank 0 is the calling process (its payload arrives as ``value``); every other rank's
+    contribution is read LIVE from its sim metric instance, so the fake world stays
+    consistent as the sims accumulate. Ranks in ``down`` miss the gather: the call raises
+    :class:`SyncTimeoutError` carrying the partial per-rank ``responses`` — exactly the
+    quorum seam a partial-capable collective exposes. The ``ranks`` subgroup keyword is
+    honoured (one entry per requested rank, in order), so :class:`HealthLedger` evictions
+    genuinely shrink the gather group and probes genuinely re-include the evictee.
+    """
+
+    def __init__(self, metrics: Sequence[Any]) -> None:
+        self.metrics: List[Any] = list(metrics)
+        self.down: set = set()
+        self.calls = 0
+        self.timeouts = 0
+        self.last_ranks: Optional[Tuple[int, ...]] = None
+
+    def options(self, **kw: Any) -> Any:
+        """SyncOptions pinned to this world's size (pass quorum/evict/probe knobs)."""
+        from torchmetrics_tpu.parallel.sync import SyncOptions
+
+        return SyncOptions(world=len(self.metrics), **kw)
+
+    def state_value(self, rank: int, name: str) -> Any:
+        import jax.numpy as jnp
+
+        st = self.metrics[rank]._state
+        if name in st.lists:
+            entries = st.lists[name]
+            if not entries:
+                return jnp.zeros((0,))
+            return jnp.concatenate([jnp.atleast_1d(e) for e in entries], axis=0)
+        return st.tensors[name]
+
+    def __call__(self, value: Any, group: Any = None, *, name: Optional[str] = None,
+                 ranks: Optional[Sequence[int]] = None) -> List[Any]:
+        self.calls += 1
+        requested = tuple(ranks) if ranks is not None else tuple(range(len(self.metrics)))
+        self.last_ranks = requested
+        responses: Dict[int, Any] = {}
+        for r in requested:
+            if r == 0:
+                responses[r] = value
+            elif r not in self.down:
+                responses[r] = self.state_value(r, name)
+        if len(responses) < len(requested):
+            self.timeouts += 1
+            obs.telemetry.counter("robust.injected_faults").inc()
+            missing = sorted(set(requested) - set(responses))
+            from torchmetrics_tpu.utils.exceptions import SyncTimeoutError as _STE
+
+            raise _STE(f"chaos: rank(s) {missing} down mid-gather", responses=responses)
+        return [responses[r] for r in requested]
+
+
+def _seeded_batches(rng: random.Random, n: int, size: int = 4) -> List[Tuple[Any, ...]]:
+    """Integer-valued float batches: float reductions stay EXACT, so bit-identical means
+    bit-identical rather than within-epsilon."""
+    return [
+        (np.asarray([float(rng.randint(0, 9)) for _ in range(size)], np.float32),)
+        for _ in range(n)
+    ]
+
+
+def _identical(a: Any, b: Any) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and bool(np.array_equal(a, b, equal_nan=True))
+
+
+def _arm_sync(metric: Any, world: SimWorld, opts: Any) -> None:
+    metric.dist_sync_fn = world
+    metric.distributed_available_fn = lambda: True
+    metric.sync_options = opts
+
+
+def _step(metric: Any, batch: Tuple[Any, ...], via: str) -> None:
+    if via == "forward":
+        metric(*batch)
+    else:
+        metric.update(*batch)
+
+
+def scenario_rank_death_quorum_rejoin(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Rank death mid-gather → quorum sync → journal recovery → reconciliation → rejoin.
+
+    A 2-rank sim world accumulates disjoint shards; rank 1 journals every batch. At a
+    seeded step rank 1 dies mid-gather: rank 0's ``compute()`` must degrade to a QUORUM
+    sync (not local, not a hang). Rank 1 then "restarts": a fresh instance restores
+    ``snapshot + replay(journal)``, the quorum side ships a reconciliation offer (merged
+    view) which the warm rejoiner verifies, and the world heals. The final full-world
+    ``compute()`` must be bit-identical with a never-faulted reference world.
+    """
+    from torchmetrics_tpu.robust import checkpoint as _checkpoint
+    from torchmetrics_tpu.robust import journal as _journal
+
+    n_batches = max(3, n_batches)
+    shards = [_seeded_batches(rng, n_batches), _seeded_batches(rng, n_batches)]
+    m0, m1 = factory(), factory()
+    world = SimWorld([m0, m1])
+    # evict_after=99: this scenario exercises quorum+rejoin, not the circuit breaker
+    _arm_sync(m0, world, world.options(quorum=1, evict_after=99))
+    jpath = f"{workdir}/rank1-wal"
+    jm1 = m1.journal(jpath, every_k=2)
+    death = rng.randrange(1, n_batches - 1)
+    quorum_level = recovery = None
+    for i in range(n_batches):
+        _step(m0, shards[0][i], via)
+        jm1.update(*shards[1][i])
+        if i == death:
+            world.down.add(1)  # rank 1 dies mid-epoch; the next gather sees it missing
+            m0.compute()
+            quorum_level = str(m0.world_consistent)
+            # rank 1's process is gone — a fresh instance restores snapshot + journal
+            # replay, bit-identically (the epoch tail since the last snapshot is in the WAL)
+            m1 = factory()
+            recovery = _journal.recover(m1, jpath)
+            jm1 = m1.journal(jpath, every_k=2)
+            # re-admission handshake: the quorum side ships its merged view; the warm
+            # rejoiner validates structural compatibility without overwriting its state
+            with m0.sync_context():
+                offer = _checkpoint.reconciliation_offer(m0, responding_ranks=(0,), epoch=i)
+            _checkpoint.accept_reconciliation(m1, offer, mode="verify")
+            world.metrics[1] = m1
+            world.down.discard(1)
+            obs.telemetry.counter("robust.recovered").inc()
+    final = m0.compute()
+    final_level = str(m0.world_consistent)
+    # reference: identical shard streams through a never-faulted world
+    r0, r1 = factory(), factory()
+    ref_world = SimWorld([r0, r1])
+    _arm_sync(r0, ref_world, ref_world.options())
+    for i in range(n_batches):
+        _step(r0, shards[0][i], via)
+        r1.update(*shards[1][i])
+    expected = r0.compute()
+    bit_identical = _identical(final, expected)
+    return {
+        "passed": bit_identical and quorum_level == "quorum" and final_level == "full",
+        "bit_identical": bit_identical,
+        "quorum_level": quorum_level,
+        "final_level": final_level,
+        "death_step": death,
+        "journal_recovery": {k: v for k, v in (recovery or {}).items()},
+    }
+
+
+def scenario_preemption_journal_replay(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Preemption mid-epoch (including mid-buffered-window) → ``snapshot + replay(journal)``.
+
+    Drives a journaled metric partway through a seeded stream and then drops the instance
+    cold — for ``via="buffered"`` with batches still PENDING in the buffered window, the
+    nastiest case: the state never saw them, only the write-ahead journal did. A fresh
+    instance recovers from the journal directory, finishes the stream, and must be
+    bit-identical with an uninterrupted reference run.
+    """
+    from torchmetrics_tpu.robust import journal as _journal
+
+    n_batches = max(3, n_batches)
+    batches = _seeded_batches(rng, n_batches)
+    jdir = f"{workdir}/wal"
+    m = factory()
+    jm = m.journal(jdir, every_k=3)
+    preempt = rng.randrange(1, n_batches - 1)
+    pending_at_death = 0
+    if via == "buffered":
+        buf = jm.buffered(2)
+        for i in range(preempt + 1):
+            buf.update(*batches[i])
+        pending_at_death = buf.pending  # window batches the state never saw
+    else:
+        for i in range(preempt + 1):
+            (jm.forward if via == "forward" else jm.update)(*batches[i])
+    # the process dies here: no flush, no clean exit, the instance is garbage
+    obs.telemetry.counter("robust.injected_faults").inc()
+    fresh = factory()
+    recovery = _journal.recover(fresh, jdir)
+    obs.telemetry.counter("robust.recovered").inc()
+    for b in batches[preempt + 1:]:
+        fresh.update(*b)
+    ref = factory()
+    for b in batches:
+        ref.update(*b)
+    bit_identical = _identical(fresh.compute(), ref.compute())
+    return {
+        "passed": bit_identical,
+        "bit_identical": bit_identical,
+        "preempt_step": preempt,
+        "pending_at_death": pending_at_death,
+        "replayed": recovery["replayed"],
+        "snapshot_restored": recovery["snapshot_restored"],
+    }
+
+
+def scenario_flap_evict_readmit(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Flapping rank → circuit-breaker eviction → backoff probe → re-admission.
+
+    Rank 1 times out on consecutive syncs until the :class:`HealthLedger` trips its
+    breaker (``evict_after=2``); the next sync must run over the SHRUNK gather group
+    (rank 1 excluded — no more stalling) at quorum grade. After the rank heals and the
+    probe backoff expires, the following sync re-includes it, re-admits it, and grades
+    ``full`` — with a final value bit-identical to a never-faulted reference world.
+    """
+    del workdir
+    from torchmetrics_tpu.parallel.sync import health_ledger
+
+    shards = [_seeded_batches(rng, 4), _seeded_batches(rng, 4)]
+    m0, m1 = factory(), factory()
+    world = SimWorld([m0, m1])
+    opts = world.options(quorum=1, evict_after=2, probe_backoff_s=0.2)
+    _arm_sync(m0, world, opts)
+    ev0 = obs.telemetry.counter("sync.rank_evictions").value
+    re0 = obs.telemetry.counter("sync.rank_readmissions").value
+    # phase 1: two flapping syncs — rank 1 misses both, tripping the breaker
+    world.down.add(1)
+    for i in (0, 1):
+        _step(m0, shards[0][i], via)
+        m1.update(*shards[1][i])
+        m0.compute()
+    evicted = health_ledger().evicted_ranks()
+    # phase 2: circuit open — rank 1 still down, but the gather group excludes it, so the
+    # sync succeeds over the subgroup instead of stalling through the timeout machinery
+    _step(m0, shards[0][2], via)
+    m1.update(*shards[1][2])
+    m0.compute()
+    level_open = str(m0.world_consistent)
+    ranks_open = world.last_ranks
+    # phase 3: the rank heals; once the probe backoff expires the sync re-includes it
+    world.down.discard(1)
+    time.sleep(opts.probe_backoff_s * 1.5)
+    _step(m0, shards[0][3], via)
+    m1.update(*shards[1][3])
+    final = m0.compute()
+    final_level = str(m0.world_consistent)
+    # reference: same four batches per shard through a healthy world
+    r0, r1 = factory(), factory()
+    ref_world = SimWorld([r0, r1])
+    _arm_sync(r0, ref_world, ref_world.options())
+    for i in range(4):
+        _step(r0, shards[0][i], via)
+        r1.update(*shards[1][i])
+    expected = r0.compute()
+    bit_identical = _identical(final, expected)
+    evictions = obs.telemetry.counter("sync.rank_evictions").value - ev0
+    readmissions = obs.telemetry.counter("sync.rank_readmissions").value - re0
+    return {
+        "passed": bool(
+            bit_identical and evicted == (1,) and evictions >= 1 and readmissions >= 1
+            and level_open == "quorum" and final_level == "full"
+        ),
+        "bit_identical": bit_identical,
+        "evicted_ranks": evicted,
+        "evictions": evictions,
+        "readmissions": readmissions,
+        "level_while_open": level_open,
+        "gather_ranks_while_open": ranks_open,
+        "final_level": final_level,
+    }
+
+
+class ChaosMatrix:
+    """Seeded sweep of composite multi-fault scenarios (``make chaos-matrix``).
+
+    Each cell runs one scenario × drive-path combination under a seed derived from
+    ``TM_TPU_CHAOS_SEED`` (deterministic fault steps and batch values), with the health
+    ledger and warning caches reset so cells are independent. Results are plain dicts —
+    ``passed`` plus scenario-specific evidence — and :meth:`summarize` collapses them for
+    CI assertion. The matrix proves the composite contracts: quorum syncs converge back
+    to bit-identical full-world results after rejoin + reconciliation, and preemption
+    recovery (``snapshot + replay(journal)``) equals the uninterrupted run.
+    """
+
+    SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
+        "rank_death_quorum_rejoin": scenario_rank_death_quorum_rejoin,
+        "preemption_journal_replay": scenario_preemption_journal_replay,
+        "flap_evict_readmit": scenario_flap_evict_readmit,
+    }
+
+    def __init__(
+        self,
+        factory: Callable[[], Any],
+        workdir: Optional[str] = None,
+        seed: Optional[int] = None,
+        scenarios: Optional[Sequence[str]] = None,
+    ) -> None:
+        import tempfile
+
+        self.factory = factory
+        self.workdir = workdir or tempfile.mkdtemp(prefix="tm-chaos-matrix-")
+        if seed is None:
+            seed = int(os.environ.get(ENV_CHAOS_SEED, DEFAULT_SEED))
+        self.seed = int(seed)
+        names = scenarios if scenarios is not None else tuple(self.SCENARIOS)
+        unknown = [n for n in names if n not in self.SCENARIOS]
+        if unknown:
+            raise ValueError(f"Unknown chaos scenario(s) {unknown}; known: {sorted(self.SCENARIOS)}")
+        self.scenarios = {n: self.SCENARIOS[n] for n in names}
+
+    def run(
+        self, n_batches: int = 6, via: Sequence[str] = ("forward",), repeats: int = 1
+    ) -> List[Dict[str, Any]]:
+        """Run every (scenario, via, repeat) cell; returns one result record per cell."""
+        from torchmetrics_tpu.parallel.sync import reset_health_state
+
+        results: List[Dict[str, Any]] = []
+        for name, fn in self.scenarios.items():
+            for v in via:
+                for rep in range(repeats):
+                    # string seeding is stable across runs (hash-salt-free) and spreads
+                    # fault steps across cells without coupling them
+                    rng = random.Random(f"{self.seed}:{name}:{v}:{rep}")
+                    cell_dir = os.path.join(self.workdir, f"{name}-{v}-{rep}")
+                    os.makedirs(cell_dir, exist_ok=True)
+                    reset_health_state()
+                    reset_warning_cache()
+                    record: Dict[str, Any] = {"scenario": name, "via": v, "repeat": rep, "seed": self.seed}
+                    try:
+                        with warnings.catch_warnings():
+                            # degraded/eviction/readmission warnings ARE the faults firing;
+                            # the sweep audits them via counters, not stderr volume
+                            warnings.simplefilter("ignore")
+                            detail = fn(self.factory, rng, n_batches, v, cell_dir)
+                        record.update(detail)
+                        record.setdefault("passed", True)
+                    except Exception as err:  # noqa: BLE001 - a cell failure is a result, not an abort
+                        record.update({"passed": False, "error": repr(err)})
+                    results.append(record)
+        summary = self.summarize(results)
+        obs.telemetry.event("robust.chaos_matrix", cat="robust", args=summary)
+        return results
+
+    @staticmethod
+    def summarize(results: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        failed = [
+            f"{r['scenario']}[{r.get('via')}#{r.get('repeat')}]" for r in results if not r.get("passed")
+        ]
+        return {"cells": len(results), "passed": len(results) - len(failed), "failed": failed}

@@ -23,19 +23,28 @@ after a base64 hop. See ``docs/robustness.md`` for the format table.
 """
 from __future__ import annotations
 
+import os
+import pickle
+import struct
+import tempfile
 import zlib
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu import obs
-from torchmetrics_tpu.utils.exceptions import SnapshotError
+from torchmetrics_tpu.utils.exceptions import ReconciliationError, SnapshotError
 
 FORMAT = "tm-tpu-metric-snapshot"
 COLLECTION_FORMAT = "tm-tpu-collection-snapshot"
+RECONCILIATION_FORMAT = "tm-tpu-reconciliation"
 VERSION = 1
+
+#: on-disk container: magic + little-endian (crc32, payload length) + pickled blob
+SNAPSHOT_MAGIC = b"TMSNAP1\n"
+_DISK_HEADER = struct.Struct("<IQ")
 
 
 def _canonical_bytes(tensors: Dict[str, np.ndarray], lists: Dict[str, List[np.ndarray]]) -> bytes:
@@ -205,3 +214,174 @@ def restore_collection(collection: Any, blob: Any) -> None:
     if collection._enable_compute_groups and collection._groups_checked:
         collection._state_is_copy = False
         collection._compute_groups_create_state_ref()
+
+
+# ---------------------------------------------------------------------------
+# Durable disk persistence (atomic temp-file + os.replace + fsync)
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed file survives power loss (best effort)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform without dir-open (the rename still landed)
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - some filesystems reject dir fsync
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: Union[str, os.PathLike], data: bytes) -> str:
+    """Crash-consistent byte write: temp file in the target dir → fsync → ``os.replace``.
+
+    The target path either holds its previous content or the complete new content —
+    never a torn intermediate. Shared by snapshot persistence and the update journal.
+    """
+    path = os.fspath(path)
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tm-tmp-")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(directory)
+    return path
+
+
+def save_snapshot(blob: Dict[str, Any], path: Union[str, os.PathLike]) -> str:
+    """Durably persist a :func:`snapshot_metric`/:func:`snapshot_collection` blob to disk.
+
+    The file is written atomically (temp file + ``os.replace`` + fsync of file AND
+    directory) so a preemption mid-write leaves either the previous snapshot or the new
+    one, never garbage. The container adds an outer CRC over the serialised payload on
+    top of the blob's own state CRC; :func:`load_snapshot` validates both layers.
+    """
+    if not isinstance(blob, dict) or blob.get("format") not in (FORMAT, COLLECTION_FORMAT):
+        raise SnapshotError(
+            "save_snapshot expects a snapshot blob from Metric.snapshot() /"
+            f" MetricCollection.snapshot(); got format"
+            f" {blob.get('format') if isinstance(blob, dict) else type(blob).__name__!r}"
+        )
+    payload = pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
+    header = SNAPSHOT_MAGIC + _DISK_HEADER.pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+    out = atomic_write_bytes(path, header + payload)
+    obs.telemetry.counter("robust.snapshot_saves").inc()
+    return out
+
+
+def load_snapshot(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    """Read a :func:`save_snapshot` file back to a blob, validating the disk container.
+
+    Rejects missing/truncated/corrupted files with :class:`SnapshotError`; the blob's own
+    state CRC is re-validated when the blob is restored into a metric.
+    """
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError as err:
+        raise SnapshotError(f"Cannot read snapshot file {path!r}: {err}") from err
+    header_len = len(SNAPSHOT_MAGIC) + _DISK_HEADER.size
+    if len(raw) < header_len or not raw.startswith(SNAPSHOT_MAGIC):
+        raise SnapshotError(
+            f"{path!r} is not a torchmetrics-tpu snapshot file (bad magic/truncated header)"
+        )
+    crc, length = _DISK_HEADER.unpack(raw[len(SNAPSHOT_MAGIC):header_len])
+    payload = raw[header_len:]
+    if len(payload) != length:
+        raise SnapshotError(
+            f"Snapshot file {path!r} is truncated: header promises {length} payload bytes,"
+            f" file holds {len(payload)}. Refusing to restore."
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise SnapshotError(
+            f"Snapshot file {path!r} failed its container checksum: the file was corrupted"
+            " in storage. Refusing to restore."
+        )
+    blob = pickle.loads(payload)
+    if not isinstance(blob, dict) or blob.get("format") not in (FORMAT, COLLECTION_FORMAT):
+        raise SnapshotError(f"Snapshot file {path!r} does not contain a snapshot blob")
+    return blob
+
+
+# ---------------------------------------------------------------------------
+# Rank re-admission: state reconciliation handshake (docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+def reconciliation_offer(
+    metric: Any, responding_ranks: Sequence[int] = (), epoch: int = 0
+) -> Dict[str, Any]:
+    """Build the re-admission handshake blob the quorum side sends a rejoining rank.
+
+    Wraps a full snapshot of ``metric``'s CURRENT state — take the offer while the metric
+    is synced (inside ``sync_context``) to ship the quorum's *merged* view — plus the
+    ranks that view covers, a caller-defined epoch, and the consistency grade it was
+    taken at. The rejoining side validates and applies it with
+    :func:`accept_reconciliation`.
+    """
+    blob = snapshot_metric(metric)
+    return {
+        "format": RECONCILIATION_FORMAT,
+        "version": VERSION,
+        "snapshot": blob,
+        "responding_ranks": tuple(int(r) for r in responding_ranks),
+        "epoch": int(epoch),
+        "consistency": str(getattr(metric, "world_consistent", "full")),
+    }
+
+
+def accept_reconciliation(metric: Any, offer: Any, mode: str = "adopt") -> Dict[str, Any]:
+    """Apply a re-admission handshake offer on the rejoining rank.
+
+    ``mode="adopt"`` (cold rejoin — the rank's local state is gone): restore the offered
+    merged snapshot into ``metric``, making it the rank's state base before it resumes
+    contributing. ``mode="verify"`` (warm rejoin — the rank recovered its own state via
+    ``snapshot + journal replay``): validate that the offer is structurally compatible
+    with the metric (class, state names, shapes, CRC) WITHOUT overwriting the recovered
+    local state. Both modes raise :class:`ReconciliationError` on an invalid offer and
+    return the offer's metadata (``responding_ranks``, ``epoch``, ``consistency``).
+    """
+    if not isinstance(offer, dict) or offer.get("format") != RECONCILIATION_FORMAT:
+        raise ReconciliationError(
+            f"Not a reconciliation offer: expected format {RECONCILIATION_FORMAT!r}, got"
+            f" {offer.get('format') if isinstance(offer, dict) else type(offer).__name__!r}"
+        )
+    if offer.get("version") != VERSION:
+        raise ReconciliationError(
+            f"Reconciliation version mismatch: offer is v{offer.get('version')!r}, this"
+            f" build speaks v{VERSION}"
+        )
+    snapshot = offer.get("snapshot")
+    try:
+        if mode == "adopt":
+            restore_metric(metric, snapshot)
+        elif mode == "verify":
+            _validate_blob(metric, snapshot)
+        else:
+            raise ValueError(f"accept_reconciliation mode must be 'adopt' or 'verify', got {mode!r}")
+    except SnapshotError as err:
+        raise ReconciliationError(f"Reconciliation offer rejected: {err}") from err
+    obs.telemetry.counter("robust.reconciliations").inc()
+    obs.telemetry.event(
+        "robust.reconciliation", cat="robust",
+        args={"mode": mode, "epoch": offer.get("epoch"),
+              "responding_ranks": list(offer.get("responding_ranks", ()))},
+    )
+    return {
+        "responding_ranks": tuple(offer.get("responding_ranks", ())),
+        "epoch": offer.get("epoch", 0),
+        "consistency": offer.get("consistency", "full"),
+        "mode": mode,
+    }

@@ -1,0 +1,324 @@
+"""Preemption-safe write-ahead journal of metric update batches.
+
+A durable snapshot (``robust/checkpoint.py``) captures the state at one instant; every
+update after it dies with the process. On preemptible capacity that tail can be hours of
+stream. The journal closes the gap with the classic WAL contract: every update batch is
+appended to disk — atomically, checksummed — *before* it is applied, so a preempted
+process restores ``snapshot + replay(journal)`` **bit-identically** instead of losing the
+tail of the epoch (replay drives the ordinary ``update`` path, which the tier-equivalence
+suite proves bit-identical with the jit / AOT+donation / buffered tiers).
+
+Layout of a journal directory::
+
+    <dir>/snapshot.tmsnap      durable state snapshot (atomic, doubly CRC'd)
+    <dir>/000000000042.tmj     one record per appended batch, named by sequence number
+
+Record container: ``TMJR1\\n`` magic + little-endian ``(crc32, length)`` + pickled
+``{"seq", "args", "kwargs"}`` with every array leaf as host numpy. Records are written
+via temp-file + ``os.replace`` + fsync (file and directory), so a record either exists
+completely or not at all; a torn TAIL record (a filesystem that lost the rename on power
+cut) is skipped with a warning, while corruption anywhere earlier raises
+:class:`~torchmetrics_tpu.utils.exceptions.JournalError` — a hole in the middle of the
+stream is unrecoverable and must fail loudly.
+
+The journal is **bounded**: :class:`MetricJournal` (``Metric.journal(dir, every_k)``)
+takes a durable snapshot every ``every_k`` appends and truncates the replayed prefix, so
+disk usage is ``O(every_k)`` batches between snapshots. It also plugs into the dispatch
+tiers' buffered seam: ``metric.buffered(k, journal=...)`` (or ``MetricJournal.buffered``)
+journals each batch write-ahead at ``update`` time, so batches pending in a
+:class:`~torchmetrics_tpu.ops.dispatch.BufferedUpdater` window survive a preemption that
+strikes before the flush.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.robust import checkpoint as _checkpoint
+from torchmetrics_tpu.utils.exceptions import JournalError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+MAGIC = b"TMJR1\n"
+RECORD_SUFFIX = ".tmj"
+SNAPSHOT_FILENAME = "snapshot.tmsnap"
+_HEADER = struct.Struct("<IQ")
+
+
+def _host_tree(value: Any) -> Any:
+    """Copy a batch pytree to host numpy (device arrays fetched once, leaves np-ified)."""
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    host = [
+        np.asarray(leaf) if hasattr(leaf, "shape") or isinstance(leaf, (int, float, bool, complex)) else leaf
+        for leaf in jax.device_get(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, host)
+
+
+class Journal:
+    """Append-only, CRC-checksummed, crash-atomic record log of update batches.
+
+    ``append`` is write-ahead durable: when it returns, the batch is on disk. ``read``
+    yields the surviving records in sequence order with full validation.
+    ``truncate_through`` drops the prefix a durable snapshot already covers.
+    """
+
+    def __init__(self, path: Union[str, os.PathLike], max_pending: int = 65536) -> None:
+        self.path = os.fspath(path)
+        self.max_pending = int(max_pending)
+        os.makedirs(self.path, exist_ok=True)
+        existing = self._record_seqs()
+        self._next_seq = (existing[-1] + 1) if existing else 0
+
+    # ------------------------------------------------------------------ directory scan
+    def _record_seqs(self) -> List[int]:
+        seqs = []
+        for fname in os.listdir(self.path):
+            if fname.endswith(RECORD_SUFFIX) and not fname.startswith("."):
+                try:
+                    seqs.append(int(fname[: -len(RECORD_SUFFIX)]))
+                except ValueError:
+                    continue
+        return sorted(seqs)
+
+    def _record_path(self, seq: int) -> str:
+        return os.path.join(self.path, f"{seq:012d}{RECORD_SUFFIX}")
+
+    @property
+    def pending(self) -> int:
+        """Records currently on disk (appended since the last truncation)."""
+        return len(self._record_seqs())
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence number of the most recently appended record; -1 before any append."""
+        return self._next_seq - 1
+
+    # ------------------------------------------------------------------------- append
+    def append(self, args: Tuple = (), kwargs: Optional[Dict[str, Any]] = None) -> int:
+        """Durably journal one update batch; returns its sequence number.
+
+        The record is fully on disk (fsync'd, atomically named) before this returns —
+        the write-ahead half of the WAL contract. The batch leaves are copied to host
+        numpy so later buffer donation cannot invalidate the journaled payload.
+        """
+        seq = self._next_seq
+        payload = pickle.dumps(
+            {"seq": seq, "args": _host_tree(tuple(args)), "kwargs": _host_tree(dict(kwargs or {}))},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        data = MAGIC + _HEADER.pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+        _checkpoint.atomic_write_bytes(self._record_path(seq), data)
+        self._next_seq = seq + 1
+        obs.telemetry.counter("robust.journal_appends").inc()
+        if self.max_pending and (seq % 64 == 0) and self.pending > self.max_pending:
+            rank_zero_warn(
+                f"Update journal at {self.path!r} holds {self.pending} records, beyond its"
+                f" {self.max_pending}-record bound: no durable snapshot is truncating it."
+                " Take snapshots (Metric.journal(every_k=...) does this automatically) or"
+                " replay will grow unboundedly expensive.",
+                UserWarning,
+            )
+        return seq
+
+    # --------------------------------------------------------------------------- read
+    def _decode(self, seq: int, is_tail: bool) -> Optional[Tuple[int, tuple, dict]]:
+        path = self._record_path(seq)
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError as err:
+            raise JournalError(f"Cannot read journal record {path!r}: {err}") from err
+        header_len = len(MAGIC) + _HEADER.size
+        problem = None
+        if len(raw) < header_len or not raw.startswith(MAGIC):
+            problem = "bad magic/truncated header"
+        else:
+            crc, length = _HEADER.unpack(raw[len(MAGIC):header_len])
+            payload = raw[header_len:]
+            if len(payload) != length:
+                problem = f"payload truncated ({len(payload)} of {length} bytes)"
+            elif zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                problem = "checksum mismatch"
+        if problem is not None:
+            if is_tail:
+                # a crash mid-append can only tear the newest record; losing the batch
+                # that was being written when the process died is the honest outcome
+                rank_zero_warn(
+                    f"Journal tail record {path!r} is torn ({problem}); skipping it."
+                    " The batch being appended at the crash is not recoverable.",
+                    UserWarning,
+                )
+                return None
+            raise JournalError(
+                f"Journal record {path!r} is corrupt ({problem}) with later records"
+                " present — the stream has a hole and cannot be replayed faithfully."
+            )
+        rec = pickle.loads(payload)
+        if not isinstance(rec, dict) or rec.get("seq") != seq:
+            raise JournalError(f"Journal record {path!r} does not match its sequence number")
+        return seq, tuple(rec.get("args", ())), dict(rec.get("kwargs", {}))
+
+    def read(self, after_seq: int = -1) -> Iterator[Tuple[int, tuple, dict]]:
+        """Yield validated ``(seq, args, kwargs)`` records with ``seq > after_seq``, in order."""
+        seqs = [s for s in self._record_seqs() if s > after_seq]
+        for i, seq in enumerate(seqs):
+            rec = self._decode(seq, is_tail=(i == len(seqs) - 1))
+            if rec is not None:
+                yield rec
+
+    # ---------------------------------------------------------------------- retention
+    def truncate_through(self, seq: int) -> int:
+        """Drop records with sequence ≤ ``seq`` (covered by a durable snapshot)."""
+        dropped = 0
+        for s in self._record_seqs():
+            if s <= seq:
+                try:
+                    os.unlink(self._record_path(s))
+                    dropped += 1
+                except OSError:  # pragma: no cover - already gone
+                    pass
+        if dropped:
+            _checkpoint._fsync_dir(self.path)
+        return dropped
+
+    def clear(self) -> int:
+        """Drop every record (the snapshot file, if any, is left in place)."""
+        return self.truncate_through(self._next_seq)
+
+
+def replay(metric: Any, journal: Union[Journal, str, os.PathLike], after_seq: int = -1) -> int:
+    """Re-apply journaled batches through ``metric.update``; returns the batch count.
+
+    Replay drives the plain ``update`` path regardless of which dispatch tier originally
+    produced the records — the tier-equivalence suite is what makes that bit-identical.
+    """
+    jr = journal if isinstance(journal, Journal) else Journal(journal)
+    n = 0
+    for _seq, args, kwargs in jr.read(after_seq=after_seq):
+        metric.update(*args, **kwargs)
+        n += 1
+    if n:
+        obs.telemetry.counter("robust.journal_replays").inc(n)
+        obs.telemetry.event("robust.journal_replay", cat="robust", args={"batches": n, "path": jr.path})
+    return n
+
+
+def recover(metric: Any, path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    """Restore ``snapshot + replay(journal)`` from a journal directory into ``metric``.
+
+    The durable snapshot (if present) is restored first — via the metric's own
+    ``restore`` so collections round-trip too — then every journal record past the
+    snapshot's high-water mark is replayed. Returns ``{"snapshot_restored", "replayed"}``.
+    """
+    path = os.fspath(path)
+    jr = Journal(path)
+    snap_path = os.path.join(path, SNAPSHOT_FILENAME)
+    restored = False
+    after = -1
+    if os.path.exists(snap_path):
+        blob = _checkpoint.load_snapshot(snap_path)
+        after = int(blob.pop("journal_seq", -1))
+        metric.restore(blob)
+        restored = True
+    replayed = replay(metric, jr, after_seq=after)
+    return {"snapshot_restored": restored, "replayed": replayed, "after_seq": after}
+
+
+class MetricJournal:
+    """Write-ahead journaled proxy for one metric (or collection): ``Metric.journal(...)``.
+
+    Every ``update``/``forward`` appends the batch durably *before* applying it, and
+    every ``every_k`` appends a durable snapshot is taken and the journal truncated — the
+    bounded snapshot/journal cycle. Use as a context manager::
+
+        with metric.journal("ckpt/m0", every_k=64) as jm:
+            for batch in stream:
+                jm.update(*batch)          # durable before applied
+        # preempted? a fresh process resumes bit-identically:
+        with fresh_metric.journal("ckpt/m0", resume=True) as jm:
+            ...
+
+    A clean context exit takes a final snapshot; an error exit leaves the journal tail in
+    place so recovery still replays the full stream. ``buffered(k)`` returns the target's
+    :class:`~torchmetrics_tpu.ops.dispatch.BufferedUpdater` with this journal plugged
+    into its write-ahead seam.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        path: Union[str, os.PathLike],
+        every_k: int = 64,
+        resume: bool = False,
+        max_pending: int = 65536,
+    ) -> None:
+        if int(every_k) < 1:
+            raise ValueError(f"journal(every_k) needs every_k >= 1, got {every_k}")
+        self.metric = metric
+        self.journal = Journal(path, max_pending=max_pending)
+        self._every_k = int(every_k)
+        self._resume = bool(resume)
+        self._since_snapshot = 0
+        self.recovered: Optional[Dict[str, Any]] = None
+        if self._resume:
+            self.recovered = recover(self.metric, self.journal.path)
+
+    @property
+    def path(self) -> str:
+        return self.journal.path
+
+    def _append(self, args: tuple, kwargs: dict) -> None:
+        self.journal.append(args, kwargs)
+        self._since_snapshot += 1
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Journal the batch durably, apply it, snapshot/truncate on the ``every_k`` cycle."""
+        self._append(args, kwargs)
+        self.metric.update(*args, **kwargs)
+        self._maybe_checkpoint()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Journaled twin of ``metric.forward`` (batch value returned as usual)."""
+        self._append(args, kwargs)
+        value = self.metric.forward(*args, **kwargs)
+        self._maybe_checkpoint()
+        return value
+
+    __call__ = forward
+
+    def compute(self) -> Any:
+        return self.metric.compute()
+
+    def buffered(self, k: int) -> Any:
+        """A :class:`BufferedUpdater` over the target with this journal at its seam."""
+        return self.metric.buffered(k, journal=self.journal)
+
+    def _maybe_checkpoint(self) -> None:
+        if self._since_snapshot >= self._every_k:
+            self.checkpoint()
+
+    def checkpoint(self) -> str:
+        """Take a durable snapshot NOW and truncate the journal prefix it covers."""
+        blob = self.metric.snapshot()
+        blob["journal_seq"] = self.journal.last_seq
+        out = _checkpoint.save_snapshot(blob, os.path.join(self.journal.path, SNAPSHOT_FILENAME))
+        self.journal.truncate_through(self.journal.last_seq)
+        self._since_snapshot = 0
+        return out
+
+    def __enter__(self) -> "MetricJournal":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        # clean exit: consolidate to a snapshot. Error exit: leave the journal tail —
+        # the stream is durable either way, and recovery replays it faithfully.
+        if exc_type is None:
+            self.checkpoint()
+        return False

@@ -35,4 +35,31 @@ class SyncTimeoutError(TorchMetricsUserError):
 
     Only raised when degraded mode is off; with ``degraded_mode=True`` the sync instead
     falls back to local state and marks the result non-world-consistent.
+
+    ``responses`` optionally carries the partial per-rank responses (``{rank: value}``)
+    that DID arrive before the deadline — a quorum-capable gather attaches them so the
+    sync layer can aggregate over the responding subset instead of dropping to
+    local-only state (``SyncOptions(quorum=...)``, docs/robustness.md).
+    """
+
+    def __init__(self, *args, responses=None):
+        super().__init__(*args)
+        self.responses = responses
+
+
+class JournalError(TorchMetricsUserError):
+    """Raised when a write-ahead update journal cannot be appended, read, or replayed.
+
+    Covers corrupted (CRC), truncated-mid-stream, or structurally alien journal records;
+    a torn TAIL record (a crash mid-append on a filesystem that lost the rename) is
+    tolerated with a warning instead — see ``torchmetrics_tpu.robust.journal``.
+    """
+
+
+class ReconciliationError(TorchMetricsUserError):
+    """Raised when a rank re-admission handshake blob fails validation.
+
+    The reconciliation offer wraps a quorum-merged snapshot; accepting it into a metric
+    whose registered states/class do not match — or from an incompatible format version —
+    fails loudly instead of silently merging mismatched state.
     """

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -21,7 +21,14 @@ bench-smoke:
 	python bench.py --smoke > /tmp/tm_bench_smoke.json
 	python -c "import json; d=[l for l in open('/tmp/tm_bench_smoke.json').read().strip().splitlines() if l][-1]; p=json.loads(d); assert 'metric' in p and 'extras' in p, p; print('bench-smoke ok:', p['metric'])"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU008, docs/static-analysis.md): exits
+# keyed multi-tenant lane (docs/keyed.md): tiny-N mixed-tenant bench asserting the
+# acceptance bar — KeyedMetric at N=10k keys >= 50x a 10k-instance Python loop, with
+# bit-identical per-key results across the jit / AOT+donation / buffered tiers
+keyed-smoke:
+	python bench.py --keyed --smoke > /tmp/tm_keyed_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_keyed_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; s=ex['keyed_vs_instance_loop_n10000']; assert s is not None and s >= 50, ex; bits=[v for k,v in ex.items() if k.startswith('keyed_bit_identical')]; assert bits and all(bits), ex; print('keyed-smoke ok: %.0fx vs instance loop @ N=10k' % s)"
+
+# static JAX/TPU hazard analysis (rules TPU001-TPU010, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`
 jaxlint:

@@ -8,6 +8,7 @@ by the reference's (oguz-hanoglu/torchmetrics, torch backend) measured on the sa
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -228,6 +229,163 @@ def bench_buffered_updates(preds: np.ndarray, target: np.ndarray, k: int = 16) -
     best = _best_of(_window, windows=4)
     print(f"ours (buffered k={k} updates): {N_BATCHES} updates in {best:.4f}s", file=sys.stderr)
     return N_BATCHES / best
+
+
+def _keyed_instance_loop_rate(cls, ids_batches, val_batches, n_keys: int) -> tuple:
+    """The loop the keyed engine replaces: a dict of per-key instances, one update per
+    key present in each batch (group-by on the host, charitable to the loop — the naive
+    per-ELEMENT loop is far worse). Returns (batches/sec, per-key values array)."""
+    import jax
+
+    insts = [cls(nan_strategy="ignore") for _ in range(n_keys)]
+    # warm the per-group-size jit cache out of window (ragged group shapes retrace)
+    ids0, vals0 = np.asarray(ids_batches[0]), np.asarray(val_batches[0])
+    for k in np.unique(ids0):
+        insts[k].update(vals0[ids0 == k])
+    for m in insts:
+        m.reset()
+    t0 = time.perf_counter()
+    for ids, vals in zip(ids_batches, val_batches):
+        ids, vals = np.asarray(ids), np.asarray(vals)
+        for k in np.unique(ids):
+            insts[k].update(vals[ids == k])
+    values = [m.compute() for m in insts]
+    jax.block_until_ready(values)
+    elapsed = time.perf_counter() - t0
+    return len(ids_batches) / elapsed, np.asarray([np.asarray(v) for v in values])
+
+
+def bench_keyed(n_keys_list, batch: int, n_batches: int, loop_batches: int) -> dict:
+    """``--keyed`` scenario: mixed-tenant batches through ONE KeyedMetric vs a dict of
+    per-key instances (docs/keyed.md). Emits per-N ``keyed_updates_per_sec`` (update
+    launches per second, each launch folding a full mixed-tenant batch), the speedup over
+    the instance loop on the SAME batches, and bit-identity of every per-key value across
+    the jit / AOT+donation / buffered dispatch tiers AND vs the instance loop.
+
+    Values are integer-valued float32 so float accumulation is exact — "bit-identical"
+    means bit-identical, not within-epsilon, regardless of reduction order.
+    """
+    import jax
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.aggregation import SumMetric
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.ops.dispatch import ENV_FAST_DISPATCH
+
+    rng = np.random.RandomState(11)
+    out: dict = {}
+    for n_keys in n_keys_list:
+        ids_np = rng.randint(0, n_keys, size=(n_batches, batch)).astype(np.int32)
+        vals_np = rng.randint(0, 64, size=(n_batches, batch)).astype(np.float32)
+        import jax.numpy as jnp
+
+        ids = [jnp.asarray(ids_np[i]) for i in range(n_batches)]
+        vals = [jnp.asarray(vals_np[i]) for i in range(n_batches)]
+        jax.block_until_ready((ids, vals))
+
+        km = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys)
+        km.update(ids[0], vals[0])  # compile out of window
+        km.reset()
+
+        def _window():
+            km.reset()
+            for i in range(n_batches):
+                km.update(ids[i], vals[i])
+            jax.block_until_ready(km.compute())
+
+        best = _best_of(_window, windows=3)
+        keyed_rate = n_batches / best
+        out[f"keyed_updates_per_sec_n{n_keys}"] = round(keyed_rate, 2)
+        print(
+            f"keyed N={n_keys}: {n_batches} mixed-tenant updates in {best:.4f}s"
+            f" ({keyed_rate:.0f} updates/s)",
+            file=sys.stderr,
+        )
+
+        # the instance loop on a PREFIX of the same stream (it is orders of magnitude
+        # slower; the rate extrapolates per batch, the values anchor bit-identity)
+        lb = min(loop_batches, n_batches)
+        loop_rate, loop_vals = _keyed_instance_loop_rate(
+            SumMetric, ids_np[:lb], vals_np[:lb], n_keys
+        )
+        out[f"instance_loop_updates_per_sec_n{n_keys}"] = round(loop_rate, 2)
+        out[f"keyed_vs_instance_loop_n{n_keys}"] = round(keyed_rate / loop_rate, 1)
+
+        # bit-identity of every per-key value, across all three dispatch tiers
+        def run_tier(tier: str) -> np.ndarray:
+            prior = os.environ.get(ENV_FAST_DISPATCH)
+            if tier == "jit":
+                os.environ[ENV_FAST_DISPATCH] = "0"
+            try:
+                m = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys)
+                if tier == "buffered":
+                    with m.buffered(4) as buf:
+                        for i in range(lb):
+                            buf.update(ids[i], vals[i])
+                else:
+                    for i in range(lb):
+                        m.update(ids[i], vals[i])
+                return np.asarray(m.compute())
+            finally:
+                if prior is None:
+                    os.environ.pop(ENV_FAST_DISPATCH, None)
+                else:
+                    os.environ[ENV_FAST_DISPATCH] = prior
+
+        tiers = {tier: run_tier(tier) for tier in ("aot", "jit", "buffered")}
+        identical = all(v.tobytes() == loop_vals.tobytes() for v in tiers.values())
+        out[f"keyed_bit_identical_n{n_keys}"] = bool(identical)
+    out["keyed_batch"] = batch
+    out["keyed_n_batches"] = n_batches
+    out["keyed_telemetry"] = {
+        k: obs.telemetry.counter(k).value
+        for k in ("keyed.updates", "keyed.active_keys", "keyed.fanout")
+    }
+    return out
+
+
+def keyed_main(smoke: bool) -> None:
+    """``bench.py --keyed [--smoke]``: one JSON line with the keyed scenario numbers.
+
+    Full mode sweeps N in {1e3, 1e4, 1e5}; smoke keeps {1e3, 1e4} at tiny batch counts
+    (the acceptance point — 50x over the instance loop at N=10k — must hold even there).
+    """
+    if smoke:
+        n_keys_list, batch, n_batches, loop_batches = (1_000, 10_000), 2048, 8, 2
+    else:
+        n_keys_list, batch, n_batches, loop_batches = (1_000, 10_000, 100_000), 8192, 50, 3
+    extras = bench_keyed(n_keys_list, batch=batch, n_batches=n_batches, loop_batches=loop_batches)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+        # per-key cost-ledger rows: the keyed kernels' compiler-level FLOPs/bytes
+        # (resolved outside every timed window), diffable by the perf gate
+        extras["cost_ledger"] = [
+            {k: r[k] for k in ("key", "metric", "kernel", "tier", "flops",
+                               "bytes_accessed", "temp_bytes", "argument_bytes", "available")}
+            for r in obs.cost_ledger()
+            if r["metric"] == "KeyedMetric"
+        ]
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    headline = extras.get("keyed_updates_per_sec_n10000")
+    print(
+        json.dumps(
+            {
+                "metric": "keyed_updates_per_sec",
+                "value": headline,
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "mixed-tenant update launches/s at N=10k keys (KeyedMetric[Sum], one"
+                    " fused segment-reduce launch per batch; per-N rates, instance-loop"
+                    " speedups, tier bit-identity, and keyed cost-ledger rows in extras)"
+                ),
+                "vs_baseline": extras.get("keyed_vs_instance_loop_n10000"),
+                "extras": extras,
+            }
+        )
+    )
 
 
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
@@ -917,7 +1075,16 @@ if __name__ == "__main__":
             print("usage: bench.py --compare A.json B.json", file=sys.stderr)
             sys.exit(2)
         sys.exit(compare_main(sys.argv[idx + 1], sys.argv[idx + 2]))
-    if "--smoke" in sys.argv:
+    if "--keyed" in sys.argv:
+        # keyed multi-tenant scenario (make keyed-smoke / docs/keyed.md): smoke pins CPU
+        # via the config API like the bench smoke lane; full mode probes for a healthy
+        # platform first (a dead tunnel plugin must not wedge the run)
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        keyed_main(smoke)
+    elif "--smoke" in sys.argv:
         # CI smoke lane (make bench-smoke): tiny sizes, CPU pinned via the config API (the
         # env-var route can wedge on a dead tunnel plugin), no subprocess orchestration —
         # one parseable JSON line in seconds or a nonzero rc

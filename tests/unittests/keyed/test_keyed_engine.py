@@ -1,0 +1,204 @@
+"""KeyedMetric / KeyedMetricCollection engine behaviour (construction, routing, obs)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, obs
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.keyed import KeyedMetric, KeyedMetricCollection
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+def _ids(*vals):
+    return np.asarray(vals, np.int32)
+
+
+def _f32(*vals):
+    return np.asarray(vals, np.float32)
+
+
+class TestConstruction:
+    def test_class_and_instance_templates(self):
+        assert KeyedMetric(SumMetric, 3).num_keys == 3
+        assert KeyedMetric(SumMetric(), 3).strategy == "segments"
+
+    def test_rejects_bad_inputs(self):
+        with pytest.raises(ValueError, match="num_keys"):
+            KeyedMetric(SumMetric, 0)
+        with pytest.raises(ValueError, match="Metric instance or subclass"):
+            KeyedMetric(object, 4)  # type: ignore[arg-type]
+        with pytest.raises(ValueError, match="nested"):
+            KeyedMetric(KeyedMetric(SumMetric, 2), 4)
+        with pytest.raises(ValueError, match="strategy"):
+            KeyedMetric(SumMetric, 4, strategy="magic")
+
+    def test_rejects_list_state_templates(self):
+        with pytest.raises(TorchMetricsUserError, match="cat"):
+            KeyedMetric(CatMetric, 4)
+
+    def test_state_shapes_carry_the_tenant_axis(self):
+        km = KeyedMetric(MeanMetric, 5)
+        state = km.metric_state
+        assert state["mean_value"].shape == (5,)
+        assert state["weight"].shape == (5,)
+
+    def test_strategy_resolution(self):
+        assert KeyedMetric(MeanMetric, 4).strategy == "segments"  # both states sum-reduced
+        assert KeyedMetric(MaxMetric, 4, strategy="vmap").strategy == "vmap"
+
+        class Hinted(SumMetric):
+            keyed_decomposable = False
+
+        assert KeyedMetric(Hinted, 4).strategy == "vmap"
+
+    def test_repr_names_template(self):
+        assert "SumMetric" in repr(KeyedMetric(SumMetric, 4))
+
+
+class TestUpdateProtocol:
+    def test_key_validation(self):
+        km = KeyedMetric(SumMetric, 4)
+        with pytest.raises(TorchMetricsUserError, match="out of range"):
+            km.update(_ids(0, 4), _f32(1, 2))
+        with pytest.raises(TorchMetricsUserError, match="integer"):
+            km.update(_f32(0.0, 1.0), _f32(1, 2))
+        with pytest.raises(TorchMetricsUserError, match="batch inputs"):
+            km.update(_ids(0, 1))
+
+    def test_validation_can_be_disabled(self):
+        km = KeyedMetric(SumMetric, 4, validate_keys=False)
+        km.update(_ids(0, 1), _f32(1, 2))  # no host-side range scan
+        assert float(km.compute_key(0)) == 1.0
+
+    def test_counters_and_active_keys(self):
+        u0 = obs.telemetry.counter("keyed.updates").value
+        f0 = obs.telemetry.counter("keyed.fanout").value
+        km = KeyedMetric(SumMetric, 8)
+        km.update(_ids(0, 0, 3), _f32(1, 2, 3))
+        km.update(_ids(3, 5), _f32(4, 5))
+        assert obs.telemetry.counter("keyed.updates").value == u0 + 2
+        assert obs.telemetry.counter("keyed.fanout").value == f0 + 2 + 2  # {0,3} then {3,5}
+        assert km.active_keys == 3  # {0, 3, 5}
+        km.reset()
+        assert km.active_keys == 0
+        assert np.asarray(km.compute()).sum() == 0.0
+
+    def test_forward_raises_with_guidance(self):
+        km = KeyedMetric(SumMetric, 4)
+        with pytest.raises(TorchMetricsUserError, match="PER KEY"):
+            km(_ids(0), _f32(1.0))
+
+    def test_aot_update_tier_engages_and_donates(self):
+        c0 = obs.telemetry.counter("dispatch.donated_steps").value
+        km = KeyedMetric(SumMetric, 6)
+        for i in range(3):
+            km.update(_ids(0, 1, 2), _f32(i, i, i))
+        assert obs.telemetry.counter("dispatch.donated_steps").value > c0
+        assert km.state_generation >= 2  # donated commits bump the generation
+
+    def test_weighted_mean_kwargs_route_through(self):
+        km = KeyedMetric(MeanMetric, 3)
+        km.update(_ids(0, 0, 1), _f32(10, 20, 5), weight=_f32(1, 3, 2))
+        ref0 = MeanMetric()
+        ref0.update(_f32(10, 20), weight=_f32(1, 3))
+        assert float(km.compute_key(0)) == float(ref0.compute())
+        assert float(km.compute_key(1)) == 5.0
+
+
+class TestComputeGather:
+    def test_lazy_gather_matches_full_compute(self):
+        km = KeyedMetric(SumMetric, 10)
+        km.update(_ids(1, 7, 1), _f32(1, 2, 3))
+        full = np.asarray(km.compute())
+        sub = np.asarray(km.compute(keys=[7, 1]))
+        assert sub.tolist() == [full[7], full[1]]
+        assert float(km.compute_key(7)) == 2.0
+
+    def test_gather_validates_keys(self):
+        km = KeyedMetric(SumMetric, 4)
+        km.update(_ids(0), _f32(1.0))
+        with pytest.raises(TorchMetricsUserError, match="out of range"):
+            km.compute(keys=[9])
+
+    def test_gather_through_journal_proxy(self, tmp_path):
+        km = KeyedMetric(SumMetric, 4)
+        jm = km.journal(str(tmp_path / "wal"))
+        jm.update(_ids(2), _f32(5.0))
+        assert np.asarray(jm.compute(keys=[2])).tolist() == [5.0]
+
+    def test_poison_guard_covers_keyed_compute(self):
+        from torchmetrics_tpu.utils.exceptions import NumericPoisonError
+
+        km = KeyedMetric(SumMetric(nan_strategy="ignore"), 4, nan_policy="raise")
+        km.update(_ids(0, 1), _f32(1.0, np.inf))
+        with pytest.raises(NumericPoisonError):
+            km.compute(keys=[0])
+
+
+class TestCollection:
+    def test_members_register_under_template_names(self):
+        kc = KeyedMetricCollection([SumMetric(), MaxMetric()], num_keys=3)
+        assert sorted(kc.keys()) == ["MaxMetric", "SumMetric"]
+        assert kc.num_keys == 3
+
+    def test_update_and_lazy_compute(self):
+        kc = KeyedMetricCollection([SumMetric(), MinMetric()], num_keys=4)
+        kc.update(_ids(0, 2, 0), _f32(3, 7, 1))
+        out = kc.compute(keys=[0])
+        assert float(np.asarray(out["SumMetric"])[0]) == 4.0
+        assert float(np.asarray(out["MinMetric"])[0]) == 1.0
+        full = kc.compute()
+        assert np.asarray(full["SumMetric"]).shape == (4,)
+
+    def test_forward_raises(self):
+        kc = KeyedMetricCollection([SumMetric()], num_keys=2)
+        with pytest.raises(TorchMetricsUserError, match="forward"):
+            kc(_ids(0), _f32(1.0))
+
+    def test_collection_keyed_helper_clones(self):
+        mc = MetricCollection([SumMetric(), MaxMetric()])
+        kc = mc.keyed(5)
+        assert isinstance(kc, KeyedMetricCollection)
+        kc.update(_ids(1), _f32(9.0))
+        # the source collection is untouched
+        assert not any(m.update_called for m in mc.values(copy_state=False))
+
+    def test_mismatched_num_keys_rejected(self):
+        with pytest.raises(ValueError, match="num_keys"):
+            KeyedMetricCollection([KeyedMetric(SumMetric, 3)], num_keys=4)
+
+    def test_duplicate_templates_rejected(self):
+        with pytest.raises(ValueError, match="both named"):
+            KeyedMetricCollection([SumMetric(), SumMetric()], num_keys=2)
+
+    def test_snapshot_restore_round_trip(self):
+        kc = KeyedMetricCollection([SumMetric(), MaxMetric()], num_keys=3)
+        kc.update(_ids(0, 1), _f32(2, 8))
+        blob = kc.snapshot()
+        fresh = KeyedMetricCollection([SumMetric(), MaxMetric()], num_keys=3)
+        fresh.restore(blob)
+        a, b = kc.compute(), fresh.compute()
+        for name in a:
+            assert np.asarray(a[name]).tobytes() == np.asarray(b[name]).tobytes()
+
+
+class TestSerde:
+    def test_pickle_round_trip(self):
+        import pickle
+
+        km = KeyedMetric(MeanMetric, 4)
+        km.update(_ids(1, 1), _f32(3, 5))
+        clone = pickle.loads(pickle.dumps(km))
+        assert clone.num_keys == 4 and clone.strategy == "segments"
+        assert np.asarray(clone.compute()).tobytes() == np.asarray(km.compute()).tobytes()
+        clone.update(_ids(0), _f32(7.0))  # kernels rebuild after unpickle
+        assert float(clone.compute_key(0)) == 7.0
+
+    def test_clone_is_independent(self):
+        km = KeyedMetric(SumMetric, 3)
+        km.update(_ids(0), _f32(1.0))
+        c = km.clone()
+        c.update(_ids(0), _f32(10.0))
+        assert float(km.compute_key(0)) == 1.0
+        assert float(c.compute_key(0)) == 11.0

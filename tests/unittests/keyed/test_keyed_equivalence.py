@@ -1,0 +1,187 @@
+"""Bit-identical equivalence: KeyedMetric(cls, N) vs a dict of N plain instances.
+
+The keyed engine's headline contract (docs/keyed.md, ISSUE 7 acceptance): for
+Sum/Mean/Max/Min templates, every per-key value out of the fused keyed kernel equals —
+bitwise — what N independent instances accumulate from the same stream, across the jit,
+AOT+donation, and buffered dispatch tiers, including ragged key batches, never-updated
+keys, and the snapshot -> restore -> replay round trip.
+
+Batches are integer-valued float32, so float accumulation is EXACT and reduction-order
+differences cannot hide behind epsilons.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.keyed import KeyedMetric
+
+N_KEYS = 13
+AGGREGATORS = [SumMetric, MeanMetric, MaxMetric, MinMetric]
+TIERS = ["aot", "jit", "buffered"]
+
+
+def _stream(seed: int, n_batches: int = 6, ragged: bool = False):
+    """Seeded mixed-key batches; ragged=True varies the batch length per step."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for i in range(n_batches):
+        size = (5, 1, 9, 4, 7, 3)[i % 6] if ragged else 8
+        ids = rng.randint(0, N_KEYS - 2, size=size).astype(np.int32)  # keys N-2, N-1 never updated
+        vals = rng.randint(-6, 7, size=size).astype(np.float32)
+        batches.append((ids, vals))
+    return batches
+
+
+def _instance_reference(cls, batches) -> np.ndarray:
+    insts = [cls() for _ in range(N_KEYS)]
+    for ids, vals in batches:
+        for k in np.unique(ids):
+            insts[k].update(vals[ids == k])
+    return np.stack([np.asarray(m.compute()) for m in insts])
+
+
+def _run_keyed(cls, batches, tier: str, monkeypatch, strategy: str = "auto") -> KeyedMetric:
+    if tier == "jit":
+        monkeypatch.setenv("TM_TPU_FAST_DISPATCH", "0")
+    km = KeyedMetric(cls, N_KEYS, strategy=strategy)
+    if tier == "buffered":
+        with km.buffered(3) as buf:
+            for ids, vals in batches:
+                buf.update(ids, vals)
+    else:
+        for ids, vals in batches:
+            km.update(ids, vals)
+    return km
+
+
+class TestTierEquivalence:
+    @pytest.mark.parametrize("tier", TIERS)
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    def test_bit_identical_vs_instance_dict(self, cls, tier, monkeypatch):
+        batches = _stream(seed=3)
+        km = _run_keyed(cls, batches, tier, monkeypatch)
+        keyed = np.asarray(km.compute())
+        ref = _instance_reference(cls, batches)
+        assert keyed.shape == (N_KEYS,)
+        assert keyed.tobytes() == ref.tobytes()
+
+    @pytest.mark.parametrize("tier", TIERS)
+    @pytest.mark.parametrize("cls", [SumMetric, MeanMetric])
+    def test_ragged_key_batches(self, cls, tier, monkeypatch):
+        # varying batch lengths: the AOT tier compiles one executable per signature and
+        # the buffered tier auto-flushes on shape change — results must not care
+        batches = _stream(seed=5, n_batches=8, ragged=True)
+        km = _run_keyed(cls, batches, tier, monkeypatch)
+        assert np.asarray(km.compute()).tobytes() == _instance_reference(cls, batches).tobytes()
+
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    def test_never_updated_keys_match_fresh_instances(self, cls):
+        batches = _stream(seed=7)
+        km = _run_keyed(cls, batches, "aot", None)
+        keyed = np.asarray(km.compute())
+        fresh = np.asarray(cls().compute())  # -inf / +inf / 0.0 depending on the class
+        for k in (N_KEYS - 2, N_KEYS - 1):
+            assert keyed[k].tobytes() == fresh.tobytes()
+
+    @pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric])
+    def test_vmap_strategy_matches_segments(self, cls, monkeypatch):
+        batches = _stream(seed=9)
+        seg = _run_keyed(cls, batches, "aot", monkeypatch, strategy="segments")
+        vm = _run_keyed(cls, batches, "aot", monkeypatch, strategy="vmap")
+        assert np.asarray(seg.compute()).tobytes() == np.asarray(vm.compute()).tobytes()
+
+    def test_vmap_bit_identical_on_inexact_floats(self, monkeypatch):
+        # the vmap fallback preserves the instance loop's op ORDER, so even non-exact
+        # floats round-trip bitwise; the segment path only guarantees this for exact data
+        rng = np.random.RandomState(1)
+        batches = [
+            (rng.randint(0, N_KEYS, size=8).astype(np.int32), rng.rand(8).astype(np.float32))
+            for _ in range(4)
+        ]
+        km = KeyedMetric(SumMetric, N_KEYS, strategy="vmap")
+        insts = [SumMetric() for _ in range(N_KEYS)]
+        for ids, vals in batches:
+            km.update(ids, vals)
+            for i in range(len(ids)):  # true per-element order
+                insts[ids[i]].update(vals[i])
+        ref = np.stack([np.asarray(m.compute()) for m in insts])
+        assert np.asarray(km.compute()).tobytes() == ref.tobytes()
+
+    @pytest.mark.parametrize("tier", TIERS)
+    def test_update_batches_stack_matches_loop(self, tier, monkeypatch):
+        if tier == "jit":
+            monkeypatch.setenv("TM_TPU_FAST_DISPATCH", "0")
+        batches = _stream(seed=11)
+        ids_stack = np.stack([b[0] for b in batches])
+        vals_stack = np.stack([b[1] for b in batches])
+        km = KeyedMetric(SumMetric, N_KEYS)
+        if tier == "buffered":
+            with km.buffered(len(batches)) as buf:
+                for ids, vals in batches:
+                    buf.update(ids, vals)
+        else:
+            km.update_batches(ids_stack, vals_stack)
+        assert np.asarray(km.compute()).tobytes() == _instance_reference(SumMetric, batches).tobytes()
+
+
+class TestKeyedRoundTrip:
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    def test_snapshot_restore_replay_bit_identical(self, cls):
+        batches = _stream(seed=13, n_batches=8)
+        km = KeyedMetric(cls, N_KEYS)
+        for ids, vals in batches[:4]:
+            km.update(ids, vals)
+        blob = km.snapshot()
+        assert blob["keys"]["num_keys"] == N_KEYS
+        assert blob["keys"]["template"] == cls.__name__
+        # preemption: a fresh instance restores and replays the tail
+        fresh = KeyedMetric(cls, N_KEYS)
+        fresh.restore(blob)
+        for ids, vals in batches[4:]:
+            fresh.update(ids, vals)
+        ref = KeyedMetric(cls, N_KEYS)
+        for ids, vals in batches:
+            ref.update(ids, vals)
+        assert np.asarray(fresh.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+
+    def test_journal_recover_all_keys_bit_identical(self, tmp_path):
+        from torchmetrics_tpu.robust import journal as _journal
+
+        batches = _stream(seed=17, n_batches=7)
+        km = KeyedMetric(MeanMetric, N_KEYS)
+        jm = km.journal(str(tmp_path / "wal"), every_k=3)
+        for ids, vals in batches[:5]:
+            jm.update(ids, vals)
+        # process dies cold (batches pending past the last snapshot live only in the WAL)
+        fresh = KeyedMetric(MeanMetric, N_KEYS)
+        recovery = _journal.recover(fresh, str(tmp_path / "wal"))
+        assert recovery["snapshot_restored"] and recovery["replayed"] >= 1
+        for ids, vals in batches[5:]:
+            fresh.update(ids, vals)
+        ref = KeyedMetric(MeanMetric, N_KEYS)
+        for ids, vals in batches:
+            ref.update(ids, vals)
+        assert np.asarray(fresh.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+        # and equals the instance loop — the journaled keyed world replaces it faithfully
+        assert np.asarray(fresh.compute()).tobytes() == _instance_reference(MeanMetric, batches).tobytes()
+
+    def test_restore_rejects_wrong_key_space(self):
+        from torchmetrics_tpu.utils.exceptions import SnapshotError
+
+        km = KeyedMetric(SumMetric, N_KEYS)
+        km.update(np.array([0, 1], np.int32), np.array([1.0, 2.0], np.float32))
+        blob = km.snapshot()
+        with pytest.raises(SnapshotError, match="key"):
+            KeyedMetric(SumMetric, N_KEYS + 1).restore(blob)
+        with pytest.raises(SnapshotError):
+            KeyedMetric(MeanMetric, N_KEYS).restore(blob)
+
+    def test_restore_rejects_unkeyed_blob(self):
+        from torchmetrics_tpu.utils.exceptions import SnapshotError
+
+        plain = SumMetric()
+        plain.update(np.array([1.0, 2.0], np.float32))
+        with pytest.raises(SnapshotError):
+            KeyedMetric(SumMetric, N_KEYS).restore(plain.snapshot())

@@ -568,6 +568,82 @@ class TestTPU009TelemetryInJit:
         ) == []
 
 
+# ------------------------------------------------------------------------------- TPU010
+class TestTPU010PerKeyMetricLoop:
+    def test_dict_comprehension_items_loop_flags(self):
+        assert "TPU010" in _rules(
+            """
+            from torchmetrics_tpu.aggregation import SumMetric
+            def step(batch):
+                per_user = {uid: SumMetric() for uid in batch.users}
+                for uid, m in per_user.items():
+                    m.update(batch.values[uid])
+            """
+        )
+
+    def test_list_subscript_forward_flags(self):
+        assert "TPU010" in _rules(
+            """
+            def step(values, keys):
+                metrics = [SumMetric() for _ in range(10)]
+                for k in keys:
+                    metrics[k].forward(values[k])
+            """
+        )
+
+    def test_dict_literal_values_loop_flags(self):
+        assert "TPU010" in _rules(
+            """
+            from torchmetrics_tpu.classification import MulticlassAccuracy
+            def step(shards):
+                per_slice = {"a": MulticlassAccuracy(3), "b": MulticlassAccuracy(3)}
+                for m in per_slice.values():
+                    m.update(shards)
+            """
+        )
+
+    def test_library_container_iteration_is_clean(self):
+        # MetricCollection's own member loop: the container is self state, not a locally
+        # built per-key dict — the analyzer cannot know what it holds
+        assert _rules(
+            """
+            class Collection:
+                def update(self, *args):
+                    for m in self.values():
+                        m.update(*args)
+            """
+        ) == []
+
+    def test_compute_only_loop_is_clean(self):
+        assert _rules(
+            """
+            def report(keys):
+                per_user = {k: SumMetric() for k in keys}
+                return {k: m.compute() for k, m in per_user.items()}
+            """
+        ) == []
+
+    def test_non_metric_container_is_clean(self):
+        assert _rules(
+            """
+            def step(handlers, events):
+                hooks = [make_handler() for _ in range(4)]
+                for h in hooks:
+                    h.update(events)
+            """
+        ) == []
+
+    def test_suppression_comment_waives(self):
+        assert _rules(
+            """
+            def step(batch):
+                per_user = {uid: SumMetric() for uid in batch.users}
+                for uid, m in per_user.items():
+                    m.update(batch.values[uid])  # jaxlint: disable=TPU010
+            """
+        ) == []
+
+
 # ------------------------------------------------------------------------------- TPU000
 def test_syntax_error_reports_tpu000():
     assert _rules("def broken(:\n") == ["TPU000"]

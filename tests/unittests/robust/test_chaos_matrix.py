@@ -98,6 +98,24 @@ class TestScenarioEvidence:
         # a mid-window preemption must have left batches only the journal saw
         assert result["pending_at_death"] >= 0 and result["replayed"] >= result["pending_at_death"]
 
+    def test_keyed_preemption_restores_all_key_states(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            MeanMetric, workdir=str(tmp_path), seed=SEED, scenarios=("keyed_preemption_journal",)
+        )
+        (result,) = matrix.run(n_batches=7)
+        assert result["passed"] and result["bit_identical"]
+        # the recovered tenant table must also equal the per-instance loop it replaces
+        assert result["instance_loop_identical"]
+        assert result["num_keys"] >= 2 and result["replayed"] >= 0
+        assert result["snapshot_restored"] in (True, False)
+
+    def test_keyed_scenario_skips_unkeyable_templates(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            CatMetric, workdir=str(tmp_path), seed=SEED, scenarios=("keyed_preemption_journal",)
+        )
+        (result,) = matrix.run(n_batches=5)
+        assert result["passed"] and result.get("scenario_applicable") is False
+
     def test_failing_factory_reports_cell_not_abort(self, tmp_path):
         class Broken(SumMetric):
             def compute(self):

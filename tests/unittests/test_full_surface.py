@@ -314,6 +314,11 @@ def _spec():
     spec["MetricTracker"] = (lambda: tm.MetricTracker(MeanSquaredError()), _reg)
     spec["MetricCollection"] = (
         lambda: tm.MetricCollection([tm.classification.MulticlassAccuracy(num_classes=C)]), _mc)
+    _keyed_batch = lambda: (jnp.asarray(rng.randint(0, 4, N).astype(np.int32)),
+                            jnp.asarray(rng.randint(0, 9, N).astype(np.float32)))
+    spec["KeyedMetric"] = (lambda: tm.KeyedMetric(tm.SumMetric, num_keys=4), _keyed_batch)
+    spec["KeyedMetricCollection"] = (
+        lambda: tm.KeyedMetricCollection([tm.SumMetric(), tm.MaxMetric()], num_keys=4), _keyed_batch)
     spec["Metric"] = None          # abstract base
     spec["__version__"] = None
     spec["functional"] = None

@@ -30,6 +30,8 @@ TPU007    value read after being donated to a compiled dispatch (deleted buffer)
 TPU008    bare ``assert`` on a traced value inside jit (a validation no-op)
 TPU009    telemetry/``obs`` registry call inside a jit-traced function (the host
           side effect runs at trace time only — silently dropped per step)
+TPU010    host-side Python loop calling ``.update()``/``.forward()`` over a
+          dict/list of Metric instances (per-key loop — use KeyedMetric)
 ========  ======================================================================
 """
 from __future__ import annotations
@@ -51,6 +53,7 @@ RULES: Dict[str, str] = {
     "TPU007": "value read after being donated to a compiled dispatch (deleted buffer)",
     "TPU008": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
     "TPU009": "telemetry/obs registry call inside jit-traced code (runs at trace time only)",
+    "TPU010": "host-side per-key Metric update loop (one dispatch per key - use KeyedMetric)",
 }
 
 # wrapper callables whose function arguments execute under tracing
@@ -990,9 +993,116 @@ def _rule_tpu009(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+def _metric_ctor_names(model: _ModuleModel) -> Set[str]:
+    """Names this module imported from a metrics package (``from ...metrics import X``).
+
+    The boundary TPU010 draws for "is this call a Metric constructor": a call to a name
+    imported from a module whose path mentions ``metrics``, or to any name ending in
+    ``Metric`` (``SumMetric``, a local ``MyMetric`` subclass). Locally defined classes
+    whose names don't say so are invisible — under-reporting beats flagging every loop
+    that calls ``.update()`` on arbitrary objects.
+    """
+    names: Set[str] = set()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and "metrics" in node.module:
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _rule_tpu010(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Host-side per-key loop driving a dict/list of Metric instances.
+
+    The shape that serves N tenants as N instances::
+
+        per_user = {uid: SumMetric() for uid in users}
+        for uid, m in per_user.items():
+            m.update(values[uid])             # one dispatch PER KEY per step
+
+    Every iteration is a separate kernel launch plus jit argument processing — the
+    host-overhead regime the engine's fused tiers exist to kill, multiplied by the key
+    count. ``torchmetrics_tpu.keyed.KeyedMetric(template, num_keys=N)`` holds all N
+    streams in one ``[N, ...]`` state table and folds a mixed-key batch in ONE launch.
+
+    Boundary: only fires when the iterated container was built *in the same function* as
+    a dict/list/set (literal or comprehension) of Metric-constructor calls — a loop over
+    ``self.metrics`` or an argument stays clean (the analyzer cannot see what it holds;
+    library containers like ``MetricCollection`` iterate members legitimately).
+    """
+    ctor_names = _metric_ctor_names(model)
+
+    def is_metric_ctor(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = _final_name(node.func)
+        return bool(name) and (name.endswith("Metric") or name in ctor_names)
+
+    out: List[Finding] = []
+    for info in model.functions:
+        per_key: Set[str] = set()
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            elems: List[ast.AST] = []
+            if isinstance(value, ast.DictComp):
+                elems = [value.value]
+            elif isinstance(value, (ast.ListComp, ast.SetComp)):
+                elems = [value.elt]
+            elif isinstance(value, ast.Dict):
+                elems = list(value.values)
+            elif isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+                elems = list(value.elts)
+            if elems and all(is_metric_ctor(e) for e in elems):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        per_key.add(t.id)
+        if not per_key:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.For):
+                continue
+            container = None
+            it = node.iter
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) and (
+                it.func.attr in ("values", "items") and isinstance(it.func.value, ast.Name)
+            ):
+                container = it.func.value.id
+            elif isinstance(it, ast.Name):
+                container = it.id
+            loop_targets = {
+                t.id for t in ast.walk(node.target) if isinstance(t, ast.Name)
+            } if container in per_key else set()
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)):
+                    continue
+                if sub.func.attr not in ("update", "forward"):
+                    continue
+                base = sub.func.value
+                hit = (
+                    (isinstance(base, ast.Name) and base.id in loop_targets)
+                    or (
+                        isinstance(base, ast.Subscript)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id in per_key
+                    )
+                )
+                if hit:
+                    which = base.id if isinstance(base, ast.Name) else base.value.id  # type: ignore[union-attr]
+                    out.append(_finding(
+                        "TPU010", path, sub, lines,
+                        f"per-key Metric loop: `.{sub.func.attr}()` on instances of"
+                        f" {which!r} dispatches one kernel per key per step — route the"
+                        " mixed-key batch through keyed.KeyedMetric(template, num_keys=N)"
+                        " (one fused launch updates every key; docs/keyed.md)",
+                    ))
+                    break
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
-    _rule_tpu007, _rule_tpu008, _rule_tpu009,
+    _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010,
 )
 
 

@@ -305,6 +305,22 @@ class MetricCollection:
 
         return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
 
+    def keyed(self, num_keys: int, strategy: str = "auto") -> "MetricCollection":
+        """A :class:`~torchmetrics_tpu.keyed.KeyedMetricCollection` twin of this collection.
+
+        Every member is cloned and wrapped with a shared ``[num_keys, ...]`` tenant axis:
+        ``update(key_ids, ...)`` then folds a mixed-tenant batch into every member's
+        tenant table in one fused launch per compute group, and ``compute(keys=...)``
+        gathers per-key values lazily. This collection's own members and state are left
+        untouched. See ``docs/keyed.md``.
+        """
+        from torchmetrics_tpu.keyed import KeyedMetricCollection
+
+        return KeyedMetricCollection(
+            {name: m.clone() for name, m in self._modules.items()},
+            num_keys=num_keys, strategy=strategy, prefix=self.prefix, postfix=self.postfix,
+        )
+
     @property
     def world_consistent(self) -> Any:
         """Worst member consistency grade: ``full`` only when EVERY member's last sync was.

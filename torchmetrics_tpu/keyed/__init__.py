@@ -1,0 +1,13 @@
+"""Keyed multi-tenant metrics: one kernel, a million streams.
+
+One :class:`~torchmetrics_tpu.metric.Metric` instance owns one logical stream; serving
+per-user / per-slice metrics for millions of tenants as a dict of instances means millions
+of per-step dispatches — the host-overhead regime the fast-dispatch tiers exist to kill.
+:class:`KeyedMetric` vectorizes the tenant axis instead: every state carries a leading
+``[num_keys, ...]`` axis (one fixed-shape resident table), and ``update(key_ids, ...)``
+routes a mixed-tenant batch through ONE fused launch — segment reductions for
+sum/max/min-shaped states, a vmap fallback otherwise. See ``docs/keyed.md``.
+"""
+from torchmetrics_tpu.keyed.engine import STRATEGIES, KeyedMetric, KeyedMetricCollection
+
+__all__ = ["KeyedMetric", "KeyedMetricCollection", "STRATEGIES"]

@@ -1,0 +1,461 @@
+"""The keyed multi-tenant engine: ``KeyedMetric`` / ``KeyedMetricCollection``.
+
+Design (docs/keyed.md):
+
+- **State**: for every tensor state of the template metric, the keyed metric registers the
+  same state with a leading ``[num_keys, ...]`` tenant axis — the whole tenant table is one
+  fixed-shape resident device buffer (memory ``num_keys x state_size``), so the dispatch
+  tiers, donation, snapshots, the journal, and ``process_sync`` all see an ordinary metric
+  with bigger states. List ("cat") states cannot be keyed (unbounded per-tenant shape).
+
+- **Update routing** (``update(key_ids, *batch)``), one fused XLA program either way:
+
+  * ``segments`` — the fast path for metrics whose update *decomposes per element* under
+    their registered reductions (every state ``sum``/``max``/``min``-reduced): the
+    template's own ``_update`` is vmapped over the batch elements against the defaults
+    (so masking/NaN handling/dtype rules are inherited, never re-implemented), and each
+    state's per-element contributions are folded into the tenant table with ONE segment
+    reduction (``ops/segments.py``). Cost ``O(batch)``, independent of ``num_keys``.
+  * ``vmap`` — the general fallback: the per-key sequential fold is vmapped across the
+    tenant axis; each key scans the batch, applies the template update speculatively,
+    and commits it only for its own elements. Bit-identical to a per-instance loop BY
+    CONSTRUCTION (same op order per key), but costs ``O(num_keys x batch)`` — right for
+    non-decomposable metrics at modest ``num_keys``, wrong at a million.
+
+- **Dispatch**: the keyed update is just another compiled kernel. ``fast_update`` opts the
+  class into the AOT single-update tier (``Metric._fast_update``): steady-state updates go
+  through a compiled executable with the ``[num_keys, ...]`` state buffers donated.
+  ``update_batches`` / ``buffered(k)`` ride the inherited whole-stack scan.
+
+- **Compute** (``compute(keys=...)``): a vectorized gather — only the requested rows of
+  the tenant table are materialized and the template's ``_compute`` is vmapped over them.
+  ``compute()`` with no keys finalises all ``num_keys`` streams in one program.
+
+- **Robustness**: ``snapshot()`` blobs gain a ``keys`` descriptor (validated on restore —
+  ``robust/checkpoint.py``), the write-ahead journal records ``(key_ids, batch)`` and
+  replays bit-identically, and ``process_sync`` reduces the keyed states elementwise
+  across ranks through the existing bounded/quorum path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.ops import segments as _segments
+from torchmetrics_tpu.utils.checks import is_traced
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+#: update-routing strategies: "auto" picks segments when the template decomposes
+STRATEGIES = ("auto", "segments", "vmap")
+
+_SUM_FX = ("sum", jnp.sum)
+_MAX_FX = ("max", jnp.max)
+_MIN_FX = ("min", jnp.min)
+
+
+class KeyedMetric(Metric):
+    """One metric, ``num_keys`` independent logical streams, one kernel per batch.
+
+    ``metric`` is the template: an instance (or zero-arg-constructible class) whose
+    ``_update``/``_compute`` kernels and registered states define the per-key semantics.
+    The template instance itself is never updated — it is the source of the kernels and
+    defaults only.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> from torchmetrics_tpu.keyed import KeyedMetric
+        >>> km = KeyedMetric(SumMetric, num_keys=4)
+        >>> km.update(np.array([0, 2, 0, 2]), np.array([1.0, 10.0, 2.0, 20.0]))
+        >>> np.asarray(km.compute()).tolist()          # every stream, one launch
+        [3.0, 0.0, 30.0, 0.0]
+        >>> np.asarray(km.compute(keys=[2])).tolist()  # lazy per-key gather
+        [30.0]
+    """
+
+    #: the keyed update is an update-only protocol: opt into the AOT+donation update tier
+    fast_update = True
+
+    def __init__(
+        self,
+        metric: Union[Metric, type],
+        num_keys: int,
+        strategy: str = "auto",
+        validate_keys: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(metric, type):
+            if not issubclass(metric, Metric):
+                raise ValueError(f"Expected a Metric instance or subclass, got {metric!r}")
+            metric = metric()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected a Metric instance or subclass, got {metric!r}")
+        if isinstance(metric, KeyedMetric):
+            raise ValueError("KeyedMetric cannot be nested: pass the plain template metric")
+        num_keys = int(num_keys)
+        if num_keys < 1:
+            raise ValueError(f"KeyedMetric needs num_keys >= 1, got {num_keys}")
+        if metric._state.lists:
+            raise TorchMetricsUserError(
+                f"{type(metric).__name__} holds list ('cat') states, which have no fixed"
+                " per-key shape — only tensor-state metrics can be keyed. Bound the state"
+                " first (e.g. a binned/sketched variant) and key that."
+            )
+        if not (metric.jit_update and metric.jit_compute):
+            raise TorchMetricsUserError(
+                f"{type(metric).__name__} opts out of jit (jit_update/jit_compute=False):"
+                " its kernels cannot trace into the fused keyed program."
+            )
+        self._template = metric
+        self.num_keys = num_keys
+        self.validate_keys = bool(validate_keys)
+        self._tpl_names = tuple(metric._state.tensors)
+        self._strategy = self._resolve_strategy(strategy)
+        for name in self._tpl_names:
+            default = metric._defaults[name]
+            keyed_default = jnp.broadcast_to(default, (num_keys,) + tuple(jnp.shape(default)))
+            self.add_state(name, keyed_default, dist_reduce_fx=metric._reductions[name])
+        # host-side activity tracking (telemetry only): which keys ever saw an update
+        self._seen_keys = np.zeros(num_keys, dtype=bool)
+        self._active_count = 0
+
+    # ------------------------------------------------------------------ strategy
+    def _decomposable(self) -> bool:
+        """True when every template state merges per element under segment reductions."""
+        for name in self._tpl_names:
+            fx = self._template._reductions[name]
+            if fx in _SUM_FX or fx in _MAX_FX or fx in _MIN_FX:
+                continue
+            return False
+        return True
+
+    def _resolve_strategy(self, strategy: str) -> str:
+        if strategy not in STRATEGIES:
+            raise ValueError(f"KeyedMetric strategy must be one of {STRATEGIES}, got {strategy!r}")
+        if strategy == "segments":
+            if not self._decomposable():
+                raise TorchMetricsUserError(
+                    f"{type(self._template).__name__} does not decompose under segment"
+                    " reductions (a state's dist_reduce_fx is not sum/max/min) — use"
+                    " strategy='vmap' (or 'auto')."
+                )
+            return strategy
+        if strategy == "vmap":
+            return strategy
+        hint = type(self._template).keyed_decomposable
+        if hint is not None:
+            return "segments" if hint else "vmap"
+        return "segments" if self._decomposable() else "vmap"
+
+    @property
+    def strategy(self) -> str:
+        """Resolved update-routing strategy: ``"segments"`` or ``"vmap"``."""
+        return self._strategy
+
+    @property
+    def template(self) -> Metric:
+        """The template metric the per-key kernels come from (never updated itself)."""
+        return self._template
+
+    @property
+    def active_keys(self) -> int:
+        """Keys this instance has seen at least one (host-visible) update for.
+
+        Best-effort telemetry: key ids arriving as tracers (inside an outer jit) cannot
+        be inspected without a host sync and are not counted.
+        """
+        return self._active_count
+
+    # ------------------------------------------------------------------ kernels
+    def _update(self, state: Dict[str, Array], key_ids: Array, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        key_ids = jnp.asarray(key_ids)
+        if not jnp.issubdtype(key_ids.dtype, jnp.integer):
+            raise TorchMetricsUserError(
+                f"key_ids must be an integer array, got dtype {key_ids.dtype}"
+            )
+        if self._strategy == "segments":
+            return self._segment_update(state, key_ids, args, kwargs)
+        return self._vmap_update(state, key_ids, args, kwargs)
+
+    def _segment_update(
+        self, state: Dict[str, Array], key_ids: Array, args: tuple, kwargs: dict
+    ) -> Dict[str, Array]:
+        """Per-element contributions via the template's OWN kernel, one segment reduce per state."""
+        tpl = self._template
+        defaults = {n: tpl._defaults[n] for n in self._tpl_names}
+        upd = tpl._update
+
+        def _elem(e_args: tuple, e_kwargs: dict) -> Dict[str, Array]:
+            out = upd(dict(defaults), *e_args, **e_kwargs)
+            return {n: out.get(n, defaults[n]) for n in defaults}
+
+        contribs = jax.vmap(_elem)(args, kwargs)  # {name: [batch, *state_shape]}
+        n_keys = self.num_keys
+        new: Dict[str, Array] = {}
+        for name in self._tpl_names:
+            fx = self._reductions[name]
+            cur = state[name]
+            c = contribs[name]
+            if fx in _SUM_FX:
+                # the per-element output includes the default; sum defaults are typically
+                # zero but subtracting keeps custom non-zero defaults exact
+                seg = _segments.segment_sum(c - defaults[name], key_ids, n_keys)
+                new[name] = cur + seg.astype(cur.dtype)
+            elif fx in _MAX_FX:
+                # empty segments come back as the dtype's identity (-inf): a no-op merge
+                seg = _segments.segment_max(c, key_ids, n_keys)
+                new[name] = jnp.maximum(cur, seg.astype(cur.dtype))
+            else:  # _MIN_FX — _resolve_strategy guarantees nothing else reaches here
+                seg = _segments.segment_min(c, key_ids, n_keys)
+                new[name] = jnp.minimum(cur, seg.astype(cur.dtype))
+        return new
+
+    def _vmap_update(
+        self, state: Dict[str, Array], key_ids: Array, args: tuple, kwargs: dict
+    ) -> Dict[str, Array]:
+        """General fallback: per-key sequential fold, vmapped across the tenant axis.
+
+        Each key scans the whole batch, applies the template update speculatively, and
+        commits the result only for its own elements — exact per-instance semantics
+        (including op order), at ``O(num_keys x batch)`` compute.
+        """
+        tpl = self._template
+        upd = tpl._update
+        names = self._tpl_names
+
+        def per_key(st_n: Dict[str, Array], key: Array) -> Dict[str, Array]:
+            def body(st, elem):
+                ids_i, (e_args, e_kwargs) = elem
+                out = upd(dict(st), *e_args, **e_kwargs)
+                hit = ids_i == key
+                return {n: jnp.where(hit, out.get(n, st[n]), st[n]) for n in st}, None
+
+            final, _ = jax.lax.scan(body, st_n, (key_ids, (args, kwargs)))
+            return final
+
+        sub = {n: state[n] for n in names}
+        return jax.vmap(per_key)(sub, jnp.arange(self.num_keys))
+
+    def _compute(self, state: Dict[str, Any]) -> Any:
+        """Finalise every stream: the template's compute vmapped over the tenant axis."""
+        sub = {n: state[n] for n in self._tpl_names}
+        return jax.vmap(self._template._compute)(sub)
+
+    # ------------------------------------------------------------------- protocol
+    def _check_key_ids(self, key_ids: Any, args: tuple = (), kwargs: Optional[dict] = None) -> None:
+        """Host-side key validation + activity counters (skipped for traced ids)."""
+        if not args and not kwargs:
+            raise TorchMetricsUserError(
+                "KeyedMetric.update needs the template metric's batch inputs after key_ids"
+            )
+        if is_traced(key_ids):
+            return
+        ids = np.asarray(key_ids)
+        if self.validate_keys:
+            if ids.dtype.kind not in "iu":
+                raise TorchMetricsUserError(
+                    f"key_ids must be an integer array, got dtype {ids.dtype}"
+                )
+            if ids.size and (ids.min() < 0 or ids.max() >= self.num_keys):
+                raise TorchMetricsUserError(
+                    f"key_ids out of range: found values in [{ids.min()}, {ids.max()}],"
+                    f" this KeyedMetric holds keys [0, {self.num_keys})."
+                )
+        if ids.size:
+            uniq = np.unique(ids)
+            obs.telemetry.counter("keyed.fanout").inc(int(uniq.size))
+            seen = self._seen_keys
+            newly = int(np.count_nonzero(~seen[uniq]))
+            if newly:
+                seen[uniq] = True
+                self._active_count += newly
+                obs.telemetry.counter("keyed.active_keys").inc(newly)
+
+    def update(self, key_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """Fold one mixed-tenant batch into the tenant table — ONE fused launch.
+
+        ``key_ids`` is an integer array of shape ``[batch]`` (element i belongs to stream
+        ``key_ids[i]``); the remaining args/kwargs are the template metric's usual update
+        inputs with the same leading batch axis.
+        """
+        self._check_key_ids(key_ids, args, kwargs)
+        obs.telemetry.counter("keyed.updates").inc()
+        super().update(key_ids, *args, **kwargs)
+
+    def update_batches(self, key_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """Whole-stack sweep: ``key_ids`` and batch args carry an extra leading axis."""
+        self._check_key_ids(key_ids, args, kwargs)
+        n_batches = jnp.shape(key_ids)[0]
+        obs.telemetry.counter("keyed.updates").inc(int(n_batches))
+        super().update_batches(key_ids, *args, **kwargs)
+
+    def compute(self, keys: Optional[Any] = None) -> Any:
+        """Finalise per-key values.
+
+        ``keys=None`` finalises every stream (shape ``[num_keys, ...]`` per output leaf).
+        With ``keys`` (an int sequence/array), only the requested rows of the tenant
+        table are gathered and finalised — lazy: cost scales with ``len(keys)``, not
+        ``num_keys``. The gather path honours the same sync/guard discipline as a plain
+        ``compute()`` (poison guard, buffered-pending guard, ``sync_on_compute``).
+        """
+        if keys is None:
+            return super().compute()
+        _dispatch.guard_buffered_pending(self, "compute")
+        obs.bump(self, "compute_calls")
+        self._guard_poison()
+        keys_arr = jnp.asarray(keys)
+        if keys_arr.ndim == 0:
+            keys_arr = keys_arr[None]
+        if self.validate_keys and not is_traced(keys):
+            ids = np.asarray(keys_arr)
+            if ids.dtype.kind not in "iu":
+                raise TorchMetricsUserError(f"compute(keys=...) needs integer keys, got {ids.dtype}")
+            if ids.size and (ids.min() < 0 or ids.max() >= self.num_keys):
+                raise TorchMetricsUserError(
+                    f"compute(keys=...) out of range: [{ids.min()}, {ids.max()}] vs"
+                    f" [0, {self.num_keys})"
+                )
+        obs.count_dispatch(self)
+        with obs.metric_span(self, "compute"):
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                fn = self._jit_cache.get("keyed_gather")
+                if fn is None:
+                    tpl_compute = self._template._compute
+                    names = self._tpl_names
+
+                    def gather(state: Dict[str, Array], ks: Array):
+                        sub = {n: state[n][ks] for n in names}
+                        return jax.vmap(tpl_compute)(sub)
+
+                    fn = jax.jit(obs.instrument_trace(gather, self, "keyed_gather"))
+                    self._jit_cache["keyed_gather"] = fn
+                value = fn({n: self._state.tensors[n] for n in self._tpl_names}, keys_arr)
+        return value
+
+    def compute_key(self, key: int) -> Any:
+        """One stream's value (a single-row :meth:`compute` gather, leading axis dropped)."""
+        value = self.compute(keys=jnp.asarray([int(key)]))
+        return jax.tree_util.tree_map(lambda v: v[0], value)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise TorchMetricsUserError(
+            "KeyedMetric has no per-batch forward value: a mixed-tenant batch has one"
+            " value PER KEY, not per batch. Drive it with update(key_ids, ...) and read"
+            " values with compute(keys=...)."
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._seen_keys[:] = False
+        self._active_count = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}({type(self._template).__name__}(),"
+            f" num_keys={self.num_keys}, strategy={self._strategy!r})"
+        )
+
+
+class KeyedMetricCollection(MetricCollection):
+    """Many keyed metrics, one ``update(key_ids, ...)`` call, shared tenant axis.
+
+    Accepts the same inputs as :class:`~torchmetrics_tpu.collections.MetricCollection`
+    (metric / sequence / dict, or a whole collection) and wraps every member in a
+    :class:`KeyedMetric` over the shared ``num_keys``. Already-keyed members pass through
+    when their ``num_keys`` matches.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import MaxMetric, SumMetric
+        >>> from torchmetrics_tpu.keyed import KeyedMetricCollection
+        >>> kc = KeyedMetricCollection([SumMetric(), MaxMetric()], num_keys=3)
+        >>> kc.update(np.array([0, 1, 0]), np.array([1.0, 5.0, 2.0]))
+        >>> {k: np.asarray(v).tolist() for k, v in sorted(kc.compute(keys=[0, 1]).items())}
+        {'MaxMetric': [2.0, 5.0], 'SumMetric': [3.0, 5.0]}
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, MetricCollection, Sequence, Dict[str, Any]],
+        *additional_metrics: Metric,
+        num_keys: int,
+        strategy: str = "auto",
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, list] = True,
+        **keyed_kwargs: Any,
+    ) -> None:
+        self.num_keys = int(num_keys)
+
+        def wrap(m: Any) -> Any:
+            if isinstance(m, KeyedMetric):
+                if m.num_keys != self.num_keys:
+                    raise ValueError(
+                        f"KeyedMetricCollection(num_keys={self.num_keys}) cannot hold a"
+                        f" KeyedMetric with num_keys={m.num_keys}"
+                    )
+                return m
+            if isinstance(m, MetricCollection):
+                return KeyedMetricCollection(
+                    dict(m.items(keep_base=True, copy_state=False)),
+                    num_keys=self.num_keys, strategy=strategy, **keyed_kwargs,
+                )
+            return KeyedMetric(m, self.num_keys, strategy=strategy, **keyed_kwargs)
+
+        rest: list = []
+        if isinstance(metrics, dict):
+            if additional_metrics:
+                raise ValueError(
+                    f"Received extra positional arguments {additional_metrics} alongside a"
+                    f" dict of metrics; name every metric in the dict instead."
+                )
+            metrics = {name: wrap(m) for name, m in metrics.items()}
+        else:
+            if isinstance(metrics, Sequence) and not isinstance(metrics, (str, bytes)):
+                wrapped = [wrap(m) for m in (*metrics, *additional_metrics)]
+            else:
+                wrapped = [wrap(metrics), *(wrap(m) for m in additional_metrics)]
+            # unnamed members register under the TEMPLATE class name, not "KeyedMetric"
+            # N times over; nested collections keep their own member names
+            named: Dict[str, Any] = {}
+            for w in wrapped:
+                if isinstance(w, KeyedMetric):
+                    name = type(w.template).__name__
+                    if name in named:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    named[name] = w
+                else:
+                    rest.append(w)
+            metrics = named
+        super().__init__(metrics, prefix=prefix, postfix=postfix, compute_groups=compute_groups)
+        for coll in rest:
+            self.add_metrics(coll)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        raise TorchMetricsUserError(
+            "KeyedMetricCollection has no per-batch forward value — use"
+            " update(key_ids, ...) + compute(keys=...)."
+        )
+
+    def compute(self, keys: Optional[Any] = None) -> Dict[str, Any]:
+        """Per-key values for every member; ``keys`` gathers lazily (see ``KeyedMetric.compute``)."""
+        if keys is None:
+            return super().compute()
+        result = {
+            name: m.compute(keys=keys)
+            for name, m in self.items(keep_base=True, copy_state=False)
+        }
+        return self._finalize_result(result)

@@ -166,6 +166,16 @@ class Metric:
     jit_compute: bool = True
     scan_update: bool = True  # False for host-computation metrics: update_batches loops instead of lax.scan
     fast_dispatch: bool = True  # False opts this class out of the AOT+donation per-step tier
+    #: opt-in AOT+donation tier for plain ``update()`` calls (no batch value returned).
+    #: Off by default — per-step training loops go through ``forward`` (already AOT) and
+    #: eval sweeps through ``update_batches``; update-only hot loops (the keyed engine's
+    #: ``update(key_ids, ...)``) flip this on to dispatch each update through a compiled
+    #: executable with the state buffers donated.
+    fast_update: bool = False
+    #: keyed-engine decomposition hint (``torchmetrics_tpu.keyed``): True forces the
+    #: segment-reduction strategy, False forces the vmap fallback, None (default) infers
+    #: from the registered ``dist_reduce_fx`` set (sum/max/min states decompose).
+    keyed_decomposable: Optional[bool] = None
 
     def __init__(self, **kwargs: Any) -> None:
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
@@ -432,13 +442,21 @@ class Metric:
             )
         _dispatch.guard_buffered_pending(self, "update")
         obs.bump(self, "update_calls")
-        obs.count_dispatch(self)
         with obs.metric_span(self, "update"):
             args, kwargs = self._coerce(args, kwargs)
             if self._should_validate():
                 self._validate(*args, **kwargs)
-            out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
-            self._apply_update_result(out)
+            if not (
+                self.fast_update
+                and self.jit_update
+                and self.fast_dispatch
+                and not self._state.lists
+                and _dispatch.fast_dispatch_enabled()
+                and self._fast_update(args, kwargs)
+            ):
+                obs.count_dispatch(self)
+                out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
+                self._apply_update_result(out)
         self._update_count += 1
         self._update_called = True
         self._computed = None
@@ -559,27 +577,72 @@ class Metric:
                 entry, out = _dispatch.dispatch_step(
                     cache, self._build_aot_update_scan, state_leaves, (), leaves, treedef
                 )
-            if entry.donated:
-                state.commit_donated(entry.state_names, out)
-                obs.telemetry.counter("dispatch.donated_steps").inc()
-            else:
-                for name, arr in zip(entry.state_names, out):
-                    state.tensors[name] = arr
-                state.abort_donated()
+            _dispatch.commit_step(state, entry, out)
             if sampled:
                 tb = time.perf_counter()
                 jax.block_until_ready(out)
                 _profiler.record_sample("scan", tb - ts0, time.perf_counter() - tb)
         except Exception:
-            state.abort_donated()
-            if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
-                for name in state.tensors:
-                    state.tensors[name] = self._defaults[name]
-                rank_zero_warn(
-                    f"A donated update_batches dispatch of {type(self).__name__} failed"
-                    " mid-flight; the metric state was reset to defaults.",
-                    UserWarning,
-                )
+            _dispatch.recover_failed_step(self, state, "update_batches")
+            cache.mark_broken()
+            return False
+        return True
+
+    def _build_aot_update(self, arg_leaves: List[Any], treedef: Any) -> "_dispatch.AotEntry":
+        """Compile a single plain ``update`` for one abstract input signature.
+
+        Flat positional calling convention and donated state, exactly like the forward
+        step — but no batch value and no merge ladder: the output IS the new state. This
+        is the ``fast_update`` tier's builder (update-only hot loops, the keyed engine)."""
+        from jax.tree_util import tree_unflatten
+
+        names = tuple(self._state.tensors)
+        n_state = len(names)
+        upd = self._effective_update()
+
+        def update_flat(*leaves):
+            st = dict(zip(names, leaves[:n_state]))
+            f_args, f_kwargs = tree_unflatten(treedef, leaves[n_state:])
+            out = upd(st, *f_args, **f_kwargs)
+            return tuple(out.get(k, st[k]) for k in names)
+
+        donated = self._donation_ok()
+        example = (*self._state_leaves_for_donation(names), *arg_leaves)
+        compiled = _dispatch.aot_compile(
+            obs.instrument_trace(update_flat, self, "aot_update"),
+            example,
+            donate_argnums=tuple(range(n_state)) if donated else (),
+            owner=self, kind="aot_update",
+        )
+        return _dispatch.AotEntry(compiled, names, donated)
+
+    def _fast_update(self, args: tuple, kwargs: dict) -> bool:
+        """AOT single-update dispatch (``fast_update`` tier); False falls back to jit."""
+        donate_now = self._donation_ok()
+        cache = self._jit_cache.get("aot_update")
+        if cache is None or cache.donate != donate_now:
+            cache = _dispatch.FastStepCache(donate_now)
+            self._jit_cache["aot_update"] = cache
+        if cache.broken:
+            return False
+        state = self._state
+        sampled = _profiler.sample_step("aot")
+        try:
+            ts0 = time.perf_counter() if sampled else 0.0
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            state_leaves = self._state_leaves_for_donation(tuple(state.tensors))
+            obs.count_dispatch(self)
+            state.begin_donated_dispatch()
+            entry, out = _dispatch.dispatch_step(
+                cache, self._build_aot_update, state_leaves, (), leaves, treedef
+            )
+            _dispatch.commit_step(state, entry, out)
+            if sampled:
+                tb = time.perf_counter()
+                jax.block_until_ready(out)
+                _profiler.record_sample("aot", tb - ts0, time.perf_counter() - tb)
+        except Exception:
+            _dispatch.recover_failed_step(self, state, "update")
             cache.mark_broken()
             return False
         return True
@@ -885,25 +948,9 @@ class Metric:
                 (np.float32(self._update_count + 1),), leaves, treedef,
             )
             t2 = time.perf_counter() if timed else 0.0
-            if entry.donated:
-                state.commit_donated(entry.state_names, merged)
-                obs.telemetry.counter("dispatch.donated_steps").inc()
-            else:
-                for name, arr in zip(entry.state_names, merged):
-                    state.tensors[name] = arr
-                state.abort_donated()
+            _dispatch.commit_step(state, entry, merged)
         except Exception:
-            state.abort_donated()
-            if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in state.tensors.values()):
-                # the dispatch died AFTER donating: the old buffers are gone and nothing
-                # replaced them — restore defaults so the metric stays usable
-                for name in state.tensors:
-                    state.tensors[name] = self._defaults[name]
-                rank_zero_warn(
-                    f"A donated forward dispatch of {type(self).__name__} failed mid-flight;"
-                    " the metric state was reset to defaults.",
-                    UserWarning,
-                )
+            _dispatch.recover_failed_step(self, state, "forward")
             cache.mark_broken()
             return _MISS
         self._update_count += 1

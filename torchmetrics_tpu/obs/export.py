@@ -83,6 +83,11 @@ _ALWAYS_TABULATED = (
     "dispatch.aot_fallbacks",
     "dispatch.donated_steps",
     "dispatch.buffered_flushes",
+    # keyed multi-tenant engine (docs/keyed.md): update launches, distinct keys ever
+    # touched, and per-batch key fanout — zero rows mean "no keyed traffic", visibly
+    "keyed.updates",
+    "keyed.active_keys",
+    "keyed.fanout",
     # cost profiler (docs/observability.md "Cost profiling & perf gate")
     "profiler.rows_recorded",
     "profiler.lazy_compiles",
@@ -93,7 +98,7 @@ _ALWAYS_TABULATED = (
 def summary(registry: Optional[Telemetry] = None) -> str:
     """Fixed-width table of every counter, timer, and histogram in the registry.
 
-    Known counter families (robust.*, dispatch.*, profiler.*) are tabulated even at zero,
+    Known counter families (robust.*, dispatch.*, keyed.*, profiler.*) are tabulated even at zero,
     and a cross-rank sync-skew section is appended when gather latencies were recorded.
     """
     tel = registry if registry is not None else telemetry
@@ -199,6 +204,11 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "sync_rank_readmissions": counters.get("sync.rank_readmissions", 0),
         "robust_journal_appends": counters.get("robust.journal_appends", 0),
         "robust_journal_replays": counters.get("robust.journal_replays", 0),
+        # keyed multi-tenant engine (docs/keyed.md): fused mixed-tenant launches and the
+        # tenant-activity trail — a bench that drove keyed traffic records how much
+        "keyed_updates": counters.get("keyed.updates", 0),
+        "keyed_active_keys": counters.get("keyed.active_keys", 0),
+        "keyed_fanout": counters.get("keyed.fanout", 0),
         # cost profiler (docs/observability.md): ledger rows captured during this run and
         # how many sampled device-timing steps fed the per-tier host/device split
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),

@@ -26,8 +26,9 @@ from typing import Any, Dict, List, Optional
 from torchmetrics_tpu.obs import ledger as _ledger
 
 #: the gate's workload classes; the committed baseline holds exactly their rows
-WORKLOAD_CLASSES = ("SumMetric", "MeanMetric", "MaxMetric", "MinMetric")
+WORKLOAD_CLASSES = ("SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "KeyedMetric")
 _N = 256  # fixed workload shape: signatures (and therefore ledger keys) must not drift
+_KEYED_N = 16  # fixed tenant count for the keyed workload rows
 
 
 def _probe_cost_analysis() -> bool:
@@ -63,6 +64,8 @@ def run_workload() -> List[Dict[str, Any]]:
     x = jnp.asarray(np.linspace(0.5, 2.0, _N, dtype=np.float32))
     stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * _N, dtype=np.float32).reshape(4, _N))
     for cls_name in WORKLOAD_CLASSES:
+        if cls_name == "KeyedMetric":  # keyed rows come from the dedicated block below
+            continue
         cls = getattr(aggregation, cls_name)
         m = cls(nan_strategy="ignore")
         m.update(x)
@@ -81,6 +84,30 @@ def run_workload() -> List[Dict[str, Any]]:
                 os.environ.pop(ENV_FAST_DISPATCH, None)
             else:
                 os.environ[ENV_FAST_DISPATCH] = prior
+
+    # keyed multi-tenant rows (docs/keyed.md): the segment-reduce update through the AOT
+    # single-update tier, the whole-stack scan, the vmapped all-keys compute, and the
+    # same update through the jit tier — pinned tenant count and batch shape
+    from torchmetrics_tpu.keyed import KeyedMetric
+
+    ids = jnp.asarray((np.arange(_N) % _KEYED_N).astype(np.int32))
+    ids_stack = jnp.broadcast_to(ids, (4, _N))
+    km = KeyedMetric(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N)
+    km.update(ids, x)
+    km.update(ids, x)
+    km.update_batches(ids_stack, stack)
+    km.compute()
+    prior = os.environ.get(ENV_FAST_DISPATCH)
+    os.environ[ENV_FAST_DISPATCH] = "0"
+    try:
+        km_jit = KeyedMetric(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N)
+        km_jit.update(ids, x)
+        km_jit.compute()
+    finally:
+        if prior is None:
+            os.environ.pop(ENV_FAST_DISPATCH, None)
+        else:
+            os.environ[ENV_FAST_DISPATCH] = prior
     rows = obs.cost_ledger()
     return [r for r in rows if r["metric"] in WORKLOAD_CLASSES]
 

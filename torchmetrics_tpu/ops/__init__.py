@@ -14,8 +14,10 @@ start:
 """
 from torchmetrics_tpu.ops.histogram import bincount, bincount_weighted, confusion_matrix_update
 from torchmetrics_tpu.ops.segments import (
+    segment_count,
     segment_max,
     segment_mean,
+    segment_mean_pair,
     segment_min,
     segment_sum,
 )
@@ -25,7 +27,9 @@ __all__ = [
     "bincount_weighted",
     "confusion_matrix_update",
     "segment_sum",
+    "segment_count",
     "segment_mean",
+    "segment_mean_pair",
     "segment_max",
     "segment_min",
 ]

@@ -231,6 +231,42 @@ def _never() -> bool:
     return False
 
 
+def commit_step(state: Any, entry: AotEntry, out: Any) -> None:
+    """Install a dispatched step's state outputs into a ``StateStore``.
+
+    Donated entries commit through the store's generation machinery (the old buffers are
+    gone — XLA aliased them into ``out``); non-donated entries are plain dict swaps. One
+    implementation for every fast tier (forward step, update scan, single update, keyed).
+    """
+    if entry.donated:
+        state.commit_donated(entry.state_names, out)
+        telemetry.counter("dispatch.donated_steps").inc()
+    else:
+        for name, arr in zip(entry.state_names, out):
+            state.tensors[name] = arr
+        state.abort_donated()
+
+
+def recover_failed_step(metric: Any, state: Any, kind: str) -> None:
+    """Post-exception cleanup shared by the fast dispatch tiers.
+
+    Clears the in-flight latch, and — when the dispatch died AFTER donating (the old
+    buffers are deleted and nothing replaced them) — restores the registered defaults so
+    the metric stays usable, with a rank-zero warning naming the failed ``kind``.
+    """
+    state.abort_donated()
+    if any(getattr(leaf, "is_deleted", _never)() for leaf in state.tensors.values()):
+        for name in state.tensors:
+            state.tensors[name] = metric._defaults[name]
+        from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            f"A donated {kind} dispatch of {type(metric).__name__} failed mid-flight;"
+            " the metric state was reset to defaults.",
+            UserWarning,
+        )
+
+
 def graph_squeeze(value: Any) -> Any:
     """Trace-time twin of ``Metric._squeeze_if_scalar``: fold the shape-(1,) squeeze into
     the compiled program so the host never pays an eager squeeze dispatch per step."""

@@ -3,8 +3,16 @@
 These replace the reference's per-query Python loops (retrieval metrics iterate groups on the
 host, ``src/torchmetrics/retrieval/base.py:165-182``) with single fused XLA reductions over a
 statically-shaped segment-id vector — the idiomatic TPU formulation of "group-by + reduce".
+
+The same primitives carry the keyed multi-tenant engine (``torchmetrics_tpu.keyed``): a
+mixed-tenant batch routes into a ``[num_keys, ...]`` state table through one segment
+reduction per state instead of one dispatch per tenant. The keyed ``MeanMetric`` needs the
+(sums, counts) PAIR as state — the ratio is only formed at ``compute()`` — which is what
+:func:`segment_mean_pair` exists for.
 """
 from __future__ import annotations
+
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -15,10 +23,28 @@ def segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 
-def segment_mean(data: Array, segment_ids: Array, num_segments: int) -> Array:
+def segment_count(segment_ids: Array, num_segments: int, dtype=jnp.int32) -> Array:
+    """Number of elements per segment (empty segments count 0)."""
+    return jax.ops.segment_sum(
+        jnp.ones(jnp.shape(segment_ids), dtype), segment_ids, num_segments=num_segments
+    )
+
+
+def segment_mean_pair(data: Array, segment_ids: Array, num_segments: int) -> Tuple[Array, Array]:
+    """Per-segment ``(sums, counts)`` — the mergeable pair, NOT the ratio.
+
+    Mean-shaped accumulator states must hold the pair: two pairs merge by elementwise
+    addition (associative, cross-batch and cross-process), while two ratios merge as
+    nothing. Counts follow ``data``'s dtype so the pair stays homogeneous with the sums.
+    """
     sums = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
-    counts = jax.ops.segment_sum(jnp.ones_like(data, dtype=jnp.float32), segment_ids, num_segments=num_segments)
-    return sums / jnp.maximum(counts, 1.0)
+    counts = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, num_segments=num_segments)
+    return sums, counts
+
+
+def segment_mean(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    sums, counts = segment_mean_pair(data, segment_ids, num_segments)
+    return sums / jnp.maximum(counts, jnp.ones((), counts.dtype))
 
 
 def segment_max(data: Array, segment_ids: Array, num_segments: int) -> Array:

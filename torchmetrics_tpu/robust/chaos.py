@@ -516,6 +516,72 @@ def scenario_preemption_journal_replay(
     }
 
 
+def scenario_keyed_preemption_journal(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Keyed twin of the preemption scenario: a multi-tenant table dies mid-epoch.
+
+    A ``KeyedMetric(template, N)`` (``torchmetrics_tpu.keyed``) journals a seeded
+    mixed-tenant stream and is dropped cold at a seeded step. A fresh keyed instance
+    recovers ``snapshot + replay(journal)`` — the snapshot blob carries the tenant-axis
+    ``keys`` descriptor, replay re-drives ``update(key_ids, ...)`` — finishes the stream,
+    and ALL ``N`` key states must be bit-identical with an uninterrupted keyed run AND
+    with a per-key instance-dict reference (the loop the keyed engine replaces).
+    Templates that cannot be keyed (list/"cat" states) report a skipped-but-passed cell.
+    """
+    del via  # the keyed protocol is update-only (no per-batch forward value)
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    try:
+        probe = KeyedMetric(factory(), 2)
+    except TorchMetricsUserError as err:
+        return {"passed": True, "skipped": str(err), "scenario_applicable": False}
+    del probe
+    n_keys = 6
+    n_batches = max(3, n_batches)
+    batches = []
+    for _ in range(n_batches):
+        ids = np.asarray([rng.randrange(n_keys) for _ in range(5)], np.int32)
+        vals = np.asarray([float(rng.randint(0, 9)) for _ in range(5)], np.float32)
+        batches.append((ids, vals))
+    jdir = f"{workdir}/keyed-wal"
+    m = KeyedMetric(factory(), n_keys)
+    jm = m.journal(jdir, every_k=3)
+    preempt = rng.randrange(1, n_batches - 1)
+    for i in range(preempt + 1):
+        jm.update(*batches[i])
+    # the process dies here: no flush, no clean exit, the instance is garbage
+    obs.telemetry.counter("robust.injected_faults").inc()
+    fresh = KeyedMetric(factory(), n_keys)
+    recovery = _journal.recover(fresh, jdir)
+    obs.telemetry.counter("robust.recovered").inc()
+    for b in batches[preempt + 1:]:
+        fresh.update(*b)
+    ref = KeyedMetric(factory(), n_keys)
+    for b in batches:
+        ref.update(*b)
+    bit_identical = _identical(fresh.compute(), ref.compute())
+    # cross-check against the per-instance loop the keyed engine replaces
+    insts = [factory() for _ in range(n_keys)]
+    for ids, vals in batches:
+        for k in range(n_keys):
+            if np.any(ids == k):
+                insts[k].update(vals[ids == k])
+    loop_vals = np.stack([np.asarray(insts[k].compute()) for k in range(n_keys)])
+    loop_identical = _identical(fresh.compute(), loop_vals)
+    return {
+        "passed": bool(bit_identical and loop_identical),
+        "bit_identical": bit_identical,
+        "instance_loop_identical": loop_identical,
+        "preempt_step": preempt,
+        "num_keys": n_keys,
+        "replayed": recovery["replayed"],
+        "snapshot_restored": recovery["snapshot_restored"],
+    }
+
+
 def scenario_flap_evict_readmit(
     factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
 ) -> Dict[str, Any]:
@@ -599,6 +665,7 @@ class ChaosMatrix:
     SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
         "rank_death_quorum_rejoin": scenario_rank_death_quorum_rejoin,
         "preemption_journal_replay": scenario_preemption_journal_replay,
+        "keyed_preemption_journal": scenario_keyed_preemption_journal,
         "flap_evict_readmit": scenario_flap_evict_readmit,
     }
 

@@ -95,7 +95,7 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
     tensors = {k: np.asarray(v) for k, v in jax.device_get(dict(state.tensors)).items()}
     lists = {k: [np.asarray(e) for e in jax.device_get(list(v))] for k, v in state.lists.items()}
     obs.telemetry.counter("robust.snapshots").inc()
-    return {
+    blob = {
         "format": FORMAT,
         "version": VERSION,
         "class": type(metric).__name__,
@@ -105,6 +105,29 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
         "update_called": bool(metric._update_called),
         "state_generation": int(state.generation),
         "crc": _checksum(tensors, lists),
+    }
+    keys = _keyed_descriptor(metric)
+    if keys is not None:
+        blob["keys"] = keys
+    return blob
+
+
+def _keyed_descriptor(metric: Any) -> Any:
+    """Tenant-axis descriptor for keyed metrics (``torchmetrics_tpu.keyed``), else None.
+
+    The per-key state payload itself rides the ordinary ``tensors`` dict (a keyed state
+    IS an ordinary ``[num_keys, ...]`` tensor state, CRC and all); the descriptor pins
+    the tenant-axis semantics — key count, template class, routing strategy — so a blob
+    can never be restored into a keyed metric of a different key space.
+    """
+    num_keys = getattr(metric, "num_keys", None)
+    template = getattr(metric, "template", None)
+    if num_keys is None or template is None:
+        return None
+    return {
+        "num_keys": int(num_keys),
+        "template": type(template).__name__,
+        "strategy": getattr(metric, "strategy", None),
     }
 
 
@@ -144,6 +167,25 @@ def _validate_blob(metric: Any, blob: Any) -> None:
             f" blob has tensors={sorted(tensors)} lists={sorted(lists)}, metric has"
             f" tensors={sorted(state.tensors)} lists={sorted(state.lists)}"
         )
+    expected_keys = _keyed_descriptor(metric)
+    if expected_keys is not None:
+        keys = blob.get("keys")
+        if not isinstance(keys, dict):
+            raise SnapshotError(
+                f"Snapshot has no tenant-axis descriptor but {type(metric).__name__}"
+                f" expects {expected_keys['num_keys']} keys — the blob was taken from an"
+                " unkeyed metric."
+            )
+        if int(keys.get("num_keys", -1)) != expected_keys["num_keys"]:
+            raise SnapshotError(
+                f"Snapshot holds {keys.get('num_keys')!r} key streams, metric holds"
+                f" {expected_keys['num_keys']} — refusing to restore across key spaces."
+            )
+        if keys.get("template") != expected_keys["template"]:
+            raise SnapshotError(
+                f"Snapshot keys were accumulated by template {keys.get('template')!r},"
+                f" metric's template is {expected_keys['template']!r}"
+            )
     for name, arr in tensors.items():
         cur = state.tensors[name]
         arr = np.asarray(arr)

@@ -293,8 +293,10 @@ class MetricJournal:
 
     __call__ = forward
 
-    def compute(self) -> Any:
-        return self.metric.compute()
+    def compute(self, *args: Any, **kwargs: Any) -> Any:
+        # pure passthrough (reads journal nothing): keeps keyed per-key gathers —
+        # ``compute(keys=...)`` — reachable through the journaled proxy
+        return self.metric.compute(*args, **kwargs)
 
     def buffered(self, k: int) -> Any:
         """A :class:`BufferedUpdater` over the target with this journal at its seam."""

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -28,7 +28,15 @@ keyed-smoke:
 	python bench.py --keyed --smoke > /tmp/tm_keyed_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_keyed_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; s=ex['keyed_vs_instance_loop_n10000']; assert s is not None and s >= 50, ex; bits=[v for k,v in ex.items() if k.startswith('keyed_bit_identical')]; assert bits and all(bits), ex; print('keyed-smoke ok: %.0fx vs instance loop @ N=10k' % s)"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU010, docs/static-analysis.md): exits
+# sharded-state lane (docs/distributed.md "Sharded state"): keyed tenant table on a forced
+# 8-device host mesh — asserts the acceptance bar: reduce-once sync bytes strictly below
+# the replicated allgather baseline, per-key values bit-identical across placements and
+# dispatch tiers, and the lazy reduce firing at most once per (update-epoch, compute) pair
+shard-smoke:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --sharded --smoke > /tmp/tm_shard_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_shard_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; rep=ex['sync_bytes_per_compute_replicated']; shd=ex['sync_bytes_per_compute_sharded']; assert shd < rep, (shd, rep); bits=[v for k,v in ex.items() if k.startswith('sharded_bit_identical')]; assert bits and all(bits), ex; assert ex['lazy_reduce_fires'] <= ex['sharded_compute_epochs'] and ex['lazy_reduce_reuses'] >= 1, ex; print('shard-smoke ok: %dB sharded vs %dB allgather per compute (%.1fx), bit-identical' % (shd, rep, rep/shd))"
+
+# static JAX/TPU hazard analysis (rules TPU001-TPU011, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`
 jaxlint:

@@ -388,6 +388,176 @@ def keyed_main(smoke: bool) -> None:
     )
 
 
+def bench_sharded(n_keys: int, batch: int, n_batches: int, world: int) -> dict:
+    """``--sharded`` scenario (docs/distributed.md "Sharded state"): a keyed tenant table
+    replicated vs ``shard()``-ed over the forced multi-device host mesh.
+
+    Measures (a) mixed-tenant update throughput in both placements (sharded accumulation
+    must not cost throughput), (b) bit-identity of every per-key value sharded-vs-
+    replicated across the AOT / jit / buffered dispatch tiers AND through a simulated
+    ``world``-rank sync, and (c) the sync byte ledger: received bytes for one compute's
+    sync through the replicated full allgather vs the sharded reduce-scatter + slab
+    assembly, plus the lazy reduce-once cache behaviour (fires once per update epoch,
+    reuses on recompute). Values are integer-valued float32 — bit-identical means
+    bit-identical.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.aggregation import SumMetric
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.ops.dispatch import ENV_FAST_DISPATCH
+    from torchmetrics_tpu.parallel import sync as sync_mod
+    from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned
+
+    ctx = MeshContext()
+    out: dict = {
+        "mesh_devices": jax.device_count(),
+        "mesh_axis_size": ctx.size,
+        "sync_world": world,
+        "sharded_n_keys": n_keys,
+        "sharded_batch": batch,
+        "sharded_n_batches": n_batches,
+    }
+    rng = np.random.RandomState(17)
+    ids_np = rng.randint(0, n_keys, size=(n_batches, batch)).astype(np.int32)
+    vals_np = rng.randint(0, 64, size=(n_batches, batch)).astype(np.float32)
+    ids = [jnp.asarray(ids_np[i]) for i in range(n_batches)]
+    vals = [jnp.asarray(vals_np[i]) for i in range(n_batches)]
+    jax.block_until_ready((ids, vals))
+
+    def throughput(mode: str) -> float:
+        km = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys)
+        if mode == "sharded":
+            km.shard(ctx)
+        km.update(ids[0], vals[0])  # compile out of window
+        km.reset()
+
+        def _window():
+            km.reset()
+            for i in range(n_batches):
+                km.update(ids[i], vals[i])
+            jax.block_until_ready(km.compute())
+
+        return n_batches / _best_of(_window, windows=3)
+
+    for mode in ("replicated", "sharded"):
+        rate = throughput(mode)
+        out[f"keyed_updates_per_sec_{mode}"] = round(rate, 2)
+        print(f"sharded lane [{mode}]: {rate:.0f} mixed-tenant updates/s at N={n_keys}", file=sys.stderr)
+
+    # tier bit-identity: sharded vs replicated per-key values must match BYTE for byte
+    def run_tier(tier: str, sharded: bool) -> np.ndarray:
+        prior = os.environ.get(ENV_FAST_DISPATCH)
+        if tier == "jit":
+            os.environ[ENV_FAST_DISPATCH] = "0"
+        try:
+            m = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys)
+            if sharded:
+                m.shard(ctx)
+            if tier == "buffered":
+                with m.buffered(4) as buf:
+                    for i in range(n_batches):
+                        buf.update(ids[i], vals[i])
+            else:
+                for i in range(n_batches):
+                    m.update(ids[i], vals[i])
+            return np.asarray(m.compute())
+        finally:
+            if prior is None:
+                os.environ.pop(ENV_FAST_DISPATCH, None)
+            else:
+                os.environ[ENV_FAST_DISPATCH] = prior
+
+    for tier in ("aot", "jit", "buffered"):
+        rep, shd = run_tier(tier, False), run_tier(tier, True)
+        out[f"sharded_bit_identical_{tier}"] = bool(rep.tobytes() == shd.tobytes())
+
+    # sync byte ledger over a simulated world: rank replicas with disjoint streams
+    ranks = [KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys) for _ in range(world)]
+    for m in ranks:
+        for _ in range(2):
+            i = rng.randint(0, n_keys, size=(batch,)).astype(np.int32)
+            v = rng.randint(0, 64, size=(batch,)).astype(np.float32)
+            m.update(i, v)
+    states = [dict(m._state.tensors) for m in ranks]
+    reds = {n: ranks[0]._reductions[n] for n in states[0]}
+    opts = sync_mod.SyncOptions(world=world)
+    gather = sync_mod.simulate_mesh_world(states, reds, opts)
+    rep_sync = sync_mod.process_sync(states[0], reds, gather_fn=gather, options=opts)
+    km0 = ranks[0].shard(ctx)
+    sharded_names = [n for n, s in km0.shard_specs.items() if is_partitioned(s)]
+    states[0] = dict(km0._state.tensors)
+    shd_sync = sync_mod.process_sync(
+        states[0], reds, gather_fn=gather, options=opts, sharded_states=sharded_names
+    )
+    out["sync_bytes_per_compute_replicated"] = int(rep_sync.bytes_received)
+    out["sync_bytes_per_compute_sharded"] = int(shd_sync.bytes_received)
+    out["sync_sharded_states"] = list(shd_sync.sharded_states)
+    out["sharded_bit_identical_sync"] = all(
+        np.asarray(rep_sync[n]).tobytes() == np.asarray(shd_sync[n]).tobytes() for n in states[0]
+    )
+
+    # lazy reduce-once: one fire per update epoch, reuse on recompute, refire after update
+    km0.compute_with_cache = False  # force each compute through the sync seam
+    km0.dist_sync_fn = gather
+    km0.distributed_available_fn = lambda: True
+    km0.sync_options = opts
+    f0 = obs.telemetry.counter("sync.lazy_reduce.fires").value
+    r0 = obs.telemetry.counter("sync.lazy_reduce.reuses").value
+    km0.compute()
+    km0.compute()  # same epoch: must reuse, zero new bytes
+    km0.update(ids[0], vals[0])  # new epoch
+    states[0] = dict(km0._state.tensors)
+    km0.compute()
+    out["sharded_compute_epochs"] = 2
+    out["lazy_reduce_fires"] = obs.telemetry.counter("sync.lazy_reduce.fires").value - f0
+    out["lazy_reduce_reuses"] = obs.telemetry.counter("sync.lazy_reduce.reuses").value - r0
+    out["sync_bytes_saved_total"] = obs.telemetry.counter("sync.bytes_saved").value
+    return out
+
+
+def sharded_main(smoke: bool) -> None:
+    """``bench.py --sharded [--smoke]``: one JSON line with the sharded-state numbers.
+
+    Runs on a forced multi-device host mesh (``--xla_force_host_platform_device_count``,
+    set by ``make shard-smoke``/this entry point). The acceptance point (``make
+    shard-smoke``): per-compute sync bytes in sharded mode strictly below the allgather
+    baseline, per-key values bit-identical across tiers and placements, and the lazy
+    reduce firing at most once per (update-epoch, compute) pair.
+    """
+    if smoke:
+        n_keys, batch, n_batches, world = 1024, 2048, 8, 4
+    else:
+        n_keys, batch, n_batches, world = 65536, 8192, 50, 8
+    extras = bench_sharded(n_keys, batch=batch, n_batches=n_batches, world=world)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    rep, shd = extras["sync_bytes_per_compute_replicated"], extras["sync_bytes_per_compute_sharded"]
+    print(
+        json.dumps(
+            {
+                "metric": "sharded_sync_bytes_per_compute",
+                "value": shd,
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "bytes received per sync of the keyed tenant table through the sharded"
+                    " reduce-scatter path (vs_baseline = replicated-allgather bytes / sharded"
+                    " bytes; throughput, tier/sync bit-identity, and lazy reduce-once"
+                    " behaviour in extras — docs/distributed.md 'Sharded state')"
+                ),
+                "vs_baseline": round(rep / shd, 2) if shd else None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     """Same sweep through the reference torchmetrics (torch backend)."""
     import types
@@ -1075,7 +1245,19 @@ if __name__ == "__main__":
             print("usage: bench.py --compare A.json B.json", file=sys.stderr)
             sys.exit(2)
         sys.exit(compare_main(sys.argv[idx + 1], sys.argv[idx + 2]))
-    if "--keyed" in sys.argv:
+    if "--sharded" in sys.argv:
+        # sharded-state scenario (make shard-smoke / docs/distributed.md): the multi-device
+        # host mesh must be forced BEFORE the first jax backend touch, and smoke pins CPU
+        # via the config API like the other lanes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        sharded_main(smoke)
+    elif "--keyed" in sys.argv:
         # keyed multi-tenant scenario (make keyed-smoke / docs/keyed.md): smoke pins CPU
         # via the config API like the bench smoke lane; full mode probes for a healthy
         # platform first (a dead tunnel plugin must not wedge the run)

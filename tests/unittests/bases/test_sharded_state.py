@@ -1,0 +1,385 @@
+"""Sharded metric state on the device mesh (docs/distributed.md "Sharded state").
+
+Placement must never change values: every test here asserts BIT-identity between the
+sharded and the replicated twin — integer-valued float32 batches keep float reductions
+exact, so ``tobytes()`` equality is the bar, across every dispatch tier (jit,
+AOT+donation, buffered/update_scan), through snapshot/restore, and through the
+reduce-scatter sharded sync. The communication claims are asserted on the byte ledger:
+sharded sync receives strictly fewer bytes than the replicated allgather, and the lazy
+reduce fires at most once per (update-epoch, compute) pair.
+
+The suite runs under the conftest-forced 8-device host platform
+(``--xla_force_host_platform_device_count=8``).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.keyed import KeyedMetric
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops.dispatch import ENV_FAST_DISPATCH
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned, local_mesh
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+N_DEV = jax.device_count()
+
+
+def _batches(n=6, size=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 64, (size,)).astype(np.float32) for _ in range(n)]
+
+
+def _bits(value) -> bytes:
+    return np.asarray(value).tobytes()
+
+
+# --------------------------------------------------------------------------- local_mesh
+class TestLocalMesh:
+    def test_default_covers_all_devices(self):
+        mesh = local_mesh()
+        assert mesh.shape["data"] == N_DEV
+
+    def test_bad_shape_raises_clearly(self):
+        with pytest.raises(TorchMetricsUserError, match="pick a shape"):
+            local_mesh(shape=(3,))
+
+    def test_shape_rank_mismatch_raises(self):
+        with pytest.raises(TorchMetricsUserError, match="axis name"):
+            local_mesh(("data", "model"), shape=(N_DEV,))
+
+    def test_duplicate_axis_names_raise(self):
+        with pytest.raises(TorchMetricsUserError, match="unique"):
+            local_mesh(("data", "data"), shape=(N_DEV, 1))
+
+    @pytest.mark.skipif(N_DEV % 2, reason="needs an even device count")
+    def test_named_2d_mesh(self):
+        mesh = local_mesh(("data", "model"), (N_DEV // 2, 2))
+        assert mesh.shape["data"] == N_DEV // 2
+        assert mesh.shape["model"] == 2
+
+    def test_mesh_is_cached(self):
+        assert local_mesh() is local_mesh()
+        assert local_mesh(("data",), (N_DEV,)) is local_mesh(("data",), (N_DEV,))
+
+
+# --------------------------------------------------------------------------- MeshContext
+class TestMeshContext:
+    def test_primary_axis_is_first_sized_axis(self):
+        if N_DEV % 2 == 0 and N_DEV > 1:
+            ctx = MeshContext(local_mesh(("model", "data"), (1, N_DEV)))
+            assert ctx.axis == "data"  # size-1 "model" axis is skipped
+        ctx = MeshContext()
+        assert ctx.size == N_DEV
+
+    def test_spec_derivation(self):
+        ctx = MeshContext()
+        scalar = ctx.spec_for_state("total", jnp.asarray(0.0), "sum")
+        assert not is_partitioned(scalar)
+        table = ctx.spec_for_state("value", jnp.zeros((8 * N_DEV,)), "sum")
+        assert is_partitioned(table) == (N_DEV > 1)
+        ragged = ctx.spec_for_state("value", jnp.zeros((N_DEV + 1,)), "sum")
+        assert not is_partitioned(ragged)  # indivisible leading axis stays replicated
+        assert ctx.spec_for_state("buf", [], "cat") is None  # list states place per entry
+
+    def test_override_wins(self):
+        from jax.sharding import PartitionSpec
+
+        ctx = MeshContext()
+        forced = ctx.spec_for_state("total", jnp.zeros((N_DEV,)), "sum", override=PartitionSpec())
+        assert not is_partitioned(forced)
+
+    def test_bad_override_type_raises(self):
+        ctx = MeshContext()
+        with pytest.raises(TorchMetricsUserError, match="PartitionSpec"):
+            ctx.spec_for_state("total", jnp.zeros((8,)), "sum", override="data")
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(TorchMetricsUserError, match="not a mesh axis"):
+            MeshContext(local_mesh(), axis="model")
+
+
+# ----------------------------------------------------------- bit-identity across tiers
+@pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+@pytest.mark.parametrize("tier", ["aot", "jit", "buffered"])
+def test_sharded_aggregation_bit_identical(cls, tier, monkeypatch):
+    if tier == "jit":
+        monkeypatch.setenv(ENV_FAST_DISPATCH, "0")
+    batches = _batches()
+    plain, sharded = cls(nan_strategy="ignore"), cls(nan_strategy="ignore").shard()
+    assert sharded.sharded and not plain.sharded
+    if tier == "buffered":
+        with plain.buffered(3) as bp, sharded.buffered(3) as bs:
+            for b in batches:
+                bp.update(b)
+                bs.update(b)
+    else:
+        for b in batches:
+            plain.update(b)
+            sharded.update(b)
+    assert _bits(plain.compute()) == _bits(sharded.compute())
+
+
+def test_sharded_cat_bit_identical_and_spread():
+    batches = _batches(n=5)
+    plain, sharded = CatMetric(), CatMetric().shard()
+    for b in batches:
+        plain.update(b)
+        sharded.update(b)
+    assert _bits(plain.compute()) == _bits(sharded.compute())
+    devices = set()
+    for e in sharded._state.lists["value"]:
+        devices |= set(e.devices()) if hasattr(e, "devices") else {e.device}
+    # round-robin entry placement spreads the unbounded buffer across the mesh
+    assert len(devices) == min(len(batches), N_DEV)
+
+
+def test_sharded_forward_returns_same_batch_values():
+    batches = _batches(n=4)
+    plain, sharded = SumMetric(nan_strategy="ignore"), SumMetric(nan_strategy="ignore").shard()
+    for b in batches:
+        assert _bits(plain(b)) == _bits(sharded(b))
+    assert _bits(plain.compute()) == _bits(sharded.compute())
+
+
+def test_sharded_update_batches_scan_tier():
+    batches = _batches(n=6)
+    stack = jnp.stack([jnp.asarray(b) for b in batches])
+    plain, sharded = SumMetric(nan_strategy="ignore"), SumMetric(nan_strategy="ignore").shard()
+    plain.update_batches(stack)
+    sharded.update_batches(stack)
+    assert _bits(plain.compute()) == _bits(sharded.compute())
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="partitioned placement needs > 1 device")
+def test_partitioned_state_keeps_mesh_layout_through_updates():
+    n_keys = 8 * N_DEV
+    km = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys).shard()
+    spec = km.shard_specs["sum_value"]
+    assert is_partitioned(spec)
+    rng = np.random.RandomState(1)
+    for _ in range(4):
+        km.update(rng.randint(0, n_keys, (128,)).astype(np.int32),
+                  rng.randint(0, 9, (128,)).astype(np.float32))
+    arr = km._state.tensors["sum_value"]
+    # the with_sharding_constraint closure held the tenant axis sharded through the
+    # AOT+donation update tier — the accumulate stayed shard-local
+    assert arr.sharding.is_equivalent_to(spec, arr.ndim)
+    km.reset()
+    arr = km._state.tensors["sum_value"]
+    assert arr.sharding.is_equivalent_to(spec, arr.ndim)  # defaults were placed too
+
+
+def test_collection_shard_and_groups():
+    batches = _batches(n=4)
+    plain = MetricCollection([SumMetric(nan_strategy="ignore"), MeanMetric(nan_strategy="ignore")])
+    shd = MetricCollection([SumMetric(nan_strategy="ignore"), MeanMetric(nan_strategy="ignore")]).shard()
+    assert shd.sharded
+    for b in batches:
+        plain.update(b)
+        shd.update(b)
+    a, b = plain.compute(), shd.compute()
+    assert set(a) == set(b)
+    for k in a:
+        assert _bits(a[k]) == _bits(b[k])
+
+
+# ------------------------------------------------------------------- guards and modes
+def test_shard_guard_buffered_pending():
+    m = SumMetric(nan_strategy="ignore")
+    buf = m.buffered(4)
+    buf.update(np.asarray([1.0], np.float32))
+    with pytest.raises(TorchMetricsUserError, match="buffered"):
+        m.shard()
+    buf.flush()
+    m.shard()
+
+
+def test_shard_unknown_spec_name_raises():
+    with pytest.raises(TorchMetricsUserError, match="unknown state"):
+        SumMetric(nan_strategy="ignore").shard(spec={"nope": None})
+
+
+def test_to_clears_shard_mode():
+    m = SumMetric(nan_strategy="ignore").shard()
+    assert m.sharded
+    m.to(jax.devices()[0])
+    assert not m.sharded and m.shard_specs == {}
+
+
+def test_pickle_roundtrip_drops_mesh_but_keeps_state():
+    import pickle
+
+    m = SumMetric(nan_strategy="ignore").shard()
+    m.update(np.asarray([5.0, 7.0], np.float32))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert not m2.sharded  # device handles cannot travel; re-shard on the receiver
+    assert _bits(m.compute()) == _bits(m2.compute())
+
+
+def test_clone_shares_mesh_context():
+    m = SumMetric(nan_strategy="ignore").shard()
+    c = m.clone()
+    assert c.sharded and c._shard_ctx is m._shard_ctx
+
+
+def test_snapshot_restore_roundtrip_sharded():
+    m = SumMetric(nan_strategy="ignore").shard()
+    for b in _batches(n=3):
+        m.update(b)
+    blob = m.snapshot()
+    assert "sharding" in blob and blob["sharding"]["mesh"]["devices"] == N_DEV
+    fresh = SumMetric(nan_strategy="ignore").shard()
+    fresh.restore(blob)
+    assert _bits(fresh.compute()) == _bits(m.compute())
+    # and across placements, both directions
+    plain = SumMetric(nan_strategy="ignore")
+    plain.restore(blob)
+    assert _bits(plain.compute()) == _bits(m.compute())
+    blob_plain = plain.snapshot()
+    resharded = SumMetric(nan_strategy="ignore").shard()
+    resharded.restore(blob_plain)
+    assert _bits(resharded.compute()) == _bits(m.compute())
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="partitioned placement needs > 1 device")
+def test_restore_replaces_under_live_mesh():
+    n_keys = 8 * N_DEV
+    km = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys).shard()
+    km.update(np.arange(n_keys, dtype=np.int32), np.ones(n_keys, np.float32))
+    blob = km.snapshot()
+    fresh = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys).shard()
+    fresh.restore(blob)
+    arr = fresh._state.tensors["sum_value"]
+    assert arr.sharding.is_equivalent_to(fresh.shard_specs["sum_value"], arr.ndim)
+    assert _bits(fresh.compute()) == _bits(km.compute())
+
+
+# --------------------------------------------------------------- sharded process_sync
+def _rank_worlds(world=4, n_keys=64, seed=3):
+    """W keyed rank replicas over disjoint integer streams + their state/reduction dicts."""
+    rng = np.random.RandomState(seed)
+    ranks = [KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys) for _ in range(world)]
+    for m in ranks:
+        for _ in range(3):
+            m.update(rng.randint(0, n_keys, (128,)).astype(np.int32),
+                     rng.randint(0, 64, (128,)).astype(np.float32))
+    states = [dict(m._state.tensors) for m in ranks]
+    reductions = {n: ranks[0]._reductions[n] for n in states[0]}
+    return ranks, states, reductions
+
+
+class TestShardedProcessSync:
+    def test_reduce_scatter_bit_identical_and_cheaper(self):
+        world = 4
+        ranks, states, reds = _rank_worlds(world)
+        opts = sync_mod.SyncOptions(world=world)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts)
+        rep = sync_mod.process_sync(states[0], reds, gather_fn=gather, options=opts)
+        shd = sync_mod.process_sync(
+            states[0], reds, gather_fn=gather, options=opts, sharded_states=["sum_value"]
+        )
+        assert shd.sharded_states == ("sum_value",)
+        assert str(shd.world_consistent) == "full"
+        assert _bits(rep["sum_value"]) == _bits(shd["sum_value"])
+        # reduce-scatter + assembly receives ~2x state; allgather receives world x state
+        assert shd.bytes_received == 2 * rep.bytes_received // world
+        assert shd.bytes_received < rep.bytes_received
+
+    def test_gather_without_shard_contract_falls_back(self):
+        world = 3
+        _, states, reds = _rank_worlds(world)
+        opts = sync_mod.SyncOptions(world=world)
+
+        def plain_gather(value, group=None, *, name=None):
+            return [jnp.asarray(s[name]) for s in states]
+
+        shd = sync_mod.process_sync(
+            states[0], reds, gather_fn=plain_gather, options=opts, sharded_states=["sum_value"]
+        )
+        assert shd.sharded_states == ()  # full gather, unchanged behaviour
+        assert shd.bytes_received == world * sync_mod._nbytes(states[0]["sum_value"])
+
+    def test_scalar_states_never_shard(self):
+        world = 4
+        scalar_worlds = [{"total": jnp.asarray(float(r + 1))} for r in range(world)]
+        reds = {"total": "sum"}
+        opts = sync_mod.SyncOptions(world=world)
+        gather = sync_mod.simulate_mesh_world(scalar_worlds, reds, opts)
+        out = sync_mod.process_sync(
+            scalar_worlds[0], reds, gather_fn=gather, options=opts, sharded_states=["total"]
+        )
+        assert out.sharded_states == ()  # a scalar has no leading axis to scatter
+        assert float(out["total"]) == 10.0
+
+    def test_timeout_degrades_sharded_state_to_local(self):
+        world = 4
+        _, states, reds = _rank_worlds(world)
+        opts = sync_mod.SyncOptions(world=world, timeout_s=0.2, retries=0, backoff_s=0.01)
+
+        def hanging(value, group=None, *, name=None, shard_slice=None, shard_assemble=None):
+            import time as _t
+
+            _t.sleep(10)
+            raise AssertionError("unreachable")
+
+        with pytest.warns(UserWarning, match="degraded"):
+            out = sync_mod.process_sync(
+                states[0], reds, gather_fn=hanging, options=opts, sharded_states=["sum_value"]
+            )
+        assert str(out.world_consistent) == "local"
+        assert _bits(out["sum_value"]) == _bits(states[0]["sum_value"])
+
+
+class TestLazyReduceOnce:
+    def test_fires_once_per_epoch_and_reuses(self):
+        world = 4
+        ranks, states, reds = _rank_worlds(world)
+        opts = sync_mod.SyncOptions(world=world)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts)
+        expected = sync_mod.process_sync(states[0], reds, gather_fn=gather, options=opts)
+        km = ranks[0]
+        km.compute_with_cache = False
+        km.dist_sync_fn = gather
+        km.distributed_available_fn = lambda: True
+        km.sync_options = opts
+        km.shard()
+        states[0] = dict(km._state.tensors)  # shard() re-placed the buffers
+        fires = obs.telemetry.counter("sync.lazy_reduce.fires")
+        reuses = obs.telemetry.counter("sync.lazy_reduce.reuses")
+        f0, r0 = fires.value, reuses.value
+        first = km.compute()
+        second = km.compute()  # same update epoch: reduce must NOT re-fire
+        assert (fires.value - f0, reuses.value - r0) == (1, 1)
+        assert _bits(first) == _bits(second)
+        rng = np.random.RandomState(9)
+        km.update(rng.randint(0, km.num_keys, (32,)).astype(np.int32),
+                  rng.randint(0, 9, (32,)).astype(np.float32))
+        states[0] = dict(km._state.tensors)
+        km.compute()  # new epoch: exactly one more fire
+        assert fires.value - f0 == 2
+
+    def test_reset_invalidates_cache(self):
+        world = 2
+        ranks, states, reds = _rank_worlds(world)
+        opts = sync_mod.SyncOptions(world=world)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts)
+        km = ranks[0]
+        km.compute_with_cache = False
+        km.dist_sync_fn = gather
+        km.distributed_available_fn = lambda: True
+        km.sync_options = opts
+        km.shard()
+        states[0] = dict(km._state.tensors)
+        km.compute()
+        assert km.__dict__["_lazy_sync_cache"] is not None
+        km.reset()
+        assert km.__dict__["_lazy_sync_cache"] is None

@@ -644,6 +644,80 @@ class TestTPU010PerKeyMetricLoop:
         ) == []
 
 
+# ------------------------------------------------------------------------------- TPU011
+class TestTPU011GatherOnShardedState:
+    def test_gather_all_on_sharded_metric_flags(self):
+        assert "TPU011" in _rules(
+            """
+            def sync_by_hand(mesh, batch):
+                km = KeyedMetric(SumMetric(), num_keys=1024).shard(mesh)
+                km.update(batch.ids, batch.values)
+                return gather_all_arrays(km.metric_state["sum_value"])
+            """
+        )
+
+    def test_process_allgather_after_inplace_shard_flags(self):
+        assert "TPU011" in _rules(
+            """
+            from jax.experimental.multihost_utils import process_allgather
+            def sweep(m, stream):
+                m.shard()
+                for batch in stream:
+                    m.update(batch)
+                return process_allgather(m.metric_state)
+            """
+        )
+
+    def test_lax_all_gather_on_shard_result_flags(self):
+        assert "TPU011" in _rules(
+            """
+            def reduce(mesh, table):
+                sharded = table.shard(mesh)
+                return lax.all_gather(sharded.value, "data", axis=0, tiled=True)
+            """
+        )
+
+    def test_gather_on_unsharded_metric_is_clean(self):
+        assert _rules(
+            """
+            def sync(m, batch):
+                m.update(batch)
+                return gather_all_arrays(m.metric_state["value"])
+            """
+        ) == []
+
+    def test_sharded_compute_is_clean(self):
+        # the sanctioned path: compute()/process_sync pick the sharded sync themselves
+        assert _rules(
+            """
+            def serve(mesh, stream):
+                km = KeyedMetric(SumMetric(), num_keys=1024).shard(mesh)
+                for batch in stream:
+                    km.update(batch.ids, batch.values)
+                return km.compute()
+            """
+        ) == []
+
+    def test_gather_of_other_object_is_clean(self):
+        assert _rules(
+            """
+            def mixed(mesh, plain, batch):
+                km = KeyedMetric(SumMetric(), num_keys=8).shard(mesh)
+                km.update(batch.ids, batch.values)
+                return gather_all_arrays(plain.metric_state["value"])
+            """
+        ) == []
+
+    def test_suppression_comment_waives(self):
+        assert _rules(
+            """
+            def debug_dump(mesh, km):
+                km.shard(mesh)
+                return gather_all_arrays(km.metric_state["sum_value"])  # jaxlint: disable=TPU011
+            """
+        ) == []
+
+
 # ------------------------------------------------------------------------------- TPU000
 def test_syntax_error_reports_tpu000():
     assert _rules("def broken(:\n") == ["TPU000"]

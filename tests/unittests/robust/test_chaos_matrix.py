@@ -98,6 +98,19 @@ class TestScenarioEvidence:
         # a mid-window preemption must have left batches only the journal saw
         assert result["pending_at_death"] >= 0 and result["replayed"] >= result["pending_at_death"]
 
+    def test_sharded_preemption_restores_under_live_mesh(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            MeanMetric, workdir=str(tmp_path), seed=SEED,
+            scenarios=("sharded_preemption_restore",),
+        )
+        (result,) = matrix.run(n_batches=7)
+        assert result["passed"] and result["bit_identical"]
+        # recovery must equal the plain UNSHARDED run too (placement never leaks into
+        # values) and re-place every restored buffer under the live mesh
+        assert result["plain_identical"] and result["placement_preserved"]
+        assert result["mesh"]["devices"] >= 1
+        assert result["replayed"] >= 0
+
     def test_keyed_preemption_restores_all_key_states(self, tmp_path):
         matrix = chaos.ChaosMatrix(
             MeanMetric, workdir=str(tmp_path), seed=SEED, scenarios=("keyed_preemption_journal",)

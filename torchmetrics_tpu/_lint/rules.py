@@ -32,6 +32,8 @@ TPU009    telemetry/``obs`` registry call inside a jit-traced function (the host
           side effect runs at trace time only — silently dropped per step)
 TPU010    host-side Python loop calling ``.update()``/``.forward()`` over a
           dict/list of Metric instances (per-key loop — use KeyedMetric)
+TPU011    full-state allgather (``gather_all_arrays``/``process_allgather``/…)
+          on a metric that declared a sharded spec (re-replicates every shard)
 ========  ======================================================================
 """
 from __future__ import annotations
@@ -54,6 +56,7 @@ RULES: Dict[str, str] = {
     "TPU008": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
     "TPU009": "telemetry/obs registry call inside jit-traced code (runs at trace time only)",
     "TPU010": "host-side per-key Metric update loop (one dispatch per key - use KeyedMetric)",
+    "TPU011": "full-state allgather on sharded metric state (re-replicates every shard)",
 }
 
 # wrapper callables whose function arguments execute under tracing
@@ -1100,9 +1103,85 @@ def _rule_tpu010(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+#: full-state gather entry points TPU011 watches for (the replicated sync primitives)
+_FULL_GATHER_NAMES = frozenset(
+    {"gather_all_arrays", "gather_all_tensors", "process_allgather", "all_gather"}
+)
+
+
+def _rule_tpu011(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Replicated full-state gather on a metric that declared a sharded spec.
+
+    The regression the sharded engine exists to remove::
+
+        km = KeyedMetric(SumMetric(), num_keys=N).shard(mesh)   # tenant axis partitioned
+        ...
+        pieces = gather_all_arrays(km.metric_state["sum_value"])  # W full copies back!
+
+    A sharded state syncs by reduce-scatter + slab assembly (received ``≈ 2×state``,
+    ``parallel/sync.py``); routing it through ``gather_all_arrays`` /
+    ``multihost_utils.process_allgather`` / a raw ``lax.all_gather`` re-replicates every
+    shard on every rank — ``world × state`` bytes plus ``world`` resident copies, exactly
+    the layout ``shard()`` was called to avoid. Let ``compute()``/``process_sync`` drive
+    the sync (they pick the sharded path from the declared specs) instead of gathering by
+    hand.
+
+    Boundary: only fires when ``.shard(...)`` was called on the object *in the same
+    function* (directly or via ``m = X.shard(mesh)`` — ``shard`` returns its metric), and
+    a watched gather call takes anything derived from that name. Cross-function sharding
+    is invisible by design — under-reporting beats flagging every gather in the sync
+    layer itself.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        sharded: Set[str] = set()
+        for node in _scoped_walk(info.node):
+            call = None
+            targets: List[str] = []
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+            if call is None or not isinstance(call.func, ast.Attribute) or call.func.attr != "shard":
+                continue
+            base = call.func.value
+            if isinstance(base, ast.Name):
+                sharded.add(base.id)
+            sharded.update(targets)  # m = SumMetric().shard(mesh) / m2 = m.shard(mesh)
+        if not sharded:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _final_name(node.func)
+            if fname not in _FULL_GATHER_NAMES:
+                continue
+            hit = None
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in sharded:
+                        hit = sub.id
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            out.append(_finding(
+                "TPU011", path, node,
+                lines,
+                f"full-state `{fname}(...)` on {hit!r}, which declared a sharded spec"
+                " via .shard(...): the gather re-replicates every shard on every rank"
+                " (world x state bytes + world resident copies) — let compute()/"
+                "process_sync drive the reduce-scatter sharded sync instead"
+                " (docs/distributed.md 'Sharded state')",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
-    _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010,
+    _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011,
 )
 
 

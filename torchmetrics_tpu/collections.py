@@ -321,6 +321,32 @@ class MetricCollection:
             num_keys=num_keys, strategy=strategy, prefix=self.prefix, postfix=self.postfix,
         )
 
+    def shard(self, mesh: Optional[Any] = None, spec: Optional[Dict[str, Any]] = None) -> "MetricCollection":
+        """Place every member's state on a device mesh (see :meth:`Metric.shard`).
+
+        One shared :class:`~torchmetrics_tpu.parallel.mesh.MeshContext` covers the whole
+        collection; ``spec`` overrides are applied per member for the state names each
+        member actually registers. Compute-group state aliasing is re-established against
+        the freshly placed leader buffers.
+        """
+        from torchmetrics_tpu.parallel.mesh import MeshContext
+
+        ctx = mesh if isinstance(mesh, MeshContext) else MeshContext(mesh)
+        overrides = dict(spec or {})
+        for m in self.values(copy_state=False):
+            member_spec = {k: v for k, v in overrides.items() if k in m._defaults}
+            m.shard(ctx, spec=member_spec or None)
+        if self._enable_compute_groups and self._groups_checked:
+            self._state_is_copy = False
+            self._compute_groups_create_state_ref()
+        return self
+
+    @property
+    def sharded(self) -> bool:
+        """True when every member holds mesh-sharded state (see :attr:`Metric.sharded`)."""
+        members = list(self.values(copy_state=False))
+        return bool(members) and all(m.sharded for m in members)
+
     @property
     def world_consistent(self) -> Any:
         """Worst member consistency grade: ``full`` only when EVERY member's last sync was.

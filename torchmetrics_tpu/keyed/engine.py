@@ -35,6 +35,13 @@ Design (docs/keyed.md):
   ``robust/checkpoint.py``), the write-ahead journal records ``(key_ids, batch)`` and
   replays bit-identically, and ``process_sync`` reduces the keyed states elementwise
   across ranks through the existing bounded/quorum path.
+
+- **Scale-out** (``KeyedMetric(...).shard(mesh)``, docs/distributed.md "Sharded state"):
+  the ``[num_keys, ...]`` tenant axis is exactly the shape the mesh layer shards — the
+  table partitions its leading axis across the devices, every tier accumulates
+  shard-local (bit-identical to replicated, segments strategy preserved), and the
+  multi-process sync reduce-scatters the table lazily instead of allgathering
+  ``world`` full copies.
 """
 from __future__ import annotations
 

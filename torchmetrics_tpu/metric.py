@@ -45,6 +45,7 @@ from jax import Array
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.obs import profiler as _profiler
 from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.parallel import mesh as _mesh
 from torchmetrics_tpu.parallel.sync import FULL, SyncOptions, as_consistency, process_sync
 from torchmetrics_tpu.robust import checkpoint as _checkpoint
 from torchmetrics_tpu.robust import guardrails as _guardrails
@@ -220,6 +221,10 @@ class Metric:
         self._buffered_pending = 0  # batches held by a BufferedUpdater (state stale until flush)
         self._state_shared = False  # True while compute-group members alias this state (gates donation)
         self._world_consistent = FULL  # degrades to "quorum"/"local" after a partial sync
+        # sharded-state mode (docs/distributed.md "Sharded state"): set by shard()
+        self._shard_ctx: Optional[Any] = None  # MeshContext
+        self._shard_specs: Optional[Dict[str, Any]] = None  # name -> NamedSharding
+        self._lazy_sync_cache: Optional[Any] = None  # (epoch, SyncedState) reduce-once cache
         if self._nan_policy != "propagate":
             # in-graph poison counter rides the normal state machinery: sum-reduced, reset
             # with reset(), donated/scanned/buffered like any accumulator — update/forward
@@ -343,6 +348,13 @@ class Metric:
             self._state.lists[name] = []
         else:
             self._state.tensors[name] = default
+        ctx = self.__dict__.get("_shard_ctx")
+        if ctx is not None and not isinstance(default, list):
+            # late registration on a sharded metric: place the new buffer under the mesh
+            spec = ctx.spec_for_state(name, default, dist_reduce_fx)
+            self._shard_specs[name] = spec
+            self._defaults[name] = jax.device_put(self._defaults[name], spec)
+            self._state.tensors[name] = jax.device_put(self._state.tensors[name], spec)
 
     def __getattr__(self, name: str):
         # states are exposed as attributes (torchmetrics UX: ``self.tp``)
@@ -380,10 +392,33 @@ class Metric:
         when a ``nan_policy`` is active — its in-graph numeric guardrail wrapper
         (non-finite counting + optional masking, traced into the same XLA program; see
         ``torchmetrics_tpu.robust.guardrails``). Resolved once per kernel build, so the
-        disabled path costs nothing per step."""
-        if self._nan_policy == "propagate":
-            return self._update
-        return _guardrails.guarded_update(self._update, self._nan_policy)
+        disabled path costs nothing per step.
+
+        In sharded mode (:meth:`shard`) the kernel is additionally closed under a
+        ``with_sharding_constraint`` on every partitioned state output, so EVERY tier —
+        jit update, fused forward, AOT+donation, ``update_scan``, group forward,
+        ``fast_update`` — accumulates shard-local: XLA keeps the state's mesh layout
+        through the whole program instead of silently replicating the merge. The
+        constraint is placement-only; values are bit-identical to the replicated twin.
+        """
+        fn = self._update if self._nan_policy == "propagate" else _guardrails.guarded_update(
+            self._update, self._nan_policy
+        )
+        specs = self.__dict__.get("_shard_specs")
+        if specs:
+            partitioned = {n: s for n, s in specs.items() if _mesh.is_partitioned(s)}
+            if partitioned:
+                base = fn
+
+                def sharded_update(state: Dict[str, Array], *args: Any, **kwargs: Any) -> Dict[str, Array]:
+                    out = dict(base(state, *args, **kwargs))
+                    for n, s in partitioned.items():
+                        if n in out:
+                            out[n] = jax.lax.with_sharding_constraint(out[n], s)
+                    return out
+
+                fn = sharded_update
+        return fn
 
     def _jitted_update(self) -> Callable:
         fn = self._jit_cache.get("update")
@@ -653,12 +688,22 @@ class Metric:
                 self._state.tensors[name] = out[name]
         if self._state.lists:
             cpu = jax.devices("cpu")[0] if self.compute_on_cpu else None
+            ctx = self.__dict__.get("_shard_ctx")
             for name in self._state.lists:
                 if name in out:
                     entry = out[name]
                     entries = list(entry) if isinstance(entry, (list, tuple)) else [entry]
                     if cpu is not None:  # offload unbounded cat-states to host RAM (metric.py:482-487)
                         entries = [jax.device_put(e, cpu) for e in entries]
+                        obs.telemetry.counter("transfer.device_put").inc(len(entries))
+                    elif ctx is not None:
+                        # sharded cat: spread the unbounded buffer's memory round-robin
+                        # across the mesh devices (docs/distributed.md "Sharded state")
+                        base = len(self._state.lists[name])
+                        entries = [
+                            jax.device_put(e, ctx.device_for_entry(base + i))
+                            for i, e in enumerate(entries)
+                        ]
                         obs.telemetry.counter("transfer.device_put").inc(len(entries))
                     self._state.lists[name].extend(entries)
 
@@ -693,13 +738,18 @@ class Metric:
                     f"Cannot reduce states with `dist_reduce_fx={fx}` in forward; set `full_state_update=True`."
                 )
             self._state.tensors[name] = reduced
+        ctx = self.__dict__.get("_shard_ctx")
         for name in self._state.lists:
             if name in batch_out:
                 entry = batch_out[name]
-                if isinstance(entry, (list, tuple)):
-                    self._state.lists[name].extend(entry)
-                else:
-                    self._state.lists[name].append(entry)
+                entries = list(entry) if isinstance(entry, (list, tuple)) else [entry]
+                if ctx is not None:  # sharded cat: round-robin placement across the mesh
+                    base = len(self._state.lists[name])
+                    entries = [
+                        jax.device_put(e, ctx.device_for_entry(base + i))
+                        for i, e in enumerate(entries)
+                    ]
+                self._state.lists[name].extend(entries)
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate AND return the batch-local value (reference ``metric.py:274-305``).
@@ -1049,13 +1099,50 @@ class Metric:
         return batch_val
 
     # ------------------------------------------------------------------- sync
+    @staticmethod
+    def _any_deleted(values: Any) -> bool:
+        """True when any array in a synced-state dict was deleted (donated) since caching."""
+        for v in values:
+            entries = v if isinstance(v, (list, tuple)) else (v,)
+            for e in entries:
+                if getattr(e, "is_deleted", lambda: False)():
+                    return True
+        return False
+
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
-        """Gather+reduce every state across the world (reference ``metric.py:426-456``)."""
+        """Gather+reduce every state across the world (reference ``metric.py:426-456``).
+
+        Sharded metrics (:meth:`shard`) sync partitioned states by reduce-scatter + slab
+        assembly instead of the full allgather, and the result is cached per update
+        epoch: a second sync with no intervening update reuses the reduced state without
+        touching the interconnect — "reduce once, lazily" (docs/distributed.md).
+        """
         obs.bump(self, "sync_calls")
-        synced = process_sync(
-            self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
-            group=process_group, options=self.sync_options,
+        specs = self.__dict__.get("_shard_specs")
+        sharded = frozenset(
+            n for n, s in (specs or {}).items() if _mesh.is_partitioned(s)
         )
+        if sharded:
+            epoch = (self._update_count, self._state.generation)
+            cached = self.__dict__.get("_lazy_sync_cache")
+            if (
+                cached is not None and cached[0] == epoch
+                and not self._any_deleted(cached[1].values())
+            ):
+                synced = cached[1]
+                obs.telemetry.counter("sync.lazy_reduce.reuses").inc()
+            else:
+                synced = process_sync(
+                    self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
+                    group=process_group, options=self.sync_options, sharded_states=sharded,
+                )
+                self._lazy_sync_cache = (epoch, synced)
+                obs.telemetry.counter("sync.lazy_reduce.fires").inc()
+        else:
+            synced = process_sync(
+                self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
+                group=process_group, options=self.sync_options,
+            )
         # a bounded sync may have degraded to quorum or local-only state; a subsequent
         # fully successful sync restores "full" and clears the stale flags below — the
         # grade always reflects the LATEST sync, never a sticky historical one
@@ -1067,6 +1154,9 @@ class Metric:
             "responding_ranks": dict(getattr(synced, "responding_ranks", {}) or {}),
             "readmitted_ranks": tuple(getattr(synced, "readmitted_ranks", ()) or ()),
             "gather_latency_us": dict(getattr(synced, "gather_latency_us", {}) or {}),
+            "bytes_shipped": int(getattr(synced, "bytes_shipped", 0) or 0),
+            "bytes_received": int(getattr(synced, "bytes_received", 0) or 0),
+            "sharded_states": tuple(getattr(synced, "sharded_states", ()) or ()),
         }
         for name in list(self._state.tensors):
             self._state.tensors[name] = synced[name]
@@ -1139,8 +1229,20 @@ class Metric:
 
     def _computable_state(self) -> Dict[str, Any]:
         state: Dict[str, Any] = dict(self._state.tensors)
+        ctx = self.__dict__.get("_shard_ctx")
         for name, entries in self._state.lists.items():
-            state[name] = dim_zero_cat(entries) if entries else []
+            if not entries:
+                state[name] = []
+            elif ctx is not None:
+                # sharded cat entries live on different mesh devices, which a single
+                # concatenate op rejects — assemble once on the host (append order is
+                # preserved, so the value is bit-identical to the replicated concat)
+                # and place the result sharded along the concatenated axis when it
+                # divides evenly. Paid once per compute, never per update.
+                cat = np.concatenate([np.atleast_1d(np.asarray(e)) for e in entries], axis=0)
+                state[name] = jax.device_put(jnp.asarray(cat), ctx.spec_for_value(cat))
+            else:
+                state[name] = dim_zero_cat(entries)
         return state
 
     def compute(self) -> Any:
@@ -1186,6 +1288,7 @@ class Metric:
         self._cache = None
         self._is_synced = False
         self._world_consistent = FULL
+        self._lazy_sync_cache = None  # the reduce-once cache is per update epoch
 
     # -------------------------------------------------------------- fault tolerance
     @property
@@ -1278,13 +1381,27 @@ class Metric:
         for k, v in self.__dict__.items():
             if k == "_jit_cache":
                 new.__dict__[k] = {}
+            elif k in ("_shard_ctx", "_shard_specs"):
+                # mesh contexts wrap live Device handles (not deep-copyable) and are
+                # immutable layout descriptions — clones share them by reference
+                new.__dict__[k] = v
+            elif k == "_lazy_sync_cache":
+                new.__dict__[k] = None
             else:
                 new.__dict__[k] = deepcopy(v, memo)
         return new
 
     def __getstate__(self) -> Dict[str, Any]:
-        # jitted callables are not picklable; state arrays → numpy (reference metric.py:693-712)
-        d = {k: v for k, v in self.__dict__.items() if k != "_jit_cache"}
+        # jitted callables are not picklable; state arrays → numpy (reference metric.py:693-712).
+        # Mesh contexts hold live Device handles: a pickled sharded metric round-trips as
+        # an UNSHARDED metric (call shard() again under the receiving process's mesh).
+        d = {
+            k: v for k, v in self.__dict__.items()
+            if k not in ("_jit_cache", "_shard_ctx", "_shard_specs", "_lazy_sync_cache")
+        }
+        d["_shard_ctx"] = None
+        d["_shard_specs"] = None
+        d["_lazy_sync_cache"] = None
         d["_state_tensors"] = {k: np.asarray(v) for k, v in self._state.tensors.items()}
         d["_state_lists"] = {k: [np.asarray(e) for e in v] for k, v in self._state.lists.items()}
         d["_defaults"] = {k: (np.asarray(v) if not isinstance(v, list) else []) for k, v in self._defaults.items()}
@@ -1370,8 +1487,86 @@ class Metric:
             self._update_called = self._update_count > 0
 
     # --------------------------------------------------------------- placement
+    def shard(self, mesh: Optional[Any] = None, spec: Optional[Dict[str, Any]] = None) -> "Metric":
+        """Place this metric's state on a device mesh: shard-local accumulate, reduce once.
+
+        ``mesh`` is a ``jax.sharding.Mesh`` or :class:`~torchmetrics_tpu.parallel.mesh.
+        MeshContext`` (default: :func:`~torchmetrics_tpu.parallel.mesh.local_mesh` over
+        every visible device). Every tensor state (and its registered default) is placed
+        with ``jax.device_put(x, NamedSharding(...))`` under a spec derived from its
+        shape and reduce fx — large ``[N, ...]`` states (keyed tenant tables, per-class
+        vectors) shard their leading axis, scalar/small states stay replicated, and
+        list ("cat") entries are distributed round-robin across the mesh devices.
+        ``spec`` overrides the derivation per state name with a ``PartitionSpec`` or
+        ``NamedSharding``.
+
+        From then on every dispatch tier (jit, AOT+donation, ``update_scan``, buffered,
+        group forward, ``fast_update``) accumulates shard-local — the update kernels are
+        closed under a ``with_sharding_constraint`` per partitioned state — and the
+        multi-process sync syncs partitioned states by reduce-scatter + slab assembly,
+        lazily, at most once per update epoch (``parallel/sync.py``), instead of
+        allgathering every replica on every compute. Placement never changes values:
+        results are bit-identical to the replicated metric. See docs/distributed.md
+        ("Sharded state") for the spec table and caveats (``to()`` un-shards; pickling
+        drops the mesh; snapshots gather to host and re-place on restore).
+        """
+        _dispatch.guard_buffered_pending(self, "shard")
+        self._state.guard_readable()
+        ctx = mesh if isinstance(mesh, _mesh.MeshContext) else _mesh.MeshContext(mesh)
+        overrides = dict(spec or {})
+        unknown = set(overrides) - set(self._defaults)
+        if unknown:
+            raise TorchMetricsUserError(
+                f"shard(spec=...) names unknown state(s) {sorted(unknown)}; registered"
+                f" states are {sorted(self._defaults)}"
+            )
+        specs: Dict[str, Any] = {}
+        for name in self._state.tensors:
+            specs[name] = ctx.spec_for_state(
+                name, self._defaults[name], self._reductions[name], override=overrides.get(name)
+            )
+        self._shard_ctx = ctx
+        self._shard_specs = specs
+        moved = 0
+        for name, s in specs.items():
+            self._defaults[name] = jax.device_put(self._defaults[name], s)
+            self._state.tensors[name] = jax.device_put(self._state.tensors[name], s)
+            moved += 2
+        for name, entries in self._state.lists.items():
+            self._state.lists[name] = [
+                jax.device_put(e, ctx.device_for_entry(i)) for i, e in enumerate(entries)
+            ]
+            moved += len(entries)
+        self._state.maybe_aliased = True  # same-placement device_put can return the input
+        self._jit_cache = {}  # kernels rebuild with the sharding constraints baked in
+        self._lazy_sync_cache = None
+        obs.telemetry.counter("shard.metrics_sharded").inc()
+        obs.telemetry.counter("transfer.device_put").inc(moved)
+        obs.telemetry.event(
+            "metric.shard", cat="shard",
+            args={
+                "metric": type(self).__name__, "mesh": ctx.describe(),
+                "specs": {n: str(getattr(s, "spec", s)) for n, s in specs.items()},
+            },
+        )
+        return self
+
+    @property
+    def sharded(self) -> bool:
+        """True once :meth:`shard` placed this metric's state on a device mesh."""
+        return self.__dict__.get("_shard_ctx") is not None
+
+    @property
+    def shard_specs(self) -> Dict[str, Any]:
+        """Per-state ``NamedSharding`` placements ({} while unsharded)."""
+        return dict(self.__dict__.get("_shard_specs") or {})
+
     def to(self, device) -> "Metric":
-        """Move all states to ``device`` (reference ``_apply``, ``metric.py:776-824``)."""
+        """Move all states to ``device`` (reference ``_apply``, ``metric.py:776-824``).
+
+        Single-device placement supersedes any :meth:`shard` mesh layout: sharded mode
+        is cleared (call :meth:`shard` again to re-place on a mesh).
+        """
         n_moved = (
             len(self._state.tensors)
             + sum(len(v) for v in self._state.lists.values())
@@ -1391,6 +1586,11 @@ class Metric:
             k: (jax.device_put(v, device) if not isinstance(v, list) else v) for k, v in self._defaults.items()
         }
         self._device = device
+        if self.__dict__.get("_shard_ctx") is not None:
+            self._shard_ctx = None
+            self._shard_specs = None
+            self._lazy_sync_cache = None
+            self._jit_cache = {}  # drop kernels carrying stale sharding constraints
         return self
 
     def set_dtype(self, dst_type) -> "Metric":
@@ -1404,6 +1604,11 @@ class Metric:
         self._state.maybe_aliased = True  # the cast is an identity for non-float states
         self._defaults = {k: (cast(v) if not isinstance(v, list) else v) for k, v in self._defaults.items()}
         self._jit_cache = {}
+        specs = self.__dict__.get("_shard_specs")
+        if specs:  # the cast may have moved float states off the mesh — re-place them
+            for name, s in specs.items():
+                self._defaults[name] = jax.device_put(self._defaults[name], s)
+                self._state.tensors[name] = jax.device_put(self._state.tensors[name], s)
         return self
 
     def float(self) -> "Metric":

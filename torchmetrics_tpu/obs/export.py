@@ -92,6 +92,15 @@ _ALWAYS_TABULATED = (
     "profiler.rows_recorded",
     "profiler.lazy_compiles",
     "profiler.sampled_steps",
+    # sharded state (docs/distributed.md "Sharded state"): mesh placements, sync byte
+    # accounting (shipped/received/saved vs the allgather baseline), and the lazy
+    # reduce-once cache's fire/reuse trail
+    "shard.metrics_sharded",
+    "sync.bytes_shipped",
+    "sync.bytes_received",
+    "sync.bytes_saved",
+    "sync.lazy_reduce.fires",
+    "sync.lazy_reduce.reuses",
 )
 
 
@@ -209,6 +218,14 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "keyed_updates": counters.get("keyed.updates", 0),
         "keyed_active_keys": counters.get("keyed.active_keys", 0),
         "keyed_fanout": counters.get("keyed.fanout", 0),
+        # sharded state (docs/distributed.md "Sharded state"): mesh placements and the
+        # sync byte ledger — a bench that synced sharded state shows the comms win here
+        "shard_metrics_sharded": counters.get("shard.metrics_sharded", 0),
+        "sync_bytes_shipped": counters.get("sync.bytes_shipped", 0),
+        "sync_bytes_received": counters.get("sync.bytes_received", 0),
+        "sync_bytes_saved": counters.get("sync.bytes_saved", 0),
+        "sync_lazy_reduce_fires": counters.get("sync.lazy_reduce.fires", 0),
+        "sync_lazy_reduce_reuses": counters.get("sync.lazy_reduce.reuses", 0),
         # cost profiler (docs/observability.md): ledger rows captured during this run and
         # how many sampled device-timing steps fed the per-tier host/device split
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),

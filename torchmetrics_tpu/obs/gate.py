@@ -26,9 +26,12 @@ from typing import Any, Dict, List, Optional
 from torchmetrics_tpu.obs import ledger as _ledger
 
 #: the gate's workload classes; the committed baseline holds exactly their rows
-WORKLOAD_CLASSES = ("SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "KeyedMetric")
+WORKLOAD_CLASSES = (
+    "SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "KeyedMetric", "KeyedMetricSharded",
+)
 _N = 256  # fixed workload shape: signatures (and therefore ledger keys) must not drift
 _KEYED_N = 16  # fixed tenant count for the keyed workload rows
+_MESH_DEVICES = 8  # forced host-mesh width for the sharded rows (pinned like the shapes)
 
 
 def _probe_cost_analysis() -> bool:
@@ -64,7 +67,7 @@ def run_workload() -> List[Dict[str, Any]]:
     x = jnp.asarray(np.linspace(0.5, 2.0, _N, dtype=np.float32))
     stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * _N, dtype=np.float32).reshape(4, _N))
     for cls_name in WORKLOAD_CLASSES:
-        if cls_name == "KeyedMetric":  # keyed rows come from the dedicated block below
+        if cls_name.startswith("KeyedMetric"):  # keyed rows come from the blocks below
             continue
         cls = getattr(aggregation, cls_name)
         m = cls(nan_strategy="ignore")
@@ -103,6 +106,33 @@ def run_workload() -> List[Dict[str, Any]]:
         km_jit = KeyedMetric(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N)
         km_jit.update(ids, x)
         km_jit.compute()
+    finally:
+        if prior is None:
+            os.environ.pop(ENV_FAST_DISPATCH, None)
+        else:
+            os.environ[ENV_FAST_DISPATCH] = prior
+
+    # sharded keyed rows (docs/distributed.md "Sharded state"): the same keyed workload
+    # with the tenant table partitioned over the forced host mesh — a distinct class name
+    # attributes the partitioned programs' cost rows separately from the replicated ones.
+    # `main` pins the mesh width via XLA_FLAGS before the backend initialises; if this
+    # process started with fewer devices the specs fall back to replication, which the
+    # baseline diff would surface as a cost change.
+    from torchmetrics_tpu.parallel.mesh import MeshContext
+
+    ShardedKeyed = type("KeyedMetricSharded", (KeyedMetric,), {})
+    ctx = MeshContext()
+    ks = ShardedKeyed(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N).shard(ctx)
+    ks.update(ids, x)
+    ks.update(ids, x)
+    ks.update_batches(ids_stack, stack)
+    ks.compute()
+    prior = os.environ.get(ENV_FAST_DISPATCH)
+    os.environ[ENV_FAST_DISPATCH] = "0"
+    try:
+        ks_jit = ShardedKeyed(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N).shard(ctx)
+        ks_jit.update(ids, x)
+        ks_jit.compute()
     finally:
         if prior is None:
             os.environ.pop(ENV_FAST_DISPATCH, None)
@@ -209,6 +239,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.add_argument(f"--{knob}", type=float, default=None,
                             help=f"override the baseline's {knob.replace('-', '_')}")
     args = parser.parse_args(argv)
+
+    # the sharded workload rows need the pinned host-mesh width; force it before the
+    # first backend touch (a no-op when the launcher — conftest, make — already did)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_MESH_DEVICES}"
+        ).strip()
 
     # config-API platform pin: env-var selection can wedge backend init on a dead
     # tunnel plugin in this environment (see bench.py --smoke), the config API is immune

@@ -19,7 +19,11 @@ host-side machinery that closes it, in three tiers (see ``docs/performance.md``)
   buffers are DELETED — the engine guards this with a state-generation counter and an
   in-flight flag on ``StateStore`` (reads mid-dispatch raise cleanly), copy-on-alias for
   default tensors, and a shared-state gate for compute-group members (jaxlint rule TPU007
-  is the static twin: reading a donated buffer after dispatch).
+  is the static twin: reading a donated buffer after dispatch). Donation composes with
+  sharded state (``Metric.shard``, docs/distributed.md): the AOT example inputs carry the
+  states' ``NamedSharding`` and the kernels are closed under matching sharding
+  constraints, so the executable aliases donated buffers shard-for-shard — mesh layout
+  AND buffer reuse survive every step.
 - **Deferred accumulation** (:class:`BufferedUpdater`, via ``Metric.buffered(k)`` /
   ``MetricCollection.buffered(k)``): stacks up to ``k`` update batches host-side and flushes
   them through the existing ``update_scan`` program in one launch — k dispatches become one

@@ -20,6 +20,13 @@ Three sync contexts are supported:
    ``shard_map``/``pmap`` training steps that keep per-device state.
 3. **Multi-process eager** — ``process_sync`` over ``jax.process_count()`` hosts for the
    torch.distributed-style one-replica-per-process layout.
+
+Large states additionally support **sharded placement** (``Metric.shard(mesh)``,
+``parallel/mesh.py`` + docs/distributed.md "Sharded state"): per-state ``NamedSharding``
+specs derived from shape + reduce fx, shard-local accumulation through every dispatch
+tier, and a lazy reduce-scatter sync (``process_sync(..., sharded_states=...)``) that
+replaces the ``world × state`` allgather with ``≈ 2 × state`` received bytes, cached per
+update epoch.
 """
 from torchmetrics_tpu.parallel.sync import (
     FULL,
@@ -37,11 +44,13 @@ from torchmetrics_tpu.parallel.sync import (
     process_sync,
     quorum_threshold,
     reset_health_state,
+    shardable_state,
+    simulate_mesh_world,
     skew_report,
     sync_options_from_env,
     sync_state,
 )
-from torchmetrics_tpu.parallel.mesh import local_mesh
+from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned, local_mesh, reset_mesh_cache
 
 __all__ = [
     "FULL",
@@ -62,5 +71,10 @@ __all__ = [
     "skew_report",
     "sync_options_from_env",
     "all_gather_object_shapes",
+    "shardable_state",
+    "simulate_mesh_world",
+    "MeshContext",
+    "is_partitioned",
     "local_mesh",
+    "reset_mesh_cache",
 ]

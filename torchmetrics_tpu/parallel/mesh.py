@@ -1,21 +1,215 @@
-"""Mesh construction helpers."""
+"""Device-mesh construction and per-state partition-spec derivation.
+
+The reference (SURVEY §2.2) only knows data-parallel metric-state *replication*: every
+process holds a full copy of every accumulator and ``gather_all_tensors`` ships all of it
+on every sync. Large states — confusion matrices, retrieval cat-buffers, histogram/curve
+buffers, the keyed engine's ``[N, ...]`` tenant tables — waste both memory and interconnect
+that way (*Automatic Cross-Replica Sharding of Weight Update in Data-Parallel Training*,
+PAPERS.md). This module is the placement layer of the sharded alternative
+(``Metric.shard(mesh)``, docs/distributed.md "Sharded state"):
+
+- :func:`local_mesh` builds (and caches) a validated ``jax.sharding.Mesh`` over the
+  visible devices, including named multi-axis meshes (``("data", "model")``).
+- :class:`MeshContext` wraps a mesh and derives a ``NamedSharding`` per metric state from
+  its registered shape and ``dist_reduce_fx``: states with a large, evenly divisible
+  leading axis (keyed tenant tables, per-class count vectors) shard that axis across the
+  primary mesh axis; scalar/small states stay replicated (replication of a scalar is
+  free — sharding it would only add layout churn); host-side list ("cat") states are
+  placed entry-by-entry round-robin across the mesh devices so an unbounded concat buffer
+  occupies every device's memory evenly instead of one device's.
+
+Placement never changes values: a sharded metric is bit-identical to its replicated twin
+by construction, across every dispatch tier. The communication win lives in the sync
+layer (``parallel/sync.py``): partitioned states sync by reduce-scatter + slab assembly
+(received bytes ``≈ 2×state``) instead of a full allgather (``world × state``).
+"""
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+#: process-level mesh cache: meshes are immutable device layouts, and rebuilding one per
+#: ``Metric.shard()`` call would re-hash the device array every time
+_MESH_CACHE: Dict[Tuple, Mesh] = {}
 
 
-def local_mesh(axis_names: Sequence[str] = ("data",), shape: Optional[Tuple[int, ...]] = None) -> Mesh:
-    """Build a mesh over all visible devices.
+def reset_mesh_cache() -> None:
+    """Drop all cached meshes (tests)."""
+    _MESH_CACHE.clear()
 
-    Default: a 1-D ``("data",)`` mesh — metric state is replicated per data shard exactly like the
-    reference's DDP layout (SURVEY §2.2: data-parallel metric-state replication only).
+
+def local_mesh(
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Tuple[int, ...]] = None,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a validated, cached mesh over the visible devices.
+
+    Default: a 1-D ``("data",)`` mesh over every device. Multi-axis named meshes are
+    supported by passing matching ``axis_names`` and ``shape`` — e.g.
+    ``local_mesh(("data", "model"), (4, 2))`` on 8 devices. The shape is validated
+    against the device count up front: a shape the devices don't factor into raises a
+    clear :class:`TorchMetricsUserError` instead of an opaque numpy reshape error.
+
+    Meshes are cached per ``(axis_names, shape, devices)`` — repeated calls (one per
+    ``Metric.shard()``) return the same ``Mesh`` object.
     """
-    devices = jax.devices()
+    axis_names = tuple(str(a) for a in axis_names)
+    if not axis_names:
+        raise TorchMetricsUserError("local_mesh needs at least one axis name, got ()")
+    if len(set(axis_names)) != len(axis_names):
+        raise TorchMetricsUserError(f"local_mesh axis names must be unique, got {axis_names}")
+    devs = tuple(jax.devices()) if devices is None else tuple(devices)
+    if not devs:
+        raise TorchMetricsUserError("local_mesh: no devices visible to build a mesh over")
+    if shape is not None:
+        shape = tuple(int(s) for s in shape)
+    key = (axis_names, shape, devs)
+    cached = _MESH_CACHE.get(key)
+    if cached is not None:
+        return cached
     if shape is None:
-        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
-    dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, axis_names)
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    if len(shape) != len(axis_names):
+        raise TorchMetricsUserError(
+            f"local_mesh: {len(axis_names)} axis name(s) {axis_names} need a {len(axis_names)}-D"
+            f" shape, got shape {shape} with {len(shape)} dim(s)"
+        )
+    if any(s < 1 for s in shape):
+        raise TorchMetricsUserError(f"local_mesh: mesh shape {shape} has a non-positive dimension")
+    need = math.prod(shape)
+    if need != len(devs):
+        raise TorchMetricsUserError(
+            f"local_mesh: mesh shape {shape} covers {need} device(s) but {len(devs)} are"
+            f" visible — pick a shape whose product is exactly the device count"
+            f" (e.g. ({len(devs)},){' or a matching factorisation' if len(axis_names) > 1 else ''})."
+        )
+    dev_array = np.asarray(devs, dtype=object).reshape(shape)
+    mesh = Mesh(dev_array, axis_names)
+    _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def is_partitioned(sharding: Any) -> bool:
+    """True when a ``NamedSharding`` actually splits data (any non-None spec entry)."""
+    spec = getattr(sharding, "spec", None)
+    return spec is not None and any(p is not None for p in spec)
+
+
+class MeshContext:
+    """A mesh plus the policy mapping metric states to ``NamedSharding`` placements.
+
+    ``mesh`` is a ``jax.sharding.Mesh`` (default: :func:`local_mesh` over every visible
+    device) and ``axis`` names the mesh axis states shard over — by default the first
+    axis with size > 1 (on a ``("data", "model")`` mesh, ``"data"``).
+
+    Example:
+        >>> from torchmetrics_tpu.parallel.mesh import MeshContext
+        >>> ctx = MeshContext()
+        >>> ctx.size >= 1
+        True
+    """
+
+    def __init__(self, mesh: Optional[Union[Mesh, "MeshContext"]] = None, axis: Optional[str] = None) -> None:
+        if isinstance(mesh, MeshContext):
+            self.mesh = mesh.mesh
+            self.axis = axis or mesh.axis
+        else:
+            self.mesh = mesh if mesh is not None else local_mesh()
+            if axis is None:
+                sized = [a for a in self.mesh.axis_names if self.mesh.shape[a] > 1]
+                axis = sized[0] if sized else self.mesh.axis_names[0]
+            self.axis = axis
+        if self.axis not in self.mesh.axis_names:
+            raise TorchMetricsUserError(
+                f"MeshContext axis {self.axis!r} is not a mesh axis (mesh has {self.mesh.axis_names})"
+            )
+        self._devices_flat = tuple(np.asarray(self.mesh.devices).reshape(-1))
+
+    @property
+    def size(self) -> int:
+        """Number of shards along the primary sharding axis."""
+        return int(self.mesh.shape[self.axis])
+
+    # ----------------------------------------------------------------- placements
+    def replicated(self) -> NamedSharding:
+        """Full replication over the mesh (every device holds the whole array)."""
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def shard_leading(self, ndim: int = 1) -> NamedSharding:
+        """Leading axis split over the primary mesh axis, remaining dims replicated."""
+        return NamedSharding(self.mesh, PartitionSpec(self.axis, *(None,) * max(0, ndim - 1)))
+
+    def spec_for_value(self, value: Any) -> NamedSharding:
+        """Placement for an ad-hoc array (cat assembly): leading-sharded when divisible."""
+        shape = tuple(np.shape(value))
+        if len(shape) >= 1 and self.size > 1 and shape[0] >= self.size and shape[0] % self.size == 0:
+            return self.shard_leading(len(shape))
+        return self.replicated()
+
+    def spec_for_state(
+        self,
+        name: str,
+        default: Any,
+        reduce_fx: Any,
+        override: Optional[Union[PartitionSpec, NamedSharding]] = None,
+    ) -> Optional[NamedSharding]:
+        """Derive one state's ``NamedSharding`` from its registered default and reduce fx.
+
+        ``override`` (a ``PartitionSpec`` or full ``NamedSharding``) wins unconditionally.
+        List ("cat") states return None — they live as host-side lists whose entries are
+        placed round-robin (:meth:`device_for_entry`), not as one partitioned array.
+        Tensor states shard their leading axis when it is at least the mesh-axis size and
+        evenly divisible by it (keyed ``[N, ...]`` tenant tables, per-class vectors);
+        everything else — scalars, small accumulators, custom/callable reductions —
+        stays replicated, which for sum/max/min-reduced scalars is exactly the
+        "replicated-small" regime the sync layer reduces in one collective.
+        """
+        if override is not None:
+            if isinstance(override, NamedSharding):
+                return override
+            if isinstance(override, PartitionSpec):
+                return NamedSharding(self.mesh, override)
+            raise TorchMetricsUserError(
+                f"shard spec override for state {name!r} must be a PartitionSpec or"
+                f" NamedSharding, got {type(override).__name__}"
+            )
+        if isinstance(default, list):
+            return None
+        shape = tuple(np.shape(default))
+        if (
+            self.size > 1
+            and len(shape) >= 1
+            and shape[0] >= self.size
+            and shape[0] % self.size == 0
+            and (reduce_fx in ("sum", "mean", "max", "min", "cat") or reduce_fx is None)
+        ):
+            return self.shard_leading(len(shape))
+        return self.replicated()
+
+    def device_for_entry(self, index: int) -> Any:
+        """Round-robin device for the ``index``-th appended cat-state entry.
+
+        Distributes an unbounded concat buffer's memory evenly across the mesh — the
+        shard-local-accumulate story for list states, whose entries have no static shape
+        to partition as one array.
+        """
+        return self._devices_flat[index % len(self._devices_flat)]
+
+    def describe(self) -> Dict[str, Any]:
+        """Telemetry/snapshot descriptor: axis sizes, primary axis, device count."""
+        return {
+            "axes": {a: int(self.mesh.shape[a]) for a in self.mesh.axis_names},
+            "axis": self.axis,
+            "devices": len(self._devices_flat),
+        }
+
+    def __repr__(self) -> str:
+        axes = ", ".join(f"{a}={self.mesh.shape[a]}" for a in self.mesh.axis_names)
+        return f"MeshContext({axes}; axis={self.axis!r})"

@@ -173,6 +173,9 @@ class SyncedState(dict):
     lists circuit-broken ranks that answered their probe during THIS sync.
     ``gather_latency_us`` maps each state name to the wall time its gather took on THIS
     rank — the raw material of the cross-rank skew report (:func:`skew_report`).
+    ``bytes_shipped``/``bytes_received`` account the sync's communication volume on this
+    rank (payload bytes out / gathered bytes in); ``sharded_states`` names the states
+    that synced through the reduce-scatter shard path instead of a full allgather.
     """
 
     world_consistent: ConsistencyLevel = FULL
@@ -181,6 +184,9 @@ class SyncedState(dict):
     responding_ranks: Dict[str, Tuple[int, ...]] = {}
     readmitted_ranks: Tuple[int, ...] = ()
     gather_latency_us: Dict[str, float] = {}
+    bytes_shipped: int = 0
+    bytes_received: int = 0
+    sharded_states: Tuple[str, ...] = ()
 
 
 # ------------------------------------------------------------------ rank health ledger
@@ -687,12 +693,88 @@ def _reduce_gathered(fx: ReduceFx, vals: List[Any], world: int, opts: SyncOption
     raise ValueError(f"Unsupported dist_reduce_fx: {fx!r}")
 
 
+def _nbytes(value: Any) -> int:
+    """Byte size of one gather payload (arrays via size×itemsize, lists summed)."""
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    size = getattr(value, "size", None)
+    itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
+    if size is None or itemsize is None:
+        return 0
+    return int(size) * int(itemsize)
+
+
+def shardable_state(value: Any, fx: ReduceFx, world: int) -> bool:
+    """Can this state sync via the reduce-scatter shard path in a ``world``-rank world?
+
+    Needs an elementwise named reduction (sum/mean/max/min — slab-wise reduction of those
+    is the SAME elementwise op sequence as the full reduction, so the result is
+    bit-identical to the allgather path) and a leading axis that splits evenly across the
+    ranks. ``cat``/``None``/callable reductions and scalars keep the full gather.
+    """
+    if fx not in ("sum", "mean", "max", "min"):
+        return False
+    shape = getattr(value, "shape", None)
+    if not shape or world <= 1:
+        return False
+    return shape[0] >= world and shape[0] % world == 0
+
+
+def simulate_mesh_world(
+    rank_states: Sequence[Dict[str, Any]],
+    reductions: Dict[str, ReduceFx],
+    options: Optional[SyncOptions] = None,
+) -> Callable:
+    """A shard-aware ``gather_fn`` over a simulated multi-rank world (tests, bench).
+
+    ``rank_states`` holds one state dict per simulated rank. The returned gather speaks
+    the full sharded-sync contract of :func:`process_sync`:
+
+    - plain call → every rank's full value (the replicated allgather),
+    - ``shard_slice=(lo, hi)`` → every rank's ``value[lo:hi]`` (the reduce-scatter
+      request: "ship me everyone's copy of MY rows"),
+    - ``shard_assemble=rows`` → every rank's REDUCED owned slab (what each rank's own
+      reduce-scatter phase produced), for the assembly allgather.
+
+    This is the eager twin of a real reduce-scatter backend — on actual multihost
+    deployments the same contract is implemented over the wire; here it reads the
+    simulated ranks directly, so single-process tests and the ``bench.py --sharded``
+    lane can drive the exact code path (and byte accounting) of a sharded sync.
+    """
+    opts = options or SyncOptions()
+
+    def gather(
+        value: Any,
+        group: Optional[str] = None,
+        *,
+        name: Optional[str] = None,
+        shard_slice: Optional[Tuple[int, int]] = None,
+        shard_assemble: Optional[int] = None,
+    ) -> List[Any]:
+        del group, value
+        vals = [jnp.asarray(s[name]) for s in rank_states]
+        if shard_slice is not None:
+            lo, hi = shard_slice
+            return [v[lo:hi] for v in vals]
+        if shard_assemble is not None:
+            rows, world = int(shard_assemble), len(vals)
+            fx = reductions.get(name, "sum")
+            return [
+                _reduce_gathered(fx, [v[r * rows:(r + 1) * rows] for v in vals], world, opts)
+                for r in range(world)
+            ]
+        return vals
+
+    return gather
+
+
 def process_sync(
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
     gather_fn: Optional[Callable] = None,
     group: Optional[str] = None,
     options: Optional[SyncOptions] = None,
+    sharded_states: Optional[Sequence[str]] = None,
 ) -> "SyncedState":
     """Eager cross-process sync of a state dict; identity when world size is 1.
 
@@ -710,6 +792,19 @@ def process_sync(
     :class:`SyncedState` grades the result ``full | quorum | local`` and names the
     degraded/quorum states — or raise :class:`SyncTimeoutError` when degraded mode is
     off. See ``docs/robustness.md``.
+
+    ``sharded_states`` (set by ``Metric._sync_dist`` for states with a partitioned
+    ``NamedSharding`` — docs/distributed.md "Sharded state") switches those states from
+    the full allgather to **reduce-scatter + slab assembly** when the gather speaks the
+    shard contract (accepts ``shard_slice``/``shard_assemble`` keywords, e.g.
+    :func:`simulate_mesh_world` or a real reduce-scatter backend): this rank gathers only
+    its OWNED ``1/world`` slab from every rank (received ``≈ state_bytes``), reduces it
+    with the state's fx — slab-wise reduction is elementwise identical to the full
+    reduction, so the result is bit-identical — then allgathers the ``world`` reduced
+    slabs (another ``≈ state_bytes``). Total received ``≈ 2×state`` instead of the
+    allgather's ``world × state``; ``SyncedState.bytes_shipped/bytes_received`` and the
+    ``sync.bytes_*`` counters carry the accounting. A gather without the shard contract
+    (the stock ``process_allgather`` path) falls back to the full gather unchanged.
     """
     import inspect
 
@@ -717,13 +812,16 @@ def process_sync(
     opts = options if options is not None else sync_options_from_env()
     t0 = time.perf_counter() if obs.telemetry.enabled else 0.0
     gather = gather_fn or gather_all_arrays
-    takes_name = takes_ranks = False
+    takes_name = takes_ranks = takes_shard = False
     try:
         params = inspect.signature(gather).parameters
-        takes_name = "name" in params
+        var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+        takes_name = var_kw or "name" in params
         takes_ranks = "ranks" in params
+        takes_shard = var_kw or ("shard_slice" in params and "shard_assemble" in params)
     except (TypeError, ValueError):
         pass
+    shard_set = frozenset(sharded_states or ())
     world = _world_size(opts)
     rank = _local_rank()
     ledger = health_ledger()
@@ -740,6 +838,8 @@ def process_sync(
     ok_ranks: set = set()
     failed_ranks: set = set()
     gather_latency_us: Dict[str, float] = {}
+    bytes_shipped = bytes_received = bytes_saved = 0
+    shard_synced: List[str] = []
 
     def run_gather(payload: Any, name: str, kw: Dict[str, Any]) -> List[Any]:
         # per-gather wall time on THIS rank: the raw material of the cross-rank skew
@@ -771,6 +871,37 @@ def process_sync(
         if takes_ranks and world > 1:
             kw["ranks"] = gather_group
         is_list = isinstance(value, (list, tuple))
+        if (
+            name in shard_set and takes_shard and not is_list
+            and shardable_state(value, fx, world)
+        ):
+            # reduce-scatter + slab assembly (docs/distributed.md "Sharded state"): this
+            # rank owns rows [rank*rows, (rank+1)*rows) of the state. Phase 1 gathers
+            # every rank's copy of the OWNED slab and reduces it (elementwise identical
+            # to the full reduction — bit-identical results); phase 2 allgathers the
+            # world's reduced slabs and concatenates them back into the full state.
+            rows = value.shape[0] // world
+            slab_bytes = _nbytes(value) // world
+            try:
+                pieces = run_gather(value, name, {**kw, "shard_slice": (rank * rows, (rank + 1) * rows)})
+                reduced_slab = _reduce_gathered(fx, [jnp.asarray(p) for p in pieces], world, opts)
+                slabs = run_gather(reduced_slab, name, {**kw, "shard_assemble": rows})
+            except SyncTimeoutError:
+                # a missing rank loses rows, which no quorum can reconstruct — the
+                # sharded path degrades straight to the local value (or raises)
+                if not opts.degraded_mode:
+                    raise
+                degraded.append(name)
+                out[name] = value
+                note_responders(name, (rank,))
+                continue
+            bytes_shipped += 2 * slab_bytes
+            bytes_received += (len(pieces) + len(slabs)) * slab_bytes
+            bytes_saved += max(0, world * _nbytes(value) - (len(pieces) + len(slabs)) * slab_bytes)
+            out[name] = jnp.concatenate([jnp.asarray(s) for s in slabs], axis=0)
+            shard_synced.append(name)
+            note_responders(name, range(world))
+            continue
         if is_list and len(value) == 0 and jax.process_count() == 1 and world == 1:
             out[name] = list(value)
             continue
@@ -797,6 +928,8 @@ def process_sync(
             out[name] = list(value) if is_list else value
             note_responders(name, partial.keys())
             continue
+        bytes_shipped += _nbytes(payload)
+        bytes_received += sum(_nbytes(g) for g in gathered)
         # successful gather: attribute the entries to ranks where the layout allows
         resp: Optional[Tuple[int, ...]] = None
         if takes_ranks and world > 1 and len(gathered) == len(gather_group):
@@ -833,6 +966,19 @@ def process_sync(
     out.responding_ranks = dict(responding)
     out.readmitted_ranks = tuple(readmitted)
     out.gather_latency_us = gather_latency_us
+    out.bytes_shipped = bytes_shipped
+    out.bytes_received = bytes_received
+    out.sharded_states = tuple(shard_synced)
+    if bytes_shipped or bytes_received:
+        obs.telemetry.counter("sync.bytes_shipped").inc(bytes_shipped)
+        obs.telemetry.counter("sync.bytes_received").inc(bytes_received)
+    if shard_synced:
+        obs.telemetry.counter("sync.bytes_saved").inc(bytes_saved)
+        obs.telemetry.event(
+            "sync.sharded", cat="sync",
+            args={"states": shard_synced, "world": world,
+                  "bytes_received": bytes_received, "bytes_saved": bytes_saved},
+        )
     if quorum_states and not degraded:
         obs.telemetry.counter("sync.quorum_syncs").inc()
         obs.telemetry.event(

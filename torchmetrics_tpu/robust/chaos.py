@@ -582,6 +582,67 @@ def scenario_keyed_preemption_journal(
     }
 
 
+def scenario_sharded_preemption_restore(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Sharded twin of the preemption scenario: mesh-placed state dies mid-epoch.
+
+    A metric sharded over the local device mesh (``Metric.shard`` — partitioned states
+    where the shapes allow, replicated otherwise, cat entries round-robin) journals a
+    seeded stream and is dropped cold at a seeded step. ``snapshot()`` must have gathered
+    the sharded buffers to host; a FRESH sharded instance recovers
+    ``snapshot + replay(journal)``, which re-places every restored buffer under the live
+    mesh, finishes the stream, and must be bit-identical with (a) an uninterrupted
+    sharded run and (b) a plain UNSHARDED run — proving placement never leaks into
+    values even through the durability seams.
+    """
+    from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned
+    from torchmetrics_tpu.robust import journal as _journal
+
+    ctx = MeshContext()
+    n_batches = max(3, n_batches)
+    batches = _seeded_batches(rng, n_batches)
+    jdir = f"{workdir}/sharded-wal"
+    m = factory().shard(ctx)
+    jm = m.journal(jdir, every_k=3)
+    preempt = rng.randrange(1, n_batches - 1)
+    for i in range(preempt + 1):
+        (jm.forward if via == "forward" else jm.update)(*batches[i])
+    # the process dies here: no flush, no clean exit, the instance is garbage
+    obs.telemetry.counter("robust.injected_faults").inc()
+    fresh = factory().shard(ctx)
+    recovery = _journal.recover(fresh, jdir)
+    obs.telemetry.counter("robust.recovered").inc()
+    for b in batches[preempt + 1:]:
+        fresh.update(*b)
+    # restored buffers must sit under the live mesh exactly as shard() placed them
+    placement_ok = all(
+        fresh._state.tensors[n].sharding.is_equivalent_to(s, fresh._state.tensors[n].ndim)
+        for n, s in fresh.shard_specs.items()
+    )
+    sharded_ref = factory().shard(ctx)
+    plain_ref = factory()
+    for b in batches:
+        sharded_ref.update(*b)
+        plain_ref.update(*b)
+    value = fresh.compute()
+    bit_identical = _identical(value, sharded_ref.compute())
+    plain_identical = _identical(value, plain_ref.compute())
+    return {
+        "passed": bool(bit_identical and plain_identical and placement_ok),
+        "bit_identical": bit_identical,
+        "plain_identical": plain_identical,
+        "placement_preserved": placement_ok,
+        "partitioned_states": sorted(
+            n for n, s in fresh.shard_specs.items() if is_partitioned(s)
+        ),
+        "mesh": ctx.describe(),
+        "preempt_step": preempt,
+        "replayed": recovery["replayed"],
+        "snapshot_restored": recovery["snapshot_restored"],
+    }
+
+
 def scenario_flap_evict_readmit(
     factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
 ) -> Dict[str, Any]:
@@ -666,6 +727,7 @@ class ChaosMatrix:
         "rank_death_quorum_rejoin": scenario_rank_death_quorum_rejoin,
         "preemption_journal_replay": scenario_preemption_journal_replay,
         "keyed_preemption_journal": scenario_keyed_preemption_journal,
+        "sharded_preemption_restore": scenario_sharded_preemption_restore,
         "flap_evict_readmit": scenario_flap_evict_readmit,
     }
 

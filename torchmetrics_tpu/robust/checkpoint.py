@@ -109,7 +109,28 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
     keys = _keyed_descriptor(metric)
     if keys is not None:
         blob["keys"] = keys
+    shard = _shard_descriptor(metric)
+    if shard is not None:
+        blob["sharding"] = shard
     return blob
+
+
+def _shard_descriptor(metric: Any) -> Any:
+    """Mesh-placement descriptor of a sharded metric (``Metric.shard``), else None.
+
+    Informational, not validated on restore: the payload is the host-gathered full state
+    (``device_get`` of a sharded array assembles every shard), and :func:`restore_metric`
+    re-places it under the RECEIVING metric's live mesh — a blob taken on an 8-way mesh
+    restores cleanly onto a 4-way (or unsharded) metric and vice versa.
+    """
+    ctx = metric.__dict__.get("_shard_ctx")
+    if ctx is None:
+        return None
+    specs = metric.__dict__.get("_shard_specs") or {}
+    return {
+        "mesh": ctx.describe(),
+        "specs": {name: str(getattr(s, "spec", s)) for name, s in specs.items()},
+    }
 
 
 def _keyed_descriptor(metric: Any) -> Any:
@@ -205,11 +226,22 @@ def restore_metric(metric: Any, blob: Dict[str, Any]) -> None:
     """
     _validate_blob(metric, blob)
     state = metric._state
+    shard_specs = metric.__dict__.get("_shard_specs") or {}
+    shard_ctx = metric.__dict__.get("_shard_ctx")
     for name, arr in blob["tensors"].items():
         # preserve the registered dtype exactly (np round-trips weak-typed scalars wide)
-        state.tensors[name] = jnp.asarray(arr, state.tensors[name].dtype)
+        value = jnp.asarray(arr, state.tensors[name].dtype)
+        spec = shard_specs.get(name)
+        if spec is not None:
+            # sharded metric: re-place the host payload under the LIVE mesh — the blob
+            # carries host-gathered full state, the receiving layout decides placement
+            value = jax.device_put(value, spec)
+        state.tensors[name] = value
     for name, entries in blob["lists"].items():
-        state.lists[name] = [jnp.asarray(e) for e in entries]
+        placed = [jnp.asarray(e) for e in entries]
+        if shard_ctx is not None:
+            placed = [jax.device_put(e, shard_ctx.device_for_entry(i)) for i, e in enumerate(placed)]
+        state.lists[name] = placed
     state.maybe_aliased = True  # fresh uploads may be deduped against live arrays
     state.inflight = False
     metric._update_count = int(blob["update_count"])
@@ -217,6 +249,7 @@ def restore_metric(metric: Any, blob: Dict[str, Any]) -> None:
     metric._computed = None
     metric._cache = None
     metric._is_synced = False
+    metric.__dict__["_lazy_sync_cache"] = None  # reduce-once cache is per restored epoch
     obs.telemetry.counter("robust.restores").inc()
 
 

@@ -1,4 +1,4 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke telemetry-smoke jaxlint chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
 test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke chaos chaos-matrix perf-gate
 
@@ -36,11 +36,22 @@ shard-smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --sharded --smoke > /tmp/tm_shard_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_shard_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; rep=ex['sync_bytes_per_compute_replicated']; shd=ex['sync_bytes_per_compute_sharded']; assert shd < rep, (shd, rep); bits=[v for k,v in ex.items() if k.startswith('sharded_bit_identical')]; assert bits and all(bits), ex; assert ex['lazy_reduce_fires'] <= ex['sharded_compute_epochs'] and ex['lazy_reduce_reuses'] >= 1, ex; print('shard-smoke ok: %dB sharded vs %dB allgather per compute (%.1fx), bit-identical' % (shd, rep, rep/shd))"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU011, docs/static-analysis.md): exits
+# static JAX/TPU hazard analysis (rules TPU001-TPU013, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
-# with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`
+# with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`. Whole-program
+# pass over the package PLUS examples/ and bench.py, with the content-fingerprint
+# incremental cache (unchanged reruns skip rule execution entirely).
 jaxlint:
-	python -m torchmetrics_tpu._lint torchmetrics_tpu --strict-baseline
+	python -m torchmetrics_tpu._lint torchmetrics_tpu examples bench.py --strict-baseline --cache
+
+# SARIF artifact for CI code-scanning upload (same finding set as `make jaxlint`)
+jaxlint-sarif:
+	python -m torchmetrics_tpu._lint torchmetrics_tpu examples bench.py --cache --format sarif --output jaxlint.sarif
+
+# opt-in jaxpr IR cross-check: lowers the registered aggregation kernels and verifies the
+# AST layer agrees with the compiler's ground truth (imports jax; see docs/static-analysis.md)
+jaxlint-ir:
+	python -m torchmetrics_tpu._lint torchmetrics_tpu examples bench.py --cache --ir
 
 # tier-1 guard for the observability exporter: one fused-sweep iteration with telemetry on,
 # trace exported and schema-checked (also runs as part of test-integration / the tier-1 lane)

@@ -480,7 +480,7 @@ def bench_sharded(n_keys: int, batch: int, n_batches: int, world: int) -> dict:
         for _ in range(2):
             i = rng.randint(0, n_keys, size=(batch,)).astype(np.int32)
             v = rng.randint(0, 64, size=(batch,)).astype(np.float32)
-            m.update(i, v)
+            m.update(i, v)  # jaxlint: disable=TPU010 — rank replicas of a simulated world, one per rank (not per-key streams)
     states = [dict(m._state.tensors) for m in ranks]
     reds = {n: ranks[0]._reductions[n] for n in states[0]}
     opts = sync_mod.SyncOptions(world=world)

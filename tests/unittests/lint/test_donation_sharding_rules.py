@@ -1,0 +1,225 @@
+"""Fixture tests for the donation-lifetime (TPU012) and sharding-consistency (TPU013) rules.
+
+TPU012 is the static twin of the runtime ``StateStore`` generation guard: every fixture
+models the hazard window between handing buffers to a donating executable and the
+commit/recover seam. The clean twins pin the window's edges — commit barriers close it,
+rebinds close it per-name, and the repo's real dispatch protocol (``ops/dispatch.py``)
+stays silent under the project pass.
+"""
+from __future__ import annotations
+
+import textwrap
+
+from torchmetrics_tpu._lint import analyze_source
+from torchmetrics_tpu._lint.core import analyze_sources
+
+
+def _rules(snippet: str, path: str = "fixture.py"):
+    return [f.rule for f in analyze_source(textwrap.dedent(snippet), path=path)]
+
+
+def _project(*sources):
+    return analyze_sources(list(sources), project=True)
+
+
+class TestTPU012SiblingAlias:
+    def test_pre_donation_alias_read_flags(self):
+        assert "TPU012" in _rules(
+            """
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                alias = state
+                out = step(state, batch)
+                return alias.sum()
+            """
+        )
+
+    def test_alias_message_names_the_donated_buffer(self):
+        findings = analyze_source(textwrap.dedent(
+            """
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                alias = state
+                out = step(state, batch)
+                return alias.sum()
+            """
+        ))
+        msgs = [f.message for f in findings if f.rule == "TPU012"]
+        assert msgs and "pre-donation alias of 'state'" in msgs[0]
+
+    def test_commit_barrier_closes_the_window(self):
+        assert "TPU012" not in _rules(
+            """
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                alias = state
+                out = step(state, batch)
+                commit_step(store, entry, out)
+                return alias.sum()
+            """
+        )
+
+    def test_rebound_alias_is_clean(self):
+        assert "TPU012" not in _rules(
+            """
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                alias = state
+                out = step(state, batch)
+                alias = out[0]
+                return alias.sum()
+            """
+        )
+
+    def test_alias_taken_after_donation_is_clean(self):
+        # the alias binds to the POST-dispatch value of the name only if rebound;
+        # an alias of a fresh object (not the donated buffer) must not fire
+        assert "TPU012" not in _rules(
+            """
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                out = step(state, batch)
+                state = out[0]
+                alias = state
+                return alias.sum()
+            """
+        )
+
+    def test_module_level_donator_direct_read_flags(self):
+        assert "TPU012" in _rules(
+            """
+            step = jax.jit(kernel, donate_argnums=(0,))
+
+            def run(state, batch):
+                out = step(state, batch)
+                return state.sum()
+            """
+        )
+
+    def test_aot_compile_donation_tracked(self):
+        assert "TPU012" in _rules(
+            """
+            def run(state, batch):
+                ex = aot_compile(kernel, (state, batch), donate_argnums=(0,))
+                alias = state
+                out = ex(state, batch)
+                return alias.sum()
+            """
+        )
+
+    def test_donates_annotation_on_def_line(self):
+        assert "TPU012" in _rules(
+            """
+            def launch(buf, batch):  # jaxlint: donates(0)
+                return _impl(buf, batch)
+
+            def run(state, batch):
+                alias = state
+                out = launch(state, batch)
+                return alias.sum()
+            """
+        )
+
+    def test_donation_commit_marker_extends_barriers(self):
+        assert "TPU012" not in _rules(
+            """
+            def settle(store, out):  # jaxlint: donation-commit
+                return store
+
+            def run(state, batch):
+                step = jax.jit(kernel, donate_argnums=(0,))
+                alias = state
+                out = step(state, batch)
+                settle(store, out)
+                return alias.sum()
+            """
+        )
+
+    def test_project_mode_annotated_donator_crosses_modules(self):
+        a = (
+            "torchmetrics_tpu/launchpad_fixture.py",
+            "def launch(buf, batch):  # jaxlint: donates(0)\n"
+            "    return _impl(buf, batch)\n",
+        )
+        b = (
+            "torchmetrics_tpu/driver_fixture.py",
+            "from torchmetrics_tpu.launchpad_fixture import launch\n"
+            "def run(state, batch):\n"
+            "    alias = state\n"
+            "    out = launch(state, batch)\n"
+            "    return alias.sum()\n",
+        )
+        findings = _project(a, b)
+        assert [f for f in findings if f.rule == "TPU012" and f.path.endswith("driver_fixture.py")]
+        # single-module view of the driver cannot know launch donates
+        assert "TPU012" not in [f.rule for f in analyze_source(b[1], path="driver_fixture.py")]
+
+    def test_shipped_dispatch_protocol_is_clean(self):
+        # the engine's own metric.py/dispatch.py call chains must stay silent — the
+        # whole-tree run is pinned by test_baseline_sync, this is the focused version
+        from pathlib import Path
+
+        import torchmetrics_tpu
+
+        root = Path(torchmetrics_tpu.__file__).resolve().parent
+        sources = []
+        for rel in ("metric.py", "collections.py", "ops/dispatch.py"):
+            sources.append((f"torchmetrics_tpu/{rel}", (root / rel).read_text()))
+        findings = analyze_sources(sources, project=True)
+        assert not [f for f in findings if f.rule == "TPU012"]
+
+
+class TestTPU013Sharding:
+    def test_unconstrained_hand_mutation_flags(self):
+        assert "TPU013" in _rules(
+            """
+            def rebuild(metric, mesh, v):
+                metric.shard(mesh)
+                metric.metric_state["v"] = jnp.zeros_like(v)
+            """
+        )
+
+    def test_constrained_mutation_is_clean(self):
+        assert "TPU013" not in _rules(
+            """
+            def rebuild(metric, mesh, v, spec):
+                metric.shard(mesh)
+                metric.metric_state["v"] = with_sharding_constraint(jnp.zeros_like(v), spec)
+            """
+        )
+
+    def test_state_alias_mutation_flags(self):
+        assert "TPU013" in _rules(
+            """
+            def rebuild(metric, mesh, v):
+                m = metric.shard(mesh)
+                st = m.metric_state
+                st["v"] = jnp.zeros_like(v)
+            """
+        )
+
+    def test_order_dependent_float_fold_flags(self):
+        assert "TPU013" in _rules(
+            """
+            def summarize(metric, mesh, parts):
+                m = metric.shard(mesh)
+                return jnp.mean(jnp.concatenate([m.metric_state["v"], parts]))
+            """
+        )
+
+    def test_fold_without_cat_is_clean(self):
+        assert "TPU013" not in _rules(
+            """
+            def summarize(metric, mesh):
+                m = metric.shard(mesh)
+                return jnp.mean(m.metric_state["v"])
+            """
+        )
+
+    def test_unsharded_metric_is_clean(self):
+        assert "TPU013" not in _rules(
+            """
+            def rebuild(metric, v):
+                metric.metric_state["v"] = jnp.zeros_like(v)
+            """
+        )

@@ -1,0 +1,277 @@
+"""Whole-program (interprocedural) jaxlint tests.
+
+The anchor is the regression pair the ISSUE pins: a hazard sitting one (and two) call
+hops away from a jit root in ANOTHER module is provably invisible to the per-module
+analyzer (``analyze_source`` on the helper module alone reports nothing) and reported by
+the project pass (``analyze_sources`` over both modules), with the cross-module call path
+rendered as ``via:`` in the message. Clean twins pin the precision half: host-config
+arguments of propagated callees stay static, config-gated validation calls never inherit
+jit context, and the ``is_traced`` guard idioms are trace-dead.
+"""
+from __future__ import annotations
+
+import textwrap
+
+from torchmetrics_tpu._lint import analyze_source
+from torchmetrics_tpu._lint.core import analyze_sources
+
+KERNEL_MODULE = textwrap.dedent(
+    """
+    from torchmetrics_tpu.helpers_fixture import fold, fold_clean, deep
+
+    class MeanThing(Metric):
+        def _update(self, state, value):
+            return {"total": fold(state["total"], value)}
+
+    class CleanThing(Metric):
+        def _update(self, state, value):
+            return {"total": fold_clean(state["total"], value, mode="fast")}
+
+    class DeepThing(Metric):
+        def _update(self, state, value):
+            return {"total": deep(state["total"], value)}
+    """
+)
+
+HELPER_MODULE = textwrap.dedent(
+    """
+    import jax.numpy as jnp
+
+    def fold(total, value):
+        if value.sum() > 0:
+            return total + jnp.sum(value)
+        return total
+
+    def fold_clean(total, value, mode="fast"):
+        if mode == "fast":
+            return total + jnp.sum(value)
+        return total + jnp.mean(value)
+
+    def deep(total, value):
+        return _inner(total, value)
+
+    def _inner(total, value):
+        if value.sum() > 0:
+            return total + 1
+        return total
+    """
+)
+
+
+def _project(*sources):
+    return analyze_sources(list(sources), project=True)
+
+
+def _pair():
+    return (
+        ("torchmetrics_tpu/kernels_fixture.py", KERNEL_MODULE),
+        ("torchmetrics_tpu/helpers_fixture.py", HELPER_MODULE),
+    )
+
+
+class TestCrossModuleRegression:
+    """The acceptance fixture: per-module miss, project hit."""
+
+    def test_single_module_run_provably_misses(self):
+        # the OLD analyzer view: helpers analyzed alone are eager, nothing fires
+        assert analyze_source(HELPER_MODULE, path="helpers_fixture.py") == []
+
+    def test_project_run_reports_one_hop_hazard_with_via(self):
+        findings = _project(*_pair())
+        hits = [f for f in findings if f.rule == "TPU002" and "'fold'" in f.message]
+        assert hits and hits[0].path == "torchmetrics_tpu/helpers_fixture.py"
+        assert "via:" in hits[0].message
+        assert "MeanThing._update" in hits[0].message
+
+    def test_project_run_reports_two_hop_hazard(self):
+        findings = _project(*_pair())
+        hits = [f for f in findings if f.rule == "TPU002" and "_inner" in f.message]
+        assert len(hits) == 1
+        # the via chain walks root -> deep -> _inner
+        assert "DeepThing._update" in hits[0].message and "deep" in hits[0].message
+
+    def test_clean_twin_config_args_stay_static(self):
+        # fold_clean branches on `mode` — a host string config arg at every call site;
+        # the propagated callee must NOT treat it as traced
+        findings = _project(*_pair())
+        assert not [f for f in findings if "fold_clean" in f.message]
+
+
+class TestPropagationPrecision:
+    def test_device_param_seeds_eager_callee(self):
+        # eager caller hands a jnp-produced value to a helper; the helper's later
+        # coercion is a real host sync even though nothing is jitted
+        a = (
+            "torchmetrics_tpu/a_fixture.py",
+            "from torchmetrics_tpu.b_fixture import readback\n"
+            "def update(x):\n"
+            "    dev = jnp.asarray(x)\n"
+            "    return readback(dev)\n",
+        )
+        b = (
+            "torchmetrics_tpu/b_fixture.py",
+            "def readback(v):\n    return float(v)\n",
+        )
+        findings = _project(a, b)
+        assert [f for f in findings if f.rule == "TPU001" and f.path.endswith("b_fixture.py")]
+
+    def test_config_gated_validation_never_inherits_jit(self):
+        # the functional-API contract: jit callers pass validate_args=False, so the
+        # guarded call must not drag the validator into jit context
+        a = (
+            "torchmetrics_tpu/api_fixture.py",
+            "from torchmetrics_tpu.val_fixture import check\n"
+            "@jax.jit\n"
+            "def score(preds, target, validate_args: bool = True):\n"
+            "    if validate_args:\n"
+            "        check(preds, target)\n"
+            "    return preds - target\n",
+        )
+        b = (
+            "torchmetrics_tpu/val_fixture.py",
+            "def check(preds, target):\n"
+            "    if preds.sum() < 0:\n"
+            "        raise ValueError('negative mass')\n",
+        )
+        assert not [f for f in _project(a, b) if f.path.endswith("val_fixture.py")]
+
+    def test_imported_base_class_flag_inheritance(self):
+        # jit_compute=False declared on a base in another module switches the subclass's
+        # _compute out of jit context — the curve-family shape
+        base = (
+            "torchmetrics_tpu/base_fixture.py",
+            "class CurveBase(Metric):\n"
+            "    jit_compute = False\n"
+            "    def _compute(self, state):\n"
+            "        return state['v']\n",
+        )
+        sub = (
+            "torchmetrics_tpu/sub_fixture.py",
+            "from torchmetrics_tpu.base_fixture import CurveBase\n"
+            "class Roc(CurveBase):\n"
+            "    def _compute(self, state):\n"
+            "        if state['v'].sum() > 0:\n"
+            "            return state['v']\n"
+            "        return -state['v']\n",
+        )
+        assert not [f for f in _project(base, sub) if f.rule == "TPU002"]
+        # the same module analyzed alone (no cross-module flag) WOULD flag it — the
+        # project pass is what makes the eager contract visible
+        assert "TPU002" in [f.rule for f in analyze_source(sub[1], path="sub_fixture.py")]
+
+    def test_hot_path_propagates_for_tpu006(self):
+        a = (
+            "torchmetrics_tpu/hot_fixture.py",
+            "from torchmetrics_tpu.util_fixture import pad\n"
+            "class M(Metric):\n"
+            "    jit_update = False\n"
+            "    def forward(self, x):\n"
+            "        return pad(x)\n",
+        )
+        b = (
+            "torchmetrics_tpu/util_fixture.py",
+            "import jax.numpy as jnp\n"
+            "def pad(x):\n"
+            "    return x + jnp.zeros((4,))\n",
+        )
+        findings = _project(a, b)
+        hits = [f for f in findings if f.rule == "TPU006" and f.path.endswith("util_fixture.py")]
+        assert hits and "via:" in hits[0].message
+
+    def test_memoized_helper_is_not_hot(self):
+        a = (
+            "torchmetrics_tpu/hot2_fixture.py",
+            "from torchmetrics_tpu.util2_fixture import table\n"
+            "class M(Metric):\n"
+            "    jit_update = False\n"
+            "    def forward(self, x):\n"
+            "        return x + table()\n",
+        )
+        b = (
+            "torchmetrics_tpu/util2_fixture.py",
+            "import functools\n"
+            "import jax.numpy as jnp\n"
+            "@functools.lru_cache(maxsize=None)\n"
+            "def table():\n"
+            "    return jnp.zeros((4,))\n",
+        )
+        assert not [f for f in _project(a, b) if f.rule == "TPU006"]
+
+
+class TestTraceGuardIdioms:
+    def test_is_traced_early_return_guards_rest_of_body(self):
+        src = (
+            "class M(Metric):\n"
+            "    def _update(self, state, value):\n"
+            "        _check(value)\n"
+            "        return {'v': state['v'] + value}\n"
+            "def _check(value):\n"
+            "    if is_traced(value):\n"
+            "        return\n"
+            "    t = np.asarray(value)\n"
+            "    if t.max() > 1:\n"
+            "        raise ValueError('bad')\n"
+        )
+        findings = analyze_source(src, path="guard_fixture.py")
+        assert not [f for f in findings if f.rule in ("TPU002", "TPU003")]
+
+    def test_not_is_traced_if_body_is_eager_only(self):
+        src = (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if not is_traced(x):\n"
+            "        np.asarray(x)\n"
+            "    return x\n"
+        )
+        assert "TPU003" not in [f.rule for f in analyze_source(src)]
+
+    def test_short_circuit_conjunct_after_guard_is_eager_only(self):
+        src = (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if not is_traced(x) and float(x) < 2:\n"
+            "        raise ValueError('too small')\n"
+            "    return x\n"
+        )
+        assert "TPU001" not in [f.rule for f in analyze_source(src)]
+
+    def test_unguarded_twin_still_flags(self):
+        src = (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if float(x) < 2:\n"
+            "        raise ValueError('too small')\n"
+            "    return x\n"
+        )
+        rules = [f.rule for f in analyze_source(src)]
+        assert "TPU001" in rules
+
+    def test_try_excepted_numpy_is_concretize_or_bail(self):
+        src = (
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    try:\n"
+            "        t = np.asarray(x)\n"
+            "    except Exception:\n"
+            "        return None\n"
+            "    return t\n"
+        )
+        assert "TPU003" not in [f.rule for f in analyze_source(src)]
+
+
+class TestModuleWrapRoots:
+    def test_module_scope_jit_of_imported_fn_is_root(self):
+        a = (
+            "torchmetrics_tpu/wrap_fixture.py",
+            "from torchmetrics_tpu.kern_fixture import kernel\n"
+            "fast = jax.jit(kernel)\n",
+        )
+        b = (
+            "torchmetrics_tpu/kern_fixture.py",
+            "def kernel(x):\n"
+            "    if x.sum() > 0:\n"
+            "        return x\n"
+            "    return -x\n",
+        )
+        findings = _project(a, b)
+        assert [f for f in findings if f.rule == "TPU002" and f.path.endswith("kern_fixture.py")]

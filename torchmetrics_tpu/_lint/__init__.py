@@ -11,8 +11,12 @@ Usage::
     python -m torchmetrics_tpu._lint torchmetrics_tpu            # lint the package
     make jaxlint                                                 # CI gate (strict baseline)
 
-Rules TPU001–TPU008 are documented with bad/good examples in ``docs/static-analysis.md``;
-per-line suppression is ``# jaxlint: disable=TPU00X``.
+Rules TPU001–TPU013 are documented with bad/good examples in ``docs/static-analysis.md``
+(the catalog table there is generated from ``rules.RULE_META``); per-line suppression is
+``# jaxlint: disable=TPU00X``. The default run is whole-program (``_lint/project.py``):
+interprocedural jit/donation/hot-path marks propagate across module boundaries and
+findings carry a ``via:`` call path. The opt-in jaxpr IR backend (``--ir``,
+``_lint/irlint.py``) is the only component that imports jax.
 """
 from torchmetrics_tpu._lint.baseline import (
     DEFAULT_BASELINE_PATH,
@@ -45,16 +49,24 @@ def package_lint_status() -> dict:
     """
     global _STATUS_CACHE
     if _STATUS_CACHE is None:
+        import os
         from pathlib import Path
 
+        from torchmetrics_tpu._lint.cache import DEFAULT_CACHE_PATH, ENV_CACHE_PATH, LintCache
+        from torchmetrics_tpu._lint.core import LAST_RUN_STATS
+
         package_root = Path(__file__).resolve().parent.parent
-        findings = analyze_paths([package_root])
+        cache = LintCache(os.environ.get(ENV_CACHE_PATH, DEFAULT_CACHE_PATH))
+        findings = analyze_paths([package_root], cache=cache)
         new, waived, stale = apply_baseline(findings, load_baseline(DEFAULT_BASELINE_PATH))
         _STATUS_CACHE = {
             "findings": len(findings),
             "new": len(new),
             "baselined": waived,
             "stale": len(stale),
+            "runtime_ms": LAST_RUN_STATS.get("runtime_ms"),
+            "cache_hits": LAST_RUN_STATS.get("cache_hits", 0),
+            "cache_misses": LAST_RUN_STATS.get("cache_misses", 0),
         }
     return dict(_STATUS_CACHE)
 

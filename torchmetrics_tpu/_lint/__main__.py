@@ -1,8 +1,15 @@
 """jaxlint CLI: ``python -m torchmetrics_tpu._lint [paths ...]``.
 
 Exit codes: 0 clean (all findings baselined), 1 new findings (or stale baseline entries
-under ``--strict-baseline``), 2 usage error. ``--write-baseline`` regenerates the baseline
-from the current finding set and always exits 0.
+under ``--strict-baseline``; or IR findings/disagreements under ``--ir``), 2 usage error.
+``--write-baseline`` regenerates the baseline from the current finding set and always
+exits 0.
+
+The default run is the whole-program pass (interprocedural marks, ``via:`` call paths);
+``--no-project`` restores the legacy per-module view. ``--cache`` enables the
+content-fingerprint incremental cache (``make jaxlint`` uses it), ``--ir`` additionally
+runs the opt-in jaxpr IR backend over the registered aggregation kernels and cross-checks
+it against the AST layer.
 """
 from __future__ import annotations
 
@@ -17,8 +24,15 @@ from torchmetrics_tpu._lint.baseline import (
     load_baseline,
     write_baseline,
 )
-from torchmetrics_tpu._lint.core import analyze_paths, render_json, render_sarif, render_text
-from torchmetrics_tpu._lint.rules import RULES
+from torchmetrics_tpu._lint.cache import DEFAULT_CACHE_PATH, LintCache
+from torchmetrics_tpu._lint.core import (
+    analyze_paths,
+    render_github,
+    render_json,
+    render_sarif,
+    render_text,
+)
+from torchmetrics_tpu._lint.rules import RULE_META, RULES
 
 
 def _default_paths() -> List[str]:
@@ -31,10 +45,12 @@ def _default_paths() -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m torchmetrics_tpu._lint",
-        description="jaxlint: AST-based JAX/TPU hazard analyzer (rules TPU001-TPU008)",
+        description="jaxlint: whole-program AST JAX/TPU hazard analyzer (rules TPU001-TPU013)",
     )
     parser.add_argument("paths", nargs="*", help="files/directories to lint (default: the package)")
-    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif", "github"), default="text")
+    parser.add_argument("--output", default=None, metavar="PATH",
+                        help="also write the rendered output to this file (e.g. a SARIF artifact)")
     parser.add_argument(
         "--baseline", default=str(DEFAULT_BASELINE_PATH),
         help="baseline file of waived findings; pass 'none' to disable (default: the shipped baseline)",
@@ -45,12 +61,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="also fail on stale baseline entries (the CI mode)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--no-project", action="store_true",
+                        help="per-module analysis only (no interprocedural propagation)")
+    parser.add_argument("--cache", nargs="?", const=DEFAULT_CACHE_PATH, default=None,
+                        metavar="PATH",
+                        help="incremental cache file (default location when given bare:"
+                             f" {DEFAULT_CACHE_PATH}; env TM_TPU_LINT_CACHE also honored)")
+    parser.add_argument("--ir", action="store_true",
+                        help="also run the jaxpr IR backend over the registered aggregation"
+                             " kernels and cross-check it against the AST layer (imports jax)")
+    parser.add_argument("--ir-metrics", default=None,
+                        help="comma-separated metric class names for --ir (default:"
+                             " Sum/Mean/Max/Min/Cat)")
+    parser.add_argument("--write-rule-catalog", nargs="?", const="docs/static-analysis.md",
+                        default=None, metavar="DOCS",
+                        help="regenerate the rule-catalog table in the docs file and exit")
     parser.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rid in sorted(RULES):
-            print(f"{rid}  {RULES[rid]}")
+            print(f"{rid}  [{RULE_META[rid]['severity']}]  {RULES[rid]}")
+        return 0
+
+    if args.write_rule_catalog is not None:
+        from torchmetrics_tpu._lint.catalog import sync_docs
+
+        changed = sync_docs(args.write_rule_catalog, write=True)
+        print(f"jaxlint: rule catalog in {args.write_rule_catalog}"
+              f" {'updated' if changed else 'already in sync'}")
         return 0
 
     select = None
@@ -67,7 +106,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"jaxlint: path(s) not found: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    findings = analyze_paths(paths, select=select)
+    cache = LintCache(args.cache) if args.cache else None
+    findings = analyze_paths(paths, select=select, project=not args.no_project, cache=cache)
 
     if args.write_baseline:
         target = DEFAULT_BASELINE_PATH if args.baseline == "none" else Path(args.baseline)
@@ -80,17 +120,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     new, waived, stale = apply_baseline(findings, entries)
 
     if args.format == "json":
-        print(render_json(new, waived, stale))
+        rendered = render_json(new, waived, stale)
     elif args.format == "sarif":
-        print(render_sarif(new, RULES))
+        rendered = render_sarif(new, RULES)
+    elif args.format == "github":
+        rendered = render_github(new, waived, stale)
     else:
-        print(render_text(new, waived, stale))
+        rendered = render_text(new, waived, stale)
+    print(rendered)
+    if args.output:
+        Path(args.output).write_text(rendered + "\n", encoding="utf-8")
 
+    rc = 0
     if new:
-        return 1
-    if stale and args.strict_baseline:
-        return 1
-    return 0
+        rc = 1
+    elif stale and args.strict_baseline:
+        rc = 1
+
+    if args.ir:
+        from torchmetrics_tpu._lint.irlint import render_ir_report, run_ir_lint
+
+        targets = None
+        if args.ir_metrics:
+            targets = [t.strip() for t in args.ir_metrics.split(",") if t.strip()]
+        report = run_ir_lint(targets=targets, ast_findings=findings)
+        print(render_ir_report(report))
+        if report["findings"] or report["ast_false_negatives"] or report["unexplained"]:
+            rc = rc or 1
+
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,140 @@
+"""Content-fingerprint incremental cache for jaxlint.
+
+Whole-program analysis re-parses the entire tree on every run; this cache makes repeat
+runs pay only for what changed:
+
+- **tree fast path** — when no analyzed file changed (the common CI re-run), the final
+  finding list is served from the cache without parsing a single file;
+- **per-module reuse** — when some files changed, every module still has to be *parsed*
+  (the project pass needs all symbol tables), but rule execution — the expensive part —
+  is skipped for modules whose source digest AND interprocedural-marks fingerprint both
+  match the cached entry. Marks are pure functions of the whole tree
+  (``project.ProjectModel.marks_fingerprint``), so matching (digest, marks) guarantees
+  identical rule output.
+
+Every key folds in the **analyzer fingerprint** (a digest of the ``_lint`` package's own
+sources) and the active ``--select`` set, so editing a rule or changing rule selection
+invalidates everything automatically — there is no version constant to forget to bump.
+
+The cache is a plain JSON file (default ``.jaxlint_cache.json`` in the working directory,
+override via ``TM_TPU_LINT_CACHE`` or ``--cache``); a corrupt or stale file is treated as
+empty, and save failures are swallowed — a cache must never take the lint run down.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ENV_CACHE_PATH = "TM_TPU_LINT_CACHE"
+DEFAULT_CACHE_PATH = ".jaxlint_cache.json"
+
+_ANALYZER_FP: Optional[str] = None
+
+
+def analyzer_fingerprint() -> str:
+    """Digest of the ``_lint`` package's own sources (cached per process).
+
+    Part of every cache key: cached findings are only as current as the rules that
+    produced them, so any analyzer edit invalidates the whole cache.
+    """
+    global _ANALYZER_FP
+    if _ANALYZER_FP is None:
+        h = hashlib.sha256()
+        pkg = Path(__file__).resolve().parent
+        for fp in sorted(pkg.glob("*.py")):
+            h.update(fp.name.encode())
+            h.update(fp.read_bytes())
+        _ANALYZER_FP = h.hexdigest()[:16]
+    return _ANALYZER_FP
+
+
+def source_digest(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "surrogatepass")).hexdigest()[:16]
+
+
+def tree_key(digests: Sequence[Tuple[str, str]], select_key: str) -> str:
+    """One digest over the whole analyzed tree: (path, source digest) pairs + selection."""
+    h = hashlib.sha256()
+    h.update(analyzer_fingerprint().encode())
+    h.update(select_key.encode())
+    for path, digest in sorted(digests):
+        h.update(path.encode())
+        h.update(digest.encode())
+    return h.hexdigest()[:16]
+
+
+def marks_digest(fingerprint: str) -> str:
+    return hashlib.sha256(fingerprint.encode()).hexdigest()[:16]
+
+
+class LintCache:
+    """Load/consult/update one cache file; ``hits``/``misses`` count per-module reuse."""
+
+    def __init__(self, path: Any) -> None:
+        self.path = Path(path)
+        self.hits = 0
+        self.misses = 0
+        self._tree: Dict[str, Any] = {}
+        self._modules: Dict[str, Dict[str, Any]] = {}
+        try:
+            payload = json.loads(self.path.read_text(encoding="utf-8"))
+            if (
+                isinstance(payload, dict)
+                and payload.get("tool") == "jaxlint-cache"
+                and payload.get("analyzer") == analyzer_fingerprint()
+            ):
+                self._tree = payload.get("tree", {}) or {}
+                self._modules = payload.get("modules", {}) or {}
+        except (OSError, ValueError):
+            pass  # missing or corrupt cache == empty cache
+
+    # ------------------------------------------------------------------------ tree level
+    def tree_findings(self, key: str) -> Optional[List[Dict[str, Any]]]:
+        if self._tree.get("key") == key:
+            return list(self._tree.get("findings", []))
+        return None
+
+    def set_tree(self, key: str, findings: List[Dict[str, Any]]) -> None:
+        self._tree = {"key": key, "findings": findings}
+
+    # ---------------------------------------------------------------------- module level
+    def module_findings(
+        self, path: str, digest: str, marks: str, select_key: str
+    ) -> Optional[List[Dict[str, Any]]]:
+        entry = self._modules.get(path)
+        if (
+            entry is not None
+            and entry.get("digest") == digest
+            and entry.get("marks") == marks
+            and entry.get("select", "") == select_key
+        ):
+            self.hits += 1
+            return list(entry.get("findings", []))
+        self.misses += 1
+        return None
+
+    def set_module(
+        self, path: str, digest: str, marks: str, select_key: str,
+        findings: List[Dict[str, Any]],
+    ) -> None:
+        self._modules[path] = {
+            "digest": digest, "marks": marks, "select": select_key, "findings": findings,
+        }
+
+    # --------------------------------------------------------------------------- persist
+    def save(self) -> None:
+        payload = {
+            "version": 1,
+            "tool": "jaxlint-cache",
+            "analyzer": analyzer_fingerprint(),
+            "tree": self._tree,
+            "modules": self._modules,
+        }
+        try:
+            tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+            tmp.write_text(json.dumps(payload), encoding="utf-8")
+            tmp.replace(self.path)
+        except OSError:
+            pass  # read-only checkout / sandbox: run uncached rather than fail

@@ -24,6 +24,11 @@ import re
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
+#: run statistics of the most recent :func:`analyze_sources` call in this process —
+#: surfaced by ``package_lint_status()`` and ``obs.bench_extras()`` (lint_runtime_ms,
+#: lint_cache_hits) so the incremental-cache win shows up in bench rounds.
+LAST_RUN_STATS: Dict[str, Any] = {}
+
 _SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable(?:=(?P<rules>[A-Z0-9, ]+))?")
 
 
@@ -75,14 +80,45 @@ def _suppressed_rules(line: str) -> Optional[set]:
     return {r.strip() for r in rules.split(",") if r.strip()}
 
 
+def _syntax_error_finding(source: str, path: str, err: SyntaxError) -> Finding:
+    line = err.lineno or 1
+    return Finding(
+        rule="TPU000",
+        path=path,
+        line=line,
+        col=(err.offset or 1) - 1,
+        message=f"file does not parse: {err.msg}",
+        snippet=(source.splitlines()[line - 1] if source.splitlines() else "").strip(),
+    )
+
+
+def _filter_findings(
+    findings: Iterable[Finding], lines: Sequence[str], select: Optional[Sequence[str]]
+) -> List[Finding]:
+    """Apply rule selection and line-level suppression comments; sort by location."""
+    kept = []
+    for f in findings:
+        if select and f.rule not in select:
+            continue
+        src_line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        waived = _suppressed_rules(src_line)
+        if waived is not None and (not waived or f.rule in waived):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
 def analyze_source(
     source: str,
     path: str = "<string>",
     select: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
-    """Run every (selected) rule over one Python source string.
+    """Run every (selected) rule over one Python source string — per-module only.
 
-    Returns findings sorted by location, with line-level suppression comments applied.
+    This is the module-local view: no interprocedural marks, no project context (use
+    :func:`analyze_paths` for the whole-program pass). Returns findings sorted by
+    location, with line-level suppression comments applied.
 
         >>> fs = analyze_source("def f(preds):\\n    return preds.item()\\n", path="snippet.py")
         >>> [f.rule for f in fs]
@@ -95,29 +131,9 @@ def analyze_source(
     try:
         tree = ast.parse(source)
     except SyntaxError as err:
-        line = err.lineno or 1
-        return [
-            Finding(
-                rule="TPU000",
-                path=path,
-                line=line,
-                col=(err.offset or 1) - 1,
-                message=f"file does not parse: {err.msg}",
-                snippet=(source.splitlines()[line - 1] if source.splitlines() else "").strip(),
-            )
-        ]
+        return [_syntax_error_finding(source, path, err)]
     lines = source.splitlines()
-    findings = []
-    for f in run_rules(tree, lines, path):
-        if select and f.rule not in select:
-            continue
-        src_line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        waived = _suppressed_rules(src_line)
-        if waived is not None and (not waived or f.rule in waived):
-            continue
-        findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    return _filter_findings(run_rules(tree, lines, path), lines, select)
 
 
 def iter_python_files(roots: Sequence[Any]) -> Iterable[Tuple[Path, str]]:
@@ -136,16 +152,102 @@ def iter_python_files(roots: Sequence[Any]) -> Iterable[Tuple[Path, str]]:
             yield fp, (Path(root.name) / fp.relative_to(root)).as_posix()
 
 
-def analyze_paths(roots: Sequence[Any], select: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Analyze every Python file under ``roots``; findings sorted by path/line."""
-    findings: List[Finding] = []
+def analyze_paths(
+    roots: Sequence[Any],
+    select: Optional[Sequence[str]] = None,
+    project: bool = True,
+    cache: Optional[Any] = None,
+) -> List[Finding]:
+    """Analyze every Python file under ``roots``; findings sorted by path/line.
+
+    ``project=True`` (the default) runs the whole-program pass: all files are modeled
+    together, interprocedural marks (jit context, device params, hot paths, donating
+    callables — see ``_lint/project.py``) propagate across module boundaries, and
+    cross-module findings carry a ``via:`` call path. ``project=False`` is the legacy
+    per-module mode (each file analyzed in isolation).
+
+    ``cache`` is an optional :class:`torchmetrics_tpu._lint.cache.LintCache`: unchanged
+    trees are served without parsing, and partially-changed trees skip rule execution for
+    every module whose (source digest, marks fingerprint) pair still matches.
+    """
+    sources: List[Tuple[str, str]] = []
     for fp, display in iter_python_files(roots):
         try:
-            source = fp.read_text(encoding="utf-8")
+            sources.append((display, fp.read_text(encoding="utf-8")))
         except (OSError, UnicodeDecodeError):
             continue
-        findings.extend(analyze_source(source, path=display, select=select))
+    return analyze_sources(sources, select=select, project=project, cache=cache)
+
+
+def analyze_sources(
+    sources: Sequence[Tuple[str, str]],
+    select: Optional[Sequence[str]] = None,
+    project: bool = True,
+    cache: Optional[Any] = None,
+) -> List[Finding]:
+    """Analyze ``(display_path, source)`` pairs (the driver behind :func:`analyze_paths`)."""
+    import time
+
+    t0 = time.perf_counter()
+    select_key = ",".join(sorted(select)) if select else ""
+    findings: List[Finding] = []
+    tkey = None
+    if cache is not None:
+        from torchmetrics_tpu._lint.cache import source_digest, tree_key
+
+        digests = {path: source_digest(src) for path, src in sources}
+        tkey = tree_key(list(digests.items()), select_key)
+        hit = cache.tree_findings(tkey)
+        if hit is not None:
+            cache.hits += len(sources)
+            findings = [Finding(**d) for d in hit]
+            LAST_RUN_STATS.update(
+                runtime_ms=round((time.perf_counter() - t0) * 1e3, 2),
+                cache_hits=cache.hits, cache_misses=cache.misses,
+                files=len(sources), mode="tree-cache",
+            )
+            return findings
+
+    if project:
+        from torchmetrics_tpu._lint.cache import marks_digest
+        from torchmetrics_tpu._lint.project import ProjectModel
+        from torchmetrics_tpu._lint.rules import run_rules
+
+        pm = ProjectModel(sources)
+        modeled = {e.path for e in pm.entries}
+        for path, src in sources:  # files the project model rejected: syntax errors
+            if path not in modeled:
+                findings.extend(analyze_source(src, path=path, select=select))
+        for entry in pm.entries:
+            if cache is not None:
+                marks = marks_digest(pm.marks_fingerprint(entry))
+                cached = cache.module_findings(entry.path, digests[entry.path], marks, select_key)
+                if cached is not None:
+                    findings.extend(Finding(**d) for d in cached)
+                    continue
+            module_findings = _filter_findings(
+                run_rules(entry.tree, entry.lines, entry.path, model=entry.model),
+                entry.lines, select,
+            )
+            if cache is not None:
+                cache.set_module(
+                    entry.path, digests[entry.path], marks, select_key,
+                    [f.to_dict() for f in module_findings],
+                )
+            findings.extend(module_findings)
+    else:
+        for path, src in sources:
+            findings.extend(analyze_source(src, path=path, select=select))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if cache is not None and tkey is not None:
+        cache.set_tree(tkey, [f.to_dict() for f in findings])
+        cache.save()
+    LAST_RUN_STATS.update(
+        runtime_ms=round((time.perf_counter() - t0) * 1e3, 2),
+        cache_hits=cache.hits if cache is not None else 0,
+        cache_misses=cache.misses if cache is not None else 0,
+        files=len(sources), mode="project" if project else "per-module",
+    )
     return findings
 
 
@@ -213,3 +315,32 @@ def render_sarif(new: List[Finding], rule_index: Dict[str, str]) -> str:
         ],
     }
     return json.dumps(doc, indent=2)
+
+
+def _gh_escape(text: str, property_value: bool = False) -> str:
+    """Escape per GitHub workflow-command rules (data vs property positions differ)."""
+    text = text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if property_value:
+        text = text.replace(":", "%3A").replace(",", "%2C")
+    return text
+
+
+def render_github(new: List[Finding], baselined: int, stale: List[Dict[str, Any]]) -> str:
+    """GitHub Actions annotations: one ``::warning`` workflow command per new finding.
+
+    Printed to a job's stdout, each line becomes an inline annotation on the PR diff —
+    no upload step, no SARIF processing delay (the SARIF export remains the archival
+    format for code-scanning; this is the instant-feedback one).
+    """
+    lines = [
+        f"::warning file={_gh_escape(f.path, True)},line={f.line},col={f.col + 1},"
+        f"title={_gh_escape('jaxlint ' + f.rule, True)}::{_gh_escape(f.message)}"
+        for f in new
+    ]
+    summary = (
+        f"jaxlint: {len(new)} new finding(s), {baselined} baselined,"
+        f" {len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    )
+    lines.append(f"::notice title=jaxlint::{_gh_escape(summary)}" if not new
+                 else f"::error title=jaxlint::{_gh_escape(summary)}")
+    return "\n".join(lines)

@@ -1,0 +1,231 @@
+"""Jaxpr IR backend: lint the kernels jax ACTUALLY compiles, cross-check the AST layer.
+
+This is the one ``_lint`` component allowed to import jax (opt-in via ``--ir``; every
+import is function-local so importing the module stays free). Where the AST rules reason
+about source text, this backend lowers the registered ``_update``/``_compute`` kernels of
+a target metric list to jaxprs — the compiler's ground truth — and lints the IR:
+
+``IR001``  host callback primitive (``pure_callback``/``io_callback``/``debug_callback``)
+           inside a compiled kernel — a per-step host round-trip the AST layer can only
+           infer from names
+``IR002``  explicit transfer primitive (``device_put`` with a host-flavored target)
+           inside a compiled kernel
+``IR003``  silent 64-bit upcast (``convert_element_type`` to f64/i64/u64/c128 from a
+           narrower input) — the classic accidentally-enabled-x64 hazard that doubles
+           HBM traffic on TPU
+
+The **cross-check**: a kernel that FAILS to lower with a tracer/concretization error
+contains a real host hazard (data-dependent branch, host coercion). If the engine jits
+that kernel and the AST layer reported no finding inside its source span, that is an AST
+false-negative — reported as its own finding class (``IR100``) so the static layer's
+blind spots surface instead of silently under-reporting. Kernels the engine never traces
+(``jit_update``/``jit_compute`` opt-outs) cannot disagree: whatever the hypothetical
+lowering says, the runtime contract is eager, and the row is recorded as explained.
+"""
+from __future__ import annotations
+
+import inspect
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+IR_RULES: Dict[str, str] = {
+    "IR001": "host callback primitive inside a compiled kernel (per-step host round-trip)",
+    "IR002": "transfer primitive inside a compiled kernel (device<->host copy per step)",
+    "IR003": "silent 64-bit upcast inside a compiled kernel (x64 leak; 2x HBM on TPU)",
+    "IR100": "AST false-negative: kernel cannot trace but the AST layer reported nothing",
+}
+
+#: the aggregation kernel set the acceptance gate pins (``--ir-metrics`` overrides)
+DEFAULT_TARGETS: Tuple[str, ...] = ("SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "CatMetric")
+
+_CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback", "callback", "outside_call"})
+_TRANSFER_PRIMS = frozenset({"device_put"})
+_WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+#: error type names that mean "the python body needs a concrete value" — a host hazard,
+#: as opposed to an infrastructure failure (no backend, bad example args)
+_HAZARD_ERRORS = (
+    "TracerBoolConversionError", "TracerArrayConversionError", "TracerIntegerConversionError",
+    "ConcretizationTypeError", "UnexpectedTracerError",
+)
+
+
+def _iter_eqns(jaxpr: Any):
+    """Yield every eqn of a (closed) jaxpr, descending into pjit/scan/cond sub-jaxprs."""
+    raw = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in raw.eqns:
+        yield eqn
+        for pval in eqn.params.values():
+            for sub in pval if isinstance(pval, (list, tuple)) else (pval,):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub)
+
+
+def _lint_jaxpr(closed: Any, where: str) -> List[Dict[str, Any]]:
+    findings: List[Dict[str, Any]] = []
+    for eqn in _iter_eqns(closed):
+        prim = eqn.primitive.name
+        if prim in _CALLBACK_PRIMS:
+            findings.append({
+                "rule": "IR001", "where": where, "primitive": prim,
+                "message": f"host callback `{prim}` compiled into {where} — one host"
+                           " round-trip per execution; hoist the host work to the eager caller",
+            })
+        elif prim in _TRANSFER_PRIMS:
+            device = eqn.params.get("devices") or eqn.params.get("device")
+            findings.append({
+                "rule": "IR002", "where": where, "primitive": prim,
+                "message": f"transfer primitive `{prim}` (target={device!r}) compiled into"
+                           f" {where} — a per-execution copy the kernel should not own",
+            })
+        elif prim == "convert_element_type":
+            new = str(eqn.params.get("new_dtype", ""))
+            srcs = [str(getattr(getattr(v, "aval", None), "dtype", "")) for v in eqn.invars]
+            if new in _WIDE_DTYPES and all(s and s != new for s in srcs):
+                findings.append({
+                    "rule": "IR003", "where": where, "primitive": prim,
+                    "message": f"silent upcast {srcs[0] or '?'} -> {new} compiled into {where}"
+                               " — an x64 leak (2x HBM, halved vector width on TPU); pin the"
+                               " dtype at the producer",
+                })
+    return findings
+
+
+def _display_path(fp: str) -> str:
+    parts = Path(fp).parts
+    if "torchmetrics_tpu" in parts:
+        return "/".join(parts[parts.index("torchmetrics_tpu"):])
+    return Path(fp).name
+
+
+def _kernel_span(fn: Any) -> Tuple[Optional[str], int, int]:
+    """(display path, first line, last line) of a kernel's source definition."""
+    try:
+        src_lines, lo = inspect.getsourcelines(fn)
+        fp = inspect.getsourcefile(fn)
+    except (OSError, TypeError):
+        return None, 0, 0
+    return _display_path(fp or ""), lo, lo + len(src_lines) - 1
+
+
+def _ast_hits(ast_findings: Optional[Sequence[Any]], path: Optional[str], lo: int, hi: int) -> List[Any]:
+    if not ast_findings or path is None:
+        return []
+    return [f for f in ast_findings if f.path == path and lo <= f.line <= hi]
+
+
+def _example_state(metric: Any):
+    """Abstract-friendly example state: defaults for tensors, a flat f32 row per list state."""
+    import jax.numpy as jnp
+
+    state = dict(metric._state.tensors)
+    for name in metric._state.lists:
+        state[name] = jnp.ones((4,), jnp.float32)
+    return state
+
+
+def run_ir_lint(
+    targets: Optional[Sequence[str]] = None,
+    ast_findings: Optional[Sequence[Any]] = None,
+    value_shape: Tuple[int, ...] = (8,),
+) -> Dict[str, Any]:
+    """Lower + lint the target metrics' kernels; cross-check against the AST findings.
+
+    Returns a report dict: per-kernel rows (lowered / findings / verdict), the flat IR
+    finding list, the AST false-negatives, and the unexplained disagreements (expected
+    empty on the shipped tree — the self-check test pins exactly that).
+    """
+    report: Dict[str, Any] = {
+        "backend": None, "kernels": [], "findings": [],
+        "ast_false_negatives": [], "unexplained": [], "skipped": None,
+    }
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        report["backend"] = jax.default_backend()
+    except Exception as err:  # no jax / no backend: the opt-in backend degrades to a no-op
+        report["skipped"] = f"jax unavailable: {err!r}"
+        return report
+
+    import torchmetrics_tpu.aggregation as agg
+
+    names = list(targets) if targets else list(DEFAULT_TARGETS)
+    value = jnp.ones(value_shape, jnp.float32)
+    for cname in names:
+        cls = getattr(agg, cname, None)
+        if cls is None:
+            report["kernels"].append({
+                "metric": cname, "kernel": "-", "lowered": False,
+                "error": "unknown metric class", "verdict": "explained: unresolved target",
+            })
+            continue
+        metric = cls()
+        state = _example_state(metric)
+        for kind, fn, flag in (
+            ("update", metric._update, "jit_update"),
+            ("compute", metric._compute, "jit_compute"),
+        ):
+            engine_jits = getattr(cls, flag, True)
+            path, lo, hi = _kernel_span(fn)
+            hits = _ast_hits(ast_findings, path, lo, hi)
+            row: Dict[str, Any] = {
+                "metric": cname, "kernel": kind, "path": path, "span": [lo, hi],
+                "engine_jits": bool(engine_jits), "ast_findings": len(hits),
+                "lowered": False, "error": None, "findings": [],
+            }
+            where = f"{cname}._{kind}"
+            try:
+                closed = jax.make_jaxpr(fn)(state, value) if kind == "update" \
+                    else jax.make_jaxpr(fn)(state)
+                row["lowered"] = True
+                row["findings"] = _lint_jaxpr(closed, where)
+                report["findings"].extend(row["findings"])
+                if hits and engine_jits:
+                    # AST flagged source the compiler traces cleanly — over-report
+                    row["verdict"] = "unexplained: AST finding in a kernel that lowers clean"
+                    report["unexplained"].append(row)
+                else:
+                    row["verdict"] = "agree"
+            except Exception as err:
+                row["error"] = f"{type(err).__name__}: {err}"
+                hazard = type(err).__name__ in _HAZARD_ERRORS
+                if not engine_jits:
+                    row["verdict"] = f"explained: engine never traces this kernel ({flag}=False)"
+                elif hazard and hits:
+                    row["verdict"] = "agree"  # both layers see the hazard
+                elif hazard:
+                    fn_row = {
+                        "rule": "IR100", "where": where, "path": path, "line": lo,
+                        "message": f"{where} cannot trace ({type(err).__name__}) but the AST"
+                                   " layer reported no finding in its span — a static-analysis"
+                                   " blind spot; add or refine the covering rule",
+                    }
+                    report["ast_false_negatives"].append(fn_row)
+                    row["verdict"] = "ast_false_negative"
+                else:
+                    row["verdict"] = "explained: lowering infrastructure error"
+            report["kernels"].append(row)
+    return report
+
+
+def render_ir_report(report: Dict[str, Any]) -> str:
+    if report.get("skipped"):
+        return f"jaxlint-ir: skipped ({report['skipped']})"
+    lines = [f"jaxlint-ir: backend={report['backend']}"]
+    for row in report["kernels"]:
+        status = "ok" if row.get("lowered") else "no-trace"
+        lines.append(
+            f"  {row['metric']}._{row['kernel']}: {status},"
+            f" {len(row.get('findings', []))} IR finding(s),"
+            f" {row.get('ast_findings', 0)} AST finding(s) in span -> {row.get('verdict')}"
+        )
+    for f in report["findings"]:
+        lines.append(f"  {f['rule']} {f['where']}: {f['message']}")
+    for f in report["ast_false_negatives"]:
+        lines.append(f"  {f['rule']} {f['where']}: {f['message']}")
+    lines.append(
+        f"jaxlint-ir: {len(report['findings'])} IR finding(s),"
+        f" {len(report['ast_false_negatives'])} AST false-negative(s),"
+        f" {len(report['unexplained'])} unexplained disagreement(s)"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,416 @@
+"""Whole-program analysis: package-wide symbol table, call graph, interprocedural marks.
+
+The per-module pass (``rules._ModuleModel``) stops at file edges: a ``.item()`` inside a
+helper called from a jit kernel two modules away, a donated buffer handed across a
+function boundary, a ``jnp`` constant built in a utility reached from ``forward`` — all
+invisible. This module builds the missing whole-program layer:
+
+1. **Symbol table** — every module's top-level functions, plus its import map
+   (``from m import f as g``, ``import pkg.mod as alias``, relative imports), resolved
+   against the set of modules actually being analyzed. Names that resolve outside the
+   project stay opaque (under-reporting beats guessing).
+2. **Call-graph propagation to fixpoint** — four mark kinds flow along resolved calls
+   (both intra- and cross-module):
+
+   - *jit context*: callees reached from a jit-traced function are jit-traced, with the
+     cross-module call path recorded as ``via`` (surfaced in finding messages);
+   - *device parameters*: a parameter that receives a device/traced expression at some
+     call site seeds the callee's traced-name dataflow even in eager context;
+   - *hot paths*: callees reached from an eager per-step entry point (``update`` /
+     ``forward``) are hot for TPU006 — except memoized helpers (``lru_cache``), whose
+     constant builds are deliberate hoists;
+   - *donating callables*: a parameter bound to a ``donate_argnums`` executable at a call
+     site makes the callee a donation site for TPU012.
+
+3. **Annotation seams** — defs carrying ``# jaxlint: donates(i, ...)`` or
+   ``# jaxlint: donation-commit`` markers (``ops/dispatch.py``) are collected
+   project-wide and attached to every module model, so TPU012 sees the engine's
+   commit/recover protocol from any caller.
+
+The pass only ADDS marks; a module analyzed alone (``analyze_source``) has none, which is
+exactly the regression the project fixtures pin: single-module run misses the
+cross-module hazard, project run reports it with a ``via:`` call path.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu._lint.rules import (
+    _COMMIT_MARKER,
+    _DONATES_RE,
+    _HOT_EXACT,
+    _HOT_PREFIXES,
+    _TRACE_WRAPPERS,
+    _FuncInfo,
+    _ModuleModel,
+    _aot_compile_donations,
+    _donating_argnums,
+    _dotted,
+    _final_name,
+    _is_device_expr,
+    _scoped_walk,
+)
+
+#: decorators that memoize a function — its body runs once, so it is never "hot"
+_MEMO_DECORATORS = frozenset({"lru_cache", "cache", "cached_property"})
+#: propagation sweeps upper bound (call chains deeper than this are pathological)
+_MAX_SWEEPS = 32
+
+
+def module_name_of(display_path: str) -> str:
+    """Dotted module name of a display path (``pkg/ops/dispatch.py`` → ``pkg.ops.dispatch``)."""
+    parts = display_path[:-3].split("/") if display_path.endswith(".py") else display_path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+class ModuleEntry:
+    """One analyzed module: source facts plus its resolved import maps."""
+
+    __slots__ = (
+        "path", "name", "source", "lines", "tree", "model",
+        "imports", "module_aliases", "base_jit",
+    )
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.name = module_name_of(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.model = _ModuleModel(tree)
+        #: local name -> (target module dotted name, symbol) for ``from M import sym``
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        #: local alias -> target module dotted name for ``import M [as a]`` forms
+        self.module_aliases: Dict[str, str] = {}
+        #: qualnames jit-marked by the per-module pass alone (before propagation)
+        self.base_jit: Set[str] = {f.qualname for f in self.model.functions if f.jit}
+
+    @property
+    def package(self) -> str:
+        return self.name.rsplit(".", 1)[0] if "." in self.name else ""
+
+
+class ProjectModel:
+    """The whole-program model: modules, resolved imports, propagated marks."""
+
+    def __init__(self, sources: Sequence[Tuple[str, str]]) -> None:
+        self.entries: List[ModuleEntry] = []
+        for path, source in sources:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue  # the driver reports TPU000 for these; nothing to model
+            self.entries.append(ModuleEntry(path, source, tree))
+        self.by_module: Dict[str, ModuleEntry] = {e.name: e for e in self.entries}
+        #: project-wide donation annotations (final def name -> donated positions)
+        self.donators: Dict[str, Set[int]] = {}
+        #: project-wide commit/recover seam names (`# jaxlint: donation-commit` defs)
+        self.barriers: Set[str] = set()
+        self._tn_cache: Dict[int, Tuple[Tuple, Tuple[Set[str], Set[str]]]] = {}
+        for entry in self.entries:
+            self._resolve_imports(entry)
+        self._inherit_class_flags()
+        for entry in self.entries:
+            self._collect_annotations(entry)
+        for entry in self.entries:  # rules read these off the model (getattr, default None)
+            entry.model.project_donators = self.donators  # type: ignore[attr-defined]
+            entry.model.project_barriers = self.barriers  # type: ignore[attr-defined]
+        self._propagate()
+
+    # ------------------------------------------------------------------ model construction
+    def _resolve_imports(self, entry: ModuleEntry) -> None:
+        for node in ast.walk(entry.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in self.by_module:
+                        local = alias.asname or alias.name.split(".")[0]
+                        # ``import a.b.c`` binds ``a`` — only the asname form gives a
+                        # direct handle on the submodule; the bare form is resolved at
+                        # call sites through the dotted chain
+                        if alias.asname is not None:
+                            entry.module_aliases[local] = alias.name
+                        else:
+                            root = alias.name.split(".")[0]
+                            if root in self.by_module:
+                                entry.module_aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative import: climb from this module's package
+                    pkg_parts = entry.name.split(".")[:-1]
+                    climb = node.level - 1
+                    if climb:
+                        pkg_parts = pkg_parts[: len(pkg_parts) - climb] if climb <= len(pkg_parts) else []
+                    base = ".".join(pkg_parts + ([node.module] if node.module else []))
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    submodule = f"{base}.{alias.name}" if base else alias.name
+                    if submodule in self.by_module:
+                        entry.module_aliases[local] = submodule
+                    elif base in self.by_module:
+                        entry.imports[local] = (base, alias.name)
+
+    def _resolve_base_flags(self, entry: ModuleEntry, base: ast.AST) -> Optional[Set[str]]:
+        """``jit_*`` flags switched off by a base-class expression, resolved cross-module."""
+        if isinstance(base, ast.Name):
+            local = entry.imports.get(base.id)
+            if local is not None:
+                mod, sym = local
+                target = self.by_module.get(mod)
+                if target is not None:
+                    return target.model.class_flags_off.get(sym)
+            return entry.model.class_flags_off.get(base.id)
+        d = _dotted(base)
+        if d and len(d) >= 2 and d[0] in entry.module_aliases:
+            modname = ".".join([entry.module_aliases[d[0]]] + d[1:-1])
+            target = self.by_module.get(modname)
+            if target is not None:
+                return target.model.class_flags_off.get(d[-1])
+        return None
+
+    def _inherit_class_flags(self) -> None:
+        """Merge ``jit_update``/``jit_compute`` opt-outs through IMPORTED base classes.
+
+        The per-module pass inherits flags only along same-module bases; here the whole
+        curve-metric family (``BinaryROC(BinaryPrecisionRecallCurve)`` etc.) picks up the
+        base's ``jit_compute = False`` across the module boundary. Models of affected
+        modules are REBUILT with the merged flags, so convention-jit marking — and every
+        rule downstream of it — sees the true runtime contract instead of assuming the
+        kernels trace.
+        """
+        extra: Dict[str, Dict[str, Set[str]]] = {}
+        for _ in range(len(self.entries) + 1):
+            changed = False
+            for entry in self.entries:
+                mod_extra = extra.setdefault(entry.path, {})
+                for cname, cnode in entry.model.class_nodes.items():
+                    have = entry.model.class_flags_off.get(cname, set()) | mod_extra.get(cname, set())
+                    merged = set(have)
+                    for base in cnode.bases:
+                        bflags = self._resolve_base_flags(entry, base)
+                        # same-module bases may themselves have gained imported flags
+                        bname = _final_name(base)
+                        if bname and bname in mod_extra:
+                            bflags = (bflags or set()) | mod_extra[bname]
+                        if bflags:
+                            merged |= bflags
+                    if merged != have:
+                        mod_extra[cname] = merged
+                        changed = True
+            if not changed:
+                break
+        for entry in self.entries:
+            mod_extra = {
+                c: f for c, f in extra.get(entry.path, {}).items()
+                if f - entry.model.class_flags_off.get(c, set())
+            }
+            if not mod_extra:
+                continue
+            entry.model = _ModuleModel(entry.tree, extra_flags_off=mod_extra)
+            entry.base_jit = {f.qualname for f in entry.model.functions if f.jit}
+
+    def _collect_annotations(self, entry: ModuleEntry) -> None:
+        for info in entry.model.functions:
+            dl = info.node.lineno
+            src = entry.lines[dl - 1] if 0 < dl <= len(entry.lines) else ""
+            m = _DONATES_RE.search(src)
+            if m:
+                self.donators[info.name] = {int(x) for x in m.group(1).split(",")}
+            if _COMMIT_MARKER in src:
+                self.barriers.add(info.name)
+
+    # ------------------------------------------------------------------------- resolution
+    def _lookup(self, module: str, symbol: str) -> List[Tuple[ModuleEntry, _FuncInfo]]:
+        target = self.by_module.get(module)
+        if target is None:
+            return []
+        return [(target, fi) for fi in target.model.by_name.get(symbol, []) if fi.cls is None]
+
+    def resolve_call(
+        self, entry: ModuleEntry, info: Optional[_FuncInfo], call: ast.Call
+    ) -> List[Tuple[ModuleEntry, _FuncInfo]]:
+        """Project functions a call site can reach (imported names, module attrs, locals)."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            tgt = self.imports_of(entry).get(fn.id)
+            if tgt is not None:
+                return self._lookup(*tgt)
+            # intra-module plain call (same visibility rule as _propagate_jit)
+            cands = entry.model.by_name.get(fn.id, [])
+            cls = info.cls if info is not None else None
+            return [(entry, fi) for fi in cands if fi.cls is None or fi.cls == cls]
+        if isinstance(fn, ast.Attribute):
+            d = _dotted(fn)
+            if d is None:
+                return []
+            if len(d) == 2 and d[0] == "self" and info is not None and info.cls is not None:
+                return [(entry, fi) for fi in entry.model.by_name.get(d[1], []) if fi.cls == info.cls]
+            # alias.sym(...) — or a dotted module path ending in .sym(...)
+            head = entry.module_aliases.get(d[0])
+            if head is not None:
+                modname = ".".join([head] + d[1:-1])
+                return self._lookup(modname, d[-1])
+            modname = ".".join(d[:-1])
+            if modname in self.by_module:
+                return self._lookup(modname, d[-1])
+        return []
+
+    def imports_of(self, entry: ModuleEntry) -> Dict[str, Tuple[str, str]]:
+        return entry.imports
+
+    # ------------------------------------------------------------------------ propagation
+    def _traced_names(self, entry: ModuleEntry, info: _FuncInfo) -> Tuple[Set[str], Set[str]]:
+        key = (info.jit, tuple(sorted(info.extra_traced)))
+        cached = self._tn_cache.get(id(info))
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        result = entry.model.traced_names(info)
+        self._tn_cache[id(info)] = (key, result)
+        return result
+
+    @staticmethod
+    def _is_memoized(info: _FuncInfo) -> bool:
+        for dec in info.node.decorator_list:
+            name = _final_name(dec.func) if isinstance(dec, ast.Call) else _final_name(dec)
+            if name in _MEMO_DECORATORS:
+                return True
+        return False
+
+    @staticmethod
+    def _is_name_hot(info: _FuncInfo) -> bool:
+        return info.name in _HOT_EXACT or info.name.startswith(_HOT_PREFIXES)
+
+    @staticmethod
+    def _positional_params(info: _FuncInfo) -> List[str]:
+        args = info.node.args
+        return [a.arg for a in args.posonlyargs + args.args if a.arg not in ("self", "cls")]
+
+    def _local_donators(self, entry: ModuleEntry, info: _FuncInfo) -> Dict[str, Set[int]]:
+        """Names bound to donating callables inside ``info`` (literal jit/AOT + param marks)."""
+        found: Dict[str, Set[int]] = {p: set(nums) for p, nums in info.donating_params.items()}
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            nums = _donating_argnums(node.value)
+            if nums is None and isinstance(node.value, ast.Call) \
+                    and _final_name(node.value.func) == "aot_compile":
+                nums = _aot_compile_donations(node.value)
+            if nums:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        found[t.id] = set(nums)
+        return found
+
+    def _propagate(self) -> None:
+        # module-scope trace wrappers over imported functions: jax.jit(imported_fn, ...)
+        for entry in self.entries:
+            for node in ast.walk(entry.tree):
+                if not (isinstance(node, ast.Call) and _final_name(node.func) in _TRACE_WRAPPERS):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id in entry.imports:
+                        for tentry, tinfo in self._lookup(*entry.imports[sub.id]):
+                            if not tinfo.jit:
+                                # a direct wrap IS a root: every non-static param traces
+                                tinfo.jit = tinfo.jit_root = True
+                                tinfo.via = (f"{entry.path}::<wrap>",)
+        for _ in range(_MAX_SWEEPS):
+            if not self._sweep():
+                break
+        # re-run each module's intra-module jit closure so nested defs and plain local
+        # calls inside newly-marked functions inherit the context (idempotent)
+        for entry in self.entries:
+            entry.model._propagate_jit()
+
+    def _sweep(self) -> bool:
+        changed = False
+        for entry in self.entries:
+            for info in entry.model.functions:
+                calls = [n for n in _scoped_walk(info.node) if isinstance(n, ast.Call)]
+                if not calls:
+                    continue
+                traced, jit_callables = self._traced_names(entry, info)
+                donators = self._local_donators(entry, info)
+                hot = (not info.jit) and (info.hot or self._is_name_hot(info))
+                qual = f"{entry.path}::{info.qualname}"
+                guard_spans = entry.model.config_guard_spans(info)
+                for call in calls:
+                    targets = self.resolve_call(entry, info, call)
+                    if not targets:
+                        continue
+                    # config-gated (eager-by-contract) call sites never carry jit context
+                    guarded = any(lo <= call.lineno <= hi for lo, hi in guard_spans)
+                    for tentry, tinfo in targets:
+                        if tinfo is info:
+                            continue
+                        # jit context flows caller -> callee
+                        if info.jit and not tinfo.jit and not guarded:
+                            tinfo.jit = True
+                            tinfo.via = (info.via or ()) + (qual,)
+                            changed = True
+                        # hot (eager per-step) context, minus memoized helpers
+                        if hot and not tinfo.jit and not tinfo.hot \
+                                and not self._is_name_hot(tinfo) and not self._is_memoized(tinfo):
+                            tinfo.hot = True
+                            tinfo.hot_via = (info.hot_via or ()) + (qual,)
+                            changed = True
+                        params = self._positional_params(tinfo)
+                        kwonly = {a.arg for a in tinfo.node.args.kwonlyargs}
+                        # device values at call sites seed the callee's dataflow
+                        for i, arg in enumerate(call.args):
+                            if isinstance(arg, ast.Starred) or i >= len(params):
+                                continue
+                            p = params[i]
+                            if p in tinfo.extra_traced or p in tinfo.static_params:
+                                continue
+                            if _is_device_expr(arg, traced, jit_callables):
+                                tinfo.extra_traced.add(p)
+                                changed = True
+                        for kw in call.keywords:
+                            if kw.arg is None or (kw.arg not in params and kw.arg not in kwonly):
+                                continue
+                            if kw.arg in tinfo.extra_traced or kw.arg in tinfo.static_params:
+                                continue
+                            if _is_device_expr(kw.value, traced, jit_callables):
+                                tinfo.extra_traced.add(kw.arg)
+                                changed = True
+                        # donating callables handed across the boundary
+                        for i, arg in enumerate(call.args):
+                            if not (isinstance(arg, ast.Name) and arg.id in donators):
+                                continue
+                            if i >= len(params):
+                                continue
+                            p = params[i]
+                            have = tinfo.donating_params.get(p, set())
+                            want = donators[arg.id]
+                            if not want <= have:
+                                tinfo.donating_params[p] = have | want
+                                if tinfo.via is None:
+                                    tinfo.via = (info.via or ()) + (qual,)
+                                changed = True
+        return changed
+
+    # ----------------------------------------------------------------------- fingerprints
+    def marks_fingerprint(self, entry: ModuleEntry) -> str:
+        """Stable digest input of every interprocedural mark affecting this module.
+
+        A cached per-module finding list is valid iff the module's source digest AND this
+        fingerprint both match — marks are pure functions of the whole tree, so equal
+        fingerprints guarantee equal rule output for an unchanged file.
+        """
+        rows: List[str] = []
+        for info in entry.model.functions:
+            added_jit = info.jit and info.qualname not in entry.base_jit
+            if not (added_jit or info.extra_traced or info.hot or info.donating_params):
+                continue
+            rows.append(
+                f"{info.qualname}|jit={int(added_jit)}|via={','.join(info.via or ())}"
+                f"|tr={','.join(sorted(info.extra_traced))}|hot={int(info.hot)}"
+                f"|hv={','.join(info.hot_via or ())}"
+                f"|don={sorted((p, tuple(sorted(n))) for p, n in info.donating_params.items())!r}"
+            )
+        rows.append(f"donators={sorted((k, tuple(sorted(v))) for k, v in self.donators.items())!r}")
+        rows.append(f"barriers={sorted(self.barriers)!r}")
+        return "\n".join(rows)

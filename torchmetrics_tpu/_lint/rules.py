@@ -34,30 +34,132 @@ TPU010    host-side Python loop calling ``.update()``/``.forward()`` over a
           dict/list of Metric instances (per-key loop — use KeyedMetric)
 TPU011    full-state allgather (``gather_all_arrays``/``process_allgather``/…)
           on a metric that declared a sharded spec (re-replicates every shard)
+TPU012    donation-lifetime race: a donated buffer (or a sibling alias of one)
+          is read after dispatch and before the commit/recover seam
+TPU013    sharding consistency: hand-mutation of ``.shard()``-placed state
+          without ``with_sharding_constraint``, or a shard-order-dependent
+          float fold over gathered/cat state
 ========  ======================================================================
+
+**Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
+per-module pass): a :class:`_FuncInfo` can carry ``via`` (the cross-module call path that
+put it in jit context), ``extra_traced`` (parameters that receive device values at some
+call site), ``hot``/``hot_via`` (reached from an eager per-step entry point), and
+``donating_params`` (parameters bound to donating callables at call sites). Rules consume
+the marks exactly like locally-inferred facts, and append the ``via:`` call path to their
+messages — a per-module run (``analyze_source``) has no marks, so its behaviour is
+unchanged; the whole-program run is strictly more informed.
 """
 from __future__ import annotations
 
 import ast
+import re
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from torchmetrics_tpu._lint.core import Finding
 
-#: rule id -> one-line description (surfaced by ``--list-rules`` and the SARIF export).
-RULES: Dict[str, str] = {
-    "TPU000": "file does not parse (analyzer cannot run)",
-    "TPU001": "host-sync coercion (.item()/float()/int()/bool()) on a device array value",
-    "TPU002": "data-dependent Python if/while on a traced array inside jit",
-    "TPU003": "host numpy op applied to a traced value inside jit",
-    "TPU004": "jit call-site leaves config parameters non-static (retrace churn)",
-    "TPU005": "add_state reduction/dtype mismatch (overflow or non-additive update)",
-    "TPU006": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
-    "TPU007": "value read after being donated to a compiled dispatch (deleted buffer)",
-    "TPU008": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
-    "TPU009": "telemetry/obs registry call inside jit-traced code (runs at trace time only)",
-    "TPU010": "host-side per-key Metric update loop (one dispatch per key - use KeyedMetric)",
-    "TPU011": "full-state allgather on sharded metric state (re-replicates every shard)",
+#: rule id -> metadata record driving ``--list-rules``, the SARIF export, and the
+#: generated catalog table in ``docs/static-analysis.md`` (``_lint/catalog.py``; the
+#: doc-sync test fails when the table drifts from this registry). Severities: ``error``
+#: = wrong results or a crash, ``warning`` = silently-degraded semantics, ``perf`` =
+#: correct but measurably slower.
+RULE_META: Dict[str, Dict[str, str]] = {
+    "TPU000": {
+        "severity": "error",
+        "summary": "file does not parse (analyzer cannot run)",
+        "example": "def f(:",
+        "fix": "fix the syntax error; every other rule is blind until the file parses",
+    },
+    "TPU001": {
+        "severity": "perf",
+        "summary": "host-sync coercion (.item()/float()/int()/bool()) on a device array value",
+        "example": "return float(jnp.mean(x))",
+        "fix": "read once via jax.device_get(...) — the sync stays, but explicit and counted",
+    },
+    "TPU002": {
+        "severity": "error",
+        "summary": "data-dependent Python if/while on a traced array inside jit",
+        "example": "if x.sum() > 0: ...",
+        "fix": "lower the branch into the program (jnp.where / lax.cond) or declare the"
+               " driver in static_argnames",
+    },
+    "TPU003": {
+        "severity": "error",
+        "summary": "host numpy op applied to a traced value inside jit",
+        "example": "np.log(x)  # x traced",
+        "fix": "use the jnp equivalent, or hoist the op out of the traced region",
+    },
+    "TPU004": {
+        "severity": "perf",
+        "summary": "jit call-site leaves config parameters non-static (retrace churn)",
+        "example": "jax.jit(kernel)  # kernel(x, mode='fast')",
+        "fix": "declare str/bool config parameters in static_argnames",
+    },
+    "TPU005": {
+        "severity": "error",
+        "summary": "add_state reduction/dtype mismatch (overflow or non-additive update)",
+        "example": "self.add_state('count', jnp.asarray(0), dist_reduce_fx='sum')",
+        "fix": "zero defaults + wide dtypes for sums, ±inf identities for min/max,"
+               " accumulate (never assign) sum-reduced states",
+    },
+    "TPU006": {
+        "severity": "perf",
+        "summary": "fresh jnp constant built inside a per-step hot path (constant re-upload)",
+        "example": "def forward(self, x): return x + jnp.zeros((4,))",
+        "fix": "hoist the constant to a module/instance-level value built once",
+    },
+    "TPU007": {
+        "severity": "error",
+        "summary": "value read after being donated to a compiled dispatch (deleted buffer)",
+        "example": "out = step(state, b); state.sum()",
+        "fix": "rebind the name to the dispatch output, or drop donate_argnums for it",
+    },
+    "TPU008": {
+        "severity": "warning",
+        "summary": "bare assert on a traced value inside jit (compiled away - a validation no-op)",
+        "example": "assert jnp.all(x >= 0)",
+        "fix": "hoist the check to the eager host path, or fold it into the graph"
+               " (nan_policy / a counted guard state)",
+    },
+    "TPU009": {
+        "severity": "warning",
+        "summary": "telemetry/obs registry call inside jit-traced code (runs at trace time only)",
+        "example": "obs.bump(self, 'calls')  # inside _update",
+        "fix": "instrument the eager caller; fold per-step quantities into the program"
+               " as a state output",
+    },
+    "TPU010": {
+        "severity": "perf",
+        "summary": "host-side per-key Metric update loop (one dispatch per key - use KeyedMetric)",
+        "example": "for uid, m in per_user.items(): m.update(v[uid])",
+        "fix": "route the mixed-key batch through keyed.KeyedMetric(template, num_keys=N)",
+    },
+    "TPU011": {
+        "severity": "perf",
+        "summary": "full-state allgather on sharded metric state (re-replicates every shard)",
+        "example": "gather_all_arrays(km.metric_state['v'])  # km.shard()-ed",
+        "fix": "let compute()/process_sync drive the reduce-scatter sharded sync",
+    },
+    "TPU012": {
+        "severity": "error",
+        "summary": "donation-lifetime race: donated buffer (or sibling alias) read before re-commit",
+        "example": "alias = state; out = step(state, b); alias.sum()",
+        "fix": "read only after the commit/recover seam (commit_step / commit_donated),"
+               " and never through a pre-donation alias",
+    },
+    "TPU013": {
+        "severity": "error",
+        "summary": "sharded-state consistency: hand mutation without with_sharding_constraint,"
+                   " or shard-order-dependent float fold",
+        "example": "m.shard(mesh); m.metric_state['v'] = jnp.zeros_like(v)",
+        "fix": "mutate through the engine's kernels (closed under sharding constraints);"
+               " make cross-shard float folds order-fixed before reducing",
+    },
 }
+
+#: rule id -> one-line description (derived view of :data:`RULE_META`; kept for the CLI,
+#: the SARIF export, and callers that predate the metadata registry).
+RULES: Dict[str, str] = {rid: meta["summary"] for rid, meta in RULE_META.items()}
 
 # wrapper callables whose function arguments execute under tracing
 _TRACE_WRAPPERS = {
@@ -134,7 +236,12 @@ _NOT_CONST = object()
 
 
 class _FuncInfo:
-    __slots__ = ("node", "name", "parent", "cls", "jit", "static_params", "children")
+    __slots__ = (
+        "node", "name", "parent", "cls", "jit", "jit_root", "static_params", "children",
+        # interprocedural marks — empty/None after the per-module pass; populated only by
+        # the whole-program pass (project.py), consumed by the rules below
+        "via", "extra_traced", "hot", "hot_via", "donating_params",
+    )
 
     def __init__(self, node, name, parent, cls):
         self.node = node
@@ -142,19 +249,56 @@ class _FuncInfo:
         self.parent: Optional["_FuncInfo"] = parent
         self.cls: Optional[str] = cls
         self.jit = False
+        #: True when jit context is intrinsic (decorator / wrapper ref / engine
+        #: convention) — every non-static parameter is traced. Propagated callees
+        #: (jit=True, jit_root=False) trace only the parameters observed to receive
+        #: device values at call sites (``extra_traced``): a helper's host-config
+        #: arguments stay static even though the helper runs under the caller's trace.
+        self.jit_root = False
         self.static_params: Set[str] = set()
         self.children: List["_FuncInfo"] = []
+        #: cross-module call path that put this function in jit context, e.g.
+        #: ("metric.py::Metric._update", "helpers.py::fold") — None when jit was local
+        self.via: Optional[Tuple[str, ...]] = None
+        #: parameter names that receive a device/traced value at some call site
+        self.extra_traced: Set[str] = set()
+        #: reached (transitively) from an eager per-step entry point
+        self.hot = False
+        self.hot_via: Optional[Tuple[str, ...]] = None
+        #: parameter name -> donated positions, for donating callables received as args
+        self.donating_params: Dict[str, Set[int]] = {}
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _via_suffix(via: Optional[Tuple[str, ...]]) -> str:
+    """Render an interprocedural call path for a finding message ('' per-module)."""
+    if not via:
+        return ""
+    return f" [via: {' -> '.join(via)}]"
 
 
 class _ModuleModel:
-    """Per-file inference shared by every rule: functions, classes, jit context, call graph."""
+    """Per-file inference shared by every rule: functions, classes, jit context, call graph.
 
-    def __init__(self, tree: ast.Module) -> None:
+    ``extra_flags_off`` injects class-level ``jit_update``/``jit_compute`` opt-outs the
+    per-module pass cannot see (flags inherited from bases defined in OTHER modules) —
+    the project pass resolves those and rebuilds the model with them, so convention-jit
+    marking honors the true runtime contract.
+    """
+
+    def __init__(
+        self, tree: ast.Module, extra_flags_off: Optional[Dict[str, Set[str]]] = None
+    ) -> None:
         self.tree = tree
         self.functions: List[_FuncInfo] = []
         self.by_name: Dict[str, List[_FuncInfo]] = {}
         self.class_nodes: Dict[str, ast.ClassDef] = {}
         self.class_flags_off: Dict[str, Set[str]] = {}  # class -> {"jit_update", ...} set False
+        self._extra_flags_off = extra_flags_off or {}
+        self._dead_spans: Dict[int, List[Tuple[int, int]]] = {}
         self._collect(tree, parent=None, cls=None)
         self._detect_class_flags()
         self._mark_jit_roots()
@@ -203,7 +347,7 @@ class _ModuleModel:
                         name = t.attr
                     if name in ("jit_update", "jit_compute"):
                         off.add(name)
-            self.class_flags_off[cname] = off
+            self.class_flags_off[cname] = off | self._extra_flags_off.get(cname, set())
         # one inheritance sweep per depth level (module class chains are shallow)
         for _ in range(len(self.class_nodes)):
             changed = False
@@ -219,18 +363,24 @@ class _ModuleModel:
                 break
 
     def _resolve_refs(self, call: ast.Call) -> List[_FuncInfo]:
-        """Local function defs referenced (by name or ``self.attr``) inside a wrapper call."""
+        """Local function defs referenced (by name or ``self.attr``) inside a wrapper call.
+
+        Only the call's ARGUMENTS are searched — the callee expression itself is not a
+        reference (``self.checkpoint(...)`` calls a method that happens to share a
+        wrapper's name; it does not hand it to a tracer).
+        """
         refs: List[_FuncInfo] = []
-        for sub in ast.walk(call):
-            if isinstance(sub, ast.Name) and sub.id in self.by_name:
-                refs.extend(self.by_name[sub.id])
-            elif (
-                isinstance(sub, ast.Attribute)
-                and isinstance(sub.value, ast.Name)
-                and sub.value.id == "self"
-                and sub.attr in self.by_name
-            ):
-                refs.extend(fi for fi in self.by_name[sub.attr] if fi.cls is not None)
+        for root in [*call.args, *(kw.value for kw in call.keywords)]:
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Name) and sub.id in self.by_name:
+                    refs.extend(self.by_name[sub.id])
+                elif (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr in self.by_name
+                ):
+                    refs.extend(fi for fi in self.by_name[sub.attr] if fi.cls is not None)
         return refs
 
     @staticmethod
@@ -279,7 +429,7 @@ class _ModuleModel:
             for dec in info.node.decorator_list:
                 wrap = self._jit_wrap_of_decorator(dec)
                 if wrap is not None:
-                    info.jit = True
+                    info.jit = info.jit_root = True
                     info.static_params |= wrap[0]
                     info.static_params |= self._argnums_to_names(info.node, wrap[1])
         # (2) wrapper-call roots: jax.jit(f, ...), jax.vmap(f), lax.scan(body, ...), ...
@@ -291,7 +441,7 @@ class _ModuleModel:
                 continue
             statics = self._statics_from_keywords(node.keywords) if fn in ("jit", "pjit") else set()
             for ref in self._resolve_refs(node):
-                ref.jit = True
+                ref.jit = ref.jit_root = True
                 ref.static_params |= statics
         # (3) engine-convention roots (Metric shell jits these)
         for info in self.functions:
@@ -300,7 +450,7 @@ class _ModuleModel:
             flag = _CONVENTION_JIT[info.name]
             if flag is not None and flag in self.class_flags_off.get(info.cls, set()):
                 continue
-            info.jit = True
+            info.jit = info.jit_root = True
 
     @staticmethod
     def _argnums_to_names(node: ast.AST, nums: Set[int]) -> Set[str]:
@@ -308,13 +458,21 @@ class _ModuleModel:
         return {params[i] for i in nums if 0 <= i < len(params)}
 
     def _propagate_jit(self) -> None:
-        """Flow jit context through plain / ``self.method`` calls and into nested defs."""
+        """Flow jit context through plain / ``self.method`` calls and into nested defs.
+
+        Callees gain jit context WITHOUT becoming roots: the traced seed of a propagated
+        callee is the set of parameters that receive a device expression at some call
+        site (bound here positionally and by keyword), so a helper's host-config
+        arguments stay static under the caller's trace.
+        """
         changed = True
         while changed:
             changed = False
             for info in self.functions:
                 if not info.jit:
                     continue
+                traced, jit_callables = self.traced_names(info)
+                guard_spans = self.config_guard_spans(info)
                 for child in info.children:
                     if not child.jit:
                         child.jit = True
@@ -322,6 +480,8 @@ class _ModuleModel:
                 for node in _scoped_walk(info.node):
                     if not isinstance(node, ast.Call):
                         continue
+                    if any(lo <= node.lineno <= hi for lo, hi in guard_spans):
+                        continue  # eager-by-contract (config-gated) call site
                     callees: List[_FuncInfo] = []
                     if isinstance(node.func, ast.Name) and node.func.id in self.by_name:
                         callees = [fi for fi in self.by_name[node.func.id] if fi.cls is None or fi.cls == info.cls]
@@ -333,22 +493,58 @@ class _ModuleModel:
                     ):
                         callees = [fi for fi in self.by_name[node.func.attr] if fi.cls == info.cls]
                     for callee in callees:
+                        if callee is info:
+                            continue
                         if not callee.jit:
                             callee.jit = True
                             changed = True
+                        if self._bind_call_args(node, callee, traced, jit_callables):
+                            changed = True
+
+    @staticmethod
+    def _bind_call_args(
+        call: ast.Call, callee: "_FuncInfo", traced: Set[str], jit_callables: Set[str]
+    ) -> bool:
+        """Mark callee parameters bound to device expressions at this call site."""
+        args = callee.node.args
+        params = [a.arg for a in args.posonlyargs + args.args if a.arg not in ("self", "cls")]
+        kwonly = {a.arg for a in args.kwonlyargs}
+        changed = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred) or i >= len(params):
+                continue
+            p = params[i]
+            if p in callee.extra_traced or p in callee.static_params:
+                continue
+            if _is_device_expr(arg, traced, jit_callables):
+                callee.extra_traced.add(p)
+                changed = True
+        for kw in call.keywords:
+            if kw.arg is None or (kw.arg not in params and kw.arg not in kwonly):
+                continue
+            if kw.arg in callee.extra_traced or kw.arg in callee.static_params:
+                continue
+            if _is_device_expr(kw.value, traced, jit_callables):
+                callee.extra_traced.add(kw.arg)
+                changed = True
+        return changed
 
     # ------------------------------------------------------------------- per-function facts
     def traced_names(self, info: _FuncInfo) -> Tuple[Set[str], Set[str]]:
         """(traced value names, locally-jitted callable names) for one function body.
 
-        Traced seeds: in jit context, every parameter that is not ``self``/``cls``, not in
-        ``static_argnames``, and has no constant (str/bool/number) default. In eager context
-        parameters are NOT assumed traced — only dataflow from device-producing calls is.
+        Traced seeds: in a jit ROOT (decorator / wrapper ref / engine convention), every
+        parameter that is not ``self``/``cls``, not in ``static_argnames``, and has no
+        constant (str/bool/number) default. A propagated-jit callee (reached from a root
+        through the call graph) traces only the parameters observed to receive device
+        values at call sites (``extra_traced``) — its host-config arguments stay static.
+        In eager context parameters are NOT assumed traced — only dataflow from
+        device-producing calls is.
         """
         traced: Set[str] = set()
         jit_callables: Set[str] = set()
         args = info.node.args
-        if info.jit:
+        if info.jit and info.jit_root:
             params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
             if args.vararg:
                 params.append(args.vararg.arg)
@@ -366,6 +562,9 @@ class _ModuleModel:
                 p for p in params
                 if p not in ("self", "cls") and p not in info.static_params and p not in defaulted
             }
+        # interprocedural mark: parameters observed to receive device values at call
+        # sites (project pass) seed the dataflow even in eager context
+        traced |= info.extra_traced
         # dataflow fixpoint over assignments (source order is irrelevant to the fixpoint)
         assigns: List[Tuple[List[ast.AST], ast.AST]] = []
         for node in _scoped_walk(info.node):
@@ -394,6 +593,103 @@ class _ModuleModel:
             if not changed:
                 break
         return traced, jit_callables
+
+    # -------------------------------------------------------------------- trace-dead code
+    def trace_dead_spans(self, info: _FuncInfo) -> List[Tuple[int, int]]:
+        """Line spans of ``info`` that can NEVER execute under jax tracing.
+
+        The repo's sanctioned eager-only idioms, modeled so jit-context rules do not
+        flag code the trace provably skips:
+
+        - ``if is_traced(...): return`` as a function-body statement — everything after
+          the guard is eager-only (the tracer returns at the top);
+        - the body of any ``if`` whose test contains a ``not is_traced(...)`` conjunct —
+          under trace the guard short-circuits False before the body runs;
+        - operands FOLLOWING ``not is_traced(x)`` inside an ``and`` chain — Python's
+          short-circuit means they only evaluate eagerly (``not is_traced(x) and
+          float(x) < 2`` never coerces a tracer).
+        """
+        cached = self._dead_spans.get(id(info))
+        if cached is not None:
+            return cached
+        spans: List[Tuple[int, int]] = []
+        fn_end = getattr(info.node, "end_lineno", None) or info.node.lineno
+        for i, stmt in enumerate(info.node.body):
+            if (
+                isinstance(stmt, ast.If)
+                and isinstance(stmt.test, ast.Call)
+                and _final_name(stmt.test.func) == "is_traced"
+                and any(isinstance(s, ast.Return) for s in stmt.body)
+            ):
+                start = (getattr(stmt, "end_lineno", None) or stmt.lineno) + 1
+                if start <= fn_end:
+                    spans.append((start, fn_end))
+                break
+        for node in _scoped_walk(info.node):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                guarded = _is_trace_guard(test) or (
+                    isinstance(test, ast.BoolOp)
+                    and isinstance(test.op, ast.And)
+                    and any(_is_trace_guard(v) for v in test.values)
+                )
+                if guarded and node.body:
+                    spans.append((
+                        node.body[0].lineno,
+                        getattr(node.body[-1], "end_lineno", None) or node.body[-1].lineno,
+                    ))
+            if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.And):
+                for i, v in enumerate(node.values):
+                    if _is_trace_guard(v) and i + 1 < len(node.values):
+                        tail = node.values[i + 1:]
+                        spans.append((
+                            tail[0].lineno,
+                            getattr(tail[-1], "end_lineno", None) or tail[-1].lineno,
+                        ))
+                        break
+        self._dead_spans[id(info)] = spans
+        return spans
+
+    def is_trace_dead(self, info: _FuncInfo, node: ast.AST) -> bool:
+        line = getattr(node, "lineno", 0)
+        return any(lo <= line <= hi for lo, hi in self.trace_dead_spans(info))
+
+    def config_guard_spans(self, info: _FuncInfo) -> List[Tuple[int, int]]:
+        """Spans of ``if <bool config param>:`` bodies — eager-by-contract call sites.
+
+        The repo's functional APIs gate validation behind ``validate_args: bool = True``;
+        a jit caller disables it (``jax.jit(lambda p, t: f(p, t, validate_args=False))``),
+        so jit context must NOT propagate into calls under such a guard: the guarded
+        helpers run eagerly or not at all. Only a bare boolean-defaulted/annotated
+        parameter (or its negation) counts — data-dependent tests never match.
+        """
+        bool_params: Set[str] = set()
+        args = info.node.args
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            if isinstance(_const_value(d), bool):
+                bool_params.add(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and isinstance(_const_value(d), bool):
+                bool_params.add(a.arg)
+        for a in pos + args.kwonlyargs:
+            if a.annotation is not None and _final_name(a.annotation) == "bool":
+                bool_params.add(a.arg)
+        if not bool_params:
+            return []
+        spans: List[Tuple[int, int]] = []
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.If) or not node.body:
+                continue
+            test = node.test
+            if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+                test = test.operand
+            if isinstance(test, ast.Name) and test.id in bool_params:
+                spans.append((
+                    node.body[0].lineno,
+                    getattr(node.body[-1], "end_lineno", None) or node.body[-1].lineno,
+                ))
+        return spans
 
     @staticmethod
     def _target_names(targets: Sequence[ast.AST]) -> Iterator[str]:
@@ -450,14 +746,30 @@ def _is_device_expr(node: ast.AST, traced: Set[str], jit_callables: Set[str]) ->
     return False
 
 
+def _is_trace_guard(node: ast.AST) -> bool:
+    """``not is_traced(x)`` — the conjunct that makes an eager-only check trace-dead."""
+    return (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.Not)
+        and isinstance(node.operand, ast.Call)
+        and _final_name(node.operand.func) == "is_traced"
+    )
+
+
 def _branches_on_traced(node: ast.AST, traced: Set[str], jit_callables: Set[str]) -> bool:
     """Does this if/while test make a data-dependent decision on a traced value?
 
     Trace-safe constructs are excluded: ``is``/``in`` comparisons (identity and dict-key
     membership are host decisions), comparisons against string literals (config dispatch),
-    shape/dtype attribute reads, and host predicates (``len``/``isinstance``/…).
+    shape/dtype attribute reads, host predicates (``len``/``isinstance``/…), explicit
+    ``jax.device_get`` reads (the sanctioned, counted sync), and conjunctions guarded by
+    ``not is_traced(...)`` — the repo's idiom for eager-only checks, which are dead under
+    trace by construction (``is_traced`` returns True for tracers, so the guard
+    short-circuits before the data-dependent operand ever evaluates).
     """
     if isinstance(node, ast.BoolOp):
+        if isinstance(node.op, ast.And) and any(_is_trace_guard(v) for v in node.values):
+            return False
         return any(_branches_on_traced(v, traced, jit_callables) for v in node.values)
     if isinstance(node, ast.UnaryOp):
         return _branches_on_traced(node.operand, traced, jit_callables)
@@ -470,7 +782,7 @@ def _branches_on_traced(node: ast.AST, traced: Set[str], jit_callables: Set[str]
         return any(_branches_on_traced(c, traced, jit_callables) for c in operands)
     if isinstance(node, ast.Call):
         fn = _final_name(node.func)
-        if fn in _STATIC_CALLS or fn in _HOST_FINAL:
+        if fn in _STATIC_CALLS or fn in _HOST_FINAL or fn == "device_get":
             return False
         if _is_device_expr(node, traced, jit_callables):  # covers x.sum(), jnp.any(x), ...
             return True
@@ -497,8 +809,13 @@ def _rule_tpu001(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
         traced, jit_callables = model.traced_names(info)
         where = "inside jit-traced code (fails or constant-folds at trace time)" if info.jit \
             else "in eager per-call code (blocking device→host round-trip)"
+        sfx = _via_suffix(info.via)
         for node in _scoped_walk(info.node):
             if not isinstance(node, ast.Call):
+                continue
+            # guarded eager-only region: the `is_traced` guard IS the sanctioned,
+            # deliberate host read — flagging it would punish the recommended idiom
+            if model.is_trace_dead(info, node):
                 continue
             # x.item()
             if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
@@ -509,7 +826,7 @@ def _rule_tpu001(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                     out.append(_finding(
                         "TPU001", path, node, lines,
                         f".item() on an array value {where}; read once via jax.device_get(...)"
-                        " and keep per-step code device-only",
+                        f" and keep per-step code device-only{sfx}",
                     ))
                 continue
             # float(x) / int(x) / bool(x) / complex(x)
@@ -520,7 +837,7 @@ def _rule_tpu001(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                     out.append(_finding(
                         "TPU001", path, node, lines,
                         f"{node.func.id}() coerces a device array value to a host scalar {where};"
-                        " use jax.device_get(...) for a deliberate, counted sync",
+                        f" use jax.device_get(...) for a deliberate, counted sync{sfx}",
                     ))
     return out
 
@@ -534,15 +851,38 @@ def _rule_tpu002(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
         if not traced:
             continue
         for node in _scoped_walk(info.node):
-            if isinstance(node, (ast.If, ast.While)) and _branches_on_traced(node.test, traced, jit_callables):
+            if isinstance(node, (ast.If, ast.While)) and not model.is_trace_dead(info, node) \
+                    and _branches_on_traced(node.test, traced, jit_callables):
                 kw = "while" if isinstance(node, ast.While) else "if"
                 out.append(_finding(
                     "TPU002", path, node, lines,
                     f"data-dependent Python `{kw}` on a traced value inside jit-traced"
                     f" {info.name!r}; use jnp.where/lax.cond (or declare the driving argument"
-                    " in static_argnames)",
+                    f" in static_argnames){_via_suffix(info.via)}",
                 ))
     return out
+
+
+def _guarded_try_spans(info: _FuncInfo) -> List[Tuple[int, int]]:
+    """Line spans of ``try`` bodies whose handlers catch ``Exception`` (or everything).
+
+    A host-numpy call wrapped this way is the deliberate concretize-or-bail idiom: on a
+    tracer the conversion raises, the handler takes the traced path, and the eager path
+    gets the host value — trace-safe by construction.
+    """
+    spans: List[Tuple[int, int]] = []
+    for node in _scoped_walk(info.node):
+        if not isinstance(node, ast.Try):
+            continue
+        broad = any(
+            h.type is None or _final_name(h.type) == "Exception" for h in node.handlers
+        )
+        if broad and node.body:
+            spans.append((
+                node.body[0].lineno,
+                getattr(node.body[-1], "end_lineno", None) or node.body[-1].lineno,
+            ))
+    return spans
 
 
 def _rule_tpu003(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
@@ -553,11 +893,16 @@ def _rule_tpu003(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
         traced, jit_callables = model.traced_names(info)
         if not traced:
             continue
+        try_spans = _guarded_try_spans(info)
         for node in _scoped_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
             dotted = _dotted(node.func)
             if dotted is None or dotted[0] not in ("np", "numpy") or len(dotted) < 2:
+                continue
+            if model.is_trace_dead(info, node) or any(
+                lo <= node.lineno <= hi for lo, hi in try_spans
+            ):
                 continue
             arg_nodes = [*node.args, *(kw.value for kw in node.keywords)]
             if any(_is_device_expr(a, traced, jit_callables) for a in arg_nodes):
@@ -565,7 +910,7 @@ def _rule_tpu003(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                     "TPU003", path, node, lines,
                     f"host numpy op {'.'.join(dotted)}(...) applied to a traced value inside"
                     f" jit-traced {info.name!r}; use the jnp equivalent or hoist the op out of"
-                    " the traced region",
+                    f" the traced region{_via_suffix(info.via)}",
                 ))
     return out
 
@@ -809,9 +1154,10 @@ def _rule_tpu006(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     for info in model.functions:
         if info.jit:
             continue  # inside jit, constants are baked into the compiled program — free
-        hot = info.name in _HOT_EXACT or info.name.startswith(_HOT_PREFIXES)
+        hot = info.hot or info.name in _HOT_EXACT or info.name.startswith(_HOT_PREFIXES)
         if not hot:
             continue
+        sfx = _via_suffix(info.hot_via)
         for node in _scoped_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -824,7 +1170,7 @@ def _rule_tpu006(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                     "TPU006", path, node, lines,
                     f"fresh device constant {'.'.join(dotted)}(...) built inside per-step hot"
                     f" path {info.name!r} — one host→device upload per call; hoist it to a"
-                    " module/instance-level constant built once",
+                    f" module/instance-level constant built once{sfx}",
                 ))
     return out
 
@@ -938,7 +1284,7 @@ def _rule_tpu008(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
         if not traced:
             continue
         for node in _scoped_walk(info.node):
-            if not isinstance(node, ast.Assert):
+            if not isinstance(node, ast.Assert) or model.is_trace_dead(info, node):
                 continue
             if _branches_on_traced(node.test, traced, jit_callables):
                 out.append(_finding(
@@ -946,7 +1292,7 @@ def _rule_tpu008(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                     f"bare `assert` on a traced value inside jit-traced {info.name!r} — the"
                     " test is compiled away (or crashes the trace), so it validates nothing"
                     " at runtime; hoist the check to the eager host path or fold it into the"
-                    " graph (jnp.where / a counted guard state)",
+                    f" graph (jnp.where / a counted guard state){_via_suffix(info.via)}",
                 ))
     return out
 
@@ -973,7 +1319,7 @@ def _rule_tpu009(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
         if not info.jit:
             continue
         for node in _scoped_walk(info.node):
-            if not isinstance(node, ast.Call):
+            if not isinstance(node, ast.Call) or model.is_trace_dead(info, node):
                 continue
             dotted = _dotted(node.func)
             if dotted is None:
@@ -991,7 +1337,8 @@ def _rule_tpu009(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
                 f"telemetry call {hit}(...) inside jit-traced {info.name!r} executes at"
                 " TRACE time only (once per compilation, not per step) — the count/span"
                 " silently stops recording on cached executions; hoist the instrument to"
-                " the eager caller or fold the quantity into the program as a state output",
+                " the eager caller or fold the quantity into the program as a state"
+                f" output{_via_suffix(info.via)}",
             ))
     return out
 
@@ -1109,6 +1456,26 @@ _FULL_GATHER_NAMES = frozenset(
 )
 
 
+def _sharded_names_in(info: _FuncInfo) -> Set[str]:
+    """Names ``.shard(...)``-placed in this function (shared by TPU011 and TPU013)."""
+    sharded: Set[str] = set()
+    for node in _scoped_walk(info.node):
+        call = None
+        targets: List[str] = []
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+        if call is None or not isinstance(call.func, ast.Attribute) or call.func.attr != "shard":
+            continue
+        base = call.func.value
+        if isinstance(base, ast.Name):
+            sharded.add(base.id)
+        sharded.update(targets)  # m = SumMetric().shard(mesh) / m2 = m.shard(mesh)
+    return sharded
+
+
 def _rule_tpu011(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
     """Replicated full-state gather on a metric that declared a sharded spec.
 
@@ -1134,21 +1501,7 @@ def _rule_tpu011(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     """
     out: List[Finding] = []
     for info in model.functions:
-        sharded: Set[str] = set()
-        for node in _scoped_walk(info.node):
-            call = None
-            targets: List[str] = []
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                call = node.value
-                targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-            elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
-                call = node.value
-            if call is None or not isinstance(call.func, ast.Attribute) or call.func.attr != "shard":
-                continue
-            base = call.func.value
-            if isinstance(base, ast.Name):
-                sharded.add(base.id)
-            sharded.update(targets)  # m = SumMetric().shard(mesh) / m2 = m.shard(mesh)
+        sharded = _sharded_names_in(info)
         if not sharded:
             continue
         for node in _scoped_walk(info.node):
@@ -1179,15 +1532,310 @@ def _rule_tpu011(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU012 helpers
+#: calls that END a donated-read window — the engine's commit/recover seams. Defs carrying
+#: the `# jaxlint: donation-commit` marker (ops/dispatch.py) extend this set in project
+#: mode; the built-ins keep single-file analysis of metric.py honest without it.
+_COMMIT_BARRIERS = frozenset({"commit_step", "recover_failed_step", "commit_donated", "abort_donated"})
+_COMMIT_MARKER = "jaxlint: donation-commit"
+#: def-line marker declaring that CALLING this function donates the given positional args
+_DONATES_RE = re.compile(r"#\s*jaxlint:\s*donates\((\d+(?:\s*,\s*\d+)*)\)")
+
+
+def _assign_of(node: ast.AST) -> Tuple[List[ast.AST], Optional[ast.AST]]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets), node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target], node.value
+    if isinstance(node, ast.AugAssign):
+        return [node.target], node.value
+    return [], None
+
+
+def _aot_compile_donations(call: ast.Call) -> Optional[Set[int]]:
+    """Literal donated positions of an ``aot_compile(fn, ex, donate_argnums=...)`` call.
+
+    ``aot_compile`` (ops/dispatch.py) returns a compiled executable that donates exactly
+    the positions its ``donate_argnums`` keyword names — the AOT twin of the jit chain
+    :func:`_donating_argnums` unwraps. Non-literal positions mark the result as donating
+    with nothing trackable (empty set); no keyword means no donation (None).
+    """
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            nums = {el.value for el in v.elts if isinstance(el, ast.Constant) and isinstance(el.value, int)}
+            return nums if len(nums) == len(v.elts) else set()
+        return set()
+    return None
+
+
+def _rule_tpu012(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Donation-lifetime race: donated buffer (or a sibling alias) read before re-commit.
+
+    The static race detector behind the engine's runtime ``StateStore`` generation guard:
+    between handing state buffers to a donating executable and the commit/recover seam
+    (``commit_step`` / ``commit_donated`` / ``recover_failed_step`` / ``abort_donated``,
+    plus any def carrying the ``# jaxlint: donation-commit`` marker), every donated buffer
+    is DELETED — a read in that window raises jax's deleted-array error, or silently reads
+    reclaimed memory on backends that ignore donation.
+
+    What this adds over the literal-only TPU007:
+
+    - **sibling aliases**: ``alias = state`` taken before the donation dies with the
+      donated name; reads through the alias are the under-reported half of TPU007.
+    - **cross-boundary donators**: callables annotated ``# jaxlint: donates(i, ...)`` on
+      their def line (the engine's ``dispatch_step``), ``aot_compile(...,
+      donate_argnums=...)`` results, and — in project mode — parameters that *receive* a
+      donating callable at a call site one or two hops away (``info.donating_params``).
+    - **commit awareness**: reads after the seam are clean (the engine rebinds state
+      through the store there), so the rule models the true hazard window instead of
+      flagging the whole rest of the function.
+    """
+    out: List[Finding] = []
+    annotated: Dict[str, Set[int]] = dict(getattr(model, "project_donators", None) or {})
+    barriers: Set[str] = set(_COMMIT_BARRIERS) | set(getattr(model, "project_barriers", None) or ())
+    for info in model.functions:
+        dl = info.node.lineno
+        src = lines[dl - 1] if 0 < dl <= len(lines) else ""
+        m = _DONATES_RE.search(src)
+        if m:
+            annotated[info.name] = {int(x) for x in m.group(1).split(",")}
+        if _COMMIT_MARKER in src:
+            barriers.add(info.name)
+    # module-scope donating callables (step = jax.jit(k, donate_argnums=...)) are visible
+    # to every function in the file through the closure
+    module_donators: Dict[str, Set[int]] = {}
+    for node in _scoped_walk(model.tree):
+        targets, value = _assign_of(node)
+        if value is None:
+            continue
+        nums = _donating_argnums(value)
+        if nums is None and isinstance(value, ast.Call) and _final_name(value.func) == "aot_compile":
+            nums = _aot_compile_donations(value)
+        if nums:
+            for name in model._target_names(targets):
+                module_donators[name] = set(nums)
+    for info in model.functions:
+        # (1) donating callables visible in this function body (or received as params,
+        # or bound at module scope — closure visibility)
+        donators: Dict[str, Tuple[Set[int], str, Optional[Tuple[str, ...]]]] = {
+            name: (set(nums), "module", None) for name, nums in module_donators.items()
+        }
+        donators.update(
+            (pname, (set(nums), "param", info.via))
+            for pname, nums in info.donating_params.items()
+        )
+        rebinds: Dict[str, List[int]] = {}
+        alias_edges: List[Tuple[str, str, int]] = []
+        for node in _scoped_walk(info.node):
+            targets, value = _assign_of(node)
+            if value is None:
+                continue
+            for name in model._target_names(targets):
+                rebinds.setdefault(name, []).append(node.lineno)
+            if isinstance(value, ast.Name):
+                for name in model._target_names(targets):
+                    alias_edges.append((name, value.id, node.lineno))
+            nums = _donating_argnums(value)
+            kind = "local"
+            if nums is None and isinstance(value, ast.Call) and _final_name(value.func) == "aot_compile":
+                nums = _aot_compile_donations(value)
+                kind = "aot"
+            if nums is not None:
+                for name in model._target_names(targets):
+                    donators[name] = (nums, kind, None)
+        # (2) donation sites and commit barriers (multi-line calls donate at end_lineno)
+        donated: Dict[str, Tuple[int, str, Optional[Tuple[str, ...]]]] = {}
+        barrier_lines: List[int] = []
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _final_name(node.func)
+            if fname in barriers:
+                barrier_lines.append(getattr(node, "end_lineno", None) or node.lineno)
+                continue
+            spec = None
+            if isinstance(node.func, ast.Name) and node.func.id in donators:
+                spec = donators[node.func.id]
+            elif fname in annotated:
+                spec = (annotated[fname], "annotated", None)
+            if spec is None:
+                continue
+            nums, kind, via = spec
+            dline = getattr(node, "end_lineno", None) or node.lineno
+            for idx in nums:
+                if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+                    nm = node.args[idx].id
+                    prev = donated.get(nm)
+                    if prev is None or dline > prev[0]:
+                        donated[nm] = (dline, kind, via)
+        if not donated:
+            continue
+        # (3) close each donated name over aliases established BEFORE its donation
+        watch: Dict[str, Tuple[str, int, str, Optional[Tuple[str, ...]]]] = {}
+        for dname, (dline, kind, via) in donated.items():
+            group = {dname}
+            changed = True
+            while changed:
+                changed = False
+                for a, b, ln in alias_edges:
+                    if ln > dline:
+                        continue
+                    if (a in group) != (b in group):
+                        group |= {a, b}
+                        changed = True
+            for nm in group:
+                if nm == dname and kind == "local":
+                    continue  # the direct read of a locally-jit-donated name is TPU007's
+                prev = watch.get(nm)
+                if prev is None or dline > prev[1]:
+                    watch[nm] = (dname, dline, kind, via)
+        if not watch:
+            continue
+        # (4) reads inside the open window: after donation, before rebind/commit seam
+        for node in _scoped_walk(info.node):
+            if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+                continue
+            spec2 = watch.get(node.id)
+            if spec2 is None:
+                continue
+            dname, dline, kind, via = spec2
+            if node.lineno <= dline:
+                continue
+            if any(dline < rl <= node.lineno for rl in rebinds.get(node.id, ())):
+                continue
+            if any(dline < bl < node.lineno for bl in barrier_lines):
+                continue
+            alias_part = "" if node.id == dname else f" (a pre-donation alias of {dname!r})"
+            out.append(_finding(
+                "TPU012", path, node, lines,
+                f"{node.id!r}{alias_part} reads a buffer donated to a compiled dispatch on"
+                f" line {dline}, before the commit/recover seam — donated buffers are"
+                " deleted by XLA, so the read raises (or returns garbage on backends that"
+                " ignore donation); commit the dispatch outputs first (commit_step /"
+                f" commit_donated) or rebind the name{_via_suffix(via)}",
+            ))
+    return out
+
+
+#: float folds whose result depends on element order (non-associative in float)
+_ORDER_FOLDS = frozenset({"mean", "sum"})
+#: concatenation builders whose cross-shard output order follows placement
+_CAT_BUILDERS = frozenset({"concatenate", "dim_zero_cat", "hstack", "vstack", "stack", "append"})
+
+
+def _rule_tpu013(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Sharding-consistency hazards on ``.shard()``-placed metric state.
+
+    Two shapes, both scoped to functions that called ``.shard(...)`` themselves (the
+    TPU011 boundary — cross-function sharding is invisible by design):
+
+    - **hand mutation without a sharding constraint**: assigning into the placed state
+      (``m.metric_state[...] = v``, ``m._state.tensors[...] = v``, or through a one-hop
+      alias of either) with a value not wrapped in ``with_sharding_constraint``. The
+      engine closes every update kernel under the declared constraints
+      (``_effective_update``); a bare host-side write silently re-replicates the leaf,
+      dropping the mesh layout every compiled tier expects.
+    - **shard-order-dependent float fold**: ``mean``/``sum`` over a concatenation
+      (``jnp.concatenate`` / ``dim_zero_cat`` / stacks) of the sharded object's state —
+      cross-shard cat order follows placement, and float reduction is not associative,
+      so the result changes with mesh shape.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        sharded = _sharded_names_in(info)
+        if not sharded:
+            continue
+        # one-hop state aliases: st = m.metric_state / st = m._state.tensors
+        state_aliases: Set[str] = set()
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Assign):
+                d = _dotted(node.value)
+                if d and d[0] in sharded and len(d) > 1 and d[-1] in ("metric_state", "tensors"):
+                    state_aliases.update(t.id for t in node.targets if isinstance(t, ast.Name))
+        # (a) hand mutation of placed state without with_sharding_constraint
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                d = _dotted(t.value)
+                which = None
+                if d and d[0] in sharded and len(d) > 1 and d[-1] in ("metric_state", "tensors"):
+                    which = d[0]
+                elif d and len(d) == 1 and d[0] in state_aliases:
+                    which = d[0]
+                if which is None:
+                    continue
+                constrained = any(
+                    isinstance(s, ast.Call) and _final_name(s.func) == "with_sharding_constraint"
+                    for s in ast.walk(node.value)
+                )
+                if not constrained:
+                    out.append(_finding(
+                        "TPU013", path, node, lines,
+                        f"state of {which!r} (placed via .shard(...)) is hand-mutated without"
+                        " with_sharding_constraint — an unconstrained write silently"
+                        " re-replicates the leaf, dropping the mesh layout every compiled"
+                        " tier was built for; route the write through the engine's update"
+                        " kernels, or wrap the value in jax.lax.with_sharding_constraint"
+                        " with the declared spec (docs/distributed.md 'Sharded state')",
+                    ))
+        # (b) float fold over a cross-shard concatenation
+        for node in _scoped_walk(info.node):
+            if not (isinstance(node, ast.Call) and _final_name(node.func) in _ORDER_FOLDS):
+                continue
+            hit = None
+            for arg in node.args:
+                for cat in (s for s in ast.walk(arg)
+                            if isinstance(s, ast.Call) and _final_name(s.func) in _CAT_BUILDERS):
+                    for s in ast.walk(cat):
+                        if isinstance(s, ast.Name) and (s.id in sharded or s.id in state_aliases):
+                            hit = s.id
+                            break
+                    if hit:
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            out.append(_finding(
+                "TPU013", path, node, lines,
+                f"float `{_final_name(node.func)}` fold over concatenated shards of"
+                f" {hit!r} — cross-shard cat order follows placement and float reduction"
+                " is not associative, so the result drifts with mesh shape; fix the"
+                " order (sort by shard index) or reduce shard-locally before"
+                " concatenating (the engine's reduce-scatter sync does exactly this)",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
-    _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011,
+    _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
+    _rule_tpu013,
 )
 
 
-def run_rules(tree: ast.Module, lines: Sequence[str], path: str) -> List[Finding]:
-    """Run every registered rule over one parsed module."""
-    model = _ModuleModel(tree)
+def run_rules(
+    tree: ast.Module,
+    lines: Sequence[str],
+    path: str,
+    model: Optional[_ModuleModel] = None,
+) -> List[Finding]:
+    """Run every registered rule over one parsed module.
+
+    ``model`` lets the whole-program pass (project.py) hand in a module model it already
+    built — and decorated with interprocedural marks — instead of re-inferring from the
+    bare tree.
+    """
+    if model is None:
+        model = _ModuleModel(tree)
     findings: List[Finding] = []
     for rule in _RULE_FUNCS:
         findings.extend(rule(model, lines, path))

@@ -43,7 +43,7 @@ class BinaryConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((2, 2), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((2, 2), jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — int32 is the TPU-native count dtype (x64 off; int64 would lower to int32), and sample-scale counts stay far below 2^31
 
     def _validate(self, preds, target) -> None:
         if self.validate_args:
@@ -91,7 +91,7 @@ class MulticlassConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — int32 is the TPU-native count dtype (x64 off), sample-scale counts stay far below 2^31
 
     def _validate(self, preds, target) -> None:
         if self.validate_args:
@@ -128,7 +128,7 @@ class MultilabelConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — int32 is the TPU-native count dtype (x64 off), sample-scale counts stay far below 2^31
 
     def _validate(self, preds, target) -> None:
         if self.validate_args:

@@ -61,9 +61,9 @@ class PanopticQuality(Metric):
         self.allow_unknown_preds_category = allow_unknown_preds_category
         num_categories = len(things_p) + len(stuffs_p)
         self.add_state("iou_sum", jnp.zeros(num_categories, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("true_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_negatives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — int32 is the TPU-native count dtype (x64 off; int64 would lower to int32), and sample-scale counts stay far below 2^31
+        self.add_state("false_positives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — see true_positives
+        self.add_state("false_negatives", jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — see true_positives
 
     def _update(self, state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
         _validate_inputs(preds, target)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -93,7 +94,7 @@ def _infer_num_classes(preds: Array, target: Array, num_classes: Optional[int]) 
         return num_classes
     if preds.ndim == target.ndim + 1:
         return preds.shape[1]
-    m = max(int(jnp.max(preds)), int(jnp.max(target)))
+    m = max(int(jax.device_get(jnp.max(preds))), int(jax.device_get(jnp.max(target))))
     return max(m + 1, 2)
 
 

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -83,8 +84,8 @@ def _compute_binary_demographic_parity(stats: Array) -> Dict[str, Array]:
     """min/max positive-prediction-rate ratio (reference ``group_fairness.py:164``)."""
     tp, fp, tn, fn = stats[:, 0], stats[:, 1], stats[:, 2], stats[:, 3]
     pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
-    lo = int(jnp.argmin(pos_rates))
-    hi = int(jnp.argmax(pos_rates))
+    lo = int(jax.device_get(jnp.argmin(pos_rates)))
+    hi = int(jax.device_get(jnp.argmax(pos_rates)))
     return {f"DP_{lo}_{hi}": _safe_divide(pos_rates[lo], pos_rates[hi])}
 
 
@@ -92,8 +93,8 @@ def _compute_binary_equal_opportunity(stats: Array) -> Dict[str, Array]:
     """min/max true-positive-rate ratio (reference ``group_fairness.py:243``)."""
     tp, fp, tn, fn = stats[:, 0], stats[:, 1], stats[:, 2], stats[:, 3]
     tprs = _safe_divide(tp, tp + fn)
-    lo = int(jnp.argmin(tprs))
-    hi = int(jnp.argmax(tprs))
+    lo = int(jax.device_get(jnp.argmin(tprs)))
+    hi = int(jax.device_get(jnp.argmax(tprs)))
     return {f"EO_{lo}_{hi}": _safe_divide(tprs[lo], tprs[hi])}
 
 
@@ -107,7 +108,7 @@ def demographic_parity(
     """Demographic-parity ratio (reference ``group_fairness.py:177``)."""
     preds = jnp.asarray(preds)
     groups = jnp.asarray(groups)
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = int(jax.device_get(jnp.max(groups))) + 1
     target = jnp.zeros(preds.shape, jnp.int32)
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
@@ -128,7 +129,7 @@ def equal_opportunity(
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     groups = jnp.asarray(groups)
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = int(jax.device_get(jnp.max(groups))) + 1
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
         _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
@@ -157,7 +158,7 @@ def binary_fairness(
     if task == "demographic_parity":
         target = jnp.zeros(preds.shape, jnp.int32)
     target = jnp.asarray(target)
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = int(jax.device_get(jnp.max(groups))) + 1
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
         if task != "demographic_parity":

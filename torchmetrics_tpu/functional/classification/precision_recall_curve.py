@@ -353,7 +353,9 @@ def _binary_precision_recall_curve_compute(
     if thresholds is not None and isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, tuple):
         return _precision_recall_from_confmat(state, thresholds)
     preds, target, weight = state
-    fps, tps, thr = _binary_clf_curve_exact(np.asarray(preds), np.asarray(target), np.asarray(weight))
+    # exact mode (thresholds=None) is host-mediated by contract: jit callers must bin
+    # (pass thresholds) — the static early-return above is the traced path
+    fps, tps, thr = _binary_clf_curve_exact(np.asarray(preds), np.asarray(target), np.asarray(weight))  # jaxlint: disable=TPU003
     return _precision_recall_from_exact(fps, tps, thr)
 
 

@@ -73,7 +73,9 @@ def _binary_roc_compute(
     if thresholds is not None and not isinstance(state, tuple):
         return _roc_from_confmat(state, thresholds)
     preds, target, weight = state
-    return _roc_from_exact(np.asarray(preds), np.asarray(target), np.asarray(weight))
+    # exact mode (thresholds=None) is host-mediated by contract: jit callers must bin
+    # (pass thresholds) — the static early-return above is the traced path
+    return _roc_from_exact(np.asarray(preds), np.asarray(target), np.asarray(weight))  # jaxlint: disable=TPU003
 
 
 def binary_roc(

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Literal, Union
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 from jax.scipy.special import gammaln
@@ -211,7 +212,7 @@ def normalized_mutual_info_score(
     _validate_average_method_arg(average_method)
     contingency = calculate_contingency_matrix(preds, target)
     mutual_info = _mutual_info_from_contingency(contingency)
-    if float(jnp.abs(mutual_info)) <= float(jnp.finfo(jnp.float32).eps):
+    if float(jax.device_get(jnp.abs(mutual_info))) <= float(jnp.finfo(jnp.float32).eps):
         return mutual_info
     normalizer = calculate_generalized_mean(
         jnp.stack(

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Collection, Dict, Optional, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -74,7 +75,7 @@ def _preprocess_inputs(
     mask_stuffs = jnp.any(cats[..., None] == stuffs_arr, axis=-1)
     mask_things = jnp.any(cats[..., None] == things_arr, axis=-1)
     known = mask_things | mask_stuffs
-    if not allow_unknown_category and not bool(jnp.all(known)):
+    if not allow_unknown_category and not bool(jax.device_get(jnp.all(known))):
         raise ValueError(f"Unknown categories found: {np.unique(np.asarray(cats)[~np.asarray(known)])}")
     inst = jnp.where(mask_stuffs, 0, out[:, :, 1])
     cats = jnp.where(known, cats, void_color[0])

@@ -241,8 +241,8 @@ class PeakSignalNoiseRatio(Metric):
                 raise ValueError("The `data_range` must be given when `dim` is not None.")
             self.data_range_val = None
             # track the observed target range (reference psnr.py:110-115, incl. its zero-init)
-            self.add_state("min_target", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="min")
-            self.add_state("max_target", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="max")
+            self.add_state("min_target", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="min")  # jaxlint: disable=TPU005 — reference-parity zero-init (torch psnr.py:110-115); diverging would change upstream numerics
+            self.add_state("max_target", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="max")  # jaxlint: disable=TPU005 — reference-parity zero-init, see min_target
         elif isinstance(data_range, tuple):
             self.clamping_range = (float(data_range[0]), float(data_range[1]))
             self.data_range_val = float(data_range[1] - data_range[0])
@@ -308,7 +308,7 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
         self.add_state("sum_squared_error", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("bef", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("data_range", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="max")
+        self.add_state("data_range", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="max")  # jaxlint: disable=TPU005 — observed ranges are nonnegative by construction, so 0 IS the max identity here
 
     def _update(self, state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
         sum_squared_error, bef, num_obs = _psnrb_update(preds, target, block_size=self.block_size)
@@ -605,7 +605,7 @@ class TotalVariation(Metric):
             self.add_state("score_list", [], dist_reduce_fx="cat")
         else:
             self.add_state("score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("num_elements", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("num_elements", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — counts batch entries (img.shape[0]), a sample-scale quantity far below 2^31; int32 is the TPU count dtype
 
     def _update(self, state: Dict[str, Array], img: Array) -> Dict[str, Array]:
         score, num_elements = _total_variation_update(img)

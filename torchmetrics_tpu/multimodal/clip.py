@@ -44,7 +44,7 @@ class CLIPScore(Metric):
         super().__init__(**kwargs)
         self.image_encoder, self.text_encoder = _resolve_encoders(model_name_or_path)
         self.add_state("score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")  # jaxlint: disable=TPU005 — int32 is the TPU-native count dtype (x64 off; int64 would lower to int32), and sample-scale counts stay far below 2^31
 
     def update(self, images, text) -> None:  # noqa: D102 - runs the encoders, then delegates
         score, n = _clip_score_update(images, text, self.image_encoder, self.text_encoder)

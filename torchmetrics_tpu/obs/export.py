@@ -255,6 +255,10 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["lint_findings"] = status["new"]
         out["lint_baselined"] = status["baselined"]
         out["lint_stale_baseline"] = status["stale"]
+        # incremental-cache economics: wall time of the status run plus how much of the
+        # tree was served from the content-fingerprint cache (the jaxlint rerun win)
+        out["lint_runtime_ms"] = status.get("runtime_ms")
+        out["lint_cache_hits"] = status.get("cache_hits", 0)
     except Exception:  # pragma: no cover - defensive: bench extras are best-effort
         out["lint_findings"] = None
     return out

@@ -193,7 +193,7 @@ class FastStepCache:
         telemetry.counter("dispatch.aot_fallbacks").inc()
 
 
-def dispatch_step(
+def dispatch_step(  # jaxlint: donates(2) — state_leaves die with the executable call
     cache: FastStepCache,
     builder: Callable[[List[Any], Any], AotEntry],
     state_leaves: List[Any],
@@ -235,7 +235,7 @@ def _never() -> bool:
     return False
 
 
-def commit_step(state: Any, entry: AotEntry, out: Any) -> None:
+def commit_step(state: Any, entry: AotEntry, out: Any) -> None:  # jaxlint: donation-commit
     """Install a dispatched step's state outputs into a ``StateStore``.
 
     Donated entries commit through the store's generation machinery (the old buffers are
@@ -251,7 +251,7 @@ def commit_step(state: Any, entry: AotEntry, out: Any) -> None:
         state.abort_donated()
 
 
-def recover_failed_step(metric: Any, state: Any, kind: str) -> None:
+def recover_failed_step(metric: Any, state: Any, kind: str) -> None:  # jaxlint: donation-commit
     """Post-exception cleanup shared by the fast dispatch tiers.
 
     Clears the in-flight latch, and — when the dispatch died AFTER donating (the old

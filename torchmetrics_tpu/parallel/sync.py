@@ -27,6 +27,7 @@ re-admits it on the first successful probe — re-admission state reconciliation
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 import os
 import threading
@@ -44,6 +45,15 @@ from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 ReduceFx = Union[str, Callable, None]
+
+
+@functools.lru_cache(maxsize=None)
+def _empty_payload() -> Array:
+    """Shared zero-length gather payload for empty list states.
+
+    Built once per process: constructing it inline in ``process_sync`` re-uploads the
+    same constant to the device on every sync (jaxlint TPU006)."""
+    return jnp.zeros((0,))
 
 # ------------------------------------------------------------------ bounded-sync options
 ENV_SYNC_TIMEOUT = "TM_TPU_SYNC_TIMEOUT_S"
@@ -563,8 +573,8 @@ def sync_state(
     known at trace time — state names, reduce-fx, payload bytes, and mesh-axis size. Executed
     latency is measured by the eager paths (``process_sync``) and the bench sync probes.
     """
-    obs.telemetry.counter("sync.sync_state.traces").inc()
-    obs.telemetry.event(
+    obs.telemetry.counter("sync.sync_state.traces").inc()  # jaxlint: disable=TPU009 — counts TRACES on purpose (see docstring)
+    obs.telemetry.event(  # jaxlint: disable=TPU009 — trace-time record by design: collectives cannot be timed in-program
         "sync.sync_state", cat="sync",
         args={
             "axis": axis_name,
@@ -906,7 +916,7 @@ def process_sync(
             out[name] = list(value)
             continue
         if is_list:
-            payload = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else jnp.zeros((0,))
+            payload = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else _empty_payload()
         else:
             payload = value
         try:

@@ -34,6 +34,7 @@ state is **bit-identical** to the unfaulted run for sum/mean/max/min/cat reducti
 """
 from __future__ import annotations
 
+import functools
 import os
 import random
 import time
@@ -51,6 +52,17 @@ from torchmetrics_tpu.utils.prints import reset_warning_cache
 #: env knob the chaos CI lane pins (``make chaos``); tests default to it for determinism.
 ENV_CHAOS_SEED = "TM_TPU_CHAOS_SEED"
 DEFAULT_SEED = 1234
+
+
+@functools.lru_cache(maxsize=None)
+def _empty_entry() -> Any:
+    """Shared zero-length cat-state placeholder (one device upload per process).
+
+    jax is imported lazily so merely importing the chaos harness never initialises a
+    backend (the module contract); the cache makes the constant once on first use."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((0,))
 
 
 def counters() -> Dict[str, int]:
@@ -349,7 +361,7 @@ class SimWorld:
         if name in st.lists:
             entries = st.lists[name]
             if not entries:
-                return jnp.zeros((0,))
+                return _empty_entry()
             return jnp.concatenate([jnp.atleast_1d(e) for e in entries], axis=0)
         return st.tensors[name]
 

@@ -25,7 +25,9 @@ def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
     """Concatenate a (possibly list-valued) state along dim 0."""
     if isinstance(x, (jax.Array, np.ndarray)):
         return jnp.asarray(x)
-    if not x:  # empty list state
+    # the isinstance early-return above narrows x to a host LIST here, so the emptiness
+    # test is a len() check on a Python container, never a bool() on a traced array
+    if not x:  # empty list state  # jaxlint: disable=TPU002
         raise ValueError("No samples to concatenate")
     x = [jnp.atleast_1d(jnp.asarray(e)) for e in x]
     return jnp.concatenate(x, axis=0)
@@ -55,7 +57,7 @@ def _flatten(x: Sequence) -> list:
 def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     """Convert (N, ...) int labels to (N, C, ...) one-hot (reference ``data.py:80``)."""
     if num_classes is None:
-        num_classes = int(jnp.max(label_tensor)) + 1
+        num_classes = int(jax.device_get(jnp.max(label_tensor))) + 1
     oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)  # (N, ..., C)
     return jnp.moveaxis(oh, -1, 1)
 
@@ -86,7 +88,7 @@ def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
     Static output shape (required by XLA) — ``minlength`` must be known at trace time.
     """
     if minlength is None:
-        minlength = int(jnp.max(x)) + 1 if x.size else 1
+        minlength = int(jax.device_get(jnp.max(x))) + 1 if x.size else 1
     return _ops_bincount(jnp.reshape(x, (-1,)), minlength)
 
 
@@ -111,4 +113,4 @@ def allclose(t1: Array, t2: Array, atol: float = 1e-8) -> bool:
     """Shape+value closeness check usable on any backend (reference ``data.py:231``)."""
     if jnp.shape(t1) != jnp.shape(t2):
         return False
-    return bool(jnp.allclose(jnp.asarray(t1, jnp.float32), jnp.asarray(t2, jnp.float32), atol=atol))
+    return bool(jax.device_get(jnp.allclose(jnp.asarray(t1, jnp.float32), jnp.asarray(t2, jnp.float32), atol=atol)))

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -36,7 +36,16 @@ shard-smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --sharded --smoke > /tmp/tm_shard_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_shard_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; rep=ex['sync_bytes_per_compute_replicated']; shd=ex['sync_bytes_per_compute_sharded']; assert shd < rep, (shd, rep); bits=[v for k,v in ex.items() if k.startswith('sharded_bit_identical')]; assert bits and all(bits), ex; assert ex['lazy_reduce_fires'] <= ex['sharded_compute_epochs'] and ex['lazy_reduce_reuses'] >= 1, ex; print('shard-smoke ok: %dB sharded vs %dB allgather per compute (%.1fx), bit-identical' % (shd, rep, rep/shd))"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU013, docs/static-analysis.md): exits
+# streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
+# acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after
+# 1 batch and the full stream, well under the cat footprint), measured quantile/AUC error
+# within the documented bounds, and the exact (cat) mode bit-identical to the functional
+# path (the sketch subsystem must not perturb it)
+sketch-smoke:
+	python bench.py --sketch --smoke > /tmp/tm_sketch_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_sketch_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['sketch_auc_abs_error'] <= ex['sketch_auc_error_bound'], ex; assert ex['quantile_rank_error'] <= ex['quantile_error_bound'], ex; assert ex['sketch_auroc_state_bytes'] == ex['sketch_auroc_state_bytes_short_stream'], ex; assert ex['sketch_auroc_state_bytes'] < ex['cat_auroc_state_bytes'], ex; assert ex['sketch_auroc_state_bytes'] <= 65536 and ex['sketch_quantile_state_bytes'] <= 65536, ex; assert ex['sketch_exact_mode_bit_identical'], ex; print('sketch-smoke ok: %dB sketch vs %dB cat state (%.0fx), AUC err %.2e <= %.2e' % (ex['sketch_auroc_state_bytes'], ex['cat_auroc_state_bytes'], ex['cat_auroc_state_bytes']/ex['sketch_auroc_state_bytes'], ex['sketch_auc_abs_error'], ex['sketch_auc_error_bound']))"
+
+# static JAX/TPU hazard analysis (rules TPU001-TPU014, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`. Whole-program
 # pass over the package PLUS examples/ and bench.py, with the content-fingerprint

@@ -558,6 +558,157 @@ def sharded_main(smoke: bool) -> None:
     )
 
 
+def bench_sketch(batch: int, n_batches: int) -> dict:
+    """``--sketch`` scenario (docs/sketches.md): O(1) streaming sketch states vs the
+    unbounded-cat exact mode, at pinned shapes.
+
+    Measures, for the AUROC family and the quantile path: updates+compute throughput
+    (sketch folds per batch and finalises O(bins) vs cat's append-then-sort-the-world),
+    resident state bytes (fixed vs linear in samples), and the measured approximation
+    error against the documented bound. Also asserts the exact mode is UNTOUCHED: the
+    cat-state metric's value must be bit-identical to the direct functional computation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.classification import BinaryAUROC
+    from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+    from torchmetrics_tpu.sketch import StreamingQuantile, auroc_error_bound, kll
+    from torchmetrics_tpu.sketch.state import sketch_state_bytes
+
+    rng = np.random.RandomState(17)
+    bins = 2048
+    preds_np = rng.uniform(0.0, 1.0, (n_batches, batch)).astype(np.float32)
+    target_np = (rng.uniform(0, 1, (n_batches, batch)) < np.clip(preds_np * 0.8 + 0.1, 0, 1)).astype(np.int32)
+    preds = [jnp.asarray(preds_np[i]) for i in range(n_batches)]
+    target = [jnp.asarray(target_np[i]) for i in range(n_batches)]
+    jax.block_until_ready((preds, target))
+    out: dict = {"sketch_bins": bins, "sketch_batch": batch, "sketch_n_batches": n_batches}
+
+    def auroc_window(metric) -> float:
+        metric.reset()
+        for i in range(n_batches):
+            metric.update(preds[i], target[i])
+        jax.block_until_ready(metric.compute())
+        return 0.0
+
+    sk = BinaryAUROC(approx="sketch", sketch_bins=bins)
+    auroc_window(sk)  # compile out of window
+    best = _best_of(lambda: auroc_window(sk), windows=3)
+    out["sketch_auroc_samples_per_sec"] = round(batch * n_batches / best, 2)
+    ex = BinaryAUROC()  # exact cat mode
+    auroc_window(ex)
+    best_ex = _best_of(lambda: auroc_window(ex), windows=3)
+    out["cat_auroc_samples_per_sec"] = round(batch * n_batches / best_ex, 2)
+    out["sketch_vs_cat_auroc_speedup"] = round(best_ex / best, 2)
+
+    # state bytes: resident accumulator footprint after the full stream
+    out["sketch_auroc_state_bytes"] = sketch_state_bytes(sk)
+    out["cat_auroc_state_bytes"] = int(sum(
+        e.size * e.dtype.itemsize for entries in ex._state.lists.values() for e in entries
+    ))
+    # fixed-size proof: the sketch footprint after 1 batch equals the full-stream one
+    sk_short = BinaryAUROC(approx="sketch", sketch_bins=bins)
+    sk_short.update(preds[0], target[0])
+    out["sketch_auroc_state_bytes_short_stream"] = sketch_state_bytes(sk_short)
+
+    # measured error vs the documented discretisation bound
+    auc_sketch = float(sk.compute())
+    auc_exact = float(ex.compute())
+    out["sketch_auc_abs_error"] = round(abs(auc_sketch - auc_exact), 8)
+    out["sketch_auc_error_bound"] = auroc_error_bound(bins)
+    # exact mode untouched: the stateful cat path == the direct functional computation
+    direct = float(binary_auroc(
+        jnp.concatenate(preds), jnp.concatenate(target), validate_args=False
+    ))
+    out["sketch_exact_mode_bit_identical"] = bool(
+        np.float32(auc_exact).tobytes() == np.float32(direct).tobytes()
+    )
+
+    # quantile sketch: rank error vs the sorted stream + throughput vs cat-and-sort
+    vals_np = rng.normal(0.0, 1.0, (n_batches, batch)).astype(np.float32)
+    vals = [jnp.asarray(vals_np[i]) for i in range(n_batches)]
+    jax.block_until_ready(vals)
+    sq = StreamingQuantile(q=(0.1, 0.5, 0.99))
+
+    def q_window():
+        sq.reset()
+        for i in range(n_batches):
+            sq.update(vals[i])
+        jax.block_until_ready(sq.compute())
+
+    q_window()
+    best_q = _best_of(q_window, windows=3)
+    out["sketch_quantile_samples_per_sec"] = round(batch * n_batches / best_q, 2)
+    sorted_all = np.sort(vals_np.reshape(-1))
+    n = sorted_all.size
+    est = np.asarray(sq.compute())
+    out["quantile_rank_error"] = round(max(
+        abs(np.searchsorted(sorted_all, est[i]) / n - q)
+        for i, q in enumerate((0.1, 0.5, 0.99))
+    ), 6)
+    out["quantile_error_bound"] = kll.DEFAULT_RANK_ERROR
+    out["sketch_quantile_state_bytes"] = sketch_state_bytes(sq)
+
+    def cat_q_window():
+        buf = [np.asarray(v) for v in vals]
+        return np.quantile(np.concatenate(buf), (0.1, 0.5, 0.99))
+
+    best_cq = _best_of(cat_q_window, windows=3)
+    out["cat_quantile_samples_per_sec"] = round(batch * n_batches / best_cq, 2)
+
+    out["sketch_telemetry"] = {
+        k: obs.telemetry.counter(k).value
+        for k in ("sketch.merges", "sketch.compactions", "sketch.state_bytes_saved")
+    }
+    return out
+
+
+def sketch_main(smoke: bool) -> None:
+    """``bench.py --sketch [--smoke]``: one JSON line with the sketch scenario numbers.
+
+    The ``make sketch-smoke`` gate asserts on this payload: measured quantile/AUC error
+    within the documented bounds, fixed sketch state strictly below the cat footprint
+    (and invariant across stream lengths), and the exact mode bit-identical to the
+    functional path.
+    """
+    if smoke:
+        batch, n_batches = 4096, 6
+    else:
+        batch, n_batches = 65536, 16
+    extras = bench_sketch(batch=batch, n_batches=n_batches)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+        extras["cost_ledger"] = [
+            {k: r[k] for k in ("key", "metric", "kernel", "tier", "flops",
+                               "bytes_accessed", "temp_bytes", "argument_bytes", "available")}
+            for r in obs.cost_ledger()
+            if r["metric"] in ("StreamingQuantile", "BinaryAUROC")
+        ]
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "sketch_auroc_samples_per_sec",
+                "value": extras.get("sketch_auroc_samples_per_sec"),
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "samples/s through BinaryAUROC(approx='sketch') updates+compute"
+                    " (O(1)-state streaming histogram pair vs the unbounded-cat exact"
+                    " mode; state bytes, error-vs-bound, quantile sketch numbers, and"
+                    " exact-mode bit-identity in extras)"
+                ),
+                "vs_baseline": extras.get("sketch_vs_cat_auroc_speedup"),
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     """Same sweep through the reference torchmetrics (torch backend)."""
     import types
@@ -1257,6 +1408,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         sharded_main(smoke)
+    elif "--sketch" in sys.argv:
+        # sketch-state scenario (make sketch-smoke / docs/sketches.md): smoke pins CPU
+        # via the config API like the other lanes; full mode probes for a healthy platform
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        sketch_main(smoke)
     elif "--keyed" in sys.argv:
         # keyed multi-tenant scenario (make keyed-smoke / docs/keyed.md): smoke pins CPU
         # via the config API like the bench smoke lane; full mode probes for a healthy

@@ -1,0 +1,102 @@
+"""TPU014 fixtures: unbounded cat state on a metric with a registered sketch twin."""
+from __future__ import annotations
+
+import textwrap
+
+from torchmetrics_tpu._lint import analyze_source
+from torchmetrics_tpu._lint.rules import _SKETCH_EQUIVALENT_METRICS
+
+
+def _rules(snippet: str, path: str = "fixture.py"):
+    return [f.rule for f in analyze_source(textwrap.dedent(snippet), path=path)]
+
+
+class TestTPU014:
+    def test_unwired_curve_class_flags(self):
+        rules = _rules(
+            """
+            class BinaryPrecisionRecallCurve(Metric):
+                def __init__(self, thresholds=None):
+                    if thresholds is None:
+                        self.add_state("preds", [], dist_reduce_fx="cat")
+                        self.add_state("target", [], dist_reduce_fx="cat")
+            """
+        )
+        assert rules.count("TPU014") == 2
+
+    def test_sketch_wired_class_clean(self):
+        assert "TPU014" not in _rules(
+            """
+            class BinaryPrecisionRecallCurve(Metric):
+                def __init__(self, thresholds=None, approx=None):
+                    self.approx = approx
+                    if approx == "sketch":
+                        register_sketch_state(self, "pos_hist", hist_spec(bins=64))
+                    elif thresholds is None:
+                        self.add_state("preds", [], dist_reduce_fx="cat")
+            """
+        )
+
+    def test_subclass_of_equivalent_with_none_fx_flags(self):
+        rules = _rules(
+            """
+            class MyRanker(RetrievalMetric):
+                def __init__(self):
+                    self.add_state("docs", [], dist_reduce_fx=None)
+            """
+        )
+        assert "TPU014" in rules
+
+    def test_omitted_fx_on_list_state_flags(self):
+        assert "TPU014" in _rules(
+            """
+            class RetrievalMetric(Metric):
+                def __init__(self):
+                    self.add_state("preds", [])
+            """
+        )
+
+    def test_unrelated_metric_with_cat_state_clean(self):
+        assert "TPU014" not in _rules(
+            """
+            class SpearmanCorrCoef(Metric):
+                def __init__(self):
+                    self.add_state("preds", [], dist_reduce_fx="cat")
+            """
+        )
+
+    def test_tensor_state_on_equivalent_clean(self):
+        assert "TPU014" not in _rules(
+            """
+            class BinaryPrecisionRecallCurve(Metric):
+                def __init__(self, thresholds):
+                    self.add_state("confmat", jnp.zeros((4, 2, 2)), dist_reduce_fx="sum")
+            """
+        )
+
+    def test_suppression_comment_respected(self):
+        assert "TPU014" not in _rules(
+            """
+            class RetrievalMetric(Metric):
+                def __init__(self):
+                    self.add_state("preds", [], dist_reduce_fx=None)  # jaxlint: disable=TPU014
+            """
+        )
+
+    def test_registry_mirrors_sketch_package(self):
+        # the analyzer is stdlib-only and restates the registry; the package import here
+        # (tests may import jax) keeps the two sets from drifting
+        from torchmetrics_tpu.sketch import SKETCH_EQUIVALENTS
+
+        assert set(_SKETCH_EQUIVALENT_METRICS) == set(SKETCH_EQUIVALENTS)
+
+    def test_message_points_at_the_twin(self):
+        findings = analyze_source(textwrap.dedent(
+            """
+            class BinaryPrecisionRecallCurve(Metric):
+                def __init__(self):
+                    self.add_state("weight", [], dist_reduce_fx="cat")
+            """
+        ), path="x.py")
+        msgs = [f.message for f in findings if f.rule == "TPU014"]
+        assert msgs and "approx='sketch'" in msgs[0] and "docs/sketches.md" in msgs[0]

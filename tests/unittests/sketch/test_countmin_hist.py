@@ -1,0 +1,134 @@
+"""Count-min and threshold-histogram properties + Pallas-vs-XLA kernel parity."""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.ops import histogram as ops_hist
+from torchmetrics_tpu.ops.pallas_hist import bincount_pallas, hist_pair_pallas
+from torchmetrics_tpu.sketch import countmin as cm
+from torchmetrics_tpu.sketch import hist as sh
+
+
+class TestCountMin:
+    def test_never_underestimates_and_bound_holds(self):
+        rng = np.random.RandomState(0)
+        ids = rng.zipf(1.5, 20_000).astype(np.int64) % 100_000
+        state = cm.cm_init()
+        for i in range(0, len(ids), 4096):
+            state = cm.cm_update(state, jnp.asarray(ids[i:i + 4096]))
+        true = collections.Counter(ids.tolist())
+        probe = np.asarray(sorted(true, key=true.get, reverse=True)[:50], np.int64)
+        est = np.asarray(cm.cm_query(state, jnp.asarray(probe)))
+        n = len(ids)
+        for p, e in zip(probe, est):
+            assert e >= true[int(p)]  # one-sided
+            assert e - true[int(p)] <= cm.cm_error_bound() * n * 4  # loose w.h.p. check
+
+    def test_merge_is_sum_and_matches_single_stream(self):
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 1000, 5000)
+        a = cm.cm_update(cm.cm_init(), jnp.asarray(ids[:2500]))
+        b = cm.cm_update(cm.cm_init(), jnp.asarray(ids[2500:]))
+        whole = cm.cm_update(cm.cm_init(), jnp.asarray(ids))
+        assert np.asarray(a + b).tobytes() == np.asarray(whole).tobytes()
+
+    def test_weighted_update(self):
+        state = cm.cm_update(cm.cm_init(), jnp.asarray([7, 7, 9]), weights=jnp.asarray([2.0, 3.0, 1.0]))
+        assert float(cm.cm_query(state, jnp.asarray([7]))[0]) >= 5.0
+
+    def test_deterministic_across_instances(self):
+        a = cm.cm_update(cm.cm_init(), jnp.arange(100))
+        b = cm.cm_update(cm.cm_init(), jnp.arange(100))
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            cm.cm_init(depth=0)
+        with pytest.raises(ValueError):
+            cm.cm_init(width=1)
+
+
+class TestThresholdHist:
+    def test_suffix_counts_equal_threshold_compare(self):
+        rng = np.random.RandomState(2)
+        bins = 64
+        scores = rng.uniform(0, 1, 5000).astype(np.float32)
+        pos_w = rng.randint(0, 2, 5000).astype(np.float32)
+        neg_w = 1.0 - pos_w
+        ph, nh = sh.hist_update_pair(
+            sh.hist_init(bins), sh.hist_init(bins), jnp.asarray(scores),
+            jnp.asarray(pos_w), jnp.asarray(neg_w),
+        )
+        tp, fp, tn, fn = (np.asarray(x) for x in sh.hist_threshold_counts(ph, nh))
+        thr = np.linspace(0, 1, bins, dtype=np.float32)
+        for t in (0, 1, bins // 2, bins - 1):
+            assert tp[t] == pos_w[scores >= thr[t]].sum()
+            assert fp[t] == neg_w[scores >= thr[t]].sum()
+            assert tn[t] + fp[t] == neg_w.sum()
+            assert fn[t] + tp[t] == pos_w.sum()
+
+    def test_class_resolved_update_matches_per_class(self):
+        rng = np.random.RandomState(3)
+        bins, C, N = 32, 5, 800
+        scores = rng.uniform(0, 1, (N, C)).astype(np.float32)
+        pos = rng.randint(0, 2, (N, C)).astype(np.float32)
+        ph, nh = sh.hist_update_classes(
+            sh.hist_init(bins, C), sh.hist_init(bins, C),
+            jnp.asarray(scores), jnp.asarray(pos), jnp.asarray(1.0 - pos),
+        )
+        for c in range(C):
+            p1, n1 = sh.hist_update_pair(
+                sh.hist_init(bins), sh.hist_init(bins), jnp.asarray(scores[:, c]),
+                jnp.asarray(pos[:, c]), jnp.asarray(1.0 - pos[:, c]),
+            )
+            assert np.allclose(np.asarray(ph)[c], np.asarray(p1))
+            assert np.allclose(np.asarray(nh)[c], np.asarray(n1))
+
+    def test_merge_by_sum_matches_single_stream(self):
+        rng = np.random.RandomState(4)
+        s = rng.uniform(0, 1, 2000).astype(np.float32)
+        w = rng.randint(0, 2, 2000).astype(np.float32)
+        whole = sh.hist_update_pair(sh.hist_init(128), sh.hist_init(128), jnp.asarray(s), jnp.asarray(w), jnp.asarray(1 - w))
+        a = sh.hist_update_pair(sh.hist_init(128), sh.hist_init(128), jnp.asarray(s[:1000]), jnp.asarray(w[:1000]), jnp.asarray(1 - w[:1000]))
+        b = sh.hist_update_pair(sh.hist_init(128), sh.hist_init(128), jnp.asarray(s[1000:]), jnp.asarray(w[1000:]), jnp.asarray(1 - w[1000:]))
+        for i in range(2):
+            assert np.asarray(a[i] + b[i]).tobytes() == np.asarray(whole[i]).tobytes()
+
+
+class TestPallasParity:
+    """The fused Pallas scatter-add kernels vs the XLA one-hot/segment paths — both
+    lowerings must count identically (interpret mode on the CPU test mesh)."""
+
+    def test_hist_pair_pallas_vs_xla(self):
+        rng = np.random.RandomState(5)
+        idx = rng.randint(-5, 300, 3000).astype(np.int32)  # incl. out-of-range
+        wp = rng.uniform(0, 2, 3000).astype(np.float32)
+        wn = rng.uniform(0, 2, 3000).astype(np.float32)
+        pallas = np.asarray(hist_pair_pallas(jnp.asarray(idx), jnp.asarray(wp), jnp.asarray(wn), 257))
+        xla = np.asarray(ops_hist.hist_pair(jnp.asarray(idx), jnp.asarray(wp), jnp.asarray(wn), 257))
+        assert pallas.shape == xla.shape == (2, 257)
+        assert np.allclose(pallas, xla, rtol=1e-5, atol=1e-3)
+
+    def test_hist_pair_backend_switch(self):
+        idx = jnp.asarray(np.arange(100) % 7)
+        wp = jnp.ones((100,), jnp.float32)
+        wn = jnp.zeros((100,), jnp.float32)
+        base = np.asarray(ops_hist.hist_pair(idx, wp, wn, 7))
+        ops_hist.set_bincount_backend("pallas")
+        try:
+            via_pallas = np.asarray(ops_hist.hist_pair(idx, wp, wn, 7))
+        finally:
+            ops_hist.set_bincount_backend("xla")
+        assert np.allclose(base, via_pallas)
+
+    def test_bincount_pallas_vs_xla_unchanged(self):
+        rng = np.random.RandomState(6)
+        x = rng.randint(0, 50, 2000).astype(np.int32)
+        assert np.array_equal(
+            np.asarray(bincount_pallas(jnp.asarray(x), 50)),
+            np.asarray(ops_hist.bincount_weighted(jnp.asarray(x), 50)),
+        )

@@ -1,0 +1,153 @@
+"""``approx="sketch"`` curve family: binned equivalence, exact-mode error bounds, and the
+exact path's bit-identity to its pre-sketch behaviour."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    MulticlassAUROC,
+    MulticlassPrecisionRecallCurve,
+    MultilabelAUROC,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.classification.roc import BinaryROC
+from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+from torchmetrics_tpu.sketch import auroc_error_bound
+
+RNG = np.random.RandomState(100)
+N = 8192
+PREDS = RNG.uniform(0, 1, N).astype(np.float32)
+TARGET = (RNG.uniform(0, 1, N) < np.clip(PREDS * 0.8 + 0.1, 0, 1)).astype(np.int32)
+
+
+def _asnp(value):
+    if isinstance(value, (tuple, list)):
+        return [np.asarray(v) for v in value]
+    return np.asarray(value)
+
+
+class TestBinarySketchEquivalence:
+    @pytest.mark.parametrize("cls", [BinaryAUROC, BinaryAveragePrecision, BinaryROC,
+                                     BinaryPrecisionRecallCurve])
+    def test_sketch_equals_binned_at_same_grid(self, cls):
+        bins = 512
+        sk = cls(approx="sketch", sketch_bins=bins)
+        binned = cls(thresholds=bins)
+        sk.update(PREDS, TARGET)
+        binned.update(PREDS, TARGET)
+        got, want = sk.compute(), binned.compute()
+        if not isinstance(got, (tuple, list)):
+            got, want = (got,), (want,)
+        for a, b in zip(got, want):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_auroc_error_vs_exact_within_documented_bound(self):
+        for bins in (256, 2048):
+            sk = BinaryAUROC(approx="sketch", sketch_bins=bins)
+            ex = BinaryAUROC()
+            sk.update(PREDS, TARGET)
+            ex.update(PREDS, TARGET)
+            err = abs(float(sk.compute()) - float(ex.compute()))
+            assert err <= auroc_error_bound(bins), (bins, err)
+
+    def test_exact_mode_bit_identical_to_functional(self):
+        ex = BinaryAUROC()
+        ex.update(PREDS, TARGET)
+        direct = binary_auroc(jnp.asarray(PREDS), jnp.asarray(TARGET), validate_args=False)
+        assert np.asarray(ex.compute()).tobytes() == np.asarray(direct).tobytes()
+
+    def test_state_is_fixed_size(self):
+        sk = BinaryAUROC(approx="sketch", sketch_bins=128)
+        sk.update(PREDS[:100], TARGET[:100])
+        bytes_small = sum(np.asarray(v).nbytes for v in sk.metric_state.values())
+        sk.update(PREDS, TARGET)
+        bytes_big = sum(np.asarray(v).nbytes for v in sk.metric_state.values())
+        assert bytes_small == bytes_big == 2 * 128 * 4
+
+    def test_tier_bit_identity(self):
+        batches = [(PREDS[i * 1000:(i + 1) * 1000], TARGET[i * 1000:(i + 1) * 1000]) for i in range(6)]
+        via_update = BinaryAUROC(approx="sketch", sketch_bins=256)
+        via_forward = BinaryAUROC(approx="sketch", sketch_bins=256)
+        via_scan = BinaryAUROC(approx="sketch", sketch_bins=256)
+        via_buffered = BinaryAUROC(approx="sketch", sketch_bins=256)
+        for p, t in batches:
+            via_update.update(p, t)
+            via_forward.forward(p, t)
+        via_scan.update_batches(np.stack([b[0] for b in batches]), np.stack([b[1] for b in batches]))
+        with via_buffered.buffered(3) as buf:
+            for p, t in batches:
+                buf.update(p, t)
+        ref = np.asarray(via_update.compute()).tobytes()
+        for m in (via_forward, via_scan, via_buffered):
+            assert np.asarray(m.compute()).tobytes() == ref
+
+    def test_forward_returns_batch_local_value(self):
+        m = BinaryAUROC(approx="sketch", sketch_bins=512)
+        batch_val = m.forward(PREDS, TARGET)
+        solo = BinaryAUROC(approx="sketch", sketch_bins=512)
+        solo.update(PREDS, TARGET)
+        assert np.allclose(np.asarray(batch_val), np.asarray(solo.compute()))
+
+    def test_ignore_index(self):
+        target = TARGET.copy().astype(np.int64)
+        target[::7] = -1
+        sk = BinaryAUROC(approx="sketch", sketch_bins=512, ignore_index=-1)
+        ex = BinaryAUROC(ignore_index=-1)
+        sk.update(PREDS, target)
+        ex.update(PREDS, target)
+        assert abs(float(sk.compute()) - float(ex.compute())) <= auroc_error_bound(512)
+
+
+class TestMultiSketch:
+    def test_multiclass_matches_binned(self):
+        C = 7
+        preds = RNG.uniform(0, 1, (1024, C)).astype(np.float32)
+        preds /= preds.sum(1, keepdims=True)
+        target = RNG.randint(0, C, 1024)
+        sk = MulticlassAUROC(num_classes=C, approx="sketch", sketch_bins=256)
+        binned = MulticlassAUROC(num_classes=C, thresholds=256)
+        sk.update(preds, target)
+        binned.update(preds, target)
+        assert np.allclose(np.asarray(sk.compute()), np.asarray(binned.compute()), atol=1e-6)
+
+    def test_multiclass_micro_curve_matches_binned(self):
+        C = 4
+        preds = RNG.uniform(0, 1, (512, C)).astype(np.float32)
+        target = RNG.randint(0, C, 512)
+        sk = MulticlassPrecisionRecallCurve(num_classes=C, average="micro", approx="sketch", sketch_bins=128)
+        binned = MulticlassPrecisionRecallCurve(num_classes=C, average="micro", thresholds=128)
+        sk.update(preds, target)
+        binned.update(preds, target)
+        for a, b in zip(_asnp(sk.compute()), _asnp(binned.compute())):
+            assert np.allclose(a, b, atol=1e-6)
+
+    def test_multilabel_matches_binned(self):
+        L = 3
+        preds = RNG.uniform(0, 1, (700, L)).astype(np.float32)
+        target = RNG.randint(0, 2, (700, L))
+        sk = MultilabelAUROC(num_labels=L, approx="sketch", sketch_bins=256)
+        binned = MultilabelAUROC(num_labels=L, thresholds=256)
+        sk.update(preds, target)
+        binned.update(preds, target)
+        assert np.allclose(np.asarray(sk.compute()), np.asarray(binned.compute()), atol=1e-6)
+
+    def test_multilabel_curve_shapes(self):
+        sk = MultilabelPrecisionRecallCurve(num_labels=2, approx="sketch", sketch_bins=32)
+        sk.update(RNG.uniform(0, 1, (64, 2)).astype(np.float32), RNG.randint(0, 2, (64, 2)))
+        p, r, t = sk.compute()
+        assert np.asarray(p).shape == (2, 33) and np.asarray(t).shape == (32,)
+
+
+class TestApproxValidation:
+    def test_approx_with_thresholds_rejected(self):
+        with pytest.raises(ValueError, match="approx='sketch'"):
+            BinaryAUROC(approx="sketch", thresholds=64)
+
+    def test_unknown_approx_rejected(self):
+        with pytest.raises(ValueError, match="`approx`"):
+            BinaryPrecisionRecallCurve(approx="tdigest")

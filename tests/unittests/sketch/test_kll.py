@@ -1,0 +1,160 @@
+"""KLL compactor property suite: merge laws, weight exactness, error bounds vs exact cat.
+
+The bound asserted here (``kll.DEFAULT_RANK_ERROR`` at the default capacity) is the one
+``docs/sketches.md`` documents and ``make sketch-smoke`` gates — a fixed-seed property
+test over uniform, normal, sorted-adversarial, and heavily-duplicated streams.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.sketch import kll
+
+
+def _stream(kind: str, n: int, seed: int) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    if kind == "uniform":
+        return rng.uniform(-5, 5, n).astype(np.float32)
+    if kind == "normal":
+        return rng.normal(0, 3, n).astype(np.float32)
+    if kind == "sorted":
+        return np.sort(rng.normal(0, 1, n)).astype(np.float32)
+    if kind == "dupes":
+        return rng.randint(0, 17, n).astype(np.float32)
+    raise AssertionError(kind)
+
+
+def _fold(values: np.ndarray, batch: int = 1000, **kw) -> jnp.ndarray:
+    s = kll.kll_init(**kw)
+    upd = jax.jit(kll.kll_update)
+    for i in range(0, len(values), batch):
+        s = upd(s, jnp.asarray(values[i:i + batch]))
+    return s
+
+
+def _max_rank_err(sketch, values: np.ndarray) -> float:
+    data = np.sort(values)
+    n = data.size
+    errs = []
+    for q in np.linspace(0.02, 0.98, 17):
+        est = float(kll.kll_quantiles(sketch, jnp.asarray([q]))[0])
+        lo = np.searchsorted(data, est, side="left") / n
+        hi = np.searchsorted(data, est, side="right") / n
+        errs.append(min(abs(lo - q), abs(hi - q)) if not lo <= q <= hi else 0.0)
+    return max(errs)
+
+
+class TestWeightExactness:
+    def test_count_is_exact_through_updates_and_merges(self):
+        a = _fold(_stream("uniform", 33333, 0))
+        b = _fold(_stream("normal", 7777, 1))
+        assert float(kll.kll_count(a)) == 33333.0
+        assert float(kll.kll_count(kll.kll_merge(a, b))) == 33333.0 + 7777.0
+
+    def test_empty_sketch_is_merge_identity(self):
+        a = _fold(_stream("uniform", 5000, 2))
+        merged = kll.kll_merge(a, kll.kll_init())
+        assert np.asarray(merged).tobytes() == np.asarray(a).tobytes()
+
+    def test_odd_sizes_conserve_weight(self):
+        s = kll.kll_init(capacity=16, levels=10)
+        upd = jax.jit(kll.kll_update)
+        total = 0
+        for n in (1, 3, 17, 31, 255, 1023):
+            s = upd(s, jnp.arange(n, dtype=jnp.float32))
+            total += n
+        assert float(kll.kll_count(s)) == float(total)
+
+
+class TestMergeLaws:
+    def test_merge_commutative_bit_identical(self):
+        a = _fold(_stream("uniform", 9000, 3))
+        b = _fold(_stream("normal", 4000, 4))
+        ab = np.asarray(kll.kll_merge(a, b))
+        ba = np.asarray(kll.kll_merge(b, a))
+        assert ab.tobytes() == ba.tobytes()
+
+    def test_merge_associative_within_bound(self):
+        streams = [_stream("uniform", 6000, s) for s in (5, 6, 7)]
+        parts = [_fold(v) for v in streams]
+        left = kll.kll_merge(kll.kll_merge(parts[0], parts[1]), parts[2])
+        right = kll.kll_merge(parts[0], kll.kll_merge(parts[1], parts[2]))
+        allv = np.concatenate(streams)
+        assert float(kll.kll_count(left)) == float(kll.kll_count(right)) == len(allv)
+        for s in (left, right):
+            assert _max_rank_err(s, allv) <= kll.DEFAULT_RANK_ERROR
+
+    def test_merge_stacked_equals_pairwise_fold(self):
+        parts = [_fold(_stream("uniform", 2000, s)) for s in (8, 9, 10)]
+        stacked = kll.kll_merge_stacked(jnp.stack(parts))
+        pairwise = kll.kll_merge(kll.kll_merge(parts[0], parts[1]), parts[2])
+        assert np.asarray(stacked).tobytes() == np.asarray(pairwise).tobytes()
+
+    def test_merge_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="cannot merge"):
+            kll.kll_merge(kll.kll_init(capacity=16, levels=8), kll.kll_init(capacity=32, levels=8))
+
+
+class TestErrorBound:
+    @pytest.mark.parametrize("kind", ["uniform", "normal", "sorted", "dupes"])
+    @pytest.mark.parametrize("n", [1_000, 50_000])
+    def test_rank_error_within_documented_bound(self, kind, n):
+        values = _stream(kind, n, seed=42)
+        sketch = _fold(values)
+        assert _max_rank_err(sketch, values) <= kll.DEFAULT_RANK_ERROR
+
+    def test_update_order_invariance_of_bound(self):
+        values = _stream("normal", 20_000, 11)
+        fwd = _fold(values)
+        rev = _fold(values[::-1].copy())
+        for s in (fwd, rev):
+            assert _max_rank_err(s, values) <= kll.DEFAULT_RANK_ERROR
+
+    def test_cdf_matches_quantiles(self):
+        values = _stream("uniform", 10_000, 12)
+        sketch = _fold(values)
+        med = float(kll.kll_quantiles(sketch, jnp.asarray([0.5]))[0])
+        cdf = float(kll.kll_cdf(sketch, jnp.asarray([med]))[0])
+        assert abs(cdf - 0.5) <= 2 * kll.DEFAULT_RANK_ERROR
+
+
+class TestStaticProgram:
+    def test_jit_and_eager_bit_identical(self):
+        values = _stream("uniform", 3000, 13)
+        eager = kll.kll_update(kll.kll_init(), jnp.asarray(values))
+        jitted = jax.jit(kll.kll_update)(kll.kll_init(), jnp.asarray(values))
+        assert np.asarray(eager).tobytes() == np.asarray(jitted).tobytes()
+
+    def test_scan_fold_matches_loop(self):
+        batches = _stream("normal", 4000, 14).reshape(8, 500)
+        loop = kll.kll_init()
+        for b in batches:
+            loop = kll.kll_update(loop, jnp.asarray(b))
+        scanned, _ = jax.lax.scan(
+            lambda st, b: (kll.kll_update(st, b), None), kll.kll_init(), jnp.asarray(batches)
+        )
+        assert np.asarray(scanned).tobytes() == np.asarray(loop).tobytes()
+
+    def test_vmap_per_key_matches_instances(self):
+        vals = _stream("uniform", 1200, 15).reshape(4, 300)
+        stacked = jax.vmap(kll.kll_update)(
+            jnp.stack([kll.kll_init()] * 4), jnp.asarray(vals)
+        )
+        for k in range(4):
+            solo = kll.kll_update(kll.kll_init(), jnp.asarray(vals[k]))
+            assert np.asarray(stacked[k]).tobytes() == np.asarray(solo).tobytes()
+
+    def test_state_bytes_fixed_and_small(self):
+        small = _fold(_stream("uniform", 100, 16))
+        big = _fold(_stream("uniform", 100_000, 16))
+        assert np.asarray(small).nbytes == np.asarray(big).nbytes == kll.kll_state_bytes()
+        assert kll.kll_state_bytes() < 16_384  # "a few KB"
+
+    def test_init_validation(self):
+        with pytest.raises(ValueError):
+            kll.kll_init(capacity=7)
+        with pytest.raises(ValueError):
+            kll.kll_init(levels=1)

@@ -1,0 +1,137 @@
+"""Retrieval ``approx="sketch"``: batch-aligned exactness, straddle detection, actions."""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecisionRecallCurve,
+)
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+
+
+def _batches(n_batches=5, nq=24, seed=0, ensure_pos=True):
+    rng = np.random.RandomState(seed)
+    out, q0 = [], 0
+    for _ in range(n_batches):
+        idx, pr, tg = [], [], []
+        for q in range(q0, q0 + nq):
+            n = rng.randint(4, 12)
+            idx += [q] * n
+            pr += list(rng.uniform(0, 1, n))
+            t = rng.randint(0, 2, n)
+            if ensure_pos and t.sum() == 0:
+                t[rng.randint(n)] = 1
+            if ensure_pos and t.sum() == n:  # keep a negative too (FallOut)
+                t[rng.randint(n)] = 0
+            tg += list(t)
+        q0 += nq
+        out.append((np.asarray(pr, np.float32), np.asarray(tg, np.int64), np.asarray(idx, np.int64)))
+    return out
+
+
+BATCHES = _batches()
+
+
+class TestBatchAlignedParity:
+    @pytest.mark.parametrize("cls", [RetrievalMRR, RetrievalMAP, RetrievalNormalizedDCG,
+                                     RetrievalHitRate, RetrievalFallOut])
+    def test_sketch_matches_exact(self, cls):
+        exact, sk = cls(), cls(approx="sketch")
+        for p, t, i in BATCHES:
+            exact.update(p, t, indexes=i)
+            sk.update(p, t, indexes=i)
+        assert np.allclose(float(exact.compute()), float(sk.compute()), atol=1e-6)
+        assert sk.straddled_queries == 0
+
+    @pytest.mark.parametrize("agg", ["mean", "min", "max"])
+    def test_aggregations(self, agg):
+        exact = RetrievalMRR(aggregation=agg)
+        sk = RetrievalMRR(aggregation=agg, approx="sketch")
+        for p, t, i in BATCHES:
+            exact.update(p, t, indexes=i)
+            sk.update(p, t, indexes=i)
+        assert np.allclose(float(exact.compute()), float(sk.compute()), atol=1e-6)
+
+    def test_top_k_respected(self):
+        exact = RetrievalHitRate(top_k=3)
+        sk = RetrievalHitRate(top_k=3, approx="sketch")
+        for p, t, i in BATCHES:
+            exact.update(p, t, indexes=i)
+            sk.update(p, t, indexes=i)
+        assert np.allclose(float(exact.compute()), float(sk.compute()), atol=1e-6)
+
+    def test_empty_metric_computes_zero(self):
+        sk = RetrievalMRR(approx="sketch")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert float(sk.compute()) == 0.0
+
+
+class TestStraddleDetection:
+    def test_straddled_counted_and_warned(self):
+        sk = RetrievalMRR(approx="sketch")
+        p, t, i = BATCHES[0]
+        sk.update(p, t, indexes=i)
+        sk.update(p, t, indexes=i)  # every query id re-appears
+        assert sk.straddled_queries == 24
+        with pytest.warns(TorchMetricsUserWarning, match="more than one update batch"):
+            sk.compute()
+
+    def test_disjoint_batches_do_not_straddle(self):
+        sk = RetrievalMRR(approx="sketch")
+        for p, t, i in BATCHES:  # query id ranges are disjoint per batch
+            sk.update(p, t, indexes=i)
+        assert sk.straddled_queries == 0
+
+
+class TestActionsAndValidation:
+    def test_error_action_raises_at_update(self):
+        sk = RetrievalMRR(empty_target_action="error", approx="sketch")
+        preds = np.asarray([0.3, 0.2], np.float32)
+        target = np.asarray([0, 0], np.int64)  # no positives
+        with pytest.raises(ValueError, match="no positive"):
+            sk.update(preds, target, indexes=np.asarray([0, 0]))
+
+    def test_skip_and_neg_actions_match_exact(self):
+        batches = _batches(ensure_pos=False, seed=7)
+        for action in ("skip", "neg", "pos"):
+            exact = RetrievalMRR(empty_target_action=action)
+            sk = RetrievalMRR(empty_target_action=action, approx="sketch")
+            for p, t, i in batches:
+                exact.update(p, t, indexes=i)
+                sk.update(p, t, indexes=i)
+            assert np.allclose(float(exact.compute()), float(sk.compute()), atol=1e-6), action
+
+    def test_median_rejected(self):
+        with pytest.raises(TorchMetricsUserError, match="median"):
+            RetrievalMRR(aggregation="median", approx="sketch")
+
+    def test_callable_aggregation_rejected(self):
+        with pytest.raises(TorchMetricsUserError):
+            RetrievalMRR(aggregation=lambda v: v.sum(), approx="sketch")
+
+    def test_curve_metric_rejected(self):
+        with pytest.raises(TorchMetricsUserError, match="approx='sketch'"):
+            RetrievalPrecisionRecallCurve(approx="sketch")
+
+    def test_unknown_approx_rejected(self):
+        with pytest.raises(ValueError, match="`approx`"):
+            RetrievalMRR(approx="bogus")
+
+    def test_snapshot_roundtrip_with_descriptor(self):
+        sk = RetrievalMRR(approx="sketch")
+        for p, t, i in BATCHES[:2]:
+            sk.update(p, t, indexes=i)
+        blob = sk.snapshot()
+        assert blob["sketch"]["query_cms"]["kind"] == "countmin"
+        fresh = RetrievalMRR(approx="sketch")
+        fresh.restore(blob)
+        assert float(fresh.compute()) == float(sk.compute())

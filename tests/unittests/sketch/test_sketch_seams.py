@@ -1,0 +1,234 @@
+"""Sketch states riding every engine seam unchanged (the ISSUE-10 acceptance matrix):
+AOT+donation, buffered, KeyedMetric, Metric.shard(), snapshot/journal round-trip, and
+quorum ``process_sync`` with merge as the reduction — each pinned here.
+
+Runs under the conftest-forced 8-device host platform."""
+from __future__ import annotations
+
+import os
+import random
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.classification import BinaryAUROC
+from torchmetrics_tpu.keyed import KeyedMetric
+from torchmetrics_tpu.ops.dispatch import ENV_FAST_DISPATCH
+from torchmetrics_tpu.parallel.mesh import MeshContext
+from torchmetrics_tpu.parallel.sync import SyncOptions, process_sync
+from torchmetrics_tpu.robust import journal as journal_mod
+from torchmetrics_tpu.sketch import StreamingQuantile, kll_count
+from torchmetrics_tpu.utils.exceptions import SnapshotError
+
+RNG = np.random.RandomState(200)
+BATCHES = [RNG.uniform(0, 100, 512).astype(np.float32) for _ in range(6)]
+
+
+def _ref_value():
+    m = StreamingQuantile(q=0.5)
+    for b in BATCHES:
+        m.update(b)
+    return np.asarray(m.compute()).tobytes()
+
+
+REF = _ref_value()
+
+
+class TestDispatchTiers:
+    def test_jit_tier_matches(self, monkeypatch):
+        monkeypatch.setenv(ENV_FAST_DISPATCH, "0")
+        m = StreamingQuantile(q=0.5)
+        for b in BATCHES:
+            m.update(b)
+        assert np.asarray(m.compute()).tobytes() == REF
+
+    def test_forward_fused_with_callable_merge(self):
+        m = StreamingQuantile(q=0.5)
+        for b in BATCHES:
+            m.forward(b)  # callable-merge ladder inside ONE fused program
+        assert np.asarray(m.compute()).tobytes() == REF
+        # AOT fused forward actually engaged (the callable merge did not break fusing)
+        assert m._jit_cache.get("forward_fusable") is True
+
+    def test_update_scan_and_buffered(self):
+        scan = StreamingQuantile(q=0.5)
+        scan.update_batches(np.stack(BATCHES))
+        buf_m = StreamingQuantile(q=0.5)
+        with buf_m.buffered(4) as buf:
+            for b in BATCHES:
+                buf.update(b)
+        assert np.asarray(scan.compute()).tobytes() == REF
+        assert np.asarray(buf_m.compute()).tobytes() == REF
+
+    def test_donation_preserves_value_and_bumps_generation(self):
+        m = StreamingQuantile(q=0.5)
+        gen0 = m.state_generation
+        for b in BATCHES:
+            m.forward(b)
+        assert np.asarray(m.compute()).tobytes() == REF
+        assert m.state_generation > gen0  # donated AOT steps committed fresh buffers
+
+
+class TestKeyedSketch:
+    def test_keyed_kll_vmap_fallback_bit_identical(self):
+        km = KeyedMetric(StreamingQuantile(q=0.5), 4)
+        assert km.strategy == "vmap"  # keyed_decomposable=False on the KLL metric
+        ids = RNG.randint(0, 4, 2048).astype(np.int32)
+        vals = RNG.uniform(0, 100, 2048).astype(np.float32)
+        km.update(ids, vals)
+        # the vmap fallback commits PER ELEMENT in batch order, so the bit-identity
+        # contract is vs the per-element instance loop (a KLL compaction schedule is
+        # batch-size-sensitive; a whole-group update differs within the error bound)
+        insts = [StreamingQuantile(q=0.5) for _ in range(4)]
+        for kid, v in zip(ids, vals):
+            insts[int(kid)].update(np.asarray([v], np.float32))
+        keyed_vals = np.asarray(km.compute())
+        inst_vals = np.stack([np.asarray(i.compute()) for i in insts])
+        assert keyed_vals.tobytes() == inst_vals.tobytes()
+
+    def test_keyed_sketch_auroc_segments_bit_identical(self):
+        tpl = BinaryAUROC(approx="sketch", sketch_bins=128)
+        km = KeyedMetric(tpl, 3)
+        assert km.strategy == "segments"  # sum-merged histograms decompose
+        ids = RNG.randint(0, 3, 1500).astype(np.int32)
+        preds = RNG.uniform(0, 1, 1500).astype(np.float32)
+        target = RNG.randint(0, 2, 1500).astype(np.int32)
+        km.update(ids, preds, target)
+        insts = [BinaryAUROC(approx="sketch", sketch_bins=128) for _ in range(3)]
+        for k in range(3):
+            insts[k].update(preds[ids == k], target[ids == k])
+        assert np.asarray(km.compute()).tobytes() == np.stack(
+            [np.asarray(i.compute()) for i in insts]
+        ).tobytes()
+
+
+class TestShardedSketch:
+    def test_sharded_bit_identical_to_replicated(self):
+        ms = StreamingQuantile(q=0.5).shard(MeshContext())
+        for b in BATCHES:
+            ms.update(b)
+        assert np.asarray(ms.compute()).tobytes() == REF
+
+    def test_sharded_curve_sketch(self):
+        plain = BinaryAUROC(approx="sketch", sketch_bins=512)
+        sharded = BinaryAUROC(approx="sketch", sketch_bins=512).shard(MeshContext())
+        preds = RNG.uniform(0, 1, 4096).astype(np.float32)
+        target = RNG.randint(0, 2, 4096).astype(np.int32)
+        plain.update(preds, target)
+        sharded.update(preds, target)
+        assert np.asarray(plain.compute()).tobytes() == np.asarray(sharded.compute()).tobytes()
+
+
+class TestSyncMerge:
+    def _rank_states(self, n_ranks=3):
+        ranks = []
+        for r in range(n_ranks):
+            m = StreamingQuantile(q=0.5)
+            for b in BATCHES[r::n_ranks]:
+                m.update(b)
+            ranks.append(m)
+        return ranks
+
+    def test_process_sync_merge_is_the_reduction(self):
+        ranks = self._rank_states()
+
+        def gather(value, group, **kw):
+            del group, kw
+            return [jnp.asarray(np.asarray(m._state.tensors["sketch"])) for m in ranks]
+
+        synced = process_sync(ranks[0]._state.snapshot(), ranks[0]._reductions, gather_fn=gather)
+        assert float(kll_count(synced["sketch"])) == sum(len(b) for b in BATCHES)
+
+    def test_quorum_partial_merge_exact_over_responders(self):
+        ranks = self._rank_states()
+
+        def gather(value, group, **kw):
+            del group, kw  # rank 1 dead: only ranks 0 and 2 answer
+            return [jnp.asarray(np.asarray(ranks[r]._state.tensors["sketch"])) for r in (0, 2)]
+
+        opts = SyncOptions(world=3, quorum=2)
+        synced = process_sync(
+            ranks[0]._state.snapshot(), ranks[0]._reductions, gather_fn=gather, options=opts
+        )
+        expect = float(kll_count(ranks[0]._state.tensors["sketch"])) + float(
+            kll_count(ranks[2]._state.tensors["sketch"])
+        )
+        # callable merges are exact over the responding subset (no sum rescaling)
+        assert float(kll_count(synced["sketch"])) == expect
+
+
+class TestDurability:
+    def test_snapshot_descriptor_validated(self):
+        m = StreamingQuantile(q=0.5, capacity=32, levels=12)
+        m.update(BATCHES[0])
+        blob = m.snapshot()
+        assert blob["sketch"]["sketch"]["kind"] == "kll"
+        assert blob["sketch"]["sketch"]["params"] == {"capacity": 32, "levels": 12}
+        other = StreamingQuantile(q=0.5, capacity=64, levels=12)
+        with pytest.raises(SnapshotError, match="sketch state"):
+            other.restore(blob)
+        same = StreamingQuantile(q=0.5, capacity=32, levels=12)
+        same.restore(blob)
+        assert np.asarray(same.compute()).tobytes() == np.asarray(m.compute()).tobytes()
+
+    def test_pre_sketch_blob_rejected(self):
+        m = StreamingQuantile(q=0.5)
+        blob = m.snapshot()
+        blob.pop("sketch")
+        # recompute the container exactly as a pre-sketch writer would have produced it
+        fresh = StreamingQuantile(q=0.5)
+        with pytest.raises(SnapshotError, match="no sketch descriptor"):
+            fresh.restore(blob)
+
+    def test_journal_replay_bit_identical(self, tmp_path):
+        m = StreamingQuantile(q=0.5)
+        jm = m.journal(str(tmp_path / "wal"), every_k=2)
+        for b in BATCHES[:4]:
+            jm.update(b)
+        fresh = StreamingQuantile(q=0.5)
+        journal_mod.recover(fresh, str(tmp_path / "wal"))
+        for b in BATCHES[4:]:
+            fresh.update(b)
+        assert np.asarray(fresh.compute()).tobytes() == REF
+
+    def test_chaos_matrix_scenario_registered_and_passes(self, tmp_path):
+        from torchmetrics_tpu.robust import chaos
+
+        assert "sketch_preemption_journal" in chaos.ChaosMatrix.SCENARIOS
+        rng = random.Random("seam-test")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = chaos.scenario_sketch_preemption_journal(None, rng, 6, "update", str(tmp_path))
+        assert out["passed"] and out["sketch_state_identical"]
+
+
+class TestObsCounters:
+    def test_sketch_counters_flow_and_are_tabulated(self):
+        merges0 = obs.telemetry.counter("sketch.merges").value
+        saved0 = obs.telemetry.counter("sketch.state_bytes_saved").value
+        m = StreamingQuantile(q=0.5)
+        m.update(BATCHES[0])
+        m.forward(BATCHES[1])
+        assert obs.telemetry.counter("sketch.merges").value > merges0
+        assert obs.telemetry.counter("sketch.state_bytes_saved").value >= saved0 + BATCHES[0].nbytes
+        summary = obs.summary()
+        for fam in ("sketch.merges", "sketch.compactions", "sketch.state_bytes_saved"):
+            assert fam in summary
+        extras = obs.bench_extras()
+        assert "sketch_merges" in extras and "sketch_state_bytes_saved" in extras
+
+    def test_compactions_counted_for_large_batches(self):
+        c0 = obs.telemetry.counter("sketch.compactions").value
+        m = StreamingQuantile(q=0.5, capacity=32, levels=16)
+        m.update(RNG.uniform(0, 1, 4096).astype(np.float32))  # >> capacity: halvings occur
+        assert obs.telemetry.counter("sketch.compactions").value > c0
+
+    def test_registry_sync_with_lint(self):
+        from torchmetrics_tpu._lint.rules import _SKETCH_EQUIVALENT_METRICS
+        from torchmetrics_tpu.sketch import SKETCH_EQUIVALENTS
+
+        assert set(_SKETCH_EQUIVALENT_METRICS) == set(SKETCH_EQUIVALENTS)

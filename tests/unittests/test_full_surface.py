@@ -317,6 +317,9 @@ def _spec():
     _keyed_batch = lambda: (jnp.asarray(rng.randint(0, 4, N).astype(np.int32)),
                             jnp.asarray(rng.randint(0, 9, N).astype(np.float32)))
     spec["KeyedMetric"] = (lambda: tm.KeyedMetric(tm.SumMetric, num_keys=4), _keyed_batch)
+    _vals = lambda: (jnp.asarray(rng.rand(N).astype(np.float32)),)
+    spec["StreamingQuantile"] = (lambda: tm.StreamingQuantile(q=0.5), _vals)
+    spec["StreamingHistogram"] = (lambda: tm.StreamingHistogram(bins=16), _vals)
     spec["KeyedMetricCollection"] = (
         lambda: tm.KeyedMetricCollection([tm.SumMetric(), tm.MaxMetric()], num_keys=4), _keyed_batch)
     spec["Metric"] = None          # abstract base

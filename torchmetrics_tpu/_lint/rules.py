@@ -39,6 +39,9 @@ TPU012    donation-lifetime race: a donated buffer (or a sibling alias of one)
 TPU013    sharding consistency: hand-mutation of ``.shard()``-placed state
           without ``with_sharding_constraint``, or a shard-order-dependent
           float fold over gathered/cat state
+TPU014    unbounded ``add_state(default=[], dist_reduce_fx="cat")`` on a
+          metric with a registered streaming-sketch equivalent and no
+          ``approx="sketch"`` wiring (state grows with samples seen)
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -154,6 +157,14 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "example": "m.shard(mesh); m.metric_state['v'] = jnp.zeros_like(v)",
         "fix": "mutate through the engine's kernels (closed under sharding constraints);"
                " make cross-shard float folds order-fixed before reducing",
+    },
+    "TPU014": {
+        "severity": "perf",
+        "summary": "unbounded cat state on a metric with a registered sketch equivalent"
+                   " (state/snapshot/sync bytes grow with samples seen)",
+        "example": "self.add_state('preds', [], dist_reduce_fx='cat')  # curve metric",
+        "fix": "offer (or use) the O(1) streaming sketch twin — approx='sketch' with the"
+               " documented error bound (docs/sketches.md)",
     },
 }
 
@@ -1815,10 +1826,88 @@ def _rule_tpu013(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+#: metric classes with a registered streaming-sketch twin. MIRRORS
+#: ``torchmetrics_tpu.sketch.state.SKETCH_EQUIVALENTS`` — the analyzer is stdlib-only and
+#: must never import the package (that pulls in jax), so the set is restated here; a sync
+#: test (``tests/unittests/lint/test_tpu014.py``) fails when the two drift apart.
+_SKETCH_EQUIVALENT_METRICS = frozenset({
+    "BinaryPrecisionRecallCurve",
+    "MulticlassPrecisionRecallCurve",
+    "MultilabelPrecisionRecallCurve",
+    "RetrievalMetric",
+})
+
+
+def _rule_tpu014(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Unbounded ``add_state(default=[], dist_reduce_fx="cat"/None)`` on a metric that has
+    a registered sketch equivalent but offers no sketch wiring.
+
+    The cat state is the slow tail the sketch subsystem exists to kill: state, snapshots,
+    journals, and sync bytes all grow linearly with samples seen, and compute sorts the
+    whole stream. A class in the sketch-equivalents registry (or subclassing one) that
+    registers a cat/gather list state should at least OFFER the O(1) twin.
+
+    Boundary — the rule stays silent when the class is sketch-wired: its ``__init__``
+    exposes an ``approx`` parameter (or references ``self.approx``), or the module calls
+    into ``torchmetrics_tpu.sketch`` (``register_sketch_state`` et al.). That keeps this
+    repo's own wired curve/retrieval classes clean while flagging forks or new metrics
+    that reintroduce the unbounded state without the escape hatch.
+    """
+    out: List[Finding] = []
+    for cname, cnode in model.class_nodes.items():
+        base_names = {b for n in cnode.bases if (b := _final_name(n))}
+        if cname not in _SKETCH_EQUIVALENT_METRICS and not (
+            base_names & _SKETCH_EQUIVALENT_METRICS
+        ):
+            continue
+        wired = False
+        for node in ast.walk(cnode):
+            if isinstance(node, ast.arg) and node.arg == "approx":
+                wired = True
+                break
+            if isinstance(node, ast.Attribute) and node.attr == "approx":
+                wired = True
+                break
+            if isinstance(node, ast.Call):
+                fname = _final_name(node.func)
+                if fname in ("register_sketch_state", "hist_spec", "kll_spec", "countmin_spec"):
+                    wired = True
+                    break
+        if wired:
+            continue
+        for node in ast.walk(cnode):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr != "add_state" or not isinstance(node.func.value, ast.Name):
+                continue
+            if node.func.value.id != "self" or len(node.args) < 2:
+                continue
+            default = node.args[1]
+            if not (isinstance(default, ast.List) and not default.elts):
+                continue
+            fx: Any = None
+            if len(node.args) >= 3:
+                fx = _const_value(node.args[2])
+            for kw in node.keywords:
+                if kw.arg == "dist_reduce_fx":
+                    fx = _const_value(kw.value)
+            if fx not in ("cat", None):
+                continue
+            state_name = _const_value(node.args[0])
+            out.append(_finding(
+                "TPU014", path, node, lines,
+                f"unbounded cat state {state_name!r} on {cname!r}, which has a registered"
+                " streaming-sketch equivalent: state/snapshot/sync bytes grow with every"
+                " sample and compute sorts the whole stream — offer approx='sketch'"
+                " (fixed-size mergeable state, documented error bound; docs/sketches.md)",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
-    _rule_tpu013,
+    _rule_tpu013, _rule_tpu014,
 )
 
 

@@ -24,6 +24,11 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 class BinaryAUROC(BinaryPrecisionRecallCurve):
     """Reference ``classification/auroc.py:43``.
 
+    Inherits the curve base's state regimes, including ``approx="sketch"``
+    (docs/sketches.md): a fixed ``2·sketch_bins``-float streaming histogram pair instead
+    of the unbounded exact-mode cat state, |ΔAUROC| bounded by the grid discretisation
+    (``sketch.auroc_error_bound``; ~1e-6 measured at the default 2048 bins).
+
     Example:
         >>> import numpy as np
         >>> from torchmetrics_tpu.classification import BinaryAUROC

@@ -8,6 +8,13 @@ State regimes (reference ``:190-250`` translated TPU-first):
 - ``thresholds=int|list|array`` (binned, the TPU-native default style): one fixed-shape
   ``(T, ..., 2, 2)`` confusion tensor in HBM with ``dist_reduce_fx="sum"`` — sync is a single
   psum, update is O(N+T) bucketed histograms.
+- ``approx="sketch"`` (streaming sketch, docs/sketches.md): a ``(..., sketch_bins)``
+  positive/negative threshold-histogram PAIR (``torchmetrics_tpu.sketch.hist``) — 4x
+  smaller than the binned confusion tensor, updated with ONE fused weighted-bincount
+  launch, merged by sum everywhere (fused forward ladder, keyed segment reductions,
+  ``shard()``, quorum sync). Equivalent to binned mode over the implicit
+  ``linspace(0, 1, sketch_bins)`` grid; vs EXACT mode the error is the grid
+  discretisation (documented bound ``sketch.auroc_error_bound(sketch_bins)``).
 """
 from __future__ import annotations
 
@@ -36,8 +43,25 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _counts_to_confmat,
+)
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.sketch import hist as _sketch_hist
+from torchmetrics_tpu.sketch.state import hist_spec, register_sketch_state
 from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _validate_approx(approx: Optional[str], thresholds: Any) -> None:
+    """Shared ``approx`` argument contract for the whole curve family."""
+    if approx not in (None, "sketch"):
+        raise ValueError(f"Argument `approx` must be None or 'sketch', got {approx!r}")
+    if approx == "sketch" and thresholds is not None:
+        raise ValueError(
+            "approx='sketch' replaces the threshold grid with its own `sketch_bins`-wide"
+            " implicit uniform grid — pass thresholds=None (exact-mode signature), or use"
+            " plain binned mode (thresholds=int) without approx."
+        )
 
 
 class BinaryPrecisionRecallCurve(Metric):
@@ -52,13 +76,26 @@ class BinaryPrecisionRecallCurve(Metric):
         thresholds: Thresholds = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        approx: Optional[str] = None,
+        sketch_bins: int = _sketch_hist.DEFAULT_BINS,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        _validate_approx(approx, thresholds)
         if validate_args:
             _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.approx = approx
+        self.sketch_bins = int(sketch_bins)
+        if approx == "sketch":
+            # sketch mode ≡ binned mode over the implicit uniform grid: every inherited
+            # compute (ROC, AUROC, AP, fixed-recall/precision) sees a plain threshold
+            # array + confmat, but the resident state is the 2·bins histogram pair
+            self.thresholds = _adjust_threshold_arg(self.sketch_bins)
+            register_sketch_state(self, "pos_hist", hist_spec(bins=self.sketch_bins))
+            register_sketch_state(self, "neg_hist", hist_spec(bins=self.sketch_bins))
+            return
         thresholds = _adjust_threshold_arg(thresholds)
         self.thresholds = thresholds
         if thresholds is None:
@@ -78,6 +115,13 @@ class BinaryPrecisionRecallCurve(Metric):
         preds, target, weight, _ = _binary_precision_recall_curve_format(
             preds, target, None, self.ignore_index
         )
+        if self.approx == "sketch":
+            pos_hist, neg_hist = _sketch_hist.hist_update_pair(
+                state["pos_hist"], state["neg_hist"], preds,
+                weight * target.astype(jnp.float32),
+                weight * (1.0 - target.astype(jnp.float32)),
+            )
+            return {"pos_hist": pos_hist, "neg_hist": neg_hist}
         if self.thresholds is None:
             return {"preds": preds, "target": target, "weight": weight}
         return {
@@ -86,6 +130,11 @@ class BinaryPrecisionRecallCurve(Metric):
         }
 
     def _curve_state(self, state):
+        if self.approx == "sketch":
+            tp, fp, tn, fn = _sketch_hist.hist_threshold_counts(
+                state["pos_hist"], state["neg_hist"]
+            )
+            return _counts_to_confmat(tp, fp, tn, fn)  # (T, 2, 2)
         if self.thresholds is None:
             return (state["preds"], state["target"], state["weight"])
         return state["confmat"]
@@ -115,15 +164,26 @@ class MulticlassPrecisionRecallCurve(Metric):
         average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        approx: Optional[str] = None,
+        sketch_bins: int = _sketch_hist.DEFAULT_BINS,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        _validate_approx(approx, thresholds)
         if validate_args:
             _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
         self.num_classes = num_classes
         self.average = average
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.approx = approx
+        self.sketch_bins = int(sketch_bins)
+        if approx == "sketch":
+            self.thresholds = _adjust_threshold_arg(self.sketch_bins)
+            classes = None if average == "micro" else num_classes
+            register_sketch_state(self, "pos_hist", hist_spec(bins=self.sketch_bins, classes=classes))
+            register_sketch_state(self, "neg_hist", hist_spec(bins=self.sketch_bins, classes=classes))
+            return
         thresholds = _adjust_threshold_arg(thresholds)
         self.thresholds = thresholds
         if thresholds is None:
@@ -146,6 +206,20 @@ class MulticlassPrecisionRecallCurve(Metric):
         preds, target, weight, _ = _multiclass_precision_recall_curve_format(
             preds, target, self.num_classes, None, self.ignore_index, self.average
         )
+        if self.approx == "sketch":
+            if self.average == "micro":  # one-vs-rest flattened: binary histogram pair
+                pos_hist, neg_hist = _sketch_hist.hist_update_pair(
+                    state["pos_hist"], state["neg_hist"], preds,
+                    weight * target.astype(jnp.float32),
+                    weight * (1.0 - target.astype(jnp.float32)),
+                )
+            else:
+                pos = (target[:, None] == jnp.arange(self.num_classes)[None, :]).astype(jnp.float32)
+                w = weight[:, None]
+                pos_hist, neg_hist = _sketch_hist.hist_update_classes(
+                    state["pos_hist"], state["neg_hist"], preds, pos * w, (1.0 - pos) * w
+                )
+            return {"pos_hist": pos_hist, "neg_hist": neg_hist}
         if self.thresholds is None:
             return {"preds": preds, "target": target, "weight": weight}
         if self.average == "micro":
@@ -157,6 +231,13 @@ class MulticlassPrecisionRecallCurve(Metric):
         return {"confmat": state["confmat"] + update}
 
     def _curve_state(self, state):
+        if self.approx == "sketch":
+            tp, fp, tn, fn = _sketch_hist.hist_threshold_counts(
+                state["pos_hist"], state["neg_hist"]
+            )
+            if self.average == "micro":
+                return _counts_to_confmat(tp, fp, tn, fn)  # (T, 2, 2)
+            return _counts_to_confmat(tp.T, fp.T, tn.T, fn.T)  # (T, C, 2, 2)
         if self.thresholds is None:
             return (state["preds"], state["target"], state["weight"])
         return state["confmat"]
@@ -186,14 +267,24 @@ class MultilabelPrecisionRecallCurve(Metric):
         thresholds: Thresholds = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        approx: Optional[str] = None,
+        sketch_bins: int = _sketch_hist.DEFAULT_BINS,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        _validate_approx(approx, thresholds)
         if validate_args:
             _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         self.num_labels = num_labels
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.approx = approx
+        self.sketch_bins = int(sketch_bins)
+        if approx == "sketch":
+            self.thresholds = _adjust_threshold_arg(self.sketch_bins)
+            register_sketch_state(self, "pos_hist", hist_spec(bins=self.sketch_bins, classes=num_labels))
+            register_sketch_state(self, "neg_hist", hist_spec(bins=self.sketch_bins, classes=num_labels))
+            return
         thresholds = _adjust_threshold_arg(thresholds)
         self.thresholds = thresholds
         if thresholds is None:
@@ -215,6 +306,12 @@ class MultilabelPrecisionRecallCurve(Metric):
         preds, target, weight, _ = _multilabel_precision_recall_curve_format(
             preds, target, self.num_labels, None, self.ignore_index
         )
+        if self.approx == "sketch":
+            t01 = target.astype(jnp.float32)
+            pos_hist, neg_hist = _sketch_hist.hist_update_classes(
+                state["pos_hist"], state["neg_hist"], preds, t01 * weight, (1.0 - t01) * weight
+            )
+            return {"pos_hist": pos_hist, "neg_hist": neg_hist}
         if self.thresholds is None:
             return {"preds": preds, "target": target, "weight": weight}
         return {
@@ -225,6 +322,11 @@ class MultilabelPrecisionRecallCurve(Metric):
         }
 
     def _curve_state(self, state):
+        if self.approx == "sketch":
+            tp, fp, tn, fn = _sketch_hist.hist_threshold_counts(
+                state["pos_hist"], state["neg_hist"]
+            )
+            return _counts_to_confmat(tp.T, fp.T, tn.T, fn.T)  # (T, L, 2, 2)
         if self.thresholds is None:
             return (state["preds"], state["target"], state["weight"])
         return state["confmat"]

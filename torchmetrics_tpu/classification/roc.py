@@ -23,7 +23,11 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
-    """Reference ``classification/roc.py:42``."""
+    """Reference ``classification/roc.py:42``.
+
+    Inherits the curve base's state regimes, including the O(1)-state streaming
+    ``approx="sketch"`` mode (docs/sketches.md) — the ROC points are then the exact
+    curve points at the implicit uniform ``sketch_bins`` grid."""
 
     def _compute(self, state):
         return _binary_roc_compute(self._curve_state(state), self.thresholds)

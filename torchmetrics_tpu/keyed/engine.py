@@ -76,6 +76,11 @@ class KeyedMetric(Metric):
     The template instance itself is never updated — it is the source of the kernels and
     defaults only.
 
+    Sketch-state templates (docs/sketches.md) key like any other metric: sum-merged
+    sketches (the curve family's ``approx="sketch"`` histogram pair) decompose under the
+    segment strategy, while KLL-backed templates (``StreamingQuantile``) declare
+    ``keyed_decomposable = False`` and take the per-element vmap fallback.
+
     Example:
         >>> import numpy as np
         >>> from torchmetrics_tpu.aggregation import SumMetric

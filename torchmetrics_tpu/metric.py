@@ -495,6 +495,15 @@ class Metric:
         self._update_count += 1
         self._update_called = True
         self._computed = None
+        self._note_sketch(args, kwargs)
+
+    def _note_sketch(self, args: tuple, kwargs: dict) -> None:
+        """Host-side sketch obs accounting (merges/compactions/bytes-saved counters);
+        a single dict miss for every non-sketch metric."""
+        if self.__dict__.get("_sketch_specs"):
+            from torchmetrics_tpu.sketch import state as _sketch_state
+
+            _sketch_state.note_update(self, args, kwargs)
 
     def update_batches(self, *args: Any, **kwargs: Any) -> None:
         """Fold a whole STACK of batches into state with one compiled ``lax.scan``.
@@ -537,6 +546,7 @@ class Metric:
             self._update_count += int(n_batches)
             self._update_called = True
             self._computed = None
+            self._note_sketch(args, kwargs)
             return
         scan_fn = self._jit_cache.get("update_scan")
         if scan_fn is None:
@@ -559,6 +569,7 @@ class Metric:
         self._update_count += int(n_batches)
         self._update_called = True
         self._computed = None
+        self._note_sketch(args, kwargs)
 
     def _build_aot_update_scan(self, arg_leaves: List[Any], treedef: Any) -> "_dispatch.AotEntry":
         """Compile the whole-stack scan for one abstract stacked-input signature (flat
@@ -840,7 +851,13 @@ class Metric:
                 and self.jit_compute
                 and not self._state.lists
                 and all(
-                    fx in ("sum", "mean", "max", "min") or fx in (jnp.sum, jnp.max, jnp.min)
+                    fx in ("sum", "mean", "max", "min")
+                    or fx in (jnp.sum, jnp.max, jnp.min)
+                    # trace-safe merge callables (sketch states: kll_merge_stacked) fold
+                    # inside the fused program like a named reduction — only callables
+                    # DECLARED traceable qualify; arbitrary host callables keep the
+                    # eager merge path
+                    or (callable(fx) and getattr(fx, "traceable", False))
                     for fx in (self._reductions[n] for n in self._state.tensors)
                 )
             )
@@ -867,7 +884,11 @@ class Metric:
                 merged[name] = jnp.maximum(gv, bv)
             elif fx == "min" or fx is jnp.min:
                 merged[name] = jnp.minimum(gv, bv)
-            else:  # pragma: no cover - callables are excluded by _fusable_forward
+            elif callable(fx) and getattr(fx, "traceable", False):
+                # trace-safe merge (sketch states): the callable's stacked-fold contract
+                # matches process_sync's — merge the batch sketch into the global one
+                merged[name] = fx(jnp.stack([gv, bv]))
+            else:  # pragma: no cover - other callables are excluded by _fusable_forward
                 raise TorchMetricsUserError(f"Cannot fuse dist_reduce_fx={fx!r}")
         return merged
 
@@ -1062,6 +1083,7 @@ class Metric:
             if self.fast_dispatch and _dispatch.fast_dispatch_enabled():
                 out = self._fast_forward_step(args, kwargs)
                 if out is not _MISS:
+                    self._note_sketch(args, kwargs)
                     return out
             obs.count_dispatch(self)
             sampled = _profiler.sample_step("jit")
@@ -1079,6 +1101,7 @@ class Metric:
             self._update_called = True
             self._computed = None
             self._state.tensors.update(merged)
+            self._note_sketch(args, kwargs)
             return self._squeeze_if_scalar(batch_val)
         obs.count_dispatch(self, 2)  # update kernel + batch-local compute launch
         batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)

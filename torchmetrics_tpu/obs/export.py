@@ -101,6 +101,11 @@ _ALWAYS_TABULATED = (
     "sync.bytes_saved",
     "sync.lazy_reduce.fires",
     "sync.lazy_reduce.reuses",
+    # sketch states (docs/sketches.md): merge launches, statically counted compaction
+    # stages, and the bytes a cat-state twin would have appended instead
+    "sketch.merges",
+    "sketch.compactions",
+    "sketch.state_bytes_saved",
 )
 
 
@@ -226,6 +231,11 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "sync_bytes_saved": counters.get("sync.bytes_saved", 0),
         "sync_lazy_reduce_fires": counters.get("sync.lazy_reduce.fires", 0),
         "sync_lazy_reduce_reuses": counters.get("sync.lazy_reduce.reuses", 0),
+        # sketch states (docs/sketches.md): a bench that folded streams into O(1)
+        # sketches records the merge/compaction volume and the cat bytes it did not keep
+        "sketch_merges": counters.get("sketch.merges", 0),
+        "sketch_compactions": counters.get("sketch.compactions", 0),
+        "sketch_state_bytes_saved": counters.get("sketch.state_bytes_saved", 0),
         # cost profiler (docs/observability.md): ledger rows captured during this run and
         # how many sampled device-timing steps fed the per-tier host/device split
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),

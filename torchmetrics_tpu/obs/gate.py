@@ -28,9 +28,13 @@ from torchmetrics_tpu.obs import ledger as _ledger
 #: the gate's workload classes; the committed baseline holds exactly their rows
 WORKLOAD_CLASSES = (
     "SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "KeyedMetric", "KeyedMetricSharded",
+    "StreamingQuantile", "BinaryAUROCSketch",
 )
 _N = 256  # fixed workload shape: signatures (and therefore ledger keys) must not drift
 _KEYED_N = 16  # fixed tenant count for the keyed workload rows
+_SKETCH_BINS = 512  # pinned histogram width for the sketch curve rows
+_SKETCH_CAPACITY = 64  # pinned KLL compactor width for the quantile rows
+_SKETCH_LEVELS = 16
 _MESH_DEVICES = 8  # forced host-mesh width for the sharded rows (pinned like the shapes)
 
 
@@ -67,7 +71,8 @@ def run_workload() -> List[Dict[str, Any]]:
     x = jnp.asarray(np.linspace(0.5, 2.0, _N, dtype=np.float32))
     stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * _N, dtype=np.float32).reshape(4, _N))
     for cls_name in WORKLOAD_CLASSES:
-        if cls_name.startswith("KeyedMetric"):  # keyed rows come from the blocks below
+        # keyed + sketch rows come from the dedicated blocks below
+        if cls_name.startswith("KeyedMetric") or cls_name in ("StreamingQuantile", "BinaryAUROCSketch"):
             continue
         cls = getattr(aggregation, cls_name)
         m = cls(nan_strategy="ignore")
@@ -133,6 +138,41 @@ def run_workload() -> List[Dict[str, Any]]:
         ks_jit = ShardedKeyed(aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N).shard(ctx)
         ks_jit.update(ids, x)
         ks_jit.compute()
+    finally:
+        if prior is None:
+            os.environ.pop(ENV_FAST_DISPATCH, None)
+        else:
+            os.environ[ENV_FAST_DISPATCH] = prior
+    # sketch rows (docs/sketches.md): the KLL compactor fold (jit + AOT fused forward +
+    # whole-stack scan) and the curve sketch's fused histogram-pair update — the pinned
+    # kernels behind `approx="sketch"`, so a regression in the sketch programs' cost
+    # (the compaction sweep's sorts, the weighted-bincount matmul) trips the gate
+    from torchmetrics_tpu.classification import BinaryAUROC
+    from torchmetrics_tpu.sketch import StreamingQuantile
+
+    sq = StreamingQuantile(q=0.5, capacity=_SKETCH_CAPACITY, levels=_SKETCH_LEVELS)
+    sq.update(x)
+    sq(x)
+    sq(x)
+    sq.update_batches(stack)
+    sq.compute()
+    AurocSketch = type("BinaryAUROCSketch", (BinaryAUROC,), {})
+    scores = jnp.asarray(np.linspace(0.0, 1.0, _N, dtype=np.float32))
+    labels = jnp.asarray((np.arange(_N) % 2).astype(np.int32))
+    ba = AurocSketch(approx="sketch", sketch_bins=_SKETCH_BINS)
+    ba.update(scores, labels)
+    ba(scores, labels)
+    ba(scores, labels)
+    ba.compute()
+    prior = os.environ.get(ENV_FAST_DISPATCH)
+    os.environ[ENV_FAST_DISPATCH] = "0"
+    try:
+        sq_jit = StreamingQuantile(q=0.5, capacity=_SKETCH_CAPACITY, levels=_SKETCH_LEVELS)
+        sq_jit(x)
+        sq_jit.compute()
+        ba_jit = AurocSketch(approx="sketch", sketch_bins=_SKETCH_BINS)
+        ba_jit(scores, labels)
+        ba_jit.compute()
     finally:
         if prior is None:
             os.environ.pop(ENV_FAST_DISPATCH, None)

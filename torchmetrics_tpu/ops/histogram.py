@@ -83,6 +83,39 @@ def bincount_weighted(x: Array, length: int, weights: Optional[Array] = None, dt
     return counts.astype(out_dtype)
 
 
+def hist_pair(idx: Array, pos_w: Array, neg_w: Array, length: int) -> Array:
+    """``(2, length)`` weighted counts of ``idx`` under two weight streams — the curve
+    sketch's accumulation kernel (``torchmetrics_tpu.sketch.hist``).
+
+    One fused launch either way: the XLA path stacks both weight streams into a single
+    ``(2, N) @ (N, C)`` one-hot matmul on the MXU (segment-sum above the one-hot budget);
+    the Pallas backend (``set_bincount_backend("pallas")``) runs the VMEM-tiled
+    scatter-add twin (``ops.pallas_hist.hist_pair_pallas``) where both streams accumulate
+    against one in-register index compare. Out-of-range indices are dropped on every
+    path; f32 accumulation (exact to 2^24 unit weights per bin).
+    """
+    idx = jnp.reshape(idx, (-1,))
+    pos_w = jnp.reshape(pos_w, (-1,)).astype(jnp.float32)
+    neg_w = jnp.reshape(neg_w, (-1,)).astype(jnp.float32)
+    if _BINCOUNT_BACKEND == "pallas":
+        from torchmetrics_tpu.ops.pallas_hist import hist_pair_pallas
+
+        try:
+            return hist_pair_pallas(idx, pos_w, neg_w, length)
+        except Exception:  # pallas lowering unavailable on this platform → XLA path
+            pass
+    valid = (idx >= 0) & (idx < length)
+    w = jnp.stack([pos_w, neg_w]) * valid.astype(jnp.float32)[None, :]  # (2, N)
+    if length <= _ONEHOT_MAX_CARDINALITY:
+        oh = jax.nn.one_hot(idx, length, dtype=jnp.float32)  # (N, C)
+        return jnp.matmul(w, oh, precision="highest")  # (2, C) on the MXU
+    clipped = jnp.clip(idx, 0, length - 1)
+    return jnp.stack([
+        jax.ops.segment_sum(w[0], clipped, num_segments=length),
+        jax.ops.segment_sum(w[1], clipped, num_segments=length),
+    ])
+
+
 def confusion_matrix_update(
     preds: Array,
     target: Array,

@@ -19,6 +19,13 @@ the MXU, which the elementwise compare+multiply+reduce here cannot match (Mosaic
 flattened-operand layout an in-kernel MXU dot would need). The kernel stays as the
 deterministic-layout tuning point and the template for shapes where the dot's operand layout
 is weak; the XLA dot remains the default.
+
+For STREAMING accumulation the sketch subsystem sidesteps this kernel's O(N·T) compare
+entirely: ``approx="sketch"`` buckets each score once into a weighted histogram pair
+(``ops/pallas_hist.hist_pair_pallas`` — the fused scatter-add twin of the bincount kernel,
+O(N·bins/128) VPU work shared across ALL thresholds) and reconstructs the threshold counts
+as an O(bins) suffix sum at compute (``torchmetrics_tpu.sketch.hist``, docs/sketches.md).
+This kernel remains the one-shot exact path for explicit non-uniform threshold grids.
 """
 from __future__ import annotations
 

@@ -77,3 +77,87 @@ def bincount_pallas(x: Array, length: int) -> Array:
     padded = jnp.full((n_pad,), sentinel, jnp.int32).at[: x.size].set(x32)
     interpret = jax.default_backend() != "tpu"
     return _bincount_pallas_impl(padded, length, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Weighted histogram-pair kernel (sketch subsystem, docs/sketches.md)
+# ---------------------------------------------------------------------------
+# The streaming curve sketch folds every batch into a (pos, neg) weighted histogram pair.
+# XLA's lowering is either a serialised scatter-add or a materialised (N, bins) one-hot;
+# this kernel is the fused scatter-add twin of the bincount kernel above: both weight
+# streams accumulate against the same in-register index compare, so the batch is read
+# once and the (N, bins) indicator never exists in VMEM or HBM.
+
+
+def _hist_pair_kernel(idx_ref, wp_ref, wn_ref, out_ref):
+    bin_block = pl.program_id(0)
+    sample_step = pl.program_id(1)
+
+    @pl.when(sample_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[...]  # (ROWS, LANES) int32
+    wp = wp_ref[...]  # (ROWS, LANES) f32
+    wn = wn_ref[...]
+    # output tile (16, LANES): rows 0..7 = positive mass, rows 8..15 = negative mass for
+    # the 8 sublane bin rows of this block. One compare feeds both accumulations.
+    for r in range(8):
+        bins = (bin_block * 8 + r) * _LANES + jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+        eq = (idx[:, :, None] == bins[None, :, :]).astype(jnp.float32)  # (ROWS, LANES, LANES)
+        out_ref[r, :] += jnp.sum(wp[:, :, None] * eq, axis=(0, 1))
+        out_ref[8 + r, :] += jnp.sum(wn[:, :, None] * eq, axis=(0, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("length", "interpret"))
+def _hist_pair_pallas_impl(
+    idx_padded: Array, wp_padded: Array, wn_padded: Array, length: int, interpret: bool
+) -> Array:
+    n = idx_padded.shape[0]
+    num_sample_blocks = n // (_ROWS * _LANES)
+    num_bin_blocks = (length + 8 * _LANES - 1) // (8 * _LANES)
+    shaped = lambda x: x.reshape(num_sample_blocks * _ROWS, _LANES)
+    # sample dim INNERMOST, exactly like the bincount kernel: the output block stays
+    # resident in VMEM across all of its accumulation steps
+    out = pl.pallas_call(
+        _hist_pair_kernel,
+        grid=(num_bin_blocks, num_sample_blocks),
+        in_specs=[
+            pl.BlockSpec((_ROWS, _LANES), lambda b, s: (s, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda b, s: (s, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda b, s: (s, 0)),
+        ],
+        out_specs=pl.BlockSpec((16, _LANES), lambda b, s: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_bin_blocks * 16, _LANES), jnp.float32),
+        interpret=interpret,
+    )(shaped(idx_padded), shaped(wp_padded), shaped(wn_padded))
+    # (blocks, [pos|neg], 8, LANES) -> (2, blocks*8*LANES) -> slice the padded bin tail
+    out = out.reshape(num_bin_blocks, 2, 8 * _LANES).transpose(1, 0, 2).reshape(2, -1)
+    return out[:, :length]
+
+
+def hist_pair_pallas(idx: Array, pos_w: Array, neg_w: Array, length: int) -> Array:
+    """``(2, length)`` weighted counts of ``idx`` under two weight streams, one pass.
+
+    Same masking contract as :func:`bincount_pallas` (out-of-range indices are remapped
+    to a sentinel bin that the final slice drops); samples are padded to a full tile with
+    zero weights. f32 accumulation — exact to 2^24 unit weights per (stream, bin).
+    """
+    idx = jnp.asarray(idx).reshape(-1)
+    pos_w = jnp.asarray(pos_w, jnp.float32).reshape(-1)
+    neg_w = jnp.asarray(neg_w, jnp.float32).reshape(-1)
+    block = _ROWS * _LANES
+    n_pad = max(((idx.size + block - 1) // block) * block, block)
+    idx32 = jnp.where((idx >= 0) & (idx < length), idx, length).astype(jnp.int32)
+
+    def pad(x, fill, dtype):
+        return jnp.full((n_pad,), fill, dtype).at[: x.size].set(x)
+
+    interpret = jax.default_backend() != "tpu"
+    return _hist_pair_pallas_impl(
+        pad(idx32, length, jnp.int32),
+        pad(pos_w, 0.0, jnp.float32),
+        pad(neg_w, 0.0, jnp.float32),
+        length,
+        interpret,
+    )

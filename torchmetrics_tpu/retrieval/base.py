@@ -10,6 +10,17 @@ D2H bandwidth (the dominant term on tunneled accelerators).
 
 State: three list states with ``dist_reduce_fx=None`` (gather-without-reduce,
 reference ``base.py:130-132``).
+
+Streaming sketch mode (``approx="sketch"``, docs/sketches.md): instead of keeping every
+``(index, pred, target)`` triple, each batch's queries are finalised ON THE SPOT through
+the same grouped kernel and folded into O(1) mergeable scalars (value sum/count/min/max,
+all sum/min/max-reduced) plus a count-min sketch over query ids
+(``torchmetrics_tpu.sketch.countmin``) that DETECTS the one approximation this makes: a
+query whose documents straddle an update-batch boundary is scored per fragment instead of
+once whole. ``straddled_queries`` reports the (never-under-) estimate, and compute warns
+when it is nonzero. With batch-aligned queries — the common evaluation layout — sketch
+mode is exact. State is ~16 KB regardless of corpus size, and every robustness seam
+(snapshot/journal/quorum sync) ships the fixed blob instead of the stream.
 """
 from __future__ import annotations
 
@@ -23,9 +34,12 @@ from jax import Array, lax
 
 from torchmetrics_tpu.functional.retrieval import _flat
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.sketch.countmin import cm_query, cm_update
+from torchmetrics_tpu.sketch.state import countmin_spec, register_sketch_state
 from torchmetrics_tpu.utils.checks import _check_retrieval_inputs
 from torchmetrics_tpu.utils.data import dim_zero_cat
-from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 def _next_pow2(x: int) -> int:
@@ -108,12 +122,16 @@ class RetrievalMetric(Metric):
     higher_is_better = True
     full_state_update = False
     allow_non_binary_target = False
+    #: which per-query count defines an "empty" query for the sketch path ("pos"
+    #: everywhere except FallOut, which empties on missing NEGATIVES)
+    _sketch_empty_from = "pos"
 
     def __init__(
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
         aggregation="mean",
+        approx: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -132,9 +150,35 @@ class RetrievalMetric(Metric):
                 "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable."
             )
         self.aggregation = aggregation
-        self.add_state("indexes", [], dist_reduce_fx=None)
-        self.add_state("preds", [], dist_reduce_fx=None)
-        self.add_state("target", [], dist_reduce_fx=None)
+        if approx not in (None, "sketch"):
+            raise ValueError(f"Argument `approx` must be None or 'sketch', got {approx!r}")
+        self.approx = approx
+        if approx == "sketch":
+            if type(self)._metric_kernel is RetrievalMetric._metric_kernel:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} does not support approx='sketch' (no per-query"
+                    " kernel to finalise batches with)."
+                )
+            if callable(aggregation) or aggregation == "median":
+                raise TorchMetricsUserError(
+                    "approx='sketch' keeps O(1) mergeable aggregates, which exist for"
+                    " aggregation='mean'/'min'/'max' — median and custom callables need"
+                    " the exact (cat-state) mode."
+                )
+            # per-batch grouped finalisation is data-dependent (host-shaped rectangles),
+            # so the sketch update runs eagerly and cannot fold under lax.scan
+            self.jit_update = False
+            self.scan_update = False
+            self.add_state("value_sum", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("query_count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("value_min", jnp.asarray(jnp.inf, jnp.float32), dist_reduce_fx="min")
+            self.add_state("value_max", jnp.asarray(-jnp.inf, jnp.float32), dist_reduce_fx="max")
+            self.add_state("straddled", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+            register_sketch_state(self, "query_cms", countmin_spec())
+        else:
+            self.add_state("indexes", [], dist_reduce_fx=None)
+            self.add_state("preds", [], dist_reduce_fx=None)
+            self.add_state("target", [], dist_reduce_fx=None)
 
     def _validate(self, preds, target, indexes=None) -> None:
         if indexes is None or preds is None or target is None:
@@ -146,7 +190,108 @@ class RetrievalMetric(Metric):
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
         )
+        if self.approx == "sketch":
+            return self._sketch_update(state, indexes, preds, target.astype(jnp.float32))
         return {"indexes": indexes, "preds": preds, "target": target.astype(jnp.float32)}
+
+    # ---------------------------------------------------------- streaming sketch mode
+    def _sketch_update(self, state, indexes: Array, preds: Array, target: Array):
+        """Finalise THIS batch's queries and fold them into the O(1) running aggregates.
+
+        Same kernel, same empty-action semantics as the exact compute — the only
+        difference is WHEN queries are scored: here, per batch, instead of once over the
+        full concatenated stream. The count-min sketch tallies query ids so fragments of
+        a batch-straddling query are detected (``straddled`` is a never-under estimate).
+        """
+        if self.ignore_index is not None:
+            valid = (target != self.ignore_index).astype(jnp.float32)
+            target = target * valid
+        else:
+            valid = jnp.ones(target.shape, jnp.float32)
+        values, pos_count, neg_count, valid_count = self._grouped_values(
+            indexes, preds, target, valid=valid
+        )
+        has_valid = valid_count > 0
+        empty_axis = pos_count if self._sketch_empty_from == "pos" else neg_count
+        empty = (empty_axis == 0) & has_valid
+        action = self.empty_target_action
+        if action == "error":
+            # explicit one-shot D2H read (TPU001), paid only under the "error" action —
+            # exactly the exact-mode contract, just at update time instead of compute
+            if bool(jax.device_get(jnp.any(empty))):
+                raise ValueError(
+                    "`update` method was provided with a query with no "
+                    + ("positive" if self._sketch_empty_from == "pos" else "negative")
+                    + " target."
+                )
+            include = has_valid
+        elif action == "skip":
+            include = has_valid & ~empty
+        else:
+            values = jnp.where(empty, 1.0 if action == "pos" else 0.0, values)
+            include = has_valid
+        stats = self._sketch_fold(state, indexes, values, include.astype(jnp.float32))
+        return stats
+
+    def _sketch_fold(self, state, indexes, values, inc):
+        """One jitted fold of per-query values + id stream into the sketch states."""
+        fn = self._jit_cache.get("sketch_fold")
+        if fn is None:
+            def fold(st, indexes, values, inc):
+                vsum = jnp.sum(values * inc)
+                vcnt = jnp.sum(inc)
+                vmin = jnp.min(jnp.where(inc > 0, values, jnp.inf))
+                vmax = jnp.max(jnp.where(inc > 0, values, -jnp.inf))
+                ids_sorted = jnp.sort(indexes)
+                is_new = jnp.concatenate(
+                    [jnp.ones((1,), jnp.float32),
+                     (ids_sorted[1:] != ids_sorted[:-1]).astype(jnp.float32)]
+                )
+                seen = (cm_query(st["query_cms"], ids_sorted) > 0).astype(jnp.float32)
+                return {
+                    "value_sum": st["value_sum"] + vsum,
+                    "query_count": st["query_count"] + vcnt,
+                    "value_min": jnp.minimum(st["value_min"], vmin),
+                    "value_max": jnp.maximum(st["value_max"], vmax),
+                    "straddled": st["straddled"] + jnp.sum(is_new * seen),
+                    "query_cms": cm_update(st["query_cms"], ids_sorted, weights=is_new),
+                }
+
+            fn = jax.jit(fold)
+            self._jit_cache["sketch_fold"] = fn
+        return fn(
+            {k: state[k] for k in ("value_sum", "query_count", "value_min", "value_max",
+                                   "straddled", "query_cms")},
+            indexes, values, inc,
+        )
+
+    @property
+    def straddled_queries(self) -> int:
+        """Estimated queries whose documents spanned more than one update batch (sketch
+        mode only; count-min backed, never an underestimate). Each such query was scored
+        per fragment — with batch-aligned queries this is 0 and sketch mode is exact."""
+        if self.approx != "sketch":
+            return 0
+        self._state.guard_readable()
+        return int(jax.device_get(self._state.tensors["straddled"]))
+
+    def _sketch_compute(self, state) -> Array:
+        cnt = state["query_count"]
+        straddled = int(jax.device_get(state["straddled"]))
+        if straddled:
+            rank_zero_warn(
+                f"{type(self).__name__}(approx='sketch'): ~{straddled} query id(s) appeared"
+                " in more than one update batch and were scored per fragment. Align query"
+                " boundaries with update batches (or use exact mode) for exact values.",
+                TorchMetricsUserWarning,
+            )
+        if self.aggregation == "min":
+            value = jnp.where(cnt > 0, state["value_min"], 0.0)
+        elif self.aggregation == "max":
+            value = jnp.where(cnt > 0, state["value_max"], 0.0)
+        else:
+            value = jnp.where(cnt > 0, state["value_sum"] / jnp.maximum(cnt, 1.0), 0.0)
+        return value
 
     # ------------------------------------------------------------ grouped kernel
     def _metric_kernel(self, preds: Array, target: Array, mask: Array) -> Array:
@@ -358,6 +503,8 @@ class RetrievalMetric(Metric):
         return values_np
 
     def _compute(self, state):
+        if self.approx == "sketch":
+            return self._sketch_compute(state)
         arrays = self._state_arrays(state)
         if arrays is None:
             return jnp.zeros(())

@@ -182,8 +182,12 @@ class RetrievalFallOut(RetrievalMetric):
     def _flat_values(self, ctx):
         return _flat.fall_out_flat(ctx)
 
+    _sketch_empty_from = "neg"  # sketch mode inherits the negative-target empty axis
+
     def _compute(self, state):
         # like base, but "empty" = no negative targets (reference fall_out.py:126)
+        if self.approx == "sketch":
+            return self._sketch_compute(state)
         arrays = self._state_arrays(state)
         if arrays is None:
             return jnp.zeros(())

@@ -594,6 +594,75 @@ def scenario_keyed_preemption_journal(
     }
 
 
+def scenario_sketch_preemption_journal(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Sketch twin of the preemption scenario: O(1) sketch states die mid-epoch.
+
+    A :class:`~torchmetrics_tpu.sketch.StreamingQuantile` (KLL compactor — callable-merge
+    state) and a sketch-mode ``BinaryAUROC`` (sum-merged histogram pair) journal a seeded
+    stream and are dropped cold at a seeded step. Fresh instances recover ``snapshot +
+    replay(journal)`` — the blob carries the validated ``sketch`` descriptor (kind,
+    capacity, error bound) — finish the stream, and must be BIT-identical with
+    uninterrupted runs: merge-based recovery is deterministic because every sketch update
+    is a pure static program and replay re-drives the exact same merges in the exact same
+    order. ``factory`` is unused (the scenario pins its own sketch metrics).
+    """
+    del factory, via  # sketch recovery is update-driven; metrics are pinned here
+    from torchmetrics_tpu.classification import BinaryAUROC
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.sketch import StreamingQuantile
+
+    n_batches = max(3, n_batches)
+    q_batches = [
+        np.asarray([rng.uniform(0.0, 100.0) for _ in range(64)], np.float32)
+        for _ in range(n_batches)
+    ]
+    a_batches = []
+    for _ in range(n_batches):
+        preds = np.asarray([rng.random() for _ in range(32)], np.float32)
+        target = np.asarray([1 if rng.random() < p else 0 for p in preds], np.int32)
+        a_batches.append((preds, target))
+    make_q = lambda: StreamingQuantile(q=0.5, capacity=32, levels=12)
+    make_a = lambda: BinaryAUROC(approx="sketch", sketch_bins=64)
+    preempt = rng.randrange(1, n_batches - 1)
+    jq = make_q().journal(f"{workdir}/sketch-q-wal", every_k=3)
+    ja = make_a().journal(f"{workdir}/sketch-a-wal", every_k=3)
+    for i in range(preempt + 1):
+        jq.update(q_batches[i])
+        ja.update(*a_batches[i])
+    # the process dies here: no flush, no clean exit, the instances are garbage
+    obs.telemetry.counter("robust.injected_faults").inc()
+    fresh_q, fresh_a = make_q(), make_a()
+    rec_q = _journal.recover(fresh_q, f"{workdir}/sketch-q-wal")
+    rec_a = _journal.recover(fresh_a, f"{workdir}/sketch-a-wal")
+    obs.telemetry.counter("robust.recovered").inc()
+    for i in range(preempt + 1, n_batches):
+        fresh_q.update(q_batches[i])
+        fresh_a.update(*a_batches[i])
+    ref_q, ref_a = make_q(), make_a()
+    for i in range(n_batches):
+        ref_q.update(q_batches[i])
+        ref_a.update(*a_batches[i])
+    quantile_identical = _identical(fresh_q.compute(), ref_q.compute())
+    auroc_identical = _identical(fresh_a.compute(), ref_a.compute())
+    # the recovered STATE must be bit-identical too, not just the finalised value
+    state_identical = all(
+        np.asarray(fresh_q._state.tensors[n]).tobytes()
+        == np.asarray(ref_q._state.tensors[n]).tobytes()
+        for n in fresh_q._state.tensors
+    )
+    return {
+        "passed": bool(quantile_identical and auroc_identical and state_identical),
+        "quantile_identical": quantile_identical,
+        "auroc_identical": auroc_identical,
+        "sketch_state_identical": state_identical,
+        "preempt_step": preempt,
+        "replayed": rec_q["replayed"] + rec_a["replayed"],
+        "snapshot_restored": bool(rec_q["snapshot_restored"] or rec_a["snapshot_restored"]),
+    }
+
+
 def scenario_sharded_preemption_restore(
     factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
 ) -> Dict[str, Any]:
@@ -739,6 +808,7 @@ class ChaosMatrix:
         "rank_death_quorum_rejoin": scenario_rank_death_quorum_rejoin,
         "preemption_journal_replay": scenario_preemption_journal_replay,
         "keyed_preemption_journal": scenario_keyed_preemption_journal,
+        "sketch_preemption_journal": scenario_sketch_preemption_journal,
         "sharded_preemption_restore": scenario_sharded_preemption_restore,
         "flap_evict_readmit": scenario_flap_evict_readmit,
     }

@@ -112,7 +112,25 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
     shard = _shard_descriptor(metric)
     if shard is not None:
         blob["sharding"] = shard
+    sketch = _sketch_descriptor(metric)
+    if sketch is not None:
+        blob["sketch"] = sketch
     return blob
+
+
+def _sketch_descriptor(metric: Any) -> Any:
+    """Per-state sketch descriptors (kind, parameters, error bound) for sketch-backed
+    metrics (``torchmetrics_tpu.sketch``), else None.
+
+    Validated on restore BEFORE the shape check: two sketches of different kind or
+    capacity can have compatible array shapes but are NOT mergeable states — restoring a
+    capacity-64 KLL blob into a capacity-64 count-min (or a different error contract)
+    must fail loudly, not corrupt quantiles silently.
+    """
+    specs = metric.__dict__.get("_sketch_specs")
+    if not specs:
+        return None
+    return {name: spec.describe() for name, spec in specs.items()}
 
 
 def _shard_descriptor(metric: Any) -> Any:
@@ -207,6 +225,23 @@ def _validate_blob(metric: Any, blob: Any) -> None:
                 f"Snapshot keys were accumulated by template {keys.get('template')!r},"
                 f" metric's template is {expected_keys['template']!r}"
             )
+    expected_sketch = _sketch_descriptor(metric)
+    if expected_sketch is not None:
+        sketch = blob.get("sketch")
+        if not isinstance(sketch, dict):
+            raise SnapshotError(
+                f"Snapshot has no sketch descriptor but {type(metric).__name__} registers"
+                f" sketch state(s) {sorted(expected_sketch)} — the blob was taken from a"
+                " non-sketch (or pre-sketch) metric."
+            )
+        for name, want in expected_sketch.items():
+            got = sketch.get(name)
+            if got != want:
+                raise SnapshotError(
+                    f"Snapshot sketch state {name!r} was accumulated as {got!r}, metric"
+                    f" expects {want!r} — sketches of different kind/capacity/error"
+                    " contract are not mergeable states; refusing to restore."
+                )
     for name, arr in tensors.items():
         cur = state.tensors[name]
         arr = np.asarray(arr)

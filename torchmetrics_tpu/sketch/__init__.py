@@ -1,0 +1,73 @@
+"""Streaming sketch states: O(1)-memory quantile/curve/retrieval accumulators.
+
+Fixed-shape, MERGEABLE sketch states (KLL compactor quantiles, count-min id counts,
+threshold histograms) registered through the ordinary ``add_state`` machinery so every
+engine seam — dispatch tiers, keyed tenant axes, ``Metric.shard()``, snapshot/journal,
+quorum ``process_sync`` where merge *is* the reduction — applies unchanged. The curve
+family (``BinaryPrecisionRecallCurve``/AUROC/ROC/…) and the retrieval metrics accept
+``approx="sketch"`` to swap their unbounded ``cat`` state for these. See
+``docs/sketches.md`` for the state model and error bounds.
+"""
+from torchmetrics_tpu.sketch.countmin import cm_error_bound, cm_init, cm_query, cm_update
+from torchmetrics_tpu.sketch.hist import (
+    auroc_error_bound,
+    hist_init,
+    hist_threshold_counts,
+    hist_update_classes,
+    hist_update_pair,
+    score_bucket,
+    suffix_counts,
+)
+from torchmetrics_tpu.sketch.kll import (
+    kll_cdf,
+    kll_count,
+    kll_init,
+    kll_merge,
+    kll_merge_stacked,
+    kll_quantiles,
+    kll_update,
+)
+from torchmetrics_tpu.sketch.metrics import StreamingHistogram, StreamingQuantile
+from torchmetrics_tpu.sketch.state import (
+    SKETCH_EQUIVALENTS,
+    SketchSpec,
+    countmin_spec,
+    hist_spec,
+    kll_spec,
+    note_update,
+    register_sketch_state,
+    sketch_descriptor,
+    sketch_state_bytes,
+)
+
+__all__ = [
+    "SKETCH_EQUIVALENTS",
+    "SketchSpec",
+    "StreamingHistogram",
+    "StreamingQuantile",
+    "auroc_error_bound",
+    "cm_error_bound",
+    "cm_init",
+    "cm_query",
+    "cm_update",
+    "countmin_spec",
+    "hist_init",
+    "hist_spec",
+    "hist_threshold_counts",
+    "hist_update_classes",
+    "hist_update_pair",
+    "kll_cdf",
+    "kll_count",
+    "kll_init",
+    "kll_merge",
+    "kll_merge_stacked",
+    "kll_quantiles",
+    "kll_spec",
+    "kll_update",
+    "note_update",
+    "register_sketch_state",
+    "score_bucket",
+    "sketch_descriptor",
+    "sketch_state_bytes",
+    "suffix_counts",
+]

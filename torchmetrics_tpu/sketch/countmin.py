@@ -1,0 +1,83 @@
+"""Count-min sketch over integer ids: fixed ``(depth, width)`` state, merge-by-sum.
+
+Built for the retrieval count paths (``torchmetrics_tpu.retrieval`` with
+``approx="sketch"``): the streaming retrieval mode finalises each batch's queries on the
+spot instead of keeping unbounded doc lists, and this sketch is how it KNOWS when that
+approximation was stressed — it counts query-id occurrences across update batches, so a
+query whose documents straddle a batch boundary is detected (and tallied) without storing
+any ids. Also usable standalone for approximate frequency queries over any int stream.
+
+Properties (standard CM guarantees, one-sided):
+
+- ``cm_query`` never underestimates a true count; the overestimate is at most
+  ``e·n/width`` with probability ``1 - e^(-depth)`` per query (n = total weight added).
+  At the defaults (depth 4, width 1024) that is ≤ ~0.27% of the stream per id at ~98%
+  confidence. The per-row hashes are fixed odd multiplicative constants (Knuth), so the
+  sketch is deterministic and two processes hash identically — a requirement for merge.
+- **Merge is elementwise sum**: the state registers with ``dist_reduce_fx="sum"``, so it
+  rides every engine seam (fused forward ladder, AOT donation, keyed segment reductions,
+  ``Metric.shard()`` named reductions, quorum ``process_sync``) with zero new code.
+- Counts accumulate in f32 — exact to 2^24 per cell, the package-wide counting contract
+  (``ops/histogram.py``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.ops.histogram import bincount_weighted
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 1024
+
+#: fixed odd 32-bit multiplicative-hash constants, one per row (Knuth's 2^32/phi seed,
+#: decorrelated by fixed odd offsets); deterministic across processes by construction
+_HASH_MULTIPLIERS = (2654435761, 2246822519, 3266489917, 668265263, 374761393, 2654435769, 3141592653, 2718281829)
+
+
+def cm_init(depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH) -> Array:
+    """Empty sketch: ``(depth, width)`` f32 zeros (the sum identity)."""
+    if not (1 <= depth <= len(_HASH_MULTIPLIERS)):
+        raise ValueError(f"countmin depth must be in [1, {len(_HASH_MULTIPLIERS)}], got {depth}")
+    if width < 2:
+        raise ValueError(f"countmin width must be >= 2, got {width}")
+    return jnp.zeros((depth, width), jnp.float32)
+
+
+def _hash_rows(ids: Array, depth: int, width: int) -> Array:
+    """(depth, N) int32 bucket indices in [0, width) via multiplicative hashing."""
+    ids_u = jnp.asarray(ids).reshape(-1).astype(jnp.uint32)
+    rows = []
+    for d in range(depth):
+        h = ids_u * jnp.uint32(_HASH_MULTIPLIERS[d]) + jnp.uint32(0x9E3779B9 * (d + 1) & 0xFFFFFFFF)
+        rows.append(((h >> jnp.uint32(16)) % jnp.uint32(width)).astype(jnp.int32))
+    return jnp.stack(rows)
+
+
+def cm_update(state: Array, ids: Array, weights: Array = None) -> Array:
+    """Add ``weights`` (default 1) per id; pure and shape-static (jit/scan/vmap-safe)."""
+    depth, width = state.shape
+    hashed = _hash_rows(ids, depth, width)
+    rows = [
+        bincount_weighted(hashed[d], width, weights=weights, dtype=jnp.float32)
+        for d in range(depth)
+    ]
+    return state + jnp.stack(rows)
+
+
+def cm_query(state: Array, ids: Array) -> Array:
+    """Estimated counts for ``ids`` — never below the true count."""
+    depth, width = state.shape
+    hashed = _hash_rows(ids, depth, width)
+    per_row = jnp.stack([state[d, hashed[d]] for d in range(depth)])
+    return jnp.min(per_row, axis=0)
+
+
+def cm_error_bound(width: int = DEFAULT_WIDTH) -> float:
+    """Documented per-query overestimate bound as a fraction of total stream weight."""
+    return 2.718281828 / width
+
+
+def cm_state_bytes(depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH) -> int:
+    """Fixed state footprint in bytes (f32), independent of ids seen."""
+    return depth * width * 4

@@ -1,0 +1,166 @@
+"""Sketch state kinds: fixed-shape mergeable accumulators registered through ``add_state``.
+
+A *sketch state* is an ordinary tensor state whose reduction is a MERGE — either a named
+``"sum"`` (count-min, threshold histograms) or a trace-safe callable (the KLL compactor)
+— plus a :class:`SketchSpec` descriptor pinning its kind, shape parameters, and
+documented error bound. Because the state is a plain fixed-shape ``jax.Array`` and the
+merge is its ``dist_reduce_fx``, every existing engine seam applies UNCHANGED:
+
+- dispatch tiers: jit update, fused forward (the merge rides the in-graph reduce ladder),
+  AOT+donation, ``update_scan``, buffered windows;
+- ``KeyedMetric`` tenant axes (sum-merged sketches decompose under segment reductions;
+  the KLL sketch declares ``keyed_decomposable=False`` and takes the vmap fallback);
+- ``Metric.shard()`` placement and the reduce-scatter sharded sync (sum-merged sketches
+  partition; callable-merged ones stay replicated);
+- snapshot/journal/quorum ``process_sync``, where the merge IS the reduction — a quorum
+  of partial sketches folds into one with the same bound.
+
+The registered specs surface in the snapshot blob as a validated ``sketch`` descriptor
+(``robust/checkpoint.py``) and drive the ``sketch.*`` obs counters. See
+``docs/sketches.md``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.sketch import countmin as _cm
+from torchmetrics_tpu.sketch import hist as _hist
+from torchmetrics_tpu.sketch import kll as _kll
+
+#: metric classes that offer a sketch twin for their unbounded ``cat`` state — the
+#: registry behind jaxlint TPU014 (``_lint/rules.py`` mirrors these names; a sync test
+#: keeps the two sets identical) and the docs table in ``docs/sketches.md``
+SKETCH_EQUIVALENTS = frozenset({
+    "BinaryPrecisionRecallCurve",
+    "MulticlassPrecisionRecallCurve",
+    "MultilabelPrecisionRecallCurve",
+    "RetrievalMetric",
+})
+
+
+@dataclass(frozen=True)
+class SketchSpec:
+    """Descriptor of one sketch state: kind + shape parameters + documented error bound.
+
+    ``params`` pins everything needed to rebuild (and to validate a snapshot against):
+    restoring a blob whose sketch descriptor disagrees in kind or parameters raises
+    ``SnapshotError`` — two sketches of different capacity are NOT mergeable states.
+    """
+
+    kind: str  # "kll" | "countmin" | "hist"
+    params: Dict[str, int] = field(default_factory=dict)
+    error_bound: float = 0.0
+    reduce_fx: Any = "sum"
+
+    def init(self):
+        if self.kind == "kll":
+            return _kll.kll_init(self.params["capacity"], self.params["levels"])
+        if self.kind == "countmin":
+            return _cm.cm_init(self.params["depth"], self.params["width"])
+        if self.kind == "hist":
+            return _hist.hist_init(self.params["bins"], self.params.get("classes"))
+        raise ValueError(f"unknown sketch kind {self.kind!r}")
+
+    def state_bytes(self) -> int:
+        if self.kind == "kll":
+            return _kll.kll_state_bytes(self.params["capacity"], self.params["levels"])
+        if self.kind == "countmin":
+            return _cm.cm_state_bytes(self.params["depth"], self.params["width"])
+        return _hist.hist_state_bytes(self.params["bins"], self.params.get("classes")) // 2
+
+    def describe(self) -> Dict[str, Any]:
+        """Snapshot-blob descriptor payload (plain JSON-able scalars)."""
+        return {
+            "kind": self.kind,
+            "params": {k: int(v) for k, v in self.params.items() if v is not None},
+            "error_bound": float(self.error_bound),
+        }
+
+
+def kll_spec(
+    capacity: int = _kll.DEFAULT_CAPACITY, levels: int = _kll.DEFAULT_LEVELS
+) -> SketchSpec:
+    """KLL quantile sketch spec; merge is the trace-safe stacked compactor fold."""
+    return SketchSpec(
+        kind="kll",
+        params={"capacity": int(capacity), "levels": int(levels)},
+        error_bound=_kll.DEFAULT_RANK_ERROR * (_kll.DEFAULT_CAPACITY / capacity),
+        reduce_fx=_kll.kll_merge_stacked,
+    )
+
+
+def countmin_spec(depth: int = _cm.DEFAULT_DEPTH, width: int = _cm.DEFAULT_WIDTH) -> SketchSpec:
+    return SketchSpec(
+        kind="countmin",
+        params={"depth": int(depth), "width": int(width)},
+        error_bound=_cm.cm_error_bound(width),
+        reduce_fx="sum",
+    )
+
+
+def hist_spec(bins: int = _hist.DEFAULT_BINS, classes: Optional[int] = None) -> SketchSpec:
+    return SketchSpec(
+        kind="hist",
+        params={"bins": int(bins), "classes": None if classes is None else int(classes)},
+        error_bound=_hist.auroc_error_bound(bins),
+        reduce_fx="sum",
+    )
+
+
+def register_sketch_state(metric: Any, name: str, spec: SketchSpec) -> None:
+    """Register ``name`` on ``metric`` as a sketch state: ordinary ``add_state`` with the
+    spec's default and merge reduction, plus the descriptor bookkeeping (snapshot
+    validation, obs counters, TPU014's "has a sketch twin" evidence)."""
+    metric.add_state(name, spec.init(), dist_reduce_fx=spec.reduce_fx)
+    specs = metric.__dict__.setdefault("_sketch_specs", {})
+    specs[name] = spec
+    obs.telemetry.counter("sketch.states_registered").inc()
+
+
+def sketch_descriptor(metric: Any) -> Optional[Dict[str, Any]]:
+    """Per-state sketch descriptors for the snapshot blob, or None for plain metrics."""
+    specs = metric.__dict__.get("_sketch_specs")
+    if not specs:
+        return None
+    return {name: spec.describe() for name, spec in specs.items()}
+
+
+def sketch_state_bytes(metric: Any) -> int:
+    """Total fixed sketch-state footprint of ``metric`` in bytes."""
+    specs = metric.__dict__.get("_sketch_specs") or {}
+    total = 0
+    for name in specs:
+        arr = metric._state.tensors.get(name)
+        total += int(arr.size * arr.dtype.itemsize) if arr is not None else 0
+    return total
+
+
+def note_update(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+    """Host-side obs accounting for one sketch-metric update (NEVER called from traced
+    code — jaxlint TPU009): merge launches, the statically known compaction stages the
+    batch triggered, and the bytes a ``cat`` twin would have appended instead.
+    """
+    specs = metric.__dict__.get("_sketch_specs") or {}
+    if not specs:
+        return
+    batch_elems = 0
+    batch_bytes = 0
+    for v in list(args) + list(kwargs.values()):
+        size = getattr(v, "size", None)
+        if size is not None:
+            batch_elems = max(batch_elems, int(size))
+            batch_bytes += int(size) * int(getattr(getattr(v, "dtype", None), "itemsize", 4) or 4)
+    compactions = 0
+    for spec in specs.values():
+        if spec.kind == "kll" and batch_elems:
+            cap = spec.params["capacity"]
+            # static halving count of the bulk pre-compaction (kll._bulk_fragments)
+            compactions += max(0, math.ceil(math.log2(max(batch_elems, 1) / cap))) if batch_elems > cap else 0
+    obs.telemetry.counter("sketch.merges").inc(len(specs))
+    if compactions:
+        obs.telemetry.counter("sketch.compactions").inc(compactions)
+    if batch_bytes:
+        obs.telemetry.counter("sketch.state_bytes_saved").inc(batch_bytes)

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -35,6 +35,15 @@ keyed-smoke:
 shard-smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --sharded --smoke > /tmp/tm_shard_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_shard_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; rep=ex['sync_bytes_per_compute_replicated']; shd=ex['sync_bytes_per_compute_sharded']; assert shd < rep, (shd, rep); bits=[v for k,v in ex.items() if k.startswith('sharded_bit_identical')]; assert bits and all(bits), ex; assert ex['lazy_reduce_fires'] <= ex['sharded_compute_epochs'] and ex['lazy_reduce_reuses'] >= 1, ex; print('shard-smoke ok: %dB sharded vs %dB allgather per compute (%.1fx), bit-identical' % (shd, rep, rep/shd))"
+
+# serving lane (docs/serving.md): tiny-N async-ingestion bench asserting the acceptance
+# bar — async completion throughput >= the synchronous loop at smoke shapes (drain-side
+# coalescing: k dispatches -> 1 update_batches scan), ZERO sheds and zero backpressure
+# stalls in block mode, exact shed accounting under forced overflow, and bit-identity of
+# the async value vs the synchronous run AND vs a preempted-mid-overlap journal replay
+serve-smoke:
+	python bench.py --serve --smoke > /tmp/tm_serve_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_serve_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; r=ex['serve_async_vs_sync_completion']; assert r >= 1.0, ('async completion fell below sync', ex); assert ex['serve_block_mode_sheds'] == 0 and ex['serve_block_mode_stalls'] == 0, ex; bits=[v for k,v in ex.items() if k.startswith('serve_bit_identical')]; assert bits and all(bits), ex; assert ex['serve_overload_sheds_exact'], ex; print('serve-smoke ok: async %.2fx sync, sustained %.2fx @1.2x offered, enqueue p99 %sus' % (r, ex['serve_sustained_vs_sync'], ex['serve_enqueue_p99_us']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

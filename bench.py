@@ -709,6 +709,243 @@ def sketch_main(smoke: bool) -> None:
     )
 
 
+def bench_serve(batch: int, n_batches: int, poisson_events: int) -> dict:
+    """``--serve`` scenario (docs/serving.md): the async ingestion engine under load.
+
+    The request stream is realistic serving traffic: each batch arrives as a
+    zlib-compressed logits payload the handler must decode (pure-C decompress —
+    GIL-released host work, the thing the drain thread overlaps). Four lanes:
+
+    1. **synchronous baseline** — decode + ``update`` per batch; its throughput is the
+       service rate everything else is calibrated against.
+    2. **sustained Poisson lane (the gate)** — arrivals paced at 1.2x the synchronous
+       rate, handler does decode + ``update_async`` (block mode): the engine must
+       COMMIT above the synchronous throughput with zero sheds and zero backpressure
+       stalls, with p50/p99 enqueue latency recorded. Self-calibrating: the offered
+       rate scales with whatever this machine's sync rate is.
+    3. **bit identity** — the async value equals the synchronous value, and a
+       journaled async run preempted MID-OVERLAP (window non-empty) recovers
+       ``snapshot + replay`` to the same bits.
+    4. **overload shed lane** — unpaced enqueues against a held drain, ``on_full=
+       "shed"``: graceful degradation with EXACT shed accounting, never OOM.
+    """
+    import random as _random
+    import tempfile
+    import zlib
+
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.serve import ServeOptions
+
+    rng = np.random.RandomState(11)
+    logits = rng.randn(n_batches, batch, NUM_CLASSES).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, size=(n_batches, batch)).astype(np.int32)
+    payloads = [
+        (zlib.compress(logits[i].tobytes(), 1), zlib.compress(target[i].tobytes(), 1))
+        for i in range(n_batches)
+    ]
+
+    def _decode(pp: bytes, tp: bytes):
+        p = np.frombuffer(zlib.decompress(pp), np.float32).reshape(batch, NUM_CLASSES)
+        t = np.frombuffer(zlib.decompress(tp), np.int32)
+        return p, t
+
+    def make():
+        # validate_args=False is the serving hot-path configuration: per-request host
+        # validation would cost more than the update dispatch at these batch sizes
+        return MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+
+    serve_opts = ServeOptions(
+        max_inflight=64, on_full="block", queue_timeout_s=120.0, coalesce=16, linger_ms=2.0
+    )
+
+    # --- lane 1: completion throughput, sync vs async (paired windows) -------------
+    m_sync = make()
+    m_sync.update(*_decode(*payloads[0]))  # compile out of window
+    m_sync.reset()
+
+    def sync_window():
+        m_sync.reset()
+        for b in payloads:
+            m_sync.update(*_decode(*b))
+        jax.block_until_ready(list(m_sync._state.tensors.values()))
+
+    def _warm_async(metric, engine):
+        """Compile the plain update AND every quantized coalesce width out of window."""
+        metric.update_async(*_decode(*payloads[0]))
+        engine.quiesce()
+        w = 2
+        while w <= engine.options.coalesce:
+            engine.pause()
+            for i in range(w):
+                metric.update_async(*_decode(*payloads[i % n_batches]))
+            engine.resume()
+            engine.quiesce()
+            w *= 2
+        metric.reset()
+
+    m_async = make()
+    eng = m_async.serve(serve_opts)
+    _warm_async(m_async, eng)
+
+    def async_window():
+        m_async.reset()
+        for b in payloads:
+            m_async.update_async(*_decode(*b))
+        eng.quiesce()
+        jax.block_until_ready(list(m_async._state.tensors.values()))
+
+    # interleave the two lanes so machine drift (CPU contention, frequency steps)
+    # lands on both equally — unpaired best-ofs measured on a noisy host can swing
+    # +-20% between lanes and drown the structural difference
+    best_sync = best_async = float("inf")
+    for _ in range(6):
+        t0 = time.perf_counter()
+        sync_window()
+        best_sync = min(best_sync, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        async_window()
+        best_async = min(best_async, time.perf_counter() - t0)
+    sync_rate = n_batches / best_sync
+    async_rate = n_batches / best_async
+
+    # --- lane 2: sustained Poisson arrivals at 1.2x the service rate (the gate) ----
+    arrival = _random.Random(23)
+    lam = 1.2 * sync_rate
+    m_p = make()
+    eng_p = m_p.serve(serve_opts)
+    _warm_async(m_p, eng_p)
+
+    def poisson_pass(events: int) -> tuple:
+        lats = []
+        t0 = time.perf_counter()
+        next_t = t0
+        committed0 = eng_p.stats()["committed"]
+        for i in range(events):
+            next_t += arrival.expovariate(lam)
+            args = _decode(*payloads[i % n_batches])  # handler decodes, then enqueues
+            # hybrid pacing: coarse sleep, then spin the final ms — time.sleep()'s
+            # ~100us overshoot at sub-ms inter-arrivals would silently lower the
+            # offered rate below its 1.2x target
+            remaining = next_t - time.perf_counter()
+            if remaining > 0.002:
+                time.sleep(remaining - 0.001)
+            while time.perf_counter() < next_t:
+                pass
+            s = time.perf_counter()
+            m_p.update_async(*args)
+            lats.append(time.perf_counter() - s)
+        eng_p.quiesce()
+        jax.block_until_ready(list(m_p._state.tensors.values()))
+        wall = time.perf_counter() - t0
+        return (eng_p.stats()["committed"] - committed0) / wall, lats
+
+    poisson_pass(min(16, poisson_events))  # shake out residual first-pass jitter
+    sustained, latencies = 0.0, []
+    for _ in range(3):  # the lane is milliseconds; best-of covers GC/contention spikes
+        m_p.reset()
+        rate, lats = poisson_pass(poisson_events)
+        latencies.extend(lats)
+        sustained = max(sustained, rate)
+    stats_p = eng_p.stats()
+    lat_sorted = sorted(latencies)
+
+    def _pct(p: float) -> float:
+        return lat_sorted[max(0, min(len(lat_sorted) - 1, int(round(p / 100.0 * (len(lat_sorted) - 1)))))]
+
+    print(
+        f"serve: sync {sync_rate:.1f}/s, async completion {async_rate:.1f}/s,"
+        f" sustained@1.2x {sustained:.1f}/s (sheds={stats_p['shed']},"
+        f" stalls={stats_p['backpressure_stalls']})",
+        file=sys.stderr,
+    )
+
+    # --- lane 3: bit identity (async vs sync, and preempt-mid-overlap replay) ------
+    v_sync = np.asarray(m_sync.compute())
+    bit_identical = bool(np.array_equal(v_sync, np.asarray(m_async.compute())))
+
+    jdir = tempfile.mkdtemp(prefix="tm-serve-bench-wal-")
+    m_j = make()
+    eng_j = m_j.serve(ServeOptions(max_inflight=64), journal=_journal.Journal(jdir))
+    half = n_batches // 2
+    for b in payloads[:half]:
+        m_j.update_async(*_decode(*b))
+    eng_j.quiesce()
+    eng_j.pause()  # hold the drain: the tail stays in the window, journaled only
+    for b in payloads[half:]:
+        m_j.update_async(*_decode(*b))
+    eng_j.abandon()  # preemption mid-overlap
+    m_rec = make()
+    _journal.recover(m_rec, jdir)
+    replay_identical = bool(np.array_equal(v_sync, np.asarray(m_rec.compute())))
+
+    # --- lane 4: overload shed (held drain, exact drop accounting) -----------------
+    m_o = make()
+    eng_o = m_o.serve(ServeOptions(max_inflight=8, on_full="shed", queue_timeout_s=5.0))
+    m_o.update_async(*_decode(*payloads[0]))
+    eng_o.quiesce()
+    m_o.reset()
+    eng_o.pause()
+    overload_tickets = [m_o.update_async(*_decode(*payloads[i % n_batches])) for i in range(24)]
+    eng_o.resume()
+    eng_o.quiesce()
+    overload_sheds = sum(1 for t in overload_tickets if t.shed)
+
+    return {
+        "serve_sync_updates_per_sec": round(sync_rate, 2),
+        "serve_async_updates_per_sec": round(async_rate, 2),
+        "serve_async_vs_sync_completion": round(async_rate / sync_rate, 3),
+        "serve_sustained_updates_per_sec": round(sustained, 2),
+        "serve_sustained_vs_sync": round(sustained / sync_rate, 3),
+        "serve_poisson_target_rate": round(lam, 2),
+        "serve_poisson_events": poisson_events,
+        "serve_block_mode_sheds": stats_p["shed"],
+        "serve_block_mode_stalls": stats_p["backpressure_stalls"],
+        "serve_enqueue_p50_us": round(_pct(50) * 1e6, 1),
+        "serve_enqueue_p99_us": round(_pct(99) * 1e6, 1),
+        "serve_bit_identical_async_vs_sync": bit_identical,
+        "serve_bit_identical_preempt_replay": replay_identical,
+        "serve_overload_sheds_exact": overload_sheds == 24 - 8,
+        "serve_overload_sheds": overload_sheds,
+        "serve_batch": batch,
+        "serve_n_batches": n_batches,
+    }
+
+
+def serve_main(smoke: bool) -> None:
+    """``bench.py --serve [--smoke]``: one JSON line with the serving scenario numbers."""
+    if smoke:
+        batch, n_batches, poisson_events = 512, 64, 96
+    else:
+        batch, n_batches, poisson_events = 2048, 256, 600
+    extras = bench_serve(batch, n_batches, poisson_events)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "serve_sustained_updates_per_sec",
+                "value": extras["serve_sustained_updates_per_sec"],
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "committed updates/s under Poisson arrivals at 1.2x the synchronous"
+                    " service rate (MulticlassAccuracy via update_async, bounded"
+                    " in-flight window; sync-vs-async completion rates, p50/p99 enqueue"
+                    " latency, exact shed counts, and bit-identity flags in extras)"
+                ),
+                "vs_baseline": extras.get("serve_async_vs_sync"),
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     """Same sweep through the reference torchmetrics (torch backend)."""
     import types
@@ -1408,6 +1645,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         sharded_main(smoke)
+    elif "--serve" in sys.argv:
+        # serving scenario (make serve-smoke / docs/serving.md): smoke pins CPU via the
+        # config API like the other lanes; full mode probes for a healthy platform
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        serve_main(smoke)
     elif "--sketch" in sys.argv:
         # sketch-state scenario (make sketch-smoke / docs/sketches.md): smoke pins CPU
         # via the config API like the other lanes; full mode probes for a healthy platform

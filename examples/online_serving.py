@@ -1,0 +1,97 @@
+"""Online serving demo: live traffic through the async ingestion tier (docs/serving.md).
+
+A request handler must not block on metric dispatch. This demo drives Poisson-arriving
+scoring traffic through ``update_async`` behind a BOUNDED in-flight window: the handler
+pays microseconds per request (enqueue + staged transfer), a background drain coalesces
+bursts into single ``update_batches`` scan launches, and overload degrades gracefully
+(counted sheds) instead of growing a queue without bound. A write-ahead journal appended
+at ENQUEUE time makes the whole stream preemption-safe: the demo kills the engine with
+batches still in flight and recovers a fresh metric bit-identically.
+"""
+import random
+import tempfile
+import time
+
+import numpy as np
+
+import _env
+
+_env.pin_platform()
+
+from torchmetrics_tpu.classification import MulticlassAccuracy  # noqa: E402
+from torchmetrics_tpu.robust.journal import Journal, recover  # noqa: E402
+from torchmetrics_tpu.serve import ServeOptions  # noqa: E402
+
+NUM_CLASSES = 5
+BATCH = 512
+N_REQUESTS = 60
+
+rng = np.random.RandomState(7)
+requests = [
+    (
+        rng.randn(BATCH, NUM_CLASSES).astype(np.float32),
+        rng.randint(0, NUM_CLASSES, BATCH).astype(np.int32),
+    )
+    for _ in range(N_REQUESTS)
+]
+
+# ---------------------------------------------------------------- live traffic ingest
+wal_dir = tempfile.mkdtemp(prefix="serving-wal-")
+metric = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+engine = metric.serve(
+    ServeOptions(max_inflight=32, on_full="block", coalesce=16, linger_ms=1.0),
+    journal=Journal(wal_dir),
+)
+
+arrivals = random.Random(3)
+enqueue_us = []
+for preds, target in requests:
+    time.sleep(arrivals.expovariate(2000.0))  # ~2k requests/s Poisson arrivals
+    t0 = time.perf_counter()
+    metric.update_async(preds, target)  # handler returns immediately; WAL'd at enqueue
+    enqueue_us.append((time.perf_counter() - t0) * 1e6)
+
+live_value = float(metric.compute())  # quiesces the window: exact over all 60 requests
+stats = engine.stats()
+enqueue_us.sort()
+print(f"accuracy over {N_REQUESTS} requests: {live_value:.4f}")
+print(
+    f"enqueue latency p50={enqueue_us[len(enqueue_us) // 2]:.0f}us"
+    f" p99={enqueue_us[int(0.99 * (len(enqueue_us) - 1))]:.0f}us;"
+    f" committed={stats['committed']}, shed={stats['shed']},"
+    f" stalls={stats['backpressure_stalls']}"
+)
+
+# ------------------------------------------------- preemption mid-overlap + recovery
+engine.pause()  # the drain stalls with traffic still arriving...
+for preds, target in requests[:5]:
+    metric.update_async(preds, target)  # journaled at enqueue, never applied
+dropped = engine.abandon()  # ...and the process is preempted mid-overlap
+print(f"preempted with {dropped} batches in the window (state never saw them)")
+
+fresh = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+rec = recover(fresh, wal_dir)  # snapshot + replay(journal), bit-identical
+recovered_value = float(fresh.compute())
+
+reference = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+for preds, target in requests:
+    reference.update(preds, target)
+for preds, target in requests[:5]:
+    reference.update(preds, target)
+assert recovered_value == float(reference.compute()), "recovery must be bit-identical"
+print(
+    f"recovered {rec['replayed']} journaled batches -> accuracy {recovered_value:.4f}"
+    " (bit-identical with the never-preempted stream)"
+)
+
+# -------------------------------------------------------- overload: graceful shedding
+shedder = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+eng2 = shedder.serve(ServeOptions(max_inflight=4, on_full="shed"))
+eng2.pause()  # a stalled drain under continuing traffic
+tickets = [shedder.update_async(p, t) for p, t in requests[:12]]
+eng2.resume()
+shedder.compute()
+print(
+    f"overload: {sum(t.shed for t in tickets)} of {len(tickets)} requests shed"
+    f" (window bound 4) — backpressure, never OOM; exact count in serve.shed"
+)

@@ -1,0 +1,97 @@
+"""TPU015: host-blocking calls reachable from an async serve/drain path."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+
+def _tpu015(source: str, path: str = "pkg/module.py"):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU015"]
+
+
+MARKED_POSITIVE = """
+def drain_step(engine, out):  # jaxlint: serve-path
+    engine.commit(out.block_until_ready())
+"""
+
+MARKED_NEGATIVE = """
+def drain_step(engine, out):  # jaxlint: serve-path
+    engine.commit(out)  # dispatch only: the future resolves on device time
+"""
+
+
+class TestServePathMarker:
+    def test_marked_function_flags_blocking_call(self):
+        findings = _tpu015(MARKED_POSITIVE)
+        assert len(findings) == 1
+        assert "block_until_ready" in findings[0].message
+
+    def test_marked_function_without_blocking_call_is_clean(self):
+        assert _tpu015(MARKED_NEGATIVE) == []
+
+    def test_unmarked_function_is_out_of_scope(self):
+        src = MARKED_POSITIVE.replace("  # jaxlint: serve-path", "")
+        assert _tpu015(src) == []
+
+
+class TestServeDirectory:
+    def test_serve_module_functions_are_roots(self):
+        src = "def commit(ticket, out):\n    ticket.resolve(out.item())\n"
+        assert len(_tpu015(src, path="torchmetrics_tpu/serve/engine.py")) == 1
+        assert _tpu015(src, path="torchmetrics_tpu/ops/engine.py") == []
+
+    def test_device_get_and_tolist_flagged(self):
+        src = (
+            "import jax\n"
+            "def drain(x):\n"
+            "    return jax.device_get(x), x.tolist()\n"
+        )
+        findings = _tpu015(src, path="pkg/serve/drain.py")
+        assert len(findings) == 2
+
+
+class TestReachability:
+    def test_helper_reached_through_call_graph(self):
+        src = """
+def helper(x):
+    return x.block_until_ready()
+
+def drain(t):  # jaxlint: serve-path
+    return helper(t)
+"""
+        findings = _tpu015(src)
+        assert len(findings) == 1
+        assert "helper" in findings[0].message
+
+    def test_nested_def_inherits_serve_path(self):
+        src = """
+def drain(t):  # jaxlint: serve-path
+    def inner(x):
+        return x.item()
+    return inner(t)
+"""
+        assert len(_tpu015(src)) == 1
+
+    def test_unreached_helper_is_clean(self):
+        src = """
+def helper(x):
+    return x.block_until_ready()
+
+def drain(t):  # jaxlint: serve-path
+    return t
+"""
+        assert _tpu015(src) == []
+
+
+class TestSuppressionAndRegistry:
+    def test_inline_disable_waives(self):
+        src = (
+            "def drain(t):  # jaxlint: serve-path\n"
+            "    return t.item()  # jaxlint: disable=TPU015\n"
+        )
+        assert _tpu015(src) == []
+
+    def test_rule_registered_with_metadata(self):
+        meta = RULE_META["TPU015"]
+        assert meta["severity"] == "perf"
+        assert "serve" in meta["summary"]

@@ -1,0 +1,72 @@
+"""Decorrelated jitter on the bounded-sync retry backoff (SyncOptions.backoff_jitter)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.parallel.sync import SyncOptions, process_sync
+from torchmetrics_tpu.robust.chaos import CollectiveTimeout
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rng(monkeypatch):
+    monkeypatch.setenv("TM_TPU_CHAOS_SEED", "1234")
+    sync_mod.reset_backoff_rng()
+    yield
+    sync_mod.reset_backoff_rng()
+
+
+def _sync_with_retries(opts: SyncOptions) -> None:
+    gather = CollectiveTimeout(fail_attempts=2, hang_s=None)
+    state = {"sum_value": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)}
+    process_sync(state, {"sum_value": "sum"}, gather_fn=gather, options=opts)
+
+
+class TestDecorrelatedJitter:
+    def test_jittered_pauses_are_not_the_exponential_ladder(self):
+        opts = SyncOptions(timeout_s=5.0, retries=4, backoff_s=0.01, world=1)
+        assert opts.backoff_jitter  # jitter is the default
+        rng = sync_mod._backoff_rng()
+        draws = [rng.uniform(0.01, 0.03) for _ in range(8)]
+        # decorrelated draws vary; the pure ladder would be exactly 0.01, 0.02, 0.04...
+        assert len({round(d, 6) for d in draws}) > 1
+
+    def test_seeded_rng_is_deterministic_under_chaos_seed(self):
+        a = sync_mod._backoff_rng().random()
+        sync_mod.reset_backoff_rng()
+        b = sync_mod._backoff_rng().random()
+        assert a == b  # same TM_TPU_CHAOS_SEED -> same jitter stream
+
+    def test_jitter_off_keeps_exponential_schedule(self):
+        # with jitter disabled the retry path still converges (legacy 2^k ladder)
+        opts = SyncOptions(timeout_s=5.0, retries=4, backoff_s=0.005, backoff_jitter=False, world=1)
+        t0 = time.monotonic()
+        _sync_with_retries(opts)
+        assert time.monotonic() - t0 < 4.0
+
+    def test_jittered_retry_converges_and_stays_in_deadline(self):
+        opts = SyncOptions(timeout_s=5.0, retries=4, backoff_s=0.005, world=1)
+        t0 = time.monotonic()
+        _sync_with_retries(opts)
+        assert time.monotonic() - t0 < 4.0
+
+    def test_env_knob_disables_jitter(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_JITTER, "0")
+        assert sync_mod.sync_options_from_env().backoff_jitter is False
+        monkeypatch.setenv(sync_mod.ENV_SYNC_JITTER, "1")
+        assert sync_mod.sync_options_from_env().backoff_jitter is True
+
+    def test_metric_sync_end_to_end_with_jittered_retries(self):
+        m = SumMetric()
+        m.update(np.asarray([1.0, 2.0], np.float32))
+        gather = CollectiveTimeout(fail_attempts=1, hang_s=None)
+        m.dist_sync_fn = gather
+        m.distributed_available_fn = lambda: True
+        m.sync_options = SyncOptions(timeout_s=5.0, retries=3, backoff_s=0.005, world=1)
+        value = m.compute()
+        assert float(value) == 3.0
+        assert gather.calls >= 2  # the retry (with jittered pause) actually fired

@@ -1,0 +1,83 @@
+"""Journal-dir exclusive writer lock: two live proxies must not interleave records."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.robust import journal as journal_mod
+from torchmetrics_tpu.utils.exceptions import JournalError
+
+
+def _b(v: float):
+    return np.full((4,), v, np.float32)
+
+
+class TestWriterLock:
+    def test_second_proxy_rejected_with_holder_pid(self, tmp_path):
+        jm1 = SumMetric().journal(tmp_path / "wal")
+        with pytest.raises(JournalError, match=str(os.getpid())):
+            SumMetric().journal(tmp_path / "wal")
+        jm1.close()
+
+    def test_close_releases_lock(self, tmp_path):
+        jm1 = SumMetric().journal(tmp_path / "wal")
+        jm1.update(_b(1.0))
+        jm1.close()
+        jm2 = SumMetric().journal(tmp_path / "wal")  # lock released: fresh proxy opens
+        jm2.update(_b(2.0))
+        jm2.close()
+
+    def test_context_exit_releases_lock_even_on_error(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            with SumMetric().journal(tmp_path / "wal") as jm:
+                jm.update(_b(1.0))
+                raise RuntimeError("boom")
+        SumMetric().journal(tmp_path / "wal").close()  # no JournalError: lock released
+
+    def test_stale_lock_of_dead_pid_is_stolen(self, tmp_path):
+        wal = tmp_path / "wal"
+        os.makedirs(wal)
+        # forge a lockfile from a pid that cannot be alive (pid_max is < 2**22 + 1)
+        with open(wal / journal_mod.LOCK_FILENAME, "w") as fh:
+            fh.write("4194305:deadbeef")
+        with pytest.warns(UserWarning, match="stale journal writer lock"):
+            jm = SumMetric().journal(wal)
+        jm.update(_b(1.0))
+        jm.close()
+
+    def test_recover_breaks_the_dead_writers_lock(self, tmp_path):
+        wal = tmp_path / "wal"
+        jm = SumMetric().journal(wal, every_k=100)
+        jm.update(_b(1.0))
+        jm.update(_b(2.0))
+        # the process "dies" here: no close(), the lockfile is left armed
+        assert os.path.exists(wal / journal_mod.LOCK_FILENAME)
+        fresh = SumMetric()
+        rec = journal_mod.recover(fresh, wal)
+        assert rec["replayed"] == 2
+        # recovery asserted the old writer dead and broke its lock: a new proxy opens
+        jm2 = fresh.journal(wal, every_k=100)
+        jm2.update(_b(3.0))
+        assert float(fresh.compute()) == 4.0 + 8.0 + 12.0
+        jm2.close()
+
+    def test_plain_journal_reader_needs_no_lock(self, tmp_path):
+        # Journal objects (replay/buffered-seam readers) never take the writer lock
+        jm = SumMetric().journal(tmp_path / "wal")
+        jm.update(_b(1.0))
+        jr = journal_mod.Journal(tmp_path / "wal")
+        assert jr.pending == 1
+        jm.close()
+
+    def test_release_is_token_safe_after_steal(self, tmp_path):
+        wal = tmp_path / "wal"
+        jm1 = SumMetric().journal(wal)
+        journal_mod.break_lock(wal)  # simulate recovery by another actor
+        jm2 = SumMetric().journal(wal)  # takes a fresh lock with its own token
+        jm1.close()  # must NOT unlink jm2's lock (token mismatch)
+        assert os.path.exists(wal / journal_mod.LOCK_FILENAME)
+        jm2.close()
+        assert not os.path.exists(wal / journal_mod.LOCK_FILENAME)

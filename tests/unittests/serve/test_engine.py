@@ -1,0 +1,268 @@
+"""Async ingestion engine: tickets, FIFO, backpressure policies, fault latches."""
+from __future__ import annotations
+
+import copy
+import pickle
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.keyed import KeyedMetric
+from torchmetrics_tpu.robust.chaos import (
+    DrainThreadDeath,
+    PreemptMidOverlap,
+    QueueOverflow,
+    StagingTransferFailure,
+)
+from torchmetrics_tpu.robust.journal import Journal, recover
+from torchmetrics_tpu.serve import IngestTicket, ServeOptions, serve_options_from_env
+from torchmetrics_tpu.utils.exceptions import BackpressureError, ServeError, TorchMetricsUserError
+
+
+def _batches(n=8, size=4, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 9, size).astype(np.float32),) for _ in range(n)]
+
+
+class TestBasics:
+    def test_async_equals_sync_bit_identical(self):
+        m, ref = SumMetric(), SumMetric()
+        for (b,) in _batches():
+            t = m.update_async(b)
+            ref.update(b)
+            assert isinstance(t, IngestTicket)
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_ticket_resolves_with_generation(self):
+        m = SumMetric()
+        t = m.update_async(np.asarray([1.0], np.float32))
+        gen = t.result(timeout=10.0)
+        assert t.done() and t.error is None and not t.shed
+        assert gen == t.generation
+
+    def test_cat_state_metric_supported(self):
+        m, ref = CatMetric(), CatMetric()
+        for (b,) in _batches():
+            m.update_async(b)
+            ref.update(b)
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_keyed_and_sharded_targets(self):
+        from torchmetrics_tpu.parallel.mesh import MeshContext
+
+        rng = np.random.RandomState(0)
+        km, kref = KeyedMetric(SumMetric(), 5), KeyedMetric(SumMetric(), 5)
+        sm, sref = SumMetric().shard(MeshContext()), SumMetric()
+        for _ in range(6):
+            ids = rng.randint(0, 5, 4).astype(np.int32)
+            vals = rng.randint(0, 9, 4).astype(np.float32)
+            km.update_async(ids, vals)
+            kref.update(ids, vals)
+            sm.update_async(vals)
+            sref.update(vals)
+        assert np.array_equal(np.asarray(km.compute()), np.asarray(kref.compute()))
+        assert np.array_equal(np.asarray(sm.compute()), np.asarray(sref.compute()))
+
+    def test_collection_update_async(self):
+        mc = MetricCollection({"s": SumMetric(), "m": MeanMetric()})
+        ref = MetricCollection({"s": SumMetric(), "m": MeanMetric()})
+        for (b,) in _batches():
+            mc.update_async(b)
+            ref.update(b)
+        a, r = mc.compute(), ref.compute()
+        assert all(np.array_equal(np.asarray(a[k]), np.asarray(r[k])) for k in a)
+
+    def test_serve_reconfigure_rejected_and_env_options(self, monkeypatch):
+        m = SumMetric()
+        m.serve(ServeOptions(max_inflight=4))
+        with pytest.raises(TorchMetricsUserError, match="already configured"):
+            m.serve(ServeOptions(max_inflight=8))
+        monkeypatch.setenv("TM_TPU_SERVE_MAX_INFLIGHT", "7")
+        monkeypatch.setenv("TM_TPU_SERVE_ON_FULL", "shed")
+        monkeypatch.setenv("TM_TPU_SERVE_LINGER_MS", "1.5")
+        opts = serve_options_from_env()
+        assert opts.max_inflight == 7 and opts.on_full == "shed" and opts.linger_ms == 1.5
+
+    def test_invalid_options_raise(self):
+        with pytest.raises(ServeError):
+            ServeOptions(max_inflight=0)
+        with pytest.raises(ServeError):
+            ServeOptions(on_full="drop")
+        with pytest.raises(ServeError):
+            ServeOptions(linger_ms=-1)
+
+    def test_deepcopy_and_pickle_drop_engine(self):
+        m = SumMetric()
+        m.update_async(np.asarray([2.0], np.float32))
+        clone = copy.deepcopy(m)
+        assert clone.__dict__["_serve"] is None
+        assert float(clone.compute()) == 2.0  # quiesced before the copy
+        back = pickle.loads(pickle.dumps(m))
+        assert back.__dict__["_serve"] is None
+        assert float(back.compute()) == 2.0
+
+
+class TestBackpressure:
+    def test_shed_mode_counts_exact(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=2, on_full="shed"))
+        shed0 = obs.telemetry.counter("serve.shed").value
+        with QueueOverflow(eng):
+            tickets = [m.update_async(np.asarray([1.0], np.float32)) for _ in range(7)]
+        shed = [t for t in tickets if t.shed]
+        assert len(shed) == 5
+        assert obs.telemetry.counter("serve.shed").value - shed0 == 5
+        assert eng.stats()["shed"] == 5
+        assert float(m.compute()) == 2.0  # exactly the admitted batches
+
+    def test_raise_mode(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=1, on_full="raise"))
+        with QueueOverflow(eng):
+            m.update_async(np.asarray([1.0], np.float32))
+            with pytest.raises(BackpressureError):
+                m.update_async(np.asarray([1.0], np.float32))
+        assert float(m.compute()) == 1.0
+
+    def test_block_mode_times_out_on_stalled_drain(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=1, on_full="block", queue_timeout_s=0.2))
+        eng.pause()
+        m.update_async(np.asarray([1.0], np.float32))
+        with pytest.raises(BackpressureError, match="queue_timeout_s"):
+            m.update_async(np.asarray([1.0], np.float32))
+        eng.resume()
+        assert eng.stats()["backpressure_stalls"] >= 1
+        assert float(m.compute()) == 1.0
+
+    def test_block_mode_unblocks_when_drain_catches_up(self):
+        m, ref = SumMetric(), SumMetric()
+        m.serve(ServeOptions(max_inflight=2, on_full="block", queue_timeout_s=30.0))
+        for (b,) in _batches(12):
+            m.update_async(b)
+            ref.update(b)
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+        assert m.serve().stats()["shed"] == 0
+
+
+class TestCoalescing:
+    def test_coalesced_window_bit_identical(self):
+        m, ref = SumMetric(), SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64, coalesce=8))
+        eng.pause()
+        for (b,) in _batches(13):
+            m.update_async(b)
+            ref.update(b)
+        c0 = obs.telemetry.counter("serve.coalesced_launches").value
+        eng.resume()
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+        assert obs.telemetry.counter("serve.coalesced_launches").value > c0
+
+    def test_shape_change_splits_window(self):
+        m, ref = SumMetric(), SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64, coalesce=8))
+        eng.pause()
+        for size in (4, 4, 7, 7, 4):
+            b = np.full((size,), 2.0, np.float32)
+            m.update_async(b)
+            ref.update(b)
+        eng.resume()
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_linger_still_quiesces_immediately(self):
+        m = SumMetric()
+        m.serve(ServeOptions(coalesce=16, linger_ms=500.0))
+        m.update_async(np.asarray([3.0], np.float32))
+        # quiesce must bypass the half-second linger dwell, not wait it out
+        assert float(m.compute()) == 3.0
+
+
+class TestFaultLatches:
+    def test_drain_thread_death_restart_bit_identical(self):
+        m, ref = SumMetric(), SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        restarts0 = eng.stats()["drain_restarts"]
+        batches = _batches(6)
+        for i, (b,) in enumerate(batches):
+            ref.update(b)
+            if i == 3:
+                with DrainThreadDeath() as inj:
+                    m.update_async(b)
+                    eng.quiesce()
+                assert inj.fired == 1
+            else:
+                m.update_async(b)
+        assert eng.stats()["drain_restarts"] > restarts0
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_staging_failure_degrades_not_drops(self):
+        m, ref = SumMetric(), SumMetric()
+        fb0 = obs.telemetry.counter("serve.staging_fallbacks").value
+        with StagingTransferFailure(fail_calls=2) as inj:
+            for (b,) in _batches(5):
+                m.update_async(b)
+                ref.update(b)
+            m.serve().quiesce()
+        assert inj.fired == 2
+        assert obs.telemetry.counter("serve.staging_fallbacks").value - fb0 == 2
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_apply_failure_surfaces_at_quiesce(self):
+        m = MulticlassAccuracy(num_classes=3, validate_args=False)
+        m.update_async(np.asarray([[0.7, 0.2, 0.1]], np.float32), np.asarray([0], np.int32))
+        m.serve().quiesce()
+        # a structurally bad batch fails in the drain; the next quiesce must raise
+        t = m.update_async(np.asarray(["bogus"]), np.asarray([0], np.int32))
+        with pytest.raises(ServeError, match="failed to apply"):
+            m.serve().quiesce()
+        assert t.error is not None
+        # the engine stays usable and earlier state is intact
+        assert float(m.compute()) == 1.0
+
+    def test_preempt_mid_overlap_journal_recovery(self, tmp_path):
+        batches = _batches(8)
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64), journal=Journal(tmp_path / "wal"))
+        for (b,) in batches[:3]:
+            m.update_async(b)
+        eng.quiesce()
+        eng.pause()
+        for (b,) in batches[3:6]:
+            m.update_async(b)  # journaled at enqueue, never applied
+        inj = PreemptMidOverlap()
+        assert inj.strike(m) == 3
+        with pytest.raises(ServeError, match="abandoned"):
+            m.update_async(batches[6][0])
+        fresh = SumMetric()
+        rec = recover(fresh, tmp_path / "wal")
+        assert rec["replayed"] == 6
+        for (b,) in batches[6:]:
+            fresh.update(b)
+        ref = SumMetric()
+        for (b,) in batches:
+            ref.update(b)
+        assert np.array_equal(np.asarray(fresh.compute()), np.asarray(ref.compute()))
+
+    def test_generation_fence_detects_mid_window_mutation(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        # commit one batch WITHOUT quiescing: the fence stays armed at its generation
+        m.update_async(np.asarray([1.0], np.float32)).result(timeout=10.0)
+        eng.pause()
+        m.update_async(np.asarray([1.0], np.float32))
+        # violate the quiesce contract on purpose: move the store generation behind
+        # the non-empty window, like a foreign donated dispatch would
+        m._state.commit_donated((), ())
+        fb0 = eng.stats()["fence_breaks"]
+        eng.resume()
+        eng.quiesce()
+        assert eng.stats()["fence_breaks"] == fb0 + 1
+        # a quiesce disarms the fence: post-quiesce mutations are legitimate
+        m.reset()
+        m.update_async(np.asarray([1.0], np.float32))
+        eng.quiesce()
+        assert eng.stats()["fence_breaks"] == fb0 + 1

@@ -1,0 +1,142 @@
+"""Pinned semantics for quiesce-vs-guard interactions (docs/serving.md "Quiesce rules").
+
+These tests are the contract: changing any of these behaviours is a semantic break, not
+a refactor.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.robust.chaos import QueueOverflow, SimWorld
+from torchmetrics_tpu.serve import ServeOptions
+from torchmetrics_tpu.utils.exceptions import SnapshotError, TorchMetricsUserError
+
+
+def _b(v: float, size: int = 4):
+    return np.full((size,), v, np.float32)
+
+
+class TestBufferedPendingPrecedence:
+    """``buffered(k)`` + ``update_async``: the pending guard fires FIRST."""
+
+    def test_update_async_raises_while_buffered_pending(self):
+        m = SumMetric()
+        buf = m.buffered(4)
+        buf.update(_b(1.0))
+        with pytest.raises(TorchMetricsUserError, match="update_async.*pending"):
+            m.update_async(_b(1.0))
+        buf.flush()
+        # once the buffered window drained, async enqueue works again
+        m.update_async(_b(2.0))
+        assert float(m.compute()) == 4.0 + 8.0
+
+    def test_buffered_flush_quiesces_async_window_first(self):
+        # async batches enqueued BEFORE the buffered window must commit before the
+        # flush applies (the flush drives update/update_batches, which quiesce)
+        m, ref = SumMetric(), SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        eng.pause()
+        m.update_async(_b(1.0))
+        ref.update(_b(1.0))
+        eng.resume()
+        buf = m.buffered(2)
+        buf.update(_b(2.0))
+        buf.update(_b(3.0))
+        ref.update(_b(2.0))
+        ref.update(_b(3.0))
+        buf.flush()
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+class TestResetDuringWindow:
+    """``reset()`` with a non-empty window: quiesce first, then clear — a
+    linearization point. Every batch enqueued before reset commits and is then wiped;
+    batches enqueued after reset accumulate from defaults."""
+
+    def test_reset_quiesces_then_clears(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        eng.pause()
+        for _ in range(3):
+            m.update_async(_b(1.0))
+        eng.resume()
+        m.reset()
+        assert eng.stats()["committed"] == 3  # quiesced, not discarded
+        assert m.update_count == 0
+        m.update_async(_b(5.0))
+        assert float(m.compute()) == 20.0
+
+    def test_snapshot_quiesces_exactly(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        eng.pause()
+        m.update_async(_b(1.0))
+        m.update_async(_b(2.0))
+        eng.resume()
+        blob = m.snapshot()  # quiesced snapshot is exact over both batches
+        fresh = SumMetric()
+        fresh.restore(blob)
+        assert float(fresh.compute()) == 12.0
+
+    def test_mid_flight_snapshot_still_hard_error(self):
+        # the donation in-flight hazard is orthogonal to the serve window and stays fatal
+        m = SumMetric()
+        m.update(_b(1.0))
+        m._state.begin_donated_dispatch()
+        try:
+            with pytest.raises(SnapshotError, match="mid-flight"):
+                m.snapshot()
+        finally:
+            m._state.abort_donated()
+
+
+class TestWorldConsistentAfterShed:
+    """Shedding degrades the DATA stream, not the sync grade: ``world_consistent``
+    reflects the latest multi-process sync only. Completeness lives in the serve
+    counters (``serve.shed``, ``IngestEngine.stats()``)."""
+
+    def test_world_consistent_stays_full_after_sheds(self):
+        m = SumMetric()
+        world = SimWorld([m, SumMetric()])
+        world.metrics[1].update(_b(1.0))
+        m.dist_sync_fn = world
+        m.distributed_available_fn = lambda: True
+        m.sync_options = world.options()
+        eng = m.serve(ServeOptions(max_inflight=1, on_full="shed"))
+        with QueueOverflow(eng):
+            tickets = [m.update_async(_b(1.0)) for _ in range(4)]
+        assert sum(t.shed for t in tickets) == 3
+        m.compute()  # full-world sync over the degraded (shed) local stream
+        assert m.world_consistent == "full"
+        assert bool(m.world_consistent)
+        assert eng.stats()["shed"] == 3
+
+    def test_sync_quiesces_window_first(self):
+        m = SumMetric()
+        world = SimWorld([m, SumMetric()])
+        m.dist_sync_fn = world
+        m.distributed_available_fn = lambda: True
+        m.sync_options = world.options()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        eng.pause()
+        m.update_async(_b(1.0))
+        eng.resume()
+        m.sync()
+        # the gathered value must include the async batch: 4*1.0 from rank 0 + 0
+        assert float(m._state.tensors["sum_value"]) == 4.0
+        m.unsync()
+
+    def test_update_and_forward_quiesce_first(self):
+        m, ref = SumMetric(), SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        eng.pause()
+        m.update_async(_b(1.0))
+        ref.update(_b(1.0))
+        eng.resume()
+        m.update(_b(2.0))  # must order AFTER the async batch
+        ref.update(_b(2.0))
+        m.forward(_b(3.0))
+        ref.update(_b(3.0))
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))

@@ -327,6 +327,9 @@ def _spec():
     spec["functional"] = None
     spec["obs"] = None             # telemetry subsystem, not a metric (tests: bases/test_telemetry.py)
     spec["robust"] = None          # fault-tolerance subsystem, not a metric (tests: robust/)
+    spec["ServeOptions"] = None    # serving-tier policy object, not a metric (tests: serve/)
+    spec["IngestEngine"] = None    # async ingestion machinery, not a metric (tests: serve/)
+    spec["IngestTicket"] = None    # enqueue future, not a metric (tests: serve/)
     return spec, mextra
 
 

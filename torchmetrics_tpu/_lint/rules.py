@@ -42,6 +42,9 @@ TPU013    sharding consistency: hand-mutation of ``.shard()``-placed state
 TPU014    unbounded ``add_state(default=[], dist_reduce_fx="cat")`` on a
           metric with a registered streaming-sketch equivalent and no
           ``approx="sketch"`` wiring (state grows with samples seen)
+TPU015    host-blocking call (``.block_until_ready()`` / ``jax.device_get`` /
+          ``.item()``/``.tolist()``) reachable from an async serve/drain path
+          (a ``serve/`` module or a ``# jaxlint: serve-path`` function)
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -165,6 +168,14 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "example": "self.add_state('preds', [], dist_reduce_fx='cat')  # curve metric",
         "fix": "offer (or use) the O(1) streaming sketch twin — approx='sketch' with the"
                " documented error bound (docs/sketches.md)",
+    },
+    "TPU015": {
+        "severity": "perf",
+        "summary": "host-blocking call (.block_until_ready()/.item()/.tolist()/device_get)"
+                   " reachable from an async serve/drain path (stalls the ingestion pipeline)",
+        "example": "def _drain(self): jax.device_get(out)  # under serve/",
+        "fix": "keep the drain non-blocking: dispatch and commit device futures; read"
+               " values only after quiesce (compute()/snapshot() quiesce for you)",
     },
 }
 
@@ -1904,10 +1915,107 @@ def _rule_tpu014(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU015 helpers
+#: host-blocking attribute calls the serving tier must never make on its drain path
+_TPU015_BLOCKING_ATTRS = {"item", "tolist", "block_until_ready"}
+_SERVE_PATH_MARK = re.compile(r"#\s*jaxlint:\s*serve-path\b")
+
+
+def _is_serve_path_file(path: str) -> bool:
+    """True for modules that ARE the serving tier (any ``serve`` directory segment)."""
+    parts = path.replace("\\", "/").split("/")
+    return "serve" in parts[:-1]
+
+
+def _marked_serve_path(info: _FuncInfo, lines: Sequence[str]) -> bool:
+    """``# jaxlint: serve-path`` on the def line, a decorator line, or the line above."""
+    node = info.node
+    first = min([node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])])
+    for ln in range(max(1, first - 1), node.lineno + 1):
+        if ln <= len(lines) and _SERVE_PATH_MARK.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _rule_tpu015(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Host-blocking call reachable from an async serve/drain path.
+
+    The serving tier's whole throughput story is that the drain thread only ever
+    *dispatches* — ``update`` kernels, staging transfers — and never waits on the
+    device: one ``.block_until_ready()`` (or an implicit sync via ``.item()`` /
+    ``.tolist()`` / ``jax.device_get``) inside the drain serializes transfer with
+    compute and the overlap evaporates; worse, under backpressure it stretches every
+    enqueue's latency by a device roundtrip. Roots are functions in a ``serve/`` module
+    or marked ``# jaxlint: serve-path``; the rule follows the intra-module call graph
+    (plain and ``self.`` calls, plus nested helpers) from those roots — cross-module
+    callees are out of scope (the engine applies batches through the metric's ordinary
+    update path, whose own hazards have their own rules).
+    """
+    roots: List[_FuncInfo] = []
+    file_is_serve = _is_serve_path_file(path)
+    for info in model.functions:
+        if file_is_serve or _marked_serve_path(info, lines):
+            roots.append(info)
+    if not roots:
+        return []
+    # fixpoint reachability over local calls + nested defs
+    reachable: Set[int] = set()
+    frontier = list(roots)
+    while frontier:
+        info = frontier.pop()
+        if id(info) in reachable:
+            continue
+        reachable.add(id(info))
+        frontier.extend(info.children)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callees: List[_FuncInfo] = []
+            if isinstance(node.func, ast.Name) and node.func.id in model.by_name:
+                callees = model.by_name[node.func.id]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in model.by_name
+            ):
+                callees = [fi for fi in model.by_name[node.func.attr] if fi.cls is not None]
+            frontier.extend(fi for fi in callees if id(fi) not in reachable)
+    by_id = {id(fi): fi for fi in model.functions}
+    out: List[Finding] = []
+    seen_lines: Set[Tuple[int, int]] = set()
+    for fid in reachable:
+        info = by_id[fid]
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            blocked: Optional[str] = None
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _TPU015_BLOCKING_ATTRS:
+                blocked = f".{node.func.attr}()"
+            else:
+                dotted = _dotted(node.func)
+                if dotted and dotted[-1] == "device_get":
+                    blocked = "jax.device_get"
+            if blocked is None:
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen_lines:
+                continue
+            seen_lines.add(key)
+            out.append(_finding(
+                "TPU015", path, node, lines,
+                f"host-blocking {blocked} in {info.qualname!r}, which is reachable from"
+                " an async serve/drain path: the drain must only dispatch — a device"
+                " sync here serializes transfer with compute and stalls every enqueue"
+                " behind a roundtrip. Commit the future and read it after quiesce.",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
-    _rule_tpu013, _rule_tpu014,
+    _rule_tpu013, _rule_tpu014, _rule_tpu015,
 )
 
 

@@ -82,6 +82,10 @@ class MetricCollection:
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
         self._groups: Dict[int, List[str]] = {}
+        # collection-level async ingestion engine (torchmetrics_tpu.serve): one window
+        # and one drain for the whole collection, so a mixed-tenant batch is applied to
+        # every member as a single FIFO unit
+        self._serve = None
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -95,6 +99,8 @@ class MetricCollection:
         non-fusable members. The first forward runs per-metric, then forms the groups
         (mirroring ``update``, reference ``collections.py:200-236``).
         """
+        if self._serve is not None:
+            self._serve.quiesce()
         if self._groups_checked:
             result = self._forward_groups(*args, **kwargs)
             return self._finalize_result(result)
@@ -305,6 +311,42 @@ class MetricCollection:
 
         return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
 
+    def serve(self, options: Optional[Any] = None, journal: Optional[Any] = None) -> Any:
+        """Configure (or fetch) the collection-level async ingestion engine.
+
+        One bounded window and one drain thread cover the whole collection: each
+        enqueued batch is applied to every member (group leaders once groups form) as a
+        single FIFO unit, so members never observe interleaved async streams. See
+        :meth:`Metric.serve` and ``docs/serving.md``.
+        """
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.serve import IngestEngine, serve_options_from_env
+        from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+        eng = self._serve
+        if eng is None:
+            eng = IngestEngine(self, options or serve_options_from_env(), journal=journal)
+            self._serve = eng
+            obs.telemetry.counter("serve.engines").inc()
+            return eng
+        if options is not None and options != eng.options:
+            raise TorchMetricsUserError(
+                "This collection's ingestion engine is already configured with"
+                f" {eng.options}; serve() cannot re-configure it to {options}."
+            )
+        if journal is not None and eng.journal is None:
+            eng.journal = journal
+        return eng
+
+    def update_async(self, *args: Any, **kwargs: Any) -> Any:
+        """Non-blocking :meth:`update` over the whole collection; returns an
+        ``IngestTicket`` resolving once every member committed the batch (see
+        :meth:`Metric.update_async`)."""
+        eng = self._serve
+        if eng is None:
+            eng = self.serve()
+        return eng.enqueue(args, kwargs)
+
     def keyed(self, num_keys: int, strategy: str = "auto") -> "MetricCollection":
         """A :class:`~torchmetrics_tpu.keyed.KeyedMetricCollection` twin of this collection.
 
@@ -368,6 +410,8 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update every metric — only group leaders once groups are formed (reference ``collections.py:200-236``)."""
+        if self._serve is not None:
+            self._serve.quiesce()  # no-op from the drain; FIFO vs async batches
         if self._groups_checked:
             # only the leader launches its update kernel; members share its state
             for cg in self._groups.values():
@@ -389,6 +433,8 @@ class MetricCollection:
 
         See :meth:`Metric.update_batches`. Group formation uses the first batch.
         """
+        if self._serve is not None:
+            self._serve.quiesce()
         if self._enable_compute_groups and not self._groups_checked:
             first = tuple(a[0] for a in args)
             first_kw = {k: v[0] for k, v in kwargs.items()}
@@ -410,6 +456,8 @@ class MetricCollection:
                 m.update_batches(*args, **m._filter_kwargs(**kwargs))
 
     def compute(self) -> Dict[str, Any]:
+        if self._serve is not None:
+            self._serve.quiesce()  # a quiesced compute is exact over every enqueued batch
         return self._compute_and_reduce("compute")
 
     def sweep_fn(self) -> Any:
@@ -514,6 +562,8 @@ class MetricCollection:
         return {self._set_name(k): v for k, v in flattened_results.items()}
 
     def reset(self) -> None:
+        if self._serve is not None:
+            self._serve.quiesce()  # pinned: batches enqueued before reset commit first
         for m in self.values(copy_state=False):
             m.reset()
         if self._enable_compute_groups and self._groups_checked:
@@ -740,6 +790,24 @@ class MetricCollection:
         return self._modules[key]
 
     # ------------------------------------------------------------- persistence
+    def __getstate__(self) -> Dict[str, Any]:
+        if self._serve is not None:
+            self._serve.quiesce()  # pickle an exact state, not a mid-window one
+        d = dict(self.__dict__)
+        d["_serve"] = None  # threads don't pickle; the receiving process re-opts-in
+        return d
+
+    def __deepcopy__(self, memo: dict) -> "MetricCollection":
+        if self._serve is not None:
+            self._serve.quiesce()  # the copy must capture every enqueued batch
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            # the ingestion engine wraps a live thread/condvar bound to THIS collection
+            new.__dict__[k] = None if k == "_serve" else deepcopy(v, memo)
+        return new
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
         if prefix:
@@ -766,6 +834,8 @@ class MetricCollection:
         """
         from torchmetrics_tpu.robust import checkpoint as _ckpt
 
+        if self._serve is not None:
+            self._serve.quiesce()  # a quiesced snapshot is exact (docs/serving.md)
         return _ckpt.snapshot_collection(self)
 
     def restore(self, blob: Dict[str, Any]) -> None:

@@ -322,6 +322,8 @@ class KeyedMetric(Metric):
         if keys is None:
             return super().compute()
         _dispatch.guard_buffered_pending(self, "compute")
+        if self._serve is not None:
+            self._serve.quiesce()  # per-key gathers see every async batch too
         obs.bump(self, "compute_calls")
         self._guard_poison()
         keys_arr = jnp.asarray(keys)

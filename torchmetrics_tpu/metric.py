@@ -219,6 +219,9 @@ class Metric:
         self._cache: Optional[Dict[str, Any]] = None
         self._jit_cache: Dict[str, Any] = {}
         self._buffered_pending = 0  # batches held by a BufferedUpdater (state stale until flush)
+        # async ingestion engine (torchmetrics_tpu.serve) — None until update_async/serve()
+        # opts in; the disabled-path cost everywhere is this one attribute-is-None check
+        self._serve = None
         self._state_shared = False  # True while compute-group members alias this state (gates donation)
         self._world_consistent = FULL  # degrades to "quorum"/"local" after a partial sync
         # sharded-state mode (docs/distributed.md "Sharded state"): set by shard()
@@ -256,6 +259,8 @@ class Metric:
     def metric_state(self) -> Dict[str, Any]:
         """Current state values (reference ``metric.py:186``)."""
         _dispatch.guard_buffered_pending(self, "metric_state")
+        if self._serve is not None:
+            self._serve.quiesce()
         return self._state.snapshot()
 
     @property
@@ -476,6 +481,8 @@ class Metric:
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
         _dispatch.guard_buffered_pending(self, "update")
+        if self._serve is not None:
+            self._serve.quiesce()  # no-op from the drain thread; FIFO vs async batches
         obs.bump(self, "update_calls")
         with obs.metric_span(self, "update"):
             args, kwargs = self._coerce(args, kwargs)
@@ -521,6 +528,8 @@ class Metric:
                 "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
             )
         _dispatch.guard_buffered_pending(self, "update_batches")
+        if self._serve is not None:
+            self._serve.quiesce()
         obs.bump(self, "update_batches_calls")
         args, kwargs = self._coerce(args, kwargs)
         n_batches = jnp.shape(args[0] if args else next(iter(kwargs.values())))[0]
@@ -771,6 +780,8 @@ class Metric:
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing `forward`.")
         _dispatch.guard_buffered_pending(self, "forward")
+        if self._serve is not None:
+            self._serve.quiesce()
         obs.bump(self, "forward_calls")
         with obs.metric_span(self, "forward"):
             if self.full_state_update or self.dist_sync_on_step:
@@ -1074,6 +1085,60 @@ class Metric:
 
         return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
 
+    # ------------------------------------------------------------- async ingestion
+    def serve(self, options: Optional[Any] = None, journal: Optional[Any] = None) -> "Any":
+        """Configure (or fetch) this metric's async ingestion engine (docs/serving.md).
+
+        Idempotent: the first call builds the :class:`~torchmetrics_tpu.serve.engine.
+        IngestEngine` from ``options`` (default: the ``TM_TPU_SERVE_*`` env knobs) with
+        an optional write-ahead ``journal`` (a :class:`~torchmetrics_tpu.robust.journal.
+        Journal` — appended at ENQUEUE time, so a preemption mid-overlap recovers via
+        ``snapshot + replay``); later calls return the existing engine. Reconfiguring a
+        live engine with different options is an error — quiesce and build a new metric
+        instead of mutating backpressure policy under load.
+        """
+        from torchmetrics_tpu.serve import IngestEngine, serve_options_from_env
+
+        eng = self.__dict__.get("_serve")
+        if eng is None:
+            eng = IngestEngine(self, options or serve_options_from_env(), journal=journal)
+            object.__setattr__(self, "_serve", eng)
+            obs.telemetry.counter("serve.engines").inc()
+            return eng
+        if options is not None and options != eng.options:
+            raise TorchMetricsUserError(
+                "This metric's ingestion engine is already configured with"
+                f" {eng.options}; serve() cannot re-configure it to {options}."
+            )
+        if journal is not None and eng.journal is None:
+            eng.journal = journal
+        return eng
+
+    def update_async(self, *args: Any, **kwargs: Any) -> "Any":
+        """Non-blocking :meth:`update`: enqueue the batch, return an ``IngestTicket``.
+
+        The batch stages through a double-buffered host→device pipeline so the transfer
+        overlaps the previous step's compute, and a background drain applies it through
+        the ordinary dispatch tiers in FIFO order. The in-flight window is bounded
+        (``ServeOptions(max_inflight=..., on_full="block"|"raise"|"shed")``) —
+        backpressure, never OOM. ``compute``/``snapshot``/``sync``/``reset`` and any
+        synchronous ``update``/``forward`` quiesce the window first, so every host read
+        observes an exact fully-drained state.
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: Did you forget to call `unsync`?"
+            )
+        # pinned precedence (tests/unittests/serve): the buffered-pending guard fires
+        # BEFORE the enqueue — a buffered window and an async window must not interleave
+        _dispatch.guard_buffered_pending(self, "update_async")
+        eng = self.__dict__.get("_serve")
+        if eng is None:
+            eng = self.serve()
+        if self._should_validate():
+            self._validate(*args, **kwargs)  # fail fast on the caller, not in the drain
+        return eng.enqueue(args, kwargs)
+
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Reference ``metric.py:352-390`` with only ONE update-kernel launch."""
         args, kwargs = self._coerce(args, kwargs)
@@ -1199,6 +1264,8 @@ class Metric:
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         _dispatch.guard_buffered_pending(self, "sync")
+        if self._serve is not None:
+            self._serve.quiesce()  # the gathered state must include every async batch
         if distributed_available is None and self.distributed_available_fn is not None:
             distributed_available = self.distributed_available_fn
         is_distributed = distributed_available() if callable(distributed_available) else False
@@ -1271,6 +1338,8 @@ class Metric:
     def compute(self) -> Any:
         """Finalise the accumulated state to the metric value (reference ``metric.py:592-622``)."""
         _dispatch.guard_buffered_pending(self, "compute")
+        if self._serve is not None:
+            self._serve.quiesce()  # a quiesced compute is exact over every enqueued batch
         if not self._update_called:
             rank_zero_warn(
                 f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` method"
@@ -1299,7 +1368,14 @@ class Metric:
         return value
 
     def reset(self) -> None:
-        """Restore default state (reference ``metric.py:672-687``)."""
+        """Restore default state (reference ``metric.py:672-687``).
+
+        With async batches in flight the window is QUIESCED first (pinned semantics,
+        tests/unittests/serve): every batch enqueued before ``reset`` commits, then the
+        state clears — reset is a linearization point, never a mid-window race.
+        """
+        if self._serve is not None:
+            self._serve.quiesce()
         self._update_count = 0
         self._update_called = False
         self._computed = None
@@ -1398,6 +1474,8 @@ class Metric:
         return deepcopy(self)
 
     def __deepcopy__(self, memo: dict) -> "Metric":
+        if self.__dict__.get("_serve") is not None:
+            self._serve.quiesce()  # the copy must capture every enqueued batch
         cls = self.__class__
         new = cls.__new__(cls)
         memo[id(self)] = new
@@ -1410,6 +1488,10 @@ class Metric:
                 new.__dict__[k] = v
             elif k == "_lazy_sync_cache":
                 new.__dict__[k] = None
+            elif k == "_serve":
+                # the ingestion engine wraps a live thread + condition variable and is
+                # bound to THIS instance's state store — clones start unconfigured
+                new.__dict__[k] = None
             else:
                 new.__dict__[k] = deepcopy(v, memo)
         return new
@@ -1418,13 +1500,16 @@ class Metric:
         # jitted callables are not picklable; state arrays → numpy (reference metric.py:693-712).
         # Mesh contexts hold live Device handles: a pickled sharded metric round-trips as
         # an UNSHARDED metric (call shard() again under the receiving process's mesh).
+        if self.__dict__.get("_serve") is not None:
+            self._serve.quiesce()  # pickle an exact state, not a mid-window one
         d = {
             k: v for k, v in self.__dict__.items()
-            if k not in ("_jit_cache", "_shard_ctx", "_shard_specs", "_lazy_sync_cache")
+            if k not in ("_jit_cache", "_shard_ctx", "_shard_specs", "_lazy_sync_cache", "_serve")
         }
         d["_shard_ctx"] = None
         d["_shard_specs"] = None
         d["_lazy_sync_cache"] = None
+        d["_serve"] = None  # threads don't pickle; the receiving process re-opts-in
         d["_state_tensors"] = {k: np.asarray(v) for k, v in self._state.tensors.items()}
         d["_state_lists"] = {k: [np.asarray(e) for e in v] for k, v in self._state.lists.items()}
         d["_defaults"] = {k: (np.asarray(v) if not isinstance(v, list) else []) for k, v in self._defaults.items()}
@@ -1534,6 +1619,8 @@ class Metric:
         drops the mesh; snapshots gather to host and re-place on restore).
         """
         _dispatch.guard_buffered_pending(self, "shard")
+        if self._serve is not None:
+            self._serve.quiesce()  # re-placement must not race the drain's commits
         self._state.guard_readable()
         ctx = mesh if isinstance(mesh, _mesh.MeshContext) else _mesh.MeshContext(mesh)
         overrides = dict(spec or {})
@@ -1590,6 +1677,8 @@ class Metric:
         Single-device placement supersedes any :meth:`shard` mesh layout: sharded mode
         is cleared (call :meth:`shard` again to re-place on a mesh).
         """
+        if self._serve is not None:
+            self._serve.quiesce()  # device moves must not race the drain's commits
         n_moved = (
             len(self._state.tensors)
             + sum(len(v) for v in self._state.lists.values())
@@ -1618,6 +1707,8 @@ class Metric:
 
     def set_dtype(self, dst_type) -> "Metric":
         """Cast float states (``.float()``/``.half()`` are deliberate no-ops — ``metric.py:740-774``)."""
+        if self._serve is not None:
+            self._serve.quiesce()
         self._dtype = dst_type
         cast = lambda v: jnp.asarray(v, dst_type) if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v
         for name, v in self._state.tensors.items():

@@ -231,6 +231,15 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "sync_bytes_saved": counters.get("sync.bytes_saved", 0),
         "sync_lazy_reduce_fires": counters.get("sync.lazy_reduce.fires", 0),
         "sync_lazy_reduce_reuses": counters.get("sync.lazy_reduce.reuses", 0),
+        # serving tier (docs/serving.md): the async ingestion window's audit trail — a
+        # bench that drove update_async records exactly what was enqueued, what
+        # committed, what shed under backpressure, and how often callers stalled
+        "serve_enqueued": counters.get("serve.enqueued", 0),
+        "serve_committed": counters.get("serve.committed", 0),
+        "serve_shed": counters.get("serve.shed", 0),
+        "serve_backpressure_stalls": counters.get("serve.backpressure_stalls", 0),
+        "serve_drain_restarts": counters.get("serve.drain_restarts", 0),
+        "serve_staging_fallbacks": counters.get("serve.staging_fallbacks", 0),
         # sketch states (docs/sketches.md): a bench that folded streams into O(1)
         # sketches records the merge/compaction volume and the cat bytes it did not keep
         "sketch_merges": counters.get("sketch.merges", 0),
@@ -251,6 +260,11 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["sync_latency_us_p50"] = round(s["p50"], 1)
         out["sync_latency_us_p99"] = round(s["p99"], 1)
         out["sync_latency_samples"] = s["count"]
+    qd = tel.get_histogram("serve.queue_depth")
+    if qd is not None and qd.count:
+        s = qd.summary()
+        out["serve_queue_depth_p50"] = s["p50"]
+        out["serve_queue_depth_p99"] = s["p99"]
     ho = snap["timers"].get("dispatch.host_overhead")
     if ho and ho["count"]:  # recorded only while tracing was enabled
         out["per_step_host_overhead_us"] = round(ho["mean_s"] * 1e6, 2)

@@ -37,6 +37,14 @@ executable.
 
 Opt-outs: ``TM_TPU_FAST_DISPATCH=0`` disables the AOT tier (jit paths remain),
 ``TM_TPU_DONATION=0`` keeps AOT but disables donation.
+
+Threading contract (the async serving tier, ``torchmetrics_tpu.serve``): nothing in this
+module takes locks — ``FastStepCache``, ``dispatch_step`` and ``commit_step`` assume a
+SINGLE mutator at a time. The ingestion engine honors that by construction: its drain
+thread is the only caller while the in-flight window is non-empty (every user-thread
+access path quiesces the window first), so the drain rides these seams exactly like a
+single-threaded training loop — donation, generation counting, and the AOT caches need
+no additional synchronization.
 """
 from __future__ import annotations
 

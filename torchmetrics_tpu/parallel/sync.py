@@ -63,6 +63,28 @@ ENV_SYNC_DEGRADED = "TM_TPU_SYNC_DEGRADED"
 ENV_SYNC_QUORUM = "TM_TPU_SYNC_QUORUM"
 ENV_SYNC_EVICT_AFTER = "TM_TPU_SYNC_EVICT_AFTER"
 ENV_SYNC_PROBE_BACKOFF = "TM_TPU_SYNC_PROBE_BACKOFF_S"
+ENV_SYNC_JITTER = "TM_TPU_SYNC_JITTER"
+
+#: retry-backoff jitter RNG, seeded from the chaos harness's fixed seed when one is
+#: pinned (``TM_TPU_CHAOS_SEED``, ``make chaos``) so jittered retry schedules stay
+#: deterministic under fault injection; free-running entropy otherwise
+_BACKOFF_RNG: Optional[Any] = None
+
+
+def _backoff_rng() -> Any:
+    global _BACKOFF_RNG
+    if _BACKOFF_RNG is None:
+        import random
+
+        seed = os.environ.get("TM_TPU_CHAOS_SEED", "")
+        _BACKOFF_RNG = random.Random(int(seed)) if seed.lstrip("-").isdigit() else random.Random()
+    return _BACKOFF_RNG
+
+
+def reset_backoff_rng() -> None:
+    """Re-derive the jitter RNG from the current env (tests re-pinning the chaos seed)."""
+    global _BACKOFF_RNG
+    _BACKOFF_RNG = None
 
 
 class ConsistencyLevel(str):
@@ -126,6 +148,13 @@ class SyncOptions:
     timeout_s: float = 0.0
     retries: int = 2
     backoff_s: float = 0.05
+    #: decorrelated jitter on the retry backoff (AWS-style: next pause drawn uniformly
+    #: from [backoff_s, 3*previous]). Plain exponential backoff SYNCHRONIZES retry
+    #: storms: after a shared stall (one straggler chip, one slow switch) every rank
+    #: retries on the same 2^k schedule and the collective thunders in lockstep; jitter
+    #: decorrelates the herd. Deterministic under chaos via the seeded-injector RNG
+    #: (``TM_TPU_CHAOS_SEED`` seeds the jitter stream too).
+    backoff_jitter: bool = True
     degraded_mode: bool = True
     quorum: Optional[Union[int, float]] = None
     quorum_rescale: bool = True
@@ -162,6 +191,8 @@ def sync_options_from_env() -> SyncOptions:
         timeout_s=_f(ENV_SYNC_TIMEOUT, 0.0),
         retries=int(_f(ENV_SYNC_RETRIES, 2)),
         backoff_s=_f(ENV_SYNC_BACKOFF, 0.05),
+        backoff_jitter=str(os.environ.get(ENV_SYNC_JITTER, "1")).strip().lower()
+        not in ("0", "false", "no", "off"),
         degraded_mode=str(os.environ.get(ENV_SYNC_DEGRADED, "1")).strip().lower()
         not in ("0", "false", "no", "off"),
         quorum=_parse_quorum(os.environ.get(ENV_SYNC_QUORUM)),
@@ -476,6 +507,7 @@ def _bounded_gather(
     """
     attempt = 0
     last_error: Optional[BaseException] = None
+    prev_pause = opts.backoff_s
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
@@ -512,8 +544,18 @@ def _bounded_gather(
                 f" within its {opts.timeout_s:g}s deadline ({detail})",
                 responses=getattr(last_error, "responses", None),
             )
-        # exponential backoff, capped so the sleep never outlives the deadline
-        pause = min(opts.backoff_s * (2 ** (attempt - 1)), max(0.0, deadline - time.monotonic()))
+        # backoff capped so the sleep never outlives the deadline. Default: decorrelated
+        # jitter (pause ~ U[base, 3*prev]) — pure exponential backoff puts every rank
+        # that shared a stall on the SAME 2^k schedule, so the retries storm the
+        # interconnect in lockstep; the jittered schedule spreads them out while keeping
+        # the same expected growth. Deterministic under `make chaos` (the RNG seeds from
+        # TM_TPU_CHAOS_SEED, like the fault injectors).
+        if opts.backoff_jitter:
+            pause = _backoff_rng().uniform(opts.backoff_s, max(opts.backoff_s, prev_pause * 3.0))
+        else:
+            pause = opts.backoff_s * (2 ** (attempt - 1))
+        prev_pause = pause
+        pause = min(pause, max(0.0, deadline - time.monotonic()))
         if pause > 0:
             time.sleep(pause)
 

@@ -245,6 +245,128 @@ class NaNPoison:
         return poisoned, zeroed
 
 
+class StagingTransferFailure(Injector):
+    """Make the serving tier's host→device staging transfer raise.
+
+    Patches the ``device_put`` seam in ``torchmetrics_tpu.serve.staging`` for the first
+    ``fail_calls`` transfers. The :class:`~torchmetrics_tpu.serve.staging.
+    StagingPipeline` must absorb the failure — fall back to unstaged host batches,
+    count ``serve.staging_fallbacks``, warn once — and values must be bit-identical
+    with the staged run (staging is placement-only).
+    """
+
+    name = "staging_transfer_failure"
+
+    def __init__(self, fail_calls: int = 1) -> None:
+        super().__init__()
+        self.fail_calls = fail_calls
+
+    def __enter__(self) -> "StagingTransferFailure":
+        from torchmetrics_tpu.serve import staging as _staging
+
+        real = _staging.device_put
+
+        def flaky(x: Any, *args: Any, **kwargs: Any) -> Any:
+            if self.fired < self.fail_calls:
+                self._fire()
+                raise RuntimeError("chaos: injected staging transfer failure")
+            return real(x, *args, **kwargs)
+
+        self._cm = _patched(_staging, "device_put", flaky)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return self._cm.__exit__(*exc)
+
+
+class DrainThreadDeath(Injector):
+    """Kill the async ingestion drain thread between dequeue and apply.
+
+    Patches ``IngestEngine._apply_window`` to raise the uncatchable-by-the-apply-handler
+    :class:`~torchmetrics_tpu.serve.engine.DrainKilled` once: the drain hands its
+    in-flight window back to the queue head and the thread terminates — exactly an
+    external kill. The engine's restart latch (driven by the next quiesce/enqueue) must
+    revive the drain and re-apply the window FIFO, bit-identically: no batch applied
+    twice, none lost.
+    """
+
+    name = "drain_thread_death"
+
+    def __init__(self, kills: int = 1) -> None:
+        super().__init__()
+        self.kills = kills
+
+    def __enter__(self) -> "DrainThreadDeath":
+        from torchmetrics_tpu.serve import engine as _engine
+
+        real = _engine.IngestEngine._apply_window
+
+        def lethal(engine: Any, items: list) -> None:
+            if self.fired < self.kills:
+                self._fire()
+                raise _engine.DrainKilled("chaos: injected drain-thread death")
+            return real(engine, items)
+
+        self._cm = _patched(_engine.IngestEngine, "_apply_window", lethal)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return self._cm.__exit__(*exc)
+
+
+class QueueOverflow(Injector):
+    """Deterministically overflow an ingestion window by holding its drain.
+
+    ``with QueueOverflow(engine):`` pauses the drain so every enqueue past
+    ``max_inflight`` hits the configured ``on_full`` policy (block/raise/shed) with no
+    thread-timing luck involved; the drain resumes on exit. The window bound itself is
+    the recovery property under test: backpressure, never unbounded growth.
+    """
+
+    name = "queue_overflow"
+
+    def __init__(self, engine: Any) -> None:
+        super().__init__()
+        self.engine = engine
+
+    def __enter__(self) -> "QueueOverflow":
+        self._fire()
+        self.engine.pause()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.engine.resume()
+        return False
+
+
+class PreemptMidOverlap(Injector):
+    """Preempt a serving metric with batches still in its ingestion window.
+
+    :meth:`strike` abandons the engine cold — window dropped, drain stopped, instance
+    garbage — modelling a preemption that lands while transfer overlaps compute. The
+    write-ahead journal (appended at ENQUEUE time) is the only survivor; recovery is
+    ``snapshot + replay(journal)`` on a fresh metric, and the chaos matrix asserts it is
+    bit-identical with the never-preempted run.
+    """
+
+    name = "preempt_mid_overlap"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.dropped_in_window = 0
+
+    def strike(self, metric: Any) -> int:
+        """Kill the metric's engine mid-window; returns the batch count dropped."""
+        engine = metric.__dict__.get("_serve")
+        if engine is None:
+            raise ValueError("PreemptMidOverlap.strike needs a metric with a live serve engine")
+        self._fire()
+        self.dropped_in_window = engine.abandon()
+        return self.dropped_in_window
+
+
 class ChaosRunner:
     """Drive a metric through a batch stream with faults, snapshots, and replay recovery.
 
@@ -792,6 +914,201 @@ def scenario_flap_evict_readmit(
     }
 
 
+# ---------------------------------------------------------------------------
+# Serving-tier scenarios (PR 11): preemption mid-overlap, drain death, overflow
+# ---------------------------------------------------------------------------
+
+def _serve_variants(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int
+) -> List[Tuple[str, Callable[[], Any], List[Tuple[Any, ...]]]]:
+    """(name, make_metric, batches) triples covering plain + keyed + sharded metrics.
+
+    Each variant's reference is the SAME maker driven synchronously, so every cell
+    proves async-vs-sync bit-identity within its own tier (plain-vs-sharded and
+    keyed-vs-instance-loop identities are the earlier scenarios' contracts).
+    Templates that cannot be keyed (list/"cat" states) simply omit the keyed variant.
+    """
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.parallel.mesh import MeshContext
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    out: List[Tuple[str, Callable[[], Any], List[Tuple[Any, ...]]]] = [
+        ("plain", factory, _seeded_batches(rng, n_batches)),
+    ]
+    try:
+        KeyedMetric(factory(), 2)
+        keyable = True
+    except TorchMetricsUserError:
+        keyable = False
+    if keyable:
+        n_keys = 4
+        keyed_batches = []
+        for _ in range(n_batches):
+            ids = np.asarray([rng.randrange(n_keys) for _ in range(5)], np.int32)
+            vals = np.asarray([float(rng.randint(0, 9)) for _ in range(5)], np.float32)
+            keyed_batches.append((ids, vals))
+        out.append(("keyed", lambda: KeyedMetric(factory(), n_keys), keyed_batches))
+    ctx = MeshContext()
+    out.append(("sharded", lambda: factory().shard(ctx), _seeded_batches(rng, n_batches)))
+    return out
+
+
+def scenario_serve_preempt_mid_overlap(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Preemption with batches still in the ingestion window → journal replay recovery.
+
+    A serving metric (plain, keyed, and sharded variants) journals at ENQUEUE time; part
+    of the stream commits, the drain is held, more batches enter the window, and then
+    :class:`PreemptMidOverlap` drops the engine cold — the nastiest case: the state
+    never saw the window batches, only the write-ahead journal did. A fresh instance
+    recovers ``snapshot + replay(journal)``, finishes the stream synchronously, and must
+    be bit-identical with an uninterrupted synchronous run.
+    """
+    del via  # the async protocol is update-shaped; tickets have no per-batch value
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.serve import ServeOptions
+
+    n_batches = max(4, n_batches)
+    preempt = rng.randrange(1, n_batches - 1)
+    variants = _serve_variants(factory, rng, n_batches)
+    detail: Dict[str, Any] = {"preempt_step": preempt}
+    passed = True
+    for name, make, batches in variants:
+        jdir = f"{workdir}/serve-preempt-{name}"
+        m = make()
+        eng = m.serve(ServeOptions(max_inflight=64), journal=_journal.Journal(jdir))
+        split = max(1, (preempt + 1) // 2)
+        for i in range(split):
+            m.update_async(*batches[i])
+        eng.quiesce()  # the prefix is committed state
+        eng.pause()  # hold the drain: the rest of the prefix stays IN the window
+        for i in range(split, preempt + 1):
+            m.update_async(*batches[i])
+        inj = PreemptMidOverlap()
+        dropped = inj.strike(m)  # the process dies here; the WAL is the only survivor
+        fresh = make()
+        recovery = _journal.recover(fresh, jdir)
+        obs.telemetry.counter("robust.recovered").inc()
+        for i in range(preempt + 1, n_batches):
+            fresh.update(*batches[i])
+        ref = make()
+        for b in batches:
+            ref.update(*b)
+        ok = _identical(fresh.compute(), ref.compute())
+        passed = passed and ok and dropped > 0 and recovery["replayed"] == preempt + 1
+        detail[name] = {
+            "bit_identical": ok,
+            "dropped_in_window": dropped,
+            "replayed": recovery["replayed"],
+        }
+    detail["passed"] = passed
+    return detail
+
+
+def scenario_serve_drain_death(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Drain-thread death mid-stream → restart latch → FIFO re-apply, bit-identically.
+
+    At a seeded step :class:`DrainThreadDeath` kills the drain between dequeue and
+    apply; the engine must hand the in-flight ticket back to the window, restart the
+    thread at the next quiesce, and re-apply — none lost, none doubled — across plain,
+    keyed, and sharded variants.
+    """
+    del via, workdir
+    from torchmetrics_tpu.serve import ServeOptions
+
+    n_batches = max(3, n_batches)
+    kill_at = rng.randrange(1, n_batches - 1)
+    variants = _serve_variants(factory, rng, n_batches)
+    detail: Dict[str, Any] = {"preempt_step": kill_at}
+    passed = True
+    for name, make, batches in variants:
+        m = make()
+        eng = m.serve(ServeOptions(max_inflight=64))
+        fired = 0
+        for i, b in enumerate(batches):
+            if i == kill_at:
+                with DrainThreadDeath() as inj:
+                    m.update_async(*b)
+                    eng.quiesce()  # detects the dead drain, restarts, re-applies FIFO
+                fired = inj.fired
+            else:
+                m.update_async(*b)
+        value = m.compute()
+        ref = make()
+        for b in batches:
+            ref.update(*b)
+        ok = _identical(value, ref.compute())
+        restarts = eng.stats()["drain_restarts"]
+        if fired and restarts:
+            obs.telemetry.counter("robust.recovered").inc()
+        passed = passed and ok and fired >= 1 and restarts >= 1
+        detail[name] = {"bit_identical": ok, "kills": fired, "drain_restarts": restarts}
+    detail["passed"] = passed
+    return detail
+
+
+def scenario_serve_queue_overflow(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Window overflow under a held drain: shed-mode counts exact, block-mode sheds zero.
+
+    With the drain paused (:class:`QueueOverflow`) and ``max_inflight=2``, every enqueue
+    past the window must shed — and the shed accounting must be EXACT: the final value
+    equals a reference fed only the admitted batches, and ``serve.shed`` moves by
+    exactly the shed count. A block-mode twin (drain running) must shed nothing and
+    match the full-stream reference. Plain + keyed + sharded variants.
+    """
+    del via, workdir
+    from torchmetrics_tpu.serve import ServeOptions
+
+    n_batches = max(4, n_batches)
+    variants = _serve_variants(factory, rng, n_batches)
+    detail: Dict[str, Any] = {"preempt_step": None}
+    passed = True
+    for name, make, batches in variants:
+        shed0 = obs.telemetry.counter("serve.shed").value
+        m = make()
+        eng = m.serve(ServeOptions(max_inflight=2, on_full="shed", queue_timeout_s=1.0))
+        with QueueOverflow(eng):
+            tickets = [m.update_async(*b) for b in batches]
+        admitted = [b for t, b in zip(tickets, batches) if not t.shed]
+        n_shed = sum(1 for t in tickets if t.shed)
+        value = m.compute()
+        ref = make()
+        for b in admitted:
+            ref.update(*b)
+        shed_delta = obs.telemetry.counter("serve.shed").value - shed0
+        ok_shed = (
+            _identical(value, ref.compute())
+            and n_shed == n_batches - 2
+            and shed_delta == n_shed
+            and eng.stats()["shed"] == n_shed
+        )
+        # block-mode twin: the drain runs, so the bounded window never sheds
+        mb = make()
+        engb = mb.serve(ServeOptions(max_inflight=2, on_full="block", queue_timeout_s=30.0))
+        for b in batches:
+            mb.update_async(*b)
+        refb = make()
+        for b in batches:
+            refb.update(*b)
+        ok_block = _identical(mb.compute(), refb.compute()) and engb.stats()["shed"] == 0
+        if ok_shed:
+            obs.telemetry.counter("robust.recovered").inc()
+        passed = passed and ok_shed and ok_block
+        detail[name] = {
+            "shed_exact": ok_shed,
+            "shed_count": n_shed,
+            "block_bit_identical": ok_block,
+            "block_stalls": engb.stats()["backpressure_stalls"],
+        }
+    detail["passed"] = passed
+    return detail
+
+
 class ChaosMatrix:
     """Seeded sweep of composite multi-fault scenarios (``make chaos-matrix``).
 
@@ -811,6 +1128,9 @@ class ChaosMatrix:
         "sketch_preemption_journal": scenario_sketch_preemption_journal,
         "sharded_preemption_restore": scenario_sharded_preemption_restore,
         "flap_evict_readmit": scenario_flap_evict_readmit,
+        "serve_preempt_mid_overlap": scenario_serve_preempt_mid_overlap,
+        "serve_drain_death": scenario_serve_drain_death,
+        "serve_queue_overflow": scenario_serve_queue_overflow,
     }
 
     def __init__(

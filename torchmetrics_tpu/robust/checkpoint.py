@@ -77,6 +77,12 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
     buffers donated to an in-flight dispatch, or batches pending in a buffered accumulator
     (flush or discard them first — a snapshot must never capture half a window).
     """
+    serve_engine = metric.__dict__.get("_serve")
+    if serve_engine is not None:
+        # quiesce the async ingestion window first: a quiesced snapshot is EXACT over
+        # every enqueued batch (docs/serving.md); the mid-flight donation check below
+        # stays a hard error — that hazard is intra-dispatch, not window-depth
+        serve_engine.quiesce()
     pending = metric.__dict__.get("_buffered_pending", 0)
     if pending:
         raise SnapshotError(

@@ -12,6 +12,11 @@ Layout of a journal directory::
 
     <dir>/snapshot.tmsnap      durable state snapshot (atomic, doubly CRC'd)
     <dir>/000000000042.tmj     one record per appended batch, named by sequence number
+    <dir>/.writer.lock         O_EXCL exclusive-writer lock: "<pid>:<token>" — a second
+                               live MetricJournal on the same dir raises JournalError
+                               (two writers interleave sequence numbers silently); a
+                               dead holder's lock is stale and stolen with a warning,
+                               and recover()/break_lock() force-release it
 
 Record container: ``TMJR1\\n`` magic + little-endian ``(crc32, length)`` + pickled
 ``{"seq", "args", "kwargs"}`` with every array leaf as host numpy. Records are written
@@ -34,6 +39,7 @@ from __future__ import annotations
 import os
 import pickle
 import struct
+import uuid
 import zlib
 from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
 
@@ -48,7 +54,109 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 MAGIC = b"TMJR1\n"
 RECORD_SUFFIX = ".tmj"
 SNAPSHOT_FILENAME = "snapshot.tmsnap"
+LOCK_FILENAME = ".writer.lock"
 _HEADER = struct.Struct("<IQ")
+
+
+def _pid_alive(pid: int) -> bool:
+    """Best-effort liveness probe; a pid we may not signal is assumed alive."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):  # pragma: no cover - exists but not ours
+        return True
+    return True
+
+
+class _WriterLock:
+    """``O_EXCL`` lockfile guarding a journal dir against a second live writer.
+
+    Two :class:`MetricJournal` proxies appending to one directory would interleave their
+    sequence numbers silently — each scans the dir at open and then counts privately, so
+    records overwrite or shuffle without any CRC failing. The lockfile holds
+    ``"<pid>:<token>"``: a conflicting open raises :class:`JournalError` naming the
+    holder's pid; a lock whose holder pid is dead is STALE and stolen with a warning
+    (the crashed writer cannot release); release only unlinks when the token still
+    matches, so a released-then-stolen lock is never deleted out from under the new
+    holder.
+    """
+
+    def __init__(self, dirpath: str) -> None:
+        self.path = os.path.join(dirpath, LOCK_FILENAME)
+        self.token = uuid.uuid4().hex
+        self.held = False
+
+    def _read_holder(self) -> Tuple[Optional[int], str]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                raw = fh.read().strip()
+        except OSError:
+            return None, ""
+        pid_s, _, token = raw.partition(":")
+        try:
+            return int(pid_s), token
+        except ValueError:
+            return None, token
+
+    def acquire(self) -> None:
+        payload = f"{os.getpid()}:{self.token}".encode()
+        for attempt in (0, 1):
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            except FileExistsError:
+                holder_pid, _ = self._read_holder()
+                if attempt == 0 and (holder_pid is None or not _pid_alive(holder_pid)):
+                    # the writer died without releasing: steal the stale lock
+                    rank_zero_warn(
+                        f"Stealing stale journal writer lock {self.path!r}"
+                        f" (holder pid {holder_pid} is gone).",
+                        UserWarning,
+                    )
+                    try:
+                        os.unlink(self.path)
+                    except OSError:  # pragma: no cover - raced another stealer
+                        pass
+                    continue
+                raise JournalError(
+                    f"Journal dir {os.path.dirname(self.path)!r} already has a live"
+                    f" writer (pid {holder_pid}). Two writers appending to one journal"
+                    " interleave records silently; close() the other MetricJournal"
+                    " first, or recover()/break_lock() if that process is dead."
+                )
+            try:
+                os.write(fd, payload)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            self.held = True
+            return
+
+    def release(self) -> None:
+        if not self.held:
+            return
+        self.held = False
+        holder_pid, token = self._read_holder()
+        if holder_pid == os.getpid() and token == self.token:
+            try:
+                os.unlink(self.path)
+            except OSError:  # pragma: no cover - already gone
+                pass
+
+
+def break_lock(path: Union[str, os.PathLike]) -> bool:
+    """Force-release a journal dir's writer lock; True when a lock was removed.
+
+    For recovery flows only: calling this asserts the previous writer process is DEAD
+    (``recover`` calls it for you). Breaking the lock of a live writer re-opens the
+    silent-interleave hazard the lock exists to prevent.
+    """
+    lock_path = os.path.join(os.fspath(path), LOCK_FILENAME)
+    try:
+        os.unlink(lock_path)
+        return True
+    except OSError:
+        return False
 
 
 def _host_tree(value: Any) -> Any:
@@ -218,6 +326,9 @@ def recover(metric: Any, path: Union[str, os.PathLike]) -> Dict[str, Any]:
     snapshot's high-water mark is replayed. Returns ``{"snapshot_restored", "replayed"}``.
     """
     path = os.fspath(path)
+    # recovery means the previous writer process is gone — its writer lock (if any) is
+    # stale by definition; break it so the recovering process can open a fresh proxy
+    break_lock(path)
     jr = Journal(path)
     snap_path = os.path.join(path, SNAPSHOT_FILENAME)
     restored = False
@@ -262,13 +373,18 @@ class MetricJournal:
         if int(every_k) < 1:
             raise ValueError(f"journal(every_k) needs every_k >= 1, got {every_k}")
         self.metric = metric
-        self.journal = Journal(path, max_pending=max_pending)
-        self._every_k = int(every_k)
         self._resume = bool(resume)
-        self._since_snapshot = 0
         self.recovered: Optional[Dict[str, Any]] = None
         if self._resume:
-            self.recovered = recover(self.metric, self.journal.path)
+            # recover() first: it breaks any stale writer lock (the preempted process
+            # cannot release) before this proxy takes the exclusive lock below
+            self.recovered = recover(self.metric, os.fspath(path))
+        self._lock = _WriterLock(os.fspath(path))
+        os.makedirs(os.fspath(path), exist_ok=True)
+        self._lock.acquire()
+        self.journal = Journal(path, max_pending=max_pending)
+        self._every_k = int(every_k)
+        self._since_snapshot = 0
 
     @property
     def path(self) -> str:
@@ -298,9 +414,39 @@ class MetricJournal:
         # ``compute(keys=...)`` — reachable through the journaled proxy
         return self.metric.compute(*args, **kwargs)
 
+    def update_async(self, *args: Any, **kwargs: Any) -> Any:
+        """Journaled twin of ``metric.update_async`` (docs/serving.md "WAL contract").
+
+        Wires this journal into the metric's ingestion engine, which appends the batch
+        durably at ENQUEUE time — before it is even pending in the window — so a
+        preemption mid-overlap recovers ``snapshot + replay`` bit-identically. The
+        ``every_k`` snapshot cycle still runs; taking the snapshot quiesces the window
+        (a quiesced snapshot is exact).
+        """
+        eng = self.metric.serve(journal=self.journal)
+        if eng.journal is not self.journal:
+            raise JournalError(
+                "This metric's ingestion engine already journals to a different"
+                " directory; one WAL per metric."
+            )
+        ticket = self.metric.update_async(*args, **kwargs)
+        self._since_snapshot += 1
+        self._maybe_checkpoint()
+        return ticket
+
     def buffered(self, k: int) -> Any:
         """A :class:`BufferedUpdater` over the target with this journal at its seam."""
         return self.metric.buffered(k, journal=self.journal)
+
+    def close(self) -> None:
+        """Release the exclusive writer lock (idempotent); the journal stays readable."""
+        self._lock.release()
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        try:
+            self._lock.release()
+        except Exception:
+            pass
 
     def _maybe_checkpoint(self) -> None:
         if self._since_snapshot >= self._every_k:
@@ -320,7 +466,12 @@ class MetricJournal:
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
         # clean exit: consolidate to a snapshot. Error exit: leave the journal tail —
-        # the stream is durable either way, and recovery replays it faithfully.
-        if exc_type is None:
-            self.checkpoint()
+        # the stream is durable either way, and recovery replays it faithfully. The
+        # writer lock releases on BOTH paths (the process is alive; an armed lock would
+        # block its own next proxy).
+        try:
+            if exc_type is None:
+                self.checkpoint()
+        finally:
+            self.close()
         return False

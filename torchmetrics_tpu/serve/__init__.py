@@ -1,0 +1,31 @@
+"""Non-blocking serving tier: ``update_async`` with bounded backpressure (docs/serving.md).
+
+Opt-in per metric: ``metric.serve(ServeOptions(...), journal=...)`` configures the
+engine, ``metric.update_async(*batch)`` enqueues and returns an :class:`IngestTicket`.
+The disabled path costs one attribute check per update. See ``docs/serving.md`` for the
+window state machine, the on-full semantics table, the enqueue-time WAL contract, and
+the quiesce rules; ``docs/robustness.md`` for the chaos coverage.
+"""
+from torchmetrics_tpu.serve.engine import DrainKilled, IngestEngine, IngestTicket
+from torchmetrics_tpu.serve.options import (
+    ENV_SERVE_MAX_INFLIGHT,
+    ENV_SERVE_ON_FULL,
+    ENV_SERVE_QUEUE_TIMEOUT,
+    ENV_SERVE_STAGING_SLOTS,
+    ServeOptions,
+    serve_options_from_env,
+)
+from torchmetrics_tpu.serve.staging import StagingPipeline
+
+__all__ = [
+    "DrainKilled",
+    "IngestEngine",
+    "IngestTicket",
+    "ServeOptions",
+    "StagingPipeline",
+    "serve_options_from_env",
+    "ENV_SERVE_MAX_INFLIGHT",
+    "ENV_SERVE_ON_FULL",
+    "ENV_SERVE_QUEUE_TIMEOUT",
+    "ENV_SERVE_STAGING_SLOTS",
+]

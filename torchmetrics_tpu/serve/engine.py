@@ -1,0 +1,434 @@
+"""The async ingestion engine: bounded in-flight window, FIFO drain, quiesce contract.
+
+``Metric.update_async`` enqueues a batch and returns an :class:`IngestTicket` future; a
+single background drain thread applies enqueued batches strictly FIFO through the
+metric's ordinary synchronous dispatch tiers (jit / AOT+donation / keyed / sharded — the
+tiers the tier-equivalence and chaos suites already prove bit-identical). Because the
+drain is the ONLY mutator while the window is non-empty, the engine needs no per-state
+locking: every host access path (``update``/``forward``/``compute``/``snapshot``/
+``sync``/``reset``) quiesces the window first, so user code only ever observes a fully
+drained, exact state.
+
+Throughput comes from two overlaps plus one structural win: the staging transfer runs
+in the caller while the previous window computes; the caller's host work (request
+decode) runs while the drain dispatches; and when traffic bursts ahead of the drain,
+consecutive same-shape batches in the window are COALESCED through one
+``update_batches`` scan launch (``ServeOptions(coalesce=k)``) — k dispatches become
+one, which a synchronous per-batch loop structurally cannot do. Coalescing changes
+launch shape only, never values (the scan tier is bit-identical with the sequential
+loop), and strictly preserves FIFO.
+
+Crash consistency (docs/serving.md "WAL contract"): when a journal is attached, the
+batch is appended durably at *enqueue* time — before it is even pending in memory — so a
+preemption mid-overlap loses nothing: ``snapshot + replay(journal)`` re-drives the exact
+committed-plus-pending stream through the synchronous path, bit-identically.
+
+Fault latches (driven by the chaos injectors in ``torchmetrics_tpu.robust.chaos``):
+
+- **drain-thread death** (:class:`DrainThreadDeath`): the in-hand ticket is returned to
+  the window head before the thread dies; the next quiesce/enqueue detects the dead
+  thread, restarts it (``serve.drain_restarts``), and the restarted drain re-applies
+  from the window — no batch applied twice, none lost.
+- **queue overflow** (:class:`QueueOverflow`): the bounded window turns overflow into
+  the configured backpressure (block / raise / shed) instead of unbounded growth.
+- **staging transfer failure** (:class:`StagingTransferFailure`): absorbed inside
+  :class:`~torchmetrics_tpu.serve.staging.StagingPipeline` — unstaged host batches,
+  same values.
+- **apply failure**: the failing ticket records its error AND the engine latches it;
+  the next quiesce raises :class:`ServeError` so a ``compute()`` can never silently
+  omit a batch the caller believes was ingested.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional, Tuple
+
+from torchmetrics_tpu.obs import telemetry
+from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.serve.options import ServeOptions
+from torchmetrics_tpu.serve.staging import StagingPipeline
+from torchmetrics_tpu.utils.exceptions import BackpressureError, ServeError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+#: initial/backoff-capped park times for a blocking enqueue (exponential between them)
+_BLOCK_WAIT_MIN_S = 0.001
+_BLOCK_WAIT_MAX_S = 0.25
+
+
+class DrainKilled(BaseException):
+    """Chaos-only: simulates the drain thread dying between dequeue and apply.
+
+    A ``BaseException`` so the ordinary apply-failure handler (which absorbs
+    ``Exception``) cannot catch it — the thread genuinely terminates, exactly like an
+    external kill, and recovery must go through the restart latch.
+    """
+
+
+class IngestTicket:
+    """Lightweight future for one enqueued batch.
+
+    ``wait``/``result`` resolve when the drain commits (or fails/sheds) the batch;
+    ``generation`` is the :class:`StateStore` generation the commit landed at (the
+    fence readers can compare against ``Metric.state_generation``).
+    """
+
+    __slots__ = ("seq", "shed", "error", "generation", "_event")
+
+    def __init__(self, seq: int) -> None:
+        self.seq = seq
+        self.shed = False
+        self.error: Optional[BaseException] = None
+        self.generation: Optional[int] = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until resolved; raise the apply error if one fired, else return the
+        committed state generation (``None`` for a shed ticket)."""
+        if not self._event.wait(timeout):
+            raise BackpressureError(
+                f"IngestTicket #{self.seq} unresolved after {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.generation
+
+    def _resolve(self, generation: Optional[int] = None, error: Optional[BaseException] = None) -> None:
+        self.generation = generation
+        self.error = error
+        self._event.set()
+
+    def __repr__(self) -> str:
+        state = "shed" if self.shed else ("done" if self.done() else "pending")
+        return f"IngestTicket(seq={self.seq}, {state})"
+
+
+class IngestEngine:
+    """One metric's (or collection's) async ingestion window + drain thread."""
+
+    def __init__(self, target: Any, options: Optional[ServeOptions] = None,
+                 journal: Optional[Any] = None) -> None:
+        self.target = target
+        self.options = options or ServeOptions()
+        self.journal = journal
+        self._staging = StagingPipeline(self.options.staging_slots)
+        self._cond = threading.Condition()
+        self._queue: Deque[Tuple[IngestTicket, tuple, dict, Optional[int]]] = deque()
+        self._applying_n = 0  # batches popped from the queue and not yet committed
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._paused = False
+        self._flush = False  # a quiescer is waiting: bypass the linger dwell
+        self._abandoned = False
+        self._seq = 0
+        self._fence: Optional[int] = None  # StateStore generation after the last commit
+        self._pending_error: Optional[BaseException] = None
+        self._stats = {
+            "enqueued": 0, "committed": 0, "shed": 0, "failed": 0,
+            "drain_restarts": 0, "fence_breaks": 0, "backpressure_stalls": 0,
+        }
+
+    # ------------------------------------------------------------------ window state
+    @property
+    def inflight(self) -> int:
+        """Enqueued-but-uncommitted batches (including those being applied)."""
+        with self._cond:
+            return len(self._queue) + self._applying_n
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            out = dict(self._stats)
+            out["inflight"] = len(self._queue) + self._applying_n
+        return out
+
+    # ---------------------------------------------------------------------- enqueue
+    def enqueue(self, args: tuple, kwargs: dict) -> IngestTicket:
+        """Stage one batch into the bounded window; returns its ticket.
+
+        Journal append happens FIRST (write-ahead at enqueue time), then window
+        admission under the ``on_full`` policy, then the staging transfer — so a batch
+        that sheds was still journaled (replay reproduces the *offered* stream; the
+        shed count says which suffix of it the live state dropped).
+        """
+        if self._abandoned:
+            raise ServeError("This IngestEngine was abandoned (chaos preemption); build a fresh metric")
+        if self.journal is not None:
+            self.journal.append(args, kwargs)
+        ticket = self._admit(args, kwargs)
+        return ticket
+
+    def _admit(self, args: tuple, kwargs: dict) -> IngestTicket:
+        opts = self.options
+        with self._cond:
+            self._ensure_drain_locked()
+            ticket = IngestTicket(self._seq)
+            self._seq += 1
+            if self._window_full_locked():
+                if opts.on_full == "shed":
+                    ticket.shed = True
+                    ticket._resolve()
+                    self._stats["shed"] += 1
+                    telemetry.counter("serve.shed").inc()
+                    telemetry.counter("robust.shed_batches").inc()
+                    rank_zero_warn(
+                        f"Async ingestion window full ({opts.max_inflight} in flight):"
+                        " shedding batches (on_full='shed'). Shed counts are exact in"
+                        " serve.shed / IngestEngine.stats().",
+                        UserWarning,
+                    )
+                    return ticket
+                if opts.on_full == "raise":
+                    raise BackpressureError(
+                        f"Async ingestion window full ({opts.max_inflight} in flight)"
+                        " and on_full='raise'"
+                    )
+                # block: park with exponential-backoff waits against queue_timeout_s
+                self._stats["backpressure_stalls"] += 1
+                telemetry.counter("serve.backpressure_stalls").inc()
+                deadline = time.monotonic() + opts.queue_timeout_s
+                wait = _BLOCK_WAIT_MIN_S
+                while self._window_full_locked():
+                    self._ensure_drain_locked()
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        telemetry.counter("serve.queue_timeouts").inc()
+                        raise BackpressureError(
+                            f"Async ingestion enqueue blocked past queue_timeout_s="
+                            f"{opts.queue_timeout_s:g}s with {opts.max_inflight} in flight"
+                            " (is the drain stalled?)"
+                        )
+                    self._cond.wait(min(wait, remaining))
+                    wait = min(wait * 2, _BLOCK_WAIT_MAX_S)
+            s_args, s_kwargs, slot = self._staging.stage(args, kwargs)
+            self._queue.append((ticket, s_args, s_kwargs, slot, time.monotonic()))
+            self._stats["enqueued"] += 1
+            depth = len(self._queue) + self._applying_n
+            self._cond.notify_all()
+        telemetry.counter("serve.enqueued").inc()
+        telemetry.histogram("serve.queue_depth").record(depth)
+        return ticket
+
+    def _window_full_locked(self) -> bool:
+        return len(self._queue) + self._applying_n >= self.options.max_inflight
+
+    # ------------------------------------------------------------------------ drain
+    def _ensure_drain_locked(self) -> None:
+        """(Re)start the drain thread; the restart path is the thread-death latch."""
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        if t is not None:  # a previous drain died (chaos DrainThreadDeath, or a crash)
+            if not self.options.restart_drain:
+                raise ServeError(
+                    "The ingestion drain thread died and restart_drain is off; the"
+                    f" window holds {len(self._queue)} unapplied batch(es)."
+                )
+            self._stats["drain_restarts"] += 1
+            telemetry.counter("serve.drain_restarts").inc()
+            rank_zero_warn(
+                "The async ingestion drain thread died; restarting it. Batches still in"
+                " the window will be re-applied in FIFO order (none were committed).",
+                UserWarning,
+            )
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="tm-tpu-serve-drain"
+        )
+        self._thread.start()
+
+    def _drain_loop(self) -> None:
+        linger_s = self.options.linger_ms / 1000.0
+        while True:
+            with self._cond:
+                while (not self._queue or self._paused) and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                if self._paused and not self._stop:
+                    continue
+                if linger_s > 0 and not (self._flush or self._stop):
+                    # micro-batching dwell: give the enqueueing thread up to linger_ms
+                    # to fill a coalescible window before launching (bypassed the
+                    # moment a quiescer waits or the window is already full-width)
+                    while (
+                        0 < len(self._queue) < self.options.coalesce
+                        and not (self._flush or self._stop or self._paused)
+                    ):
+                        remaining = self._queue[0][4] + linger_s - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    if not self._queue or self._paused or (self._stop and not self._queue):
+                        continue
+                items = [self._queue.popleft()]
+                if self.options.coalesce > 1 and self._queue:
+                    # coalesce consecutive same-shape batches into one scan launch:
+                    # k dispatches become 1 (the update_batches tier), FIFO preserved.
+                    # Widths are quantized to powers of two so the compiled stacked-scan
+                    # signatures stay bounded at log2(coalesce) shapes — an arbitrary
+                    # width would AOT-compile a fresh scan per distinct burst size.
+                    key0 = _dispatch._batch_key(items[0][1], items[0][2])
+                    while self._queue and len(items) < self.options.coalesce:
+                        head = self._queue[0]
+                        if _dispatch._batch_key(head[1], head[2]) != key0:
+                            break
+                        items.append(self._queue.popleft())
+                    width = 1 << (len(items).bit_length() - 1)
+                    while len(items) > width:  # hand the overshoot back, order intact
+                        self._queue.appendleft(items.pop())
+                self._applying_n = len(items)
+            try:
+                self._apply_window(items)
+            except DrainKilled:
+                # the thread is dying between dequeue and apply: hand the window back
+                # (nothing was committed) so the restart latch re-applies it FIFO, then
+                # terminate without the default excepthook spew — the death is
+                # observable via the dead thread, exactly like an external kill
+                with self._cond:
+                    self._queue.extendleft(reversed(items))
+                    self._applying_n = 0
+                    self._cond.notify_all()
+                for it in items:
+                    self._staging.release(it[3])
+                return
+            except Exception as err:  # noqa: BLE001 - a bad batch must not kill the drain
+                self._stats["failed"] += len(items)
+                telemetry.counter("serve.apply_failures").inc(len(items))
+                for it in items:
+                    it[0]._resolve(error=err)
+                with self._cond:
+                    if self._pending_error is None:
+                        self._pending_error = err
+                    self._applying_n = 0
+                    self._cond.notify_all()
+            else:
+                telemetry.counter("serve.committed").inc(len(items))
+                if len(items) > 1:
+                    telemetry.counter("serve.coalesced_launches").inc()
+                with self._cond:
+                    self._stats["committed"] += len(items)
+                    self._applying_n = 0
+                    self._cond.notify_all()
+            finally:
+                for it in items:
+                    self._staging.release(it[3])
+
+    def _apply_window(self, items: list) -> None:
+        """Apply one FIFO window of batches through the target's synchronous tiers.
+
+        A single batch drives ``update``; a coalesced window stacks the batches and
+        drives ``update_batches`` (the compiled scan tier — bit-identical with the
+        sequential loop by the tier-equivalence contract). The generation fence:
+        between two drain commits nothing else may move the target's
+        :class:`StateStore` generation — a move means some other thread mutated state
+        while the window was non-empty (a quiesce-contract violation), which is
+        counted and warned, never silent.
+        """
+        store = getattr(self.target, "_state", None)
+        if store is not None and self._fence is not None and store.generation != self._fence:
+            self._stats["fence_breaks"] += 1
+            telemetry.counter("serve.fence_breaks").inc()
+            rank_zero_warn(
+                "Async ingestion generation fence broke: the metric state moved"
+                f" (generation {self._fence} -> {store.generation}) while batches were"
+                " in flight. Some non-drain code mutated state without quiescing the"
+                " window first.",
+                UserWarning,
+            )
+        if len(items) == 1:
+            args, kwargs = items[0][1], items[0][2]
+            self.target.update(*args, **kwargs)
+        else:
+            import jax.numpy as jnp
+
+            first_args, first_kwargs = items[0][1], items[0][2]
+            stacked_args = tuple(
+                jnp.stack([it[1][i] for it in items]) for i in range(len(first_args))
+            )
+            stacked_kwargs = {
+                name: jnp.stack([it[2][name] for it in items]) for name in first_kwargs
+            }
+            self.target.update_batches(*stacked_args, **stacked_kwargs)
+        gen = store.generation if store is not None else None
+        self._fence = gen
+        for it in items:
+            it[0]._resolve(generation=gen)
+
+    # ---------------------------------------------------------------------- quiesce
+    def quiesce(self, timeout: Optional[float] = None) -> None:
+        """Block until the window is empty (called by every host access path).
+
+        No-op from the drain thread itself (the drain calling ``target.update`` must
+        not wait on its own queue). Restarts a dead drain when batches are pending;
+        re-raises the first deferred apply error so a drained state is either exact or
+        loudly incomplete — never silently short.
+        """
+        if threading.current_thread() is self._thread:
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._flush = True  # bypass the linger dwell: a reader is waiting
+            self._cond.notify_all()
+            try:
+                while self._queue or self._applying_n:
+                    self._ensure_drain_locked()
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise ServeError(
+                            f"quiesce timed out with {len(self._queue)} batch(es) still in"
+                            " the ingestion window"
+                        )
+                    self._cond.wait(0.05)
+            finally:
+                self._flush = False
+            # an empty window means user code may mutate state freely until the next
+            # enqueue; drop the fence so legitimate post-quiesce mutations don't trip it
+            self._fence = None
+            err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise ServeError(
+                f"A batch enqueued via update_async failed to apply: {err!r}. The"
+                " metric state holds every batch before it; the failed batch is NOT"
+                " included."
+            ) from err
+
+    # ------------------------------------------------------------- chaos/test seams
+    def pause(self) -> None:
+        """Hold the drain (QueueOverflow chaos: fills the window deterministically)."""
+        with self._cond:
+            self._paused = True
+            self._cond.notify_all()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def abandon(self) -> int:
+        """Chaos preemption: drop the engine cold, window and all; returns the number of
+        batches that were in flight. The journal (appended at enqueue) is the only
+        survivor — recovery is ``snapshot + replay(journal)`` on a FRESH metric."""
+        with self._cond:
+            dropped = len(self._queue) + self._applying_n
+            self._queue.clear()
+            self._paused = False
+            self._stop = True
+            self._abandoned = True
+            self._cond.notify_all()
+        return dropped
+
+    def close(self) -> None:
+        """Drain outstanding batches, then stop the thread (idempotent)."""
+        self.quiesce()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)

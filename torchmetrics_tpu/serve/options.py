@@ -1,0 +1,108 @@
+"""Serving-tier policy: the bounded in-flight window and its on-full semantics.
+
+The bound is the robustness property: an unbounded enqueue path turns a traffic spike
+into host-RAM/HBM exhaustion, a bounded one turns it into *backpressure* — the caller
+blocks, errors, or sheds, and the engine's memory footprint stays ``O(max_inflight +
+staging_slots)`` batches whatever the arrival rate does. ``on_full`` picks the contract:
+
+==========  =========================================================================
+``block``   park the caller with exponential-backoff waits until a slot frees; give up
+            with :class:`~torchmetrics_tpu.utils.exceptions.BackpressureError` after
+            ``queue_timeout_s`` (a stuck drain must not wedge the service forever)
+``raise``   fail the enqueue immediately with :class:`BackpressureError` (the caller
+            owns the retry/shed policy)
+``shed``    drop the batch, count it (``serve.shed`` / ``robust.shed_batches``), warn
+            once rank-zero, and return a ticket marked ``shed`` — graceful degradation
+==========  =========================================================================
+
+Env knobs (read by :func:`serve_options_from_env`, the default when ``update_async`` is
+called on an unconfigured metric): ``TM_TPU_SERVE_MAX_INFLIGHT``, ``TM_TPU_SERVE_ON_FULL``,
+``TM_TPU_SERVE_QUEUE_TIMEOUT_S``, ``TM_TPU_SERVE_STAGING_SLOTS``.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from torchmetrics_tpu.utils.exceptions import ServeError
+
+ENV_SERVE_MAX_INFLIGHT = "TM_TPU_SERVE_MAX_INFLIGHT"
+ENV_SERVE_ON_FULL = "TM_TPU_SERVE_ON_FULL"
+ENV_SERVE_QUEUE_TIMEOUT = "TM_TPU_SERVE_QUEUE_TIMEOUT_S"
+ENV_SERVE_STAGING_SLOTS = "TM_TPU_SERVE_STAGING_SLOTS"
+ENV_SERVE_COALESCE = "TM_TPU_SERVE_COALESCE"
+ENV_SERVE_LINGER = "TM_TPU_SERVE_LINGER_MS"
+
+_ON_FULL = ("block", "raise", "shed")
+
+
+@dataclass(frozen=True)
+class ServeOptions:
+    """Policy for one :class:`~torchmetrics_tpu.serve.engine.IngestEngine`.
+
+    ``max_inflight`` bounds enqueued-but-uncommitted batches (the in-flight window,
+    including the batch the drain thread is currently applying). ``queue_timeout_s``
+    caps how long one blocking enqueue may park. ``staging_slots`` sizes the
+    double-buffered host→device staging pipeline (transfer-ahead depth).
+    ``restart_drain`` lets quiesce revive a dead drain thread (the drain-thread-death
+    recovery latch); turning it off makes thread death a hard :class:`ServeError`.
+    """
+
+    max_inflight: int = 64
+    on_full: str = "block"
+    queue_timeout_s: float = 30.0
+    staging_slots: int = 2
+    #: drain-side batch coalescing: when the window holds several consecutive batches of
+    #: the same shape signature, the drain folds up to this many through ONE
+    #: ``update_batches`` scan launch instead of one dispatch each — the structural
+    #: throughput win a synchronous per-batch loop cannot have (k dispatches → 1,
+    #: bit-identical by the tier-equivalence contract). 1 disables coalescing.
+    coalesce: int = 16
+    #: micro-batching dwell (milliseconds): with a short queue the drain waits up to
+    #: this long for more same-shape batches before launching, so steady high-rate
+    #: traffic coalesces instead of degenerating into per-batch launches that fight
+    #: the enqueueing thread for the GIL (the Nagle tradeoff: + linger on commit
+    #: latency, x coalesce on drain throughput). 0 launches immediately. Quiesce and
+    #: close bypass the linger — a waiting reader never pays it.
+    linger_ms: float = 0.0
+    restart_drain: bool = True
+
+    def __post_init__(self) -> None:
+        if int(self.max_inflight) < 1:
+            raise ServeError(f"ServeOptions(max_inflight) needs >= 1, got {self.max_inflight}")
+        if int(self.coalesce) < 1:
+            raise ServeError(f"ServeOptions(coalesce) needs >= 1, got {self.coalesce}")
+        if float(self.linger_ms) < 0:
+            raise ServeError(f"ServeOptions(linger_ms) needs >= 0, got {self.linger_ms}")
+        if self.on_full not in _ON_FULL:
+            raise ServeError(
+                f"ServeOptions(on_full) must be one of {_ON_FULL}, got {self.on_full!r}"
+            )
+        if float(self.queue_timeout_s) < 0:
+            raise ServeError(
+                f"ServeOptions(queue_timeout_s) needs >= 0, got {self.queue_timeout_s}"
+            )
+        if int(self.staging_slots) < 1:
+            raise ServeError(f"ServeOptions(staging_slots) needs >= 1, got {self.staging_slots}")
+
+
+def serve_options_from_env() -> ServeOptions:
+    """Build :class:`ServeOptions` from the ``TM_TPU_SERVE_*`` environment knobs."""
+
+    def _f(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
+    on_full = str(os.environ.get(ENV_SERVE_ON_FULL, "block")).strip().lower()
+    if on_full not in _ON_FULL:
+        on_full = "block"
+    return ServeOptions(
+        max_inflight=int(_f(ENV_SERVE_MAX_INFLIGHT, 64)),
+        on_full=on_full,
+        queue_timeout_s=_f(ENV_SERVE_QUEUE_TIMEOUT, 30.0),
+        staging_slots=int(_f(ENV_SERVE_STAGING_SLOTS, 2)),
+        coalesce=int(_f(ENV_SERVE_COALESCE, 16)),
+        linger_ms=_f(ENV_SERVE_LINGER, 0.0),
+    )

@@ -56,6 +56,25 @@ class JournalError(TorchMetricsUserError):
     """
 
 
+class ServeError(TorchMetricsUserError):
+    """Raised by the async ingestion tier (``torchmetrics_tpu.serve``) on engine faults.
+
+    Covers a drain thread that died and could not be restarted, an enqueued batch whose
+    deferred apply failed (surfaced at the next quiesce so ``compute()`` can never
+    silently miss a committed-looking batch), and invalid ``ServeOptions``.
+    """
+
+
+class BackpressureError(ServeError):
+    """Raised when the bounded in-flight window rejects an ``update_async`` enqueue.
+
+    Fired immediately with ``ServeOptions(on_full="raise")``, or after
+    ``queue_timeout_s`` of blocking with ``on_full="block"``. With ``on_full="shed"``
+    the batch is dropped-and-counted instead and no exception is raised — see
+    ``docs/serving.md`` for the on-full semantics table.
+    """
+
+
 class ReconciliationError(TorchMetricsUserError):
     """Raised when a rank re-admission handshake blob fails validation.
 

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -44,6 +44,16 @@ shard-smoke:
 serve-smoke:
 	python bench.py --serve --smoke > /tmp/tm_serve_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_serve_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; r=ex['serve_async_vs_sync_completion']; assert r >= 1.0, ('async completion fell below sync', ex); assert ex['serve_block_mode_sheds'] == 0 and ex['serve_block_mode_stalls'] == 0, ex; bits=[v for k,v in ex.items() if k.startswith('serve_bit_identical')]; assert bits and all(bits), ex; assert ex['serve_overload_sheds_exact'], ex; print('serve-smoke ok: async %.2fx sync, sustained %.2fx @1.2x offered, enqueue p99 %sus' % (r, ex['serve_sustained_vs_sync'], ex['serve_enqueue_p99_us']))"
+
+# serving-observability lane (docs/observability.md "Serving traces, live series &
+# SLOs"): traced serve burst -> exported Perfetto trace with VALID flow pairing (every
+# ph:"s" has its ph:"f", committed flows land on the drain track), OpenMetrics
+# exposition round-tripped through the strict parser AND fetched over the localhost
+# scrape endpoint, the SLO shed-ratio alarm quiet on a healthy run and FIRING on an
+# injected shed storm, and the tracing-disabled enqueue hook chain <= 2us/enqueue
+obs-smoke:
+	python bench.py --obs --smoke > /tmp/tm_obs_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_obs_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['obs_trace_flows_valid'] and ex['obs_trace_flows'] > 0, ex; assert ex['obs_trace_committed_cross_thread'] == ex['obs_trace_flows'], ex; assert ex['obs_openmetrics_valid'] and ex['obs_scrape_valid'], ex; assert ex['obs_slo_quiet_when_healthy'] and ex['obs_slo_alarm_fired'], ex; assert ex['obs_disabled_overhead_ok'], ('disabled-path enqueue hooks above the 2us bound', ex['obs_disabled_hook_overhead_us']); print('obs-smoke ok: %d flows valid, %dB OpenMetrics (%d families), SLO burn %.0fx on %d sheds, disabled-path %.2fus' % (ex['obs_trace_flows'], ex['obs_openmetrics_bytes'], ex['obs_openmetrics_families'], ex['obs_slo_burn_rate'], ex['obs_slo_storm_sheds'], ex['obs_disabled_hook_overhead_us']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

@@ -81,3 +81,57 @@ def test_fused_sweep_exports_parseable_trace(tmp_path):
     tel = mc.telemetry
     assert tel["dispatches"] >= 1
     assert tel["retraces_total"] == 0
+
+
+def test_serve_burst_exports_valid_flow_events(tmp_path):
+    """A serve burst traces every ticket caller->drain: the Perfetto flow contract.
+
+    Every ``ph:"s"`` must pair with exactly one ``ph:"f"`` under a unique per-ticket
+    id, and committed flows must land on the drain-thread track — the ISSUE-12
+    acceptance shape, checked against the actually-exported trace file.
+    """
+    from torchmetrics_tpu.aggregation import SumMetric
+    from torchmetrics_tpu.obs import trace
+    from torchmetrics_tpu.serve import ServeOptions
+
+    trace.clear()
+    n = 12
+    try:
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=16, coalesce=4))
+            tickets = [m.update_async(jnp.asarray(float(i))) for i in range(n)]
+            eng.quiesce()
+            assert float(m.compute()) == float(sum(range(n)))
+            trace_path = tmp_path / "serve_trace.json"
+            obs.export_trace(trace_path)
+    finally:
+        events = trace.events()
+        trace.clear()
+
+    data = json.load(open(trace_path))
+    exported = data["traceEvents"]
+    for e in exported:
+        assert "ph" in e and "ts" in e and "pid" in e
+
+    starts = [e for e in exported if e.get("ph") == "s" and e.get("cat") == "serve"]
+    ends = [e for e in exported if e.get("ph") == "f" and e.get("cat") == "serve"]
+    assert len(starts) == n
+    ids = [e["id"] for e in starts]
+    assert len(set(ids)) == n, "flow ids must be unique per ticket"
+    assert sorted(ids) == sorted(t.trace_id for t in tickets)
+    end_ids = {e["id"] for e in ends}
+    assert all(i in end_ids for i in ids), "every flow start needs a matching end"
+    for e in ends:
+        assert e.get("bp") == "e"
+
+    # committed flows end on the drain-thread track, not the caller's
+    verdict = trace.validate_flows(events)
+    assert verdict["valid"], verdict
+    assert verdict["committed_cross_thread"] == n
+    drain_tids = {e["tid"] for e in events if e["name"] == "thread_name"
+                  and e["args"]["name"] == "serve-drain"}
+    assert {e["tid"] for e in ends} <= drain_tids
+
+    # the always-on series fed the registry alongside the trace
+    assert obs.telemetry.get_series("serve.commit_latency_us").count >= n

@@ -1,0 +1,158 @@
+"""TPU016: unclosed spans + trace-ring/series mutation inside jit-traced code."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+
+def _tpu016(source: str, path: str = "pkg/module.py"):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU016"]
+
+
+# --------------------------------------------------------------- prong 1: span closure
+LEAKED_SPAN = """
+def work(telemetry, x):
+    s = telemetry.span("work")
+    s.__enter__()
+    return x + 1
+"""
+
+WITH_SPAN = """
+def work(telemetry, x):
+    with telemetry.span("work"):
+        return x + 1
+"""
+
+
+class TestSpanClosure:
+    def test_manually_entered_span_without_finally_flags(self):
+        findings = _tpu016(LEAKED_SPAN)
+        assert len(findings) == 1
+        assert "never closed" in findings[0].message
+
+    def test_with_span_is_clean(self):
+        assert _tpu016(WITH_SPAN) == []
+
+    def test_bare_span_call_flags(self):
+        src = "def work(telemetry):\n    telemetry.span('dropped')\n"
+        assert len(_tpu016(src)) == 1
+
+    def test_assigned_then_with_is_clean(self):
+        src = """
+def work(telemetry, x):
+    s = telemetry.span("work")
+    with s:
+        return x + 1
+"""
+        assert _tpu016(src) == []
+
+    def test_try_finally_exit_is_clean(self):
+        src = """
+def work(telemetry, x):
+    s = telemetry.span("work")
+    s.__enter__()
+    try:
+        return x + 1
+    finally:
+        s.__exit__(None, None, None)
+"""
+        assert _tpu016(src) == []
+
+    def test_returned_span_is_factory_idiom(self):
+        src = "def my_span(telemetry):\n    return telemetry.span('scoped')\n"
+        assert _tpu016(src) == []
+
+    def test_metric_span_covered(self):
+        src = "def work(obs, m):\n    sc = obs.metric_span(m, 'update')\n    sc.__enter__()\n"
+        assert len(_tpu016(src)) == 1
+
+    def test_inline_disable_waives(self):
+        src = (
+            "def work(telemetry):\n"
+            "    s = telemetry.span('x')  # jaxlint: disable=TPU016\n"
+        )
+        assert _tpu016(src) == []
+
+
+# ------------------------------------------------- prong 2: trace mutation under jit
+JIT_TRACE_MUTATION = """
+import jax
+
+@jax.jit
+def _update(state, x):
+    trace.dispatched_event(1, "update", 1)
+    return state + x
+"""
+
+EAGER_TRACE_MUTATION = """
+def drain(items):
+    trace.dispatched_event(1, "update", len(items))
+    return items
+"""
+
+
+class TestJitTraceMutation:
+    def test_trace_hook_inside_jit_flags(self):
+        findings = _tpu016(JIT_TRACE_MUTATION)
+        assert len(findings) == 1
+        assert "TRACE time" in findings[0].message
+
+    def test_trace_hook_in_eager_code_is_clean(self):
+        assert _tpu016(EAGER_TRACE_MUTATION) == []
+
+    def test_ring_push_inside_jit_flags(self):
+        src = """
+import jax
+
+@jax.jit
+def _compute(state):
+    ring.push({"name": "bad"})
+    return state
+"""
+        assert len(_tpu016(src)) == 1
+
+    def test_series_record_inside_jit_flags(self):
+        src = """
+import jax
+
+@jax.jit
+def _update(state, x):
+    telemetry.series("serve.queue_depth").record(1.0)
+    return state + x
+"""
+        findings = _tpu016(src)
+        assert len(findings) == 1
+        assert "series" in findings[0].message
+
+    def test_series_record_in_eager_code_is_clean(self):
+        src = "def enqueue(telemetry, d):\n    telemetry.series('q').record(d)\n"
+        assert _tpu016(src) == []
+
+    def test_convention_jit_method_covered(self):
+        # _update is jitted by the Metric engine convention, no decorator needed
+        src = """
+class M:
+    def _update(self, state, x):
+        trace.committed_event(1, 0.0, None)
+        return state + x
+"""
+        assert len(_tpu016(src)) == 1
+
+
+class TestRegistry:
+    def test_rule_registered_with_metadata(self):
+        meta = RULE_META["TPU016"]
+        assert meta["severity"] == "warning"
+        assert "span" in meta["summary"]
+
+    def test_package_is_clean_under_tpu016(self):
+        # the shipped obs/serve modules must satisfy their own rule (baseline EMPTY)
+        import pathlib
+
+        import torchmetrics_tpu.obs as obs_pkg
+
+        root = pathlib.Path(obs_pkg.__file__).parent
+        for py in sorted(root.glob("*.py")):
+            src = py.read_text()
+            findings = _tpu016(src, path=str(py))
+            assert findings == [], (py, [f.message for f in findings])

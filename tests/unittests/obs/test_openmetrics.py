@@ -1,0 +1,156 @@
+"""OpenMetrics exposition: spec-valid rendering, strict-parser round-trip, scrape endpoint."""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from torchmetrics_tpu.obs import openmetrics
+from torchmetrics_tpu.obs.telemetry import Telemetry
+
+
+def _registry() -> Telemetry:
+    t = Telemetry(enabled=False)
+    t.counter("serve.enqueued").inc(12)
+    t.counter("serve.shed").inc(2)
+    t.gauge("slo.demo.burn_rate").set(3.5)
+    t.timer("metric.M.update").observe(0.25)
+    t.timer("metric.M.update").observe(0.75)
+    h = t.histogram("sync.latency_us")
+    for v in range(100):
+        h.record(float(v))
+    s = t.series("serve.commit_latency_us")
+    for v in range(200):
+        s.record(float(v * 10), now=float(v))
+    return t
+
+
+class TestRender:
+    def test_families_and_samples(self):
+        text = openmetrics.render(registry=_registry())
+        assert text.endswith("# EOF\n")
+        assert "# TYPE tm_serve_enqueued counter" in text
+        assert 'tm_serve_enqueued_total{rank="0"} 12' in text
+        assert "# TYPE tm_slo_demo_burn_rate gauge" in text
+        assert "# TYPE tm_metric_M_update_seconds summary" in text
+        assert 'tm_metric_M_update_seconds_sum{rank="0"} 1' in text
+        assert 'tm_metric_M_update_seconds_count{rank="0"} 2' in text
+        assert "# TYPE tm_serve_commit_latency_us summary" in text
+        assert 'quantile="0.99"' in text
+
+    def test_every_type_declared_before_samples(self):
+        text = openmetrics.render(registry=_registry())
+        seen = set()
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                seen.add(line.split(" ")[2])
+            elif line and not line.startswith("#"):
+                name = line.split("{")[0]
+                assert any(
+                    name == fam or name.startswith(fam + "_") for fam in seen
+                ), line
+
+    def test_write_to_file(self, tmp_path):
+        path = openmetrics.write(tmp_path / "metrics.om", registry=_registry())
+        text = open(path).read()
+        assert openmetrics.parse(text)["samples"] > 0
+
+
+class TestStrictParserRoundTrip:
+    def test_round_trip(self):
+        text = openmetrics.render(registry=_registry())
+        parsed = openmetrics.parse(text)
+        fams = parsed["families"]
+        assert fams["tm_serve_enqueued"]["type"] == "counter"
+        [c] = fams["tm_serve_enqueued"]["samples"]
+        assert c["value"] == 12.0 and c["labels"]["rank"] == "0"
+        summary = fams["tm_serve_commit_latency_us"]
+        kinds = {s["name"].rsplit("_", 1)[-1] for s in summary["samples"]}
+        assert "count" in kinds and "sum" in kinds
+        quantiles = [s for s in summary["samples"] if "quantile" in s["labels"]]
+        assert len(quantiles) == 3
+
+    def test_missing_eof_rejected(self):
+        with pytest.raises(ValueError, match="EOF"):
+            openmetrics.parse('# TYPE x counter\nx_total{rank="0"} 1\n')
+
+    def test_undeclared_family_rejected(self):
+        with pytest.raises(ValueError, match="no declared family"):
+            openmetrics.parse('mystery_total{rank="0"} 1\n# EOF\n')
+
+    def test_counter_without_total_suffix_rejected(self):
+        with pytest.raises(ValueError, match="_total"):
+            openmetrics.parse('# TYPE x counter\nx{rank="0"} 1\n# EOF\n')
+
+    def test_duplicate_type_rejected(self):
+        with pytest.raises(ValueError, match="twice"):
+            openmetrics.parse("# TYPE x counter\n# TYPE x counter\n# EOF\n")
+
+    def test_malformed_label_rejected(self):
+        with pytest.raises(ValueError, match="label"):
+            openmetrics.parse("# TYPE x gauge\nx{rank=0} 1\n# EOF\n")
+
+    def test_quantile_on_counter_rejected(self):
+        with pytest.raises(ValueError, match="quantile"):
+            openmetrics.parse('# TYPE x summary\nx_count{quantile="0.5"} 1\n# EOF\n')
+
+    def test_content_after_eof_rejected(self):
+        with pytest.raises(ValueError, match="after"):
+            openmetrics.parse("# TYPE x gauge\n# EOF\nx 1\n")
+
+
+class TestMergedView:
+    def test_injected_gather_merges_ranks(self):
+        t = _registry()
+        local = json.dumps({"rank": 0, "snapshot": t.snapshot()})
+
+        def gather_fn(payload):
+            other = json.loads(payload)
+            other = {"rank": 1, "snapshot": other["snapshot"]}
+            return [payload, json.dumps(other)]
+
+        text = openmetrics.render(registry=t, merged=True, gather_fn=gather_fn)
+        parsed = openmetrics.parse(text)
+        samples = parsed["families"]["tm_serve_enqueued"]["samples"]
+        assert {s["labels"]["rank"] for s in samples} == {"0", "1"}
+        # family metadata appears once even with two ranks contributing
+        assert text.count("# TYPE tm_serve_enqueued counter") == 1
+        del local
+
+    def test_skew_report_folds_in_as_per_rank_gauges(self):
+        from torchmetrics_tpu.parallel import sync as _sync
+
+        _sync.reset_skew_state()
+        try:
+            _sync._record_gather_latency(0.001)
+            _sync._record_gather_latency(0.002)
+
+            def gather_fn(payload, _group):
+                return [payload, payload * 3.0]  # rank 1 three times slower
+
+            _sync.skew_report(gather_fn=gather_fn)
+            text = openmetrics.render(registry=_registry())
+            parsed = openmetrics.parse(text)
+            g = parsed["families"]["tm_sync_gather_mean_us"]["samples"]
+            assert {s["labels"]["rank"] for s in g} == {"0", "1"}
+            assert "tm_sync_straggler_index" in parsed["families"]
+        finally:
+            _sync.reset_skew_state()
+
+
+class TestScrapeEndpoint:
+    def test_localhost_scrape_round_trips(self):
+        t = _registry()
+        with openmetrics.serve_scrape(registry=t) as srv:
+            assert srv.url.startswith("http://127.0.0.1:")
+            with urllib.request.urlopen(srv.url, timeout=5.0) as resp:
+                assert resp.headers["Content-Type"] == openmetrics.CONTENT_TYPE
+                body = resp.read().decode("utf-8")
+        parsed = openmetrics.parse(body)
+        assert parsed["families"]["tm_serve_enqueued"]["samples"][0]["value"] == 12.0
+
+    def test_unknown_path_is_404(self):
+        with openmetrics.serve_scrape(registry=_registry()) as srv:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(srv.url.replace("/metrics", "/nope"), timeout=5.0)

@@ -1,0 +1,159 @@
+"""SLO burn-rate monitor: spec validation, fixed synthetic series, alarm evidence."""
+from __future__ import annotations
+
+import warnings
+
+import pytest
+
+from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_serve_specs
+from torchmetrics_tpu.obs.telemetry import Telemetry
+
+
+def _latency_registry(bad_every: int) -> Telemetry:
+    """200 samples over 20s of synthetic time; every ``bad_every``-th exceeds 100."""
+    t = Telemetry(enabled=False)
+    s = t.series("lat")
+    for i in range(200):
+        v = 1000.0 if (bad_every and i % bad_every == 0) else 10.0
+        s.record(v, now=100.0 + i * 0.1)
+    return t
+
+
+class TestSpecValidation:
+    def test_objective_bounds(self):
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec(name="x", series="s", objective=1.0)
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec(name="x", series="s", objective=0.0)
+
+    def test_bad_when_vocabulary(self):
+        with pytest.raises(ValueError, match="bad_when"):
+            SloSpec(name="x", series="s", bad_when="sideways")
+
+    def test_windows_positive(self):
+        with pytest.raises(ValueError, match="window"):
+            SloSpec(name="x", series="s", windows=((0.0, 1.0),))
+        with pytest.raises(ValueError, match="at least one"):
+            SloSpec(name="x", series="s", windows=())
+
+    def test_budget(self):
+        assert SloSpec(name="x", series="s", objective=0.99).budget == pytest.approx(0.01)
+
+
+class TestBurnRateMath:
+    def test_error_rate_and_burn_at_fixed_series(self):
+        t = _latency_registry(bad_every=10)  # 10% bad
+        spec = SloSpec(name="lat", series="lat", objective=0.99, threshold=100.0,
+                       windows=((5.0, 1.0), (20.0, 1.0)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            [st] = SloMonitor([spec], registry=t).evaluate(now=120.0)
+        assert st.error_rates[20.0] == pytest.approx(0.1, abs=0.02)
+        assert st.worst_burn == pytest.approx(10.0, rel=0.25)  # 0.1 error / 0.01 budget
+        assert st.burning
+
+    def test_healthy_series_does_not_fire(self):
+        t = _latency_registry(bad_every=0)  # all good
+        spec = SloSpec(name="lat", series="lat", objective=0.99, threshold=100.0,
+                       windows=((5.0, 1.0), (20.0, 1.0)))
+        [st] = SloMonitor([spec], registry=t).evaluate(now=120.0)
+        assert not st.burning
+        assert st.worst_burn == 0.0
+        assert t.counter("slo.alarms").value == 0
+
+    def test_multi_window_and_gate(self):
+        # bad samples only in the distant past: long window burns, short one is clean
+        t = Telemetry(enabled=False)
+        s = t.series("lat")
+        for i in range(100):
+            s.record(1000.0, now=100.0 + i * 0.1)   # old storm
+        for i in range(100):
+            s.record(10.0, now=150.0 + i * 0.1)     # recent calm
+        spec = SloSpec(name="lat", series="lat", objective=0.99, threshold=100.0,
+                       windows=((5.0, 1.0), (100.0, 1.0)))
+        [st] = SloMonitor([spec], registry=t).evaluate(now=160.0)
+        assert st.burn_rates[100.0] > 1.0  # sustained view still hot
+        assert st.burn_rates[5.0] == 0.0   # but no longer happening
+        assert not st.burning              # the AND gate holds the alarm back
+
+    def test_empty_window_is_no_evidence(self):
+        t = Telemetry(enabled=False)
+        t.series("lat")  # exists, never recorded
+        spec = SloSpec(name="lat", series="lat", windows=((5.0, 1.0),))
+        [st] = SloMonitor([spec], registry=t).evaluate(now=100.0)
+        assert not st.burning
+        assert st.burn_rates[5.0] is None
+
+    def test_missing_series_is_no_evidence(self):
+        t = Telemetry(enabled=False)
+        spec = SloSpec(name="lat", series="never.recorded", windows=((5.0, 1.0),))
+        [st] = SloMonitor([spec], registry=t).evaluate(now=100.0)
+        assert not st.burning
+
+
+class TestRatioMode:
+    def test_shed_ratio_burns(self):
+        t = Telemetry(enabled=False)
+        sheds, offered = t.series("sheds"), t.series("offered")
+        for i in range(100):
+            offered.record(1.0, now=100.0 + i * 0.1)
+            if i % 4 == 0:
+                sheds.record(1.0, now=100.0 + i * 0.1)  # 25% shed
+        spec = SloSpec(name="shed", series="sheds", ratio_of="offered",
+                       objective=0.999, windows=((10.0, 1.0),))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            [st] = SloMonitor([spec], registry=t).evaluate(now=110.0)
+        assert st.burning
+        assert st.error_rates[10.0] == pytest.approx(0.25, abs=0.05)
+
+    def test_no_traffic_is_no_evidence(self):
+        t = Telemetry(enabled=False)
+        t.series("sheds"), t.series("offered")
+        spec = SloSpec(name="shed", series="sheds", ratio_of="offered",
+                       windows=((10.0, 1.0),))
+        [st] = SloMonitor([spec], registry=t).evaluate(now=100.0)
+        assert not st.burning
+
+
+class TestAlarmEvidence:
+    def _burning_monitor(self):
+        t = _latency_registry(bad_every=2)  # 50% bad: hard burn
+        spec = SloSpec(name="lat", series="lat", objective=0.99, threshold=100.0,
+                       windows=((20.0, 1.0),))
+        return t, SloMonitor([spec], registry=t)
+
+    def test_counters_gauge_and_warning(self):
+        t, mon = self._burning_monitor()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mon.evaluate(now=120.0)
+        assert any("SLO 'lat' burning" in str(w.message) for w in caught)
+        assert t.counter("slo.alarms").value == 1
+        assert t.counter("slo.alarms.lat").value == 1
+        assert t.gauge("slo.lat.burn_rate").value > 1.0
+        assert t.counter("slo.evaluations").value == 1
+        assert mon.burning() == ["lat"]
+
+    def test_warning_fires_once_per_transition(self):
+        t, mon = self._burning_monitor()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mon.evaluate(now=120.0)
+            mon.evaluate(now=120.5)  # still burning: counter moves, warn does not
+        assert sum("SLO 'lat'" in str(w.message) for w in caught) == 1
+        assert t.counter("slo.alarms.lat").value == 2
+
+
+class TestDefaults:
+    def test_default_serve_specs_shape(self):
+        specs = default_serve_specs()
+        names = {s.name for s in specs}
+        assert names == {"commit-latency", "shed-ratio"}
+        shed = next(s for s in specs if s.name == "shed-ratio")
+        assert shed.ratio_of == "serve.queue_depth"
+
+    def test_signals_empty_registry(self):
+        mon = SloMonitor([], registry=Telemetry(enabled=False))
+        sig = mon.signals()
+        assert sig["commit_rate"] is None and sig["shed_rate"] is None

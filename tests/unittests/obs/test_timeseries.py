@@ -1,0 +1,132 @@
+"""Live time series: KLL-backed quantiles, windowed views, O(1) memory, registry wiring."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.obs.telemetry import Telemetry
+from torchmetrics_tpu.obs.timeseries import TimeSeries
+
+
+class TestRecordAndQuantiles:
+    def test_empty_series(self):
+        ts = TimeSeries("t")
+        assert ts.count == 0
+        assert ts.last is None
+        assert ts.quantile(0.5) is None
+        assert ts.quantiles((0.5, 0.99)) == [None, None]
+
+    def test_quantiles_track_numpy_percentile(self):
+        rng = np.random.RandomState(7)
+        vals = rng.randn(20_000).astype(np.float64) * 100.0
+        ts = TimeSeries("t", fold_every=512)
+        for v in vals:
+            ts.record(float(v))
+        for q in (0.1, 0.5, 0.9, 0.99):
+            got = ts.quantile(q)
+            # KLL rank-error contract: the estimate's true rank is within eps*n
+            rank = float(np.searchsorted(np.sort(vals), got)) / len(vals)
+            assert abs(rank - q) <= 0.03, (q, got, rank)
+
+    def test_count_and_sum_exact(self):
+        ts = TimeSeries("t", fold_every=16)
+        for i in range(1000):
+            ts.record(1.0)
+        assert ts.count == 1000
+        assert ts.total == pytest.approx(1000.0)
+
+    def test_partial_pending_folds_at_read(self):
+        ts = TimeSeries("t", fold_every=10_000)  # nothing folds during recording
+        for i in range(100):
+            ts.record(float(i))
+        assert abs(ts.quantile(0.5) - 49.5) <= 5.0
+
+
+class TestWindowedViews:
+    def test_window_selects_recent_points(self):
+        ts = TimeSeries("t")
+        for i in range(100):
+            ts.record(float(i), now=float(i))
+        assert len(ts.window(9.5, now=99.0)) == 10
+        assert ts.window(0.5, now=99.0) == [99.0]
+
+    def test_rate_over_counts_events_per_second(self):
+        ts = TimeSeries("t")
+        for i in range(50):
+            ts.record(1.0, now=100.0 + i * 0.1)  # 10 events/s for 5s
+        assert ts.rate_over(5.0, now=104.9) == pytest.approx(10.0, rel=0.1)
+        assert ts.rate_over(5.0, now=200.0) == 0.0
+
+    def test_mean_over(self):
+        ts = TimeSeries("t")
+        ts.record(2.0, now=1.0)
+        ts.record(4.0, now=2.0)
+        assert ts.mean_over(10.0, now=2.0) == pytest.approx(3.0)
+        assert ts.mean_over(0.5, now=100.0) is None
+
+    def test_bad_fraction_over_both_directions(self):
+        ts = TimeSeries("t")
+        for i in range(10):
+            ts.record(float(i), now=float(i))
+        assert ts.bad_fraction_over(100.0, 6.5, "above", now=9.0) == pytest.approx(0.3)
+        assert ts.bad_fraction_over(100.0, 2.5, "below", now=9.0) == pytest.approx(0.3)
+        assert ts.bad_fraction_over(0.1, 0.0, "above", now=1000.0) is None
+
+
+class TestBoundedMemory:
+    def test_state_bytes_independent_of_stream_length(self):
+        ts = TimeSeries("t", fold_every=64)
+        b0 = ts.state_bytes()
+        for i in range(10_000):
+            ts.record(float(i % 17))
+        assert ts.state_bytes() == b0
+        # and the actual retained structures respect the bound
+        assert len(ts._points) <= ts._points.maxlen
+        assert len(ts._pending) <= 64
+
+    def test_ring_wraps_without_error(self):
+        ts = TimeSeries("t", points=16)
+        for i in range(100):
+            ts.record(float(i), now=float(i))
+        assert len(ts.window(1000.0, now=99.0)) == 16  # only the ring survives
+        assert ts.count == 100  # but the sketch/count saw everything
+
+
+class TestRegistryWiring:
+    def test_series_get_or_create(self):
+        t = Telemetry(enabled=False)
+        s1 = t.series("x.y")
+        s2 = t.series("x.y")
+        assert s1 is s2
+        assert t.get_series("x.y") is s1
+        assert t.get_series("missing") is None
+        assert t.series_names() == ["x.y"]
+
+    def test_snapshot_includes_series_summary(self):
+        t = Telemetry(enabled=False)
+        s = t.series("lat")
+        for i in range(10):
+            s.record(float(i))
+        snap = t.snapshot()
+        assert snap["series"]["lat"]["count"] == 10
+        assert "p99" in snap["series"]["lat"]
+        assert snap["series"]["lat"]["sum"] == pytest.approx(45.0)
+
+    def test_reset_clears_series_and_gauges(self):
+        t = Telemetry(enabled=False)
+        t.series("lat").record(1.0)
+        t.gauge("g").set(5.0)
+        t.reset()
+        assert t.get_series("lat") is None
+        assert t.snapshot()["gauges"] == {}
+
+    def test_summary_tabulates_series_rows(self):
+        from torchmetrics_tpu.obs.export import summary
+
+        t = Telemetry(enabled=False)
+        t.series("serve.queue_depth").record(3.0)
+        t.gauge("slo.demo.burn_rate").set(2.5)
+        text = summary(t)
+        assert "serve.queue_depth" in text
+        assert "series" in text
+        assert "slo.demo.burn_rate" in text

@@ -1,0 +1,176 @@
+"""Per-ticket trace propagation through the ingestion engine: stages, flows, gating."""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.serve import ServeOptions
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_ring():
+    trace.clear()
+    yield
+    trace.clear()
+
+
+def _names(events):
+    return [e["name"] for e in events]
+
+
+class TestDisabledPath:
+    def test_no_trace_ids_and_no_events_while_disabled(self):
+        minted0 = obs.telemetry.counter("trace.tickets").value  # process-global counter
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=8))
+        tickets = [m.update_async(np.float32(i)) for i in range(4)]
+        eng.quiesce()
+        assert all(t.trace_id is None for t in tickets)
+        assert trace.span_count() == 0
+        assert obs.telemetry.counter("trace.tickets").value == minted0
+
+    def test_mint_is_none_while_disabled(self):
+        assert trace.mint() is None
+
+    def test_series_still_record_while_disabled(self):
+        # the live series are ALWAYS-on — tracing off must not blind the SLO feed
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=8))
+        m.update_async(np.float32(1.0))
+        eng.quiesce()
+        assert obs.telemetry.get_series("serve.queue_depth").count >= 1
+        assert obs.telemetry.get_series("serve.commits").count >= 1
+        assert obs.telemetry.get_series("serve.commit_latency_us").count >= 1
+
+
+class TestTicketLifecycle:
+    def test_committed_ticket_emits_every_stage(self):
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=8, coalesce=1))
+            t = m.update_async(np.float32(2.0))
+            eng.quiesce()
+        assert t.trace_id is not None
+        evts = trace.events()
+        names = _names(evts)
+        for expected in ("serve.enqueue", "serve.stage.staged", "serve.stage.dispatched",
+                         "serve.apply", "serve.stage.committed"):
+            assert expected in names, (expected, names)
+        commit = next(e for e in evts if e["name"] == "serve.stage.committed")
+        assert commit["args"]["ticket"] == t.trace_id
+        assert commit["args"]["latency_us"] >= 0
+
+    def test_coalesced_tickets_note_their_width(self):
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=32, coalesce=4))
+            eng.pause()
+            tickets = [m.update_async(np.float32(i)) for i in range(4)]
+            eng.resume()
+            eng.quiesce()
+        widths = [e["args"]["width"] for e in trace.events()
+                  if e["name"] == "serve.stage.coalesced"]
+        assert widths and all(w >= 2 for w in widths)
+        assert all(t.trace_id is not None for t in tickets)
+
+    def test_flow_pairs_resolve_caller_to_drain(self):
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=16, coalesce=2))
+            for i in range(10):
+                m.update_async(np.float32(i))
+            eng.quiesce()
+        verdict = trace.validate_flows(trace.events())
+        assert verdict["valid"], verdict
+        assert verdict["flows"] == 10
+        assert verdict["committed_cross_thread"] == 10
+
+    def test_shed_ticket_has_no_flow(self):
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=2, on_full="shed", queue_timeout_s=2.0))
+            eng.pause()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                tickets = [m.update_async(np.float32(i)) for i in range(6)]
+            eng.resume()
+            eng.quiesce()
+        assert sum(1 for t in tickets if t.shed) == 4
+        names = _names(trace.events())
+        assert "serve.stage.shed" in names
+        verdict = trace.validate_flows(trace.events())
+        assert verdict["valid"], verdict
+        assert verdict["flows"] == 2  # only admitted tickets open flows
+
+    def test_abandoned_window_closes_flows(self):
+        with obs.enabled():
+            m = SumMetric()
+            eng = m.serve(ServeOptions(max_inflight=16))
+            m.update_async(np.float32(1.0))
+            eng.quiesce()
+            eng.pause()
+            for i in range(3):
+                m.update_async(np.float32(i))
+            eng.abandon()
+        evts = trace.events()
+        assert sum(1 for e in evts if e["name"] == "serve.stage.abandoned") == 3
+        verdict = trace.validate_flows(evts)
+        assert verdict["valid"], verdict
+
+    def test_failed_apply_closes_flow(self):
+        class Exploding(SumMetric):
+            def update(self, value):  # type: ignore[override]
+                raise RuntimeError("boom")
+
+        with obs.enabled():
+            m = Exploding()
+            eng = m.serve(ServeOptions(max_inflight=4))
+            t = m.update_async(np.float32(1.0))
+            t.wait(5.0)
+            with pytest.raises(Exception):
+                eng.quiesce()
+        evts = trace.events()
+        assert "serve.stage.failed" in _names(evts)
+        assert trace.validate_flows(evts)["valid"]
+
+
+class TestRingBounds:
+    def test_ring_is_bounded_and_counts_drops(self):
+        r = trace.TraceRing(maxlen=8)
+        for i in range(20):
+            r.push({"name": f"e{i}"})
+        assert len(r) == 8
+        assert r.dropped == 12
+        assert r.events()[0]["name"] == "e12"
+
+    def test_clear_resets(self):
+        r = trace.TraceRing(maxlen=4)
+        r.push({"name": "x"})
+        r.clear()
+        assert len(r) == 0 and r.dropped == 0
+
+
+class TestValidator:
+    def test_dangling_start_detected(self):
+        evts = [{"cat": "serve", "ph": "s", "id": 1, "tid": 1}]
+        v = trace.validate_flows(evts)
+        assert not v["valid"] and v["dangling_starts"] == [1]
+
+    def test_duplicate_start_detected(self):
+        evts = [{"cat": "serve", "ph": "s", "id": 1, "tid": 1},
+                {"cat": "serve", "ph": "s", "id": 1, "tid": 1}]
+        assert not trace.validate_flows(evts)["valid"]
+
+    def test_committed_flow_must_cross_threads(self):
+        evts = [
+            {"cat": "serve", "ph": "s", "id": 7, "tid": 1},
+            {"cat": "serve", "ph": "f", "id": 7, "tid": 1},
+            {"cat": "serve", "ph": "i", "name": "serve.stage.committed", "tid": 1,
+             "args": {"ticket": 7}},
+        ]
+        assert not trace.validate_flows(evts)["valid"]

@@ -177,6 +177,14 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "fix": "keep the drain non-blocking: dispatch and commit device futures; read"
                " values only after quiesce (compute()/snapshot() quiesce for you)",
     },
+    "TPU016": {
+        "severity": "warning",
+        "summary": "span begun without with/try-finally closure (leaks an open slice),"
+                   " or trace-ring/series mutation inside jit-traced code",
+        "example": "s = telemetry.span('x'); s.__enter__()",
+        "fix": "enter spans via `with` (or try/finally calling __exit__); emit trace"
+               " stage events and series records from the eager host path only",
+    },
 }
 
 #: rule id -> one-line description (derived view of :data:`RULE_META`; kept for the CLI,
@@ -2012,10 +2020,127 @@ def _rule_tpu015(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU016 helpers
+#: span-factory call names whose result is a context manager that MUST be closed
+_TPU016_SPAN_FACTORIES = {"span", "metric_span"}
+#: serve-trace / live-series mutation hooks that are host side effects per call
+#: (extends TPU009's registry-method set to the PR-12 trace/series API)
+_TPU016_TRACE_HOOKS = {
+    "mint", "enqueue_span", "shed_event", "coalesced_event", "dispatched_event",
+    "apply_span", "committed_event", "failed_event", "abandoned_event",
+    "fence_break_event", "note_thread", "push",
+}
+
+
+def _rule_tpu016(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Unclosed spans, and trace-ring/series mutation reachable from jit-traced code.
+
+    Prong 1 (any function): a call to a span factory (``telemetry.span(...)`` /
+    ``obs.metric_span(...)``) opens a timed scope whose ``__exit__`` records the event —
+    begun outside a ``with`` item and never closed, the slice silently never lands in
+    the trace (and its Timer never observes). Clean shapes: the call is a ``with``
+    item; the result is assigned and later entered via ``with``; the result is
+    assigned and ``.__exit__`` is called under ``try/finally``; or the call is
+    returned (ownership passes to the caller, the factory idiom).
+
+    Prong 2 (jit-traced functions only — TPU009's argument, new API): serve-trace
+    stage emitters (``trace.enqueue_span`` etc.), ring pushes, and live-series
+    ``.record(...)`` calls are host side effects; inside a traced body they run once
+    per COMPILATION, so the span/series silently stops recording on cached executions.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        # ---- prong 1: span lifecycle over every function ---------------------------
+        with_exprs: Set[int] = set()
+        entered_names: Set[str] = set()
+        exited_names: Set[str] = set()
+        returned: Set[int] = set()
+        assigns: List[Tuple[str, ast.Call]] = []
+        span_calls: List[ast.Call] = []
+
+        def _is_span_call(node: ast.AST) -> bool:
+            return (
+                isinstance(node, ast.Call)
+                and _final_name(node.func) in _TPU016_SPAN_FACTORIES
+            )
+
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _is_span_call(item.context_expr):
+                        with_exprs.add(id(item.context_expr))
+                    elif isinstance(item.context_expr, ast.Name):
+                        entered_names.add(item.context_expr.id)
+            elif isinstance(node, ast.Return) and _is_span_call(node.value):
+                returned.add(id(node.value))
+            elif isinstance(node, ast.Assign) and _is_span_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.append((t.id, node.value))
+            elif isinstance(node, ast.Try) and node.finalbody:
+                for fin in node.finalbody:
+                    for sub in ast.walk(fin):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "__exit__"
+                            and isinstance(sub.func.value, ast.Name)
+                        ):
+                            exited_names.add(sub.func.value.id)
+            if _is_span_call(node):
+                span_calls.append(node)  # type: ignore[arg-type]
+
+        closed_ids: Set[int] = set(with_exprs) | set(returned)
+        for name, call in assigns:
+            if name in entered_names or name in exited_names:
+                closed_ids.add(id(call))
+        for call in span_calls:
+            if id(call) in closed_ids:
+                continue
+            out.append(_finding(
+                "TPU016", path, call, lines,
+                f"span opened by {_final_name(call.func)}(...) in {info.qualname!r} is"
+                " never closed — not a `with` item, never entered, and no try/finally"
+                " __exit__: the slice (and its timer observation) silently never"
+                " records; wrap the scope in `with`, or close it in a finally block",
+            ))
+
+        # ---- prong 2: trace/series mutation inside jit-traced code -----------------
+        if not info.jit:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call) or model.is_trace_dead(info, node):
+                continue
+            hit: Optional[str] = None
+            dotted = _dotted(node.func)
+            if dotted is not None and dotted[-1] in _TPU016_TRACE_HOOKS and (
+                "trace" in dotted[:-1] or "ring" in dotted[:-1] or dotted[0] == "ring"
+            ):
+                hit = ".".join(dotted)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and isinstance(node.func.value, ast.Call)
+                and _final_name(node.func.value.func) == "series"
+            ):
+                hit = "series(...).record"
+            if hit is None:
+                continue
+            out.append(_finding(
+                "TPU016", path, node, lines,
+                f"serve-trace/series mutation {hit}(...) inside jit-traced"
+                f" {info.name!r} executes at TRACE time only (once per compilation,"
+                " not per step) — the span/series silently stops recording on cached"
+                " executions; emit from the eager host caller"
+                f"{_via_suffix(info.via)}",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
-    _rule_tpu013, _rule_tpu014, _rule_tpu015,
+    _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016,
 )
 
 

@@ -32,6 +32,7 @@ from torchmetrics_tpu.obs.telemetry import (
     ENV_FLAG,
     ENV_RETRACE_THRESHOLD,
     Counter,
+    Gauge,
     Histogram,
     Telemetry,
     Timer,
@@ -69,8 +70,22 @@ from torchmetrics_tpu.obs.profiler import (
     set_profiling,
     timing_summary,
 )
+from torchmetrics_tpu.obs import openmetrics, slo, timeseries, trace  # noqa: F401
+from torchmetrics_tpu.obs.openmetrics import serve_scrape
+from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_serve_specs
+from torchmetrics_tpu.obs.timeseries import TimeSeries
 
 __all__ = [
+    "Gauge",
+    "SloMonitor",
+    "SloSpec",
+    "TimeSeries",
+    "default_serve_specs",
+    "openmetrics",
+    "serve_scrape",
+    "slo",
+    "timeseries",
+    "trace",
     "ENV_FLAG",
     "ENV_PROFILE",
     "ENV_RETRACE_THRESHOLD",

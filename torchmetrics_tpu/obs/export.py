@@ -32,10 +32,17 @@ def export_trace(path: Any, registry: Optional[Telemetry] = None) -> str:
             "args": {"name": "torchmetrics_tpu"},
         }
     ]
+    events = meta + tel.events()
+    dropped = tel.dropped_events
+    if registry is None:  # the serve-trace ring is process-global, like the registry
+        from torchmetrics_tpu.obs import trace as _trace
+
+        events = events + _trace.events()
+        dropped += _trace.ring.dropped
     payload = {
-        "traceEvents": meta + tel.events(),
+        "traceEvents": events,
         "displayTimeUnit": "ms",
-        "otherData": {"dropped_events": tel.dropped_events},
+        "otherData": {"dropped_events": dropped},
     }
     path = os.fspath(path)
     with open(path, "w") as fh:
@@ -106,6 +113,26 @@ _ALWAYS_TABULATED = (
     "sketch.merges",
     "sketch.compactions",
     "sketch.state_bytes_saved",
+    # serving tier (docs/serving.md): the async ingestion window's full audit trail —
+    # a summary with zero serve rows must still SAY the serving tier saw no traffic
+    # (the same invisibility fix robust.*/dispatch.* got)
+    "serve.engines",
+    "serve.enqueued",
+    "serve.committed",
+    "serve.shed",
+    "serve.backpressure_stalls",
+    "serve.drain_restarts",
+    "serve.coalesced_launches",
+    "serve.apply_failures",
+    "serve.fence_breaks",
+    "serve.queue_timeouts",
+    "serve.staging_fallbacks",
+    # serving observability (docs/observability.md "Serving traces, live series &
+    # SLOs"): per-ticket trace volume and the SLO alarm substrate
+    "trace.tickets",
+    "trace.spans",
+    "slo.evaluations",
+    "slo.alarms",
 )
 
 
@@ -133,6 +160,18 @@ def summary(registry: Optional[Telemetry] = None) -> str:
         else:
             detail = "(empty)"
         rows.append((name, "histogram", str(h.get("count", 0)), detail))
+    for name in sorted(snap.get("gauges", ())):
+        rows.append((name, "gauge", "", f"{snap['gauges'][name]:g}"))
+    for name in sorted(snap.get("series", ())):
+        s = snap["series"][name]
+        if s.get("count"):
+            detail = (
+                f"last={s.get('last', 0):g} p50={s.get('p50', 0):.1f}"
+                f" p99={s.get('p99', 0):.1f}"
+            )
+        else:
+            detail = "(empty)"
+        rows.append((name, "series", str(s.get("count", 0)), detail))
     widths = [max(len(r[i]) for r in rows) for i in range(4)]
     lines = ["  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row)).rstrip() for row in rows]
     lines.insert(1, "  ".join("-" * w for w in widths))
@@ -240,6 +279,13 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "serve_backpressure_stalls": counters.get("serve.backpressure_stalls", 0),
         "serve_drain_restarts": counters.get("serve.drain_restarts", 0),
         "serve_staging_fallbacks": counters.get("serve.staging_fallbacks", 0),
+        # serving observability (docs/observability.md "Serving traces, live series &
+        # SLOs"): per-ticket trace volume, SLO alarm evidence, and the size of the
+        # OpenMetrics exposition this registry renders to — a bench records whether its
+        # run was observable, not just fast
+        "serve_trace_tickets": counters.get("trace.tickets", 0),
+        "slo_evaluations": counters.get("slo.evaluations", 0),
+        "slo_alarms": counters.get("slo.alarms", 0),
         # sketch states (docs/sketches.md): a bench that folded streams into O(1)
         # sketches records the merge/compaction volume and the cat bytes it did not keep
         "sketch_merges": counters.get("sketch.merges", 0),
@@ -265,6 +311,26 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         s = qd.summary()
         out["serve_queue_depth_p50"] = s["p50"]
         out["serve_queue_depth_p99"] = s["p99"]
+    # serve-trace ring + KLL-backed live series + exposition size, best-effort: the
+    # extras block must stay assemblable even mid-refactor of the obs modules
+    try:
+        from torchmetrics_tpu.obs import trace as _trace
+
+        out["serve_trace_spans"] = _trace.span_count()
+        out["serve_trace_dropped"] = _trace.ring.dropped
+    except Exception:  # pragma: no cover - defensive
+        out["serve_trace_spans"] = None
+    lat = tel.get_series("serve.commit_latency_us")
+    if lat is not None and lat.count:
+        p50, p99 = lat.quantiles((0.5, 0.99))
+        out["serve_commit_latency_us_p50"] = round(p50, 1)
+        out["serve_commit_latency_us_p99"] = round(p99, 1)
+    try:
+        from torchmetrics_tpu.obs import openmetrics as _openmetrics
+
+        out["openmetrics_bytes"] = len(_openmetrics.render(registry).encode("utf-8"))
+    except Exception:  # pragma: no cover - defensive
+        out["openmetrics_bytes"] = None
     ho = snap["timers"].get("dispatch.host_overhead")
     if ho and ho["count"]:  # recorded only while tracing was enabled
         out["per_step_host_overhead_us"] = round(ho["mean_s"] * 1e6, 2)

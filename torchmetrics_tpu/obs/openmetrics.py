@@ -1,0 +1,389 @@
+"""OpenMetrics/Prometheus text exposition for the whole telemetry registry.
+
+:func:`render` turns every registered instrument — counters, timers, histograms,
+gauges, and the live :mod:`~torchmetrics_tpu.obs.timeseries` series — into spec-valid
+OpenMetrics text (``# TYPE`` metadata, ``_total``/``_count``/``_sum``/``quantile``
+sample naming, terminal ``# EOF``) with a ``rank`` label on every sample, writable to a
+file (:func:`write`) or served from an opt-in localhost scrape endpoint
+(:func:`serve_scrape` — never bound by default; observability must be asked for, not
+listening). :func:`parse` is the strict line parser the round-trip tests and the
+``make obs-smoke`` gate drive — it rejects undeclared families, suffix/type mismatches,
+malformed labels, duplicated metadata, and a missing ``# EOF``.
+
+The rank-zero **merged view** (``render(merged=True)``) rides the same gather seam the
+sync layer uses (injectable ``gather_fn`` for tests, byte-payload
+``gather_all_arrays`` at world > 1): each rank contributes its snapshot, family
+metadata is emitted once, and per-rank samples sit side by side under their rank
+labels. Cross-rank straggler evidence from :func:`torchmetrics_tpu.parallel.sync.
+skew_report` folds in as per-rank gauges (``tm_sync_gather_mean_us{rank="r"}``,
+``tm_sync_straggler_index``).
+
+    >>> from torchmetrics_tpu.obs.telemetry import Telemetry
+    >>> t = Telemetry(enabled=False)
+    >>> t.counter("demo.hits").inc(3)
+    >>> text = render(registry=t)
+    >>> '# TYPE tm_demo_hits counter' in text and 'tm_demo_hits_total{rank="0"} 3' in text
+    True
+    >>> parse(text)["families"]["tm_demo_hits"]["type"]
+    'counter'
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
+
+__all__ = ["render", "write", "parse", "serve_scrape", "ScrapeServer", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # sample name
+    r"(\{[^{}]*\})?"                          # optional labelset
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|Inf)|NaN|\+Inf)$"  # value
+)
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+_TYPES = ("counter", "gauge", "summary", "histogram", "unknown", "info", "stateset")
+#: sample-name suffixes each family type may expose (per the OpenMetrics spec)
+_TYPE_SUFFIXES = {
+    # the bare name resolves to the family so the suffix check below can reject it
+    # with the specific "counters must use _total" message
+    "counter": ("_total", "_created", ""),
+    "gauge": ("",),
+    "summary": ("", "_count", "_sum", "_created"),
+    "histogram": ("_bucket", "_count", "_sum", "_created"),
+    "unknown": ("",),
+}
+
+
+def metric_name(name: str) -> str:
+    """Registry name → OpenMetrics family name (``serve.shed`` → ``tm_serve_shed``)."""
+    return "tm_" + _NAME_SANITIZE.sub("_", name)
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    f = float(value)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+# ----------------------------------------------------------------------- rendering
+class _Writer:
+    """Accumulates families (metadata once) + per-rank samples in a stable order."""
+
+    def __init__(self) -> None:
+        self.declared: Dict[str, str] = {}
+        self.samples: Dict[str, List[str]] = {}
+
+    def family(self, name: str, typ: str) -> bool:
+        """Declare a family; False (skipped) when the sanitized name already exists
+        with a different type — dotted registry names may collide after sanitizing."""
+        prev = self.declared.get(name)
+        if prev is not None:
+            return prev == typ
+        self.declared[name] = typ
+        self.samples[name] = []
+        return True
+
+    def sample(self, family: str, suffix: str, labels: Dict[str, Any], value: float) -> None:
+        labelstr = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        self.samples[family].append(f"{family}{suffix}{{{labelstr}}} {_fmt(value)}")
+
+    def text(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self.declared):
+            lines.append(f"# TYPE {name} {self.declared[name]}")
+            lines.extend(self.samples[name])
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _emit_snapshot(w: _Writer, snap: Dict[str, Any], rank: int) -> None:
+    lbl = {"rank": rank}
+    for name in sorted(snap.get("counters", ())):
+        fam = metric_name(name)
+        if w.family(fam, "counter"):
+            w.sample(fam, "_total", lbl, snap["counters"][name])
+    for name in sorted(snap.get("gauges", ())):
+        fam = metric_name(name)
+        if w.family(fam, "gauge"):
+            w.sample(fam, "", lbl, snap["gauges"][name])
+    for name in sorted(snap.get("timers", ())):
+        t = snap["timers"][name]
+        fam = metric_name(name) + "_seconds"
+        if w.family(fam, "summary"):
+            w.sample(fam, "_count", lbl, t["count"])
+            w.sample(fam, "_sum", lbl, t["total_s"])
+    for name in sorted(snap.get("histograms", ())):
+        h = snap["histograms"][name]
+        fam = metric_name(name)
+        if w.family(fam, "summary"):
+            w.sample(fam, "_count", lbl, h.get("count", 0))
+            for q in ("p50", "p90", "p99"):
+                if q in h:
+                    w.sample(fam, "", {**lbl, "quantile": f"0.{q[1:]}"}, h[q])
+    for name in sorted(snap.get("series", ())):
+        s = snap["series"][name]
+        fam = metric_name(name)
+        if w.family(fam, "summary"):
+            w.sample(fam, "_count", lbl, s.get("count", 0))
+            if "sum" in s:
+                w.sample(fam, "_sum", lbl, s["sum"])
+            for q in ("p50", "p90", "p99"):
+                if q in s:
+                    w.sample(fam, "", {**lbl, "quantile": f"0.{q[1:]}"}, s[q])
+        last = s.get("last")
+        if last is not None:
+            fam_last = fam + "_last"
+            if w.family(fam_last, "gauge"):
+                w.sample(fam_last, "", lbl, last)
+
+
+def _emit_skew(w: _Writer) -> None:
+    """Per-rank straggler gauges from the last cross-rank skew report, if any ran."""
+    try:
+        from torchmetrics_tpu.parallel import sync as _sync
+
+        skew = _sync.last_skew_report()
+    except Exception:  # pragma: no cover - exposition must render regardless
+        skew = None
+    if not skew:
+        return
+    if w.family("tm_sync_gather_mean_us", "gauge"):
+        for r, mean_us in enumerate(skew.get("per_rank_mean_us", ())):
+            w.sample("tm_sync_gather_mean_us", "", {"rank": r}, mean_us)
+    if w.family("tm_sync_straggler_index", "gauge"):
+        w.sample("tm_sync_straggler_index", "", {"rank": _rank()}, skew["straggler_index"])
+    if w.family("tm_sync_straggler_rank", "gauge"):
+        w.sample("tm_sync_straggler_rank", "", {"rank": _rank()}, skew["straggler_rank"])
+
+
+def _gather_snapshots(
+    snap: Dict[str, Any], gather_fn: Optional[Callable] = None
+) -> List[Tuple[int, Dict[str, Any]]]:
+    """(rank, snapshot) per responding process, through the sync gather seam.
+
+    ``gather_fn`` (tests) maps the local JSON payload to the gathered payload list; at
+    world > 1 the payload rides :func:`~torchmetrics_tpu.parallel.sync.
+    gather_all_arrays` as a uint8 buffer (its uneven-dim0 pad+trim handles the
+    per-rank length differences); at world 1 the local snapshot is the view.
+    """
+    payload = json.dumps({"rank": _rank(), "snapshot": snap})
+    if gather_fn is not None:
+        gathered = [json.loads(p) for p in gather_fn(payload)]
+    else:
+        try:
+            import jax
+
+            world = jax.process_count()
+        except Exception:
+            world = 1
+        if world <= 1:
+            return [(_rank(), snap)]
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torchmetrics_tpu.parallel.sync import gather_all_arrays
+
+        buf = jnp.asarray(np.frombuffer(payload.encode("utf-8"), np.uint8))
+        gathered = [
+            json.loads(bytes(np.asarray(g)).decode("utf-8"))
+            for g in gather_all_arrays(buf)
+        ]
+    return [(int(p["rank"]), p["snapshot"]) for p in gathered]
+
+
+def render(
+    registry: Optional[Telemetry] = None,
+    merged: bool = False,
+    gather_fn: Optional[Callable] = None,
+) -> str:
+    """The registry as OpenMetrics text; ``merged=True`` gathers every rank's view."""
+    tel = registry if registry is not None else telemetry
+    snap = tel.snapshot()
+    w = _Writer()
+    if merged:
+        for rank, rsnap in sorted(_gather_snapshots(snap, gather_fn)):
+            _emit_snapshot(w, rsnap, rank)
+    else:
+        _emit_snapshot(w, snap, _rank())
+    _emit_skew(w)
+    return w.text()
+
+
+def write(path: Any, registry: Optional[Telemetry] = None, merged: bool = False,
+          gather_fn: Optional[Callable] = None) -> str:
+    """Render to ``path`` (the node-local scrape-file protocol); returns the path."""
+    path = os.fspath(path)
+    with open(path, "w") as fh:
+        fh.write(render(registry, merged=merged, gather_fn=gather_fn))
+    return path
+
+
+# ------------------------------------------------------------------- strict parser
+def _parse_labels(raw: Optional[str], line_no: int) -> Dict[str, str]:
+    if not raw:
+        return {}
+    out: Dict[str, str] = {}
+    body = raw[1:-1]
+    if not body:
+        return out
+    for part in body.split(","):
+        m = _LABEL_RE.match(part)
+        if m is None:
+            raise ValueError(f"line {line_no}: malformed label {part!r}")
+        if m.group(1) in out:
+            raise ValueError(f"line {line_no}: duplicate label {m.group(1)!r}")
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+def _family_of(sample_name: str, declared: Dict[str, str]) -> Optional[Tuple[str, str]]:
+    """(family, suffix) for a sample name against the declared families, or None."""
+    candidates = []
+    for fam, typ in declared.items():
+        for suffix in _TYPE_SUFFIXES.get(typ, ("",)):
+            if sample_name == fam + suffix:
+                candidates.append((fam, suffix))
+    if not candidates:
+        return None
+    # longest family wins (tm_x vs tm_x_last both declared)
+    return max(candidates, key=lambda c: len(c[0]))
+
+
+def parse(text: str) -> Dict[str, Any]:
+    """Strictly parse OpenMetrics exposition text; raises ``ValueError`` on violations.
+
+    Enforces: every sample belongs to a ``# TYPE``-declared family with a suffix its
+    type allows (counters expose ``_total``, summaries ``_count``/``_sum``/quantile
+    samples, gauges bare names), labels are well-formed and unduplicated, quantile
+    labels parse as probabilities, no family is declared twice, and the last line is
+    ``# EOF`` with nothing after it.
+    """
+    declared: Dict[str, str] = {}
+    families: Dict[str, Dict[str, Any]] = {}
+    n_samples = 0
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    if "# EOF" not in lines:
+        raise ValueError("exposition must end with '# EOF'")
+    if lines[-1] != "# EOF":
+        raise ValueError("content after # EOF")
+    for i, line in enumerate(lines, 1):
+        if line == "# EOF":
+            if i != len(lines):
+                raise ValueError(f"line {i}: content after # EOF")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                raise ValueError(f"line {i}: malformed TYPE line {line!r}")
+            _, _, fam, typ = parts
+            if typ not in _TYPES:
+                raise ValueError(f"line {i}: unknown family type {typ!r}")
+            if fam in declared:
+                raise ValueError(f"line {i}: family {fam!r} declared twice")
+            declared[fam] = typ
+            families[fam] = {"type": typ, "samples": []}
+            continue
+        if line.startswith("# HELP ") or line.startswith("# UNIT "):
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {i}: unknown comment form {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: malformed sample line {line!r}")
+        name, rawlabels, rawvalue = m.groups()
+        hit = _family_of(name, declared)
+        if hit is None:
+            raise ValueError(f"line {i}: sample {name!r} has no declared family")
+        fam, suffix = hit
+        labels = _parse_labels(rawlabels, i)
+        if declared[fam] == "counter" and suffix != "_total":
+            raise ValueError(f"line {i}: counter sample {name!r} must use _total")
+        if "quantile" in labels:
+            if declared[fam] != "summary" or suffix != "":
+                raise ValueError(f"line {i}: quantile label on non-summary sample {name!r}")
+            q = float(labels["quantile"])
+            if not (0.0 <= q <= 1.0):
+                raise ValueError(f"line {i}: quantile {q} outside [0, 1]")
+        value = float(rawvalue.replace("Inf", "inf"))
+        families[fam]["samples"].append({"name": name, "labels": labels, "value": value})
+        n_samples += 1
+    return {"families": families, "samples": n_samples}
+
+
+# ------------------------------------------------------------------ scrape endpoint
+class ScrapeServer:
+    """Opt-in localhost ``/metrics`` endpoint (daemon thread; ``close()`` to stop)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[Telemetry] = None, merged: bool = False) -> None:
+        import http.server
+
+        reg, mrg = registry, merged
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render(reg, merged=mrg).encode("utf-8")
+                except Exception as err:  # noqa: BLE001 - a scrape must not kill the server
+                    self.send_error(500, explain=repr(err))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:  # silence per-scrape stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="tm-tpu-openmetrics"
+        )
+        self._thread.start()
+        telemetry.counter("obs.scrape_servers").inc()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "ScrapeServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.close()
+        return False
+
+
+def serve_scrape(port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[Telemetry] = None, merged: bool = False) -> ScrapeServer:
+    """Start the opt-in localhost scrape endpoint; returns the running server."""
+    return ScrapeServer(host=host, port=port, registry=registry, merged=merged)

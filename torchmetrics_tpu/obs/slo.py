@@ -1,0 +1,250 @@
+"""Declarative SLO specs with multi-window burn-rate evaluation over registry series.
+
+An :class:`SloSpec` names one live series (:meth:`Telemetry.series`), what makes a
+sample *bad*, and the multi-window burn-rate policy; an :class:`SloMonitor` evaluates a
+set of specs on demand. The math is the standard SRE recipe: with error budget
+``1 - objective``, the **burn rate** over a window is ``error_rate / budget`` — burn 1
+consumes the budget exactly at the objective's pace; an alarm needs the burn threshold
+exceeded in EVERY configured window (long window = sustained, short window = still
+happening), which keeps alarms both fast and spike-proof.
+
+Spec grammar (docs/observability.md "SLO specs"):
+
+- ``series`` — the registry series the objective reads (e.g.
+  ``serve.commit_latency_us``); **sample mode** judges each recorded value against
+  ``threshold``/``bad_when``.
+- ``ratio_of`` — switches to **event-ratio mode**: ``series`` counts bad events,
+  ``ratio_of`` counts all events, error rate = bad-rate / total-rate per window (shed
+  ratio: ``series="serve.sheds", ratio_of="serve.queue_depth"`` — the depth series
+  has exactly one point per offered batch).
+- ``windows`` — ``(window_seconds, burn_threshold)`` pairs, every one of which must
+  burn hot for the alarm to fire.
+
+Firing is observable three ways: a one-shot ``rank_zero_warn`` per alarm transition,
+``slo.alarms`` / ``slo.alarms.<name>`` counters, and a ``slo.<name>.burn_rate`` gauge
+(the OpenMetrics exposition picks all three up). :meth:`SloMonitor.signals` exposes the
+queue-depth / commit-rate / latency pressure numbers the adaptive coalesce/linger work
+(ROADMAP item 5) will consume, and the alarm substrate is what item 2's drift detection
+plugs into.
+
+    >>> from torchmetrics_tpu.obs.telemetry import Telemetry
+    >>> t = Telemetry(enabled=False)
+    >>> s = t.series("demo.latency_us")
+    >>> for i in range(100):
+    ...     s.record(10_000.0 if i % 2 else 10.0, now=100.0 + i / 100.0)
+    >>> spec = SloSpec(name="enqueue-p99", series="demo.latency_us", objective=0.99,
+    ...                threshold=5_000.0, windows=((1.0, 1.0), (10.0, 1.0)))
+    >>> status = SloMonitor([spec], registry=t).evaluate(now=101.0)[0]
+    >>> status.burning, status.worst_burn >= 1.0
+    (True, True)
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["SloSpec", "SloStatus", "SloMonitor", "default_serve_specs"]
+
+#: default multi-window policy: sustained over 5 minutes AND still burning over the
+#: last 30 seconds, both at >= 2x budget pace
+DEFAULT_WINDOWS: Tuple[Tuple[float, float], ...] = ((30.0, 2.0), (300.0, 2.0))
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One service-level objective over a registry series (see module docstring)."""
+
+    name: str
+    series: str
+    objective: float = 0.999
+    threshold: float = 0.0
+    bad_when: str = "above"             # "above" | "below" (sample mode only)
+    ratio_of: Optional[str] = None      # event-ratio mode: total-events series
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS
+    description: str = ""
+
+    def __post_init__(self) -> None:
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(f"SloSpec(objective) needs (0, 1), got {self.objective}")
+        if self.bad_when not in ("above", "below"):
+            raise ValueError(f"SloSpec(bad_when) must be 'above'|'below', got {self.bad_when!r}")
+        if not self.windows:
+            raise ValueError("SloSpec(windows) needs at least one (window_s, burn) pair")
+        for w, b in self.windows:
+            if w <= 0 or b <= 0:
+                raise ValueError(f"SloSpec window ({w}, {b}) needs positive entries")
+
+    @property
+    def budget(self) -> float:
+        """Error budget: the bad fraction the objective tolerates."""
+        return 1.0 - self.objective
+
+
+@dataclass
+class SloStatus:
+    """One evaluation result: per-window error/burn rates + the alarm verdict."""
+
+    spec: SloSpec
+    burning: bool
+    worst_burn: float
+    burn_rates: Dict[float, Optional[float]] = field(default_factory=dict)
+    error_rates: Dict[float, Optional[float]] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.spec.name,
+            "series": self.spec.series,
+            "burning": self.burning,
+            "worst_burn": round(self.worst_burn, 3),
+            "burn_rates": {str(w): (None if b is None else round(b, 3))
+                           for w, b in self.burn_rates.items()},
+            "error_rates": {str(w): (None if e is None else round(e, 4))
+                            for w, e in self.error_rates.items()},
+        }
+
+
+class SloMonitor:
+    """Evaluates a set of :class:`SloSpec` against the (global) telemetry registry."""
+
+    def __init__(self, specs: Sequence[SloSpec] = (),
+                 registry: Optional[Telemetry] = None) -> None:
+        self.specs: List[SloSpec] = list(specs)
+        self._tel = registry if registry is not None else telemetry
+        self._burning: Dict[str, bool] = {}
+
+    def watch(self, spec: SloSpec) -> "SloMonitor":
+        self.specs.append(spec)
+        return self
+
+    # ------------------------------------------------------------------ evaluation
+    def _error_rate(self, spec: SloSpec, window_s: float,
+                    now: Optional[float]) -> Optional[float]:
+        series = self._tel.get_series(spec.series)
+        if series is None:
+            return None
+        if spec.ratio_of is not None:
+            total = self._tel.get_series(spec.ratio_of)
+            if total is None:
+                return None
+            total_rate = total.rate_over(window_s, now=now)
+            if total_rate <= 0:
+                return None  # no traffic in window: no evidence either way
+            return min(1.0, series.rate_over(window_s, now=now) / total_rate)
+        return series.bad_fraction_over(window_s, spec.threshold, spec.bad_when, now=now)
+
+    def evaluate(self, now: Optional[float] = None) -> List[SloStatus]:
+        """Evaluate every spec; fires alarms (warn + counters + gauges) on transition.
+
+        ``now`` pins the evaluation clock (monotonic-domain) for tests/synthetic
+        series; production callers leave it None. A window with no samples contributes
+        ``None`` and cannot satisfy the alarm condition — silence is not burn.
+        """
+        self._tel.counter("slo.evaluations").inc()
+        out: List[SloStatus] = []
+        eval_now = time.monotonic() if now is None else now
+        for spec in self.specs:
+            burns: Dict[float, Optional[float]] = {}
+            errs: Dict[float, Optional[float]] = {}
+            alarm = True
+            worst = 0.0
+            for window_s, burn_threshold in spec.windows:
+                err = self._error_rate(spec, window_s, eval_now)
+                errs[window_s] = err
+                burn = None if err is None else err / spec.budget
+                burns[window_s] = burn
+                if burn is None or burn < burn_threshold:
+                    alarm = False
+                if burn is not None:
+                    worst = max(worst, burn)
+            self._tel.gauge(f"slo.{spec.name}.burn_rate").set(worst)
+            was = self._burning.get(spec.name, False)
+            if alarm:
+                self._tel.counter("slo.alarms").inc()
+                self._tel.counter(f"slo.alarms.{spec.name}").inc()
+                if not was:
+                    rank_zero_warn(
+                        f"SLO '{spec.name}' burning: series {spec.series!r} error budget"
+                        f" ({spec.budget:.4g}) is being consumed at {worst:.1f}x the"
+                        f" objective pace across all configured windows"
+                        f" ({', '.join(f'{w:g}s' for w, _ in spec.windows)})."
+                        + (f" {spec.description}" if spec.description else ""),
+                        UserWarning,
+                    )
+            self._burning[spec.name] = alarm
+            if self._tel.enabled:
+                self._tel.event(
+                    f"slo.{spec.name}", ph="i", cat="slo",
+                    args={"burning": alarm, "worst_burn": round(worst, 3)},
+                )
+            out.append(SloStatus(spec=spec, burning=alarm, worst_burn=worst,
+                                 burn_rates=burns, error_rates=errs))
+        return out
+
+    def burning(self) -> List[str]:
+        """Names of specs whose last evaluation fired."""
+        return sorted(n for n, b in self._burning.items() if b)
+
+    # ------------------------------------------------------------ adaptive-serve feed
+    def signals(self, window_s: float = 30.0, now: Optional[float] = None) -> Dict[str, Any]:
+        """The live queue-pressure numbers adaptive coalesce/linger will consume.
+
+        Reads the ``serve.*`` series the ingestion engine records always-on: queue
+        depth (last + p50/p99), in-flight occupancy, commit/enqueue/shed rates over
+        ``window_s``, and the enqueue→commit latency quantiles. Missing series (no
+        serving traffic yet) simply yield None entries.
+        """
+        out: Dict[str, Any] = {"window_s": window_s}
+        depth = self._tel.get_series("serve.queue_depth")
+        if depth is not None and depth.count:
+            p50, p99 = depth.quantiles((0.5, 0.99))
+            out.update({"queue_depth_last": depth.last, "queue_depth_p50": p50,
+                        "queue_depth_p99": p99})
+        inflight = self._tel.get_series("serve.inflight")
+        if inflight is not None:
+            out["inflight_last"] = inflight.last
+        for key, series in (("commit_rate", "serve.commits"),
+                            # queue_depth has one point per offered batch, so its
+                            # event rate IS the enqueue rate (engine._admit)
+                            ("enqueue_rate", "serve.queue_depth"),
+                            ("shed_rate", "serve.sheds")):
+            s = self._tel.get_series(series)
+            out[key] = None if s is None else round(s.rate_over(window_s, now=now), 3)
+        lat = self._tel.get_series("serve.commit_latency_us")
+        if lat is not None and lat.count:
+            p50, p99 = lat.quantiles((0.5, 0.99))
+            out.update({"commit_latency_us_p50": p50, "commit_latency_us_p99": p99})
+        return out
+
+
+def default_serve_specs(
+    latency_objective: float = 0.99,
+    latency_threshold_us: float = 50_000.0,
+    shed_objective: float = 0.999,
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS,
+) -> List[SloSpec]:
+    """The serving tier's stock SLOs: enqueue→commit latency and shed ratio.
+
+    ``commit-latency``: at least ``latency_objective`` of committed batches finish
+    within ``latency_threshold_us`` of enqueue. ``shed-ratio``: sheds stay within the
+    ``1 - shed_objective`` budget of offered batches. Both ride the always-on series
+    the engine records, so watching them costs nothing extra.
+    """
+    return [
+        SloSpec(
+            name="commit-latency", series="serve.commit_latency_us",
+            objective=latency_objective, threshold=latency_threshold_us,
+            bad_when="above", windows=windows,
+            description="enqueue->commit latency budget (docs/serving.md)",
+        ),
+        SloSpec(
+            # serve.queue_depth records one point per OFFERED batch (admitted or
+            # shed), so it is the exact denominator for the shed ratio
+            name="shed-ratio", series="serve.sheds", ratio_of="serve.queue_depth",
+            objective=shed_objective, windows=windows,
+            description="shed batches vs offered batches (on_full='shed' pressure)",
+        ),
+    ]

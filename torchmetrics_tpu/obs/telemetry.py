@@ -103,6 +103,25 @@ class Timer:
         return self._total_s / self._count if self._count else 0.0
 
 
+class Gauge:
+    """Last-written instantaneous value (queue depth, burn rate). Thread-safe."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
 class Histogram:
     """Bounded reservoir of raw observations with nearest-rank percentiles.
 
@@ -226,6 +245,8 @@ class Telemetry:
         self._counters: Dict[str, Counter] = {}
         self._timers: Dict[str, Timer] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._series: Dict[str, Any] = {}  # name -> obs.timeseries.TimeSeries
         self._events: deque = deque(maxlen=max_events or _env_int(ENV_MAX_EVENTS, 200_000))
         self._dropped_events = 0
         self._epoch = time.perf_counter()
@@ -256,6 +277,30 @@ class Telemetry:
 
     def get_histogram(self, name: str) -> Optional[Histogram]:
         return self._histograms.get(name)
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def series(self, name: str, **kwargs: Any) -> Any:
+        """Get-or-create the named live :class:`~torchmetrics_tpu.obs.timeseries.
+        TimeSeries` (always-on, O(1) memory; ``kwargs`` shape it on first creation)."""
+        s = self._series.get(name)
+        if s is None:
+            from torchmetrics_tpu.obs.timeseries import TimeSeries
+
+            with self._lock:
+                s = self._series.setdefault(name, TimeSeries(name, **kwargs))
+        return s
+
+    def get_series(self, name: str) -> Optional[Any]:
+        return self._series.get(name)
+
+    def series_names(self) -> List[str]:
+        return sorted(self._series)
 
     # -- event log ----------------------------------------------------------------------
     def now_us(self) -> float:
@@ -321,12 +366,19 @@ class Telemetry:
                 for n, t in self._timers.items()
             }
             hists = {n: h.summary() for n, h in self._histograms.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()}
+            series_objs = dict(self._series)
             n_events = len(self._events)
+        # series summaries outside the registry lock: a quantile read may fold pending
+        # samples through jnp, and must not hold up concurrent instrument creation
+        series = {n: s.summary() for n, s in series_objs.items()}
         return {
             "enabled": self.enabled,
             "counters": counters,
             "timers": timers,
             "histograms": hists,
+            "gauges": gauges,
+            "series": series,
             "events_recorded": n_events,
             "events_dropped": self._dropped_events,
         }
@@ -336,6 +388,8 @@ class Telemetry:
             self._counters.clear()
             self._timers.clear()
             self._histograms.clear()
+            self._gauges.clear()
+            self._series.clear()
             if clear_events:
                 self._events.clear()
                 self._dropped_events = 0

@@ -413,6 +413,10 @@ def _record_gather_latency(dur_s: float) -> None:
     us = dur_s * 1e6
     _GATHER_LATENCIES_US.append(us)
     obs.telemetry.histogram("sync.gather.latency_us").record(us)
+    # always-on live series (docs/observability.md "Live time series"): windowed
+    # gather rate + all-time KLL quantiles, addressable by SLO specs (e.g. a gather
+    # p99 objective) and rendered by the OpenMetrics exposition
+    obs.telemetry.series("sync.gather_latency_us").record(us)
 
 
 def local_gather_stats() -> Optional[Dict[str, Any]]:

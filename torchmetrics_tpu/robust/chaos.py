@@ -964,8 +964,15 @@ def scenario_serve_preempt_mid_overlap(
     never saw the window batches, only the write-ahead journal did. A fresh instance
     recovers ``snapshot + replay(journal)``, finishes the stream synchronously, and must
     be bit-identical with an uninterrupted synchronous run.
+
+    The plain variant additionally runs with per-ticket tracing enabled and asserts the
+    exported trace stays WELL-FORMED through the preemption: every committed ticket's
+    enqueue flow resolves onto the drain-thread track, and the window batches the
+    preemption dropped close their flows as ``serve.stage.abandoned`` — no dangling
+    flow ids even when the engine dies mid-overlap (ISSUE 12 acceptance).
     """
     del via  # the async protocol is update-shaped; tickets have no per-batch value
+    from torchmetrics_tpu.obs import trace as _obs_trace
     from torchmetrics_tpu.robust import journal as _journal
     from torchmetrics_tpu.serve import ServeOptions
 
@@ -976,17 +983,44 @@ def scenario_serve_preempt_mid_overlap(
     passed = True
     for name, make, batches in variants:
         jdir = f"{workdir}/serve-preempt-{name}"
-        m = make()
-        eng = m.serve(ServeOptions(max_inflight=64), journal=_journal.Journal(jdir))
-        split = max(1, (preempt + 1) // 2)
-        for i in range(split):
-            m.update_async(*batches[i])
-        eng.quiesce()  # the prefix is committed state
-        eng.pause()  # hold the drain: the rest of the prefix stays IN the window
-        for i in range(split, preempt + 1):
-            m.update_async(*batches[i])
-        inj = PreemptMidOverlap()
-        dropped = inj.strike(m)  # the process dies here; the WAL is the only survivor
+        traced = name == "plain"
+        if traced:
+            _obs_trace.clear()
+        prev_enabled = obs.telemetry.enabled
+        obs.telemetry.enabled = prev_enabled or traced
+        try:
+            m = make()
+            eng = m.serve(ServeOptions(max_inflight=64), journal=_journal.Journal(jdir))
+            split = max(1, (preempt + 1) // 2)
+            for i in range(split):
+                m.update_async(*batches[i])
+            eng.quiesce()  # the prefix is committed state
+            eng.pause()  # hold the drain: the rest of the prefix stays IN the window
+            for i in range(split, preempt + 1):
+                m.update_async(*batches[i])
+            inj = PreemptMidOverlap()
+            dropped = inj.strike(m)  # the process dies here; the WAL is the only survivor
+        finally:
+            obs.telemetry.enabled = prev_enabled
+        if traced:
+            trace_events = _obs_trace.events()
+            verdict = _obs_trace.validate_flows(trace_events)
+            abandoned = sum(
+                1 for e in trace_events if e.get("name") == "serve.stage.abandoned"
+            )
+            trace_ok = bool(
+                verdict["valid"]
+                and verdict["committed_flows"] >= 1
+                and abandoned == dropped
+            )
+            detail["trace"] = {
+                "well_formed": trace_ok,
+                "flows": verdict["flows"],
+                "committed_cross_thread": verdict["committed_cross_thread"],
+                "abandoned_closed": abandoned,
+            }
+            passed = passed and trace_ok
+            _obs_trace.clear()
         fresh = make()
         recovery = _journal.recover(fresh, jdir)
         obs.telemetry.counter("robust.recovered").inc()

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Any, Deque, Dict, Optional, Tuple
 
 from torchmetrics_tpu.obs import telemetry
+from torchmetrics_tpu.obs import trace as _trace
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.serve.options import ServeOptions
 from torchmetrics_tpu.serve.staging import StagingPipeline
@@ -71,16 +72,20 @@ class IngestTicket:
 
     ``wait``/``result`` resolve when the drain commits (or fails/sheds) the batch;
     ``generation`` is the :class:`StateStore` generation the commit landed at (the
-    fence readers can compare against ``Metric.state_generation``).
+    fence readers can compare against ``Metric.state_generation``). ``trace_id`` is the
+    per-ticket trace/span id minted at enqueue while telemetry is enabled (None
+    otherwise) — the flow-event id linking the caller's enqueue slice to the drain
+    thread's commit in the exported Perfetto trace (docs/observability.md).
     """
 
-    __slots__ = ("seq", "shed", "error", "generation", "_event")
+    __slots__ = ("seq", "shed", "error", "generation", "trace_id", "_event")
 
     def __init__(self, seq: int) -> None:
         self.seq = seq
         self.shed = False
         self.error: Optional[BaseException] = None
         self.generation: Optional[int] = None
+        self.trace_id: Optional[int] = None
         self._event = threading.Event()
 
     def done(self) -> bool:
@@ -166,6 +171,8 @@ class IngestEngine:
 
     def _admit(self, args: tuple, kwargs: dict) -> IngestTicket:
         opts = self.options
+        # one flag read on the tracing-disabled path (the <=2us bound obs-smoke pins)
+        t0_us = telemetry.now_us() if telemetry.enabled else 0.0
         with self._cond:
             self._ensure_drain_locked()
             ticket = IngestTicket(self._seq)
@@ -177,6 +184,12 @@ class IngestEngine:
                     self._stats["shed"] += 1
                     telemetry.counter("serve.shed").inc()
                     telemetry.counter("robust.shed_batches").inc()
+                    # always-on live series (docs/observability.md "Live time series"):
+                    # queue_depth records one point per OFFERED batch (the shed-ratio
+                    # denominator), serve.sheds the shed events themselves
+                    telemetry.series("serve.queue_depth").record(opts.max_inflight)
+                    telemetry.series("serve.sheds").record(1.0)
+                    _trace.shed_event(ticket.trace_id, ticket.seq)
                     rank_zero_warn(
                         f"Async ingestion window full ({opts.max_inflight} in flight):"
                         " shedding batches (on_full='shed'). Shed counts are exact in"
@@ -207,12 +220,24 @@ class IngestEngine:
                     self._cond.wait(min(wait, remaining))
                     wait = min(wait * 2, _BLOCK_WAIT_MAX_S)
             s_args, s_kwargs, slot = self._staging.stage(args, kwargs)
+            # the trace id must exist BEFORE the batch is visible to the drain: the
+            # commit's flow-end reads it, possibly before this thread leaves the lock.
+            # Guarded here (not just inside mint) so the disabled path pays one flag
+            # read, not a function call — the <=2us/enqueue budget is tight.
+            if telemetry.enabled:
+                ticket.trace_id = _trace.mint()
             self._queue.append((ticket, s_args, s_kwargs, slot, time.monotonic()))
             self._stats["enqueued"] += 1
             depth = len(self._queue) + self._applying_n
             self._cond.notify_all()
         telemetry.counter("serve.enqueued").inc()
         telemetry.histogram("serve.queue_depth").record(depth)
+        # ONE always-on series record per enqueue (the <=2us disabled-path budget):
+        # each point is the live depth, so the series doubles as the offered-event
+        # stream — rate_over() is the enqueue rate, the SLO shed-ratio denominator
+        telemetry.series("serve.queue_depth").record(depth)
+        if ticket.trace_id is not None:
+            _trace.enqueue_span(ticket.trace_id, t0_us, ticket.seq, depth, slot)
         return ticket
 
     def _window_full_locked(self) -> bool:
@@ -244,6 +269,7 @@ class IngestEngine:
         self._thread.start()
 
     def _drain_loop(self) -> None:
+        _trace.note_thread("serve-drain")  # label this track in the exported trace
         linger_s = self.options.linger_ms / 1000.0
         while True:
             with self._cond:
@@ -284,6 +310,17 @@ class IngestEngine:
                     while len(items) > width:  # hand the overshoot back, order intact
                         self._queue.appendleft(items.pop())
                 self._applying_n = len(items)
+                inflight_now = len(self._queue) + self._applying_n
+            width = len(items)
+            tier = "update" if width == 1 else "update_batches"
+            telemetry.series("serve.inflight").record(inflight_now)
+            t_apply0 = 0.0
+            if telemetry.enabled:
+                t_apply0 = telemetry.now_us()
+                for it in items:
+                    if width > 1:
+                        _trace.coalesced_event(it[0].trace_id, width)
+                    _trace.dispatched_event(it[0].trace_id, tier, width)
             try:
                 self._apply_window(items)
             except DrainKilled:
@@ -303,6 +340,7 @@ class IngestEngine:
                 telemetry.counter("serve.apply_failures").inc(len(items))
                 for it in items:
                     it[0]._resolve(error=err)
+                    _trace.failed_event(it[0].trace_id, repr(err))
                 with self._cond:
                     if self._pending_error is None:
                         self._pending_error = err
@@ -312,6 +350,21 @@ class IngestEngine:
                 telemetry.counter("serve.committed").inc(len(items))
                 if len(items) > 1:
                     telemetry.counter("serve.coalesced_launches").inc()
+                # always-on: commit-event + enqueue->commit latency series (the SLO
+                # commit-latency feed), then the trace closes each ticket's flow on
+                # THIS (drain) thread — the caller->drain link Perfetto draws
+                now_mono = time.monotonic()
+                lat_series = telemetry.series("serve.commit_latency_us")
+                commits = telemetry.series("serve.commits")
+                for it in items:
+                    lat_series.record((now_mono - it[4]) * 1e6)
+                    commits.record(1.0)
+                if telemetry.enabled:
+                    _trace.apply_span(t_apply0, width, tier)
+                    for it in items:
+                        _trace.committed_event(
+                            it[0].trace_id, (now_mono - it[4]) * 1e6, it[0].generation
+                        )
                 with self._cond:
                     self._stats["committed"] += len(items)
                     self._applying_n = 0
@@ -335,6 +388,7 @@ class IngestEngine:
         if store is not None and self._fence is not None and store.generation != self._fence:
             self._stats["fence_breaks"] += 1
             telemetry.counter("serve.fence_breaks").inc()
+            _trace.fence_break_event(self._fence, store.generation)
             rank_zero_warn(
                 "Async ingestion generation fence broke: the metric state moved"
                 f" (generation {self._fence} -> {store.generation}) while batches were"
@@ -416,6 +470,8 @@ class IngestEngine:
         survivor — recovery is ``snapshot + replay(journal)`` on a FRESH metric."""
         with self._cond:
             dropped = len(self._queue) + self._applying_n
+            for it in self._queue:  # close every in-window flow: no dangling trace ids
+                _trace.abandoned_event(it[0].trace_id)
             self._queue.clear()
             self._paused = False
             self._stop = True

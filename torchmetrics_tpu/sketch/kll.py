@@ -203,6 +203,17 @@ def _weighted_points(state: Array) -> Tuple[Array, Array]:
     return flat[order], weights[order]
 
 
+def kll_weighted_points(state: Array) -> Tuple[Array, Array]:
+    """Public view of the sketch's (sorted values, per-item weights) support.
+
+    Lets consumers fold the sketch into THEIR quantile math (the obs live series
+    merges these points with its not-yet-folded pending samples in one numpy pass);
+    invalid slots carry weight 0 and sort last (+inf), so cumulative-weight rank
+    queries can ignore them.
+    """
+    return _weighted_points(state)
+
+
 def kll_quantiles(state: Array, qs: Array) -> Array:
     """Estimated quantile values at probabilities ``qs`` (any shape), NaN when empty."""
     qs = jnp.asarray(qs, jnp.float32)

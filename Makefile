@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke online-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke online-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -54,6 +54,15 @@ serve-smoke:
 obs-smoke:
 	python bench.py --obs --smoke > /tmp/tm_obs_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_obs_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['obs_trace_flows_valid'] and ex['obs_trace_flows'] > 0, ex; assert ex['obs_trace_committed_cross_thread'] == ex['obs_trace_flows'], ex; assert ex['obs_openmetrics_valid'] and ex['obs_scrape_valid'], ex; assert ex['obs_slo_quiet_when_healthy'] and ex['obs_slo_alarm_fired'], ex; assert ex['obs_disabled_overhead_ok'], ('disabled-path enqueue hooks above the 2us bound', ex['obs_disabled_hook_overhead_us']); print('obs-smoke ok: %d flows valid, %dB OpenMetrics (%d families), SLO burn %.0fx on %d sheds, disabled-path %.2fus' % (ex['obs_trace_flows'], ex['obs_openmetrics_bytes'], ex['obs_openmetrics_families'], ex['obs_slo_burn_rate'], ex['obs_slo_storm_sheds'], ex['obs_disabled_hook_overhead_us']))"
+
+# online windowed-monitoring lane (docs/online.md): tiny-N windowed bench asserting the
+# acceptance bar — windowed per-update cost <= 1.5x the plain template, sliding
+# compute() bit-identical to the direct twin across the AOT/jit/buffered/scan tiers,
+# and the KS drift alarm firing its one-shot warn EXACTLY once on an injected
+# distribution shift while staying silent on the stationary segment
+online-smoke:
+	python bench.py --online --smoke > /tmp/tm_online_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_online_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; r=ex['online_windowed_vs_plain_overhead']; assert r <= ex['online_overhead_bound'], ('windowed overhead above bound', ex); bits=[v for k,v in ex.items() if k.startswith('online_bit_identical')]; assert bits and all(bits), ex; assert ex['online_drift_quiet_stationary'] and ex['online_drift_alarm_fired_once'], ex; print('online-smoke ok: %.2fx windowed overhead, advance %sus, detector %sus, drift one-shot on shift' % (r, ex['online_advance_cost_us'], ex['online_detector_eval_us']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

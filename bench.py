@@ -1093,6 +1093,166 @@ def obs_main(smoke: bool) -> None:
     )
 
 
+def bench_online(batch: int, n_batches: int) -> dict:
+    """``--online`` scenario (docs/online.md): windowed monitoring on the hot path.
+
+    Four lanes:
+
+    1. **overhead** — per-update wall time of a windowed metric vs its plain template
+       (same stream, same tier). The ring adds one dynamic slot read/write and the
+       advance select to the fused program; the acceptance bound at smoke shapes is
+       windowed <= 1.5x plain.
+    2. **advance + detector cost** — amortized manual-advance launch time and the
+       host-side drift-detector evaluation latency (sketch-to-sketch, no raw data).
+    3. **bit-identity** — sliding ``compute()`` vs a fresh template fed exactly the
+       window's batches, across the AOT+donation / jit / buffered / scan tiers
+       (integer-valued f32 so reduction order cannot hide behind epsilons).
+    4. **drift alarm** — a KS detector over a windowed KLL sketch must stay quiet on
+       a stationary stream and fire its one-shot warn EXACTLY once on an injected
+       distribution shift.
+    """
+    import warnings
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.online import DriftMonitor, DriftSpec, KsDrift, Windowed
+    from torchmetrics_tpu.sketch import StreamingQuantile
+
+    rng = np.random.RandomState(29)
+    out: dict = {}
+    window, every = 8, 8
+    stream = [rng.randint(-6, 7, size=batch).astype(np.float32) for _ in range(n_batches)]
+
+    # --- lane 1: windowed-vs-plain per-update overhead -----------------------------
+    def _time_updates(metric, reps: int) -> float:
+        for b in stream[: min(8, len(stream))]:  # warm the compiled programs
+            metric.update(b)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            metric.update(stream[i % len(stream)])
+        return (time.perf_counter() - t0) / reps
+
+    reps = max(64, n_batches)
+    plain_s = _time_updates(MeanMetric(), reps)
+    windowed_s = _time_updates(
+        Windowed(MeanMetric(), window=window, advance_every=every, emit=False), reps
+    )
+    out["online_plain_updates_per_sec"] = round(1.0 / plain_s, 1)
+    out["online_windowed_updates_per_sec"] = round(1.0 / windowed_s, 1)
+    out["online_windowed_vs_plain_overhead"] = round(windowed_s / plain_s, 3)
+    out["online_overhead_bound"] = 1.5
+
+    # --- lane 2: advance launch cost + detector eval latency -----------------------
+    wa = Windowed(SumMetric(), window=window, advance_every=None, emit=False)
+    wa.update(stream[0])
+    wa.advance()  # compile out of window
+    t0 = time.perf_counter()
+    adv_reps = 32
+    for _ in range(adv_reps):
+        wa.advance()
+    out["online_advance_cost_us"] = round((time.perf_counter() - t0) / adv_reps * 1e6, 1)
+
+    wq = Windowed(StreamingQuantile(q=0.5, capacity=32, levels=12), window=4,
+                  advance_every=2, emit=False)
+    ref_sample = rng.normal(0.0, 1.0, 4096).astype(np.float32)
+    for _ in range(6):
+        wq.update(rng.normal(0.0, 1.0, batch).astype(np.float32))
+    det = KsDrift(wq, ref_sample)
+    det.score()  # warm the merge kernel
+    t0 = time.perf_counter()
+    det_reps = 16
+    for _ in range(det_reps):
+        det.score()
+    out["online_detector_eval_us"] = round((time.perf_counter() - t0) / det_reps * 1e6, 1)
+
+    # --- lane 3: bit-identity vs the direct twin across dispatch tiers -------------
+    start = max(0, len(stream) // every - window + 1) * every
+    direct = MeanMetric()
+    for b in stream[start:]:
+        direct.update(b)
+    direct_bytes = np.asarray(direct.compute()).tobytes()
+    for tier in ("aot", "jit", "buffered", "scan"):
+        m = Windowed(MeanMetric(), window=window, advance_every=every, emit=False)
+        if tier == "jit":
+            m.fast_dispatch = False
+            m.fast_update = False
+        if tier == "buffered":
+            with m.buffered(4) as buf:
+                for b in stream:
+                    buf.update(b)
+        elif tier == "scan":
+            m.update_batches(np.stack(stream))
+        else:
+            for b in stream:
+                m.update(b)
+        out[f"online_bit_identical_{tier}"] = (
+            np.asarray(m.compute()).tobytes() == direct_bytes
+        )
+
+    # --- lane 4: drift alarm — quiet on stationary, one-shot loud on a shift -------
+    from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+    reset_warning_cache()
+    wd = Windowed(StreamingQuantile(q=0.5, capacity=32, levels=12), window=4,
+                  advance_every=2, emit=False)
+    mon = DriftMonitor([
+        DriftSpec(name="bench-online-drift", detector=KsDrift(wd, ref_sample),
+                  threshold=0.2, windows=((5.0, 1.0),)),
+    ])
+    now = 10_000.0
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for _ in range(10):
+            wd.update(rng.normal(0.0, 1.0, batch).astype(np.float32))
+            now += 1.0
+            statuses = mon.evaluate(now=now)
+        quiet = not any(s.drifting for s in statuses)
+        quiet_warns = sum(1 for x in rec if "burning" in str(x.message))
+        for _ in range(10):
+            wd.update(rng.normal(4.0, 1.0, batch).astype(np.float32))
+            now += 1.0
+            statuses = mon.evaluate(now=now)
+        loud = any(s.drifting for s in statuses)
+        fired = sum(1 for x in rec if "burning" in str(x.message))
+    out["online_drift_quiet_stationary"] = bool(quiet and quiet_warns == 0)
+    out["online_drift_alarm_fired_once"] = bool(loud and fired == 1)
+    out["online_drift_score_final"] = (
+        None if statuses[0].score is None else round(statuses[0].score, 4)
+    )
+    out["online_windows_advanced"] = obs.telemetry.counter("online.windows_advanced").value
+    out["online_drift_evaluations"] = obs.telemetry.counter("drift.evaluations").value
+    out["online_drift_alarms"] = obs.telemetry.counter("drift.alarms").value
+    return out
+
+
+def online_main(smoke: bool) -> None:
+    """``bench.py --online [--smoke]``: one JSON line with the windowed-monitoring proof."""
+    batch, n_batches = (256, 64) if smoke else (2048, 256)
+    extras = bench_online(batch, n_batches)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "online_windowed_vs_plain_overhead",
+                "value": extras["online_windowed_vs_plain_overhead"],
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "per-update cost of a sliding ring vs its plain template (bound:"
+                    " 1.5x); advance cost, detector latency, tier bit-identity flags,"
+                    " and the one-shot drift-alarm evidence in extras"
+                ),
+                "vs_baseline": None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_reference(preds: np.ndarray, target: np.ndarray) -> float:
     """Same sweep through the reference torchmetrics (torch backend)."""
     import types
@@ -1808,6 +1968,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         obs_main(smoke)
+    elif "--online" in sys.argv:
+        # online windowed-monitoring lane (make online-smoke / docs/online.md): smoke
+        # pins CPU like the other lanes; full mode probes for a healthy platform
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        online_main(smoke)
     elif "--sketch" in sys.argv:
         # sketch-state scenario (make sketch-smoke / docs/sketches.md): smoke pins CPU
         # via the config API like the other lanes; full mode probes for a healthy platform

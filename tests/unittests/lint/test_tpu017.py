@@ -1,0 +1,123 @@
+"""TPU017: wall-clock reads inside jit-traced code or per-step hot paths."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+
+def _tpu017(source: str, path: str = "pkg/module.py"):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU017"]
+
+
+HOT_POSITIVE = """
+import time
+
+class WindowedThing:
+    def update(self, value):
+        if time.time() - self._last_advance > 60.0:
+            self._rotate()
+        self._fold(value)
+"""
+
+HOT_NEGATIVE = """
+import time
+
+class WindowedThing:
+    def update(self, value):
+        if self._update_count % self.advance_every == 0:
+            self._rotate()
+        self._fold(value)
+
+    def snapshot_meta(self):
+        return {"taken_at": time.time()}  # not a hot path: metadata is fine
+"""
+
+
+class TestHotPathProng:
+    def test_wall_clock_in_update_flagged(self):
+        findings = _tpu017(HOT_POSITIVE)
+        assert len(findings) == 1
+        assert "time.time" in findings[0].message
+        assert "hot path" in findings[0].message
+
+    def test_count_gated_advance_is_clean(self):
+        assert _tpu017(HOT_NEGATIVE) == []
+
+    def test_forward_and_monotonic_flagged(self):
+        src = (
+            "import time\n"
+            "def forward(self, x):\n"
+            "    self._t = time.monotonic()\n"
+            "    return x\n"
+        )
+        findings = _tpu017(src)
+        assert len(findings) == 1 and "time.monotonic" in findings[0].message
+
+    def test_datetime_now_flagged(self):
+        src = (
+            "import datetime\n"
+            "def update(self, x):\n"
+            "    self._day = datetime.datetime.now().day\n"
+        )
+        assert len(_tpu017(src)) == 1
+
+    def test_perf_counter_is_exempt(self):
+        # measurement clocks never define metric semantics; the engine's profiling
+        # spans use them on every hot path by design
+        src = (
+            "import time\n"
+            "def update(self, x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    self._fold(x)\n"
+            "    self._span_s = time.perf_counter() - t0\n"
+        )
+        assert _tpu017(src) == []
+
+    def test_non_hot_function_is_out_of_scope(self):
+        src = (
+            "import time\n"
+            "def export_report(self):\n"
+            "    return {'at': time.time()}\n"
+        )
+        assert _tpu017(src) == []
+
+
+class TestJitProng:
+    def test_wall_clock_in_jitted_kernel_flagged(self):
+        src = (
+            "import time\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def kernel(state, x):\n"
+            "    decay = 0.99 ** (time.time() - state['t0'])\n"
+            "    return state['v'] * decay + x\n"
+        )
+        findings = _tpu017(src)
+        assert len(findings) == 1
+        assert "TRACE time" in findings[0].message
+
+    def test_engine_convention_update_kernel_flagged(self):
+        src = (
+            "import time\n"
+            "class M:\n"
+            "    def _update(self, state, x):\n"
+            "        state['stamp'] = time.monotonic()\n"
+            "        return state\n"
+        )
+        findings = _tpu017(src)
+        assert len(findings) == 1 and "jit-traced" in findings[0].message
+
+
+class TestSuppressionAndRegistry:
+    def test_inline_disable_waives(self):
+        src = (
+            "import time\n"
+            "def update(self, x):\n"
+            "    deadline = time.monotonic() + 5.0  # jaxlint: disable=TPU017\n"
+        )
+        assert _tpu017(src) == []
+
+    def test_rule_registered(self):
+        meta = RULE_META["TPU017"]
+        assert meta["severity"] == "warning"
+        assert "wall-clock" in meta["summary"]

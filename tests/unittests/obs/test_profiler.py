@@ -339,3 +339,18 @@ def test_bench_extras_carries_profiler_and_nonfinite_counters():
     for key in ("robust_nonfinite_detected", "profiler_rows_recorded",
                 "profiler_lazy_compiles", "profiler_sampled_steps"):
         assert key in extras
+
+
+def test_summary_always_tabulates_online_and_drift_families():
+    # docs/online.md: a summary with zero online rows must still SAY no windows
+    # advanced and no drift was evaluated (the PR-5 zero-row convention)
+    fresh = obs.summary()
+    for name in ("online.windows_advanced", "online.emitted",
+                 "drift.evaluations", "drift.alarms", "serve.online_advances"):
+        assert name in fresh, f"{name} missing from obs.summary()"
+
+
+def test_bench_extras_carries_online_counters():
+    extras = obs.bench_extras()
+    for key in ("online_windows_advanced", "drift_evaluations", "drift_alarms"):
+        assert key in extras

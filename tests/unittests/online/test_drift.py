@@ -1,0 +1,188 @@
+"""Drift detection: KS/PSI sketch-to-sketch math, EWMA bands, and the alarm path.
+
+Pins the detector math against closed-form/numpy references (including parity between
+the numpy detectors and the traceable ``sketch.kll`` twins), and the monitor contract:
+scores land in ``drift.*`` series/gauges, alarms ride the SLO burn-rate machinery
+(one-shot warn per transition, counters), quiet on stationary streams, loud exactly
+once on an injected shift.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.online import (
+    DriftMonitor,
+    DriftSpec,
+    EwmaBand,
+    KsDrift,
+    PsiDrift,
+    Windowed,
+    default_drift_specs,
+)
+from torchmetrics_tpu.online.drift import ks_distance_points, psi_points, _as_points
+from torchmetrics_tpu.sketch import StreamingQuantile
+from torchmetrics_tpu.sketch.kll import kll_init, kll_ks_distance, kll_psi, kll_update
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+
+def _sq(seed: int, loc: float = 0.0, n: int = 1024):
+    rng = np.random.RandomState(seed)
+    m = StreamingQuantile(q=0.5, capacity=32, levels=12)
+    m.update(rng.normal(loc, 1.0, n).astype(np.float32))
+    return m
+
+
+class TestKsMath:
+    def test_identical_distributions_score_near_zero(self):
+        d = KsDrift(_sq(0), _sq(1)).score()
+        assert d is not None and d < 0.08
+
+    def test_shifted_distribution_scores_high(self):
+        d = KsDrift(_sq(0, loc=3.0), _sq(1)).score()
+        assert d is not None and d > 0.5
+
+    def test_empty_window_returns_none(self):
+        empty = StreamingQuantile(q=0.5, capacity=32, levels=12)
+        assert KsDrift(empty, _sq(1)).score() is None
+
+    def test_exact_cdfs_on_raw_samples(self):
+        # two disjoint supports: KS distance must be exactly 1
+        a = (np.asarray([0.0, 1.0]), np.asarray([1.0, 1.0]))
+        b = (np.asarray([5.0, 6.0]), np.asarray([1.0, 1.0]))
+        assert ks_distance_points(a, b) == 1.0
+        assert ks_distance_points(a, a) == 0.0
+
+    def test_numpy_vs_traceable_kll_twin_parity(self):
+        rng = np.random.RandomState(5)
+        a = kll_update(kll_init(32, 12), jnp.asarray(rng.normal(0, 1, 512), jnp.float32))
+        b = kll_update(kll_init(32, 12), jnp.asarray(rng.normal(1, 1, 512), jnp.float32))
+        device = float(np.asarray(kll_ks_distance(a, b)))
+        host = ks_distance_points(_as_points(a), _as_points(b))
+        assert abs(device - host) < 1e-6
+
+
+class TestPsiMath:
+    def test_identical_distributions_score_near_zero(self):
+        s = PsiDrift(_sq(0), _sq(1), bins=10).score()
+        assert s is not None and s < 0.05
+
+    def test_shifted_distribution_scores_above_rule_of_thumb(self):
+        s = PsiDrift(_sq(0, loc=3.0), _sq(1), bins=10).score()
+        assert s is not None and s > 0.25
+
+    def test_numpy_vs_traceable_kll_twin_parity(self):
+        rng = np.random.RandomState(9)
+        ref = kll_update(kll_init(32, 12), jnp.asarray(rng.normal(0, 1, 512), jnp.float32))
+        cur = kll_update(kll_init(32, 12), jnp.asarray(rng.normal(2, 1, 512), jnp.float32))
+        device = float(np.asarray(kll_psi(ref, cur, bins=8)))
+        host = psi_points(_as_points(ref), _as_points(cur), bins=8)
+        # both are PSI over the same sketch supports; grids differ only in edge
+        # tie-breaking, so the scores agree to a loose tolerance and the same verdict
+        assert device > 0.25 and host > 0.25
+        assert abs(device - host) < 0.5
+
+
+class TestEwmaBand:
+    def test_stationary_scores_stay_low(self):
+        rng = np.random.RandomState(2)
+        band = EwmaBand(alpha=0.2, warmup=5)
+        scores = [band.observe(v) for v in rng.normal(10.0, 1.0, 60)]
+        live = [s for s in scores if s is not None]
+        assert scores[:5] == [None] * 5 and live and max(live) < 5.0
+
+    def test_level_shift_scores_high(self):
+        band = EwmaBand(alpha=0.2, warmup=3)
+        for v in (10.0, 10.2, 9.8, 10.1, 9.9):
+            band.observe(v)
+        z = band.observe(20.0)
+        assert z is not None and z > 10.0
+
+    def test_state_roundtrip_deterministic(self):
+        a, b = EwmaBand(alpha=0.3, warmup=2), EwmaBand(alpha=0.3, warmup=2)
+        for v in (1.0, 2.0, 3.0):
+            a.observe(v)
+        b.restore(a.state())
+        assert a.observe(4.0) == b.observe(4.0)
+        assert a.state() == b.state()
+
+    def test_bound_metric_reads_window_value(self):
+        w = Windowed(StreamingQuantile(q=0.5, capacity=32, levels=12), 2, advance_every=2,
+                     emit=False)
+        w.update(np.random.RandomState(0).normal(0, 1, 64).astype(np.float32))
+        band = EwmaBand(metric=w, warmup=1)
+        assert band.score() is None  # first observation: warming up
+        assert band.score() is not None
+
+    def test_unbound_score_raises(self):
+        with pytest.raises(TorchMetricsUserError, match="no bound metric"):
+            EwmaBand().score()
+
+
+class TestDriftMonitor:
+    def _monitor(self, metric, reference, threshold=0.15, name="t-drift"):
+        # one registry per process: each test names its own spec so another test's
+        # recorded scores (at other pinned clocks) can never leak into its windows
+        spec = DriftSpec(
+            name=name, detector=KsDrift(metric, reference), threshold=threshold,
+            windows=((5.0, 1.0),),
+        )
+        return DriftMonitor([spec])
+
+    def test_alarm_fires_once_on_shift_quiet_on_stationary(self):
+        reset_warning_cache()
+        rng = np.random.RandomState(4)
+        w = Windowed(StreamingQuantile(q=0.5, capacity=32, levels=12), 3, advance_every=2,
+                     emit=False)
+        ref = rng.normal(0, 1, 4096).astype(np.float32)
+        mon = self._monitor(w, ref)
+        ev0 = obs.telemetry.counter("drift.evaluations").value
+        now = 1000.0
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            for _ in range(10):  # stationary segment
+                w.update(rng.normal(0, 1, 128).astype(np.float32))
+                now += 1.0
+                statuses = mon.evaluate(now=now)
+            assert not any(s.drifting for s in statuses)
+            quiet_warns = [x for x in rec if "burning" in str(x.message)]
+            assert quiet_warns == []
+            for _ in range(10):  # injected distribution shift
+                w.update(rng.normal(5, 1, 128).astype(np.float32))
+                now += 1.0
+                statuses = mon.evaluate(now=now)
+            assert any(s.drifting for s in statuses)
+            fired = [x for x in rec if "burning" in str(x.message)]
+        assert len(fired) == 1  # one-shot per transition, however many hot evaluations
+        assert obs.telemetry.counter("drift.evaluations").value - ev0 == 20
+        assert obs.telemetry.counter("drift.alarms.t-drift").value >= 1
+        assert mon.drifting() == ["t-drift"]
+
+    def test_scores_recorded_as_series_and_gauge(self):
+        w = _sq(0)
+        mon = self._monitor(w, _sq(1), name="t-drift-series")
+        mon.evaluate(now=50.0)
+        series = obs.telemetry.get_series("drift.t-drift-series.score")
+        assert series is not None and series.count >= 1
+
+    def test_empty_window_is_no_evidence(self):
+        empty = StreamingQuantile(q=0.5, capacity=32, levels=12)
+        mon = self._monitor(empty, _sq(1), name="t-drift-empty")
+        statuses = mon.evaluate(now=60.0)
+        assert statuses[0].score is None and not statuses[0].drifting
+
+    def test_default_drift_specs_shape(self):
+        w = _sq(0)
+        specs = default_drift_specs(w, _sq(1))
+        assert [s.name for s in specs] == [
+            "streamingquantile-drift-ks", "streamingquantile-drift-psi",
+        ]
+        assert isinstance(specs[0].detector, KsDrift)
+        assert isinstance(specs[1].detector, PsiDrift)
+        # and the obs-side constructor is the same thing (serving-users' one call)
+        assert [s.name for s in obs.default_drift_specs(w, _sq(1))] == [s.name for s in specs]

@@ -1,0 +1,362 @@
+"""Windowed-vs-direct equivalence: the sliding ring vs a fresh metric fed the window.
+
+The online layer's headline contract (docs/online.md, ISSUE 13 acceptance): for
+named-reduction templates (Sum/Mean/Max/Min — integer-valued f32 so accumulation is
+exact), ``Windowed(...).compute()`` is BIT-identical to a fresh template fed exactly
+the window's batches, across the jit / AOT+donation / buffered / scan dispatch tiers;
+for mergeable-sketch templates (KLL quantiles, streaming histograms) it is
+bit-identical to explicitly merging per-sub-window states (the mergeable-sketch
+contract), with the histogram pair additionally exact vs the direct run. Plus: the EMA
+closed form, never-advanced and freshly-emptied windows, descriptors, journal replay,
+serving integration, and advance emission.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.keyed import KeyedMetric
+from torchmetrics_tpu.online import Ema, Windowed
+from torchmetrics_tpu.online.windowed import ADVANCES_STATE, COUNT_STATE, SLOT_STATE
+from torchmetrics_tpu.sketch import StreamingHistogram, StreamingQuantile
+from torchmetrics_tpu.sketch.kll import kll_merge_stacked
+from torchmetrics_tpu.utils.exceptions import SnapshotError, TorchMetricsUserError
+
+AGGREGATORS = [SumMetric, MeanMetric, MaxMetric, MinMetric]
+TIERS = ["aot", "jit", "buffered", "scan"]
+WINDOW, EVERY = 3, 2
+
+
+def _stream(seed: int, n_batches: int = 9, size: int = 6):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(-6, 7, size=size).astype(np.float32) for _ in range(n_batches)]
+
+
+def _window_batches(batches, window: int, every: int):
+    """The batches a fresh twin must see: the last ``window`` sub-windows' worth."""
+    t = len(batches)
+    advances = t // every
+    start = max(0, advances - window + 1) * every
+    return batches[start:]
+
+
+def _drive(m, batches, tier: str):
+    if tier == "jit":
+        m.fast_dispatch = False
+        m.fast_update = False
+    if tier == "buffered":
+        with m.buffered(4) as buf:
+            for b in batches:
+                buf.update(b)
+    elif tier == "scan":
+        # equal-shape stack: one compiled lax.scan launch over the whole stream
+        m.update_batches(np.stack(batches))
+    else:
+        for b in batches:
+            m.update(b)
+    return m
+
+
+class TestWindowedVsDirect:
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    @pytest.mark.parametrize("tier", TIERS)
+    def test_sliding_compute_bit_identical(self, cls, tier):
+        batches = _stream(11)
+        w = _drive(Windowed(cls(), WINDOW, advance_every=EVERY, emit=False), batches, tier)
+        direct = cls()
+        for b in _window_batches(batches, WINDOW, EVERY):
+            direct.update(b)
+        assert np.asarray(w.compute()).tobytes() == np.asarray(direct.compute()).tobytes()
+        assert w.windows_advanced == len(batches) // EVERY
+
+    @pytest.mark.parametrize("cls", AGGREGATORS)
+    def test_tiers_agree_with_each_other(self, cls):
+        batches = _stream(7)
+        values = [
+            np.asarray(
+                _drive(Windowed(cls(), WINDOW, advance_every=EVERY, emit=False), batches, tier).compute()
+            ).tobytes()
+            for tier in TIERS
+        ]
+        assert len(set(values)) == 1
+
+    @pytest.mark.parametrize("boundary", [EVERY, 2 * EVERY, WINDOW * EVERY])
+    def test_exact_boundary_drops_oldest(self, boundary):
+        """At t = a·n the ring just rotated: the twin covers (window-1) full sub-windows."""
+        batches = _stream(3, n_batches=boundary)
+        w = _drive(Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False), batches, "aot")
+        direct = SumMetric()
+        for b in _window_batches(batches, WINDOW, EVERY):
+            direct.update(b)
+        assert float(w.compute()) == float(direct.compute())
+
+    def test_keyed_template_window(self):
+        rng = np.random.RandomState(3)
+        n_keys = 5
+        batches = [
+            (rng.randint(0, n_keys, size=7).astype(np.int32),
+             rng.randint(0, 9, size=7).astype(np.float32))
+            for _ in range(8)
+        ]
+        w = Windowed(KeyedMetric(SumMetric, n_keys), WINDOW, advance_every=EVERY, emit=False)
+        for b in batches:
+            w.update(*b)
+        direct = KeyedMetric(SumMetric, n_keys)
+        for b in _window_batches(batches, WINDOW, EVERY):
+            direct.update(*b)
+        assert np.asarray(w.compute()).tobytes() == np.asarray(direct.compute()).tobytes()
+
+
+class TestSketchWindows:
+    def test_histogram_window_bit_identical_to_direct(self):
+        batches = [np.random.RandomState(s).uniform(0, 1, 64).astype(np.float32) for s in range(9)]
+        w = Windowed(StreamingHistogram(bins=16), WINDOW, advance_every=EVERY, emit=False)
+        for b in batches:
+            w.update(b)
+        direct = StreamingHistogram(bins=16)
+        for b in _window_batches(batches, WINDOW, EVERY):
+            direct.update(b)
+        # histogram counts are small integers in f32: sum order cannot perturb them
+        assert np.asarray(w.compute()).tobytes() == np.asarray(direct.compute()).tobytes()
+
+    def test_kll_window_bit_identical_to_subwindow_merge(self):
+        """The sketch contract: the ring compute IS the stacked merge of per-sub-window
+        sketches (sequential-update equivalence only holds to the error bound)."""
+        batches = [np.random.RandomState(s).normal(0, 1, 64).astype(np.float32) for s in range(9)]
+        w = Windowed(StreamingQuantile(q=0.5, capacity=32, levels=12), WINDOW,
+                     advance_every=EVERY, emit=False)
+        for b in batches:
+            w.update(b)
+        # explicit per-sub-window twin states, merged through the same stacked fold
+        live = _window_batches(batches, WINDOW, EVERY)
+        subs = [live[i:i + EVERY] for i in range(0, len(live), EVERY)]
+        states = []
+        for sub in subs:
+            m = StreamingQuantile(q=0.5, capacity=32, levels=12)
+            for b in sub:
+                m.update(b)
+            states.append(m.metric_state["sketch"])
+        while len(states) < WINDOW:
+            states.append(StreamingQuantile(q=0.5, capacity=32, levels=12).metric_state["sketch"])
+        merged = kll_merge_stacked(jnp.stack(states[:WINDOW]))
+        assert np.asarray(w.window_state()["sketch"]).tobytes() == np.asarray(merged).tobytes()
+        # and the sliding quantile tracks the direct twin within the documented bound
+        direct = StreamingQuantile(q=0.5, capacity=32, levels=12)
+        for b in live:
+            direct.update(b)
+        assert abs(float(w.compute()) - float(direct.compute())) <= 0.5
+
+
+class TestEma:
+    def test_closed_form_sum(self):
+        decay = 0.75
+        vals = [3.0, -1.0, 4.0, 2.0, 5.0]
+        m = Ema(SumMetric(), decay=decay)
+        for v in vals:
+            m.update(np.asarray([v], np.float32))
+        t = len(vals)
+        expected = np.float32(0.0)
+        for i, v in enumerate(vals):
+            expected = np.float32(expected + np.float32(decay) ** np.float32(t - 1 - i) * np.float32(v))
+        assert abs(float(m.compute()) - float(expected)) < 1e-5
+
+    def test_decay_one_is_plain_metric(self):
+        batches = _stream(5)
+        m, ref = Ema(MeanMetric(), decay=1.0), MeanMetric()
+        for b in batches:
+            m.update(b)
+            ref.update(b)
+        assert np.asarray(m.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+
+    def test_rejects_non_sum_states(self):
+        with pytest.raises(TorchMetricsUserError, match="sum-reduced"):
+            Ema(MaxMetric(), decay=0.9)
+
+    def test_forward_raises(self):
+        with pytest.raises(TorchMetricsUserError, match="no per-batch forward"):
+            Ema(SumMetric(), decay=0.9)(np.asarray([1.0], np.float32))
+
+
+class TestEdges:
+    def test_never_advanced_equals_plain(self):
+        batches = _stream(2, n_batches=3)  # advance_every=None: one eternal sub-window
+        w = Windowed(SumMetric(), WINDOW, advance_every=None, emit=False)
+        ref = SumMetric()
+        for b in batches:
+            w.update(b)
+            ref.update(b)
+        assert float(w.compute()) == float(ref.compute())
+
+    def test_empty_window_computes_template_default(self):
+        w = Windowed(MeanMetric(), WINDOW, advance_every=EVERY, emit=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # compute-before-update advisory
+            assert float(w.compute()) == 0.0  # MeanMetric(empty_result=0.0)
+
+    def test_window_one_tumbles(self):
+        w = Windowed(SumMetric(), 1, advance_every=2, emit=False)
+        for v in (1.0, 2.0, 4.0, 8.0, 16.0):
+            w.update(np.asarray([v], np.float32))
+        assert float(w.compute()) == 16.0  # only the live, partial sub-window
+
+    def test_manual_advance(self):
+        w = Windowed(SumMetric(), 2, advance_every=None, emit=False)
+        w.update(np.asarray([3.0], np.float32))
+        w.advance()
+        w.update(np.asarray([5.0], np.float32))
+        assert float(w.compute()) == 8.0 and w.windows_advanced == 1
+        w.advance()
+        w.update(np.asarray([7.0], np.float32))
+        assert float(w.compute()) == 12.0  # the 3.0 sub-window rotated out
+
+    def test_manual_advance_forbidden_with_auto(self):
+        w = Windowed(SumMetric(), 2, advance_every=2, emit=False)
+        with pytest.raises(TorchMetricsUserError, match="auto-advances"):
+            w.advance()
+
+    def test_forward_raises(self):
+        with pytest.raises(TorchMetricsUserError, match="no per-batch forward"):
+            Windowed(SumMetric(), 2, advance_every=2)(np.asarray([1.0], np.float32))
+
+    def test_cat_template_rejected(self):
+        with pytest.raises(TorchMetricsUserError, match="cat"):
+            Windowed(CatMetric(), 2, advance_every=2)
+
+    def test_nesting_rejected(self):
+        with pytest.raises(ValueError, match="nested"):
+            Windowed(Windowed(SumMetric(), 2), 2)
+        with pytest.raises(ValueError, match="nested"):
+            Ema(Ema(SumMetric()), decay=0.5)
+
+    def test_reset_clears_ring_and_counter(self):
+        w = Windowed(SumMetric(), 2, advance_every=1, emit=False)
+        for v in (1.0, 2.0, 3.0):
+            w.update(np.asarray([v], np.float32))
+        assert w.windows_advanced == 3
+        w.reset()
+        assert w.windows_advanced == 0
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert float(w.compute()) == 0.0
+
+
+class TestDurability:
+    def test_snapshot_roundtrip_and_descriptor(self):
+        batches = _stream(9)
+        w = Windowed(MeanMetric(), WINDOW, advance_every=EVERY, emit=False)
+        for b in batches:
+            w.update(b)
+        blob = w.snapshot()
+        assert blob["window"] == {
+            "mode": "sliding", "window": WINDOW, "advance_every": EVERY,
+            "template": "MeanMetric",
+        }
+        fresh = Windowed(MeanMetric(), WINDOW, advance_every=EVERY, emit=False)
+        fresh.restore(blob)
+        assert np.asarray(fresh.compute()).tobytes() == np.asarray(w.compute()).tobytes()
+        assert fresh.windows_advanced == w.windows_advanced
+
+    def test_descriptor_rejects_cross_cadence_restore(self):
+        w = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        w.update(np.asarray([1.0], np.float32))
+        blob = w.snapshot()
+        # same array shapes, different advance cadence: only the descriptor can catch it
+        other = Windowed(SumMetric(), WINDOW, advance_every=EVERY + 1, emit=False)
+        with pytest.raises(SnapshotError, match="window descriptor"):
+            other.restore(blob)
+
+    def test_ema_descriptor_rejects_cross_decay_restore(self):
+        m = Ema(SumMetric(), decay=0.9)
+        m.update(np.asarray([1.0], np.float32))
+        blob = m.snapshot()
+        assert blob["window"]["mode"] == "ema"
+        other = Ema(SumMetric(), decay=0.99)
+        with pytest.raises(SnapshotError, match="window descriptor"):
+            other.restore(blob)
+
+    def test_journal_replay_reconstructs_ring(self, tmp_path):
+        batches = _stream(13, n_batches=7)
+        w = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        jm = w.journal(str(tmp_path / "wal"), every_k=3)
+        for b in batches[:5]:
+            jm.update(b)
+        # preemption: fresh instance recovers snapshot + replay, ring included
+        from torchmetrics_tpu.robust import journal as _journal
+
+        fresh = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        _journal.recover(fresh, str(tmp_path / "wal"))
+        for b in batches[5:]:
+            fresh.update(b)
+        ref = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        for b in batches:
+            ref.update(b)
+        for name in fresh._state.tensors:
+            assert (
+                np.asarray(fresh._state.tensors[name]).tobytes()
+                == np.asarray(ref._state.tensors[name]).tobytes()
+            ), name
+        assert fresh.windows_advanced == ref.windows_advanced
+
+
+class TestServingIntegration:
+    def test_async_drain_advances_and_matches_sync(self):
+        batches = _stream(21, n_batches=8)
+        w = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        eng = w.serve()
+        for b in batches:
+            w.update_async(b)
+        eng.quiesce()
+        ref = Windowed(SumMetric(), WINDOW, advance_every=EVERY, emit=False)
+        for b in batches:
+            ref.update(b)
+        assert float(w.compute()) == float(ref.compute())
+        assert w.windows_advanced == ref.windows_advanced == len(batches) // EVERY
+        assert eng.stats()["online_advances"] == w.windows_advanced
+
+    def test_advance_emits_series_and_counters(self):
+        base = obs.telemetry.counter("online.windows_advanced").value
+        w = Windowed(SumMetric(), 2, advance_every=2, series="online.test.emission")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            w.update(np.asarray([v], np.float32))
+        assert obs.telemetry.counter("online.windows_advanced").value - base == 2
+        series = obs.telemetry.get_series("online.test.emission")
+        assert series is not None and series.count == 2
+        # each emission is the sliding value AFTER the eager rotation: advance 1
+        # emits 1+2=3; advance 2 first drops the {1,2} slab (window=2), emitting 3+4=7
+        assert series.last == 7.0
+
+    def test_bookkeeping_states_registered(self):
+        w = Windowed(SumMetric(), WINDOW, advance_every=EVERY)
+        for name in (SLOT_STATE, COUNT_STATE, ADVANCES_STATE):
+            assert name in w._state.tensors
+
+
+class TestCollectionTwin:
+    def test_collection_windowed_members(self):
+        from torchmetrics_tpu.collections import MetricCollection
+
+        coll = MetricCollection({"s": SumMetric(), "m": MaxMetric()})
+        wc = coll.windowed(WINDOW, advance_every=EVERY, emit=False)
+        batches = _stream(17)
+        for b in batches:
+            wc.update(b)
+        out = wc.compute()
+        ref_s, ref_m = SumMetric(), MaxMetric()
+        for b in _window_batches(batches, WINDOW, EVERY):
+            ref_s.update(b)
+            ref_m.update(b)
+        assert float(out["s"]) == float(ref_s.compute())
+        assert float(out["m"]) == float(ref_m.compute())
+        # the source collection's own members are untouched
+        assert not any(m.update_called for m in coll.values(copy_state=False))
+
+    def test_metric_windowed_seam(self):
+        w = SumMetric().windowed(2, advance_every=2, emit=False)
+        assert isinstance(w, Windowed) and w.window == 2
+        e = SumMetric().ema(decay=0.5)
+        assert isinstance(e, Ema) and e.decay == 0.5

@@ -129,6 +129,31 @@ class TestScenarioEvidence:
         (result,) = matrix.run(n_batches=5)
         assert result["passed"] and result.get("scenario_applicable") is False
 
+    def test_online_window_preemption_recovers_ring_history_detector(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            MeanMetric, workdir=str(tmp_path), seed=SEED,
+            scenarios=("online_window_preemption",),
+        )
+        (result,) = matrix.run(n_batches=8)
+        assert result["passed"]
+        # every variant must recover all three layers: the ring buffers (bookkeeping
+        # scalars included), the per-advance value history, and the EWMA detector state
+        for variant in ("plain", "keyed", "sharded"):
+            cell = result[variant]
+            assert cell["bit_identical"] and cell["ring_identical"], (variant, cell)
+            assert cell["history_identical"] and cell["detector_identical"], (variant, cell)
+            assert cell["dropped_in_window"] > 0  # the preemption really hit mid-overlap
+            assert cell["replayed"] == result["preempt_step"] + 1
+            assert cell["windows_advanced"] >= 1
+
+    def test_online_scenario_substitutes_unwindowable_templates(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            CatMetric, workdir=str(tmp_path), seed=SEED,
+            scenarios=("online_window_preemption",),
+        )
+        (result,) = matrix.run(n_batches=8)
+        assert result["passed"] and result["template_substituted"]
+
     def test_failing_factory_reports_cell_not_abort(self, tmp_path):
         class Broken(SumMetric):
             def compute(self):

@@ -322,6 +322,9 @@ def _spec():
     spec["StreamingHistogram"] = (lambda: tm.StreamingHistogram(bins=16), _vals)
     spec["KeyedMetricCollection"] = (
         lambda: tm.KeyedMetricCollection([tm.SumMetric(), tm.MaxMetric()], num_keys=4), _keyed_batch)
+    spec["Windowed"] = (lambda: tm.Windowed(tm.SumMetric(), window=4, advance_every=8,
+                                            emit=False), _vals)
+    spec["Ema"] = (lambda: tm.Ema(tm.SumMetric(), decay=0.9), _vals)
     spec["Metric"] = None          # abstract base
     spec["__version__"] = None
     spec["functional"] = None
@@ -330,6 +333,11 @@ def _spec():
     spec["ServeOptions"] = None    # serving-tier policy object, not a metric (tests: serve/)
     spec["IngestEngine"] = None    # async ingestion machinery, not a metric (tests: serve/)
     spec["IngestTicket"] = None    # enqueue future, not a metric (tests: serve/)
+    spec["DriftMonitor"] = None    # drift-alarm machinery, not a metric (tests: online/)
+    spec["DriftSpec"] = None       # drift objective record, not a metric (tests: online/)
+    spec["EwmaBand"] = None        # drift detector, not a metric (tests: online/)
+    spec["KsDrift"] = None         # drift detector, not a metric (tests: online/)
+    spec["PsiDrift"] = None        # drift detector, not a metric (tests: online/)
     return spec, mextra
 
 

@@ -45,6 +45,9 @@ TPU014    unbounded ``add_state(default=[], dist_reduce_fx="cat")`` on a
 TPU015    host-blocking call (``.block_until_ready()`` / ``jax.device_get`` /
           ``.item()``/``.tolist()``) reachable from an async serve/drain path
           (a ``serve/`` module or a ``# jaxlint: serve-path`` function)
+TPU017    wall-clock read (``time.time()``/``time.monotonic()``/
+          ``datetime.now()``) inside jit-traced code or a per-step hot path
+          (non-reproducible boundaries + trace-time freeze)
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -184,6 +187,15 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "example": "s = telemetry.span('x'); s.__enter__()",
         "fix": "enter spans via `with` (or try/finally calling __exit__); emit trace"
                " stage events and series records from the eager host path only",
+    },
+    "TPU017": {
+        "severity": "warning",
+        "summary": "wall-clock read (time.time/time.monotonic/datetime.now) in jit-traced"
+                   " code or a per-step hot path (irreproducible boundaries, frozen under trace)",
+        "example": "if time.time() - start > 60: self.advance()",
+        "fix": "gate logic on a step/update COUNT (deterministic, journal-replayable);"
+               " pass timestamps in as inputs; time.perf_counter stays fine for"
+               " pure measurement that never feeds control flow",
     },
 }
 
@@ -2137,10 +2149,82 @@ def _rule_tpu016(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU017 helpers
+#: wall-clock reads whose value gates behaviour non-reproducibly. time.perf_counter /
+#: process_time are deliberately ABSENT: they are measurement clocks this codebase uses
+#: for profiling, and their values never define metric semantics.
+_TPU017_CLOCKS = {
+    ("time", "time"),
+    ("time", "time_ns"),
+    ("time", "monotonic"),
+    ("time", "monotonic_ns"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("date", "today"),
+}
+
+
+def _rule_tpu017(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Wall-clock read inside jit-traced code or an eager per-step hot path.
+
+    Two distinct failure modes behind one read:
+
+    - **under jit** the call executes at TRACE time only — the "current time" is
+      frozen into the compiled program, so any window boundary or decay horizon built
+      on it silently stops moving after the first compilation (and forcing a retrace
+      per step to "fix" it is the TPU004 churn hazard).
+    - **on an eager per-step path** the value makes metric behaviour a function of the
+      host's clock: window advances land on different batches across runs, a WAL
+      replay (``snapshot + replay(journal)``) cannot reconstruct the same state, and
+      the tier-equivalence/chaos bit-identity contracts quietly stop holding. The
+      online window layer (``torchmetrics_tpu.online``) exists precisely to provide
+      the deterministic alternative: update-count-driven advances.
+
+    Hot-path detection matches TPU006's (name heuristics + the whole-program ``hot``
+    mark); measurement-only clocks (``perf_counter``) are exempt.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        in_jit = info.jit
+        hot = (not in_jit) and (
+            info.hot or info.name in _HOT_EXACT or info.name.startswith(_HOT_PREFIXES)
+        )
+        if not (in_jit or hot):
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or len(dotted) < 2 or tuple(dotted[-2:]) not in _TPU017_CLOCKS:
+                continue
+            if in_jit and model.is_trace_dead(info, node):
+                continue
+            clock = ".".join(dotted[-2:])
+            if in_jit:
+                why = (
+                    "executes at TRACE time only — the timestamp is frozen into the"
+                    " compiled program, so time-gated behaviour silently stops moving"
+                    f" after the first compilation{_via_suffix(info.via)}"
+                )
+            else:
+                why = (
+                    "makes per-step behaviour a function of the host clock —"
+                    " irreproducible across runs and unreconstructable under WAL"
+                    " replay; gate on an update/step count instead"
+                    f" (torchmetrics_tpu.online advances that way){_via_suffix(info.hot_via)}"
+                )
+            out.append(_finding(
+                "TPU017", path, node, lines,
+                f"wall-clock read {clock}() in"
+                f" {'jit-traced' if in_jit else 'per-step hot path'} {info.qualname!r} {why}",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
-    _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016,
+    _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017,
 )
 
 

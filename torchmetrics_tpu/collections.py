@@ -363,6 +363,28 @@ class MetricCollection:
             num_keys=num_keys, strategy=strategy, prefix=self.prefix, postfix=self.postfix,
         )
 
+    def windowed(
+        self, window: int, advance_every: Optional[int] = None, **kwargs: Any
+    ) -> "MetricCollection":
+        """A collection of sliding-window twins of every member (docs/online.md).
+
+        Each member is cloned and wrapped in a :class:`~torchmetrics_tpu.online.
+        Windowed` ring under its existing registration name, so ``update`` drives
+        every member's live sub-window and ``compute`` returns the per-member sliding
+        values. This collection's own members and state are left untouched. Windowed
+        members own their rings individually — compute groups are disabled (ring
+        bookkeeping must never be aliased across members).
+        """
+        from torchmetrics_tpu.online import Windowed
+
+        return MetricCollection(
+            {
+                name: Windowed(m.clone(), window=window, advance_every=advance_every, **kwargs)
+                for name, m in self._modules.items()
+            },
+            prefix=self.prefix, postfix=self.postfix, compute_groups=False,
+        )
+
     def shard(self, mesh: Optional[Any] = None, spec: Optional[Dict[str, Any]] = None) -> "MetricCollection":
         """Place every member's state on a device mesh (see :meth:`Metric.shard`).
 

@@ -1085,6 +1085,33 @@ class Metric:
 
         return _journal.MetricJournal(self, path, every_k=every_k, resume=resume)
 
+    # ------------------------------------------------------------- online windows
+    def windowed(
+        self, window: int, advance_every: Optional[int] = None, **kwargs: Any
+    ) -> "Any":
+        """Sliding-window twin of this metric (docs/online.md).
+
+        Returns a :class:`~torchmetrics_tpu.online.Windowed` using THIS instance as
+        the kernel template (this instance itself is never updated by the twin):
+        every tensor state gains a leading ``[window, ...]`` ring axis of tumbling
+        sub-window slabs, the ring rotates in-graph every ``advance_every`` updates
+        (update-count-driven — deterministic under WAL replay), and ``compute()``
+        merges the live sub-windows through the registered reductions. Each advance
+        emits the sliding value into the ``online.*`` live series.
+        """
+        from torchmetrics_tpu.online import Windowed
+
+        return Windowed(self, window=window, advance_every=advance_every, **kwargs)
+
+    def ema(self, decay: float = 0.99, **kwargs: Any) -> "Any":
+        """Exponentially-decayed twin of this metric (sum-reduced states only): the
+        decay is one fused multiply inside the update kernel — per UPDATE, not per
+        wall-clock second, so the horizon is deterministic and replayable. See
+        :class:`~torchmetrics_tpu.online.Ema` and ``docs/online.md``."""
+        from torchmetrics_tpu.online import Ema
+
+        return Ema(self, decay=decay, **kwargs)
+
     # ------------------------------------------------------------- async ingestion
     def serve(self, options: Optional[Any] = None, journal: Optional[Any] = None) -> "Any":
         """Configure (or fetch) this metric's async ingestion engine (docs/serving.md).

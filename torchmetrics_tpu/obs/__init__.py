@@ -72,7 +72,7 @@ from torchmetrics_tpu.obs.profiler import (
 )
 from torchmetrics_tpu.obs import openmetrics, slo, timeseries, trace  # noqa: F401
 from torchmetrics_tpu.obs.openmetrics import serve_scrape
-from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_serve_specs
+from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_drift_specs, default_serve_specs
 from torchmetrics_tpu.obs.timeseries import TimeSeries
 
 __all__ = [
@@ -80,6 +80,7 @@ __all__ = [
     "SloMonitor",
     "SloSpec",
     "TimeSeries",
+    "default_drift_specs",
     "default_serve_specs",
     "openmetrics",
     "serve_scrape",

@@ -133,6 +133,14 @@ _ALWAYS_TABULATED = (
     "trace.spans",
     "slo.evaluations",
     "slo.alarms",
+    # online windowed monitoring (docs/online.md): ring advances, emitted window
+    # values, and the drift-detection audit trail — a summary with zero online rows
+    # must still SAY no windows advanced and no drift was evaluated
+    "online.windows_advanced",
+    "online.emitted",
+    "drift.evaluations",
+    "drift.alarms",
+    "serve.online_advances",
 )
 
 
@@ -286,6 +294,11 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "serve_trace_tickets": counters.get("trace.tickets", 0),
         "slo_evaluations": counters.get("slo.evaluations", 0),
         "slo_alarms": counters.get("slo.alarms", 0),
+        # online windowed monitoring (docs/online.md): a bench that drove sliding/EMA
+        # windows records how many rings advanced and what the drift layer concluded
+        "online_windows_advanced": counters.get("online.windows_advanced", 0),
+        "drift_evaluations": counters.get("drift.evaluations", 0),
+        "drift_alarms": counters.get("drift.alarms", 0),
         # sketch states (docs/sketches.md): a bench that folded streams into O(1)
         # sketches records the merge/compaction volume and the cat bytes it did not keep
         "sketch_merges": counters.get("sketch.merges", 0),

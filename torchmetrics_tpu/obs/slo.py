@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["SloSpec", "SloStatus", "SloMonitor", "default_serve_specs"]
+__all__ = ["SloSpec", "SloStatus", "SloMonitor", "default_drift_specs", "default_serve_specs"]
 
 #: default multi-window policy: sustained over 5 minutes AND still burning over the
 #: last 30 seconds, both at >= 2x budget pace
@@ -248,3 +248,15 @@ def default_serve_specs(
             description="shed batches vs offered batches (on_full='shed' pressure)",
         ),
     ]
+
+
+def default_drift_specs(metric: Any, reference: Any, **kwargs: Any) -> list:
+    """Model-QUALITY twin of :func:`default_serve_specs`: stock drift alarms (KS +
+    PSI, sketch-to-sketch vs ``reference``) for a windowed, sketch-backed metric on
+    the serving path. Delegates to :func:`torchmetrics_tpu.online.drift.
+    default_drift_specs`; drive the result with a
+    :class:`~torchmetrics_tpu.online.drift.DriftMonitor` — alarms ride the same
+    burn-rate/counter/gauge substrate as the serve SLOs (docs/online.md)."""
+    from torchmetrics_tpu.online.drift import default_drift_specs as _impl
+
+    return _impl(metric, reference, **kwargs)

@@ -1,0 +1,30 @@
+"""torchmetrics_tpu.online — windowed monitoring and drift alarms on the serving path.
+
+Sliding/EMA windows as first-class fixed-shape metric states (``Windowed`` / ``Ema``,
+or the ``Metric.windowed()`` / ``Metric.ema()`` / ``MetricCollection.windowed()``
+seams), per-window value emission into the always-on ``online.*`` live series, and
+drift detection (KS / PSI sketch-to-sketch, EWMA control bands) alarmed through the
+SLO burn-rate machinery. See ``docs/online.md``.
+"""
+from torchmetrics_tpu.online.drift import (
+    DriftDetector,
+    DriftMonitor,
+    DriftSpec,
+    EwmaBand,
+    KsDrift,
+    PsiDrift,
+    default_drift_specs,
+)
+from torchmetrics_tpu.online.windowed import Ema, Windowed
+
+__all__ = [
+    "DriftDetector",
+    "DriftMonitor",
+    "DriftSpec",
+    "Ema",
+    "EwmaBand",
+    "KsDrift",
+    "PsiDrift",
+    "Windowed",
+    "default_drift_specs",
+]

@@ -1,0 +1,406 @@
+"""Drift detection: windowed metric state vs a reference, alarmed through the SLO stack.
+
+A sliding window (:class:`~torchmetrics_tpu.online.windowed.Windowed`) makes the live
+distribution of a served stream observable in O(1) state; this module turns that state
+into *alarms*. Three detector families, all host-side and O(sketch) — no raw data is
+ever retained or compared:
+
+- :class:`KsDrift` — Kolmogorov–Smirnov distance between the current window's KLL
+  sketch and a reference (sketch-to-sketch at the merged support: both CDFs are exact
+  functions of the two fixed ~KB sketch states).
+- :class:`PsiDrift` — Population Stability Index over quantile-grid bins derived from
+  the reference (the industry-standard "has the score distribution moved" number;
+  rule-of-thumb: 0.1 drifting, 0.25 shifted).
+- :class:`EwmaBand` — an EWMA control band over a scalar value stream (the emitted
+  window values): score is the deviation in sigma units. State is three floats —
+  snapshot/restore-able, so chaos recovery can prove detector state survives
+  preemption bit-identically.
+
+A :class:`DriftSpec` names a detector, a score threshold, and a multi-window burn-rate
+policy; :class:`DriftMonitor` records each evaluation's score into a ``drift.<name>.
+score`` live series and drives the PR-12 :class:`~torchmetrics_tpu.obs.slo.SloMonitor`
+over it — so a drift alarm gets exactly the serving-SLO treatment: a one-shot
+``rank_zero_warn`` per transition, ``slo.alarms`` / ``drift.alarms`` counters, and a
+burn-rate gauge in the OpenMetrics exposition. ``default_drift_specs`` is the one-call
+constructor serving users pair with ``obs.default_serve_specs()``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.obs.slo import DEFAULT_WINDOWS, SloMonitor, SloSpec, SloStatus
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "DriftDetector",
+    "DriftMonitor",
+    "DriftSpec",
+    "EwmaBand",
+    "KsDrift",
+    "PsiDrift",
+    "default_drift_specs",
+]
+
+#: PSI rule-of-thumb alarm threshold ("population has shifted")
+DEFAULT_PSI_THRESHOLD = 0.25
+#: KS-distance default alarm threshold
+DEFAULT_KS_THRESHOLD = 0.15
+
+
+# ---------------------------------------------------------------------------
+# weighted-point plumbing (host numpy; sketches expose their support explicitly)
+# ---------------------------------------------------------------------------
+
+def _metric_sketch_state(metric: Any, state: str) -> Any:
+    """The named sketch state — merged over the ring for Windowed metrics."""
+    window_state = getattr(metric, "window_state", None)
+    source = window_state() if callable(window_state) else metric.metric_state
+    if state not in source:
+        raise TorchMetricsUserError(
+            f"{type(metric).__name__} has no state {state!r}; registered states are"
+            f" {sorted(source)}"
+        )
+    return source[state]
+
+
+def _as_points(ref: Any, state: str = "sketch") -> Tuple[np.ndarray, np.ndarray]:
+    """Coerce a reference into (values, weights) support points.
+
+    Accepts a raw sample array (unit weights — the exact empirical distribution), a
+    2-D KLL sketch state, or a metric holding one (``StreamingQuantile`` or a
+    ``Windowed`` wrapper of it).
+    """
+    from torchmetrics_tpu.sketch.kll import kll_weighted_points
+
+    if hasattr(ref, "_state"):  # a Metric
+        ref = _metric_sketch_state(ref, state)
+    arr = np.asarray(ref)
+    if arr.ndim == 2:  # a KLL state (levels, capacity+2)
+        v, w = kll_weighted_points(ref if not isinstance(ref, np.ndarray) else arr)
+        return np.asarray(v, np.float64), np.asarray(w, np.float64)
+    values = arr.astype(np.float64).reshape(-1)
+    return np.sort(values), np.ones(values.size, np.float64)
+
+
+def _cdf_at(values: np.ndarray, weights: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Weighted empirical CDF of (values, weights) evaluated at ``xs``."""
+    finite = np.isfinite(values) & (weights > 0)
+    v, w = values[finite], weights[finite]
+    if v.size == 0:
+        return np.zeros_like(xs, np.float64)
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    cw = np.cumsum(w)
+    idx = np.searchsorted(v, xs, side="right")
+    cdf = np.where(idx > 0, cw[np.clip(idx - 1, 0, len(cw) - 1)], 0.0)
+    return cdf / cw[-1]
+
+
+def ks_distance_points(
+    a: Tuple[np.ndarray, np.ndarray], b: Tuple[np.ndarray, np.ndarray]
+) -> float:
+    """KS distance between two weighted empirical distributions (numpy twin of
+    ``sketch.kll.kll_ks_distance``; parity-tested)."""
+    support = np.concatenate([a[0], b[0]])
+    support = np.sort(support[np.isfinite(support)])
+    if support.size == 0:
+        return 0.0
+    return float(np.max(np.abs(_cdf_at(*a, support) - _cdf_at(*b, support))))
+
+
+def psi_points(
+    ref: Tuple[np.ndarray, np.ndarray],
+    cur: Tuple[np.ndarray, np.ndarray],
+    bins: int = 10,
+) -> float:
+    """Population Stability Index over quantile-grid bins derived from the reference
+    (numpy twin of ``sketch.kll.kll_psi``; masses are epsilon-clamped so empty bins
+    contribute a finite penalty instead of an infinity)."""
+    v, w = ref
+    finite = np.isfinite(v) & (w > 0)
+    v, w = v[finite], w[finite]
+    if v.size == 0:
+        return 0.0
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    cw = np.cumsum(w)
+    targets = np.linspace(0.0, 1.0, bins + 1)[1:-1] * cw[-1]
+    edges = v[np.minimum(np.searchsorted(cw, targets, side="left"), v.size - 1)]
+    grid = np.concatenate([[-np.inf], edges, [np.inf]])
+    p = np.diff(_cdf_at(*ref, grid[1:-1]), prepend=0.0, append=1.0)
+    q = np.diff(_cdf_at(*cur, grid[1:-1]), prepend=0.0, append=1.0)
+    eps = 1e-6
+    p, q = np.clip(p, eps, None), np.clip(q, eps, None)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+class DriftDetector:
+    """One drift score source: ``score()`` returns the current drift magnitude, or
+    ``None`` when there is no evidence yet (empty window, warmup). Detectors are
+    host-side and deterministic — state (if any) is plain floats."""
+
+    def score(self) -> Optional[float]:
+        raise NotImplementedError
+
+    def state(self) -> Dict[str, float]:
+        """Serialisable detector state (empty for stateless detectors)."""
+        return {}
+
+    def restore(self, state: Dict[str, float]) -> None:
+        """Restore a :meth:`state` payload (no-op for stateless detectors)."""
+
+
+class KsDrift(DriftDetector):
+    """KS distance between ``metric``'s (window-merged) KLL sketch and ``reference``.
+
+    O(1) in the stream: both sides are fixed-size sketch supports. ``reference`` is a
+    sample array, a KLL state, or a metric holding one (see ``_as_points``).
+    """
+
+    def __init__(self, metric: Any, reference: Any, state: str = "sketch") -> None:
+        self.metric = metric
+        self.state_name = state
+        self._ref = _as_points(reference, state)
+
+    def score(self) -> Optional[float]:
+        from torchmetrics_tpu.sketch.kll import kll_count
+
+        sk = _metric_sketch_state(self.metric, self.state_name)
+        if float(np.asarray(kll_count(sk))) <= 0:
+            return None  # empty window: no evidence either way
+        return ks_distance_points(_as_points(sk), self._ref)
+
+
+class PsiDrift(DriftDetector):
+    """PSI between ``metric``'s (window-merged) KLL sketch and ``reference`` over
+    ``bins`` reference-quantile bins."""
+
+    def __init__(self, metric: Any, reference: Any, bins: int = 10, state: str = "sketch") -> None:
+        if bins < 2:
+            raise ValueError(f"PsiDrift needs bins >= 2, got {bins}")
+        self.metric = metric
+        self.state_name = state
+        self.bins = int(bins)
+        self._ref = _as_points(reference, state)
+
+    def score(self) -> Optional[float]:
+        from torchmetrics_tpu.sketch.kll import kll_count
+
+        sk = _metric_sketch_state(self.metric, self.state_name)
+        if float(np.asarray(kll_count(sk))) <= 0:
+            return None
+        return psi_points(self._ref, _as_points(sk), bins=self.bins)
+
+
+class EwmaBand(DriftDetector):
+    """EWMA control band over a scalar value stream: score = |x − ewma| in sigma units.
+
+    Feed values explicitly with :meth:`observe` (each call scores the value against
+    the band BEFORE folding it in, so a genuine level shift cannot mask itself), or
+    bind a ``metric`` whose scalar window value is read on every :meth:`score` call.
+    Warmup observations return ``None`` (no evidence). State is three floats —
+    deterministic and snapshot/restore-able.
+    """
+
+    def __init__(
+        self,
+        metric: Any = None,
+        alpha: float = 0.1,
+        warmup: int = 5,
+        min_sigma: float = 1e-9,
+    ) -> None:
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"EwmaBand needs alpha in (0, 1], got {alpha}")
+        self.metric = metric
+        self.alpha = float(alpha)
+        self.warmup = max(1, int(warmup))
+        self.min_sigma = float(min_sigma)
+        self._mean = 0.0
+        self._var = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> Optional[float]:
+        """Score ``value`` against the current band, then fold it into the EWMA."""
+        value = float(value)
+        if self._n >= self.warmup:
+            sigma = max(np.sqrt(self._var), self.min_sigma)
+            z = abs(value - self._mean) / sigma
+        else:
+            z = None
+        a = self.alpha
+        if self._n == 0:
+            self._mean = value
+        else:
+            delta = value - self._mean
+            self._mean += a * delta
+            self._var = (1.0 - a) * (self._var + a * delta * delta)
+        self._n += 1
+        return z
+
+    def score(self) -> Optional[float]:
+        if self.metric is None:
+            raise TorchMetricsUserError(
+                "This EwmaBand has no bound metric: drive it with observe(value), or"
+                " construct it with EwmaBand(metric=...)"
+            )
+        reader = getattr(self.metric, "window_values", None)
+        value = reader() if callable(reader) else self.metric.compute()
+        arr = np.asarray(value)
+        if arr.size != 1:
+            raise TorchMetricsUserError(
+                f"EwmaBand needs a scalar value stream; {type(self.metric).__name__}"
+                f" produced shape {arr.shape}"
+            )
+        return self.observe(float(arr.reshape(())))
+
+    def state(self) -> Dict[str, float]:
+        return {"mean": self._mean, "var": self._var, "n": float(self._n)}
+
+    def restore(self, state: Dict[str, float]) -> None:
+        self._mean = float(state["mean"])
+        self._var = float(state["var"])
+        self._n = int(state["n"])
+
+
+# ---------------------------------------------------------------------------
+# specs + monitor
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DriftSpec:
+    """One drift objective: a detector, a score threshold, and the burn-rate policy.
+
+    ``threshold`` is in the detector's own units (KS distance, PSI nats, EWMA
+    sigmas). ``objective``/``windows`` parameterise the SLO burn-rate evaluation over
+    the recorded score series — the same multi-window "sustained AND still happening"
+    recipe the serving SLOs use, which keeps drift alarms spike-proof.
+    """
+
+    name: str
+    detector: DriftDetector
+    threshold: float
+    objective: float = 0.999
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS
+    description: str = ""
+
+    def as_slo_spec(self) -> SloSpec:
+        return SloSpec(
+            name=self.name,
+            series=f"drift.{self.name}.score",
+            objective=self.objective,
+            threshold=self.threshold,
+            bad_when="above",
+            windows=self.windows,
+            description=self.description
+            or f"drift score above {self.threshold:g} (docs/online.md)",
+        )
+
+
+@dataclass
+class DriftStatus:
+    """One drift evaluation: the raw score plus the SLO burn verdict."""
+
+    spec: DriftSpec
+    score: Optional[float]
+    slo: Optional[SloStatus]
+
+    @property
+    def drifting(self) -> bool:
+        return bool(self.slo is not None and self.slo.burning)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.spec.name,
+            "score": None if self.score is None else round(self.score, 6),
+            "threshold": self.spec.threshold,
+            "drifting": self.drifting,
+            "slo": None if self.slo is None else self.slo.as_dict(),
+        }
+
+
+class DriftMonitor:
+    """Evaluates drift specs through the SLO burn-rate machinery.
+
+    Each :meth:`evaluate` call scores every detector, records the scores into
+    ``drift.<name>.score`` live series (+ gauges), and runs the embedded
+    :class:`SloMonitor` over them — firing alarms with the full serving-SLO
+    treatment (one-shot warn per transition, counters, burn gauges). ``now`` pins
+    the clock for tests; production callers leave it None.
+    """
+
+    def __init__(self, specs: Sequence[DriftSpec] = (), registry: Any = None) -> None:
+        self.specs: List[DriftSpec] = list(specs)
+        self._tel = registry if registry is not None else obs.telemetry
+        self._slo = SloMonitor([s.as_slo_spec() for s in self.specs], registry=self._tel)
+
+    def watch(self, spec: DriftSpec) -> "DriftMonitor":
+        self.specs.append(spec)
+        self._slo.watch(spec.as_slo_spec())
+        return self
+
+    def evaluate(self, now: Optional[float] = None) -> List[DriftStatus]:
+        scores: Dict[str, Optional[float]] = {}
+        for spec in self.specs:
+            self._tel.counter("drift.evaluations").inc()
+            s = spec.detector.score()
+            scores[spec.name] = s
+            if s is None:
+                continue  # no evidence: the empty window cannot satisfy any burn
+            self._tel.series(f"drift.{spec.name}.score").record(float(s), now=now)
+            self._tel.gauge(f"drift.{spec.name}.score").set(float(s))
+        statuses = {st.spec.name: st for st in self._slo.evaluate(now=now)}
+        out: List[DriftStatus] = []
+        for spec in self.specs:
+            st = statuses.get(spec.name)
+            if st is not None and st.burning:
+                self._tel.counter("drift.alarms").inc()
+                self._tel.counter(f"drift.alarms.{spec.name}").inc()
+            out.append(DriftStatus(spec=spec, score=scores[spec.name], slo=st))
+        return out
+
+    def drifting(self) -> List[str]:
+        """Names of specs whose last evaluation fired."""
+        return self._slo.burning()
+
+
+def default_drift_specs(
+    metric: Any,
+    reference: Any,
+    name: Optional[str] = None,
+    ks_threshold: float = DEFAULT_KS_THRESHOLD,
+    psi_threshold: float = DEFAULT_PSI_THRESHOLD,
+    psi_bins: int = 10,
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS,
+) -> List[DriftSpec]:
+    """The stock quality alarms for a served, windowed, sketch-backed metric.
+
+    One call gives serving users model-quality drift alarms next to their
+    ``obs.default_serve_specs()`` system alarms: a KS-distance spec and a PSI spec,
+    both comparing ``metric``'s (window-merged) KLL sketch against ``reference`` —
+    a held-out sample array, a reference sketch state, or a warmed-up twin metric.
+    """
+    base = name or f"{type(metric).__name__.lower()}-drift"
+    return [
+        DriftSpec(
+            name=f"{base}-ks",
+            detector=KsDrift(metric, reference),
+            threshold=ks_threshold,
+            windows=windows,
+            description="KS distance of the live window vs the reference distribution",
+        ),
+        DriftSpec(
+            name=f"{base}-psi",
+            detector=PsiDrift(metric, reference, bins=psi_bins),
+            threshold=psi_threshold,
+            windows=windows,
+            description="PSI of the live window vs the reference distribution",
+        ),
+    ]

@@ -1,0 +1,557 @@
+"""Windowed metric state: sliding rings and EMA decay as first-class, fixed-shape states.
+
+A production serving stack needs the metric *values* flowing through it to be visible
+continuously, not once per epoch (ROADMAP item 2; *Fine-Tuning and Serving Gemma 4 31B
+on Google Cloud TPU*, PAPERS.md). The blockers have always been state shape and host
+coordination: a naive sliding window keeps the raw samples (unbounded state — exactly
+what PR 10's sketches eliminated), and a host-driven window boundary is both a retrace
+hazard and non-reproducible under WAL replay (jaxlint TPU017 exists precisely for the
+wall-clock version of this bug).
+
+Design — the KeyedMetric trick, turned 90 degrees (docs/online.md):
+
+- **State**: :class:`Windowed` wraps a template metric and registers every template
+  tensor state with a leading ``[window, ...]`` ring axis — ``window`` tumbling
+  sub-window slabs, each accumulated with the template's OWN ``_update`` kernel, plus
+  three scalar bookkeeping states (``window_slot`` / ``window_count`` /
+  ``window_advances``). The whole ring is a fixed-shape pytree of ordinary states, so
+  EVERY engine seam — jit, AOT+donation, ``update_scan``, buffered windows, keyed
+  templates, ``shard()``, snapshot/journal/quorum sync — applies unchanged.
+
+- **Advance is in-graph and update-count-driven.** With ``advance_every=n`` the update
+  kernel itself rotates the ring: after the slot's ``n``-th update it moves the slot
+  pointer, resets the next slab to the template defaults (dropping the oldest
+  sub-window), and bumps the advance counter — all ``jnp.where`` selects over fixed
+  shapes, no host round-trips, no wall clock. Window boundaries are therefore a pure
+  function of the update count: a WAL replay (``snapshot + replay(journal)``)
+  reconstructs the ring BIT-identically, and the serve drain advances windows simply by
+  applying batches (batch-count ticks, quiesce-safe by the single-mutator contract).
+
+- **Compute merges the live sub-windows** through the same reduction ladder the
+  engine already trusts: ``sum`` states fold as ``default + Σ(slab - default)``,
+  ``max``/``min`` as the axis-0 reduction, and trace-safe callable merges (the KLL
+  compactor's ``kll_merge_stacked``) fold the ``[window, ...]`` stack directly — so
+  every PR-10 mergeable sketch gets sliding semantics for free. For named reductions
+  the window value is bit-identical to a fresh metric fed exactly the window's batches
+  (with order-exact inputs, e.g. integer-valued f32); for sketch merges it is
+  bit-identical to explicitly merging per-sub-window sketches (the mergeable-sketch
+  contract — see docs/online.md).
+
+- **EMA / time-decayed** (:class:`Ema`): the decay is ONE extra fused multiply applied
+  to the (sum-reduced) state inside the update kernel — no ring, no host work. Decay is
+  per *update*, deliberately not per wall-clock second: deterministic, replayable, and
+  trace-stable (TPU017 again).
+
+Per-window observability: each advance bumps ``online.windows_advanced`` and (when
+``emit=True``) records the freshly-computed sliding value into the always-on
+``online.<Template>.w<window>`` :class:`~torchmetrics_tpu.obs.timeseries.TimeSeries`
+(+ a matching OpenMetrics gauge) — one deliberate device read per ``advance_every``
+updates, amortized. Drift detection over these windows lives in
+:mod:`torchmetrics_tpu.online.drift`.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops import dispatch as _dispatch
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+_SUM_FX = ("sum", jnp.sum)
+_MAX_FX = ("max", jnp.max)
+_MIN_FX = ("min", jnp.min)
+
+#: bookkeeping states registered alongside the ring slabs (reserved names)
+SLOT_STATE = "window_slot"
+COUNT_STATE = "window_count"
+ADVANCES_STATE = "window_advances"
+_BOOKKEEPING = (SLOT_STATE, COUNT_STATE, ADVANCES_STATE)
+
+
+def _slotwise_merge(fx: Callable) -> Callable:
+    """Slot-wise twin of a trace-safe merge callable for ``[window, ...]`` ring states.
+
+    ``process_sync`` stacks per-rank states to ``(world, window, ...)`` while the
+    template's merge expects ``(world, ...)``; vmapping over the slot axis merges each
+    ring slot across ranks independently. The wrapper stays declared trace-safe so the
+    quorum/forward merge ladders keep accepting it.
+    """
+
+    def slotwise(stacked: Array) -> Array:
+        return jax.vmap(fx, in_axes=1, out_axes=0)(stacked)
+
+    slotwise.traceable = True
+    slotwise.__name__ = f"windowed_{getattr(fx, '__name__', 'merge')}"
+    return slotwise
+
+
+def _check_template(metric: Union[Metric, type], kind: str) -> Metric:
+    if isinstance(metric, type):
+        if not issubclass(metric, Metric):
+            raise ValueError(f"Expected a Metric instance or subclass, got {metric!r}")
+        metric = metric()
+    if not isinstance(metric, Metric):
+        raise ValueError(f"Expected a Metric instance or subclass, got {metric!r}")
+    if isinstance(metric, (Windowed, Ema)):
+        raise ValueError(f"{kind} cannot be nested: pass the plain template metric")
+    if metric._state.lists:
+        raise TorchMetricsUserError(
+            f"{type(metric).__name__} holds list ('cat') states, which have no fixed"
+            f" per-window shape — only tensor-state metrics can be {kind.lower()}ed."
+            " Bound the state first (e.g. a binned/sketched variant) and window that."
+        )
+    if not (metric.jit_update and metric.jit_compute):
+        raise TorchMetricsUserError(
+            f"{type(metric).__name__} opts out of jit (jit_update/jit_compute=False):"
+            f" its kernels cannot trace into the fused {kind.lower()}ed program."
+        )
+    for name in metric._state.tensors:
+        if name in _BOOKKEEPING:
+            raise TorchMetricsUserError(
+                f"{type(metric).__name__} registers a state named {name!r}, which is"
+                f" reserved for {kind}'s ring bookkeeping."
+            )
+    return metric
+
+
+class Windowed(Metric):
+    """Sliding-window view of a template metric: a ring of tumbling sub-window slabs.
+
+    ``window`` is the number of sub-windows in the ring; ``advance_every`` (updates per
+    sub-window) drives the in-graph rotation — after every ``advance_every``-th update
+    the slot pointer moves on and the slab it moves into is reset to the template
+    defaults, so :meth:`compute` always covers the last ``window`` sub-windows
+    (including the live, partially-filled one). With ``advance_every=None`` the ring
+    only rotates on explicit :meth:`advance` calls (manual tumbling — note that manual
+    advances are NOT write-ahead journaled; use ``advance_every`` wherever replay
+    fidelity matters, e.g. under ``serve(journal=...)``).
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> from torchmetrics_tpu.online import Windowed
+        >>> w = Windowed(SumMetric(), window=2, advance_every=2, emit=False)
+        >>> for v in (1.0, 2.0, 4.0, 8.0, 16.0):
+        ...     w.update(np.asarray([v], np.float32))
+        >>> float(w.compute())  # last 2 sub-windows: (4+8) + 16
+        28.0
+        >>> w.windows_advanced
+        2
+    """
+
+    #: update-only protocol: opt into the AOT+donation plain-update tier
+    fast_update = True
+    #: the ring fold does not decompose under segment reductions
+    keyed_decomposable = False
+
+    def __init__(
+        self,
+        metric: Union[Metric, type],
+        window: int,
+        advance_every: Optional[int] = None,
+        emit: bool = True,
+        series: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        metric = _check_template(metric, "Windowed")
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"Windowed needs window >= 1, got {window}")
+        if advance_every is not None:
+            advance_every = int(advance_every)
+            if advance_every < 1:
+                raise ValueError(f"Windowed needs advance_every >= 1, got {advance_every}")
+        self._template = metric
+        self.window = window
+        self.advance_every = advance_every
+        self._tpl_names = tuple(metric._state.tensors)
+        self._emit = bool(emit)
+        self._series_name = series or f"online.{type(metric).__name__}.w{window}"
+        for name in self._tpl_names:
+            fx = metric._reductions[name]
+            if fx in _SUM_FX or fx in _MAX_FX or fx in _MIN_FX:
+                ring_fx: Any = fx
+            elif callable(fx) and getattr(fx, "traceable", False):
+                ring_fx = _slotwise_merge(fx)
+            else:
+                raise TorchMetricsUserError(
+                    f"{type(metric).__name__} state {name!r} has dist_reduce_fx={fx!r},"
+                    " which the window merge ladder cannot fold — windowed states need"
+                    " sum/max/min or a trace-safe callable merge (sketch states)."
+                )
+            default = metric._defaults[name]
+            ring_default = jnp.broadcast_to(default, (window,) + tuple(jnp.shape(default)))
+            self.add_state(name, ring_default, dist_reduce_fx=ring_fx)
+        # bookkeeping rides the ordinary state machinery (donated/scanned/journaled/
+        # snapshotted); all ranks advance in lockstep, so "max" is the identity sync
+        self.add_state(SLOT_STATE, jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state(COUNT_STATE, jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self.add_state(ADVANCES_STATE, jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        self._advances_seen = 0
+
+    # ------------------------------------------------------------------ properties
+    @property
+    def template(self) -> Metric:
+        """The template metric the per-slot kernels come from (never updated itself)."""
+        return self._template
+
+    @property
+    def windows_advanced(self) -> int:
+        """Total ring advances so far (host-tracked; no device read)."""
+        return self._advances_seen
+
+    @property
+    def series_name(self) -> str:
+        """The ``online.*`` live-series name advance emissions record into."""
+        return self._series_name
+
+    @property
+    def online_descriptor(self) -> Dict[str, Any]:
+        """Snapshot-blob ``window`` descriptor (validated on restore BEFORE shapes —
+        two rings of different geometry or advance cadence are not the same state even
+        when their arrays happen to agree in shape)."""
+        return {
+            "mode": "sliding",
+            "window": int(self.window),
+            "advance_every": None if self.advance_every is None else int(self.advance_every),
+            "template": type(self._template).__name__,
+        }
+
+    # ------------------------------------------------------------------ kernels
+    def _ring_row_default(self, name: str) -> Array:
+        return self._template._defaults[name]
+
+    def _update(self, state: Dict[str, Array], *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        tpl = self._template
+        slot = state[SLOT_STATE]
+        row_state = {
+            n: jax.lax.dynamic_index_in_dim(state[n], slot, axis=0, keepdims=False)
+            for n in self._tpl_names
+        }
+        out = tpl._update(dict(row_state), *args, **kwargs)
+        new: Dict[str, Array] = {}
+        for n in self._tpl_names:
+            row = out.get(n, row_state[n])
+            new[n] = jax.lax.dynamic_update_index_in_dim(state[n], row, slot, axis=0)
+        count = state[COUNT_STATE] + 1
+        advances = state[ADVANCES_STATE]
+        if self.advance_every is not None:
+            # eager in-graph advance: the moment a sub-window fills, rotate the pointer
+            # and reset the slab it rotates into (dropping the oldest sub-window), so a
+            # compute() between updates never sees a stale (window+1)-th sub-window
+            do_adv = count >= self.advance_every
+            nxt = jnp.mod(slot + 1, self.window)
+            for n in self._tpl_names:
+                cleared = jax.lax.dynamic_update_index_in_dim(
+                    new[n], self._ring_row_default(n), nxt, axis=0
+                )
+                new[n] = jnp.where(do_adv, cleared, new[n])
+            slot = jnp.where(do_adv, nxt, slot)
+            count = jnp.where(do_adv, 0, count)
+            advances = advances + do_adv.astype(advances.dtype)
+        new[SLOT_STATE] = slot
+        new[COUNT_STATE] = count
+        new[ADVANCES_STATE] = advances
+        return new
+
+    def _merge_ring(self, state: Dict[str, Array]) -> Dict[str, Array]:
+        """Fold the ``[window, ...]`` slabs into one template state — the same reduction
+        ladder the engine's forward merge and ``process_sync`` use, so empty slabs are
+        exact identities (zero sum contribution, ±inf extrema, the empty sketch)."""
+        tpl = self._template
+        merged: Dict[str, Array] = {}
+        for n in self._tpl_names:
+            fx = tpl._reductions[n]
+            v = state[n]
+            if fx in _SUM_FX:
+                d = tpl._defaults[n]
+                merged[n] = d + jnp.sum(v - d, axis=0)
+            elif fx in _MAX_FX:
+                merged[n] = jnp.max(v, axis=0)
+            elif fx in _MIN_FX:
+                merged[n] = jnp.min(v, axis=0)
+            else:  # trace-safe callable: the ring IS the stacked-merge operand
+                merged[n] = fx(v)
+        return merged
+
+    def _compute(self, state: Dict[str, Any]) -> Any:
+        return self._template._compute(self._merge_ring(state))
+
+    # ------------------------------------------------------------------ protocol
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Fold one batch into the live sub-window (rotating in-graph when it fills)."""
+        super().update(*args, **kwargs)
+        self._online_tick()
+
+    def update_batches(self, *args: Any, **kwargs: Any) -> None:
+        """Whole-stack sweep; ring rotations buried inside the scan are counted (and the
+        latest window value emitted once) on return."""
+        super().update_batches(*args, **kwargs)
+        self._online_tick()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise TorchMetricsUserError(
+            "Windowed has no per-batch forward value: the window merge is not a batch"
+            " reduction. Drive it with update(...) and read the sliding value with"
+            " compute() (or the online.* live series the advances emit)."
+        )
+
+    def advance(self) -> None:
+        """Manually close the live sub-window (only with ``advance_every=None``).
+
+        Rotates the ring in one compiled launch: pointer forward, the slab it moves
+        into reset to defaults. Manual advances are host-driven and NOT journaled —
+        a WAL replay cannot reproduce them; use ``advance_every`` for replay fidelity.
+        """
+        if self.advance_every is not None:
+            raise TorchMetricsUserError(
+                f"This Windowed metric auto-advances every {self.advance_every}"
+                " update(s); mixing manual advance() calls in would make the window"
+                " boundaries irreproducible under journal replay."
+            )
+        _dispatch.guard_buffered_pending(self, "advance")
+        if self._serve is not None:
+            self._serve.quiesce()
+        self._state.guard_readable()
+        fn = self._jit_cache.get("window_advance")
+        if fn is None:
+            names = self._tpl_names
+
+            def advance_kernel(state: Dict[str, Array]) -> Dict[str, Array]:
+                nxt = jnp.mod(state[SLOT_STATE] + 1, self.window)
+                new = dict(state)
+                for n in names:
+                    new[n] = jax.lax.dynamic_update_index_in_dim(
+                        state[n], self._ring_row_default(n), nxt, axis=0
+                    )
+                new[SLOT_STATE] = nxt
+                new[COUNT_STATE] = jnp.zeros_like(state[COUNT_STATE])
+                new[ADVANCES_STATE] = state[ADVANCES_STATE] + 1
+                return new
+
+            fn = jax.jit(obs.instrument_trace(advance_kernel, self, "window_advance"))
+            self._jit_cache["window_advance"] = fn
+        obs.count_dispatch(self)
+        out = fn(dict(self._state.tensors))
+        for name in self._state.tensors:
+            self._state.tensors[name] = out[name]
+        self._computed = None
+        self._advances_seen += 1
+        obs.telemetry.counter("online.windows_advanced").inc()
+        if self._emit:
+            self._emit_window_value()
+
+    # ------------------------------------------------------------- observability
+    def _online_tick(self) -> None:
+        """Host tail of every update: count in-graph advances (pure update-count math,
+        no device read) and emit the sliding value once per batch of new advances."""
+        if self.advance_every is None:
+            return
+        total = self._update_count // self.advance_every
+        new = total - self._advances_seen
+        if new <= 0:
+            return
+        self._advances_seen = total
+        obs.telemetry.counter("online.windows_advanced").inc(new)
+        if self._emit:
+            self._emit_window_value()
+
+    def _emit_window_value(self) -> None:
+        """One deliberate device read per advance (amortized over ``advance_every``
+        updates): the freshly-closed window's sliding value lands in the always-on
+        ``online.*`` series + gauge so dashboards see metric VALUES, not just queues."""
+        value = self._jitted_compute()(dict(self._state.tensors))
+        arr = np.asarray(value)
+        if arr.size != 1:
+            # no single dashboard number (e.g. a keyed template's per-key vector);
+            # the advance counter still fired — consumers read window_values()
+            obs.telemetry.counter("online.emit_skipped").inc()
+            return
+        v = float(arr.reshape(()))
+        obs.telemetry.series(self._series_name).record(v)
+        obs.telemetry.gauge(self._series_name).set(v)
+        obs.telemetry.counter("online.emitted").inc()
+
+    def window_state(self) -> Dict[str, Array]:
+        """Merged template state over the live ring (one fused launch; drift detectors
+        read sketch states from here — sketch-to-sketch comparison, no raw data)."""
+        _dispatch.guard_buffered_pending(self, "window_state")
+        if self._serve is not None:
+            self._serve.quiesce()
+        self._state.guard_readable()
+        fn = self._jit_cache.get("window_merge")
+        if fn is None:
+            fn = jax.jit(obs.instrument_trace(self._merge_ring, self, "window_merge"))
+            self._jit_cache["window_merge"] = fn
+        return dict(fn(dict(self._state.tensors)))
+
+    def window_values(self) -> Any:
+        """The sliding window's computed value (quiesce-safe, no sync machinery —
+        exactly what advance emission records)."""
+        _dispatch.guard_buffered_pending(self, "window_values")
+        if self._serve is not None:
+            self._serve.quiesce()
+        self._state.guard_readable()
+        return self._jitted_compute()(dict(self._state.tensors))
+
+    # ------------------------------------------------------------------ lifecycle
+    def reset(self) -> None:
+        super().reset()
+        self._advances_seen = 0
+
+    def restore(self, blob: Dict[str, Any]) -> None:
+        super().restore(blob)
+        # resync the host-side advance counter with the restored ring (the in-graph
+        # counter is the truth; emission must not replay a burst after restore)
+        self._advances_seen = int(np.asarray(self._state.tensors[ADVANCES_STATE]))
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}({type(self._template).__name__}(),"
+            f" window={self.window}, advance_every={self.advance_every})"
+        )
+
+
+class Ema(Metric):
+    """Exponentially-decayed view of a template metric: one fused multiply per update.
+
+    Every template state must be sum-reduced (``SumMetric``, ``MeanMetric``'s
+    value/weight pair, the curve family's histogram pairs, count-min): the update
+    kernel decays the state by ``decay`` before folding the batch in, so after ``t``
+    updates each batch ``i`` contributes with weight ``decay^(t-i)`` — the classic
+    exponentially-weighted accumulator, in-graph, zero host work. For a ``MeanMetric``
+    template (both states decayed identically) ``compute`` is therefore the
+    exponentially-weighted mean.
+
+    Decay is per UPDATE, deliberately not per wall-clock second: window semantics stay
+    deterministic, journal-replayable, and trace-stable (jaxlint TPU017 flags the
+    wall-clock alternative). ``emit_every=n`` records the decayed value into the
+    ``online.<Template>.ema`` live series every ``n`` updates.
+
+    Example:
+        >>> import numpy as np
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> from torchmetrics_tpu.online import Ema
+        >>> m = Ema(SumMetric(), decay=0.5)
+        >>> for v in (1.0, 1.0, 1.0):
+        ...     m.update(np.asarray([v], np.float32))
+        >>> float(m.compute())  # 0.25 + 0.5 + 1
+        1.75
+    """
+
+    fast_update = True
+    keyed_decomposable = False
+
+    def __init__(
+        self,
+        metric: Union[Metric, type],
+        decay: float = 0.99,
+        emit_every: Optional[int] = None,
+        series: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        metric = _check_template(metric, "Ema")
+        decay = float(decay)
+        if not (0.0 < decay <= 1.0):
+            raise ValueError(f"Ema needs decay in (0, 1], got {decay}")
+        if emit_every is not None:
+            emit_every = int(emit_every)
+            if emit_every < 1:
+                raise ValueError(f"Ema needs emit_every >= 1, got {emit_every}")
+        for name, fx in metric._reductions.items():
+            if fx not in _SUM_FX:
+                raise TorchMetricsUserError(
+                    f"{type(metric).__name__} state {name!r} has dist_reduce_fx={fx!r};"
+                    " EMA decay is only well-defined for sum-reduced states (decaying"
+                    " an extremum or a sketch has no exponential-weighting meaning)."
+                    " Use Windowed for bounded-horizon semantics instead."
+                )
+        self._template = metric
+        self.decay = decay
+        self.emit_every = emit_every
+        self._tpl_names = tuple(metric._state.tensors)
+        self._series_name = series or f"online.{type(metric).__name__}.ema"
+        self._emitted_at = 0
+        for name in self._tpl_names:
+            self.add_state(
+                name, metric._defaults[name], dist_reduce_fx=metric._reductions[name]
+            )
+
+    @property
+    def template(self) -> Metric:
+        return self._template
+
+    @property
+    def series_name(self) -> str:
+        return self._series_name
+
+    @property
+    def online_descriptor(self) -> Dict[str, Any]:
+        """Snapshot-blob ``window`` descriptor (decay cadence is part of the state's
+        meaning: restoring a 0.9-decay blob into a 0.99-decay metric is wrong even
+        though every array shape matches)."""
+        return {
+            "mode": "ema",
+            "decay": float(self.decay),
+            "template": type(self._template).__name__,
+        }
+
+    def _update(self, state: Dict[str, Array], *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        tpl = self._template
+        decayed = {}
+        for n in self._tpl_names:
+            d = tpl._defaults[n]
+            # sum states: default + decay·contribution (defaults are typically zero,
+            # but keeping the affine form exact covers custom non-zero sum defaults)
+            decayed[n] = d + self.decay * (state[n] - d)
+        out = tpl._update(decayed, *args, **kwargs)
+        return {n: out.get(n, decayed[n]) for n in self._tpl_names}
+
+    def _compute(self, state: Dict[str, Any]) -> Any:
+        return self._template._compute({n: state[n] for n in self._tpl_names})
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        super().update(*args, **kwargs)
+        self._online_tick()
+
+    def update_batches(self, *args: Any, **kwargs: Any) -> None:
+        super().update_batches(*args, **kwargs)
+        self._online_tick()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise TorchMetricsUserError(
+            "Ema has no per-batch forward value: the decayed merge is not the engine's"
+            " batch reduction. Drive it with update(...) and read compute()."
+        )
+
+    def _online_tick(self) -> None:
+        if self.emit_every is None:
+            return
+        due = self._update_count // self.emit_every
+        if due <= self._emitted_at:
+            return
+        self._emitted_at = due
+        value = self._jitted_compute()(dict(self._state.tensors))
+        arr = np.asarray(value)
+        if arr.size != 1:
+            obs.telemetry.counter("online.emit_skipped").inc()
+            return
+        v = float(arr.reshape(()))
+        obs.telemetry.series(self._series_name).record(v)
+        obs.telemetry.gauge(self._series_name).set(v)
+        obs.telemetry.counter("online.emitted").inc()
+
+    def reset(self) -> None:
+        super().reset()
+        self._emitted_at = 0
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({type(self._template).__name__}(), decay={self.decay})"

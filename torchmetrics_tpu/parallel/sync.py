@@ -513,7 +513,10 @@ def _bounded_gather(
     last_error: Optional[BaseException] = None
     prev_pause = opts.backoff_s
     while True:
-        remaining = deadline - time.monotonic()
+        # deadline arithmetic, not metric semantics: the clock decides when to STOP
+        # waiting on a straggler, never which batch lands where (values are identical
+        # on every timing path — degraded mode is flagged, not silent)
+        remaining = deadline - time.monotonic()  # jaxlint: disable=TPU017
         if remaining <= 0:
             raise SyncTimeoutError(
                 f"sync of state {state_name!r} exhausted its {opts.timeout_s:g}s deadline"
@@ -559,7 +562,7 @@ def _bounded_gather(
         else:
             pause = opts.backoff_s * (2 ** (attempt - 1))
         prev_pause = pause
-        pause = min(pause, max(0.0, deadline - time.monotonic()))
+        pause = min(pause, max(0.0, deadline - time.monotonic()))  # jaxlint: disable=TPU017 - deadline clamp, not semantics
         if pause > 0:
             time.sleep(pause)
 
@@ -887,7 +890,9 @@ def process_sync(
     if world > 1 and takes_ranks:
         gather_group, _ = ledger.gather_group(world)
     quorum_k = quorum_threshold(opts.quorum, world)
-    deadline = time.monotonic() + opts.timeout_s if opts.bounded else 0.0
+    # timeout anchor for the bounded-sync deadline: fault-tolerance plumbing (when to
+    # give up on a rank), never a value/window boundary
+    deadline = time.monotonic() + opts.timeout_s if opts.bounded else 0.0  # jaxlint: disable=TPU017
     degraded: List[str] = []
     quorum_states: List[str] = []
     responding: Dict[str, Tuple[int, ...]] = {}

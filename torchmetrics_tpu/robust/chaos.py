@@ -1143,6 +1143,152 @@ def scenario_serve_queue_overflow(
     return detail
 
 
+def scenario_online_window_preemption(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Windowed-metric preemption mid-overlap → ring, history, and detector recovery.
+
+    A sliding-window metric (``torchmetrics_tpu.online.Windowed`` — plain, keyed, and
+    sharded variants) serves an async stream with a write-ahead journal at enqueue;
+    part of the stream commits, the drain is held, more batches enter the window, and
+    the engine is dropped cold mid-overlap. A fresh instance recovers ``snapshot +
+    replay(journal)`` and finishes the stream. Because window advances are a pure
+    function of the update count (in-graph rotation, no wall clock — the property
+    jaxlint TPU017 defends), the recovered run must be bit-identical in THREE layers:
+
+    - the **window ring** — every state buffer byte-for-byte, including the
+      slot/count/advance bookkeeping scalars;
+    - the **per-window history** — the sliding values the continuation emits match
+      the uninterrupted run's advance-for-advance;
+    - the **drift-detector state** — an EWMA control band fed the two runs' value
+      histories lands on identical (float-exact) mean/var/n.
+
+    Templates that cannot be windowed (list/"cat" states) fall back to a pinned
+    ``MeanMetric`` so every matrix cell still exercises the ring.
+    """
+    del via  # the windowed protocol is update-only (forward raises by contract)
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.online import EwmaBand, Windowed
+    from torchmetrics_tpu.parallel.mesh import MeshContext
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.serve import ServeOptions
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    window, every = 3, 2
+    n_batches = max(8, n_batches)
+    preempt = rng.randrange(2, n_batches - 1)
+    try:
+        Windowed(factory(), window=window, advance_every=every, emit=False)
+        plain_tpl: Callable[[], Any] = factory
+        substituted = False
+    except (TorchMetricsUserError, ValueError):
+        plain_tpl = MeanMetric  # unwindowable template (cat state): pin a windowable one
+        substituted = True
+    ctx = MeshContext()
+    n_keys = 4
+    keyed_batches = []
+    for _ in range(n_batches):
+        ids = np.asarray([rng.randrange(n_keys) for _ in range(5)], np.int32)
+        vals = np.asarray([float(rng.randint(0, 9)) for _ in range(5)], np.float32)
+        keyed_batches.append((ids, vals))
+    variants: List[Tuple[str, Callable[[], Any], List[Tuple[Any, ...]]]] = [
+        ("plain",
+         lambda: Windowed(plain_tpl(), window=window, advance_every=every, emit=False),
+         _seeded_batches(rng, n_batches)),
+        ("keyed",
+         lambda: Windowed(KeyedMetric(SumMetric, n_keys), window=window,
+                          advance_every=every, emit=False),
+         keyed_batches),
+        ("sharded",
+         lambda: Windowed(plain_tpl(), window=window, advance_every=every,
+                          emit=False).shard(ctx),
+         _seeded_batches(rng, n_batches)),
+    ]
+
+    def _drive_and_watch(m: Any, batches: List[Tuple[Any, ...]]) -> List[bytes]:
+        """Synchronously apply ``batches``, capturing the sliding value at every ring
+        advance — the per-window history an ``online.*`` series would have seen."""
+        history: List[bytes] = []
+        seen = m.windows_advanced
+        for b in batches:
+            m.update(*b)
+            if m.windows_advanced > seen:
+                seen = m.windows_advanced
+                history.append(np.asarray(m.window_values()).tobytes())
+        return history
+
+    detail: Dict[str, Any] = {
+        "preempt_step": preempt,
+        "window": window,
+        "advance_every": every,
+        "template_substituted": substituted,
+    }
+    passed = True
+    for name, make, batches in variants:
+        jdir = f"{workdir}/online-preempt-{name}"
+        m = make()
+        eng = m.serve(ServeOptions(max_inflight=64), journal=_journal.Journal(jdir))
+        split = max(1, (preempt + 1) // 2)
+        for i in range(split):
+            m.update_async(*batches[i])
+        eng.quiesce()  # the prefix is committed ring state
+        eng.pause()  # hold the drain: the rest stays IN the overlap window
+        for i in range(split, preempt + 1):
+            m.update_async(*batches[i])
+        inj = PreemptMidOverlap()
+        dropped = inj.strike(m)  # the process dies here; the WAL is the only survivor
+        fresh = make()
+        recovery = _journal.recover(fresh, jdir)
+        obs.telemetry.counter("robust.recovered").inc()
+        continuation = _drive_and_watch(fresh, batches[preempt + 1:])
+        ref = make()
+        ref_history = _drive_and_watch(ref, batches)
+        # layer 1: the ring itself — every buffer byte-identical, bookkeeping included
+        ring_identical = all(
+            np.asarray(fresh._state.tensors[n]).tobytes()
+            == np.asarray(ref._state.tensors[n]).tobytes()
+            for n in fresh._state.tensors
+        )
+        value_identical = _identical(fresh.compute(), ref.compute())
+        # layer 2: per-window history — the continuation's advance values must equal
+        # the uninterrupted run's trailing advances, advance-for-advance
+        history_identical = (
+            continuation == ref_history[len(ref_history) - len(continuation):]
+            if continuation else True
+        )
+        # layer 3: detector state — the EWMA band over both histories agrees exactly
+        # (scalar windows only; a keyed ring emits per-key vectors, covered by layer 2)
+        det_identical = True
+        if ref_history and np.frombuffer(ref_history[0], np.float32).size == 1:
+            det_ref, det_rec = EwmaBand(warmup=1), EwmaBand(warmup=1)
+            recovered_history = (
+                ref_history[: len(ref_history) - len(continuation)] + continuation
+            )
+            for h in ref_history:
+                det_ref.observe(float(np.frombuffer(h, np.float32)[0]))
+            for h in recovered_history:
+                det_rec.observe(float(np.frombuffer(h, np.float32)[0]))
+            det_identical = det_ref.state() == det_rec.state()
+        ok = bool(
+            ring_identical and value_identical and history_identical and det_identical
+            and dropped > 0 and recovery["replayed"] == preempt + 1
+            and fresh.windows_advanced == ref.windows_advanced
+        )
+        passed = passed and ok
+        detail[name] = {
+            "bit_identical": value_identical,
+            "ring_identical": ring_identical,
+            "history_identical": history_identical,
+            "detector_identical": det_identical,
+            "dropped_in_window": dropped,
+            "replayed": recovery["replayed"],
+            "windows_advanced": fresh.windows_advanced,
+        }
+    detail["passed"] = passed
+    return detail
+
+
 class ChaosMatrix:
     """Seeded sweep of composite multi-fault scenarios (``make chaos-matrix``).
 
@@ -1165,6 +1311,7 @@ class ChaosMatrix:
         "serve_preempt_mid_overlap": scenario_serve_preempt_mid_overlap,
         "serve_drain_death": scenario_serve_drain_death,
         "serve_queue_overflow": scenario_serve_queue_overflow,
+        "online_window_preemption": scenario_online_window_preemption,
     }
 
     def __init__(

@@ -115,6 +115,9 @@ def snapshot_metric(metric: Any) -> Dict[str, Any]:
     keys = _keyed_descriptor(metric)
     if keys is not None:
         blob["keys"] = keys
+    window = _window_descriptor(metric)
+    if window is not None:
+        blob["window"] = window
     shard = _shard_descriptor(metric)
     if shard is not None:
         blob["sharding"] = shard
@@ -176,6 +179,22 @@ def _keyed_descriptor(metric: Any) -> Any:
     }
 
 
+def _window_descriptor(metric: Any) -> Any:
+    """Online-window descriptor (``torchmetrics_tpu.online``), else None.
+
+    The ring payload itself rides the ordinary ``tensors`` dict (``[window, ...]``
+    slabs + the slot/count/advance bookkeeping scalars, CRC and all); the descriptor
+    pins the window SEMANTICS — geometry, advance cadence, sliding-vs-EMA mode,
+    template class — so a blob can never be restored across window shapes. Validated
+    BEFORE the shape check: a ring of the same array shapes but a different
+    ``advance_every`` is a different state, and must fail loudly.
+    """
+    desc = getattr(metric, "online_descriptor", None)
+    if desc is None:
+        return None
+    return dict(desc)
+
+
 def _validate_blob(metric: Any, blob: Any) -> None:
     if not isinstance(blob, dict) or blob.get("format") not in (FORMAT,):
         raise SnapshotError(
@@ -230,6 +249,21 @@ def _validate_blob(metric: Any, blob: Any) -> None:
             raise SnapshotError(
                 f"Snapshot keys were accumulated by template {keys.get('template')!r},"
                 f" metric's template is {expected_keys['template']!r}"
+            )
+    expected_window = _window_descriptor(metric)
+    if expected_window is not None:
+        window = blob.get("window")
+        if not isinstance(window, dict):
+            raise SnapshotError(
+                f"Snapshot has no window descriptor but {type(metric).__name__} is an"
+                f" online-window metric ({expected_window['mode']}) — the blob was"
+                " taken from a plain (or pre-window) metric."
+            )
+        if window != expected_window:
+            raise SnapshotError(
+                f"Snapshot window descriptor {window!r} does not match the metric's"
+                f" {expected_window!r} — rings of different geometry, advance cadence,"
+                " or decay are not the same state; refusing to restore."
             )
     expected_sketch = _sketch_descriptor(metric)
     if expected_sketch is not None:

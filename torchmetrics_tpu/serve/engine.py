@@ -138,6 +138,7 @@ class IngestEngine:
         self._stats = {
             "enqueued": 0, "committed": 0, "shed": 0, "failed": 0,
             "drain_restarts": 0, "fence_breaks": 0, "backpressure_stalls": 0,
+            "online_advances": 0,
         }
 
     # ------------------------------------------------------------------ window state
@@ -396,6 +397,11 @@ class IngestEngine:
                 " window first.",
                 UserWarning,
             )
+        # windowed targets (torchmetrics_tpu.online) advance their ring in-graph as
+        # the drain applies batches (update-count ticks — deterministic under WAL
+        # replay); the host-side advance counter diff attributes those advances to
+        # the drain without any device read
+        advances_before = getattr(self.target, "windows_advanced", None)
         if len(items) == 1:
             args, kwargs = items[0][1], items[0][2]
             self.target.update(*args, **kwargs)
@@ -410,6 +416,12 @@ class IngestEngine:
                 name: jnp.stack([it[2][name] for it in items]) for name in first_kwargs
             }
             self.target.update_batches(*stacked_args, **stacked_kwargs)
+        if advances_before is not None:
+            advanced = self.target.windows_advanced - advances_before
+            if advanced > 0:
+                with self._cond:
+                    self._stats["online_advances"] += advanced
+                telemetry.counter("serve.online_advances").inc(advanced)
         gen = store.generation if store is not None else None
         self._fence = gen
         for it in items:

@@ -236,6 +236,39 @@ def kll_cdf(state: Array, xs: Array) -> Array:
     return jnp.where(n > 0, cw[idx] / jnp.maximum(n, 1.0), jnp.nan)
 
 
+def kll_ks_distance(a: Array, b: Array) -> Array:
+    """Kolmogorov–Smirnov distance between two sketched distributions.
+
+    Both CDFs are evaluated on the UNION of the two sketches' supports (the supremum
+    of |F_a − F_b| over the pooled item values equals the supremum over the reals for
+    step CDFs), so the comparison is sketch-to-sketch — O(capacity·levels), no raw
+    data — and fully traceable (fixed shapes). NaN when either sketch is empty.
+    Drives the ``online.drift`` KS detector; numpy twin parity-tested there.
+    """
+    support = jnp.sort(jnp.concatenate([a[:, :-2].reshape(-1), b[:, :-2].reshape(-1)]))
+    diff = jnp.abs(kll_cdf(a, support) - kll_cdf(b, support))
+    # +inf padding slots yield cdf 1.0 - 1.0 = 0 on both sides; NaN (empty sketch)
+    # propagates through the max as the "no evidence" signal
+    return jnp.max(diff)
+
+
+def kll_psi(a: Array, b: Array, bins: int = 10) -> Array:
+    """Population Stability Index of sketch ``b`` against reference sketch ``a``.
+
+    Bin edges are ``a``'s quantile grid (equal reference mass per bin); per-bin
+    masses come from both sketches' CDFs at those edges, epsilon-clamped so an empty
+    bin contributes a finite penalty. Traceable, O(bins + capacity·levels).
+    """
+    qs = jnp.linspace(0.0, 1.0, bins + 1)[1:-1]
+    edges = kll_quantiles(a, qs)
+    pa = jnp.diff(kll_cdf(a, edges), prepend=0.0, append=1.0)
+    pb = jnp.diff(kll_cdf(b, edges), prepend=0.0, append=1.0)
+    eps = 1e-6
+    pa = jnp.clip(pa, eps, None)
+    pb = jnp.clip(pb, eps, None)
+    return jnp.sum((pb - pa) * jnp.log(pb / pa))
+
+
 def kll_state_bytes(capacity: int = DEFAULT_CAPACITY, levels: int = DEFAULT_LEVELS) -> int:
     """Fixed state footprint in bytes (f32), independent of samples seen."""
     return levels * (capacity + 2) * 4

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke online-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke serve-smoke obs-smoke online-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -35,6 +35,16 @@ keyed-smoke:
 shard-smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --sharded --smoke > /tmp/tm_shard_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_shard_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; rep=ex['sync_bytes_per_compute_replicated']; shd=ex['sync_bytes_per_compute_sharded']; assert shd < rep, (shd, rep); bits=[v for k,v in ex.items() if k.startswith('sharded_bit_identical')]; assert bits and all(bits), ex; assert ex['lazy_reduce_fires'] <= ex['sharded_compute_epochs'] and ex['lazy_reduce_reuses'] >= 1, ex; print('shard-smoke ok: %dB sharded vs %dB allgather per compute (%.1fx), bit-identical' % (shd, rep, rep/shd))"
+
+# compressed-collective lane (docs/distributed.md "Compressed collectives"): 4-rank
+# simulated world asserting the acceptance bar — int8/bf16 modes ship strictly fewer
+# bytes than compression="none" at the pinned shapes (sketch states >= 2x saved via the
+# packed-blob fast path), exact modes (min/max/count/int/sketch-merge) BIT-identical to
+# the uncompressed sync, and sum error under error-feedback within the documented
+# block-scale bound across repeated sync epochs (no drift)
+compress-smoke:
+	python bench.py --sync-compress --smoke > /tmp/tm_compress_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_compress_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; base=ex['compress_bytes_received_none']; assert ex['compress_bytes_received_int8'] < base and ex['compress_bytes_received_bf16'] < base, ex; assert ex['compress_sketch_saved_ratio_int8'] >= 2 and ex['compress_sketch_saved_ratio_bf16'] >= 2, ex; assert ex['compress_exact_bit_identical_int8'] and ex['compress_exact_bit_identical_bf16'], ex; assert ex['compress_sum_abs_err_int8'] <= ex['compress_sum_err_bound_int8'] and ex['compress_sum_abs_err_bf16'] <= ex['compress_sum_err_bound_bf16'], ex; assert ex['compress_mean_abs_err_int8'] <= ex['compress_mean_err_bound_int8'] and ex['compress_mean_abs_err_bf16'] <= ex['compress_mean_err_bound_bf16'], ex; assert ex['compress_ef_max_err_int8'] <= ex['compress_ef_err_bound_int8'] and ex['compress_ef_max_err_bf16'] <= ex['compress_ef_err_bound_bf16'], ex; print('compress-smoke ok: int8 %dB vs none %dB per sync (%.2fx), sketch %.1fx saved, EF err %.2e <= %.2e' % (ex['compress_bytes_received_int8'], base, base/ex['compress_bytes_received_int8'], ex['compress_sketch_saved_ratio_int8'], ex['compress_ef_max_err_int8'], ex['compress_ef_err_bound_int8']))"
 
 # serving lane (docs/serving.md): tiny-N async-ingestion bench asserting the acceptance
 # bar — async completion throughput >= the synchronous loop at smoke shapes (drain-side

@@ -558,6 +558,165 @@ def sharded_main(smoke: bool) -> None:
     )
 
 
+def bench_sync_compress(n: int, world: int, epochs: int) -> dict:
+    """``--sync-compress`` scenario (docs/distributed.md "Compressed collectives"):
+    every ``SyncOptions(compression=...)`` mode over a ``world``-rank simulated mesh.
+
+    Measures, per mode at pinned shapes: (a) true wire bytes shipped/received/saved
+    for a state dict covering every codec lane (f32 sum + mean slabs, f32 max/min,
+    int32 counts, a KLL quantile sketch and a threshold-histogram pair); (b) exact-mode
+    bit-identity flags — min/max/count/int and both sketch merges must match the
+    ``compression="none"`` sync byte for byte; (c) sum/mean error vs full precision
+    within the documented block-scale bounds; (d) the sketch-blob fast path's saved
+    ratio (packed wire vs raw arrays, ≥ 2x gated); and (e) error-feedback behaviour
+    across repeated sync EPOCHS of a growing sum — the max error must stay within the
+    single-sync bound (no drift), which is the whole point of the residual store.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.parallel import compress as compress_mod
+    from torchmetrics_tpu.parallel import sync as sync_mod
+    from torchmetrics_tpu.sketch import kll
+
+    rng = np.random.RandomState(23)
+    kinds = {"q": "kll", "hist": "hist"}
+
+    def make_states() -> list:
+        states = []
+        for _ in range(world):
+            sketch = kll.kll_init(64, 16)
+            sketch = kll.kll_update(sketch, jnp.asarray(rng.randn(512).astype(np.float32)))
+            states.append({
+                "slab": jnp.asarray((rng.randn(n) * 16).astype(np.float32)),
+                "meanv": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "vmax": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "vmin": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "count": jnp.asarray(rng.randint(0, 1 << 20, size=(n,)).astype(np.int32)),
+                "q": sketch,
+                "hist": jnp.asarray(rng.randint(0, 4096, size=(2, 512)).astype(np.float32)),
+            })
+        return states
+
+    reds = {"slab": "sum", "meanv": "mean", "vmax": "max", "vmin": "min",
+            "count": "sum", "q": kll.kll_merge_stacked, "hist": "sum"}
+    states = make_states()
+    jax.block_until_ready([s["slab"] for s in states])
+    out: dict = {"compress_world": world, "compress_n": n, "compress_ef_epochs": epochs}
+    synced: dict = {}
+    exact_states = ("vmax", "vmin", "count", "q", "hist")
+    slab_max = max(float(np.max(np.abs(np.asarray(s["slab"])))) for s in states)
+    mean_max = max(float(np.max(np.abs(np.asarray(s["meanv"])))) for s in states)
+    for mode in ("none", "bf16", "int8"):
+        opts = sync_mod.SyncOptions(world=world, compression=mode)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts, sketch_kinds=kinds)
+        t0 = time.perf_counter()
+        res = sync_mod.process_sync(
+            dict(states[0]), reds, gather_fn=gather, options=opts,
+            sketch_wire=kinds, residuals={},
+        )
+        out[f"compress_sync_wall_ms_{mode}"] = round((time.perf_counter() - t0) * 1e3, 2)
+        synced[mode] = res
+        out[f"compress_bytes_shipped_{mode}"] = int(res.bytes_shipped)
+        out[f"compress_bytes_received_{mode}"] = int(res.bytes_received)
+        out[f"compress_bytes_saved_{mode}"] = int(res.bytes_saved)
+        out[f"compress_compressed_states_{mode}"] = list(res.compressed_states)
+        # sketch-blob fast path in isolation: packed wire vs the raw arrays
+        sk_states = [{k: s[k] for k in ("q", "hist")} for s in states]
+        sk_gather = sync_mod.simulate_mesh_world(sk_states, reds, opts, sketch_kinds=kinds)
+        sk = sync_mod.process_sync(
+            dict(sk_states[0]), {k: reds[k] for k in ("q", "hist")},
+            gather_fn=sk_gather, options=opts, sketch_wire=kinds,
+        )
+        raw_sk = sum(int(np.asarray(s[k]).nbytes) for s in sk_states for k in ("q", "hist"))
+        raw_sk_wire = raw_sk + int(np.asarray(sk_states[0]["q"]).nbytes
+                                   + np.asarray(sk_states[0]["hist"]).nbytes)
+        out[f"compress_sketch_wire_bytes_{mode}"] = int(sk.bytes_shipped + sk.bytes_received)
+        out[f"compress_sketch_saved_ratio_{mode}"] = round(
+            raw_sk_wire / max(1, sk.bytes_shipped + sk.bytes_received), 2
+        )
+    base = synced["none"]
+    for mode in ("bf16", "int8"):
+        res = synced[mode]
+        out[f"compress_exact_bit_identical_{mode}"] = all(
+            np.asarray(res[k]).tobytes() == np.asarray(base[k]).tobytes() for k in exact_states
+        )
+        sum_err = float(np.max(np.abs(np.asarray(res["slab"], np.float64) - np.asarray(base["slab"], np.float64))))
+        mean_err = float(np.max(np.abs(np.asarray(res["meanv"], np.float64) - np.asarray(base["meanv"], np.float64))))
+        out[f"compress_sum_abs_err_{mode}"] = sum_err
+        out[f"compress_sum_err_bound_{mode}"] = compress_mod.sum_error_bound(mode, slab_max, world)
+        out[f"compress_mean_abs_err_{mode}"] = mean_err
+        # mean over w ranks averages w per-rank quantization errors — same bound / w
+        out[f"compress_mean_err_bound_{mode}"] = compress_mod.sum_error_bound(mode, mean_max, world) / world
+
+    # error-feedback across repeated sync epochs: a growing sum, one sync per epoch,
+    # rank 0's residual store persistent (as Metric._sync_dist keeps it) — max error
+    # must stay within the single-sync bound at the FINAL magnitudes (no drift)
+    for mode in ("bf16", "int8"):
+        ef_states = [{"acc": np.zeros(n, np.float32)} for _ in range(world)]
+        ef_reds = {"acc": "sum"}
+        opts = sync_mod.SyncOptions(world=world, compression=mode)
+        gather = sync_mod.simulate_mesh_world(ef_states, ef_reds, opts)
+        store: dict = {}
+        max_err = 0.0
+        for _ in range(epochs):
+            for r in range(world):
+                ef_states[r]["acc"] = ef_states[r]["acc"] + rng.randn(n).astype(np.float32)
+            exact = np.sum([np.asarray(s["acc"], np.float64) for s in ef_states], axis=0)
+            res = sync_mod.process_sync(
+                dict(ef_states[0]), ef_reds, gather_fn=gather, options=opts, residuals=store,
+            )
+            max_err = max(max_err, float(np.max(np.abs(np.asarray(res["acc"], np.float64) - exact))))
+        acc_max = max(float(np.max(np.abs(s["acc"]))) for s in ef_states)
+        out[f"compress_ef_max_err_{mode}"] = max_err
+        out[f"compress_ef_err_bound_{mode}"] = compress_mod.sum_error_bound(mode, acc_max, world)
+    out["compress_bytes_saved_total"] = obs.telemetry.counter("sync.bytes_saved.compression").value
+    out["compress_compressed_syncs_total"] = obs.telemetry.counter("sync.compressed_syncs").value
+    return out
+
+
+def sync_compress_main(smoke: bool) -> None:
+    """``bench.py --sync-compress [--smoke]``: one JSON line with the codec numbers.
+
+    The acceptance point (``make compress-smoke``): int8/bf16 modes ship strictly fewer
+    bytes than ``compression="none"`` at the pinned shapes (sketch states ≥ 2x saved),
+    exact modes bit-identical to the uncompressed sync, and sum error under
+    error-feedback within the documented bound across repeated sync epochs.
+    """
+    if smoke:
+        n, world, epochs = 4096, 4, 4
+    else:
+        n, world, epochs = 262144, 8, 8
+    extras = bench_sync_compress(n, world=world, epochs=epochs)
+    extras.update(_contention_report())
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    none_b = extras["compress_bytes_received_none"]
+    int8_b = extras["compress_bytes_received_int8"]
+    print(
+        json.dumps(
+            {
+                "metric": "sync_compress_bytes_per_sync",
+                "value": int8_b,
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "bytes received per process_sync of the mixed state dict under"
+                    " compression='int8' (vs_baseline = compression='none' bytes /"
+                    " int8 bytes; per-mode wire bytes, exact-mode bit-identity flags,"
+                    " error-feedback drift bounds and sketch-blob ratios in extras —"
+                    " docs/distributed.md 'Compressed collectives')"
+                ),
+                "vs_baseline": round(none_b / int8_b, 2) if int8_b else None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_sketch(batch: int, n_batches: int) -> dict:
     """``--sketch`` scenario (docs/sketches.md): O(1) streaming sketch states vs the
     unbounded-cat exact mode, at pinned shapes.
@@ -1952,6 +2111,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         sharded_main(smoke)
+    elif "--sync-compress" in sys.argv:
+        # compressed-collective lane (make compress-smoke / docs/distributed.md
+        # "Compressed collectives"): smoke pins CPU via the config API like the others
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        sync_compress_main(smoke)
     elif "--serve" in sys.argv:
         # serving scenario (make serve-smoke / docs/serving.md): smoke pins CPU via the
         # config API like the other lanes; full mode probes for a healthy platform

@@ -1,0 +1,361 @@
+"""Compressed collectives: the ``SyncOptions(compression=...)`` wire codec layer.
+
+Covers the codec in isolation (``parallel/compress.py`` round trips, error bounds,
+never-bigger guard, lossless sketch packing), ``process_sync`` end-to-end over the
+codec-aware ``simulate_mesh_world`` (exact-mode bit-identity, lossy bounds, quorum over
+decoded values, error-feedback across epochs, sharded slabs, byte accounting), and the
+metric-level seams (``_sync_dist`` sketch-wire threading, the compression-keyed lazy
+reduce cache, ``_tm_last_sync`` fields). See docs/distributed.md "Compressed
+collectives".
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.parallel import compress as C
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.sketch import kll
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+
+def _warm_kll(seed: int, n: int = 700, capacity: int = 64, levels: int = 16):
+    rng = np.random.RandomState(seed)
+    state = kll.kll_init(capacity, levels)
+    return kll.kll_update(state, jnp.asarray(rng.randn(n).astype(np.float32)))
+
+
+class TestCodecRoundTrips:
+    def test_mode_validation(self):
+        assert C.validate_mode("INT8 ") == "int8"
+        assert C.validate_mode(None) == "none"
+        with pytest.raises(ValueError, match="unknown sync compression"):
+            C.validate_mode("fp4")
+        with pytest.raises(ValueError, match="unknown sync compression"):
+            sync_mod.SyncOptions(compression="zstd")
+
+    def test_bf16_round_trip_error_bound(self):
+        x = np.random.RandomState(0).randn(4096).astype(np.float32) * 100
+        blob = C.encode_array(x, "bf16")
+        assert C.is_wire(blob) and blob.nbytes < x.nbytes
+        back = C.decode(blob, x.shape, x.dtype)
+        assert np.max(np.abs(back - x)) <= np.max(np.abs(x)) * C.LOSSY_EPS["bf16"]
+
+    def test_bf16_preserves_nonfinite(self):
+        x = np.asarray([np.nan, np.inf, -np.inf, 1.5], np.float32)
+        back = C.decode(C.encode_array(x, "bf16"), x.shape, x.dtype)
+        assert np.isnan(back[0]) and np.isposinf(back[1]) and np.isneginf(back[2])
+
+    def test_int8_block_scale_error_bound(self):
+        rng = np.random.RandomState(1)
+        # wildly different block magnitudes: per-block scales must localise the error
+        x = np.concatenate([
+            rng.randn(C.BLOCK).astype(np.float32) * 1e-3,
+            rng.randn(C.BLOCK).astype(np.float32) * 1e3,
+        ])
+        blob = C.encode_array(x, "int8")
+        back = C.decode(blob, x.shape, x.dtype)
+        for b in range(2):
+            sl = slice(b * C.BLOCK, (b + 1) * C.BLOCK)
+            bound = np.max(np.abs(x[sl])) / 254.0
+            assert np.max(np.abs(back[sl] - x[sl])) <= bound + 1e-12
+
+    def test_int8_nonfinite_refuses(self):
+        x = np.asarray([1.0, np.inf], np.float32)
+        assert C.encode_array(x, "int8") is None
+
+    def test_non_f32_refuses_lossy(self):
+        assert C.encode_array(np.arange(8, dtype=np.int32), "int8") is None
+        assert C.plan_state(np.arange(8, dtype=np.int32), "sum", "int8") == "raw"
+
+    def test_kll_pack_is_lossless(self):
+        state = np.asarray(_warm_kll(2))
+        blob = C.encode_sketch(state, "kll")
+        assert blob.nbytes < state.nbytes / 2  # the padding never ships
+        back = C.decode(blob, state.shape, state.dtype)
+        assert np.array_equal(back, state)
+
+    def test_kll_invariant_violation_falls_back_verbatim(self):
+        state = np.asarray(_warm_kll(3)).copy()
+        state[0, -3] = np.nan  # a NaN inside the padding tail breaks the pack invariant
+        blob = C.encode_sketch(state, "kll")
+        back = C.decode(blob, state.shape, state.dtype)
+        assert np.array_equal(back, state, equal_nan=True)
+
+    @pytest.mark.parametrize("top,width", [(200, 1), (60000, 2), (1 << 24, 4)])
+    def test_counts_pack_narrowest_width(self, top, width):
+        rng = np.random.RandomState(4)
+        x = rng.randint(0, top, size=(2, 512)).astype(np.float32)
+        blob = C.encode_sketch(x, "hist")
+        assert blob.nbytes == C.HEADER_BYTES + x.size * width
+        assert np.array_equal(C.decode(blob, x.shape, x.dtype), x)
+
+    def test_counts_pack_nonintegral_verbatim(self):
+        x = np.asarray([[0.5, 2.0]], np.float32)
+        blob = C.encode_sketch(x, "countmin")
+        assert np.array_equal(C.decode(blob, x.shape, x.dtype), x)
+
+    def test_never_bigger_guard_ships_raw_and_clears_residual(self):
+        scalar = np.asarray(3.0, np.float32)
+        store = {"s": np.asarray(1.0, np.float32)}
+        payload, plan = C.encode_for_wire(scalar, "sum", "int8", residuals=store, key="s")
+        assert plan == "raw" and payload is scalar
+        assert "s" not in store  # raw ships exact: no quantization error to carry
+
+    def test_error_feedback_residual_bookkeeping(self):
+        x = np.random.RandomState(5).randn(1024).astype(np.float32)
+        store: dict = {}
+        blob, approx = C.encode_with_feedback(x, "int8", store, "s")
+        assert np.allclose(store["s"], x - approx)
+        # second epoch: the carried residual is folded into the next payload
+        blob2, approx2 = C.encode_with_feedback(x, "int8", store, "s")
+        assert np.allclose(store["s"], (x + (x - approx)) - approx2)
+
+
+class TestProcessSyncCompressed:
+    WORLD = 4
+
+    def _states(self, seed=7, n=4096):
+        rng = np.random.RandomState(seed)
+        states = []
+        for r in range(self.WORLD):
+            states.append({
+                "s": jnp.asarray((rng.randn(n) * 10).astype(np.float32)),
+                "m": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "mx": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "mn": jnp.asarray(rng.randn(n).astype(np.float32)),
+                "cnt": jnp.asarray(rng.randint(0, 1 << 16, n).astype(np.int32)),
+                "q": _warm_kll(seed + r),
+            })
+        reds = {"s": "sum", "m": "mean", "mx": "max", "mn": "min", "cnt": "sum",
+                "q": kll.kll_merge_stacked}
+        return states, reds, {"q": "kll"}
+
+    def _sync(self, states, reds, kinds, mode, **kw):
+        opts = sync_mod.SyncOptions(world=self.WORLD, compression=mode)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts, sketch_kinds=kinds)
+        return sync_mod.process_sync(
+            dict(states[0]), reds, gather_fn=gather, options=opts,
+            sketch_wire=kinds, **kw,
+        )
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8"])
+    def test_exact_states_bit_identical_and_lossy_within_bound(self, mode):
+        states, reds, kinds = self._states()
+        base = self._sync(states, reds, kinds, "none")
+        res = self._sync(states, reds, kinds, mode, residuals={})
+        for name in ("mx", "mn", "cnt", "q"):
+            assert np.asarray(res[name]).tobytes() == np.asarray(base[name]).tobytes(), name
+        smax = max(float(np.max(np.abs(np.asarray(s["s"])))) for s in states)
+        err = np.max(np.abs(np.asarray(res["s"], np.float64) - np.asarray(base["s"], np.float64)))
+        assert err <= C.sum_error_bound(mode, smax, self.WORLD)
+        assert res.compression == mode
+        assert "s" in res.compressed_states and "m" in res.compressed_states
+        assert res.bytes_received < base.bytes_received
+        assert res.bytes_shipped < base.bytes_shipped
+        assert res.bytes_saved > 0 and base.bytes_saved == 0
+
+    def test_none_mode_is_byte_identical_accounting(self):
+        states, reds, kinds = self._states()
+        res = self._sync(states, reds, kinds, "none")
+        assert res.compression == "none" and res.compressed_states == ()
+        raw = sum(int(np.asarray(states[0][n]).nbytes) for n in states[0])
+        assert res.bytes_shipped == raw  # raw arrays ship as-is: honest byte ledger
+
+    def test_counters_and_gauges(self):
+        states, reds, kinds = self._states()
+        c0 = obs.telemetry.counter("sync.bytes_saved.compression").value
+        s0 = obs.telemetry.counter("sync.compressed_syncs").value
+        res = self._sync(states, reds, kinds, "int8", residuals={})
+        assert obs.telemetry.counter("sync.compressed_syncs").value == s0 + 1
+        saved = obs.telemetry.counter("sync.bytes_saved.compression").value - c0
+        assert saved > 0
+        assert obs.telemetry.gauge("sync.compression.wire_bytes").value > 0
+        assert obs.telemetry.gauge("sync.compression.raw_bytes").value > \
+            obs.telemetry.gauge("sync.compression.wire_bytes").value
+        assert res.bytes_saved >= saved  # SyncedState also counts shard savings
+
+    def test_error_feedback_no_drift_across_epochs(self):
+        rng = np.random.RandomState(11)
+        states = [{"acc": np.zeros(2048, np.float32)} for _ in range(self.WORLD)]
+        reds = {"acc": "sum"}
+        opts = sync_mod.SyncOptions(world=self.WORLD, compression="int8")
+        gather = sync_mod.simulate_mesh_world(states, reds, opts)
+        store: dict = {}
+        max_err = 0.0
+        for _ in range(10):
+            for r in range(self.WORLD):
+                states[r]["acc"] = states[r]["acc"] + rng.randn(2048).astype(np.float32)
+            exact = np.sum([np.asarray(s["acc"], np.float64) for s in states], axis=0)
+            res = sync_mod.process_sync(
+                dict(states[0]), reds, gather_fn=gather, options=opts, residuals=store,
+            )
+            max_err = max(max_err, float(np.max(np.abs(np.asarray(res["acc"], np.float64) - exact))))
+        amax = max(float(np.max(np.abs(s["acc"]))) for s in states)
+        assert max_err <= C.sum_error_bound("int8", amax, self.WORLD)
+        assert store  # the residual store is live
+
+    def test_quorum_rescale_operates_on_decoded_values(self):
+        states, reds, kinds = self._states(n=2048)
+        reds = {"s": "sum"}
+        states = [{"s": s["s"]} for s in states]
+        opts = sync_mod.SyncOptions(
+            world=self.WORLD, compression="int8", timeout_s=0.05, retries=0, quorum=2,
+        )
+        inner = sync_mod.simulate_mesh_world(states, reds, opts)
+
+        def flaky(value, group=None, *, name=None, **kw):
+            full = inner(value, group, name=name, **kw)
+            raise SyncTimeoutError(
+                "rank 3 down", responses={i: full[i] for i in range(self.WORLD - 1)}
+            )
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = sync_mod.process_sync(
+                dict(states[0]), reds, gather_fn=flaky, options=opts, residuals={},
+            )
+        assert str(res.world_consistent) == "quorum"
+        k = self.WORLD - 1
+        exact = np.sum(
+            [np.asarray(states[r]["s"], np.float64) for r in range(k)], axis=0
+        ) * (self.WORLD / k)
+        smax = max(float(np.max(np.abs(np.asarray(s["s"])))) for s in states)
+        bound = C.sum_error_bound("int8", smax, self.WORLD) * (self.WORLD / k)
+        assert np.max(np.abs(np.asarray(res["s"], np.float64) - exact)) <= bound
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8"])
+    def test_sharded_slab_path_compresses(self, mode):
+        rng = np.random.RandomState(13)
+        states = [{"tbl": jnp.asarray((rng.randn(1024) * 8).astype(np.float32))}
+                  for _ in range(self.WORLD)]
+        reds = {"tbl": "sum"}
+
+        def run(m):
+            opts = sync_mod.SyncOptions(world=self.WORLD, compression=m)
+            gather = sync_mod.simulate_mesh_world(states, reds, opts)
+            return sync_mod.process_sync(
+                dict(states[0]), reds, gather_fn=gather, options=opts,
+                sharded_states=["tbl"],
+            )
+
+        base, res = run("none"), run(mode)
+        assert res.sharded_states == ("tbl",) and "tbl" in res.compressed_states
+        assert res.bytes_received < base.bytes_received
+        tmax = max(float(np.max(np.abs(np.asarray(s["tbl"])))) for s in states)
+        err = np.max(np.abs(np.asarray(res["tbl"], np.float64) - np.asarray(base["tbl"], np.float64)))
+        # two quantization stages (slice exchange + assembly): twice the one-shot bound
+        assert err <= 2 * C.sum_error_bound(mode, tmax, self.WORLD)
+
+    def test_cat_list_states_never_compress(self):
+        states = [
+            {"c": [jnp.asarray(np.arange(16, dtype=np.float32) + r)]}
+            for r in range(self.WORLD)
+        ]
+        reds = {"c": "cat"}
+        base = self._sync_cat(states, reds, "none")
+        res = self._sync_cat(states, reds, "int8")
+        assert np.asarray(res).tobytes() == np.asarray(base).tobytes()
+
+    def _sync_cat(self, states, reds, mode):
+        opts = sync_mod.SyncOptions(world=self.WORLD, compression=mode)
+        sim_states = [
+            {"c": jnp.concatenate([jnp.atleast_1d(e) for e in s["c"]])} for s in states
+        ]
+        gather = sync_mod.simulate_mesh_world(sim_states, reds, opts)
+        out = sync_mod.process_sync(dict(states[0]), reds, gather_fn=gather, options=opts)
+        return jnp.concatenate([jnp.atleast_1d(e) for e in out["c"]])
+
+    def test_compression_unaware_transport_degrades_to_raw(self):
+        # a gather that ignores the payload and answers with raw rank values: the sync
+        # must still converge (entries pass through undecoded) — just uncompressed
+        states, reds, kinds = self._states(n=512)
+        reds = {"mx": "max"}
+        vals = [s["mx"] for s in states]
+
+        def naive(value, group=None, *, name=None):
+            return list(vals)
+
+        opts = sync_mod.SyncOptions(world=self.WORLD, compression="int8")
+        res = sync_mod.process_sync({"mx": vals[0]}, reds, gather_fn=naive, options=opts)
+        expected = np.max(np.stack([np.asarray(v) for v in vals]), axis=0)
+        assert np.array_equal(np.asarray(res["mx"]), expected)
+
+
+class TestMetricLevelSeams:
+    WORLD = 3
+
+    def _armed_quantile(self, mode):
+        from torchmetrics_tpu.sketch import StreamingQuantile
+        from torchmetrics_tpu.sketch.state import sketch_wire_kinds
+
+        rng = np.random.RandomState(17)
+        ms = [StreamingQuantile(q=0.5, capacity=64, levels=16) for _ in range(self.WORLD)]
+        for m in ms:
+            for _ in range(3):
+                m.update(jnp.asarray(rng.randn(400).astype(np.float32)))
+        m0 = ms[0]
+        states = [dict(m._state.tensors) for m in ms]
+        reds = {n: m0._reductions[n] for n in states[0]}
+        opts = sync_mod.SyncOptions(world=self.WORLD, compression=mode)
+        gather = sync_mod.simulate_mesh_world(
+            states, reds, opts, sketch_kinds=sketch_wire_kinds(m0) or {}
+        )
+        m0.dist_sync_fn = gather
+        m0.distributed_available_fn = lambda: True
+        m0.sync_options = opts
+        m0.compute_with_cache = False
+        return m0
+
+    def test_sketch_metric_sync_bit_identical_and_tagged(self):
+        v_none = np.asarray(self._armed_quantile("none").compute())
+        m = self._armed_quantile("int8")
+        v_int8 = np.asarray(m.compute())
+        assert np.array_equal(v_none, v_int8)  # lossless sketch wire
+        last = m._tm_last_sync
+        assert last["compression"] == "int8"
+        assert last["compressed_states"] and last["bytes_saved"] > 0
+
+    def test_env_knob_reaches_options(self, monkeypatch):
+        monkeypatch.setenv(sync_mod.ENV_SYNC_COMPRESSION, "bf16")
+        assert sync_mod.sync_options_from_env().compression == "bf16"
+        monkeypatch.setenv(sync_mod.ENV_SYNC_COMPRESSION, "garbage")
+        assert sync_mod.sync_options_from_env().compression == "none"
+
+    def test_lazy_reduce_cache_keyed_by_compression_mode(self):
+        pytest.importorskip("jax")
+        import jax
+
+        if jax.device_count() < 2:
+            pytest.skip("needs a multi-device host mesh")
+        from torchmetrics_tpu.aggregation import SumMetric
+        from torchmetrics_tpu.keyed import KeyedMetric
+        from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned
+
+        n_keys = 512
+        rng = np.random.RandomState(19)
+        ranks = [KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys) for _ in range(2)]
+        for m in ranks:
+            ids = jnp.asarray(rng.randint(0, n_keys, 64).astype(np.int32))
+            vals = jnp.asarray(rng.randint(0, 9, 64).astype(np.float32))
+            m.update(ids, vals)  # jaxlint: disable=TPU010 — rank replicas, not per-key streams
+        km0 = ranks[0].shard(MeshContext())
+        assert any(is_partitioned(s) for s in km0.shard_specs.values())
+        states = [dict(km0._state.tensors), dict(ranks[1]._state.tensors)]
+        reds = {n: km0._reductions[n] for n in states[0]}
+        fires = obs.telemetry.counter("sync.lazy_reduce.fires")
+        km0.distributed_available_fn = lambda: True
+        km0.compute_with_cache = False
+        f0 = fires.value
+        for mode in ("int8", "int8", "none"):
+            opts = sync_mod.SyncOptions(world=2, compression=mode)
+            gather = sync_mod.simulate_mesh_world(states, reds, opts)
+            km0.dist_sync_fn = gather
+            km0.sync_options = opts
+            km0.compute()
+        # same mode reuses the cached reduce; switching modes must refire
+        assert fires.value - f0 == 2

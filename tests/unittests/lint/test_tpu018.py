@@ -1,0 +1,119 @@
+"""TPU018: lossy sync compression beside a non-error-feedback-safe callable reducer."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+
+def _tpu018(source: str, path: str = "pkg/module.py"):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU018"]
+
+
+BAD = """
+from torchmetrics_tpu.parallel.sync import SyncOptions
+
+def weird_fold(stacked):
+    return stacked.prod(0)
+
+class ProductMetric:
+    def __init__(self):
+        self.add_state("v", init, dist_reduce_fx=weird_fold)
+        self.sync_options = SyncOptions(compression="int8")
+"""
+
+CLEAN = """
+from torchmetrics_tpu.parallel.sync import SyncOptions
+from torchmetrics_tpu.sketch import kll_merge_stacked
+
+def safe_fold(stacked):
+    return stacked.sum(0)
+safe_fold.traceable = True
+
+class SafeMetric:
+    def __init__(self):
+        self.add_state("v", init, dist_reduce_fx=safe_fold)
+        self.add_state("q", init2, dist_reduce_fx=kll_merge_stacked)
+        self.sync_options = SyncOptions(compression="int8")
+
+class UncompressedMetric:
+    def __init__(self):
+        self.add_state("w", init, dist_reduce_fx=plain_fold)
+        self.sync_options = SyncOptions(compression="none")
+"""
+
+
+class TestTpu018:
+    def test_bad_fixture_flagged_at_construction_site(self):
+        findings = _tpu018(BAD)
+        assert len(findings) == 1
+        f = findings[0]
+        assert "SyncOptions" in f.snippet or "compression" in f.snippet
+        assert "weird_fold" in f.message and "'v'" in f.message
+        assert "int8" in f.message
+
+    def test_clean_fixture_silent(self):
+        # traceable-marked callables, sketch-imported merges, and compression="none"
+        # are all inside the codec's exactness lanes
+        assert _tpu018(CLEAN) == []
+
+    def test_bf16_literal_also_flagged(self):
+        src = BAD.replace('"int8"', '"bf16"')
+        assert len(_tpu018(src)) == 1
+
+    def test_named_reductions_never_flag(self):
+        src = """
+from torchmetrics_tpu.parallel.sync import SyncOptions
+
+class M:
+    def __init__(self):
+        self.add_state("a", init, dist_reduce_fx="sum")
+        self.add_state("b", init, dist_reduce_fx="cat")
+        self.add_state("c", init, dist_reduce_fx=None)
+        self.sync_options = SyncOptions(compression="int8")
+"""
+        assert _tpu018(src) == []
+
+    def test_lambda_reducer_flagged(self):
+        src = """
+from torchmetrics_tpu.parallel.sync import SyncOptions
+
+class M:
+    def __init__(self):
+        self.add_state("v", init, dist_reduce_fx=lambda s: s.prod(0))
+        self.opts = SyncOptions(compression="int8")
+"""
+        findings = _tpu018(src)
+        assert len(findings) == 1 and "<lambda>" in findings[0].message
+
+    def test_cross_class_pairing_does_not_leak(self):
+        # class A's lossy options must not indict class B's contract-less reducer
+        src = """
+from torchmetrics_tpu.parallel.sync import SyncOptions
+
+class A:
+    def __init__(self):
+        self.add_state("a", init, dist_reduce_fx="sum")
+        self.opts = SyncOptions(compression="int8")
+
+class B:
+    def __init__(self):
+        self.add_state("b", init, dist_reduce_fx=odd_fold)
+        self.opts = SyncOptions(compression="none")
+"""
+        assert _tpu018(src) == []
+
+    def test_variable_mode_out_of_scope(self):
+        src = BAD.replace('compression="int8"', "compression=mode")
+        assert _tpu018(src) == []
+
+    def test_suppression_comment(self):
+        src = BAD.replace(
+            'SyncOptions(compression="int8")',
+            'SyncOptions(compression="int8")  # jaxlint: disable=TPU018',
+        )
+        assert _tpu018(src) == []
+
+    def test_rule_registered_in_catalog_meta(self):
+        meta = RULE_META["TPU018"]
+        assert meta["severity"] == "warning"
+        assert "compression" in meta["summary"]

@@ -48,6 +48,9 @@ TPU015    host-blocking call (``.block_until_ready()`` / ``jax.device_get`` /
 TPU017    wall-clock read (``time.time()``/``time.monotonic()``/
           ``datetime.now()``) inside jit-traced code or a per-step hot path
           (non-reproducible boundaries + trace-time freeze)
+TPU018    lossy sync compression (``SyncOptions(compression="bf16"|"int8")``)
+          configured next to a metric state whose callable ``dist_reduce_fx``
+          carries no traceable/merge contract (not error-feedback safe)
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -196,6 +199,17 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "fix": "gate logic on a step/update COUNT (deterministic, journal-replayable);"
                " pass timestamps in as inputs; time.perf_counter stays fine for"
                " pure measurement that never feeds control flow",
+    },
+    "TPU018": {
+        "severity": "warning",
+        "summary": "lossy sync compression configured beside a callable dist_reduce_fx"
+                   " without a traceable/merge contract (not error-feedback safe)",
+        "example": "self.add_state('v', init, dist_reduce_fx=my_fold)\n"
+                   "SyncOptions(compression='int8')",
+        "fix": "mark the reducer's merge contract (fx.traceable = True — a mergeable"
+               " fold over stacked states, exact on decoded wire values), register the"
+               " state as a sketch (packed lossless wire), or keep compression='none'"
+               " for this metric",
     },
 }
 
@@ -2221,10 +2235,132 @@ def _rule_tpu017(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU018 helpers
+#: lossy wire modes of SyncOptions(compression=...) (parallel/compress.py MODES minus "none")
+_TPU018_LOSSY_MODES = {"bf16", "int8"}
+
+
+def _tpu018_traceable_names(tree: ast.Module) -> Set[str]:
+    """Names the module marks with the merge contract (``<name>.traceable = True``)."""
+    marked: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant) and node.value.value is True):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and t.attr == "traceable" and isinstance(t.value, ast.Name):
+                marked.add(t.value.id)
+    return marked
+
+
+def _tpu018_sketch_imports(tree: ast.Module) -> Set[str]:
+    """Local names imported from the sketch subsystem (merge-contract by provenance)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and "sketch" in (node.module or ""):
+            names.update(a.asname or a.name for a in node.names)
+        elif isinstance(node, ast.Import):
+            names.update(
+                (a.asname or a.name.split(".")[0])
+                for a in node.names
+                if "sketch" in a.name
+            )
+    return names
+
+
+def _rule_tpu018(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Lossy sync compression configured beside a non-error-feedback-safe reduction.
+
+    The compressed-collective codec keeps its exactness promises *structurally*
+    (docs/distributed.md "Compressed collectives"): named reductions either stay raw
+    on the wire (min/max/cat, int dtypes) or quantize under error feedback
+    (sum/mean), and sketch merges ship LOSSLESS packed blobs because their callables
+    declare the merge contract (``fx.traceable = True`` — a commutative fold over
+    stacked states, exact on decoded values). A *plain* callable ``dist_reduce_fx``
+    sits outside every one of those lanes: ``process_sync`` ships its state raw, so
+    ``SyncOptions(compression="bf16"|"int8")`` quietly buys no bytes for that state —
+    and a fork that widened the lossy lane to callables would fold quantization error
+    through an arbitrary reducer with no residual to absorb it. The rule warns at the
+    ``SyncOptions`` construction site, naming the contract-less reducer.
+
+    Boundary — per-module, like TPU014: a callable is SAFE when the module marks
+    ``fx.traceable = True``, imports it from the sketch subsystem, or registers its
+    state through ``register_sketch_state``/``kll_spec``/``hist_spec``/
+    ``countmin_spec``. Literal ``compression=`` strings only; modes threaded through
+    variables or the env knob are out of scope (under-reporting beats noise).
+    """
+    marked = _tpu018_traceable_names(model.tree)
+    sketchy = _tpu018_sketch_imports(model.tree)
+
+    def _owning_class(target: ast.AST) -> Optional[str]:
+        for cname, cnode in model.class_nodes.items():
+            if any(sub is target for sub in ast.walk(cnode)):
+                return cname
+        return None
+
+    # (owning class or None, state name, fx display name) — pairing is class-scoped:
+    # a lossy SyncOptions in class A must not indict class B's reducer
+    unsafe: List[Tuple[Optional[str], str, str]] = []
+    for node in ast.walk(model.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr != "add_state":
+            continue
+        fx: Optional[ast.AST] = node.args[2] if len(node.args) >= 3 else None
+        for kw in node.keywords:
+            if kw.arg == "dist_reduce_fx":
+                fx = kw.value
+        if fx is None or (isinstance(fx, ast.Constant) and (fx.value is None or isinstance(fx.value, str))):
+            continue  # named reductions and None are codec-safe by construction
+        if isinstance(fx, ast.Lambda):
+            display = "<lambda>"
+        else:
+            dotted = _dotted(fx)
+            if dotted is None:
+                continue
+            if dotted[0] in sketchy or dotted[-1] in marked or dotted[0] in marked:
+                continue
+            display = ".".join(dotted)
+        state_name = _const_value(node.args[0]) if node.args else None
+        unsafe.append((_owning_class(node), str(state_name), display))
+    if not unsafe:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call) or _final_name(node.func) != "SyncOptions":
+            continue
+        mode: Optional[str] = None
+        for kw in node.keywords:
+            if kw.arg == "compression" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if mode not in _TPU018_LOSSY_MODES:
+            continue
+        site_cls = _owning_class(node)
+        relevant = [
+            u for u in unsafe
+            if site_cls is None or u[0] is None or u[0] == site_cls
+        ]
+        if not relevant:
+            continue
+        _cls, state_name, display = relevant[0]
+        out.append(_finding(
+            "TPU018", path, node, lines,
+            f"lossy sync compression {mode!r} configured in a module whose state"
+            f" {state_name!r} reduces through callable {display!r} with no"
+            " traceable/merge contract: the codec ships that state RAW (no bytes"
+            " saved), and a lossy lane over an arbitrary reducer would have no"
+            " error-feedback residual to absorb quantization drift — mark the merge"
+            " contract (fx.traceable = True), register the state as a sketch, or"
+            " keep compression='none' here",
+        ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
-    _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017,
+    _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017, _rule_tpu018,
 )
 
 

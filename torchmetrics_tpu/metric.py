@@ -46,7 +46,13 @@ from torchmetrics_tpu import obs
 from torchmetrics_tpu.obs import profiler as _profiler
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.parallel import mesh as _mesh
-from torchmetrics_tpu.parallel.sync import FULL, SyncOptions, as_consistency, process_sync
+from torchmetrics_tpu.parallel.sync import (
+    FULL,
+    SyncOptions,
+    as_consistency,
+    process_sync,
+    sync_options_from_env,
+)
 from torchmetrics_tpu.robust import checkpoint as _checkpoint
 from torchmetrics_tpu.robust import guardrails as _guardrails
 from torchmetrics_tpu.utils.checks import is_traced
@@ -1237,8 +1243,20 @@ class Metric:
         sharded = frozenset(
             n for n, s in (specs or {}).items() if _mesh.is_partitioned(s)
         )
+        # compressed-collective seams (docs/distributed.md "Compressed collectives"):
+        # sketch states advertise their wire descriptors so the codec ships packed
+        # blobs, and the per-metric error-feedback residuals live host-side here so
+        # repeated syncs of a sum state never drift
+        opts = self.sync_options if self.sync_options is not None else sync_options_from_env()
+        mode = getattr(opts, "compression", "none")
+        sketch_wire = {
+            n: spec.kind for n, spec in (self.__dict__.get("_sketch_specs") or {}).items()
+        } or None
+        residuals = self.__dict__.setdefault("_sync_ef_residuals", {})
         if sharded:
-            epoch = (self._update_count, self._state.generation)
+            # the cache is keyed by compression mode too: a mode switch must re-reduce
+            # (a cached int8 result is not the none-mode result, and vice versa)
+            epoch = (self._update_count, self._state.generation, mode)
             cached = self.__dict__.get("_lazy_sync_cache")
             if (
                 cached is not None and cached[0] == epoch
@@ -1250,6 +1268,7 @@ class Metric:
                 synced = process_sync(
                     self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
                     group=process_group, options=self.sync_options, sharded_states=sharded,
+                    sketch_wire=sketch_wire, residuals=residuals,
                 )
                 self._lazy_sync_cache = (epoch, synced)
                 obs.telemetry.counter("sync.lazy_reduce.fires").inc()
@@ -1257,6 +1276,7 @@ class Metric:
             synced = process_sync(
                 self._state.snapshot(), self._reductions, gather_fn=dist_sync_fn,
                 group=process_group, options=self.sync_options,
+                sketch_wire=sketch_wire, residuals=residuals,
             )
         # a bounded sync may have degraded to quorum or local-only state; a subsequent
         # fully successful sync restores "full" and clears the stale flags below — the
@@ -1271,7 +1291,10 @@ class Metric:
             "gather_latency_us": dict(getattr(synced, "gather_latency_us", {}) or {}),
             "bytes_shipped": int(getattr(synced, "bytes_shipped", 0) or 0),
             "bytes_received": int(getattr(synced, "bytes_received", 0) or 0),
+            "bytes_saved": int(getattr(synced, "bytes_saved", 0) or 0),
             "sharded_states": tuple(getattr(synced, "sharded_states", ()) or ()),
+            "compression": str(getattr(synced, "compression", "none") or "none"),
+            "compressed_states": tuple(getattr(synced, "compressed_states", ()) or ()),
         }
         for name in list(self._state.tensors):
             self._state.tensors[name] = synced[name]
@@ -1415,6 +1438,9 @@ class Metric:
         self._is_synced = False
         self._world_consistent = FULL
         self._lazy_sync_cache = None  # the reduce-once cache is per update epoch
+        # error-feedback residuals belong to the accumulation epoch that produced
+        # them: a reset state has nothing to carry (docs/distributed.md "Error feedback")
+        self.__dict__.pop("_sync_ef_residuals", None)
 
     # -------------------------------------------------------------- fault tolerance
     @property

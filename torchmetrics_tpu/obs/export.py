@@ -108,6 +108,11 @@ _ALWAYS_TABULATED = (
     "sync.bytes_saved",
     "sync.lazy_reduce.fires",
     "sync.lazy_reduce.reuses",
+    # compressed collectives (docs/distributed.md "Compressed collectives"): syncs that
+    # actually shrank a payload, and the cumulative bytes the codec kept off the wire —
+    # a summary with zero rows must still SAY no sync byte was compressed
+    "sync.compressed_syncs",
+    "sync.bytes_saved.compression",
     # sketch states (docs/sketches.md): merge launches, statically counted compaction
     # stages, and the bytes a cat-state twin would have appended instead
     "sketch.merges",
@@ -278,6 +283,8 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "sync_bytes_saved": counters.get("sync.bytes_saved", 0),
         "sync_lazy_reduce_fires": counters.get("sync.lazy_reduce.fires", 0),
         "sync_lazy_reduce_reuses": counters.get("sync.lazy_reduce.reuses", 0),
+        "sync_compressed_syncs": counters.get("sync.compressed_syncs", 0),
+        "sync_bytes_saved_compression": counters.get("sync.bytes_saved.compression", 0),
         # serving tier (docs/serving.md): the async ingestion window's audit trail — a
         # bench that drove update_async records exactly what was enqueued, what
         # committed, what shed under backpressure, and how often callers stalled

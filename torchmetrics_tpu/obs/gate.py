@@ -182,6 +182,63 @@ def run_workload() -> List[Dict[str, Any]]:
     return [r for r in rows if r["metric"] in WORKLOAD_CLASSES]
 
 
+#: pinned shapes for the compressed-sync probe (docs/distributed.md "Compressed
+#: collectives"): a 4-rank simulated world syncing one f32 sum slab, one KLL quantile
+#: sketch, and one threshold-histogram pair per rank — the rows are byte-deterministic
+_SYNC_PROBE_WORLD = 4
+_SYNC_PROBE_N = 4096
+_SYNC_PROBE_SEED = 23
+
+
+def run_sync_probe() -> Dict[str, Dict[str, Any]]:
+    """Deterministic ``sync.bytes_saved[<mode>]`` rows for the ledger's ``sync`` block.
+
+    The probe runs entirely on the host (the codec layer never launches a kernel), so
+    its byte numbers are exact and platform-independent — the gate holds the line on
+    them with the ordinary bytes tolerance, which in practice means exactly.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu.parallel import sync as sync_mod
+    from torchmetrics_tpu.sketch import kll
+
+    rng = np.random.RandomState(_SYNC_PROBE_SEED)
+    kinds = {"q": "kll", "hist": "hist"}
+    states = []
+    for _ in range(_SYNC_PROBE_WORLD):
+        sketch = kll.kll_update(
+            kll.kll_init(_SKETCH_CAPACITY, _SKETCH_LEVELS),
+            jnp.asarray(rng.randn(512).astype(np.float32)),
+        )
+        states.append({
+            "slab": jnp.asarray((rng.randn(_SYNC_PROBE_N) * 16).astype(np.float32)),
+            "q": sketch,
+            "hist": jnp.asarray(rng.randint(0, 4096, size=(2, 512)).astype(np.float32)),
+        })
+    reds = {"slab": "sum", "q": kll.kll_merge_stacked, "hist": "sum"}
+    rows: Dict[str, Dict[str, Any]] = {}
+    raw_bytes: Optional[int] = None
+    for mode in ("none", "bf16", "int8"):
+        opts = sync_mod.SyncOptions(world=_SYNC_PROBE_WORLD, compression=mode)
+        gather = sync_mod.simulate_mesh_world(states, reds, opts, sketch_kinds=kinds)
+        res = sync_mod.process_sync(
+            dict(states[0]), reds, gather_fn=gather, options=opts,
+            sketch_wire=kinds, residuals={},
+        )
+        wire = int(res.bytes_shipped + res.bytes_received)
+        if mode == "none":
+            raw_bytes = wire
+            continue
+        rows[f"sync.bytes_saved[{mode}]"] = {
+            "wire_bytes": wire,
+            "raw_bytes": raw_bytes,
+            "bytes_saved": int(res.bytes_saved),
+            "compressed_states": list(res.compressed_states),
+        }
+    return rows
+
+
 def run_gate(
     baseline_path: str = _ledger.DEFAULT_BASELINE,
     bench_dir: str = ".",
@@ -201,6 +258,7 @@ def run_gate(
 
     rows = run_workload()
     current = _ledger.rows_by_key(rows)
+    sync_rows = run_sync_probe()
 
     bench_file = _ledger.latest_bench_file(bench_dir)
     bench_numbers: Dict[str, Any] = {}
@@ -212,10 +270,11 @@ def run_gate(
             bench_numbers = {}
 
     if update_baseline:
-        doc = _ledger.build_document(rows, bench=bench_numbers, tolerances=tolerances)
+        doc = _ledger.build_document(rows, bench=bench_numbers, tolerances=tolerances, sync=sync_rows)
         _ledger.write_document(doc, baseline_path)
         print(
             f"perf-gate: wrote baseline {baseline_path} ({len(rows)} ledger rows,"
+            f" {len(sync_rows)} sync probe rows,"
             f" bench source: {bench_numbers.get('file', 'none')})",
             file=out,
         )
@@ -239,12 +298,21 @@ def run_gate(
     base_bench = baseline.get("bench") or {}
     if base_bench and bench_numbers:
         bench_deltas = _ledger.compare_bench(base_bench, bench_numbers, tol)
+    sync_deltas: List[Dict[str, Any]] = []
+    base_sync = baseline.get("sync") or {}
+    if base_sync:
+        sync_deltas = _ledger.compare_sync(base_sync, sync_rows, tol)
 
-    all_regressions = _ledger.regressions(deltas) + _ledger.regressions(bench_deltas)
+    all_regressions = (
+        _ledger.regressions(deltas)
+        + _ledger.regressions(bench_deltas)
+        + _ledger.regressions(sync_deltas)
+    )
     if as_json:
         print(json.dumps({
             "ledger_deltas": deltas,
             "bench_deltas": bench_deltas,
+            "sync_deltas": sync_deltas,
             "bench_file": bench_numbers.get("file"),
             "regressions": len(all_regressions),
             "tolerances": tol,
@@ -256,6 +324,8 @@ def run_gate(
                 bench_deltas,
                 title=f"perf-gate bench ({base_bench.get('file')} -> {bench_numbers.get('file')})",
             ), file=out)
+        if sync_deltas:
+            print(_ledger.render_deltas(sync_deltas, title="perf-gate sync probe"), file=out)
         verdict = "FAIL" if all_regressions else "PASS"
         print(f"perf-gate: {verdict} ({len(all_regressions)} regression(s))", file=out)
     return 1 if all_regressions else 0

@@ -7,7 +7,9 @@ One format, three consumers: the committed ``PERF_LEDGER.json`` baseline, the CI
       "format": "tm-tpu-perf-ledger", "version": 1, "jax_version": "0.4.x",
       "tolerances": {"flops_rtol": ..., "bytes_rtol": ..., "memory_rtol": ..., "bench_rtol": ...},
       "ledger": {"<Metric>.<kernel>[<signature>]": {<CostRow fields>}},
-      "bench":  {"file": "BENCH_rNN.json", "value": ..., "<extras numbers>": ...}
+      "bench":  {"file": "BENCH_rNN.json", "value": ..., "<extras numbers>": ...},
+      "sync":   {"sync.bytes_saved[<mode>]": {"wire_bytes": ..., "raw_bytes": ...,
+                 "bytes_saved": ...}}   # deterministic compressed-sync probe rows
     }
 
 Comparison semantics: compiler cost quantities (flops, bytes accessed, argument/temp/output
@@ -69,8 +71,9 @@ def build_document(
     rows: List[Dict[str, Any]],
     bench: Optional[Dict[str, Any]] = None,
     tolerances: Optional[Dict[str, float]] = None,
+    sync: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
-    """Assemble a ledger document from profiler rows (+ optional bench numbers)."""
+    """Assemble a ledger document from profiler rows (+ optional bench/sync numbers)."""
     try:
         import jax
 
@@ -84,6 +87,7 @@ def build_document(
         "tolerances": dict(DEFAULT_TOLERANCES, **(tolerances or {})),
         "ledger": {r["key"]: r for r in rows},
         "bench": bench or {},
+        "sync": sync or {},
     }
 
 
@@ -195,6 +199,49 @@ def compare_bench(
         d = _delta(key, key, float(base), float(cur), rtol, _bench_higher_is_better(key))
         if d is not None:
             deltas.append(d)
+    return deltas
+
+
+#: sync probe fields the gate compares, with direction: bytes the codec saved must not
+#: shrink (higher-is-better), wire bytes must not grow (lower-is-better). raw_bytes is
+#: informational (it only moves when the pinned probe shapes move).
+SYNC_FIELDS: Tuple[Tuple[str, bool], ...] = (("bytes_saved", True), ("wire_bytes", False))
+
+
+def compare_sync(
+    baseline_rows: Dict[str, Dict[str, Any]],
+    current_rows: Dict[str, Dict[str, Any]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Compare the compressed-sync probe rows (``sync.bytes_saved[<mode>]``).
+
+    The probe is deterministic (pinned shapes, pinned seed, host-side codec), so these
+    rows hold the byte line exactly the way cost rows hold FLOPs: a codec change that
+    ships more wire bytes — or saves fewer — than the committed baseline regresses.
+    Missing rows regress too (a silently skipped mode is lost coverage).
+    """
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    rtol = tol.get("bytes_rtol", DEFAULT_TOLERANCES["bytes_rtol"])
+    deltas: List[Dict[str, Any]] = []
+    for key, base in sorted(baseline_rows.items()):
+        cur = current_rows.get(key)
+        if cur is None:
+            deltas.append({
+                "key": key, "field": "(row)", "baseline": None, "current": None,
+                "rel": None, "rtol": None, "status": "regression",
+                "note": "sync probe row missing from the current run (mode coverage lost)",
+            })
+            continue
+        for field, higher in SYNC_FIELDS:
+            d = _delta(key, field, base.get(field), cur.get(field), rtol, higher)
+            if d is not None:
+                deltas.append(d)
+    for key in sorted(set(current_rows) - set(baseline_rows)):
+        deltas.append({
+            "key": key, "field": "(row)", "baseline": None, "current": None,
+            "rel": None, "rtol": None, "status": "new",
+            "note": "sync probe row not in baseline (--update-baseline to adopt)",
+        })
     return deltas
 
 

@@ -28,6 +28,7 @@ tier, and a lazy reduce-scatter sync (``process_sync(..., sharded_states=...)``)
 replaces the ``world × state`` allgather with ``≈ 2 × state`` received bytes, cached per
 update epoch.
 """
+from torchmetrics_tpu.parallel import compress
 from torchmetrics_tpu.parallel.sync import (
     FULL,
     LOCAL,
@@ -53,6 +54,7 @@ from torchmetrics_tpu.parallel.sync import (
 from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned, local_mesh, reset_mesh_cache
 
 __all__ = [
+    "compress",
     "FULL",
     "LOCAL",
     "QUORUM",

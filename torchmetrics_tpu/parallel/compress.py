@@ -1,0 +1,394 @@
+"""Wire codecs for compressed collectives: ``SyncOptions(compression=...)``.
+
+Every eager ``process_sync`` gather used to ship full-precision state even though the
+dominant payloads are (a) large float accumulator slabs whose consumers tolerate a
+documented quantization error and (b) sketch states that are mostly ``+inf`` padding.
+This module is the codec seam behind ``SyncOptions(compression="none"|"bf16"|"int8")``
+(env ``TM_TPU_SYNC_COMPRESSION``), in the spirit of *EQuARX: Efficient Quantized
+AllReduce in XLA* (PAPERS.md): block-scaled quantization with per-block scales packed
+into ONE wire payload, plus error-feedback residuals so repeated syncs of a sum state
+do not drift.
+
+Exactness matrix (docs/distributed.md "Compressed collectives"):
+
+=====================================  ==========  =================================
+state / reduction                      wire        exactness
+=====================================  ==========  =================================
+int / bool dtype (counts)              raw         bit-identical by construction
+``min`` / ``max`` reductions           raw         bit-identical by construction
+``cat`` / ``None`` / plain callables   raw         bit-identical by construction
+sketch states (kll / countmin / hist)  packed      LOSSLESS pack → merge bit-identical
+f32 ``sum``                            bf16/int8   error-feedback, bounded (below)
+f32 ``mean``                           bf16/int8   plain quantization, bounded
+anything whose wire would be BIGGER    raw         bit-identical (never ship more)
+=====================================  ==========  =================================
+
+Wire format — a self-identifying 1-D ``uint8`` blob::
+
+    [0:4)  magic b"TMCW"      [4]    kind      [5]    flags   [6:8)  reserved
+    [8:12) n (u32 LE)         [12:16) extra (u32 LE)          [16:)  payload
+
+- ``bf16`` (kind 1): payload = round-to-nearest-even bfloat16 halves (2 bytes/elem).
+- ``int8`` (kind 2): payload = per-block f32 scales (``ceil(n/BLOCK)``) followed by the
+  symmetric int8 quanta (``q = clip(round(x/scale), -127, 127)``, ``scale =
+  max|block|/127``). Per-element abs error ≤ ``scale/2``.
+- ``kll`` (kind 3): LOSSLESS pack of a KLL compactor state ``(levels, capacity+2)`` —
+  per-level u16 counts + u8 parities, then only the ``count`` VALID leading items per
+  level as verbatim f32 bytes (slots past the count are ``+inf`` by construction, so
+  decode rebuilds the exact array). A state that violates the invariant (e.g. NaN
+  samples sorted into the tail) falls back to a verbatim f32 payload (flags=0).
+- ``counts`` (kind 4): LOSSLESS narrow-int pack of integral count grids (count-min
+  rows, threshold-histogram pairs): u8/u16/u32 chosen by range (flags = byte width),
+  verbatim dtype bytes when the values are non-integral/negative (flags=0).
+
+Everything here is host numpy — the eager sync path already runs on the host, and the
+codec must never add a device launch per state. jax is deliberately NOT imported.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, MutableMapping, Optional, Tuple
+
+import numpy as np
+
+#: recognised compression modes for ``SyncOptions(compression=...)``
+MODES = ("none", "bf16", "int8")
+ENV_SYNC_COMPRESSION = "TM_TPU_SYNC_COMPRESSION"
+
+#: quantization block width for int8 (one f32 scale per block)
+BLOCK = 256
+
+_MAGIC = b"TMCW"
+_HEADER = struct.Struct("<4sBBHII")  # magic, kind, flags, reserved, n, extra
+HEADER_BYTES = _HEADER.size
+
+KIND_BF16 = 1
+KIND_INT8 = 2
+KIND_KLL = 3
+KIND_COUNTS = 4
+
+#: sketch kind (SketchSpec.kind) -> wire codec kind
+SKETCH_WIRE_KINDS: Dict[str, int] = {"kll": KIND_KLL, "countmin": KIND_COUNTS, "hist": KIND_COUNTS}
+
+#: documented per-element relative quantization quantum per lossy mode (half-ulp):
+#: bf16 keeps 8 significand bits, so round-to-nearest lands within ``2^-8`` of the
+#: value relatively; int8 block-scaling bounds abs error by ``block_max/254`` per
+#: element. Bound helpers below fold in the world size and a 2x slack for the
+#: error-feedback carry (the shipped value is ``x + residual``).
+LOSSY_EPS = {"bf16": 2.0 ** -8, "int8": 1.0 / 254.0}
+
+
+def validate_mode(mode: Any) -> str:
+    """Normalise + validate a compression mode string."""
+    m = str(mode or "none").strip().lower()
+    if m not in MODES:
+        raise ValueError(f"unknown sync compression mode {mode!r}; expected one of {MODES}")
+    return m
+
+
+def _pack(kind: int, flags: int, n: int, extra: int, payload: bytes) -> np.ndarray:
+    header = _HEADER.pack(_MAGIC, kind, flags, 0, n, extra)
+    return np.frombuffer(header + payload, dtype=np.uint8).copy()
+
+
+def is_wire(value: Any) -> bool:
+    """True when ``value`` is (or wraps) a blob this module encoded."""
+    arr = np.asarray(value)
+    if arr.dtype != np.uint8 or arr.ndim != 1 or arr.size < HEADER_BYTES:
+        return False
+    return arr[:4].tobytes() == _MAGIC
+
+
+def wire_nbytes(value: Any) -> int:
+    """Byte size of one wire blob (or 0 for non-wire values)."""
+    arr = np.asarray(value)
+    return int(arr.size) if is_wire(arr) else 0
+
+
+# ------------------------------------------------------------------- lossy float codecs
+def _bf16_encode(x32: np.ndarray) -> bytes:
+    u = np.ascontiguousarray(x32, np.float32).view(np.uint32)
+    rounding = np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+    bf = ((u + rounding) >> np.uint32(16)).astype(np.uint16)
+    nan = np.isnan(x32)
+    if nan.any():
+        # round-to-nearest of a NaN mantissa can overflow into the exponent (-> inf);
+        # truncate instead and force a quiet-NaN mantissa bit
+        bf[nan] = ((u[nan] >> np.uint32(16)) | np.uint32(0x0040)).astype(np.uint16)
+    return bf.tobytes()
+
+
+def _bf16_decode(payload: bytes, n: int) -> np.ndarray:
+    bf = np.frombuffer(payload, dtype=np.uint16, count=n).astype(np.uint32)
+    return (bf << np.uint32(16)).view(np.float32)
+
+
+def _int8_encode(x32: np.ndarray) -> Optional[Tuple[bytes, int]]:
+    n = x32.size
+    nb = max(1, -(-n // BLOCK))
+    xp = np.zeros((nb * BLOCK,), np.float32)
+    xp[:n] = x32.reshape(-1)
+    xp = xp.reshape(nb, BLOCK)
+    maxabs = np.max(np.abs(xp), axis=1)
+    if not np.isfinite(maxabs).all():
+        return None  # non-finite blocks cannot block-scale; caller ships raw
+    scales = (maxabs / 127.0).astype(np.float32)
+    safe = np.where(scales > 0.0, scales, np.float32(1.0))
+    q = np.clip(np.rint(xp / safe[:, None]), -127, 127).astype(np.int8)
+    # ship exactly n quanta — the last block's padding is reconstructed on decode
+    return scales.tobytes() + q.reshape(-1)[:n].tobytes(), nb
+
+
+def _int8_decode(payload: bytes, n: int, nb: int) -> np.ndarray:
+    scales = np.frombuffer(payload, dtype=np.float32, count=nb)
+    q = np.zeros((nb * BLOCK,), np.int8)
+    q[:n] = np.frombuffer(payload, dtype=np.int8, offset=4 * nb, count=n)
+    out = q.reshape(nb, BLOCK).astype(np.float32) * scales[:, None]
+    return out.reshape(-1)[:n]
+
+
+# -------------------------------------------------------------- lossless sketch codecs
+def _kll_geometry(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    levels, width = int(shape[0]), int(shape[1])
+    return levels, width - 2
+
+
+def _kll_encode(state: np.ndarray) -> np.ndarray:
+    """LOSSLESS pack of a KLL state: only the valid leading items per level ship."""
+    levels, cap = _kll_geometry(state.shape)
+    items, counts, pars = state[:, :cap], state[:, cap], state[:, cap + 1]
+    cnt = counts.astype(np.int64)
+    valid = (
+        np.all(counts == cnt)
+        and np.all((cnt >= 0) & (cnt <= cap))
+        and np.all((pars == 0.0) | (pars == 1.0))
+        and all(bool(np.all(np.isposinf(items[lvl, cnt[lvl]:]))) for lvl in range(levels))
+    )
+    n = int(state.size)
+    extra = (levels << 16) | cap
+    if not valid:
+        # e.g. NaN samples sorted into the padding tail: ship the array verbatim so the
+        # round-trip stays bit-identical no matter what (the never-bigger guard upstream
+        # then prefers the raw array over this header-taxed copy)
+        return _pack(KIND_KLL, 0, n, extra, np.ascontiguousarray(state, np.float32).tobytes())
+    body = cnt.astype("<u2").tobytes() + pars.astype(np.uint8).tobytes()
+    body += b"".join(
+        np.ascontiguousarray(items[lvl, : cnt[lvl]], np.float32).tobytes() for lvl in range(levels)
+    )
+    return _pack(KIND_KLL, 1, n, extra, body)
+
+
+def _kll_decode(blob: np.ndarray, flags: int, n: int, extra: int) -> np.ndarray:
+    levels, cap = extra >> 16, extra & 0xFFFF
+    payload = blob[HEADER_BYTES:].tobytes()
+    if flags == 0:
+        return np.frombuffer(payload, dtype=np.float32, count=n).reshape(levels, cap + 2).copy()
+    cnt = np.frombuffer(payload, dtype="<u2", count=levels).astype(np.int64)
+    pars = np.frombuffer(payload, dtype=np.uint8, offset=2 * levels, count=levels)
+    state = np.full((levels, cap + 2), np.inf, np.float32)
+    state[:, cap] = cnt.astype(np.float32)
+    state[:, cap + 1] = pars.astype(np.float32)
+    offset = 3 * levels
+    for lvl in range(levels):
+        k = int(cnt[lvl])
+        if k:
+            state[lvl, :k] = np.frombuffer(payload, dtype=np.float32, offset=offset, count=k)
+            offset += 4 * k
+    return state
+
+
+def _counts_encode(arr: np.ndarray) -> np.ndarray:
+    """LOSSLESS narrow-int pack of an integral count grid (count-min / hist pair)."""
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    n = int(flat.size)
+    as_int = flat.astype(np.int64, copy=False) if flat.dtype.kind in "iu" else None
+    if as_int is None and flat.dtype.kind == "f" and np.isfinite(flat).all():
+        cand = np.rint(flat)
+        if np.array_equal(cand, flat):
+            as_int = cand.astype(np.int64)
+    if as_int is None or n == 0 or as_int.min() < 0 or as_int.max() > 0xFFFFFFFF:
+        return _pack(KIND_COUNTS, 0, n, 0, flat.tobytes())
+    top = int(as_int.max())
+    width = 1 if top <= 0xFF else (2 if top <= 0xFFFF else 4)
+    payload = as_int.astype(f"<u{width}").tobytes()
+    return _pack(KIND_COUNTS, width, n, 0, payload)
+
+
+def _counts_decode(blob: np.ndarray, flags: int, n: int, dtype: Any) -> np.ndarray:
+    payload = blob[HEADER_BYTES:].tobytes()
+    if flags == 0:
+        return np.frombuffer(payload, dtype=np.dtype(dtype), count=n).copy()
+    vals = np.frombuffer(payload, dtype=f"<u{flags}", count=n)
+    return vals.astype(np.dtype(dtype))
+
+
+# ------------------------------------------------------------------------- public codec
+def encode_array(value: Any, mode: str) -> Optional[np.ndarray]:
+    """Block-scaled lossy encode of a float array; None when the value can't compress
+    (non-f32 dtype, non-finite int8 blocks) — the caller then ships raw."""
+    arr = np.asarray(value)
+    if arr.dtype != np.float32:
+        return None
+    flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    if mode == "bf16":
+        return _pack(KIND_BF16, 0, flat.size, 0, _bf16_encode(flat))
+    if mode == "int8":
+        enc = _int8_encode(flat)
+        if enc is None:
+            return None
+        payload, nb = enc
+        return _pack(KIND_INT8, 0, flat.size, nb, payload)
+    raise ValueError(f"not a lossy wire mode: {mode!r}")
+
+
+def encode_sketch(value: Any, sketch_kind: str) -> Optional[np.ndarray]:
+    """LOSSLESS pack of one sketch state (``SketchSpec.kind``); None for unknown kinds."""
+    wire_kind = SKETCH_WIRE_KINDS.get(sketch_kind)
+    arr = np.asarray(value)
+    if wire_kind == KIND_KLL and arr.ndim == 2 and arr.shape[1] >= 3 and arr.dtype == np.float32:
+        return _kll_encode(arr)
+    if wire_kind == KIND_COUNTS:
+        return _counts_encode(arr)
+    return None
+
+
+def decode(blob: Any, shape: Tuple[int, ...], dtype: Any) -> np.ndarray:
+    """Decode one wire blob back to an array of the receiver's (known) shape/dtype."""
+    arr = np.asarray(blob)
+    magic, kind, flags, _res, n, extra = _HEADER.unpack(arr[:HEADER_BYTES].tobytes())
+    if magic != _MAGIC:
+        raise ValueError("not a TMCW wire blob")
+    if kind == KIND_BF16:
+        return _bf16_decode(arr[HEADER_BYTES:].tobytes(), n).reshape(shape).astype(np.dtype(dtype))
+    if kind == KIND_INT8:
+        return _int8_decode(arr[HEADER_BYTES:].tobytes(), n, extra).reshape(shape).astype(np.dtype(dtype))
+    if kind == KIND_KLL:
+        return _kll_decode(arr, flags, n, extra).reshape(shape)
+    if kind == KIND_COUNTS:
+        return _counts_decode(arr, flags, n, dtype).reshape(shape)
+    raise ValueError(f"unknown wire kind {kind}")
+
+
+def maybe_decode(value: Any, shape: Tuple[int, ...], dtype: Any) -> Any:
+    """Decode when ``value`` is a wire blob; pass anything else through untouched.
+
+    The wire is self-identifying (magic header), so a transport that ignored the
+    encoded payload (a compression-unaware injected gather) degrades gracefully: its
+    raw entries flow through and the sync is simply uncompressed for that state.
+    """
+    if is_wire(value):
+        return decode(value, shape, dtype)
+    return value
+
+
+# --------------------------------------------------------------------- codec planning
+def plan_state(value: Any, fx: Any, mode: str, sketch_kind: Optional[str] = None) -> str:
+    """Pick the wire treatment for one state: ``raw | bf16 | int8 | sketch``.
+
+    Exactness is preserved BY CONSTRUCTION for int/bool dtypes, ``min``/``max``
+    reductions, ``cat``/``None``/callable reductions (raw wire), and sketch merges
+    (lossless pack). Lossy block-scaled quantization applies only to float32 ``sum`` /
+    ``mean`` slabs. The caller additionally enforces the never-bigger guard (a wire
+    blob that does not beat the raw bytes ships raw).
+    """
+    if mode == "none":
+        return "raw"
+    if sketch_kind is not None and sketch_kind in SKETCH_WIRE_KINDS:
+        return "sketch"
+    if isinstance(value, (list, tuple)):
+        return "raw"
+    dtype = getattr(value, "dtype", None)
+    if dtype is None or np.dtype(dtype) != np.float32:
+        return "raw"
+    if fx in ("sum", "mean"):
+        return mode
+    return "raw"
+
+
+def encode_with_feedback(
+    value: Any,
+    mode: str,
+    residuals: Optional[MutableMapping[str, np.ndarray]] = None,
+    key: Optional[str] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Quantize ``value`` with error-feedback: ship ``Q(x + r)``, keep ``r' = x + r − Q``.
+
+    The residual lives HOST-side in ``residuals[key]`` (per state, per metric) so
+    repeated syncs of a growing sum do not drift: whatever one epoch's quantization
+    dropped is re-injected into the next epoch's payload. Returns ``(wire, decoded)``
+    — ``decoded`` is exactly what every receiver reconstructs — or None when the value
+    cannot compress (the caller ships raw and leaves the residual untouched).
+    """
+    base = np.asarray(value)
+    if base.dtype != np.float32:
+        return None
+    carry = base
+    if residuals is not None and key is not None:
+        prev = residuals.get(key)
+        if prev is not None and prev.shape == base.shape:
+            carry = base + prev
+    blob = encode_array(carry, mode)
+    if blob is None:
+        return None
+    approx = decode(blob, base.shape, base.dtype)
+    if residuals is not None and key is not None:
+        residuals[key] = (carry - approx).astype(np.float32)
+    return blob, approx
+
+
+def encode_for_wire(
+    value: Any,
+    fx: Any,
+    mode: str,
+    sketch_kind: Optional[str] = None,
+    residuals: Optional[MutableMapping[str, np.ndarray]] = None,
+    key: Optional[str] = None,
+) -> Tuple[Any, str]:
+    """The whole shipping policy for one state: plan, encode, never-bigger guard.
+
+    Returns ``(wire_or_original, plan)`` where ``plan`` is the treatment that was
+    ACTUALLY applied — a blob that fails to beat the raw bytes (scalars, tiny vectors,
+    non-finite int8 blocks) degrades to ``"raw"`` and, because raw ships exact, any
+    stored error-feedback residual for the state is cleared rather than carried.
+    Shared by ``process_sync`` and the simulated transports so every simulated rank
+    applies byte-for-byte the policy the local rank does.
+    """
+    plan = plan_state(value, fx, mode, sketch_kind)
+    if plan == "raw":
+        return value, "raw"
+    arr = np.asarray(value)
+    blob: Optional[np.ndarray] = None
+    if plan == "sketch":
+        blob = encode_sketch(arr, sketch_kind or "")
+    elif fx == "sum":
+        enc = encode_with_feedback(arr, plan, residuals, key)
+        if enc is not None:
+            blob = enc[0]
+    else:
+        blob = encode_array(arr, plan)
+    if blob is None or blob.nbytes >= arr.nbytes:
+        if residuals is not None and key is not None:
+            residuals.pop(key, None)
+        return value, "raw"
+    return blob, plan
+
+
+def sum_error_bound(mode: str, per_rank_maxabs: Any, world: Optional[int] = None) -> float:
+    """Documented abs-error bound for a ``sum`` synced under lossy compression.
+
+    Per rank, per element: bf16 rounds at ≤ ``2^-8`` relative, int8 block-scaling at
+    ≤ ``block_max/254`` absolute. Summing ``world`` quantized contributions adds the
+    per-rank bounds; the error-feedback carry can push one epoch's shipped magnitude
+    up to one quantum past the raw value, covered by the 2x slack. ``per_rank_maxabs``
+    is a scalar (shared bound) or one max-abs per rank.
+    """
+    eps = LOSSY_EPS[validate_mode(mode)] if mode != "none" else 0.0
+    maxes = np.atleast_1d(np.asarray(per_rank_maxabs, np.float64))
+    if world is not None and maxes.size == 1:
+        maxes = np.repeat(maxes, world)
+    return float(2.0 * eps * np.sum(maxes))
+
+
+def reset_residuals(store: MutableMapping[str, np.ndarray]) -> None:
+    """Drop accumulated error-feedback residuals (tests / after a state reset)."""
+    store.clear()

@@ -41,6 +41,7 @@ import numpy as np
 from jax import Array, lax
 
 from torchmetrics_tpu import obs
+from torchmetrics_tpu.parallel import compress as _compress
 from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -64,6 +65,7 @@ ENV_SYNC_QUORUM = "TM_TPU_SYNC_QUORUM"
 ENV_SYNC_EVICT_AFTER = "TM_TPU_SYNC_EVICT_AFTER"
 ENV_SYNC_PROBE_BACKOFF = "TM_TPU_SYNC_PROBE_BACKOFF_S"
 ENV_SYNC_JITTER = "TM_TPU_SYNC_JITTER"
+ENV_SYNC_COMPRESSION = _compress.ENV_SYNC_COMPRESSION  # "TM_TPU_SYNC_COMPRESSION"
 
 #: retry-backoff jitter RNG, seeded from the chaos harness's fixed seed when one is
 #: pinned (``TM_TPU_CHAOS_SEED``, ``make chaos``) so jittered retry schedules stay
@@ -143,6 +145,13 @@ class SyncOptions:
     ``evict_after``/``probe_backoff_s`` configure the per-rank circuit breaker
     (:class:`HealthLedger`): a rank missing ``evict_after`` consecutive syncs is evicted
     from the gather group and probed with exponential backoff until it answers again.
+
+    ``compression`` (``"none" | "bf16" | "int8"``, env ``TM_TPU_SYNC_COMPRESSION``)
+    selects the wire codec for every eager gather (docs/distributed.md "Compressed
+    collectives"): block-scaled lossy quantization of float32 sum/mean slabs with
+    error-feedback residuals, LOSSLESS packed blobs for sketch states, raw (exact)
+    wire for int/bool dtypes, min/max/cat/None/callable reductions, and anything the
+    blob would not shrink. ``"none"`` is byte-for-byte the pre-codec behaviour.
     """
 
     timeout_s: float = 0.0
@@ -161,6 +170,12 @@ class SyncOptions:
     world: Optional[int] = None
     evict_after: int = 3
     probe_backoff_s: float = 1.0
+    compression: str = "none"
+
+    def __post_init__(self) -> None:
+        # normalise + validate eagerly so a typo'd mode fails at the construction site,
+        # not on the first (possibly degraded) sync deep inside compute()
+        object.__setattr__(self, "compression", _compress.validate_mode(self.compression))
 
     @property
     def bounded(self) -> bool:
@@ -176,6 +191,14 @@ def _parse_quorum(raw: Optional[str]) -> Optional[Union[int, float]]:
     except (TypeError, ValueError):
         return None
     return val if val > 0 else None
+
+
+def _parse_compression(raw: Optional[str]) -> str:
+    """Env-lenient mode parse: unset/invalid values fall back to ``"none"``."""
+    try:
+        return _compress.validate_mode(raw)
+    except ValueError:
+        return "none"
 
 
 def sync_options_from_env() -> SyncOptions:
@@ -198,6 +221,7 @@ def sync_options_from_env() -> SyncOptions:
         quorum=_parse_quorum(os.environ.get(ENV_SYNC_QUORUM)),
         evict_after=int(_f(ENV_SYNC_EVICT_AFTER, 3)),
         probe_backoff_s=_f(ENV_SYNC_PROBE_BACKOFF, 1.0),
+        compression=_parse_compression(os.environ.get(ENV_SYNC_COMPRESSION)),
     )
 
 
@@ -215,8 +239,13 @@ class SyncedState(dict):
     ``gather_latency_us`` maps each state name to the wall time its gather took on THIS
     rank — the raw material of the cross-rank skew report (:func:`skew_report`).
     ``bytes_shipped``/``bytes_received`` account the sync's communication volume on this
-    rank (payload bytes out / gathered bytes in); ``sharded_states`` names the states
-    that synced through the reduce-scatter shard path instead of a full allgather.
+    rank — TRUE wire bytes: when a state ships as a quantized slab or a packed sketch
+    blob, the blob's bytes are counted, not the raw array's. ``sharded_states`` names
+    the states that synced through the reduce-scatter shard path instead of a full
+    allgather; ``compression`` tags the wire mode the sync ran under,
+    ``compressed_states`` the states whose payloads actually shrank, and
+    ``bytes_saved`` the bytes this sync avoided versus a full-precision allgather
+    (shard-path savings + codec savings combined).
     """
 
     world_consistent: ConsistencyLevel = FULL
@@ -227,7 +256,10 @@ class SyncedState(dict):
     gather_latency_us: Dict[str, float] = {}
     bytes_shipped: int = 0
     bytes_received: int = 0
+    bytes_saved: int = 0
     sharded_states: Tuple[str, ...] = ()
+    compression: str = "none"
+    compressed_states: Tuple[str, ...] = ()
 
 
 # ------------------------------------------------------------------ rank health ledger
@@ -753,7 +785,16 @@ def _reduce_gathered(fx: ReduceFx, vals: List[Any], world: int, opts: SyncOption
 
 
 def _nbytes(value: Any) -> int:
-    """Byte size of one gather payload (arrays via size×itemsize, lists summed)."""
+    """Byte size of one gather payload as it ACTUALLY travels (arrays via
+    size×itemsize, lists summed).
+
+    Wire blobs (``parallel.compress`` packed/quantized payloads) are 1-D uint8 arrays,
+    so ``size × itemsize`` IS their true wire size — the ledger counts what shipped,
+    never the raw array a sketch blob or quantized slab stands in for. (Before the
+    codec layer the sketch states' ~12 KB arrays were charged at full f32 bytes even
+    though only the packed blob need ship; ``bytes_saved`` is honest in every mode
+    now that the accounting runs on the encoded payloads themselves.)
+    """
     if isinstance(value, (list, tuple)):
         return sum(_nbytes(v) for v in value)
     size = getattr(value, "size", None)
@@ -783,6 +824,7 @@ def simulate_mesh_world(
     rank_states: Sequence[Dict[str, Any]],
     reductions: Dict[str, ReduceFx],
     options: Optional[SyncOptions] = None,
+    sketch_kinds: Optional[Dict[str, str]] = None,
 ) -> Callable:
     """A shard-aware ``gather_fn`` over a simulated multi-rank world (tests, bench).
 
@@ -799,8 +841,39 @@ def simulate_mesh_world(
     deployments the same contract is implemented over the wire; here it reads the
     simulated ranks directly, so single-process tests and the ``bench.py --sharded``
     lane can drive the exact code path (and byte accounting) of a sharded sync.
+
+    With ``options.compression != "none"`` the transport is codec-aware: every
+    simulated rank's contribution travels as the SAME wire payload the local rank
+    ships (block-scaled quantized slabs with per-rank host-side error-feedback
+    residuals for sums, packed sketch blobs per ``sketch_kinds`` — a
+    ``{state_name: SketchSpec.kind}`` map — exact raw wire everywhere else), and the
+    shard phases quantize slab exchanges exactly as a real compressed reduce-scatter
+    would (reduce over DECODED values, re-encode the reduced slab for assembly).
     """
     opts = options or SyncOptions()
+    mode = _compress.validate_mode(getattr(opts, "compression", "none"))
+    active = mode != "none" and len(rank_states) > 1
+    kinds = dict(sketch_kinds or {})
+    # per-simulated-rank error-feedback residuals, persistent across syncs (epochs)
+    rank_residuals: List[Dict[str, Any]] = [{} for _ in rank_states]
+
+    def _enc(rank: int, arr: Any, fx: ReduceFx, key: str, slab: bool = False) -> Any:
+        if not active:
+            return arr
+        if slab and key in kinds:
+            # a partitioned sum-merged sketch keeps RAW slabs: lossy quantization would
+            # break the sketch-merge exactness promise, and the packed codecs are
+            # whole-state formats
+            return arr
+        payload, _plan = _compress.encode_for_wire(
+            arr, fx, mode,
+            sketch_kind=None if slab else kinds.get(key),
+            # slab exchanges re-quantize fresh sub-ranges per sync; residual feedback
+            # is a full-state contract (see docs/distributed.md)
+            residuals=None if slab else rank_residuals[rank],
+            key=key,
+        )
+        return payload
 
     def gather(
         value: Any,
@@ -810,19 +883,36 @@ def simulate_mesh_world(
         shard_slice: Optional[Tuple[int, int]] = None,
         shard_assemble: Optional[int] = None,
     ) -> List[Any]:
-        del group, value
+        del group
         vals = [jnp.asarray(s[name]) for s in rank_states]
+        fx = reductions.get(name, "sum")
         if shard_slice is not None:
             lo, hi = shard_slice
-            return [v[lo:hi] for v in vals]
+            return [_enc(i, v[lo:hi], fx, name, slab=True) for i, v in enumerate(vals)]
         if shard_assemble is not None:
             rows, world = int(shard_assemble), len(vals)
-            fx = reductions.get(name, "sum")
-            return [
-                _reduce_gathered(fx, [v[r * rows:(r + 1) * rows] for v in vals], world, opts)
-                for r in range(world)
-            ]
-        return vals
+
+            def _assembled(r: int) -> Any:
+                slabs = [v[r * rows:(r + 1) * rows] for v in vals]
+                if active:
+                    # faithful compressed reduce-scatter: rank r receives each peer's
+                    # QUANTIZED slab, reduces the decoded values, then re-encodes its
+                    # reduced slab for the assembly allgather
+                    contrib = [_enc(i, s, fx, name, slab=True) for i, s in enumerate(slabs)]
+                    slabs = [
+                        _compress.maybe_decode(c, tuple(s.shape), s.dtype)
+                        for c, s in zip(contrib, slabs)
+                    ]
+                reduced = _reduce_gathered(fx, [jnp.asarray(s) for s in slabs], world, opts)
+                return _enc(r, reduced, fx, name, slab=True)
+
+            return [_assembled(r) for r in range(world)]
+        out = [_enc(i, v, fx, name) for i, v in enumerate(vals)]
+        if active and _compress.is_wire(value):
+            # the calling rank already encoded its payload (with ITS residual store);
+            # echo that exact wire back so the round-trip matches what it shipped
+            out[0] = value
+        return out
 
     return gather
 
@@ -834,6 +924,8 @@ def process_sync(
     group: Optional[str] = None,
     options: Optional[SyncOptions] = None,
     sharded_states: Optional[Sequence[str]] = None,
+    sketch_wire: Optional[Dict[str, str]] = None,
+    residuals: Optional[Dict[str, Any]] = None,
 ) -> "SyncedState":
     """Eager cross-process sync of a state dict; identity when world size is 1.
 
@@ -864,6 +956,22 @@ def process_sync(
     allgather's ``world × state``; ``SyncedState.bytes_shipped/bytes_received`` and the
     ``sync.bytes_*`` counters carry the accounting. A gather without the shard contract
     (the stock ``process_allgather`` path) falls back to the full gather unchanged.
+
+    ``options.compression`` (docs/distributed.md "Compressed collectives") turns on the
+    wire codec layer (:mod:`torchmetrics_tpu.parallel.compress`): float32 sum/mean
+    payloads ship as block-scaled bf16/int8 blobs — sums with host-side error-feedback
+    residuals (``residuals``, one dict per metric, so repeated syncs never drift) —
+    and sketch states named in ``sketch_wire`` (``{state: SketchSpec.kind}``) ship as
+    LOSSLESS packed blobs decoded and merged on the receiver. Every exactness-promising
+    reduction (min/max/count/int dtypes, cat/None/callable, sketch merges) stays
+    bit-identical to the uncompressed sync by construction; quorum aggregation operates
+    on DECODED values, so partial-world rescaling composes with the codec unchanged.
+    The codec needs a payload-faithful transport (one that ships what it is handed —
+    the stock ``process_allgather``, or the codec-aware :func:`simulate_mesh_world`);
+    raw entries from a compression-unaware gather pass through undecoded and simply
+    stay uncompressed. ``SyncedState.compression/compressed_states/bytes_saved`` and
+    the ``sync.bytes_saved.compression`` counter + ``sync.compression.*`` gauges carry
+    the accounting.
     """
     import inspect
 
@@ -899,8 +1007,16 @@ def process_sync(
     ok_ranks: set = set()
     failed_ranks: set = set()
     gather_latency_us: Dict[str, float] = {}
-    bytes_shipped = bytes_received = bytes_saved = 0
+    bytes_shipped = bytes_received = shard_saved = 0
     shard_synced: List[str] = []
+    # wire codec (docs/distributed.md "Compressed collectives"): active only at world
+    # > 1 — a single-rank "sync" never touches the wire, so mode "none" semantics and
+    # the historical byte accounting are preserved exactly there
+    mode = _compress.validate_mode(getattr(opts, "compression", "none"))
+    compress_active = mode != "none" and world > 1
+    sketch_kinds = dict(sketch_wire or {})
+    compressed: List[str] = []
+    comp_raw_bytes = comp_wire_bytes = 0
 
     def run_gather(payload: Any, name: str, kw: Dict[str, Any]) -> List[Any]:
         # per-gather wall time on THIS rank: the raw material of the cross-rank skew
@@ -943,10 +1059,26 @@ def process_sync(
             # world's reduced slabs and concatenates them back into the full state.
             rows = value.shape[0] // world
             slab_bytes = _nbytes(value) // world
+            slab_shape = (rows,) + tuple(value.shape[1:])
+            # lossy slab codec: sum/mean f32 slabs quantize on the wire; sketch states
+            # and exactness-promising reductions keep raw slabs (min/max stay exact)
+            slab_lossy = (
+                compress_active and name not in sketch_kinds
+                and _compress.plan_state(value, fx, mode) in ("bf16", "int8")
+            )
+            got_wire = False
             try:
                 pieces = run_gather(value, name, {**kw, "shard_slice": (rank * rows, (rank + 1) * rows)})
+                recv_b = sum(_nbytes(p) for p in pieces)
+                if slab_lossy:
+                    got_wire = any(_compress.is_wire(p) for p in pieces)
+                    pieces = [_compress.maybe_decode(p, slab_shape, value.dtype) for p in pieces]
                 reduced_slab = _reduce_gathered(fx, [jnp.asarray(p) for p in pieces], world, opts)
                 slabs = run_gather(reduced_slab, name, {**kw, "shard_assemble": rows})
+                recv_b += sum(_nbytes(s) for s in slabs)
+                if slab_lossy:
+                    got_wire = got_wire or any(_compress.is_wire(s) for s in slabs)
+                    slabs = [_compress.maybe_decode(s, slab_shape, value.dtype) for s in slabs]
             except SyncTimeoutError:
                 # a missing rank loses rows, which no quorum can reconstruct — the
                 # sharded path degrades straight to the local value (or raises)
@@ -956,9 +1088,24 @@ def process_sync(
                 out[name] = value
                 note_responders(name, (rank,))
                 continue
-            bytes_shipped += 2 * slab_bytes
-            bytes_received += (len(pieces) + len(slabs)) * slab_bytes
-            bytes_saved += max(0, world * _nbytes(value) - (len(pieces) + len(slabs)) * slab_bytes)
+            ship_b = 2 * slab_bytes
+            if slab_lossy and got_wire:
+                # the transport really spoke the codec: what we shipped was the same
+                # encoding of our own slab, once per phase
+                own = _compress.encode_array(
+                    np.asarray(value[rank * rows:(rank + 1) * rows]), mode
+                )
+                if own is not None and own.nbytes < slab_bytes:
+                    ship_b = 2 * int(own.nbytes)
+                raw_total = (2 + len(pieces) + len(slabs)) * slab_bytes
+                wire_total = ship_b + recv_b
+                if wire_total < raw_total:
+                    compressed.append(name)
+                    comp_raw_bytes += raw_total
+                    comp_wire_bytes += wire_total
+            bytes_shipped += ship_b
+            bytes_received += recv_b
+            shard_saved += max(0, world * _nbytes(value) - recv_b)
             out[name] = jnp.concatenate([jnp.asarray(s) for s in slabs], axis=0)
             shard_synced.append(name)
             note_responders(name, range(world))
@@ -970,15 +1117,36 @@ def process_sync(
             payload = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if len(value) else _empty_payload()
         else:
             payload = value
+        # wire codec seam: cat/list payloads always ship raw (sample streams must stay
+        # exact); everything else goes through the shared shipping policy — packed
+        # sketch blobs, error-feedback quantized sums, plain-quantized means, raw for
+        # every exactness-promising reduction and for blobs that would not shrink
+        plan = "raw"
+        enc_payload = payload
+        if compress_active and not is_list:
+            enc_payload, plan = _compress.encode_for_wire(
+                payload, fx, mode,
+                sketch_kind=sketch_kinds.get(name),
+                residuals=residuals if fx == "sum" else None,
+                key=name,
+            )
         try:
-            gathered = run_gather(payload, name, kw)
+            gathered = run_gather(enc_payload, name, kw)
         except SyncTimeoutError as err:
             partial = dict(getattr(err, "responses", None) or {})
             # this rank's own contribution always "responds" — k >= 1, so the quorum
             # mean/rescale arithmetic can never divide by zero
-            partial.setdefault(rank, payload)
+            partial.setdefault(rank, enc_payload)
             if quorum_k and len(partial) >= quorum_k:
                 vals = [partial[r] for r in sorted(partial)]
+                if plan != "raw" or compress_active:
+                    # quorum aggregation (incl. the sum rescale over responders)
+                    # operates on DECODED values — the codec never changes the
+                    # partial-world arithmetic
+                    vals = [
+                        _compress.maybe_decode(v, tuple(payload.shape), payload.dtype)
+                        for v in vals
+                    ] if not is_list else vals
                 out[name] = list(vals) if is_list else _reduce_gathered(fx, vals, world, opts)
                 quorum_states.append(name)
                 note_responders(name, partial.keys())
@@ -989,8 +1157,24 @@ def process_sync(
             out[name] = list(value) if is_list else value
             note_responders(name, partial.keys())
             continue
-        bytes_shipped += _nbytes(payload)
-        bytes_received += sum(_nbytes(g) for g in gathered)
+        wire_ship = _nbytes(enc_payload)
+        wire_recv = sum(_nbytes(g) for g in gathered)
+        bytes_shipped += wire_ship
+        bytes_received += wire_recv
+        if compress_active and not is_list:
+            if plan != "raw" or any(_compress.is_wire(g) for g in gathered):
+                raw_total = _nbytes(payload) * (1 + len(gathered))
+                if wire_ship + wire_recv < raw_total:
+                    compressed.append(name)
+                    comp_raw_bytes += raw_total
+                    comp_wire_bytes += wire_ship + wire_recv
+                # the wire is self-identifying, so decode opportunistically: a transport
+                # that encoded MORE than this rank planned (e.g. a codec-aware simulated
+                # world given sketch descriptors this caller lacked) still round-trips
+                gathered = [
+                    _compress.maybe_decode(g, tuple(payload.shape), payload.dtype)
+                    for g in gathered
+                ]
         # successful gather: attribute the entries to ranks where the layout allows
         resp: Optional[Tuple[int, ...]] = None
         if takes_ranks and world > 1 and len(gathered) == len(gather_group):
@@ -1030,15 +1214,32 @@ def process_sync(
     out.bytes_shipped = bytes_shipped
     out.bytes_received = bytes_received
     out.sharded_states = tuple(shard_synced)
+    comp_saved = max(0, comp_raw_bytes - comp_wire_bytes)
+    out.compression = mode
+    out.compressed_states = tuple(dict.fromkeys(compressed))
+    out.bytes_saved = shard_saved + comp_saved
     if bytes_shipped or bytes_received:
         obs.telemetry.counter("sync.bytes_shipped").inc(bytes_shipped)
         obs.telemetry.counter("sync.bytes_received").inc(bytes_received)
     if shard_synced:
-        obs.telemetry.counter("sync.bytes_saved").inc(bytes_saved)
+        obs.telemetry.counter("sync.bytes_saved").inc(shard_saved)
         obs.telemetry.event(
             "sync.sharded", cat="sync",
             args={"states": shard_synced, "world": world,
-                  "bytes_received": bytes_received, "bytes_saved": bytes_saved},
+                  "bytes_received": bytes_received, "bytes_saved": shard_saved},
+        )
+    if compressed:
+        # the codec's own ledger rows: cumulative bytes avoided vs the full-precision
+        # allgather, plus per-sync compressed-vs-raw gauges for the OpenMetrics scrape
+        obs.telemetry.counter("sync.compressed_syncs").inc()
+        obs.telemetry.counter("sync.bytes_saved.compression").inc(comp_saved)
+        obs.telemetry.gauge("sync.compression.wire_bytes").set(comp_wire_bytes)
+        obs.telemetry.gauge("sync.compression.raw_bytes").set(comp_raw_bytes)
+        obs.telemetry.event(
+            "sync.compressed", cat="sync",
+            args={"mode": mode, "states": out.compressed_states, "world": world,
+                  "wire_bytes": comp_wire_bytes, "raw_bytes": comp_raw_bytes,
+                  "bytes_saved": comp_saved},
         )
     if quorum_states and not degraded:
         obs.telemetry.counter("sync.quorum_syncs").inc()

@@ -463,12 +463,18 @@ class SimWorld:
     genuinely shrink the gather group and probes genuinely re-include the evictee.
     """
 
-    def __init__(self, metrics: Sequence[Any]) -> None:
+    def __init__(self, metrics: Sequence[Any], compression: str = "none") -> None:
         self.metrics: List[Any] = list(metrics)
         self.down: set = set()
         self.calls = 0
         self.timeouts = 0
         self.last_ranks: Optional[Tuple[int, ...]] = None
+        #: wire mode for the simulated transport (docs/distributed.md "Compressed
+        #: collectives"): every sim rank's contribution travels through the SAME codec
+        #: policy the local rank's ``process_sync`` applies — quantized sum/mean slabs
+        #: with per-rank error-feedback residuals, packed sketch blobs, raw elsewhere
+        self.compression = compression
+        self._residuals: Dict[int, Dict[str, Any]] = {}
 
     def options(self, **kw: Any) -> Any:
         """SyncOptions pinned to this world's size (pass quorum/evict/probe knobs)."""
@@ -487,6 +493,23 @@ class SimWorld:
             return jnp.concatenate([jnp.atleast_1d(e) for e in entries], axis=0)
         return st.tensors[name]
 
+    def _encode(self, rank: int, name: str, val: Any) -> Any:
+        """Apply the wire codec to one sim rank's contribution (no-op at mode none)."""
+        if self.compression == "none":
+            return val
+        from torchmetrics_tpu.parallel import compress as _compress
+
+        m = self.metrics[rank]
+        fx = m._reductions.get(name, "sum")
+        specs = m.__dict__.get("_sketch_specs") or {}
+        kind = specs[name].kind if name in specs else None
+        payload, _plan = _compress.encode_for_wire(
+            np.asarray(val), fx, self.compression, sketch_kind=kind,
+            residuals=self._residuals.setdefault(rank, {}) if fx == "sum" else None,
+            key=name,
+        )
+        return payload
+
     def __call__(self, value: Any, group: Any = None, *, name: Optional[str] = None,
                  ranks: Optional[Sequence[int]] = None) -> List[Any]:
         self.calls += 1
@@ -497,7 +520,7 @@ class SimWorld:
             if r == 0:
                 responses[r] = value
             elif r not in self.down:
-                responses[r] = self.state_value(r, name)
+                responses[r] = self._encode(r, name, self.state_value(r, name))
         if len(responses) < len(requested):
             self.timeouts += 1
             obs.telemetry.counter("robust.injected_faults").inc()
@@ -914,6 +937,183 @@ def scenario_flap_evict_readmit(
     }
 
 
+def scenario_compressed_sync_quorum(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Quantized sync under straggler timeout + quorum degrade + journal replay.
+
+    Three variants per cell, each running the SAME seeded fault schedule twice — once
+    under ``SyncOptions(compression="int8")``, once under ``"none"`` — and asserting
+    the codec changes bytes, never semantics:
+
+    - **plain**: a 2-rank codec-aware :class:`SimWorld`; rank 1 dies mid-gather at a
+      seeded step (rank 0's compute must degrade to QUORUM), rank 0 is then preempted
+      cold and recovered ``snapshot + replay(journal)``, rank 1 heals, and the final
+      compute grades FULL. The :class:`ConsistencyLevel` sequence must MATCH the
+      uncompressed twin step for step, and values must be bit-identical (scalar
+      aggregator states ride the never-bigger guard → raw exact wire).
+    - **keyed**: the same schedule over ``KeyedMetric(template, 16)`` — a ``[16]``
+      tenant table that genuinely quantizes. Exact reductions (max/min) must be
+      bit-identical to the uncompressed twin; lossy sums/means must land within the
+      documented block-scale bound; grades unchanged. Unkeyable templates (cat) report
+      a skipped-but-passed cell.
+    - **sharded**: the keyed table ``shard()``-ed and synced through the codec-aware
+      ``simulate_mesh_world`` reduce-scatter slabs — compressed-vs-raw values within
+      the same bound (exact fx bit-identical), both runs grading full.
+    """
+    from torchmetrics_tpu.parallel import compress as _compress
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+    n_batches = max(4, n_batches)
+    detail: Dict[str, Any] = {}
+
+    # ---------------------------------------------------------------- plain variant
+    shards = [_seeded_batches(rng, n_batches), _seeded_batches(rng, n_batches)]
+    death = rng.randrange(1, n_batches - 1)
+
+    def _drive_plain(mode: str, tag: str) -> Tuple[Any, List[str]]:
+        m0, m1 = factory(), factory()
+        world = SimWorld([m0, m1], compression=mode)
+        opts = world.options(quorum=1, evict_after=99, compression=mode)
+        _arm_sync(m0, world, opts)
+        jdir = f"{workdir}/plain-{tag}-wal"
+        jm0 = m0.journal(jdir, every_k=2)
+        grades: List[str] = []
+        for i in range(n_batches):
+            (jm0.forward if via == "forward" else jm0.update)(*shards[0][i])
+            m1.update(*shards[1][i])
+            if i == death:
+                world.down.add(1)
+                m0.compute()
+                grades.append(str(m0.world_consistent))
+                # rank 0 is preempted cold mid-epoch; a fresh instance recovers
+                # snapshot + replay — the compressed wire never touched the WAL
+                obs.telemetry.counter("robust.injected_faults").inc()
+                fresh = factory()
+                _journal.recover(fresh, jdir)
+                obs.telemetry.counter("robust.recovered").inc()
+                _arm_sync(fresh, world, opts)
+                world.metrics[0] = fresh
+                m0 = fresh
+                jm0 = m0.journal(jdir, every_k=2)
+                world.down.discard(1)
+        final = m0.compute()
+        grades.append(str(m0.world_consistent))
+        return final, grades
+
+    v_comp, g_comp = _drive_plain("int8", "int8")
+    v_raw, g_raw = _drive_plain("none", "none")
+    plain_identical = _identical(v_comp, v_raw)
+    detail.update({
+        "plain_bit_identical": plain_identical,
+        "plain_grades": g_comp,
+        "plain_grades_match": g_comp == g_raw,
+        "plain_quorum_seen": "quorum" in g_comp and g_comp[-1] == "full",
+        "death_step": death,
+    })
+
+    # ---------------------------------------------------------------- keyed variant
+    keyed_ok = sharded_ok = True
+    try:
+        from torchmetrics_tpu.keyed import KeyedMetric
+
+        KeyedMetric(factory(), 2)
+        keyable = True
+    except TorchMetricsUserError as err:
+        keyable = False
+        detail["keyed_skipped"] = str(err)
+    if keyable:
+        n_keys = 16
+        kbatches = []
+        for _ in range(n_batches):
+            ids = np.asarray([rng.randrange(n_keys) for _ in range(6)], np.int32)
+            vals = np.asarray([float(rng.randint(0, 9)) for _ in range(6)], np.float32)
+            kbatches.append((ids, vals))
+        kdeath = rng.randrange(1, n_batches - 1)
+
+        def _drive_keyed(mode: str) -> Tuple[Any, List[str]]:
+            m0, m1 = KeyedMetric(factory(), n_keys), KeyedMetric(factory(), n_keys)
+            world = SimWorld([m0, m1], compression=mode)
+            opts = world.options(quorum=1, evict_after=99, compression=mode)
+            _arm_sync(m0, world, opts)
+            grades: List[str] = []
+            for i in range(n_batches):
+                m0.update(*kbatches[i])
+                m1.update(*kbatches[i])
+                if i == kdeath:
+                    world.down.add(1)
+                    m0.compute()
+                    grades.append(str(m0.world_consistent))
+                    world.down.discard(1)
+            final = m0.compute()
+            grades.append(str(m0.world_consistent))
+            return np.asarray(final), grades
+
+        kv_comp, kg_comp = _drive_keyed("int8")
+        kv_raw, kg_raw = _drive_keyed("none")
+        exact_fx = all(
+            fx in ("max", "min") for fx in KeyedMetric(factory(), 2)._reductions.values()
+        )
+        if exact_fx:
+            keyed_ok = _identical(kv_comp, kv_raw)
+            detail["keyed_bit_identical"] = keyed_ok
+        else:
+            bound = _compress.sum_error_bound(
+                "int8", max(1.0, float(np.max(np.abs(kv_raw)))), world=2
+            ) * 2.0  # quorum rescale (×world/k) scales the quantization error too
+            err = float(np.max(np.abs(kv_comp - kv_raw)))
+            keyed_ok = err <= bound
+            detail.update({"keyed_abs_err": err, "keyed_err_bound": bound})
+        detail["keyed_grades_match"] = kg_comp == kg_raw
+        keyed_ok = keyed_ok and kg_comp == kg_raw and "quorum" in kg_comp
+
+        # ------------------------------------------------------------ sharded variant
+        from torchmetrics_tpu.parallel import sync as _sync
+        from torchmetrics_tpu.parallel.mesh import MeshContext, is_partitioned
+
+        ranks = [KeyedMetric(factory(), n_keys) for _ in range(2)]
+        for m in ranks:
+            for b in kbatches:
+                m.update(*b)  # jaxlint: disable=TPU010 — rank replicas of a simulated world
+        km0 = ranks[0].shard(MeshContext())
+        states = [dict(m._state.tensors) for m in ranks]
+        states[0] = dict(km0._state.tensors)
+        reds = {n: km0._reductions[n] for n in states[0]}
+        sharded_names = [n for n, s in km0.shard_specs.items() if is_partitioned(s)]
+
+        def _shard_sync(mode: str) -> Any:
+            opts = _sync.SyncOptions(world=2, compression=mode)
+            gather = _sync.simulate_mesh_world(states, reds, opts)
+            return _sync.process_sync(
+                dict(states[0]), reds, gather_fn=gather, options=opts,
+                sharded_states=sharded_names,
+            )
+
+        s_comp, s_raw = _shard_sync("int8"), _shard_sync("none")
+        detail["sharded_grades_match"] = str(s_comp.world_consistent) == str(s_raw.world_consistent) == "full"
+        s_errs = {}
+        for n in states[0]:
+            a, b = np.asarray(s_comp[n], np.float64), np.asarray(s_raw[n], np.float64)
+            fx = reds[n]
+            if fx in ("max", "min") or a.dtype.kind in "iub":
+                ok = bool(np.array_equal(a, b))
+            else:
+                bound = _compress.sum_error_bound("int8", max(1.0, float(np.max(np.abs(b)))), world=2)
+                ok = float(np.max(np.abs(a - b))) <= bound
+            s_errs[n] = ok
+        sharded_ok = detail["sharded_grades_match"] and all(s_errs.values())
+        detail["sharded_states_within_bound"] = s_errs
+        detail["sharded_compressed_states"] = list(s_comp.compressed_states)
+
+    passed = bool(
+        plain_identical and detail["plain_grades_match"] and detail["plain_quorum_seen"]
+        and keyed_ok and sharded_ok
+    )
+    detail["passed"] = passed
+    return detail
+
+
 # ---------------------------------------------------------------------------
 # Serving-tier scenarios (PR 11): preemption mid-overlap, drain death, overflow
 # ---------------------------------------------------------------------------
@@ -1308,6 +1508,7 @@ class ChaosMatrix:
         "sketch_preemption_journal": scenario_sketch_preemption_journal,
         "sharded_preemption_restore": scenario_sharded_preemption_restore,
         "flap_evict_readmit": scenario_flap_evict_readmit,
+        "compressed_sync_quorum": scenario_compressed_sync_quorum,
         "serve_preempt_mid_overlap": scenario_serve_preempt_mid_overlap,
         "serve_drain_death": scenario_serve_drain_death,
         "serve_queue_overflow": scenario_serve_queue_overflow,

@@ -38,6 +38,8 @@ from torchmetrics_tpu.sketch.state import (
     register_sketch_state,
     sketch_descriptor,
     sketch_state_bytes,
+    sketch_wire_bytes,
+    sketch_wire_kinds,
 )
 
 __all__ = [
@@ -69,5 +71,7 @@ __all__ = [
     "score_bucket",
     "sketch_descriptor",
     "sketch_state_bytes",
+    "sketch_wire_bytes",
+    "sketch_wire_kinds",
     "suffix_counts",
 ]

@@ -79,6 +79,15 @@ class SketchSpec:
             "error_bound": float(self.error_bound),
         }
 
+    @property
+    def wire_kind(self) -> str:
+        """Packed-blob wire codec for this sketch on the compressed sync path
+        (``parallel.compress``): ``"kll"`` packs only the valid leading items per
+        compactor level; ``"counts"`` narrow-int packs integral count grids. Both are
+        LOSSLESS, so decoded merges stay bit-identical (the mergeable-sketch contract
+        survives the wire)."""
+        return "kll" if self.kind == "kll" else "counts"
+
 
 def kll_spec(
     capacity: int = _kll.DEFAULT_CAPACITY, levels: int = _kll.DEFAULT_LEVELS
@@ -135,6 +144,33 @@ def sketch_state_bytes(metric: Any) -> int:
     for name in specs:
         arr = metric._state.tensors.get(name)
         total += int(arr.size * arr.dtype.itemsize) if arr is not None else 0
+    return total
+
+
+def sketch_wire_kinds(metric: Any) -> Optional[Dict[str, str]]:
+    """``{state_name: SketchSpec.kind}`` wire descriptors for ``process_sync``'s codec
+    seam (``sketch_wire=`` keyword), or None for plain metrics. The engine threads
+    this automatically in ``Metric._sync_dist``; it is exposed for bare
+    ``process_sync`` callers (bench lanes, simulated worlds)."""
+    specs = metric.__dict__.get("_sketch_specs")
+    if not specs:
+        return None
+    return {name: spec.kind for name, spec in specs.items()}
+
+
+def sketch_wire_bytes(metric: Any) -> int:
+    """Current PACKED wire footprint of ``metric``'s sketch states in bytes — what the
+    compressed sync actually ships, versus :func:`sketch_state_bytes`'s raw arrays."""
+    from torchmetrics_tpu.parallel import compress as _compress
+
+    specs = metric.__dict__.get("_sketch_specs") or {}
+    total = 0
+    for name, spec in specs.items():
+        arr = metric._state.tensors.get(name)
+        if arr is None:
+            continue
+        blob = _compress.encode_sketch(arr, spec.kind)
+        total += int(blob.nbytes) if blob is not None else int(arr.size * arr.dtype.itemsize)
     return total
 
 

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -73,6 +73,16 @@ obs-smoke:
 online-smoke:
 	python bench.py --online --smoke > /tmp/tm_online_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_online_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; r=ex['online_windowed_vs_plain_overhead']; assert r <= ex['online_overhead_bound'], ('windowed overhead above bound', ex); bits=[v for k,v in ex.items() if k.startswith('online_bit_identical')]; assert bits and all(bits), ex; assert ex['online_drift_quiet_stationary'] and ex['online_drift_alarm_fired_once'], ex; print('online-smoke ok: %.2fx windowed overhead, advance %sus, detector %sus, drift one-shot on shift' % (r, ex['online_advance_cost_us'], ex['online_detector_eval_us']))"
+
+# flight-recorder & post-mortem-bundle lane (docs/observability.md "Flight recorder &
+# post-mortem bundles"): asserts the acceptance bar — the ALWAYS-ON flight-ring record
+# path <= 2us/event (best-of-3), a captured bundle passes strict per-section-CRC
+# validation, obs.memory_ledger() resident bytes match nbytes ground truth within 1%
+# for keyed tables / window rings / sketch states, and the MemoryBudget alarm fires its
+# one-shot warn EXACTLY once on an injected over-budget keyed table (quiet under budget)
+bundle-smoke:
+	python bench.py --flight --smoke > /tmp/tm_bundle_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_bundle_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['flight_record_ok'], ('flight-ring record path above the 2us bound', ex['flight_record_us_per_event']); assert ex['bundle_validates'], ex; assert ex['memory_ledger_ok'], ('memory ledger off nbytes truth', ex['memory_ledger_max_rel_err']); assert ex['memory_budget_quiet_under_budget'] and ex['memory_budget_fires_over_budget'] and ex['memory_budget_warned_exactly_once'], ex; assert set(ex['memory_ledger_kinds']) >= {'tenant_table','window_ring','sketch'}, ex; print('bundle-smoke ok: record %.2fus/event (<=2us), capture %.1fms, ledger err %.1e, budget one-shot' % (ex['flight_record_us_per_event'], ex['bundle_capture_ms'], ex['memory_ledger_max_rel_err']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

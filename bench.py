@@ -1252,6 +1252,150 @@ def obs_main(smoke: bool) -> None:
     )
 
 
+def bench_flight(batch: int, n_batches: int) -> dict:
+    """``--flight`` scenario (docs/observability.md "Flight recorder & post-mortem bundles").
+
+    Four lanes:
+
+    1. **record-path overhead** — the always-on flight ring is NOT gated on telemetry,
+       so its per-event cost is paid on every failure-seam event in production; the
+       acceptance bound is ≤ 2µs/event (best-of-3 — GC/contention spikes must not
+       fail the bound).
+    2. **bundle capture latency** — wall time of one full ``capture_bundle`` (build +
+       per-section CRC + atomic write + fsync), plus strict validation of the result
+       through ``python -m torchmetrics_tpu.obs.bundle validate``'s code path.
+    3. **memory-ledger accuracy** — ``obs.memory_ledger()`` resident-bytes rows vs the
+       ``np.asarray(state).nbytes`` ground truth for a keyed ``[N,...]`` tenant table,
+       an online window ring, and a KLL sketch state; acceptance: within 1%.
+    4. **budget alarm discipline** — a :class:`MemoryBudget` under an injected
+       over-budget keyed table fires its one-shot warning EXACTLY once across repeated
+       evaluations, and stays silent under budget.
+    """
+    import tempfile
+    import warnings
+
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.online import Windowed
+    from torchmetrics_tpu.sketch import StreamingQuantile
+
+    del batch, n_batches  # the flight lanes are event/byte-shaped, not batch-shaped
+    out: dict = {}
+
+    # --- lane 1: always-on record-path overhead ------------------------------------
+    reps = 20_000
+    per_event_us = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(reps):
+            obs.flightrec.record("bench.tick", step=i)
+        per_event_us = min(per_event_us, (time.perf_counter() - t0) / reps * 1e6)
+    out["flight_record_us_per_event"] = round(per_event_us, 3)
+    out["flight_record_bound_us"] = 2.0
+    out["flight_record_ok"] = per_event_us <= 2.0
+
+    # --- lane 2: bundle capture latency + strict validation ------------------------
+    m_ctx = SumMetric()
+    m_ctx.update(np.asarray([1.0, 2.0], np.float32))
+    bdir = tempfile.mkdtemp(prefix="tm-flight-bench-")
+    capture_ms = float("inf")
+    path = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        path = obs.capture_bundle("bench-flight", metric=m_ctx, directory=bdir)
+        capture_ms = min(capture_ms, (time.perf_counter() - t0) * 1e3)
+    out["bundle_capture_ms"] = round(capture_ms, 2)
+    try:
+        verdict = obs.validate_bundle(path)
+        out["bundle_validates"] = bool(verdict["valid"])
+        out["bundle_flight_events"] = verdict["flight_events"]
+    except Exception as err:
+        out["bundle_validates"] = False
+        out["bundle_validate_error"] = repr(err)
+
+    # --- lane 3: memory-ledger accuracy vs nbytes ground truth ---------------------
+    n_keys = 1000
+    keyed = KeyedMetric(SumMetric(nan_strategy="ignore"), n_keys)
+    keyed.update(jnp.asarray(np.arange(64) % n_keys, jnp.int32),
+                 jnp.asarray(np.ones(64, np.float32)))
+    windowed = Windowed(MeanMetric(nan_strategy="ignore"), window=8, advance_every=8, emit=False)
+    windowed.update(jnp.asarray(np.ones(32, np.float32)))
+    sketch = StreamingQuantile(q=0.5)
+    sketch.update(jnp.asarray(np.linspace(0.0, 1.0, 256, dtype=np.float32)))
+    max_rel_err = 0.0
+    kinds_seen = set()
+    for metric, label in ((keyed, "keyed"), (windowed, "windowed"), (sketch, "sketch")):
+        ledger = obs.memory_ledger(metrics=[metric], cross_check=False)
+        truth = sum(np.asarray(v).nbytes for v in metric._state.tensors.values()) + sum(
+            np.asarray(e).nbytes for vs in metric._state.lists.values() for e in vs
+        )
+        got = ledger["totals"]["resident_bytes"]
+        rel = abs(got - truth) / truth if truth else 0.0
+        max_rel_err = max(max_rel_err, rel)
+        kinds_seen.update(r["kind"] for r in ledger["rows"])
+        out[f"memory_ledger_bytes_{label}"] = got
+        out[f"memory_truth_bytes_{label}"] = int(truth)
+    out["memory_ledger_max_rel_err"] = round(max_rel_err, 6)
+    out["memory_ledger_err_bound"] = 0.01
+    out["memory_ledger_ok"] = max_rel_err <= 0.01
+    out["memory_ledger_kinds"] = sorted(kinds_seen)
+    out["memory_resident_bytes_total"] = obs.memory_ledger(cross_check=False)["totals"][
+        "resident_bytes"
+    ]
+
+    # --- lane 4: MemoryBudget one-shot alarm discipline ----------------------------
+    keyed_bytes = int(out["memory_ledger_bytes_keyed"])
+    quiet = obs.MemoryBudget(
+        bytes=keyed_bytes * 10, name="bench-quiet", metrics=[keyed], windows=((60.0, 1.0),)
+    )
+    loud = obs.MemoryBudget(
+        bytes=max(1, keyed_bytes // 2), name="bench-loud", metrics=[keyed],
+        windows=((60.0, 1.0),),
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        quiet_burning = any(s.burning for _ in range(3) for s in quiet.evaluate())
+        loud_burning = all(s.burning for _ in range(3) for s in loud.evaluate())
+    alarm_warns = [w for w in caught if "bench-loud" in str(w.message)]
+    quiet_warns = [w for w in caught if "bench-quiet" in str(w.message)]
+    out["memory_budget_quiet_under_budget"] = not quiet_burning and not quiet_warns
+    out["memory_budget_fires_over_budget"] = bool(loud_burning)
+    out["memory_budget_warned_exactly_once"] = len(alarm_warns) == 1
+    out["flight_events_total"] = obs.telemetry.counter("flight.events").value
+    out["bundles_captured_total"] = obs.telemetry.counter("flight.bundles_captured").value
+    return out
+
+
+def flight_main(smoke: bool) -> None:
+    """``bench.py --flight [--smoke]``: one JSON line with the flight-recorder proof."""
+    extras = bench_flight(*((256, 16) if smoke else (2048, 64)))
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "flight_record_us_per_event",
+                "value": extras["flight_record_us_per_event"],
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "per-event cost of the ALWAYS-ON flight ring record path (bound:"
+                    " 2us); bundle capture latency + strict validation, memory-ledger"
+                    " accuracy vs nbytes ground truth, and MemoryBudget one-shot alarm"
+                    " evidence in extras"
+                ),
+                "vs_baseline": None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_online(batch: int, n_batches: int) -> dict:
     """``--online`` scenario (docs/online.md): windowed monitoring on the hot path.
 
@@ -2135,6 +2279,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         obs_main(smoke)
+    elif "--flight" in sys.argv:
+        # flight-recorder & post-mortem-bundle lane (make bundle-smoke /
+        # docs/observability.md "Flight recorder"): smoke pins CPU like the other lanes
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        flight_main(smoke)
     elif "--online" in sys.argv:
         # online windowed-monitoring lane (make online-smoke / docs/online.md): smoke
         # pins CPU like the other lanes; full mode probes for a healthy platform

@@ -1,0 +1,127 @@
+"""TPU019: silent broad exception swallow on serve/sync/robust seam functions."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+SEAM_PATH = "torchmetrics_tpu/serve/engine.py"
+
+
+def _tpu019(source: str, path: str = SEAM_PATH):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU019"]
+
+
+SILENT = """
+def drain(engine, batch):
+    try:
+        engine.apply(batch)
+    except Exception:
+        pass
+"""
+
+RECORDED = """
+from torchmetrics_tpu import obs
+
+def drain(engine, batch):
+    try:
+        engine.apply(batch)
+    except Exception as err:
+        obs.flightrec.record("serve.apply_failure", error=repr(err))
+"""
+
+
+class TestSeamScope:
+    def test_silent_swallow_in_serve_module_flags(self):
+        findings = _tpu019(SILENT)
+        assert len(findings) == 1
+        assert "swallows silently" in findings[0].message
+
+    def test_robust_module_and_parallel_sync_are_seams(self):
+        assert len(_tpu019(SILENT, path="torchmetrics_tpu/robust/journal.py")) == 1
+        assert len(_tpu019(SILENT, path="torchmetrics_tpu/parallel/sync.py")) == 1
+
+    def test_non_seam_module_is_out_of_scope(self):
+        assert _tpu019(SILENT, path="torchmetrics_tpu/ops/dispatch.py") == []
+        assert _tpu019(SILENT, path="torchmetrics_tpu/obs/bundle.py") == []
+
+
+class TestHandlerShapes:
+    def test_bare_except_and_base_exception_flag(self):
+        bare = SILENT.replace("except Exception:", "except:")
+        base = SILENT.replace("except Exception:", "except BaseException:")
+        assert len(_tpu019(bare)) == 1 and len(_tpu019(base)) == 1
+
+    def test_broad_member_of_tuple_flags(self):
+        src = SILENT.replace("except Exception:", "except (ValueError, Exception):")
+        assert len(_tpu019(src)) == 1
+
+    def test_narrow_handler_is_clean(self):
+        src = SILENT.replace("except Exception:", "except OSError:")
+        assert _tpu019(src) == []
+
+    def test_silent_continue_in_loop_flags(self):
+        src = """
+def drain(engine, batches):
+    for b in batches:
+        try:
+            engine.apply(b)
+        except Exception:
+            continue
+"""
+        assert len(_tpu019(src)) == 1
+
+
+class TestAbsorptionIsVisible:
+    def test_reraise_is_clean(self):
+        src = SILENT.replace("pass", "raise")
+        assert _tpu019(src) == []
+
+    def test_fallback_return_is_clean(self):
+        src = SILENT.replace("pass", "return None")
+        assert _tpu019(src) == []
+
+    def test_flight_record_is_clean(self):
+        assert _tpu019(RECORDED) == []
+
+    def test_telemetry_counter_is_clean(self):
+        src = SILENT.replace("pass", 'telemetry.counter("serve.apply_failures").inc()')
+        assert _tpu019(src) == []
+
+    def test_rank_zero_warn_is_clean(self):
+        src = SILENT.replace("pass", 'rank_zero_warn("absorbed", UserWarning)')
+        assert _tpu019(src) == []
+
+    def test_logger_call_is_clean(self):
+        src = SILENT.replace("pass", 'logger.warning("absorbed")')
+        assert _tpu019(src) == []
+
+
+class TestExemptions:
+    def test_dunder_del_is_exempt(self):
+        src = """
+class Proxy:
+    def __del__(self):
+        try:
+            self._lock.release()
+        except Exception:
+            pass
+"""
+        assert _tpu019(src, path="torchmetrics_tpu/robust/journal.py") == []
+
+    def test_inline_disable_waives(self):
+        src = """
+def probe():
+    try:
+        return backend_world()
+    except Exception:  # jaxlint: disable=TPU019 - capability probe
+        world = 1
+    return world
+"""
+        assert _tpu019(src, path="torchmetrics_tpu/parallel/sync.py") == []
+
+
+class TestRegistry:
+    def test_rule_registered_with_metadata(self):
+        meta = RULE_META["TPU019"]
+        assert meta["severity"] == "warning"
+        assert "swallows" in meta["summary"] or "seam" in meta["summary"]

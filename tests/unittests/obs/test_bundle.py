@@ -1,0 +1,231 @@
+"""Post-mortem bundles: capture, per-section CRC validation, CLI, cursor replay."""
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.obs import bundle as bundle_mod
+from torchmetrics_tpu.robust import journal as journal_mod
+from torchmetrics_tpu.utils.exceptions import BundleError
+
+
+def _capture(tmp_path, reason="test", metric=None, **kw):
+    path = obs.capture_bundle(reason, metric=metric, directory=str(tmp_path), **kw)
+    assert path is not None
+    return path
+
+
+class TestCaptureAndValidate:
+    def test_round_trip_has_required_sections(self, tmp_path):
+        obs.flightrec.record("test.event", detail=1)
+        path = _capture(tmp_path)
+        doc = bundle_mod.load_bundle(path)
+        for section in bundle_mod.REQUIRED_SECTIONS:
+            assert section in doc["sections"], section
+        summary = obs.validate_bundle(path)
+        assert summary["valid"] and summary["reason"] == "test"
+
+    def test_metric_context_records_state_shapes(self, tmp_path):
+        m = SumMetric()
+        m.update(np.asarray([1.0, 2.0], np.float32))
+        path = _capture(tmp_path, metric=m)
+        doc = bundle_mod.load_bundle(path)
+        sec = doc["sections"]["metric"]
+        assert sec["class"] == "SumMetric" and sec["update_count"] == 1
+        assert sec["states"]["sum_value"]["shape"] == ()
+
+    def test_dump_diagnostics_public_api(self, tmp_path):
+        m = MeanMetric()
+        m.update(np.asarray([3.0], np.float32))
+        path = m.dump_diagnostics(directory=str(tmp_path))
+        assert path is not None and obs.validate_bundle(path)["reason"] == "manual"
+
+    def test_container_corruption_detected(self, tmp_path):
+        path = _capture(tmp_path)
+        raw = bytearray(open(path, "rb").read())
+        raw[-3] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(BundleError, match="checksum"):
+            bundle_mod.load_bundle(path)
+
+    def test_not_a_bundle_rejected(self, tmp_path):
+        path = tmp_path / "junk.tmb"
+        path.write_bytes(b"hello world, definitely not a bundle")
+        with pytest.raises(BundleError, match="magic"):
+            obs.validate_bundle(str(path))
+
+    def test_section_crc_violation_named(self, tmp_path):
+        path = _capture(tmp_path)
+        doc = bundle_mod.load_bundle(path)
+        # re-encode with one section's bytes flipped under its stale CRC
+        import pickle
+        import struct
+        import zlib
+
+        packed = {
+            name: {"crc": zlib.crc32(pickle.dumps(objv)) & 0xFFFFFFFF,
+                   "data": pickle.dumps(objv)}
+            for name, objv in doc["sections"].items()
+        }
+        bad = bytearray(packed["flight"]["data"])
+        bad[-1] ^= 0xFF
+        packed["flight"]["data"] = bytes(bad)
+        payload = pickle.dumps(
+            {**{k: v for k, v in doc.items() if k != "sections"}, "sections": packed}
+        )
+        open(path, "wb").write(
+            bundle_mod.BUNDLE_MAGIC
+            + struct.Struct("<IQ").pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+            + payload
+        )
+        with pytest.raises(BundleError, match="flight"):
+            obs.validate_bundle(path)
+        lenient = bundle_mod.load_bundle(path, strict=False)
+        assert "flight" in lenient["_section_errors"]
+
+    def test_disabled_switch_returns_none(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(bundle_mod.ENV_BUNDLES, "0")
+        assert obs.capture_bundle("off", directory=str(tmp_path)) is None
+
+    def test_capture_dir_scopes_and_last_path_tracks(self, tmp_path):
+        with bundle_mod.capture_dir(str(tmp_path / "scoped")):
+            path = obs.capture_bundle("scoped-reason")
+        assert path is not None and str(tmp_path / "scoped") in path
+        assert obs.last_bundle_path() == path
+
+    def test_pruning_keeps_newest(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(bundle_mod.ENV_BUNDLE_KEEP, "3")
+        for i in range(6):
+            _capture(tmp_path, reason=f"r{i}")
+        names = sorted(n for n in os.listdir(tmp_path) if n.endswith(".tmb"))
+        assert len(names) == 3
+
+
+class TestCli:
+    def test_validate_exit_codes(self, tmp_path, capsys):
+        good = _capture(tmp_path)
+        assert bundle_mod.main(["validate", good]) == 0
+        bad = tmp_path / "bad.tmb"
+        bad.write_bytes(b"nope")
+        assert bundle_mod.main(["validate", str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "ok" in out and "INVALID" in out
+
+    def test_inspect_renders_sections(self, tmp_path, capsys):
+        obs.flightrec.record("inspect.me", x=7)
+        m = SumMetric()
+        path = _capture(tmp_path, reason="inspect-test", metric=m)
+        assert bundle_mod.main(["inspect", path]) == 0
+        out = capsys.readouterr().out
+        assert "inspect-test" in out and "flight:" in out and "memory:" in out
+        assert "SumMetric" in out
+
+    def test_diff_shows_counter_and_flight_movement(self, tmp_path, capsys):
+        a = _capture(tmp_path, reason="before")
+        obs.telemetry.counter("diff.demo").inc(5)
+        obs.flightrec.record("diff.event")
+        b = _capture(tmp_path, reason="after")
+        assert bundle_mod.main(["diff", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "diff.demo" in out and "+5" in out
+        assert "diff.event" in out
+
+
+class TestMergedView:
+    def test_merged_bundle_gathers_per_rank_payloads(self, tmp_path):
+        def fake_gather(payload):
+            other = json.loads(payload)
+            other = dict(other, rank=1)
+            return [payload, json.dumps(other)]
+
+        path = obs.capture_bundle(
+            "merged", directory=str(tmp_path), merged=True, gather_fn=fake_gather
+        )
+        doc = bundle_mod.load_bundle(path)
+        ranks = doc["sections"]["ranks"]
+        assert [r["rank"] for r in ranks] == [0, 1]
+        assert all("memory_totals" in r and "flight" in r for r in ranks)
+        rendered = bundle_mod.inspect_bundle(path)
+        assert "merged view over 2 rank(s)" in rendered
+
+
+class TestJournalCursorReplay:
+    def test_bundle_carries_live_journal_cursor(self, tmp_path):
+        jdir = str(tmp_path / "wal")
+        jr = journal_mod.Journal(jdir)
+        jr.append((np.asarray([1.0], np.float32),), {})
+        jr.append((np.asarray([2.0], np.float32),), {})
+        path = _capture(tmp_path / "bundles", reason="cursor")
+        cursor = obs.validate_bundle(path)["journal_cursor"]
+        assert cursor["path"] == jdir and cursor["last_seq"] == 1
+
+    def test_recover_through_bundle_cursor_is_bit_identical(self, tmp_path):
+        jdir = str(tmp_path / "wal")
+        jr = journal_mod.Journal(jdir)
+        batches = [np.asarray([float(i)], np.float32) for i in range(5)]
+        live = SumMetric()
+        for i, b in enumerate(batches):
+            jr.append((b,), {})
+            live.update(b)
+            if i == 2:  # the "crash instant": bundle pins the cursor at seq 2
+                crash_state = np.asarray(live._state.tensors["sum_value"]).tobytes()
+                bundle_path = _capture(tmp_path / "bundles", reason="preempt", metric=live)
+        # ordinary recovery replays the whole tail (seq 0..4)
+        full = SumMetric()
+        assert journal_mod.recover(full, jdir)["replayed"] == 5
+        # cursor-bounded recovery stops at the captured instant (seq 0..2)
+        snap = SumMetric()
+        recovery = journal_mod.MetricJournal.recover(snap, jdir, cursor=bundle_path)
+        assert recovery["replayed"] == 3 and recovery["through_seq"] == 2
+        assert np.asarray(snap._state.tensors["sum_value"]).tobytes() == crash_state
+        assert np.asarray(full._state.tensors["sum_value"]).tobytes() != crash_state
+
+    def test_cursor_accepts_int_dict_and_document(self, tmp_path):
+        jdir = str(tmp_path / "wal")
+        jr = journal_mod.Journal(jdir)
+        for i in range(4):
+            jr.append((np.asarray([1.0], np.float32),), {})
+        path = _capture(tmp_path / "bundles", reason="forms")
+        doc = bundle_mod.load_bundle(path)
+        # the captured document's own cursor points at the journal tail (seq 3)
+        for cursor, expect in ((1, 2), ({"last_seq": 1}, 2), (doc, 4)):
+            m = SumMetric()
+            assert journal_mod.recover(m, jdir, cursor=cursor)["replayed"] == expect
+
+    def test_unusable_cursor_raises(self, tmp_path):
+        from torchmetrics_tpu.utils.exceptions import JournalError
+
+        with pytest.raises(JournalError, match="cursor"):
+            journal_mod.recover(SumMetric(), str(tmp_path), cursor=object())
+
+
+class TestFailureSeamsCapture:
+    def test_nan_poison_raise_captures_bundle(self, tmp_path, monkeypatch):
+        from torchmetrics_tpu.utils.exceptions import NumericPoisonError
+
+        monkeypatch.setenv(bundle_mod.ENV_BUNDLE_DIR, str(tmp_path))
+        captured0 = obs.telemetry.counter("flight.bundles_captured").value
+        m = SumMetric(nan_policy="raise")
+        m.update(np.asarray([1.0, float("nan")], np.float32))
+        with pytest.raises(NumericPoisonError):
+            m.compute()
+        assert obs.telemetry.counter("flight.bundles_captured").value > captured0
+        assert any(
+            e["kind"] == "nan.poison" for e in obs.flightrec.events()
+        )
+        assert obs.validate_bundle(obs.last_bundle_path())["reason"] == "nan_poison"
+
+    def test_capture_failure_degrades_to_warning(self, tmp_path):
+        fails0 = obs.telemetry.counter("flight.bundle_capture_failures").value
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where the capture dir should go")
+        with pytest.warns(UserWarning, match="bundle capture"):
+            out = obs.capture_bundle("doomed", directory=str(blocker))
+        assert out is None
+        assert obs.telemetry.counter("flight.bundle_capture_failures").value == fails0 + 1

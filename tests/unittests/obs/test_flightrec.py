@@ -1,0 +1,82 @@
+"""Flight recorder: always-on ring semantics, sequence monotonicity, bounds."""
+from __future__ import annotations
+
+import threading
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.obs.flightrec import FlightRecorder
+
+
+class TestRecorder:
+    def test_record_is_always_on_regardless_of_telemetry(self):
+        rec = FlightRecorder()
+        prev = obs.telemetry.enabled
+        obs.telemetry.enabled = False
+        try:
+            rec.record("sync.downgrade", level="quorum")
+        finally:
+            obs.telemetry.enabled = prev
+        (evt,) = rec.events()
+        assert evt["kind"] == "sync.downgrade" and evt["level"] == "quorum"
+
+    def test_sequence_numbers_are_process_monotonic(self):
+        a, b = FlightRecorder(), FlightRecorder()
+        s1 = a.record("x")
+        s2 = b.record("y")
+        s3 = a.record("z")
+        assert s1 < s2 < s3
+        assert a.last_seq == s3 and b.last_seq == s2
+
+    def test_bounded_ring_counts_dropped(self):
+        rec = FlightRecorder(maxlen=4)
+        for i in range(10):
+            rec.record("tick", i=i)
+        assert len(rec) == 4 and rec.dropped == 6
+        snap = rec.snapshot()
+        assert snap["recorded"] == 10 and snap["dropped"] == 6
+        assert [e["i"] for e in snap["events"]] == [6, 7, 8, 9]
+
+    def test_snapshot_orders_by_sequence(self):
+        rec = FlightRecorder()
+        barrier = threading.Barrier(4)
+
+        def spam():
+            barrier.wait()
+            for _ in range(200):
+                rec.record("race")
+
+        threads = [threading.Thread(target=spam) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        seqs = [e["seq"] for e in rec.snapshot()["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_record_bumps_always_on_counter(self):
+        before = obs.telemetry.counter("flight.events").value
+        obs.flightrec.record("counter.check")
+        assert obs.telemetry.counter("flight.events").value == before + 1
+
+    def test_clear_resets_ring_and_highwater(self):
+        rec = FlightRecorder()
+        rec.record("a")
+        rec.clear()
+        assert len(rec) == 0 and rec.dropped == 0 and rec.last_seq == 0
+
+
+class TestSummaryFamilies:
+    def test_summary_always_tabulates_flight_and_memory_rows(self):
+        from torchmetrics_tpu.obs.telemetry import Telemetry
+
+        text = obs.summary(Telemetry(enabled=False))
+        assert "flight.events" in text
+        assert "flight.bundles_captured" in text
+        assert "memory.resident_bytes" in text
+        assert "memory.metrics_tracked" in text
+
+    def test_bench_extras_carry_flight_fields(self):
+        extras = obs.bench_extras()
+        assert "flight_events" in extras and "bundles_captured" in extras
+        assert "memory_resident_bytes" in extras
+        assert isinstance(extras["memory_resident_bytes"], int)

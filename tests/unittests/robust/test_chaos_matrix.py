@@ -36,6 +36,12 @@ class TestChaosMatrixSweep:
         results = matrix.run(n_batches=6, via=("forward", "update"))
         summary = _assert_all_passed(results)
         assert summary["cells"] == len(chaos.ChaosMatrix.SCENARIOS) * 2
+        # post-mortem contract (docs/observability.md): EVERY scenario cell captures at
+        # least one bundle, and every captured bundle passes strict validation
+        for r in results:
+            evidence = r["bundles"]
+            assert evidence["captured"] >= 1, (r["scenario"], evidence)
+            assert evidence["validated"] == evidence["captured"], (r["scenario"], evidence)
 
     @pytest.mark.parametrize("cls", [SumMetric, MeanMetric, CatMetric])
     def test_preemption_mid_buffered_window(self, cls, tmp_path):
@@ -145,6 +151,30 @@ class TestScenarioEvidence:
             assert cell["dropped_in_window"] > 0  # the preemption really hit mid-overlap
             assert cell["replayed"] == result["preempt_step"] + 1
             assert cell["windows_advanced"] >= 1
+            # post-mortem contract: replay from the strike bundle's journal cursor
+            # reconstructed the ring byte-identically (bookkeeping scalars included)
+            assert cell["bundle_replay_identical"] is True, (variant, cell)
+
+    def test_serve_preemption_replays_from_bundle_cursor(self, tmp_path):
+        matrix = chaos.ChaosMatrix(
+            SumMetric, workdir=str(tmp_path), seed=SEED,
+            scenarios=("serve_preempt_mid_overlap",),
+        )
+        (result,) = matrix.run(n_batches=6)
+        assert result["passed"]
+        for variant in ("plain", "keyed", "sharded"):
+            cell = result[variant]
+            # the strike's bundle pinned the journal cursor at the abandoned instant;
+            # recover(cursor=bundle) must land byte-identically with plain recovery
+            assert cell["bundle_replay_identical"] is True, (variant, cell)
+        # the captured bundles themselves validate strictly — and at least one is the
+        # engine-abandonment capture whose journal cursor drove the replay above
+        evidence = result["bundles"]
+        assert evidence["validated"] == evidence["captured"] >= 1
+        from torchmetrics_tpu import obs
+
+        reasons = [obs.validate_bundle(p)["reason"] for p in evidence["paths"]]
+        assert "serve_abandoned" in reasons
 
     def test_online_scenario_substitutes_unwindowable_templates(self, tmp_path):
         matrix = chaos.ChaosMatrix(

@@ -211,6 +211,15 @@ RULE_META: Dict[str, Dict[str, str]] = {
                " state as a sketch (packed lossless wire), or keep compression='none'"
                " for this metric",
     },
+    "TPU019": {
+        "severity": "warning",
+        "summary": "broad except that swallows silently (no re-raise, no telemetry/"
+                   "flight-ring record, no fallback return) on a serve/sync/robust seam",
+        "example": "def drain(self):\n    try: apply(batch)\n    except Exception: pass",
+        "fix": "re-raise, return an explicit degraded value, or record the absorption"
+               " (telemetry counter / obs.flightrec.record / rank_zero_warn) — a"
+               " swallowed failure on a recovery seam is an observability kill",
+    },
 }
 
 #: rule id -> one-line description (derived view of :data:`RULE_META`; kept for the CLI,
@@ -2357,10 +2366,114 @@ def _rule_tpu018(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU019 helpers
+#: final call-name segments that count as "the absorption was recorded" — telemetry
+#: instruments, flight-ring records, structured logging, warning emission
+_TPU019_OBS_CALL_NAMES = {
+    "inc", "record", "event", "observe", "bump", "push",
+    "warn", "warning", "error", "exception", "critical", "log",
+    "capture_bundle", "rank_zero_warn", "_fire",
+}
+#: dotted-path segments that mark a call as an observability hook regardless of its
+#: final name (obs.x(...), telemetry.x(...), flightrec.x(...), logger.x(...))
+_TPU019_OBS_MODULES = {"obs", "telemetry", "flightrec", "trace", "bundle", "logger", "logging"}
+
+
+def _is_seam_file(path: str) -> bool:
+    """Modules that ARE the serve/sync/robust seams: any ``serve``/``robust`` directory
+    segment, or a ``sync.py`` living under a ``parallel`` directory."""
+    parts = path.replace("\\", "/").split("/")
+    dirs = parts[:-1]
+    if "serve" in dirs or "robust" in dirs:
+        return True
+    return parts[-1] == "sync.py" and "parallel" in dirs
+
+
+def _tpu019_broad_type(expr: Optional[ast.AST]) -> Optional[str]:
+    """Display name when the except clause is broad (bare / Exception / BaseException,
+    alone or inside a tuple); None for narrow handlers."""
+    if expr is None:
+        return "bare except"
+    candidates = list(expr.elts) if isinstance(expr, ast.Tuple) else [expr]
+    for cand in candidates:
+        name = _final_name(cand)
+        if name in ("Exception", "BaseException"):
+            return f"except {name}"
+    return None
+
+
+def _tpu019_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises, nor returns a fallback, nor records.
+
+    A ``return`` is a documented-degrade idiom (the caller receives an explicit
+    fallback value); a ``raise`` propagates; any observability call — telemetry
+    counter/event, flight-ring record, ``rank_zero_warn``, logger — makes the
+    absorption visible. Everything else lets execution fall through as if the
+    exception never happened: the silent-failure shape this rule exists for.
+    """
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return)):
+            return False
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted[-1] in _TPU019_OBS_CALL_NAMES:
+                return False
+            if any(part in _TPU019_OBS_MODULES for part in dotted[:-1]):
+                return False
+    return True
+
+
+def _rule_tpu019(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Silent broad exception swallow on a serve/sync/robust seam function.
+
+    The recovery seams — the async drain, the bounded sync, the journal, the chaos
+    harness — are exactly where a swallowed exception costs the most: the engine keeps
+    running, the state is quietly wrong or quietly short, and the flight recorder /
+    post-mortem bundle that should explain the failure never heard about it
+    (docs/observability.md "Flight recorder"). On those modules a broad handler
+    (``except:``, ``except Exception:``, ``except BaseException:``) must do at least
+    one of: re-raise, ``return`` an explicit fallback value, or record the absorption
+    through an observability hook (telemetry counter/event, ``obs.flightrec.record``,
+    ``rank_zero_warn``, a logger).
+
+    Boundary: scoped to seam modules (``serve/``/``robust/`` directories and
+    ``parallel/sync.py``) — probe-with-fallback handlers elsewhere are out of scope,
+    and ``__del__`` is exempt everywhere (GC teardown has no caller to inform and no
+    safe hook to call). Narrow handlers (``except OSError:``) stay untouched: catching
+    a *named* failure class is a decision; catching everything silently is not.
+    """
+    if not _is_seam_file(path):
+        return []
+    out: List[Finding] = []
+    for info in model.functions:
+        if info.name == "__del__":
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                broad = _tpu019_broad_type(handler.type)
+                if broad is None or not _tpu019_swallows(handler):
+                    continue
+                out.append(_finding(
+                    "TPU019", path, handler, lines,
+                    f"{broad} in {info.qualname!r} swallows silently on a"
+                    " serve/sync/robust seam: no re-raise, no fallback return, no"
+                    " telemetry/flight-ring record — the failure becomes invisible to"
+                    " the flight recorder and every post-mortem bundle. Re-raise,"
+                    " return an explicit degraded value, or record the absorption"
+                    " (obs.flightrec.record / a telemetry counter / rank_zero_warn).",
+                ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
     _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017, _rule_tpu018,
+    _rule_tpu019,
 )
 
 

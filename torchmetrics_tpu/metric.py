@@ -243,6 +243,9 @@ class Metric:
         self._tm_counts: Dict[str, int] = {}
         self._tm_times: Dict[str, float] = {}
         self._tm_retrace_warned = False
+        # HBM memory ledger (docs/observability.md "Memory ledger"): a WeakSet add, so
+        # obs.memory_ledger() can walk live metrics without extending any lifetime
+        obs.memory.track(self)
 
     # ------------------------------------------------------------------ state
     @property
@@ -1470,11 +1473,17 @@ class Metric:
         if not cnt:
             return
         obs.telemetry.counter("robust.nonfinite_detected").inc(cnt)
+        obs.flightrec.record(
+            "nan.poison", metric=type(self).__name__, count=cnt, policy=policy
+        )
         msg = (
             f"{type(self).__name__} accumulated {cnt} non-finite input value(s)"
             f" (nan_policy={policy!r})."
         )
         if policy == "raise":
+            # the state is unusable from here: land the post-mortem bundle BEFORE the
+            # raise so the flight ring and counters survive the process that dies on it
+            obs.capture_bundle("nan_poison", metric=self)
             raise NumericPoisonError(
                 msg + " The accumulator state is poisoned; reset() or restore() a clean snapshot."
             )
@@ -1518,6 +1527,22 @@ class Metric:
         """
         _checkpoint.restore_metric(self, blob)
 
+    def dump_diagnostics(
+        self, reason: str = "manual", directory: Optional[Any] = None
+    ) -> Optional[str]:
+        """Capture a post-mortem flight bundle for THIS metric, on demand.
+
+        The explicit twin of the automatic failure-seam captures: the written bundle
+        carries the flight ring, the full telemetry snapshot, this metric's state
+        shapes/bytes and last :class:`~torchmetrics_tpu.parallel.sync.SyncedState`
+        summary, the write-ahead journal cursor (when serving with a WAL), the memory
+        ledger, and an env fingerprint — inspect/validate/diff it with ``python -m
+        torchmetrics_tpu.obs.bundle`` (docs/observability.md "Flight recorder &
+        post-mortem bundles"). Returns the written path, or None when bundling is
+        disabled (``TM_TPU_BUNDLES=0``) or capture failed (warned, never raised).
+        """
+        return obs.capture_bundle(reason, metric=self, directory=directory)
+
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self.forward(*args, **kwargs)
 
@@ -1547,6 +1572,7 @@ class Metric:
                 new.__dict__[k] = None
             else:
                 new.__dict__[k] = deepcopy(v, memo)
+        obs.memory.track(new)  # clones hold their own resident buffers: ledger them
         return new
 
     def __getstate__(self) -> Dict[str, Any]:
@@ -1591,6 +1617,7 @@ class Metric:
                 k: ([jnp.asarray(e) for e in v] if isinstance(v, list) else jnp.asarray(v))
                 for k, v in self.__dict__["_cache"].items()
             }
+        obs.memory.track(self)  # an unpickled metric resides on this process's devices
 
     def persistent(self, mode: bool = False) -> None:
         """Flip persistence of all states (reference ``metric.py:826``)."""

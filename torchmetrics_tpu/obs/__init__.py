@@ -70,23 +70,34 @@ from torchmetrics_tpu.obs.profiler import (
     set_profiling,
     timing_summary,
 )
-from torchmetrics_tpu.obs import openmetrics, slo, timeseries, trace  # noqa: F401
+from torchmetrics_tpu.obs import flightrec, openmetrics, slo, timeseries, trace  # noqa: F401
+from torchmetrics_tpu.obs import bundle, memory  # noqa: F401  (after flightrec: bundle reads it)
+from torchmetrics_tpu.obs.bundle import capture_bundle, last_bundle_path, validate_bundle
+from torchmetrics_tpu.obs.memory import MemoryBudget, memory_ledger
 from torchmetrics_tpu.obs.openmetrics import serve_scrape
 from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_drift_specs, default_serve_specs
 from torchmetrics_tpu.obs.timeseries import TimeSeries
 
 __all__ = [
     "Gauge",
+    "MemoryBudget",
     "SloMonitor",
     "SloSpec",
     "TimeSeries",
+    "bundle",
+    "capture_bundle",
     "default_drift_specs",
     "default_serve_specs",
+    "flightrec",
+    "last_bundle_path",
+    "memory",
+    "memory_ledger",
     "openmetrics",
     "serve_scrape",
     "slo",
     "timeseries",
     "trace",
+    "validate_bundle",
     "ENV_FLAG",
     "ENV_PROFILE",
     "ENV_RETRACE_THRESHOLD",

@@ -1,0 +1,608 @@
+"""Crash-consistent post-mortem bundles: the flight recorder's durable landing zone.
+
+A **bundle** is one atomically-written, versioned, per-section-CRC'd file capturing
+everything a post-mortem needs at the instant a failure seam fired: the flight ring
+(:mod:`torchmetrics_tpu.obs.flightrec`), the full counter/gauge/series snapshot, a
+recent Perfetto trace slice, the rank-health ledger, the metric's last
+:class:`~torchmetrics_tpu.parallel.sync.SyncedState` summary, the write-ahead journal
+cursor (so :func:`torchmetrics_tpu.robust.journal.recover` can replay **bit-identically**
+to the captured instant), the HBM memory ledger, and an environment/config fingerprint.
+
+:func:`capture_bundle` fires from every failure seam — ``SyncTimeoutError`` propagation,
+drain death/``ServeError``, ``JournalError`` corruption, ``NumericPoisonError``, chaos
+injections, engine abandonment — and from the explicit ``Metric.dump_diagnostics()``
+API. Capture is **best-effort by contract**: a failure path must never be turned into a
+second failure, so any capture-time error degrades to a counted warning
+(``flight.bundle_capture_failures``) instead of raising.
+
+Disk container (``.tmb``): ``TMBDL1\\n`` magic + little-endian ``(crc32, length)`` over a
+pickled document whose ``sections`` map holds each section as its OWN pickled byte blob
+with its OWN crc32 — a torn or bit-flipped section is named precisely by ``validate``
+instead of poisoning the whole read. Writes go through the shared
+:func:`~torchmetrics_tpu.robust.checkpoint.atomic_write_bytes` (tmp + ``os.replace`` +
+fsync of file and directory), so a preemption mid-capture leaves either no bundle or a
+complete one — never garbage.
+
+CLI::
+
+    python -m torchmetrics_tpu.obs.bundle inspect  <bundle.tmb>
+    python -m torchmetrics_tpu.obs.bundle validate <bundle.tmb> [...]   # exit 0/1
+    python -m torchmetrics_tpu.obs.bundle diff     <a.tmb> <b.tmb>
+
+The rank-zero **merged view** (``capture_bundle(..., merged=True)``) gathers every
+rank's core payload (flight ring, counters, memory totals) over the same gather seam the
+sync layer and the OpenMetrics merged scrape use (injectable ``gather_fn`` for tests;
+``gather_all_arrays`` uint8 payloads at world > 1) and lands them in a ``ranks``
+section of one rank-zero bundle — one file tells the whole pod's story.
+
+Env knobs: ``TM_TPU_BUNDLE_DIR`` (capture directory; default
+``<tmp>/tm-tpu-bundles``), ``TM_TPU_BUNDLES=0`` (master off switch),
+``TM_TPU_BUNDLE_KEEP`` (retained bundles per directory, default 64).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import pickle
+import struct
+import sys
+import tempfile
+import zlib
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+from torchmetrics_tpu.obs import flightrec
+from torchmetrics_tpu.obs.telemetry import telemetry
+from torchmetrics_tpu.utils.exceptions import BundleError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "FORMAT", "VERSION", "REQUIRED_SECTIONS", "BundleError",
+    "build_bundle", "capture_bundle", "load_bundle", "validate_bundle",
+    "inspect_bundle", "diff_bundles", "last_bundle_path", "capture_dir", "main",
+]
+
+FORMAT = "tm-tpu-flight-bundle"
+VERSION = 1
+SUFFIX = ".tmb"
+BUNDLE_MAGIC = b"TMBDL1\n"
+_DISK_HEADER = struct.Struct("<IQ")
+
+ENV_BUNDLE_DIR = "TM_TPU_BUNDLE_DIR"
+ENV_BUNDLES = "TM_TPU_BUNDLES"
+ENV_BUNDLE_KEEP = "TM_TPU_BUNDLE_KEEP"
+
+#: sections every bundle must carry (``validate`` enforces presence + per-section CRC)
+REQUIRED_SECTIONS = (
+    "flight", "telemetry", "trace", "health", "sync", "journal", "memory", "env",
+)
+
+#: recent Perfetto events retained per source ring (telemetry log + serve-trace ring)
+_TRACE_SLICE = 512
+
+_capture_seq = itertools.count(1).__next__
+_last_path: Optional[str] = None
+_dir_override: Optional[str] = None
+
+
+def _enabled() -> bool:
+    return str(os.environ.get(ENV_BUNDLES, "1")).strip().lower() not in ("0", "false", "no", "off")
+
+
+def _default_dir() -> str:
+    if _dir_override is not None:
+        return _dir_override
+    return os.environ.get(ENV_BUNDLE_DIR) or os.path.join(tempfile.gettempdir(), "tm-tpu-bundles")
+
+
+@contextmanager
+def capture_dir(path: Union[str, os.PathLike]) -> Iterator[str]:
+    """Scope every auto-capture inside the block to ``path`` (chaos cells, tests)."""
+    global _dir_override
+    prev = _dir_override
+    _dir_override = os.fspath(path)
+    try:
+        yield _dir_override
+    finally:
+        _dir_override = prev
+
+
+def last_bundle_path() -> Optional[str]:
+    """Path of the most recently captured bundle in this process (None before any)."""
+    return _last_path
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+# ------------------------------------------------------------------ section builders
+def _env_section() -> Dict[str, Any]:
+    """Environment/config fingerprint: enough to answer "what build, what knobs"."""
+    out: Dict[str, Any] = {"python": sys.version.split()[0]}
+    try:
+        from torchmetrics_tpu.__about__ import __version__
+
+        out["package_version"] = __version__
+    except Exception:
+        out["package_version"] = None
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+        out["backend"] = jax.default_backend()
+        out["device_count"] = jax.device_count()
+    except Exception:
+        out["jax_version"] = out["backend"] = None
+        out["device_count"] = 0
+    out["env"] = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(("TM_TPU_", "JAX_", "XLA_FLAGS"))
+    }
+    return out
+
+
+def _health_section() -> Dict[str, Any]:
+    try:
+        from torchmetrics_tpu.parallel import sync as _sync
+
+        return {
+            "ranks": {int(r): dict(h) for r, h in _sync.health_ledger().report().items()},
+            "skew": _sync.last_skew_report(),
+            "gather_stats": _sync.local_gather_stats(),
+        }
+    except Exception:
+        return {"ranks": {}, "skew": None, "gather_stats": None}
+
+
+def _journal_section(metric: Optional[Any]) -> Dict[str, Any]:
+    """The write-ahead journal cursor: where replay must stop to match this capture."""
+    cursor: Optional[Dict[str, Any]] = None
+    if metric is not None:
+        eng = getattr(metric, "__dict__", {}).get("_serve")
+        jr = getattr(eng, "journal", None) if eng is not None else None
+        if jr is not None:
+            cursor = {
+                "path": jr.path,
+                "last_seq": jr.last_seq,
+                "snapshot_present": os.path.exists(
+                    os.path.join(jr.path, "snapshot.tmsnap")
+                ),
+            }
+    if cursor is None:
+        try:
+            from torchmetrics_tpu.robust import journal as _journal
+
+            cursor = _journal.last_cursor()
+        except Exception:
+            cursor = None
+    return {"cursor": cursor}
+
+
+def _memory_section() -> Dict[str, Any]:
+    try:
+        from torchmetrics_tpu.obs import memory as _memory
+
+        ledger = _memory.memory_ledger(cross_check=False)
+        return {"rows": ledger["rows"], "totals": ledger["totals"]}
+    except Exception:
+        return {"rows": [], "totals": {}}
+
+
+def _metric_section(metric: Any) -> Dict[str, Any]:
+    """Per-metric context (shapes/dtypes/bytes, never payloads — bundles stay small)."""
+    states: Dict[str, Any] = {}
+    try:
+        store = metric._state
+        for name, arr in store.tensors.items():
+            shape = tuple(getattr(arr, "shape", ()))
+            dtype = str(getattr(arr, "dtype", ""))
+            states[name] = {"shape": shape, "dtype": dtype}
+        for name, entries in store.lists.items():
+            states[name] = {"entries": len(entries)}
+    except Exception:
+        pass
+    return {
+        "class": type(metric).__name__,
+        "update_count": int(getattr(metric, "_update_count", 0) or 0),
+        "state_generation": int(getattr(metric, "state_generation", 0) or 0),
+        "world_consistent": str(getattr(metric, "world_consistent", "full")),
+        "nan_policy": str(getattr(metric, "nan_policy", "propagate")),
+        "states": states,
+    }
+
+
+def _core_payload() -> Dict[str, Any]:
+    """The per-rank slice the merged view gathers (JSON-serialisable, compact)."""
+    snap = telemetry.snapshot()
+    mem = _memory_section()
+    return {
+        "rank": _rank(),
+        "flight": flightrec.snapshot(),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "memory_totals": mem["totals"],
+    }
+
+
+def _gather_ranks(gather_fn: Optional[Callable]) -> List[Dict[str, Any]]:
+    """Per-rank core payloads over the sync gather seam (world-1 = local only)."""
+    payload = json.dumps(_core_payload())
+    if gather_fn is not None:
+        return [json.loads(p) for p in gather_fn(payload)]
+    try:
+        import jax
+
+        world = jax.process_count()
+    except Exception:
+        world = 1
+    if world <= 1:
+        return [_core_payload()]
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu.parallel.sync import gather_all_arrays
+
+    buf = jnp.asarray(np.frombuffer(payload.encode("utf-8"), np.uint8))
+    return [
+        json.loads(bytes(np.asarray(g)).decode("utf-8")) for g in gather_all_arrays(buf)
+    ]
+
+
+def build_bundle(
+    reason: str,
+    metric: Optional[Any] = None,
+    merged: bool = False,
+    gather_fn: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """Assemble the in-memory bundle document (sections as live Python objects)."""
+    import time
+
+    events = telemetry.events()
+    try:
+        from torchmetrics_tpu.obs import trace as _trace
+
+        serve_events = _trace.events()
+    except Exception:
+        serve_events = []
+    sections: Dict[str, Any] = {
+        "flight": flightrec.snapshot(),
+        "telemetry": telemetry.snapshot(),
+        "trace": {
+            "events": events[-_TRACE_SLICE:] + serve_events[-_TRACE_SLICE:],
+            "telemetry_events_total": len(events),
+            "serve_events_total": len(serve_events),
+        },
+        "health": _health_section(),
+        "sync": dict(getattr(metric, "__dict__", {}).get("_tm_last_sync") or {}) or None,
+        "journal": _journal_section(metric),
+        "memory": _memory_section(),
+        "env": _env_section(),
+    }
+    if metric is not None:
+        sections["metric"] = _metric_section(metric)
+    if merged:
+        sections["ranks"] = _gather_ranks(gather_fn)
+    return {
+        "format": FORMAT,
+        "version": VERSION,
+        "reason": str(reason),
+        "rank": _rank(),
+        "pid": os.getpid(),
+        # wall-clock stamp is for HUMANS correlating bundles with external logs; no
+        # metric value or replay boundary ever derives from it
+        "captured_unix": time.time(),  # jaxlint: disable=TPU017
+        "captured_monotonic_us": telemetry.now_us(),
+        "flight_last_seq": flightrec.last_seq(),
+        "sections": sections,
+    }
+
+
+# ------------------------------------------------------------------ encode / decode
+def encode(doc: Dict[str, Any]) -> bytes:
+    """Bundle document → the on-disk container bytes (per-section CRC + outer CRC)."""
+    packed_sections: Dict[str, Dict[str, Any]] = {}
+    for name, obj in doc["sections"].items():
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        packed_sections[name] = {"crc": zlib.crc32(data) & 0xFFFFFFFF, "data": data}
+    payload = pickle.dumps(
+        {**{k: v for k, v in doc.items() if k != "sections"}, "sections": packed_sections},
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    return BUNDLE_MAGIC + _DISK_HEADER.pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+
+
+def _decode_container(raw: bytes, origin: str) -> Dict[str, Any]:
+    header_len = len(BUNDLE_MAGIC) + _DISK_HEADER.size
+    if len(raw) < header_len or not raw.startswith(BUNDLE_MAGIC):
+        raise BundleError(f"{origin}: not a flight bundle (bad magic/truncated header)")
+    crc, length = _DISK_HEADER.unpack(raw[len(BUNDLE_MAGIC):header_len])
+    payload = raw[header_len:]
+    if len(payload) != length:
+        raise BundleError(
+            f"{origin}: truncated container (header promises {length} bytes,"
+            f" file holds {len(payload)})"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise BundleError(f"{origin}: container checksum mismatch (corrupted in storage)")
+    doc = pickle.loads(payload)
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+        raise BundleError(f"{origin}: payload is not a {FORMAT} document")
+    if int(doc.get("version", 0)) > VERSION:
+        raise BundleError(
+            f"{origin}: bundle version {doc.get('version')} is newer than this reader"
+            f" (supports <= {VERSION})"
+        )
+    return doc
+
+
+def load_bundle(path: Union[str, os.PathLike], strict: bool = True) -> Dict[str, Any]:
+    """Read a bundle file back to a document with live section objects.
+
+    ``strict=True`` (default) additionally enforces every per-section CRC and the
+    required-section set — the ``validate`` CLI path. ``strict=False`` decodes what it
+    can, attaching ``_section_errors`` instead of raising (the ``inspect`` path: a
+    damaged bundle should still render its readable sections).
+    """
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError as err:
+        raise BundleError(f"Cannot read bundle {path!r}: {err}") from err
+    doc = _decode_container(raw, path)
+    sections: Dict[str, Any] = {}
+    errors: Dict[str, str] = {}
+    for name, packed in (doc.get("sections") or {}).items():
+        data = packed.get("data")
+        if not isinstance(data, bytes):
+            errors[name] = "section payload missing"
+            continue
+        if zlib.crc32(data) & 0xFFFFFFFF != packed.get("crc"):
+            errors[name] = "section checksum mismatch"
+            continue
+        try:
+            sections[name] = pickle.loads(data)
+        except Exception as err:
+            errors[name] = f"section unpickle failed: {err!r}"
+    missing = [s for s in REQUIRED_SECTIONS if s not in sections and s not in errors]
+    if strict:
+        if errors:
+            raise BundleError(f"{path}: corrupt section(s) {sorted(errors)}: {errors}")
+        if missing:
+            raise BundleError(f"{path}: missing required section(s) {missing}")
+    doc["sections"] = sections
+    if errors or missing:
+        doc["_section_errors"] = {**errors, **{m: "missing" for m in missing}}
+    return doc
+
+
+def validate_bundle(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    """Strictly validate one bundle file; returns its summary dict or raises
+    :class:`BundleError` naming the precise violation (container, section, or schema)."""
+    doc = load_bundle(path, strict=True)
+    flight = doc["sections"]["flight"]
+    if not isinstance(flight.get("events"), list):
+        raise BundleError(f"{path}: flight section carries no event list")
+    for evt in flight["events"]:
+        if not isinstance(evt, dict) or "seq" not in evt or "kind" not in evt:
+            raise BundleError(f"{path}: malformed flight event {evt!r}")
+    seqs = [e["seq"] for e in flight["events"]]
+    if seqs != sorted(seqs):
+        raise BundleError(f"{path}: flight ring sequence numbers are not monotonic")
+    return {
+        "path": os.fspath(path),
+        "reason": doc.get("reason"),
+        "rank": doc.get("rank"),
+        "sections": sorted(doc["sections"]),
+        "flight_events": len(flight["events"]),
+        "flight_last_seq": doc.get("flight_last_seq"),
+        "journal_cursor": (doc["sections"]["journal"] or {}).get("cursor"),
+        "valid": True,
+    }
+
+
+# -------------------------------------------------------------------------- capture
+def _prune(directory: str) -> None:
+    """Keep only the newest ``TM_TPU_BUNDLE_KEEP`` bundles in ``directory``."""
+    try:
+        keep = max(1, int(os.environ.get(ENV_BUNDLE_KEEP, 64)))
+    except (TypeError, ValueError):
+        keep = 64
+    try:
+        names = [n for n in os.listdir(directory) if n.endswith(SUFFIX)]
+        if len(names) <= keep:
+            return
+        paths = sorted(
+            (os.path.join(directory, n) for n in names), key=lambda p: os.path.getmtime(p)
+        )
+        for p in paths[: len(paths) - keep]:
+            os.unlink(p)
+    except OSError:
+        pass
+
+
+def capture_bundle(
+    reason: str,
+    metric: Optional[Any] = None,
+    directory: Optional[Union[str, os.PathLike]] = None,
+    merged: bool = False,
+    gather_fn: Optional[Callable] = None,
+) -> Optional[str]:
+    """Capture one post-mortem bundle NOW; returns the written path (or None).
+
+    Fires from every failure seam, so it is best-effort by contract: any capture-time
+    error is absorbed into a counted rank-zero warning — a dying process must not die
+    twice. Returns None when capture is disabled (``TM_TPU_BUNDLES=0``), when this rank
+    is not rank zero in a merged capture, or when capture itself failed.
+    """
+    global _last_path
+    if not _enabled():
+        return None
+    try:
+        doc = build_bundle(reason, metric=metric, merged=merged, gather_fn=gather_fn)
+        if merged and doc["rank"] != 0:
+            return None  # contributors hand their payload to rank zero's gather
+        from torchmetrics_tpu.robust.checkpoint import atomic_write_bytes
+
+        directory = os.fspath(directory) if directory is not None else _default_dir()
+        safe_reason = "".join(c if c.isalnum() or c in "-_." else "-" for c in str(reason))[:64]
+        name = f"bundle-{_capture_seq():06d}-{safe_reason}-r{doc['rank']}-p{doc['pid']}{SUFFIX}"
+        path = os.path.join(directory, name)
+        atomic_write_bytes(path, encode(doc))
+        _prune(directory)
+        _last_path = path
+        telemetry.counter("flight.bundles_captured").inc()
+        flightrec.record("bundle.captured", reason=str(reason), path=path)
+        return path
+    except Exception as err:
+        telemetry.counter("flight.bundle_capture_failures").inc()
+        rank_zero_warn(
+            f"Post-mortem bundle capture for reason {reason!r} failed ({err!r}); the"
+            " original failure is unaffected. Set TM_TPU_BUNDLE_DIR to a writable"
+            " directory (docs/observability.md).",
+            UserWarning,
+        )
+        return None
+
+
+# ------------------------------------------------------------------------ rendering
+def inspect_bundle(path: Union[str, os.PathLike], max_events: int = 20) -> str:
+    """Human-readable rendering of one bundle (lenient: damaged sections are named)."""
+    doc = load_bundle(path, strict=False)
+    lines: List[str] = [
+        f"bundle {os.fspath(path)}",
+        f"  reason:   {doc.get('reason')}",
+        f"  rank/pid: {doc.get('rank')}/{doc.get('pid')}",
+        f"  captured: unix={doc.get('captured_unix'):.3f}",
+        f"  sections: {', '.join(sorted(doc.get('sections', {})))}",
+    ]
+    if doc.get("_section_errors"):
+        lines.append(f"  DAMAGED:  {doc['_section_errors']}")
+    sections = doc.get("sections", {})
+    flight = sections.get("flight") or {}
+    evts = flight.get("events") or []
+    lines.append(
+        f"  flight:   {len(evts)} event(s) retained, {flight.get('dropped', 0)} dropped,"
+        f" last_seq={flight.get('last_seq')}"
+    )
+    for evt in evts[-max_events:]:
+        extra = {k: v for k, v in evt.items() if k not in ("seq", "ts_us", "kind")}
+        lines.append(f"    #{evt['seq']:<6} {evt['ts_us']:>14.1f}us  {evt['kind']:<24} {extra or ''}")
+    cursor = (sections.get("journal") or {}).get("cursor")
+    lines.append(f"  journal:  cursor={cursor}")
+    sync = sections.get("sync")
+    if sync:
+        lines.append(
+            f"  sync:     level={sync.get('world_consistent')}"
+            f" degraded={sync.get('degraded_states')} quorum={sync.get('quorum_states')}"
+        )
+    mem = sections.get("memory") or {}
+    totals = mem.get("totals") or {}
+    lines.append(
+        f"  memory:   resident_bytes={totals.get('resident_bytes')}"
+        f" over {totals.get('metrics')} metric(s)"
+    )
+    metric = sections.get("metric")
+    if metric:
+        lines.append(
+            f"  metric:   {metric.get('class')} updates={metric.get('update_count')}"
+            f" gen={metric.get('state_generation')} consistency={metric.get('world_consistent')}"
+        )
+    ranks = sections.get("ranks")
+    if ranks:
+        lines.append(f"  ranks:    merged view over {len(ranks)} rank(s)")
+        for r in ranks:
+            mt = r.get("memory_totals") or {}
+            lines.append(
+                f"    r{r.get('rank')}: flight={len((r.get('flight') or {}).get('events', []))}"
+                f" resident_bytes={mt.get('resident_bytes')}"
+            )
+    env = sections.get("env") or {}
+    lines.append(
+        f"  env:      jax={env.get('jax_version')} backend={env.get('backend')}"
+        f" pkg={env.get('package_version')}"
+    )
+    return "\n".join(lines)
+
+
+def diff_bundles(path_a: Union[str, os.PathLike], path_b: Union[str, os.PathLike]) -> str:
+    """Compare two bundles: counter deltas, flight-window delta, memory movement."""
+    a = load_bundle(path_a, strict=False)
+    b = load_bundle(path_b, strict=False)
+    lines = [f"bundle diff: {os.fspath(path_a)} -> {os.fspath(path_b)}"]
+    ca = (a["sections"].get("telemetry") or {}).get("counters", {})
+    cb = (b["sections"].get("telemetry") or {}).get("counters", {})
+    moved = {k: (ca.get(k, 0), cb.get(k, 0)) for k in sorted(set(ca) | set(cb))
+             if ca.get(k, 0) != cb.get(k, 0)}
+    lines.append(f"  counters moved: {len(moved)}")
+    for k, (va, vb) in moved.items():
+        lines.append(f"    {k}: {va} -> {vb} ({vb - va:+d})")
+    fa = (a["sections"].get("flight") or {})
+    fb = (b["sections"].get("flight") or {})
+    lines.append(
+        f"  flight: last_seq {fa.get('last_seq')} -> {fb.get('last_seq')}"
+        f" (+{max(0, (fb.get('last_seq') or 0) - (fa.get('last_seq') or 0))} events)"
+    )
+    new_events = [
+        e for e in (fb.get("events") or []) if e.get("seq", 0) > (fa.get("last_seq") or 0)
+    ]
+    for evt in new_events[:40]:
+        extra = {k: v for k, v in evt.items() if k not in ("seq", "ts_us", "kind")}
+        lines.append(f"    +#{evt['seq']:<6} {evt['kind']:<24} {extra or ''}")
+    ta = ((a["sections"].get("memory") or {}).get("totals") or {}).get("resident_bytes")
+    tb = ((b["sections"].get("memory") or {}).get("totals") or {}).get("resident_bytes")
+    if ta is not None or tb is not None:
+        lines.append(f"  memory.resident_bytes: {ta} -> {tb}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------------------ CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.obs.bundle",
+        description="Inspect/validate/diff post-mortem flight bundles (docs/observability.md)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_inspect = sub.add_parser("inspect", help="render one bundle")
+    p_inspect.add_argument("path")
+    p_inspect.add_argument("--events", type=int, default=20, help="flight events to show")
+    p_validate = sub.add_parser("validate", help="strictly validate bundle(s); exit 0/1")
+    p_validate.add_argument("paths", nargs="+")
+    p_diff = sub.add_parser("diff", help="compare two bundles")
+    p_diff.add_argument("path_a")
+    p_diff.add_argument("path_b")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "inspect":
+        print(inspect_bundle(args.path, max_events=args.events))
+        return 0
+    if args.cmd == "validate":
+        bad = 0
+        for path in args.paths:
+            try:
+                summary = validate_bundle(path)
+            except BundleError as err:
+                print(f"INVALID  {path}: {err}")
+                bad += 1
+            else:
+                print(
+                    f"ok       {path}: reason={summary['reason']!r}"
+                    f" flight_events={summary['flight_events']}"
+                    f" cursor={summary['journal_cursor']}"
+                )
+        return 1 if bad else 0
+    print(diff_bundles(args.path_a, args.path_b))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

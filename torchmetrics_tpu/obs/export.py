@@ -146,6 +146,20 @@ _ALWAYS_TABULATED = (
     "drift.evaluations",
     "drift.alarms",
     "serve.online_advances",
+    # flight recorder & post-mortem bundles (docs/observability.md "Flight recorder"):
+    # always-on black-box events and the bundles that landed them on disk — a summary
+    # with zero flight rows must still SAY no failure seam fired
+    "flight.events",
+    "flight.bundles_captured",
+    "flight.bundle_capture_failures",
+)
+
+#: gauge families ALWAYS tabulated by ``summary()`` even before first publication —
+#: the HBM memory ledger's headline numbers must be visibly zero, never absent
+#: (docs/observability.md "Memory ledger")
+_ALWAYS_TABULATED_GAUGES = (
+    "memory.resident_bytes",
+    "memory.metrics_tracked",
 )
 
 
@@ -160,6 +174,9 @@ def summary(registry: Optional[Telemetry] = None) -> str:
     counters = dict(snap["counters"])
     for name in _ALWAYS_TABULATED:
         counters.setdefault(name, 0)
+    snap.setdefault("gauges", {})
+    for name in _ALWAYS_TABULATED_GAUGES:
+        snap["gauges"].setdefault(name, 0.0)
     rows = [("name", "kind", "count", "total/percentiles")]
     for name in sorted(counters):
         rows.append((name, "counter", str(counters[name]), ""))
@@ -311,6 +328,11 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "sketch_merges": counters.get("sketch.merges", 0),
         "sketch_compactions": counters.get("sketch.compactions", 0),
         "sketch_state_bytes_saved": counters.get("sketch.state_bytes_saved", 0),
+        # flight recorder & post-mortem bundles (docs/observability.md "Flight
+        # recorder"): the always-on black-box trail — a bench records how many notable
+        # events fired and how many post-mortem bundles landed on disk
+        "flight_events": counters.get("flight.events", 0),
+        "bundles_captured": counters.get("flight.bundles_captured", 0),
         # cost profiler (docs/observability.md): ledger rows captured during this run and
         # how many sampled device-timing steps fed the per-tier host/device split
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),
@@ -351,6 +373,16 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["openmetrics_bytes"] = len(_openmetrics.render(registry).encode("utf-8"))
     except Exception:  # pragma: no cover - defensive
         out["openmetrics_bytes"] = None
+    # HBM memory ledger (docs/observability.md "Memory ledger"): live resident bytes
+    # across every tracked metric at extras-assembly time — best-effort like the rest
+    try:
+        from torchmetrics_tpu.obs import memory as _memory
+
+        out["memory_resident_bytes"] = _memory.memory_ledger(cross_check=False)["totals"][
+            "resident_bytes"
+        ]
+    except Exception:  # pragma: no cover - defensive
+        out["memory_resident_bytes"] = None
     ho = snap["timers"].get("dispatch.host_overhead")
     if ho and ho["count"]:  # recorded only while tracing was enabled
         out["per_step_host_overhead_us"] = round(ho["mean_s"] * 1e6, 2)

@@ -1,0 +1,157 @@
+"""Always-on flight recorder: a bounded, lock-light ring of notable engine events.
+
+The PR-1/PR-12 telemetry stack answers "what is happening" while a process is alive —
+and evaporates exactly when it matters: a preemption, a drain death, a sync timeout, a
+NaN poisoning leaves nothing to debug from. The flight recorder is the black box that
+survives to the post-mortem bundle (:mod:`torchmetrics_tpu.obs.bundle`): every failure
+seam in the engine records one small host-side event here, **unconditionally** — unlike
+the trace ring (:mod:`torchmetrics_tpu.obs.trace`) this is NOT gated on
+``TM_TPU_TELEMETRY``, because the events it holds are the rare, load-bearing ones (a
+shed storm, a ``ConsistencyLevel`` downgrade, a fence break), not per-step volume.
+
+Event taxonomy (docs/observability.md "Flight recorder" has the full table):
+
+==========================  ==========================================================
+``sync.outcome``            one per multi-rank ``process_sync`` (consistency level)
+``sync.downgrade``          ConsistencyLevel left ``full`` (quorum/local states named)
+``sync.timeout``            a ``SyncTimeoutError`` is about to propagate (bundle fires)
+``rank.evicted``            health-ledger circuit breaker opened for a rank
+``rank.readmitted``         probe succeeded; rank rejoined the gather group
+``serve.shed``              bounded window dropped an offered batch
+``serve.backpressure``      a blocking enqueue parked against the full window
+``serve.fence_break``       foreign mutation moved state while batches were in flight
+``serve.drain_restart``     the drain thread died and was restarted (bundle fires)
+``serve.apply_failure``     a batch failed to apply on the drain
+``serve.abandoned``         chaos/preemption dropped the engine cold (bundle fires)
+``journal.append``          one WAL record went durable (seq = the replay cursor)
+``journal.truncate``        snapshot covered a prefix; records dropped
+``journal.replay``          recovery re-drove journaled batches
+``journal.torn_tail``       crash-torn tail record skipped on read
+``journal.corrupt``         mid-stream hole detected (bundle fires)
+``jit.recompile_churn``     the one-shot retrace-churn warning fired
+``nan.poison``              the in-graph guardrail surfaced non-finite values
+``slo.alarm``               an SLO/drift/memory burn alarm transitioned (both ways)
+``chaos.injected``          a seeded fault injector fired
+``chaos.cell_failed``       a chaos-matrix cell errored instead of recovering
+==========================  ==========================================================
+
+Cost model: :func:`record` builds one small dict, stamps a monotonic sequence number
+(``itertools.count`` — GIL-atomic) and a microsecond timestamp, appends to a bounded
+``deque`` (no lock), and bumps the always-on ``flight.events`` counter. Measured
+~0.5µs/event on the shared CI host; ``make bundle-smoke`` pins the ≤2µs bound.
+
+    >>> import torchmetrics_tpu.obs.flightrec as flightrec
+    >>> flightrec.clear()
+    >>> _ = flightrec.record("sync.downgrade", level="quorum", states=("v",))
+    >>> evts = flightrec.events()
+    >>> evts[-1]["kind"], evts[-1]["level"]
+    ('sync.downgrade', 'quorum')
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from torchmetrics_tpu.obs.telemetry import _env_int, telemetry
+
+ENV_FLIGHT_EVENTS = "TM_TPU_FLIGHT_EVENTS"
+
+__all__ = [
+    "FlightRecorder", "recorder", "record", "events", "clear", "snapshot", "last_seq",
+]
+
+
+class FlightRecorder:
+    """Bounded always-on event ring with monotonic per-process sequence numbers.
+
+    Appends are GIL-atomic deque pushes (no lock on the record path); the sequence
+    counter is shared across instances of a process so bundle diffs can order events
+    from different captures. ``dropped`` counts events the bound overwrote — a bundle
+    whose ring wrapped says so instead of silently presenting a truncated history.
+    """
+
+    __slots__ = ("_events", "_pushed", "_seq")
+
+    #: process-wide monotonic sequence (shared so merged views order correctly)
+    _next_seq = itertools.count(1).__next__
+
+    def __init__(self, maxlen: Optional[int] = None) -> None:
+        self._events: deque = deque(maxlen=maxlen or _env_int(ENV_FLIGHT_EVENTS, 4096))
+        self._pushed = 0
+        self._seq = 0  # highest sequence this recorder has seen
+
+    def record(self, kind: str, **fields: Any) -> int:
+        """Append one event; returns its sequence number. Always-on, ~0.5µs."""
+        seq = FlightRecorder._next_seq()
+        evt: Dict[str, Any] = {"seq": seq, "ts_us": round(telemetry.now_us(), 1), "kind": kind}
+        if fields:
+            evt.update(fields)
+        self._pushed += 1  # benign under the GIL (monotonic high-water mark)
+        self._seq = seq
+        self._events.append(evt)
+        telemetry.counter("flight.events").inc()
+        return seq
+
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by the bound (pushed minus retained)."""
+        return max(0, self._pushed - len(self._events))
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence number of the most recent event this recorder saw (0 = none)."""
+        return self._seq
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serialisable view for bundles/merged gathers.
+
+        Events are ordered by sequence number: concurrent recorders draw their seq
+        BEFORE the (GIL-atomic) append, so raw ring order can interleave by one slot
+        under a thread race — the snapshot presents the true causal order, and bundle
+        validation holds it monotonic.
+        """
+        return {
+            "events": sorted(self.events(), key=lambda e: e["seq"]),
+            "recorded": self._pushed,
+            "dropped": self.dropped,
+            "last_seq": self._seq,
+            "maxlen": self._events.maxlen,
+        }
+
+    def clear(self) -> None:
+        self._events.clear()
+        self._pushed = 0
+        self._seq = 0
+
+
+#: the process-global flight ring every seam records into
+recorder = FlightRecorder()
+
+
+def record(kind: str, **fields: Any) -> int:
+    """Record one event into the process-global flight ring (always-on)."""
+    return recorder.record(kind, **fields)
+
+
+def events() -> List[Dict[str, Any]]:
+    return recorder.events()
+
+
+def last_seq() -> int:
+    return recorder.last_seq
+
+
+def snapshot() -> Dict[str, Any]:
+    return recorder.snapshot()
+
+
+def clear() -> None:
+    """Drop recorded events (tests / fresh smoke runs)."""
+    recorder.clear()

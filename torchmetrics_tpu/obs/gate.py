@@ -239,6 +239,48 @@ def run_sync_probe() -> Dict[str, Dict[str, Any]]:
     return rows
 
 
+#: pinned workloads for the memory-ledger probe (docs/observability.md "Memory
+#: ledger"): scalar aggregate, 16-key tenant table, 8-slot window ring, KLL sketch —
+#: one representative per state-kind the ledger classifies. Byte-deterministic.
+_MEMORY_PROBE_WINDOW = 8
+
+
+def run_memory_probe() -> Dict[str, Dict[str, Any]]:
+    """Deterministic ``memory.resident_bytes[<Workload>]`` rows for the ledger.
+
+    Resident bytes are shape × itemsize of the registered state buffers — exact and
+    platform-independent, so the gate holds the HBM line on them precisely: a state
+    that silently grows (a widened dtype, an extra bookkeeping slab, a ring that
+    doubled) moves a pinned row beyond tolerance and trips the gate.
+    """
+    from torchmetrics_tpu import aggregation, obs
+    from torchmetrics_tpu.keyed import KeyedMetric
+    from torchmetrics_tpu.online import Windowed
+    from torchmetrics_tpu.sketch import StreamingQuantile
+
+    workloads = {
+        "SumMetric": aggregation.SumMetric(nan_strategy="ignore"),
+        "KeyedMetric": KeyedMetric(
+            aggregation.SumMetric(nan_strategy="ignore"), _KEYED_N
+        ),
+        "WindowedMean": Windowed(
+            aggregation.MeanMetric(nan_strategy="ignore"),
+            window=_MEMORY_PROBE_WINDOW, advance_every=_MEMORY_PROBE_WINDOW, emit=False,
+        ),
+        "StreamingQuantile": StreamingQuantile(
+            q=0.5, capacity=_SKETCH_CAPACITY, levels=_SKETCH_LEVELS
+        ),
+    }
+    rows: Dict[str, Dict[str, Any]] = {}
+    for name, metric in workloads.items():
+        ledger = obs.memory_ledger(metrics=[metric], cross_check=False)
+        rows[f"memory.resident_bytes[{name}]"] = {
+            "resident_bytes": int(ledger["totals"]["resident_bytes"]),
+            "states": len(ledger["rows"]),
+        }
+    return rows
+
+
 def run_gate(
     baseline_path: str = _ledger.DEFAULT_BASELINE,
     bench_dir: str = ".",
@@ -259,6 +301,7 @@ def run_gate(
     rows = run_workload()
     current = _ledger.rows_by_key(rows)
     sync_rows = run_sync_probe()
+    memory_rows = run_memory_probe()
 
     bench_file = _ledger.latest_bench_file(bench_dir)
     bench_numbers: Dict[str, Any] = {}
@@ -270,11 +313,14 @@ def run_gate(
             bench_numbers = {}
 
     if update_baseline:
-        doc = _ledger.build_document(rows, bench=bench_numbers, tolerances=tolerances, sync=sync_rows)
+        doc = _ledger.build_document(
+            rows, bench=bench_numbers, tolerances=tolerances, sync=sync_rows,
+            memory=memory_rows,
+        )
         _ledger.write_document(doc, baseline_path)
         print(
             f"perf-gate: wrote baseline {baseline_path} ({len(rows)} ledger rows,"
-            f" {len(sync_rows)} sync probe rows,"
+            f" {len(sync_rows)} sync probe rows, {len(memory_rows)} memory probe rows,"
             f" bench source: {bench_numbers.get('file', 'none')})",
             file=out,
         )
@@ -302,17 +348,23 @@ def run_gate(
     base_sync = baseline.get("sync") or {}
     if base_sync:
         sync_deltas = _ledger.compare_sync(base_sync, sync_rows, tol)
+    memory_deltas: List[Dict[str, Any]] = []
+    base_memory = baseline.get("memory") or {}
+    if base_memory:
+        memory_deltas = _ledger.compare_memory(base_memory, memory_rows, tol)
 
     all_regressions = (
         _ledger.regressions(deltas)
         + _ledger.regressions(bench_deltas)
         + _ledger.regressions(sync_deltas)
+        + _ledger.regressions(memory_deltas)
     )
     if as_json:
         print(json.dumps({
             "ledger_deltas": deltas,
             "bench_deltas": bench_deltas,
             "sync_deltas": sync_deltas,
+            "memory_deltas": memory_deltas,
             "bench_file": bench_numbers.get("file"),
             "regressions": len(all_regressions),
             "tolerances": tol,
@@ -326,6 +378,8 @@ def run_gate(
             ), file=out)
         if sync_deltas:
             print(_ledger.render_deltas(sync_deltas, title="perf-gate sync probe"), file=out)
+        if memory_deltas:
+            print(_ledger.render_deltas(memory_deltas, title="perf-gate memory probe"), file=out)
         verdict = "FAIL" if all_regressions else "PASS"
         print(f"perf-gate: {verdict} ({len(all_regressions)} regression(s))", file=out)
     return 1 if all_regressions else 0

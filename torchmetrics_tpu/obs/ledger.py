@@ -9,7 +9,9 @@ One format, three consumers: the committed ``PERF_LEDGER.json`` baseline, the CI
       "ledger": {"<Metric>.<kernel>[<signature>]": {<CostRow fields>}},
       "bench":  {"file": "BENCH_rNN.json", "value": ..., "<extras numbers>": ...},
       "sync":   {"sync.bytes_saved[<mode>]": {"wire_bytes": ..., "raw_bytes": ...,
-                 "bytes_saved": ...}}   # deterministic compressed-sync probe rows
+                 "bytes_saved": ...}},  # deterministic compressed-sync probe rows
+      "memory": {"memory.resident_bytes[<Workload>]": {"resident_bytes": ...,
+                 "states": ...}}        # deterministic HBM memory-ledger probe rows
     }
 
 Comparison semantics: compiler cost quantities (flops, bytes accessed, argument/temp/output
@@ -72,8 +74,9 @@ def build_document(
     bench: Optional[Dict[str, Any]] = None,
     tolerances: Optional[Dict[str, float]] = None,
     sync: Optional[Dict[str, Dict[str, Any]]] = None,
+    memory: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
-    """Assemble a ledger document from profiler rows (+ optional bench/sync numbers)."""
+    """Assemble a ledger document from profiler rows (+ optional bench/sync/memory)."""
     try:
         import jax
 
@@ -88,6 +91,7 @@ def build_document(
         "ledger": {r["key"]: r for r in rows},
         "bench": bench or {},
         "sync": sync or {},
+        "memory": memory or {},
     }
 
 
@@ -241,6 +245,45 @@ def compare_sync(
             "key": key, "field": "(row)", "baseline": None, "current": None,
             "rel": None, "rtol": None, "status": "new",
             "note": "sync probe row not in baseline (--update-baseline to adopt)",
+        })
+    return deltas
+
+
+def compare_memory(
+    baseline_rows: Dict[str, Dict[str, Any]],
+    current_rows: Dict[str, Dict[str, Any]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Compare the HBM memory-ledger probe rows (``memory.resident_bytes[<Workload>]``).
+
+    The probe builds pinned metric workloads (fixed key counts, window geometry, sketch
+    capacity) and reads ``obs.memory_ledger()`` — the byte numbers are shape × itemsize
+    and therefore exact, so these rows hold the resident-HBM line the way cost rows
+    hold FLOPs: a state-layout change that makes a pinned workload resident-heavier
+    than the committed baseline regresses (lower-is-better under ``bytes_rtol``), and a
+    missing row is lost coverage.
+    """
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    rtol = tol.get("bytes_rtol", DEFAULT_TOLERANCES["bytes_rtol"])
+    deltas: List[Dict[str, Any]] = []
+    for key, base in sorted(baseline_rows.items()):
+        cur = current_rows.get(key)
+        if cur is None:
+            deltas.append({
+                "key": key, "field": "(row)", "baseline": None, "current": None,
+                "rel": None, "rtol": None, "status": "regression",
+                "note": "memory probe row missing from the current run (workload coverage lost)",
+            })
+            continue
+        d = _delta(key, "resident_bytes", base.get("resident_bytes"),
+                   cur.get("resident_bytes"), rtol, higher_is_better=False)
+        if d is not None:
+            deltas.append(d)
+    for key in sorted(set(current_rows) - set(baseline_rows)):
+        deltas.append({
+            "key": key, "field": "(row)", "baseline": None, "current": None,
+            "rel": None, "rtol": None, "status": "new",
+            "note": "memory probe row not in baseline (--update-baseline to adopt)",
         })
     return deltas
 

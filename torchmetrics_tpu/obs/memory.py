@@ -1,0 +1,318 @@
+"""HBM memory ledger: per-metric resident device bytes, always accountable.
+
+ROADMAP item 3 (elastic tenant tables serving 1M+ keys in *bounded* HBM) needs an
+accounting substrate before any eviction policy can exist: something must say, at any
+instant, how many device bytes each live metric's state holds — keyed ``[N, ...]``
+tenant tables, online window rings, sketch slabs, cat entry lists — and how those bytes
+split across mesh shards. That is this module:
+
+- every :class:`~torchmetrics_tpu.metric.Metric` registers itself in a weak set at
+  construction (:func:`track` — a ``WeakSet.add``, nothing retained beyond the metric's
+  own lifetime);
+- :func:`memory_ledger` walks the live metrics and reports one row per state —
+  ``nbytes`` computed from the registered shape × itemsize, which IS the resident
+  device footprint of the buffer (sharded states additionally report the per-shard
+  split), cross-checked against the PR-5 cost profiler's ``memory_analysis`` rows
+  (``output_bytes``/``temp_bytes`` of the compiled update programs) where those were
+  captured;
+- :func:`publish_gauges` exports the totals as always-on ``memory.*`` gauges (picked up
+  by the OpenMetrics exposition, per rank in the merged view) and records one point
+  into the ``memory.resident_bytes`` live series — the feed :class:`MemoryBudget`
+  alarms on through the PR-12 SLO burn-rate machinery.
+
+State-kind taxonomy (docs/keyed.md and docs/observability.md):
+
+==============  =============================================================
+``tenant_table``  keyed ``[num_keys, ...]`` state (docs/keyed.md)
+``window_ring``   online ``[window, ...]`` ring slab (docs/online.md)
+``sketch``        registered sketch slab (docs/sketches.md)
+``cat``           list ("cat") state — entry count × per-entry bytes
+``tensor``        every other tensor state (scalars, vectors, confmats)
+==============  =============================================================
+
+    >>> from torchmetrics_tpu.aggregation import SumMetric
+    >>> m = SumMetric()
+    >>> rows = [r for r in memory_ledger()["rows"] if r["instance"] == id(m)]
+    >>> rows[0]["state"], rows[0]["nbytes"]
+    ('sum_value', 4)
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
+
+__all__ = [
+    "track", "tracked_metrics", "memory_ledger", "publish_gauges", "MemoryBudget",
+    "reset_tracking",
+]
+
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def track(metric: Any) -> None:
+    """Register a live metric for ledger walks (called by ``Metric.__init__``)."""
+    with _LIVE_LOCK:
+        _LIVE.add(metric)
+
+
+def tracked_metrics() -> List[Any]:
+    """Snapshot of the currently-live tracked metrics (dead refs drop automatically)."""
+    with _LIVE_LOCK:
+        return list(_LIVE)
+
+
+def reset_tracking() -> None:
+    """Forget every tracked metric (tests; instances stay alive, just untracked)."""
+    with _LIVE_LOCK:
+        _LIVE.clear()
+
+
+# ------------------------------------------------------------------ row construction
+def _nbytes_of(value: Any) -> int:
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    try:
+        return n * np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+
+
+def _state_kind(metric: Any, name: str, shape: Tuple[int, ...], is_list: bool) -> str:
+    specs = metric.__dict__.get("_sketch_specs") or {}
+    if name in specs:
+        return "sketch"
+    if is_list:
+        return "cat"
+    desc = getattr(metric, "online_descriptor", None)
+    if isinstance(desc, dict) and desc.get("mode") == "sliding":
+        if shape and shape[0] == desc.get("window"):
+            return "window_ring"
+    num_keys = getattr(metric, "num_keys", None)
+    if (
+        num_keys is not None
+        and getattr(metric, "template", None) is not None
+        and shape
+        and shape[0] == int(num_keys)
+    ):
+        return "tenant_table"
+    return "tensor"
+
+
+def _shard_split(metric: Any, name: str, nbytes: int) -> Tuple[bool, Optional[int], int]:
+    """(partitioned?, per-shard bytes, device count) for one tensor state."""
+    specs = metric.__dict__.get("_shard_specs") or {}
+    ctx = metric.__dict__.get("_shard_ctx")
+    spec = specs.get(name)
+    if ctx is None or spec is None:
+        return False, None, 1
+    try:
+        from torchmetrics_tpu.parallel import mesh as _mesh
+
+        devices = int(ctx.describe()["devices"])
+        if devices > 1 and _mesh.is_partitioned(spec):
+            # leading-axis partition (the only split spec_for_state derives): each
+            # device holds exactly its 1/devices slab of the buffer
+            return True, nbytes // devices, devices
+        return False, nbytes, devices
+    except Exception:
+        return False, None, 1
+
+
+def _profiler_memory(metric_cls: str) -> Optional[Dict[str, Any]]:
+    """Already-captured ``memory_analysis`` evidence for one metric class, if any.
+
+    Reads the cost ledger's RECORDED rows only — never triggers the lazy jit-tier
+    resolution compiles (a memory walk must stay cheap and dispatch-free).
+    """
+    try:
+        from torchmetrics_tpu.obs import profiler as _profiler
+
+        rows = _profiler.recorded_rows(metric_cls)
+    except Exception:
+        return None
+    best: Optional[Dict[str, Any]] = None
+    for r in rows:
+        if r.get("output_bytes") is None:
+            continue
+        if best is None or (r.get("output_bytes") or 0) > (best.get("output_bytes") or 0):
+            best = r
+    if best is None:
+        return None
+    return {
+        "kernel": best["kernel"],
+        "output_bytes": best.get("output_bytes"),
+        "temp_bytes": best.get("temp_bytes"),
+        "argument_bytes": best.get("argument_bytes"),
+    }
+
+
+def memory_ledger(
+    metrics: Optional[Iterable[Any]] = None, cross_check: bool = True
+) -> Dict[str, Any]:
+    """Walk live metrics and report per-state resident device bytes.
+
+    One row per (metric instance, state): kind (tenant table / window ring / sketch /
+    cat / tensor), ``nbytes`` (shape × itemsize — exactly the buffer's resident
+    footprint), shape/dtype, and the per-shard split for ``.shard()``-ed states.
+    ``cross_check=True`` attaches the cost profiler's captured ``memory_analysis``
+    numbers per metric class (the compiled programs' output/temp bytes — the same HBM
+    quantities, seen from the compiler's side). Mid-flight metrics (buffers donated to
+    an in-progress dispatch) report rows from their registered DEFAULTS with
+    ``inflight=True`` — shapes are dispatch-invariant, so the byte accounting holds.
+    """
+    rows: List[Dict[str, Any]] = []
+    per_class: Dict[str, int] = {}
+    targets = tracked_metrics() if metrics is None else list(metrics)
+    for metric in targets:
+        store = metric.__dict__.get("_state")
+        if store is None:
+            continue
+        cls = type(metric).__name__
+        inflight = bool(getattr(store, "inflight", False))
+        source = metric.__dict__.get("_defaults", {}) if inflight else store.tensors
+        for name in store.tensors:
+            value = source.get(name, store.tensors.get(name))
+            nbytes = _nbytes_of(value)
+            shape = tuple(int(s) for s in getattr(value, "shape", ()) or ())
+            partitioned, per_shard, devices = _shard_split(metric, name, nbytes)
+            rows.append({
+                "metric": cls,
+                "instance": id(metric),
+                "state": name,
+                "kind": _state_kind(metric, name, shape, is_list=False),
+                "nbytes": nbytes,
+                "shape": list(shape),
+                "dtype": str(getattr(value, "dtype", "")),
+                "sharded": partitioned,
+                "per_shard_bytes": per_shard,
+                "devices": devices,
+                "inflight": inflight,
+            })
+            per_class[cls] = per_class.get(cls, 0) + nbytes
+        for name, entries in store.lists.items():
+            nbytes = sum(_nbytes_of(e) for e in entries)
+            rows.append({
+                "metric": cls,
+                "instance": id(metric),
+                "state": name,
+                "kind": _state_kind(metric, name, (), is_list=True),
+                "nbytes": nbytes,
+                "entries": len(entries),
+                "sharded": False,
+                "per_shard_bytes": None,
+                "devices": 1,
+                "inflight": inflight,
+            })
+            per_class[cls] = per_class.get(cls, 0) + nbytes
+    total = sum(r["nbytes"] for r in rows)
+    out: Dict[str, Any] = {
+        "rows": rows,
+        "totals": {
+            "resident_bytes": total,
+            "metrics": len({r["instance"] for r in rows}),
+            "per_class": per_class,
+        },
+    }
+    if cross_check:
+        out["profiler"] = {
+            cls: prof for cls in sorted(per_class)
+            if (prof := _profiler_memory(cls)) is not None
+        }
+    return out
+
+
+# ----------------------------------------------------------------- gauges + budget
+def publish_gauges(
+    metrics: Optional[Iterable[Any]] = None,
+    registry: Optional[Telemetry] = None,
+    now: Optional[float] = None,
+) -> int:
+    """Export the ledger totals as ``memory.*`` gauges + one series point; returns the
+    total resident bytes.
+
+    Gauges: ``memory.resident_bytes`` (grand total), ``memory.resident_bytes.<Class>``
+    per metric class, ``memory.metrics_tracked``. The OpenMetrics exposition renders
+    every one (per rank in the merged view — a pod-level scrape shows per-rank HBM
+    residency); the ``memory.resident_bytes`` series point is the
+    :class:`MemoryBudget` burn-rate feed.
+    """
+    tel = registry if registry is not None else telemetry
+    ledger = memory_ledger(metrics=metrics, cross_check=False)
+    totals = ledger["totals"]
+    tel.gauge("memory.resident_bytes").set(totals["resident_bytes"])
+    tel.gauge("memory.metrics_tracked").set(totals["metrics"])
+    for cls, nbytes in totals["per_class"].items():
+        tel.gauge(f"memory.resident_bytes.{cls}").set(nbytes)
+    tel.series("memory.resident_bytes").record(float(totals["resident_bytes"]), now=now)
+    return int(totals["resident_bytes"])
+
+
+class MemoryBudget:
+    """Alarm when resident metric-state bytes exceed a budget — via the SLO machinery.
+
+    ``MemoryBudget(bytes=...)`` declares the HBM budget; every :meth:`evaluate` call
+    publishes the live ledger into the ``memory.resident_bytes`` series and drives the
+    PR-12 multi-window burn-rate monitor over it (``bad_when="above"`` the budget):
+    sustained over-budget residency fires ONE rank-zero warning per transition (plus
+    the ``slo.alarms`` counters and the ``slo.<name>.burn_rate`` gauge), and recovery
+    re-arms it — exactly the alarm discipline the serve SLOs use. The eviction policy
+    of ROADMAP item 3 consumes :meth:`evaluate`'s statuses as its pressure signal.
+
+        >>> from torchmetrics_tpu.obs.telemetry import Telemetry
+        >>> budget = MemoryBudget(bytes=10**12, registry=Telemetry(enabled=False))
+        >>> [s.burning for s in budget.evaluate()]
+        [False]
+    """
+
+    def __init__(
+        self,
+        bytes: int,
+        name: str = "memory-budget",
+        objective: float = 0.99,
+        windows: Sequence[Tuple[float, float]] = ((30.0, 1.0),),
+        metrics: Optional[Iterable[Any]] = None,
+        registry: Optional[Telemetry] = None,
+    ) -> None:
+        from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec
+
+        if int(bytes) <= 0:
+            raise ValueError(f"MemoryBudget(bytes) needs a positive byte budget, got {bytes}")
+        self.bytes = int(bytes)
+        self.name = name
+        self.metrics = metrics
+        self._registry = registry
+        self.spec = SloSpec(
+            name=name,
+            series="memory.resident_bytes",
+            objective=objective,
+            threshold=float(self.bytes),
+            bad_when="above",
+            windows=tuple((float(w), float(b)) for w, b in windows),
+            description=(
+                f"resident metric-state bytes vs the {self.bytes}-byte HBM budget"
+                " (obs.memory_ledger; docs/observability.md)"
+            ),
+        )
+        self.monitor = SloMonitor([self.spec], registry=registry)
+
+    def evaluate(self, now: Optional[float] = None) -> List[Any]:
+        """Publish the live ledger, then evaluate the burn-rate alarm; returns the
+        :class:`~torchmetrics_tpu.obs.slo.SloStatus` list (one entry)."""
+        publish_gauges(metrics=self.metrics, registry=self._registry, now=now)
+        return self.monitor.evaluate(now=now)
+
+    @property
+    def burning(self) -> bool:
+        """True while the last evaluation found the budget burning."""
+        return self.name in self.monitor.burning()

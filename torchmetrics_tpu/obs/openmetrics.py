@@ -215,6 +215,17 @@ def render(
 ) -> str:
     """The registry as OpenMetrics text; ``merged=True`` gathers every rank's view."""
     tel = registry if registry is not None else telemetry
+    if registry is None:
+        # refresh the always-on memory.* gauges against the LIVE metric set before
+        # snapshotting, so every scrape reports current HBM residency — and the merged
+        # view (each rank snapshots after its own refresh) shows per-rank rows, the
+        # same way the skew_report gauges fold in (docs/observability.md)
+        try:
+            from torchmetrics_tpu.obs import memory as _memory
+
+            _memory.publish_gauges()
+        except Exception:  # pragma: no cover - a scrape must render regardless
+            pass
     snap = tel.snapshot()
     w = _Writer()
     if merged:

@@ -272,6 +272,19 @@ def cost_ledger() -> List[Dict[str, Any]]:
     return [r.to_dict() for r in rows]
 
 
+def recorded_rows(metric_cls: str) -> List[Dict[str, Any]]:
+    """Already-RESOLVED ledger rows for one metric class — never compiles.
+
+    The memory ledger (:mod:`torchmetrics_tpu.obs.memory`) cross-checks resident state
+    bytes against ``memory_analysis`` evidence; that walk must stay dispatch-free, so
+    pending jit-tier entries are simply not reported here (read
+    :func:`cost_profile_for` when a lazy resolve is acceptable).
+    """
+    with _LOCK:
+        rows = sorted((r for r in _ROWS.values() if r.metric == metric_cls), key=lambda r: r.key)
+    return [r.to_dict() for r in rows]
+
+
 def cost_profile_for(metric_cls: str) -> List[Dict[str, Any]]:
     """Ledger rows attributed to one metric class (``Metric.cost_profile`` backend)."""
     resolve_pending()

@@ -162,6 +162,16 @@ class SloMonitor:
                     worst = max(worst, burn)
             self._tel.gauge(f"slo.{spec.name}.burn_rate").set(worst)
             was = self._burning.get(spec.name, False)
+            if alarm != was:
+                # alarm TRANSITIONS (both directions) are flight-ring events: a
+                # post-mortem bundle must show when the burn started AND whether it
+                # had cleared before the failure (docs/observability.md)
+                from torchmetrics_tpu.obs import flightrec as _flightrec
+
+                _flightrec.record(
+                    "slo.alarm", name=spec.name, series=spec.series,
+                    burning=alarm, worst_burn=round(worst, 3),
+                )
             if alarm:
                 self._tel.counter("slo.alarms").inc()
                 self._tel.counter(f"slo.alarms.{spec.name}").inc()

@@ -532,6 +532,13 @@ def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict,
     retraces = counts[key] - 1
     if retraces > _retrace_warn_threshold and not owner.__dict__.get("_tm_retrace_warned", False):
         object.__setattr__(owner, "_tm_retrace_warned", True)
+        # recompile churn is a flight-ring event (docs/observability.md "Flight
+        # recorder"): lazily imported — flightrec sits above this module
+        from torchmetrics_tpu.obs import flightrec as _flightrec
+
+        _flightrec.record(
+            "jit.recompile_churn", metric=cls, kernel=kind, retraces=retraces, cache_key=sig
+        )
         rank_zero_warn(
             f"Metric {cls} retraced its jitted {kind!r} kernel {retraces} times (threshold"
             f" {_retrace_warn_threshold}) — recompile churn, usually shape/dtype-polymorphic"

@@ -342,6 +342,7 @@ class HealthLedger:
             h.readmissions += 1
             obs.telemetry.counter("sync.rank_readmissions").inc()
             obs.telemetry.event("sync.rank_readmitted", cat="sync", args={"rank": h.rank})
+            obs.flightrec.record("rank.readmitted", rank=h.rank, readmissions=h.readmissions)
             rank_zero_warn(
                 f"process_sync: rank {h.rank} answered its health probe and was re-admitted"
                 " to the gather group. Reconcile its state before trusting full-world"
@@ -370,6 +371,9 @@ class HealthLedger:
             obs.telemetry.event(
                 "sync.rank_evicted", cat="sync",
                 args={"rank": h.rank, "consecutive_failures": h.consecutive_failures},
+            )
+            obs.flightrec.record(
+                "rank.evicted", rank=h.rank, consecutive_failures=h.consecutive_failures
             )
             rank_zero_warn(
                 f"process_sync: rank {h.rank} missed {h.consecutive_failures} consecutive"
@@ -485,7 +489,7 @@ def skew_report(gather_fn: Optional[Callable] = None) -> Optional[Dict[str, Any]
     try:
         world = jax.process_count()
         rank = jax.process_index()
-    except Exception:
+    except Exception:  # jaxlint: disable=TPU019 - capability probe: no backend = single-process defaults, nothing absorbed
         world, rank = 1, 0
     payload = np.asarray([local["mean_us"]], np.float32)
     if gather_fn is not None:
@@ -562,7 +566,7 @@ def _bounded_gather(
         def _work() -> None:
             try:
                 result.append(gather(value, group, **kw))
-            except BaseException as err:  # noqa: BLE001 - must cross the thread boundary
+            except BaseException as err:  # noqa: BLE001  # jaxlint: disable=TPU019 - not a swallow: the error crosses the thread boundary and re-raises in the caller
                 error.append(err)
             finally:
                 done.set()
@@ -608,7 +612,7 @@ def _axis_size(axis_name: str) -> Optional[int]:
     try:
         # static mesh metadata, constant-folds at trace time — no runtime sync
         return int(lax.axis_size(axis_name))  # jaxlint: disable=TPU001
-    except Exception:
+    except Exception:  # jaxlint: disable=TPU019 - capability probe: older JAX lacks axis_size, the psum fold below answers
         pass
     try:
         size = lax.psum(1, axis_name)
@@ -1083,6 +1087,10 @@ def process_sync(
                 # a missing rank loses rows, which no quorum can reconstruct — the
                 # sharded path degrades straight to the local value (or raises)
                 if not opts.degraded_mode:
+                    # the exception is about to propagate out of the sync layer: land
+                    # the post-mortem bundle while this process still can
+                    obs.flightrec.record("sync.timeout", state=name, world=world, sharded=True)
+                    obs.capture_bundle("sync_timeout")
                     raise
                 degraded.append(name)
                 out[name] = value
@@ -1152,6 +1160,11 @@ def process_sync(
                 note_responders(name, partial.keys())
                 continue
             if not opts.degraded_mode:
+                obs.flightrec.record(
+                    "sync.timeout", state=name, world=world,
+                    responded=sorted(int(r) for r in partial),
+                )
+                obs.capture_bundle("sync_timeout")
                 raise
             degraded.append(name)
             out[name] = list(value) if is_list else value
@@ -1205,6 +1218,18 @@ def process_sync(
             ledger.record_failure(r)
 
     level = LOCAL if degraded else (QUORUM if quorum_states else FULL)
+    # flight ring (docs/observability.md "Flight recorder"): one always-on outcome
+    # event per multi-rank sync, plus an explicit downgrade record whenever the
+    # ConsistencyLevel left "full" — the trail a post-mortem bundle reconstructs
+    if world > 1:
+        obs.flightrec.record(
+            "sync.outcome", level=str(level), world=world, states=len(state)
+        )
+    if level != FULL:
+        obs.flightrec.record(
+            "sync.downgrade", level=str(level),
+            degraded=tuple(degraded), quorum=tuple(dict.fromkeys(quorum_states)),
+        )
     out.world_consistent = level
     out.degraded_states = tuple(degraded)
     out.quorum_states = tuple(dict.fromkeys(quorum_states))

@@ -109,6 +109,10 @@ class Injector:
     def _fire(self) -> None:
         self.fired += 1
         obs.telemetry.counter("robust.injected_faults").inc()
+        # every injected fault is a flight-ring event AND a post-mortem bundle: the
+        # chaos tier exercises exactly the failure seams production bundles come from
+        obs.flightrec.record("chaos.injected", injector=self.name, firing=self.fired)
+        obs.capture_bundle(f"chaos.{self.name}")
 
     def __enter__(self) -> "Injector":  # pragma: no cover - subclasses override
         return self
@@ -421,7 +425,8 @@ class ChaosRunner:
                             self._step(metric, batch, via)
                     else:
                         self._step(metric, batch, via)
-                except Exception:
+                except Exception as err:
+                    obs.flightrec.record("chaos.fault_detected", error=repr(err)[:200])
                     faulted = True
                 if any("failed mid-flight" in str(w.message) for w in caught):
                     # the engine absorbed a donated-dispatch death by resetting state to
@@ -538,6 +543,42 @@ def _seeded_batches(rng: random.Random, n: int, size: int = 4) -> List[Tuple[Any
         (np.asarray([float(rng.randint(0, 9)) for _ in range(size)], np.float32),)
         for _ in range(n)
     ]
+
+
+def _states_identical(a: Any, b: Any) -> bool:
+    """Byte-for-byte equality of two metrics' full state stores (tensors + lists)."""
+    ta, tb = a._state.tensors, b._state.tensors
+    if set(ta) != set(tb) or set(a._state.lists) != set(b._state.lists):
+        return False
+    for n in ta:
+        if np.asarray(ta[n]).tobytes() != np.asarray(tb[n]).tobytes():
+            return False
+    for n in a._state.lists:
+        ea, eb = a._state.lists[n], b._state.lists[n]
+        if len(ea) != len(eb):
+            return False
+        if any(np.asarray(x).tobytes() != np.asarray(y).tobytes() for x, y in zip(ea, eb)):
+            return False
+    return True
+
+
+def _bundle_cursor_replay(make: Callable[[], Any], jdir: str, recovered: Any) -> Optional[bool]:
+    """Post-mortem twin recovery: replay from the LAST captured bundle's journal cursor.
+
+    The preemption strike captured a bundle whose journal section pins the cursor at
+    the abandoned instant; recovering a fresh instance THROUGH that cursor must land on
+    byte-identical state with the ordinary ``recover`` — the bundle + journal pair is a
+    reproducible crash scene. Returns None when no bundle was captured (bundling
+    disabled), True/False otherwise.
+    """
+    bundle_path = obs.last_bundle_path()
+    if bundle_path is None:
+        return None
+    from torchmetrics_tpu.robust import journal as _journal
+
+    twin = make()
+    _journal.recover(twin, jdir, cursor=bundle_path)
+    return _states_identical(twin, recovered)
 
 
 def _identical(a: Any, b: Any) -> bool:
@@ -1224,17 +1265,24 @@ def scenario_serve_preempt_mid_overlap(
         fresh = make()
         recovery = _journal.recover(fresh, jdir)
         obs.telemetry.counter("robust.recovered").inc()
+        # post-mortem contract: the strike's bundle pins the journal cursor at the
+        # abandoned instant — replaying FROM THE BUNDLE must land byte-identically
+        bundle_replay = _bundle_cursor_replay(make, jdir, fresh)
         for i in range(preempt + 1, n_batches):
             fresh.update(*batches[i])
         ref = make()
         for b in batches:
             ref.update(*b)
         ok = _identical(fresh.compute(), ref.compute())
-        passed = passed and ok and dropped > 0 and recovery["replayed"] == preempt + 1
+        passed = (
+            passed and ok and dropped > 0 and recovery["replayed"] == preempt + 1
+            and bundle_replay is not False
+        )
         detail[name] = {
             "bit_identical": ok,
             "dropped_in_window": dropped,
             "replayed": recovery["replayed"],
+            "bundle_replay_identical": bundle_replay,
         }
     detail["passed"] = passed
     return detail
@@ -1441,6 +1489,9 @@ def scenario_online_window_preemption(
         fresh = make()
         recovery = _journal.recover(fresh, jdir)
         obs.telemetry.counter("robust.recovered").inc()
+        # post-mortem contract: replay from the strike bundle's journal cursor must
+        # reconstruct the ring (bookkeeping scalars included) byte-identically
+        bundle_replay = _bundle_cursor_replay(make, jdir, fresh)
         continuation = _drive_and_watch(fresh, batches[preempt + 1:])
         ref = make()
         ref_history = _drive_and_watch(ref, batches)
@@ -1474,6 +1525,7 @@ def scenario_online_window_preemption(
             ring_identical and value_identical and history_identical and det_identical
             and dropped > 0 and recovery["replayed"] == preempt + 1
             and fresh.windows_advanced == ref.windows_advanced
+            and bundle_replay is not False
         )
         passed = passed and ok
         detail[name] = {
@@ -1484,6 +1536,7 @@ def scenario_online_window_preemption(
             "dropped_in_window": dropped,
             "replayed": recovery["replayed"],
             "windows_advanced": fresh.windows_advanced,
+            "bundle_replay_identical": bundle_replay,
         }
     detail["passed"] = passed
     return detail
@@ -1553,20 +1606,50 @@ class ChaosMatrix:
                     reset_health_state()
                     reset_warning_cache()
                     record: Dict[str, Any] = {"scenario": name, "via": v, "repeat": rep, "seed": self.seed}
+                    bundle_dir = os.path.join(cell_dir, "bundles")
                     try:
-                        with warnings.catch_warnings():
+                        # every cell captures its post-mortem bundles into the cell dir
+                        # (docs/observability.md): injector firings land theirs, and the
+                        # cell-level capture below guarantees at least one per scenario
+                        with obs.bundle.capture_dir(bundle_dir), warnings.catch_warnings():
                             # degraded/eviction/readmission warnings ARE the faults firing;
                             # the sweep audits them via counters, not stderr volume
                             warnings.simplefilter("ignore")
                             detail = fn(self.factory, rng, n_batches, v, cell_dir)
+                            obs.capture_bundle(f"chaos-matrix.{name}")
                         record.update(detail)
                         record.setdefault("passed", True)
                     except Exception as err:  # noqa: BLE001 - a cell failure is a result, not an abort
+                        obs.flightrec.record("chaos.cell_failed", scenario=name, error=repr(err)[:200])
                         record.update({"passed": False, "error": repr(err)})
+                    record["bundles"] = self._bundle_evidence(bundle_dir)
                     results.append(record)
         summary = self.summarize(results)
         obs.telemetry.event("robust.chaos_matrix", cat="robust", args=summary)
         return results
+
+    @staticmethod
+    def _bundle_evidence(bundle_dir: str) -> Dict[str, Any]:
+        """Validate every bundle a cell captured: {captured, validated, paths, errors}."""
+        from torchmetrics_tpu.obs import bundle as _bundle
+
+        paths = sorted(
+            os.path.join(bundle_dir, n)
+            for n in (os.listdir(bundle_dir) if os.path.isdir(bundle_dir) else ())
+            if n.endswith(_bundle.SUFFIX)
+        )
+        validated, errors = 0, []
+        for p in paths:
+            try:
+                _bundle.validate_bundle(p)
+                validated += 1
+            except Exception as err:  # noqa: BLE001 - evidence, not an abort
+                obs.flightrec.record("bundle.invalid", path=p, error=repr(err)[:200])
+                errors.append(f"{os.path.basename(p)}: {err!r}")
+        return {
+            "captured": len(paths), "validated": validated, "paths": paths,
+            "errors": errors,
+        }
 
     @staticmethod
     def summarize(results: Sequence[Dict[str, Any]]) -> Dict[str, Any]:

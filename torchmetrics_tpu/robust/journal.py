@@ -57,6 +57,46 @@ SNAPSHOT_FILENAME = "snapshot.tmsnap"
 LOCK_FILENAME = ".writer.lock"
 _HEADER = struct.Struct("<IQ")
 
+#: most recent journal activity in this process: the cursor a post-mortem bundle
+#: records so replay can stop bit-identically at the captured instant
+#: (docs/observability.md "Flight recorder & post-mortem bundles")
+_LAST_CURSOR: Optional[Dict[str, Any]] = None
+
+
+def _note_cursor(path: str, last_seq: int) -> None:
+    global _LAST_CURSOR
+    _LAST_CURSOR = {
+        "path": path,
+        "last_seq": int(last_seq),
+        "snapshot_present": os.path.exists(os.path.join(path, SNAPSHOT_FILENAME)),
+    }
+
+
+def last_cursor() -> Optional[Dict[str, Any]]:
+    """The latest journal cursor this process touched (None before any append)."""
+    return None if _LAST_CURSOR is None else dict(_LAST_CURSOR)
+
+
+def _cursor_seq(cursor: Any) -> Optional[int]:
+    """Normalise a replay cursor: int, cursor dict, bundle document, or bundle path."""
+    if cursor is None:
+        return None
+    if isinstance(cursor, int):
+        return cursor
+    if isinstance(cursor, (str, os.PathLike)):
+        from torchmetrics_tpu.obs.bundle import load_bundle
+
+        cursor = load_bundle(cursor, strict=False)
+    if isinstance(cursor, dict):
+        if "sections" in cursor:  # a full bundle document
+            cursor = (cursor["sections"].get("journal") or {}).get("cursor") or {}
+        if "last_seq" in cursor:
+            return int(cursor["last_seq"])
+    raise JournalError(
+        f"Unusable journal cursor {cursor!r}: pass a sequence number, a bundle's"
+        " journal cursor dict, a bundle document, or a bundle path."
+    )
+
 
 def _pid_alive(pid: int) -> bool:
     """Best-effort liveness probe; a pid we may not signal is assumed alive."""
@@ -225,6 +265,8 @@ class Journal:
         _checkpoint.atomic_write_bytes(self._record_path(seq), data)
         self._next_seq = seq + 1
         obs.telemetry.counter("robust.journal_appends").inc()
+        obs.flightrec.record("journal.append", seq=seq, path=self.path)
+        _note_cursor(self.path, seq)
         if self.max_pending and (seq % 64 == 0) and self.pending > self.max_pending:
             rank_zero_warn(
                 f"Update journal at {self.path!r} holds {self.pending} records, beyond its"
@@ -258,12 +300,16 @@ class Journal:
             if is_tail:
                 # a crash mid-append can only tear the newest record; losing the batch
                 # that was being written when the process died is the honest outcome
+                obs.flightrec.record("journal.torn_tail", seq=seq, problem=problem)
                 rank_zero_warn(
                     f"Journal tail record {path!r} is torn ({problem}); skipping it."
                     " The batch being appended at the crash is not recoverable.",
                     UserWarning,
                 )
                 return None
+            # a mid-stream hole is unrecoverable: bundle the evidence before failing
+            obs.flightrec.record("journal.corrupt", seq=seq, problem=problem, path=self.path)
+            obs.capture_bundle("journal_corrupt")
             raise JournalError(
                 f"Journal record {path!r} is corrupt ({problem}) with later records"
                 " present — the stream has a hole and cannot be replayed faithfully."
@@ -294,6 +340,8 @@ class Journal:
                     pass
         if dropped:
             _checkpoint._fsync_dir(self.path)
+            obs.flightrec.record("journal.truncate", through=seq, dropped=dropped, path=self.path)
+            _note_cursor(self.path, self.last_seq)
         return dropped
 
     def clear(self) -> int:
@@ -301,31 +349,53 @@ class Journal:
         return self.truncate_through(self._next_seq)
 
 
-def replay(metric: Any, journal: Union[Journal, str, os.PathLike], after_seq: int = -1) -> int:
+def replay(
+    metric: Any,
+    journal: Union[Journal, str, os.PathLike],
+    after_seq: int = -1,
+    through_seq: Optional[int] = None,
+) -> int:
     """Re-apply journaled batches through ``metric.update``; returns the batch count.
 
     Replay drives the plain ``update`` path regardless of which dispatch tier originally
     produced the records — the tier-equivalence suite is what makes that bit-identical.
+    ``through_seq`` (a post-mortem bundle's journal cursor) stops replay AT that record,
+    reconstructing the exact state of the captured instant rather than the journal tail.
     """
     jr = journal if isinstance(journal, Journal) else Journal(journal)
     n = 0
-    for _seq, args, kwargs in jr.read(after_seq=after_seq):
+    for seq, args, kwargs in jr.read(after_seq=after_seq):
+        if through_seq is not None and seq > through_seq:
+            break
         metric.update(*args, **kwargs)
         n += 1
     if n:
         obs.telemetry.counter("robust.journal_replays").inc(n)
         obs.telemetry.event("robust.journal_replay", cat="robust", args={"batches": n, "path": jr.path})
+        obs.flightrec.record(
+            "journal.replay", batches=n, path=jr.path,
+            through=through_seq if through_seq is not None else jr.last_seq,
+        )
     return n
 
 
-def recover(metric: Any, path: Union[str, os.PathLike]) -> Dict[str, Any]:
+def recover(
+    metric: Any, path: Union[str, os.PathLike], cursor: Any = None
+) -> Dict[str, Any]:
     """Restore ``snapshot + replay(journal)`` from a journal directory into ``metric``.
 
     The durable snapshot (if present) is restored first — via the metric's own
     ``restore`` so collections round-trip too — then every journal record past the
     snapshot's high-water mark is replayed. Returns ``{"snapshot_restored", "replayed"}``.
+
+    ``cursor`` accepts a post-mortem bundle's journal cursor — an int sequence number,
+    the cursor dict, the loaded bundle document, or a ``.tmb`` path — and stops replay
+    at it, so the recovered state is **bit-identical** to the state of the process at
+    the instant the bundle was captured (not the journal's later tail). That is the
+    post-mortem contract: a bundle plus its journal is a reproducible crash scene.
     """
     path = os.fspath(path)
+    through = _cursor_seq(cursor)
     # recovery means the previous writer process is gone — its writer lock (if any) is
     # stale by definition; break it so the recovering process can open a fresh proxy
     break_lock(path)
@@ -338,8 +408,11 @@ def recover(metric: Any, path: Union[str, os.PathLike]) -> Dict[str, Any]:
         after = int(blob.pop("journal_seq", -1))
         metric.restore(blob)
         restored = True
-    replayed = replay(metric, jr, after_seq=after)
-    return {"snapshot_restored": restored, "replayed": replayed, "after_seq": after}
+    replayed = replay(metric, jr, after_seq=after, through_seq=through)
+    return {
+        "snapshot_restored": restored, "replayed": replayed, "after_seq": after,
+        "through_seq": through,
+    }
 
 
 class MetricJournal:
@@ -437,6 +510,15 @@ class MetricJournal:
     def buffered(self, k: int) -> Any:
         """A :class:`BufferedUpdater` over the target with this journal at its seam."""
         return self.metric.buffered(k, journal=self.journal)
+
+    @staticmethod
+    def recover(metric: Any, path: Union[str, os.PathLike], cursor: Any = None) -> Dict[str, Any]:
+        """``snapshot + replay(journal)`` into ``metric`` — accepting a post-mortem
+        bundle's journal cursor (int / cursor dict / bundle document / ``.tmb`` path)
+        so replay stops bit-identically at the captured instant. Delegates to the
+        module-level :func:`recover`; provided on the proxy class so recovery code has
+        one import surface."""
+        return recover(metric, path, cursor=cursor)
 
     def close(self) -> None:
         """Release the exclusive writer lock (idempotent); the journal stays readable."""

@@ -45,6 +45,8 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, Optional, Tuple
 
+from torchmetrics_tpu.obs import bundle as _bundle
+from torchmetrics_tpu.obs import flightrec as _flightrec
 from torchmetrics_tpu.obs import telemetry
 from torchmetrics_tpu.obs import trace as _trace
 from torchmetrics_tpu.ops import dispatch as _dispatch
@@ -190,6 +192,7 @@ class IngestEngine:
                     # denominator), serve.sheds the shed events themselves
                     telemetry.series("serve.queue_depth").record(opts.max_inflight)
                     telemetry.series("serve.sheds").record(1.0)
+                    _flightrec.record("serve.shed", seq=ticket.seq, inflight=opts.max_inflight)
                     _trace.shed_event(ticket.trace_id, ticket.seq)
                     rank_zero_warn(
                         f"Async ingestion window full ({opts.max_inflight} in flight):"
@@ -206,6 +209,9 @@ class IngestEngine:
                 # block: park with exponential-backoff waits against queue_timeout_s
                 self._stats["backpressure_stalls"] += 1
                 telemetry.counter("serve.backpressure_stalls").inc()
+                _flightrec.record(
+                    "serve.backpressure", seq=ticket.seq, inflight=opts.max_inflight
+                )
                 deadline = time.monotonic() + opts.queue_timeout_s
                 wait = _BLOCK_WAIT_MIN_S
                 while self._window_full_locked():
@@ -252,12 +258,23 @@ class IngestEngine:
             return
         if t is not None:  # a previous drain died (chaos DrainThreadDeath, or a crash)
             if not self.options.restart_drain:
+                _flightrec.record(
+                    "serve.drain_restart", pending=len(self._queue), restarted=False
+                )
+                _bundle.capture_bundle("serve_drain_death", metric=self.target)
                 raise ServeError(
                     "The ingestion drain thread died and restart_drain is off; the"
                     f" window holds {len(self._queue)} unapplied batch(es)."
                 )
             self._stats["drain_restarts"] += 1
             telemetry.counter("serve.drain_restarts").inc()
+            # a drain death is a real failure seam even when the latch recovers it:
+            # land the post-mortem bundle, then restart (docs/observability.md)
+            _flightrec.record(
+                "serve.drain_restart", pending=len(self._queue),
+                restarts=self._stats["drain_restarts"],
+            )
+            _bundle.capture_bundle("serve_drain_death", metric=self.target)
             rank_zero_warn(
                 "The async ingestion drain thread died; restarting it. Batches still in"
                 " the window will be re-applied in FIFO order (none were committed).",
@@ -339,6 +356,9 @@ class IngestEngine:
             except Exception as err:  # noqa: BLE001 - a bad batch must not kill the drain
                 self._stats["failed"] += len(items)
                 telemetry.counter("serve.apply_failures").inc(len(items))
+                _flightrec.record(
+                    "serve.apply_failure", batches=len(items), error=repr(err)[:200]
+                )
                 for it in items:
                     it[0]._resolve(error=err)
                     _trace.failed_event(it[0].trace_id, repr(err))
@@ -389,6 +409,9 @@ class IngestEngine:
         if store is not None and self._fence is not None and store.generation != self._fence:
             self._stats["fence_breaks"] += 1
             telemetry.counter("serve.fence_breaks").inc()
+            _flightrec.record(
+                "serve.fence_break", expected=self._fence, observed=store.generation
+            )
             _trace.fence_break_event(self._fence, store.generation)
             rank_zero_warn(
                 "Async ingestion generation fence broke: the metric state moved"
@@ -458,6 +481,9 @@ class IngestEngine:
             self._fence = None
             err, self._pending_error = self._pending_error, None
         if err is not None:
+            # the deferred apply failure surfaces HERE (the drain already recorded the
+            # apply_failure event); capture the bundle before the raise reaches user code
+            _bundle.capture_bundle("serve_apply_failure", metric=self.target)
             raise ServeError(
                 f"A batch enqueued via update_async failed to apply: {err!r}. The"
                 " metric state holds every batch before it; the failed batch is NOT"
@@ -489,6 +515,11 @@ class IngestEngine:
             self._stop = True
             self._abandoned = True
             self._cond.notify_all()
+        # the preemption seam: the dropped window only survives in the write-ahead
+        # journal, and the bundle records its cursor — post-mortem replay from it is
+        # bit-identical (docs/observability.md "Flight recorder & post-mortem bundles")
+        _flightrec.record("serve.abandoned", dropped_in_window=dropped)
+        _bundle.capture_bundle("serve_abandoned", metric=self.target)
         return dropped
 
     def close(self) -> None:

@@ -75,6 +75,16 @@ class BackpressureError(ServeError):
     """
 
 
+class BundleError(TorchMetricsUserError):
+    """Raised when a post-mortem flight bundle fails capture-time or read-time validation.
+
+    Covers files that are not bundles (bad magic/truncated header), container or
+    per-section CRC mismatches, unknown format versions, and bundles missing required
+    sections — see ``torchmetrics_tpu.obs.bundle`` and docs/observability.md
+    "Flight recorder & post-mortem bundles".
+    """
+
+
 class ReconciliationError(TorchMetricsUserError):
     """Raised when a rank re-admission handshake blob fails validation.
 

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke chaos chaos-matrix perf-gate
+test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -83,6 +83,15 @@ online-smoke:
 bundle-smoke:
 	python bench.py --flight --smoke > /tmp/tm_bundle_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_bundle_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['flight_record_ok'], ('flight-ring record path above the 2us bound', ex['flight_record_us_per_event']); assert ex['bundle_validates'], ex; assert ex['memory_ledger_ok'], ('memory ledger off nbytes truth', ex['memory_ledger_max_rel_err']); assert ex['memory_budget_quiet_under_budget'] and ex['memory_budget_fires_over_budget'] and ex['memory_budget_warned_exactly_once'], ex; assert set(ex['memory_ledger_kinds']) >= {'tenant_table','window_ring','sketch'}, ex; print('bundle-smoke ok: record %.2fus/event (<=2us), capture %.1fms, ledger err %.1e, budget one-shot' % (ex['flight_record_us_per_event'], ex['bundle_capture_ms'], ex['memory_ledger_max_rel_err']))"
+
+# fleet federation lane (docs/observability.md "Fleet federation & incident
+# correlation"): live localhost peers -> fleet-tier Federator, asserting the acceptance
+# bar -- merged scrape strict-parses, counters sum exactly, the fleet p99 is a true
+# pooled quantile within the KLL rank-error bound, and a peer killed mid-fleet degrades
+# to an unhealthy count without failing the scrape
+fleet-smoke:
+	python bench.py --fleet --smoke > /tmp/tm_fleet_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_fleet_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['merged_scrape_parses'], ex; assert ex['fleet_counter_sum_ok'], ('fleet counter aggregate wrong', ex['fleet_counter_sum']); assert ex['fleet_p99_ok'], ('fleet p99 outside the pooled-quantile bound', ex['fleet_p99']); assert ex['incident_minted'] and ex['incident_in_federated_scrape'], ('incident id did not gossip into the scrape', ex); assert ex['fleet_bundle_validates'] and ex['fleet_bundle_incident_matches'], ('merge-fleet bundle invalid', ex); assert ex['degrade_ok'], ('peer death failed the scrape', ex); assert ex['fleet_unhealthy'] == 0, ex; print('fleet-smoke ok: %d peers polled in %.1fms, %dB merged scrape, pooled p99 %.0f, peer-death degrades cleanly' % (ex['fleet_peers'], ex['fleet_poll_ms'], ex['merged_scrape_bytes'], ex['fleet_p99']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

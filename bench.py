@@ -1396,6 +1396,154 @@ def flight_main(smoke: bool) -> None:
     )
 
 
+def bench_fleet(n_peers: int, points_per_peer: int) -> dict:
+    """``--fleet`` scenario (docs/observability.md "Fleet federation & incident correlation").
+
+    Four lanes over a real in-process fleet (N scrape servers on localhost, one
+    fleet-tier :class:`~torchmetrics_tpu.obs.federation.Federator` polling them over
+    actual HTTP):
+
+    1. **federation poll latency** — wall time of one full poll (N ``/metrics`` GETs,
+       strict parses, N ``/federation`` sidecar GETs, aggregate + SLO evaluation),
+       best-of-3 after a warmup poll so the sketch-merge jit compile is excluded.
+    2. **merged-scrape cost** — byte size of the tier-labelled merged exposition, and
+       proof it strict-``parse()``\\ s; counter-sum and pooled-quantile (true
+       ``kll_merge``) correctness are asserted, not just measured.
+    3. **incident correlation** — two bundle captures join one incident whose id is
+       visible in the federated scrape, and ``merge_fleet_bundles`` assembles them
+       into a bundle that strict ``validate_bundle`` accepts.
+    4. **degradation** — one peer killed mid-fleet: the next poll must not raise, must
+       count exactly one unhealthy peer, and the merged scrape must stay parseable.
+    """
+    from torchmetrics_tpu.obs import federation, openmetrics
+    from torchmetrics_tpu.obs.telemetry import Telemetry
+
+    out: dict = {}
+    regs = []
+    for i in range(n_peers):
+        t = Telemetry(enabled=False)
+        t.counter("serve.enqueued").inc((i + 1) * 10)
+        s = t.series("fleet.bench_lat")
+        for v in range(i * points_per_peer, (i + 1) * points_per_peer):
+            s.record(float(v))
+        regs.append(t)
+    servers = [openmetrics.serve_scrape(registry=r) for r in regs]
+    try:
+        peers = [
+            federation.Peer(name=f"p{i}", url=f"http://127.0.0.1:{srv.bound_port()}")
+            for i, srv in enumerate(servers)
+        ]
+        fed = federation.Federator(peers, tier="fleet", timeout_s=10.0)
+
+        # --- lane 1: poll latency (warmup excludes the kll_merge jit compile) -------
+        fed.poll()
+        poll_ms = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            summary = fed.poll()
+            poll_ms = min(poll_ms, (time.perf_counter() - t0) * 1e3)
+        out["fleet_poll_ms"] = round(poll_ms, 2)
+        out["fleet_peers"] = n_peers
+        out["fleet_unhealthy"] = summary["unhealthy"]
+
+        # --- lane 2: merged-scrape bytes + semantic proof ---------------------------
+        text = fed.render()
+        out["merged_scrape_bytes"] = len(text.encode("utf-8"))
+        parsed = openmetrics.parse(text)
+        out["merged_scrape_parses"] = parsed["samples"] > 0
+        agg = [
+            s
+            for s in parsed["families"]["tm_serve_enqueued"]["samples"]
+            if s["labels"].get("tier") == "fleet"
+        ]
+        want = sum((i + 1) * 10 for i in range(n_peers))
+        out["fleet_counter_sum"] = agg[0]["value"] if agg else None
+        out["fleet_counter_sum_ok"] = bool(agg) and agg[0]["value"] == want
+        n_total = n_peers * points_per_peer
+        p99 = next(
+            (
+                s["value"]
+                for s in parsed["families"]["tm_fleet_bench_lat"]["samples"]
+                if s["labels"].get("quantile") == "0.99"
+                and s["labels"].get("tier") == "fleet"
+            ),
+            None,
+        )
+        out["fleet_p99"] = p99
+        # pooled quantile within the documented KLL rank-error bound (2% of N ranks)
+        out["fleet_p99_ok"] = p99 is not None and abs(p99 - 0.99 * (n_total - 1)) <= (
+            0.02 * n_total + 1
+        )
+
+        # --- lane 3: incident-id propagation into a validated fleet bundle ----------
+        import tempfile
+
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.obs import flightrec
+
+        flightrec.clear_incidents()
+        bdir = tempfile.mkdtemp(prefix="tm-fleet-bench-")
+        obs.capture_bundle("fleet-bench-timeout", directory=bdir)
+        obs.capture_bundle("fleet-bench-drain", directory=bdir)  # joins the incident
+        inc_id = flightrec.current_incident()
+        out["incident_minted"] = inc_id is not None
+        fed.poll()
+        scrape = fed.render()
+        out["incident_in_federated_scrape"] = bool(inc_id) and inc_id in scrape
+        try:
+            merged = obs.merge_fleet_bundles([bdir])
+            verdict = obs.validate_bundle(merged)
+            out["fleet_bundle_validates"] = bool(verdict["valid"])
+            out["fleet_bundle_incident_matches"] = verdict.get("incident_id") == inc_id
+        except Exception as err:
+            out["fleet_bundle_validates"] = False
+            out["fleet_bundle_error"] = repr(err)
+        flightrec.clear_incidents()
+
+        # --- lane 4: peer death degrades, never raises ------------------------------
+        servers[-1].close()
+        fed.timeout_s = 1.0
+        try:
+            after = fed.poll()
+            openmetrics.parse(fed.render())
+            out["degrade_unhealthy"] = after["unhealthy"]
+            out["degrade_ok"] = after["unhealthy"] == 1
+        except Exception as err:  # a dead peer must never fail the scrape
+            out["degrade_ok"] = False
+            out["degrade_error"] = repr(err)
+    finally:
+        for srv in servers:
+            srv.close()
+    return out
+
+
+def fleet_main(smoke: bool) -> None:
+    """``bench.py --fleet [--smoke]``: one JSON line with the federation proof."""
+    extras = bench_fleet(*((3, 100) if smoke else (8, 2000)))
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "fleet_poll_ms",
+                "value": extras["fleet_poll_ms"],
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "wall ms for one full federation poll over live localhost peers"
+                    " (strict parse + sidecar + aggregate + fleet SLOs); merged-scrape"
+                    " bytes, counter-sum/pooled-p99 proofs, and peer-death degradation"
+                    " evidence in extras"
+                ),
+                "vs_baseline": None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_online(batch: int, n_batches: int) -> dict:
     """``--online`` scenario (docs/online.md): windowed monitoring on the hot path.
 
@@ -2287,6 +2435,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         flight_main(smoke)
+    elif "--fleet" in sys.argv:
+        # fleet federation lane (make fleet-smoke / docs/observability.md "Fleet
+        # federation & incident correlation"): smoke pins CPU like the other lanes
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        fleet_main(smoke)
     elif "--online" in sys.argv:
         # online windowed-monitoring lane (make online-smoke / docs/online.md): smoke
         # pins CPU like the other lanes; full mode probes for a healthy platform

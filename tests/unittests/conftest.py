@@ -67,5 +67,15 @@ def _fresh_rank_health():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _fresh_incidents():
+    # incidents dedup within a 300s window by design; without a reset, one test's failure
+    # seam would stamp its incident id onto every later test's flight events
+    from torchmetrics_tpu.obs import flightrec
+
+    flightrec.clear_incidents()
+    yield
+
+
 def use_deterministic_algorithms():  # parity shim with reference conftest
     pass

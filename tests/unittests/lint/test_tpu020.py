@@ -1,0 +1,114 @@
+"""TPU020: process-identity reads inside jit-traced code (frozen at trace time)."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+PATH = "torchmetrics_tpu/obs/labels.py"
+
+
+def _tpu020(source: str, path: str = PATH):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU020"]
+
+
+# identity baked into the trace: pid + hostname read inside a jitted engine kernel
+FROZEN = """
+import os
+import socket
+import jax
+
+@jax.jit
+def _update(state, preds):
+    label = f"{socket.gethostname()}:{os.getpid()}"
+    return state + preds.sum(), label
+"""
+
+# the correct shape: identity read once on the eager host path, traced code stays pure
+EAGER = """
+import os
+import socket
+import jax
+from torchmetrics_tpu import obs
+
+FINGERPRINT = obs.process_fingerprint()
+
+
+def scrape_labels():
+    return {"host": socket.gethostname(), "pid": str(os.getpid())}
+
+
+@jax.jit
+def _update(state, preds):
+    return state + preds.sum()
+"""
+
+
+class TestFrozenIdentity:
+    def test_identity_reads_inside_jit_flag(self):
+        findings = _tpu020(FROZEN)
+        assert len(findings) == 2
+        msgs = "\n".join(f.message for f in findings)
+        assert "os.getpid" in msgs and "socket.gethostname" in msgs
+        assert "TRACE time" in findings[0].message
+        assert "compilation-cache" in findings[0].message
+
+    def test_fingerprint_inside_jit_flags(self):
+        src = """
+import jax
+from torchmetrics_tpu import obs
+
+@jax.jit
+def _compute(state):
+    who = obs.process_fingerprint()
+    return state, who
+"""
+        findings = _tpu020(src)
+        assert len(findings) == 1
+        assert "process_fingerprint" in findings[0].message
+
+    def test_uuid_node_identity_flags(self):
+        src = """
+import uuid
+import jax
+
+@jax.jit
+def _update(state):
+    return state, str(uuid.uuid1())
+"""
+        assert len(_tpu020(src)) == 1
+
+
+class TestEagerIdentityClean:
+    def test_eager_host_path_is_clean(self):
+        assert _tpu020(EAGER) == []
+
+    def test_module_level_read_is_clean(self):
+        src = """
+import os
+
+PID = os.getpid()
+
+
+def fmt(v):
+    return f"{PID}:{v}"
+"""
+        assert _tpu020(src) == []
+
+    def test_disable_comment_suppresses(self):
+        src = """
+import os
+import jax
+
+@jax.jit
+def _update(state):
+    return state, os.getpid()  # jaxlint: disable=TPU020
+"""
+        assert _tpu020(src) == []
+
+
+class TestRegistration:
+    def test_rule_meta_registered(self):
+        meta = RULE_META["TPU020"]
+        assert meta["severity"] == "warning"
+        assert "process-identity" in meta["summary"]
+        assert "eager host path" in meta["fix"]

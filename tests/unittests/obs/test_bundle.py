@@ -229,3 +229,66 @@ class TestFailureSeamsCapture:
             out = obs.capture_bundle("doomed", directory=str(blocker))
         assert out is None
         assert obs.telemetry.counter("flight.bundle_capture_failures").value == fails0 + 1
+
+
+class TestFleetMerge:
+    def test_bundles_in_one_window_share_an_incident(self, tmp_path):
+        a = _capture(tmp_path, reason="sync_timeout")
+        b = _capture(tmp_path, reason="serve_drain_death")
+        inc_a = obs.validate_bundle(a)["incident_id"]
+        inc_b = obs.validate_bundle(b)["incident_id"]
+        assert inc_a is not None and inc_a == inc_b
+
+    def test_merge_fleet_round_trip(self, tmp_path, capsys):
+        obs.flightrec.record("pre.merge", step=1)
+        _capture(tmp_path, reason="sync_timeout")
+        obs.flightrec.record("mid.incident", step=2)
+        _capture(tmp_path, reason="serve_drain_death")
+        out = obs.merge_fleet_bundles([str(tmp_path)])
+        summary = obs.validate_bundle(out)
+        assert summary["incident_id"] and "fleet-" in os.path.basename(out)
+        doc = bundle_mod.load_bundle(out)
+        fleet = doc["sections"]["fleet"]
+        assert len(fleet["bundles"]) == 2
+        # cross-rank contract: per-peer causal order, peers side by side
+        keys = [(e["peer"], e["seq"]) for e in fleet["timeline"]]
+        assert keys == sorted(keys)
+        assert any(e["kind"] == "mid.incident" for e in fleet["timeline"])
+        # CLI front door agrees
+        assert bundle_mod.main(["validate", out]) == 0
+        assert bundle_mod.main(["inspect", out]) == 0
+        assert "incident" in capsys.readouterr().out
+
+    def test_merge_fleet_cli(self, tmp_path, capsys):
+        _capture(tmp_path, reason="sync_timeout")
+        assert bundle_mod.main(["merge-fleet", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "fleet bundle written:" in out
+
+    def test_merge_without_incident_fails_cleanly(self, tmp_path, capsys):
+        from torchmetrics_tpu.obs import flightrec
+
+        flightrec.clear_incidents()
+        path = obs.capture_bundle("manual", directory=str(tmp_path))
+        # a manual capture DOES open an incident; strip it to simulate old bundles
+        doc = bundle_mod.load_bundle(path)
+        assert doc["incident_id"]
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(BundleError):
+            obs.merge_fleet_bundles([str(empty)])
+        assert bundle_mod.main(["merge-fleet", str(empty)]) == 1
+        assert "merge-fleet failed" in capsys.readouterr().out
+
+    def test_mismatched_incident_skipped_with_warning(self, tmp_path, monkeypatch):
+        from torchmetrics_tpu.obs import flightrec
+
+        a = _capture(tmp_path, reason="first_storm")
+        flightrec.clear_incidents()
+        b = _capture(tmp_path, reason="second_storm")
+        inc_b = obs.validate_bundle(b)["incident_id"]
+        with pytest.warns(UserWarning, match="incident"):
+            out = obs.merge_fleet_bundles([str(tmp_path)], incident_id=inc_b)
+        fleet = bundle_mod.load_bundle(out)["sections"]["fleet"]
+        assert len(fleet["bundles"]) == 1
+        assert fleet["bundles"][0]["reason"] == "second_storm"

@@ -1,0 +1,305 @@
+"""Federation: 3-peer round trip, merge semantics per instrument, peer-death degradation."""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.obs import federation, openmetrics
+from torchmetrics_tpu.obs.federation import Federator, Peer, federation_payload, peers_from_file
+from torchmetrics_tpu.obs.telemetry import Telemetry
+
+
+def _peer_registry(counter: float, lat_points) -> Telemetry:
+    t = Telemetry(enabled=False)
+    t.counter("serve.enqueued").inc(int(counter))
+    t.gauge("memory.resident_bytes").set(counter * 1000)
+    s = t.series("demo.lat")
+    for v in lat_points:
+        s.record(float(v))
+    return t
+
+
+def _three_registries():
+    return {
+        "p0": _peer_registry(10, range(0, 100)),
+        "p1": _peer_registry(20, range(100, 200)),
+        "p2": _peer_registry(30, range(200, 300)),
+    }
+
+
+class _FakeFleet:
+    """In-memory transport: a fetch_fn over per-peer registries, with a kill switch."""
+
+    def __init__(self, registries):
+        self.registries = registries
+        self.dead = set()
+
+    def peers(self):
+        return [Peer(name=n, url=f"mem://{n}", pod="pod0") for n in self.registries]
+
+    def fetch(self, url: str) -> bytes:
+        name = url.split("//")[1].split("/")[0]
+        if name in self.dead:
+            raise ConnectionError(f"{name} is down")
+        reg = self.registries[name]
+        if url.endswith("/federation"):
+            return json.dumps(federation_payload(reg)).encode("utf-8")
+        return openmetrics.render(registry=reg).encode("utf-8")
+
+
+@pytest.fixture()
+def fake_fleet():
+    return _FakeFleet(_three_registries())
+
+
+def _samples(parsed, fam):
+    return parsed["families"][fam]["samples"]
+
+
+class TestMergeSemantics:
+    def test_counters_sum_into_tier_aggregate(self, fake_fleet):
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        assert fed.poll()["unhealthy"] == 0
+        parsed = openmetrics.parse(fed.render())
+        agg = [s for s in _samples(parsed, "tm_serve_enqueued")
+               if s["labels"].get("tier") == "fleet"]
+        assert len(agg) == 1
+        assert agg[0]["value"] == 60.0
+
+    def test_gauges_keep_per_peer_samples_plus_aggregate(self, fake_fleet):
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        parsed = openmetrics.parse(fed.render())
+        samples = _samples(parsed, "tm_memory_resident_bytes")
+        by_peer = {s["labels"]["peer"]: s["value"]
+                   for s in samples if "peer" in s["labels"]}
+        assert by_peer == {"p0": 10000.0, "p1": 20000.0, "p2": 30000.0}
+        agg = [s for s in samples if s["labels"].get("tier") == "fleet"]
+        assert agg and agg[0]["value"] == 60000.0
+
+    def test_per_peer_samples_carry_tier_pod_peer_labels(self, fake_fleet):
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        parsed = openmetrics.parse(fed.render())
+        peer_samples = [s for s in _samples(parsed, "tm_serve_enqueued")
+                        if "peer" in s["labels"]]
+        assert len(peer_samples) == 3
+        for s in peer_samples:
+            assert s["labels"]["tier"] == "host"  # one hop from a plain process
+            assert s["labels"]["pod"] == "pod0"
+
+    def test_series_merge_is_a_true_pooled_quantile(self, fake_fleet):
+        # 300 pooled points 0..299: the fleet p99 must honour the KLL rank-error
+        # bound over the POOLED distribution — not an average of per-peer p99s
+        # (which would be ~(99+199+299)/3 = 199).
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        parsed = openmetrics.parse(fed.render())
+        samples = _samples(parsed, "tm_demo_lat")
+        agg = {s["name"] + "|" + s["labels"].get("quantile", ""): s["value"]
+               for s in samples if s["labels"].get("tier") == "fleet"}
+        assert agg["tm_demo_lat_count|"] == 300.0
+        assert agg["tm_demo_lat_sum|"] == float(sum(range(300)))
+        p99 = agg["tm_demo_lat|0.99"]
+        assert abs(p99 - np.quantile(np.arange(300.0), 0.99)) <= 0.02 * 300 + 1
+        p50 = agg["tm_demo_lat|0.5"]
+        assert abs(p50 - 149.5) <= 0.02 * 300 + 1
+
+    def test_payload_chains_with_tier_stamp(self, fake_fleet):
+        fed = Federator(fake_fleet.peers(), tier="pod", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        payload = fed.payload()
+        assert payload["tier"] == "pod"
+        assert payload["counters"]["serve.enqueued"] == 60.0
+        # series chain by concatenation: one sketch payload per peer
+        assert len(payload["series"]["demo.lat"]) == 3
+
+    def test_chained_federator_does_not_double_count(self, fake_fleet):
+        pod = Federator(fake_fleet.peers(), tier="pod", fetch_fn=fake_fleet.fetch)
+        pod.poll()
+
+        def outer_fetch(url: str) -> bytes:
+            if url.endswith("/federation"):
+                return json.dumps(pod.payload()).encode("utf-8")
+            return pod.render().encode("utf-8")
+
+        fleet = Federator([Peer(name="pod-a", url="mem://pod-a", pod="pod-a")],
+                          tier="fleet", fetch_fn=outer_fetch)
+        fleet.poll()
+        parsed = openmetrics.parse(fleet.render())
+        agg = [s for s in _samples(parsed, "tm_serve_enqueued")
+               if s["labels"].get("tier") == "fleet"]
+        assert agg and agg[0]["value"] == 60.0  # not 120
+
+
+class TestPeerDeath:
+    def test_dead_peer_degrades_never_raises(self, fake_fleet):
+        from torchmetrics_tpu.obs import flightrec
+
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        fake_fleet.dead.add("p2")
+        summary = fed.poll()  # must not raise
+        assert summary["unhealthy"] == 1
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "fleet.peer_unreachable" in kinds
+        parsed = openmetrics.parse(fed.render())
+        up = {s["labels"]["peer"]: s["value"]
+              for s in _samples(parsed, "tm_fleet_peer_up")}
+        assert up == {"p0": 1.0, "p1": 1.0, "p2": 0.0}
+        unhealthy = _samples(parsed, "tm_fleet_peers_unhealthy")
+        assert unhealthy[0]["value"] == 1.0
+
+    def test_stale_beats_blind(self, fake_fleet):
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fed.poll()
+        fake_fleet.dead.add("p2")
+        fed.poll()
+        # p2's last-good counter still contributes to the aggregate
+        parsed = openmetrics.parse(fed.render())
+        agg = [s for s in _samples(parsed, "tm_serve_enqueued")
+               if s["labels"].get("tier") == "fleet"]
+        assert agg[0]["value"] == 60.0
+
+    def test_recovery_records_transition_event(self, fake_fleet):
+        from torchmetrics_tpu.obs import flightrec
+
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fake_fleet.fetch)
+        fake_fleet.dead.add("p1")
+        fed.poll()
+        fake_fleet.dead.clear()
+        fed.poll()
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "fleet.peer_recovered" in kinds
+        # transitions only: a second healthy poll adds no new transition events
+        n = kinds.count("fleet.peer_recovered")
+        fed.poll()
+        assert [e["kind"] for e in flightrec.events()].count("fleet.peer_recovered") == n
+
+    def test_garbage_scrape_counts_as_unhealthy(self, fake_fleet):
+        def corrupt_fetch(url):
+            if "p0" in url and url.endswith("/metrics"):
+                return b"this is not openmetrics\n"
+            return fake_fleet.fetch(url)
+
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=corrupt_fetch)
+        assert fed.poll()["unhealthy"] == 1
+
+
+class TestIncidentGossip:
+    def test_peer_incidents_union_deduped(self, fake_fleet):
+        def fetch_with_incident(url):
+            body = fake_fleet.fetch(url)
+            if url.endswith("/federation"):
+                payload = json.loads(body)
+                payload["incidents"] = [
+                    {"id": "inc-deadbeef-0001", "reason": "sync_timeout", "active": True}
+                ]
+                return json.dumps(payload).encode("utf-8")
+            return body
+
+        fed = Federator(fake_fleet.peers(), tier="fleet", fetch_fn=fetch_with_incident)
+        fed.poll()
+        incidents = fed.active_incidents()
+        ids = [i["id"] for i in incidents]
+        assert ids.count("inc-deadbeef-0001") == 1  # 3 peers gossip it, deduped
+        assert fed.registry.gauge("fleet.active_incidents").value >= 1
+
+
+class TestLiveHttpRoundTrip:
+    def test_three_scrape_servers_end_to_end(self):
+        regs = _three_registries()
+        servers = {n: openmetrics.serve_scrape(registry=r) for n, r in regs.items()}
+        try:
+            peers = [Peer(name=n, url=f"http://127.0.0.1:{srv.bound_port()}")
+                     for n, srv in servers.items()]
+            fed = Federator(peers, tier="fleet", timeout_s=5.0)
+            assert fed.poll()["unhealthy"] == 0
+            parsed = openmetrics.parse(fed.render())
+            agg = [s for s in _samples(parsed, "tm_serve_enqueued")
+                   if s["labels"].get("tier") == "fleet"]
+            assert agg and agg[0]["value"] == 60.0
+            # kill one server mid-fleet: next poll degrades, never raises
+            servers["p2"].close()
+            fed.timeout_s = 1.0
+            assert fed.poll()["unhealthy"] == 1
+            openmetrics.parse(fed.render())  # still strictly parseable
+        finally:
+            for srv in servers.values():
+                srv.close()
+
+    def test_federation_server_serves_merged_view(self):
+        regs = _three_registries()
+        servers = {n: openmetrics.serve_scrape(registry=r) for n, r in regs.items()}
+        fed_srv = None
+        try:
+            peers = [Peer(name=n, url=f"http://127.0.0.1:{srv.bound_port()}")
+                     for n, srv in servers.items()]
+            fed = Federator(peers, tier="fleet", timeout_s=5.0)
+            fed_srv = fed.serve(poll_interval_s=0.0)
+            with urllib.request.urlopen(fed_srv.url, timeout=5.0) as resp:
+                text = resp.read().decode("utf-8")
+            assert openmetrics.parse(text)["samples"] > 0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{fed_srv.bound_port()}/federation", timeout=5.0
+            ) as resp:
+                payload = json.loads(resp.read())
+            assert payload["tier"] == "fleet"
+            assert payload["counters"]["serve.enqueued"] == 60.0
+        finally:
+            if fed_srv is not None:
+                fed_srv.close()
+            for srv in servers.values():
+                srv.close()
+
+
+class TestPeerFile:
+    def test_json_format(self, tmp_path):
+        p = tmp_path / "peers.json"
+        p.write_text(json.dumps([
+            {"name": "p0", "url": "http://h0:9464", "pod": "pod-a"},
+            {"name": "p1", "url": "http://h1:9464"},
+        ]))
+        peers = peers_from_file(p)
+        assert peers[0] == Peer(name="p0", url="http://h0:9464", pod="pod-a")
+        assert peers[1].pod == "pod0"
+
+    def test_line_format_with_comments(self, tmp_path):
+        p = tmp_path / "peers.txt"
+        p.write_text("# fleet roster\np0 http://h0:9464 pod-a\n\np1 http://h1:9464\n")
+        peers = peers_from_file(p)
+        assert [pe.name for pe in peers] == ["p0", "p1"]
+        assert peers[0].pod == "pod-a"
+
+    def test_malformed_line_raises(self, tmp_path):
+        p = tmp_path / "peers.txt"
+        p.write_text("just-a-name\n")
+        with pytest.raises(ValueError):
+            peers_from_file(p)
+
+    def test_bad_tier_rejected(self):
+        with pytest.raises(ValueError):
+            Federator([], tier="galaxy")
+
+
+class TestProcessIdentity:
+    def test_scrape_carries_process_info_sample(self):
+        from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+        text = openmetrics.render(registry=Telemetry(enabled=False))
+        parsed = openmetrics.parse(text)
+        samples = _samples(parsed, "tm_process")
+        assert len(samples) == 1
+        fp = process_fingerprint()
+        assert samples[0]["labels"]["fingerprint"] == fp["fingerprint"]
+        assert samples[0]["labels"]["pid"] == str(fp["pid"])
+        assert samples[0]["value"] == 1.0
+
+    def test_payload_carries_fingerprint(self, fake_fleet):
+        payload = federation_payload(Telemetry(enabled=False))
+        assert set(payload["fingerprint"]) == {
+            "fingerprint", "host", "pid", "process_index", "start_unix"
+        }

@@ -1,0 +1,169 @@
+"""Fleet status: the structured document, the one-screen table, and the CLI."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from torchmetrics_tpu.obs import fleet as fleet_mod
+from torchmetrics_tpu.obs import openmetrics
+from torchmetrics_tpu.obs.federation import Federator, Peer, federation_payload
+from torchmetrics_tpu.obs.fleet import fleet_status, format_status
+from torchmetrics_tpu.obs.telemetry import Telemetry
+
+
+def _serving_registry(enqueued: int, sheds: int, mem_mb: float) -> Telemetry:
+    t = Telemetry(enabled=False)
+    t.counter("serve.enqueued").inc(enqueued)
+    t.gauge("memory.resident_bytes").set(mem_mb * 1e6)
+    qd = t.series("serve.queue_depth")
+    for i in range(enqueued):
+        qd.record(float(i % 7))
+    sh = t.series("serve.sheds")
+    for _ in range(sheds):
+        sh.record(1.0)
+    lat = t.series("serve.commit_latency_us")
+    for v in range(100):
+        lat.record(float(v * 10))
+    return t
+
+
+class _FakeFleet:
+    def __init__(self, registries):
+        self.registries = registries
+        self.dead = set()
+
+    def peers(self):
+        return [Peer(name=n, url=f"mem://{n}") for n in self.registries]
+
+    def fetch(self, url: str) -> bytes:
+        name = url.split("//")[1].split("/")[0]
+        if name in self.dead:
+            raise ConnectionError(f"{name} is down")
+        reg = self.registries[name]
+        if url.endswith("/federation"):
+            return json.dumps(federation_payload(reg)).encode("utf-8")
+        return openmetrics.render(registry=reg).encode("utf-8")
+
+
+@pytest.fixture()
+def fed():
+    fake = _FakeFleet({
+        "p0": _serving_registry(100, 0, 512.0),
+        "p1": _serving_registry(100, 5, 640.0),
+    })
+    f = Federator(fake.peers(), tier="fleet", fetch_fn=fake.fetch)
+    f._fake = fake
+    return f
+
+
+class TestFleetStatus:
+    def test_per_peer_rows(self, fed):
+        fed.poll()
+        status = fleet_status(fed)
+        assert status["tier"] == "fleet"
+        assert status["unhealthy"] == 0
+        rows = {r["peer"]: r for r in status["peers"]}
+        assert set(rows) == {"p0", "p1"}
+        assert rows["p0"]["up"] and rows["p1"]["up"]
+        assert rows["p0"]["shed_ratio"] == 0.0
+        assert rows["p1"]["shed_ratio"] == pytest.approx(0.05)
+        assert rows["p0"]["memory_bytes"] == pytest.approx(512e6)
+        # pooled p99 of 0,10,...,990 is within the KLL rank-error bound of 980
+        assert abs(rows["p0"]["commit_p99_us"] - 980.0) <= 0.02 * 100 * 10 + 10
+        assert rows["p0"]["fingerprint"]  # identity propagates through the payload
+
+    def test_down_peer_row_carries_error(self, fed):
+        fed.poll()
+        fed._fake.dead.add("p1")
+        fed.poll()
+        status = fleet_status(fed)
+        rows = {r["peer"]: r for r in status["peers"]}
+        assert rows["p1"]["up"] is False
+        assert "down" in rows["p1"]["error"]
+        assert status["unhealthy"] == 1
+
+    def test_document_is_json_serialisable(self, fed):
+        fed.poll()
+        json.dumps(fleet_status(fed))  # must not raise
+
+    def test_slo_rows_present(self, fed):
+        fed.poll()
+        names = {s["name"] for s in fleet_status(fed)["slo"]}
+        assert "fleet-shed-storm" in names
+        assert "fleet-peers-healthy" in names
+
+
+class TestFormatStatus:
+    def test_one_screen_table(self, fed):
+        fed.poll()
+        text = format_status(fleet_status(fed))
+        lines = text.splitlines()
+        assert lines[0].split() == [
+            "peer", "pod", "up", "rank", "fprint", "shed%", "p99_us", "mem_MB",
+            "sync", "straggler", "incidents",
+        ]
+        assert any(line.startswith("p0") and "UP" in line for line in lines)
+        assert "tier=fleet  peers_unhealthy=0" in text
+        assert "slo fleet-peers-healthy:" in text
+
+    def test_down_peer_renders_not_crashes(self, fed):
+        fed._fake.dead.add("p0")
+        fed.poll()
+        text = format_status(fleet_status(fed))
+        assert "DOWN" in text
+        assert "peers_unhealthy=1" in text
+
+    def test_empty_fleet_renders_header(self):
+        f = Federator([], tier="fleet", fetch_fn=lambda url: b"")
+        f.poll()
+        text = format_status(fleet_status(f))
+        assert text.splitlines()[0].startswith("peer")
+
+
+class TestCli:
+    def _live_server(self):
+        return openmetrics.serve_scrape(registry=_serving_registry(50, 1, 256.0))
+
+    def test_status_table_against_live_peer(self, capsys):
+        srv = self._live_server()
+        try:
+            rc = fleet_mod.main([
+                "status", "--peer", f"http://127.0.0.1:{srv.bound_port()}",
+                "--timeout", "5.0",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "peer0" in out and "UP" in out
+        finally:
+            srv.close()
+
+    def test_status_json_against_live_peer(self, capsys):
+        srv = self._live_server()
+        try:
+            rc = fleet_mod.main([
+                "status", "--json", "--peer",
+                f"http://127.0.0.1:{srv.bound_port()}", "--timeout", "5.0",
+            ])
+            assert rc == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["peers"][0]["up"] is True
+            assert doc["peers"][0]["memory_bytes"] == pytest.approx(256e6)
+        finally:
+            srv.close()
+
+    def test_status_peers_file(self, tmp_path, capsys):
+        srv = self._live_server()
+        try:
+            roster = tmp_path / "peers.txt"
+            roster.write_text(f"host-a http://127.0.0.1:{srv.bound_port()} pod-a\n")
+            rc = fleet_mod.main(["status", "--peers", str(roster), "--timeout", "5.0"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "host-a" in out and "pod-a" in out
+        finally:
+            srv.close()
+
+    def test_no_peers_is_a_usage_error(self):
+        with pytest.raises(SystemExit):
+            fleet_mod.main(["status"])

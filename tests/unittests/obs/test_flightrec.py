@@ -80,3 +80,51 @@ class TestSummaryFamilies:
         assert "flight_events" in extras and "bundles_captured" in extras
         assert "memory_resident_bytes" in extras
         assert isinstance(extras["memory_resident_bytes"], int)
+
+
+class TestIncidents:
+    def test_open_mints_stable_id_and_stamps_events(self):
+        from torchmetrics_tpu.obs import flightrec
+        from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+        inc_id = flightrec.open_incident("sync_timeout")
+        assert inc_id.startswith(f"inc-{process_fingerprint()['fingerprint']}-")
+        assert flightrec.current_incident() == inc_id
+        obs.flightrec.record("some.event", x=1)
+        assert obs.flightrec.events()[-1]["incident"] == inc_id
+
+    def test_seams_within_window_join_one_incident(self):
+        from torchmetrics_tpu.obs import flightrec
+
+        first = flightrec.open_incident("sync_timeout")
+        second = flightrec.open_incident("serve_drain_death")
+        assert second == first  # joined, not a new incident
+
+    def test_adopt_foreign_incident(self):
+        from torchmetrics_tpu.obs import flightrec
+
+        flightrec.adopt_incident("inc-cafebabe-0042", reason="gossip")
+        assert flightrec.current_incident() == "inc-cafebabe-0042"
+        kinds = [e["kind"] for e in obs.flightrec.events()]
+        assert "incident.adopted" in kinds
+
+    def test_window_expiry_mints_fresh_incident(self, monkeypatch):
+        from torchmetrics_tpu.obs import flightrec
+
+        monkeypatch.setenv(flightrec.ENV_INCIDENT_WINDOW, "0")
+        first = flightrec.open_incident("sync_timeout")
+        assert flightrec.current_incident() is None  # 0s window: aged out at once
+        second = flightrec.open_incident("sync_timeout")
+        assert second != first
+
+    def test_recent_incidents_feed_for_gossip(self):
+        from torchmetrics_tpu.obs import flightrec
+
+        inc_id = flightrec.open_incident("probe")
+        feed = flightrec.recent_incidents()
+        assert any(i["id"] == inc_id for i in feed)
+        assert all({"id", "reason"} <= set(i) for i in feed)
+
+    def test_events_without_open_incident_are_unstamped(self):
+        obs.flightrec.record("plain.event")
+        assert "incident" not in obs.flightrec.events()[-1]

@@ -51,6 +51,9 @@ TPU017    wall-clock read (``time.time()``/``time.monotonic()``/
 TPU018    lossy sync compression (``SyncOptions(compression="bf16"|"int8")``)
           configured next to a metric state whose callable ``dist_reduce_fx``
           carries no traceable/merge contract (not error-feedback safe)
+TPU020    process-identity read (``os.getpid()``/``socket.gethostname()``/
+          ``uuid``/``process_fingerprint``) inside jit-traced code — the
+          identity is frozen at trace time, stale after restart/cache hit
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -219,6 +222,16 @@ RULE_META: Dict[str, Dict[str, str]] = {
         "fix": "re-raise, return an explicit degraded value, or record the absorption"
                " (telemetry counter / obs.flightrec.record / rank_zero_warn) — a"
                " swallowed failure on a recovery seam is an observability kill",
+    },
+    "TPU020": {
+        "severity": "warning",
+        "summary": "process-identity read (os.getpid/socket.gethostname/uuid/"
+                   "process_fingerprint) inside jit-traced code — frozen at trace time,"
+                   " stale after restart or a compilation-cache hit",
+        "example": "label = f\"{socket.gethostname()}:{os.getpid()}\"  # inside jit",
+        "fix": "read identity once on the eager host path (obs.process_fingerprint())"
+               " and attach it as labels/metadata outside the traced computation —"
+               " never bake who-am-I into a compiled program",
     },
 }
 
@@ -2469,11 +2482,77 @@ def _rule_tpu019(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU020 helpers
+#: process-identity sources: calls whose result names THIS process/host. Distinct from
+#: _TPU017_CLOCKS (wall-clock values): an identity read is not merely irreproducible —
+#: it is WRONG after any restart, because the compiled program keeps answering with the
+#: pid/host of whichever process happened to trace it.
+_TPU020_IDENTITY = {
+    ("os", "getpid"),
+    ("os", "getppid"),
+    ("os", "uname"),
+    ("socket", "gethostname"),
+    ("socket", "getfqdn"),
+    ("platform", "node"),
+    ("uuid", "uuid1"),
+    ("uuid", "uuid4"),
+    ("getpass", "getuser"),
+    ("telemetry", "process_fingerprint"),
+    ("obs", "process_fingerprint"),
+}
+
+
+def _rule_tpu020(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Process-identity read inside jit-traced code.
+
+    Extends TPU017's trace-time-freeze reasoning from clock VALUES to identity LABELS:
+    ``os.getpid()`` / ``socket.gethostname()`` / ``uuid.uuid1()`` /
+    ``obs.process_fingerprint()`` under ``jax.jit`` executes once, at trace time, and
+    the answer is baked into the compiled program. Every telemetry sample, scrape
+    label, or incident id derived from it then reports the identity of whichever
+    process happened to trace — wrong after a restart (new pid, same cached trace),
+    wrong under the persistent compilation cache (a DIFFERENT host's identity can be
+    replayed), and silently identical across ranks that share a compiled executable.
+
+    The fleet plane depends on these labels being honest: federation peer
+    attribution, per-rank bundle merging, and incident gossip all key on
+    ``process_fingerprint()``. The fix is structural, not a retrace: read identity
+    once on the eager host path and attach it as labels/metadata OUTSIDE the traced
+    computation (exactly how ``obs.openmetrics`` stamps ``tm_process`` info samples).
+
+    Jit-scope only — an identity read on an eager path is correct by construction,
+    so there is no hot-path branch here (unlike TPU017).
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        if not info.jit:
+            continue
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or len(dotted) < 2 or tuple(dotted[-2:]) not in _TPU020_IDENTITY:
+                continue
+            if model.is_trace_dead(info, node):
+                continue
+            ident = ".".join(dotted[-2:])
+            out.append(_finding(
+                "TPU020", path, node, lines,
+                f"process-identity read {ident}() in jit-traced {info.qualname!r}"
+                " executes at TRACE time only — the identity is frozen into the"
+                " compiled program: stale after a restart, and a persistent"
+                " compilation-cache hit can replay another process's identity."
+                " Read identity on the eager host path (obs.process_fingerprint())"
+                f" and attach it as labels outside the trace{_via_suffix(info.via)}",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
     _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017, _rule_tpu018,
-    _rule_tpu019,
+    _rule_tpu019, _rule_tpu020,
 )
 
 

@@ -72,27 +72,53 @@ from torchmetrics_tpu.obs.profiler import (
 )
 from torchmetrics_tpu.obs import flightrec, openmetrics, slo, timeseries, trace  # noqa: F401
 from torchmetrics_tpu.obs import bundle, memory  # noqa: F401  (after flightrec: bundle reads it)
-from torchmetrics_tpu.obs.bundle import capture_bundle, last_bundle_path, validate_bundle
+from torchmetrics_tpu.obs import federation, fleet  # noqa: F401  (after openmetrics/bundle)
+from torchmetrics_tpu.obs.bundle import (
+    capture_bundle,
+    last_bundle_path,
+    merge_fleet_bundles,
+    validate_bundle,
+)
+from torchmetrics_tpu.obs.federation import Federator, Peer, peers_from_file
+from torchmetrics_tpu.obs.flightrec import adopt_incident, current_incident, open_incident
 from torchmetrics_tpu.obs.memory import MemoryBudget, memory_ledger
 from torchmetrics_tpu.obs.openmetrics import serve_scrape
-from torchmetrics_tpu.obs.slo import SloMonitor, SloSpec, default_drift_specs, default_serve_specs
+from torchmetrics_tpu.obs.slo import (
+    SloMonitor,
+    SloSpec,
+    default_drift_specs,
+    default_fleet_specs,
+    default_serve_specs,
+)
+from torchmetrics_tpu.obs.telemetry import process_fingerprint
 from torchmetrics_tpu.obs.timeseries import TimeSeries
 
 __all__ = [
+    "Federator",
     "Gauge",
     "MemoryBudget",
+    "Peer",
     "SloMonitor",
     "SloSpec",
     "TimeSeries",
+    "adopt_incident",
     "bundle",
     "capture_bundle",
+    "current_incident",
     "default_drift_specs",
+    "default_fleet_specs",
     "default_serve_specs",
+    "federation",
+    "fleet",
     "flightrec",
     "last_bundle_path",
     "memory",
     "memory_ledger",
+    "merge_fleet_bundles",
+    "open_incident",
     "openmetrics",
+    "peers_from_file",
+    "process_fingerprint",
     "serve_scrape",
     "slo",
     "timeseries",

@@ -60,7 +60,8 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 __all__ = [
     "FORMAT", "VERSION", "REQUIRED_SECTIONS", "BundleError",
     "build_bundle", "capture_bundle", "load_bundle", "validate_bundle",
-    "inspect_bundle", "diff_bundles", "last_bundle_path", "capture_dir", "main",
+    "inspect_bundle", "diff_bundles", "merge_fleet_bundles", "last_bundle_path",
+    "capture_dir", "main",
 ]
 
 FORMAT = "tm-tpu-flight-bundle"
@@ -145,6 +146,14 @@ def _env_section() -> Dict[str, Any]:
         k: v for k, v in sorted(os.environ.items())
         if k.startswith(("TM_TPU_", "JAX_", "XLA_FLAGS"))
     }
+    # the stable identity (host, pid, process_index, start time): distinguishes
+    # "rank 3" from "rank 3 after a restart" when fleet bundles merge
+    try:
+        from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+        out["process"] = process_fingerprint()
+    except Exception:  # pragma: no cover - the section must build regardless
+        out["process"] = None
     return out
 
 
@@ -295,6 +304,9 @@ def build_bundle(
         "reason": str(reason),
         "rank": _rank(),
         "pid": os.getpid(),
+        # the open incident (if any seam fired inside the dedup window): the key
+        # `merge-fleet` groups per-rank bundles on
+        "incident_id": flightrec.current_incident(),
         # wall-clock stamp is for HUMANS correlating bundles with external logs; no
         # metric value or replay boundary ever derives from it
         "captured_unix": time.time(),  # jaxlint: disable=TPU017
@@ -396,10 +408,27 @@ def validate_bundle(path: Union[str, os.PathLike]) -> Dict[str, Any]:
     seqs = [e["seq"] for e in flight["events"]]
     if seqs != sorted(seqs):
         raise BundleError(f"{path}: flight ring sequence numbers are not monotonic")
+    fleet = doc["sections"].get("fleet")
+    if fleet is not None:
+        # cross-rank seqs are NOT globally monotonic, so the fleet timeline lives in
+        # its own section with its own ordering contract: sorted by (peer, seq)
+        timeline = fleet.get("timeline")
+        if not isinstance(timeline, list):
+            raise BundleError(f"{path}: fleet section carries no timeline list")
+        keys = []
+        for evt in timeline:
+            if not isinstance(evt, dict) or "peer" not in evt or "seq" not in evt:
+                raise BundleError(f"{path}: malformed fleet timeline event {evt!r}")
+            keys.append((evt["peer"], evt["seq"]))
+        if keys != sorted(keys):
+            raise BundleError(f"{path}: fleet timeline is not ordered by (peer, seq)")
+        if not fleet.get("bundles"):
+            raise BundleError(f"{path}: fleet section names no source bundles")
     return {
         "path": os.fspath(path),
         "reason": doc.get("reason"),
         "rank": doc.get("rank"),
+        "incident_id": doc.get("incident_id"),
         "sections": sorted(doc["sections"]),
         "flight_events": len(flight["events"]),
         "flight_last_seq": doc.get("flight_last_seq"),
@@ -446,6 +475,10 @@ def capture_bundle(
     if not _enabled():
         return None
     try:
+        # every bundle-capturing seam is an incident seam: mint (or join, within the
+        # dedup window) the process-stable id BEFORE building, so the document and
+        # the bundle.captured flight event both carry it
+        flightrec.open_incident(reason)
         doc = build_bundle(reason, metric=metric, merged=merged, gather_fn=gather_fn)
         if merged and doc["rank"] != 0:
             return None  # contributors hand their payload to rank zero's gather
@@ -472,6 +505,111 @@ def capture_bundle(
         return None
 
 
+# ----------------------------------------------------------------------- fleet merge
+def _collect_bundle_paths(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(
+                os.path.join(p, n) for n in sorted(os.listdir(p)) if n.endswith(SUFFIX)
+            )
+        else:
+            out.append(p)
+    return out
+
+
+def merge_fleet_bundles(
+    paths: List[str],
+    incident_id: Optional[str] = None,
+    output: Optional[Union[str, os.PathLike]] = None,
+) -> str:
+    """Assemble per-rank bundles sharing an incident id into ONE validated fleet bundle.
+
+    ``paths`` mixes bundle files and directories (directories are swept for ``.tmb``).
+    With ``incident_id=None`` the most common id across the readable bundles is
+    chosen; bundles without that id are skipped (named in the warning). The output is
+    a full bundle document (its REQUIRED sections captured locally, so
+    ``validate_bundle`` holds end to end) plus a ``fleet`` section:
+
+    - ``bundles`` — per source bundle: path, reason, rank/pid, process fingerprint;
+    - ``timeline`` — every source's flight events tagged ``peer="r<rank>-p<pid>"``,
+      ordered by ``(peer, seq)`` — cross-rank seqs are not globally comparable, so
+      the contract is per-peer causal order, peers side by side.
+
+    Returns the written path. Raises :class:`BundleError` when no source matches.
+    """
+    candidates = _collect_bundle_paths(paths)
+    docs: List[Dict[str, Any]] = []
+    skipped: List[str] = []
+    for p in candidates:
+        try:
+            docs.append({"path": p, "doc": load_bundle(p, strict=True)})
+        except BundleError:
+            skipped.append(p)
+    if incident_id is None:
+        counts: Dict[str, int] = {}
+        for d in docs:
+            inc = d["doc"].get("incident_id")
+            if inc:
+                counts[inc] = counts.get(inc, 0) + 1
+        if not counts:
+            raise BundleError(
+                f"no bundle among {len(candidates)} candidate(s) carries an incident id"
+            )
+        incident_id = max(counts, key=lambda k: counts[k])
+    matched = [d for d in docs if d["doc"].get("incident_id") == incident_id]
+    if not matched:
+        raise BundleError(f"no bundle matches incident id {incident_id!r}")
+    skipped.extend(d["path"] for d in docs if d["doc"].get("incident_id") != incident_id)
+    if skipped:
+        rank_zero_warn(
+            f"merge-fleet: skipped {len(skipped)} bundle(s) not matching incident"
+            f" {incident_id!r}: {skipped}",
+            UserWarning,
+        )
+    summaries: List[Dict[str, Any]] = []
+    timeline: List[Dict[str, Any]] = []
+    for d in matched:
+        doc = d["doc"]
+        peer = f"r{doc.get('rank')}-p{doc.get('pid')}"
+        fp = (doc["sections"].get("env") or {}).get("process")
+        summaries.append({
+            "path": d["path"],
+            "peer": peer,
+            "reason": doc.get("reason"),
+            "rank": doc.get("rank"),
+            "pid": doc.get("pid"),
+            "fingerprint": fp,
+            "captured_unix": doc.get("captured_unix"),
+            "flight_last_seq": doc.get("flight_last_seq"),
+        })
+        for evt in (doc["sections"].get("flight") or {}).get("events", []):
+            timeline.append({**evt, "peer": peer})
+    timeline.sort(key=lambda e: (e["peer"], e["seq"]))
+    fleet_doc = build_bundle(f"fleet-merge-{incident_id}")
+    fleet_doc["incident_id"] = incident_id
+    fleet_doc["sections"]["fleet"] = {
+        "incident_id": incident_id,
+        "bundles": summaries,
+        "timeline": timeline,
+    }
+    from torchmetrics_tpu.robust.checkpoint import atomic_write_bytes
+
+    if output is None:
+        base = candidates[0]
+        directory = base if os.path.isdir(base) else (os.path.dirname(base) or ".")
+        safe = "".join(c if c.isalnum() or c in "-_." else "-" for c in incident_id)
+        output = os.path.join(directory, f"fleet-{safe}{SUFFIX}")
+    output = os.fspath(output)
+    atomic_write_bytes(output, encode(fleet_doc))
+    telemetry.counter("flight.fleet_merges").inc()
+    flightrec.record(
+        "bundle.fleet_merged", incident=incident_id, bundles=len(summaries), path=output
+    )
+    return output
+
+
 # ------------------------------------------------------------------------ rendering
 def inspect_bundle(path: Union[str, os.PathLike], max_events: int = 20) -> str:
     """Human-readable rendering of one bundle (lenient: damaged sections are named)."""
@@ -480,6 +618,7 @@ def inspect_bundle(path: Union[str, os.PathLike], max_events: int = 20) -> str:
         f"bundle {os.fspath(path)}",
         f"  reason:   {doc.get('reason')}",
         f"  rank/pid: {doc.get('rank')}/{doc.get('pid')}",
+        f"  incident: {doc.get('incident_id') or '-'}",
         f"  captured: unix={doc.get('captured_unix'):.3f}",
         f"  sections: {', '.join(sorted(doc.get('sections', {})))}",
     ]
@@ -515,6 +654,18 @@ def inspect_bundle(path: Union[str, os.PathLike], max_events: int = 20) -> str:
             f"  metric:   {metric.get('class')} updates={metric.get('update_count')}"
             f" gen={metric.get('state_generation')} consistency={metric.get('world_consistent')}"
         )
+    fleet = sections.get("fleet")
+    if fleet:
+        lines.append(
+            f"  fleet:    {len(fleet.get('bundles') or [])} bundle(s) merged on"
+            f" incident {fleet.get('incident_id')},"
+            f" {len(fleet.get('timeline') or [])} timeline event(s)"
+        )
+        for b in fleet.get("bundles") or []:
+            fp = (b.get("fingerprint") or {}).get("fingerprint")
+            lines.append(
+                f"    {b.get('peer')}: reason={b.get('reason')!r} fingerprint={fp}"
+            )
     ranks = sections.get("ranks")
     if ranks:
         lines.append(f"  ranks:    merged view over {len(ranks)} rank(s)")
@@ -580,10 +731,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_diff = sub.add_parser("diff", help="compare two bundles")
     p_diff.add_argument("path_a")
     p_diff.add_argument("path_b")
+    p_merge = sub.add_parser(
+        "merge-fleet",
+        help="assemble per-rank bundles sharing an incident id into one fleet bundle",
+    )
+    p_merge.add_argument("paths", nargs="+", help="bundle files and/or directories")
+    p_merge.add_argument("--incident", default=None,
+                         help="incident id to merge (default: most common across inputs)")
+    p_merge.add_argument("--output", default=None, help="output bundle path")
     args = parser.parse_args(argv)
 
     if args.cmd == "inspect":
         print(inspect_bundle(args.path, max_events=args.events))
+        return 0
+    if args.cmd == "merge-fleet":
+        try:
+            out = merge_fleet_bundles(args.paths, incident_id=args.incident,
+                                      output=args.output)
+        except BundleError as err:
+            print(f"merge-fleet failed: {err}")
+            return 1
+        print(f"fleet bundle written: {out}")
         return 0
     if args.cmd == "validate":
         bad = 0

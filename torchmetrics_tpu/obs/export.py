@@ -22,6 +22,9 @@ def export_trace(path: Any, registry: Optional[Telemetry] = None) -> str:
     record so the track is labeled in the Perfetto UI.
     """
     tel = registry if registry is not None else telemetry
+    from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+    fp = process_fingerprint()
     meta = [
         {
             "name": "process_name",
@@ -29,8 +32,22 @@ def export_trace(path: Any, registry: Optional[Telemetry] = None) -> str:
             "ts": 0,
             "pid": tel.pid,
             "tid": 0,
-            "args": {"name": "torchmetrics_tpu"},
-        }
+            # the stable fingerprint distinguishes restarted processes when traces
+            # from several runs are merged in one Perfetto session
+            "args": {
+                "name": f"torchmetrics_tpu r{fp['process_index']} {fp['host']}"
+                        f" [{fp['fingerprint']}]"
+            },
+        },
+        {
+            "name": "process_labels",
+            "ph": "M",
+            "ts": 0,
+            "pid": tel.pid,
+            "tid": 0,
+            "args": {"labels": f"fingerprint={fp['fingerprint']},"
+                               f"start_unix={fp['start_unix']}"},
+        },
     ]
     events = meta + tel.events()
     dropped = tel.dropped_events

@@ -1,0 +1,516 @@
+"""Pull-based hierarchical telemetry federation: peer scrapes → one tier-labelled view.
+
+The PR-12 OpenMetrics exposition stops at the rank-zero merged view of one flat world;
+ROADMAP item 5's multi-pod fleets aggregate in *tiers*. This module is the pull side of
+that hierarchy: every process keeps serving its existing scrape endpoint
+(:func:`~torchmetrics_tpu.obs.openmetrics.serve_scrape` — which now also answers
+``/federation`` with a JSON sidecar of sketch payloads + identity + incidents), and a
+:class:`Federator` — any process, or the standalone ``python -m
+torchmetrics_tpu.obs.fleet serve`` — pulls N peers from a static list / discovery file,
+strict-``parse()``\\ s each exposition, and re-exposes ONE merged exposition in which
+
+- every per-peer sample carries ``tier`` (``"host"`` unless the peer already
+  aggregated), ``pod``, ``peer``, and ``rank`` labels;
+- **counters sum** into a ``tier="<federator tier>"`` aggregate sample;
+- **gauges keep their per-peer samples** plus a summed fleet aggregate;
+- **series/KLL summaries merge via the PR-10 mergeable-sketch contract**
+  (:func:`~torchmetrics_tpu.obs.timeseries.merged_quantiles` — real ``kll_merge``\\ s
+  of the peers' sketch states, so a fleet p99 is a true pooled quantile within the
+  documented rank-error bound, never an average of per-peer p99s).
+
+Stale or unreachable peers NEVER fail the merged scrape: they degrade to a
+``fleet.peers_unhealthy`` gauge, per-peer ``tm_fleet_peer_up`` samples, and one flight
+event per transition (``fleet.peer_unreachable`` / ``fleet.peer_recovered``). Incident
+ids gossiped by peers (``tm_fleet_active_incidents`` info samples) propagate through
+re-emission, so a fleet operator sees every open incident from one scrape. Federators
+chain: a pod-tier federator's exposition and ``/federation`` payload feed a fleet-tier
+one without double counting (aggregation reads the payload's already-summed values and
+concatenated sketch lists, not the re-labelled text).
+
+    >>> peers_from_file  # doctest: +ELLIPSIS
+    <function peers_from_file at ...>
+
+See docs/observability.md "Fleet federation & incident correlation".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from torchmetrics_tpu.obs import flightrec
+from torchmetrics_tpu.obs.openmetrics import (
+    CONTENT_TYPE,
+    _rank,
+    _Writer,
+    metric_name,
+    parse,
+)
+from torchmetrics_tpu.obs.telemetry import Telemetry, process_fingerprint, telemetry
+
+__all__ = [
+    "Peer",
+    "peers_from_file",
+    "federation_payload",
+    "Federator",
+    "FederationServer",
+    "TIER_ORDER",
+    "DEFAULT_TIMEOUT_S",
+]
+
+#: aggregation hierarchy, inner to outer — a sample's ``tier`` label says how many
+#: federation hops produced it
+TIER_ORDER: Tuple[str, ...] = ("host", "pod", "fleet")
+
+DEFAULT_TIMEOUT_S = 2.0
+
+
+# ------------------------------------------------------------------------ peer model
+@dataclass(frozen=True)
+class Peer:
+    """One scrape target: ``url`` is the base (``http://host:port``), labels ride along."""
+
+    name: str
+    url: str
+    pod: str = "pod0"
+
+    @property
+    def metrics_url(self) -> str:
+        return self.url.rstrip("/") + "/metrics"
+
+    @property
+    def federation_url(self) -> str:
+        return self.url.rstrip("/") + "/federation"
+
+
+def peers_from_file(path: Any) -> List[Peer]:
+    """Load a static peer list / discovery file.
+
+    Two formats: a JSON array of ``{"name", "url", "pod"?}`` objects, or plain lines
+    ``name url [pod]`` (``#`` comments and blank lines skipped) — the latter is what a
+    launcher can append to as hosts come up.
+    """
+    path = os.fspath(path)
+    with open(path) as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    peers: List[Peer] = []
+    if stripped.startswith("["):
+        for entry in json.loads(stripped):
+            peers.append(Peer(name=str(entry["name"]), url=str(entry["url"]),
+                              pod=str(entry.get("pod", "pod0"))))
+        return peers
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            raise ValueError(f"peer line needs 'name url [pod]', got {line!r}")
+        peers.append(Peer(name=parts[0], url=parts[1],
+                          pod=parts[2] if len(parts) > 2 else "pod0"))
+    return peers
+
+
+# ------------------------------------------------------------------ the JSON sidecar
+def federation_payload(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
+    """The ``/federation`` JSON sidecar: what the text exposition cannot carry.
+
+    Sketch states (base64 float32 — a fleet quantile needs the peer's MERGEABLE state,
+    not its rendered p99), raw counter/gauge registry values keyed by registry name
+    (so aggregation never reverse-maps sanitized family names), the process
+    fingerprint, and the incident gossip feed. ``series`` values are LISTS of sketch
+    payloads so federator payloads chain by concatenation.
+    """
+    tel = registry if registry is not None else telemetry
+    snap_series = {}
+    for name in tel.series_names():
+        s = tel.get_series(name)
+        if s is not None:
+            snap_series[name] = [s.sketch_payload()]
+    active = flightrec.current_incident()
+    # the fleet status table wants the sync posture too: the last ConsistencyLevel is
+    # a flight-event field (sync.outcome/downgrade), the straggler index a skew report
+    sync_info: Dict[str, Any] = {"last_level": None, "straggler_index": None}
+    for evt in reversed(flightrec.events()):
+        if evt.get("kind") in ("sync.outcome", "sync.downgrade"):
+            sync_info["last_level"] = evt.get("level")
+            break
+    try:
+        from torchmetrics_tpu.parallel import sync as _sync
+
+        skew = _sync.last_skew_report()
+        if skew:
+            sync_info["straggler_index"] = skew.get("straggler_index")
+    except Exception:  # pragma: no cover - payload must build regardless
+        pass
+    return {
+        "fingerprint": process_fingerprint(),
+        "rank": _rank(),
+        "tier": None,  # a plain process; Federator.payload() stamps its tier
+        "counters": {n: c.value for n, c in tel._counters.items()},
+        "gauges": {n: g.value for n, g in tel._gauges.items()},
+        "series": snap_series,
+        "sync": sync_info,
+        "incidents": [
+            {**inc, "active": inc["id"] == active} for inc in flightrec.recent_incidents()
+        ],
+    }
+
+
+def _http_get(url: str, timeout_s: float) -> bytes:
+    req = urllib.request.Request(url, headers={"User-Agent": "tm-tpu-federator"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+# -------------------------------------------------------------------- the federator
+class Federator:
+    """Polls peer scrape endpoints and re-exposes one tier-labelled merged exposition.
+
+    Owns a private :class:`~torchmetrics_tpu.obs.telemetry.Telemetry` registry
+    (``.registry``) holding the fleet-side instruments — ``fleet.peers_unhealthy``,
+    per-poll ``fleet.shed_ratio`` / ``fleet.poll_ms`` series — which is exactly what
+    fleet-scoped :class:`~torchmetrics_tpu.obs.slo.SloSpec`\\ s evaluate against
+    (``SloMonitor(default_fleet_specs(), registry=federator.registry)``).
+
+    ``fetch_fn`` injects transport for tests (maps a URL to response bytes, raising on
+    "unreachable"); production uses stdlib urllib with ``timeout_s`` per request.
+    """
+
+    def __init__(
+        self,
+        peers: Sequence[Peer],
+        tier: str = "fleet",
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        fetch_fn: Optional[Callable[[str], bytes]] = None,
+        slo_specs: Optional[Sequence[Any]] = None,
+    ) -> None:
+        if tier not in TIER_ORDER:
+            raise ValueError(f"tier must be one of {TIER_ORDER}, got {tier!r}")
+        self.peers = list(peers)
+        self.tier = tier
+        self.timeout_s = float(timeout_s)
+        self._fetch = fetch_fn or (lambda url: _http_get(url, self.timeout_s))
+        self.registry = Telemetry(enabled=False)
+        self._lock = threading.Lock()
+        #: peer name -> {"up", "parsed", "payload", "error"} from the last poll
+        self._state: Dict[str, Dict[str, Any]] = {}
+        #: previous summed series counts, for the per-poll fleet shed-ratio deltas
+        self._prev_counts: Dict[str, float] = {}
+        from torchmetrics_tpu.obs.slo import SloMonitor, default_fleet_specs
+
+        self.monitor = SloMonitor(
+            default_fleet_specs() if slo_specs is None else slo_specs,
+            registry=self.registry,
+        )
+
+    # ------------------------------------------------------------------ polling
+    def poll(self) -> Dict[str, Any]:
+        """Pull every peer once; returns a poll summary. Never raises for a dead peer.
+
+        Each peer costs one ``/metrics`` GET (strict-parsed — a peer serving garbage
+        counts as unhealthy, exactly like an unreachable one) and one ``/federation``
+        GET (optional: a peer without the sidecar still federates, minus sketch
+        quantiles). Health transitions record flight events; the unhealthy count
+        lands in the ``fleet.peers_unhealthy`` gauge AND series, then the fleet SLO
+        monitor runs — so a storm alarm is at most one poll behind the storm.
+        """
+        t0 = time.perf_counter()
+        unhealthy = 0
+        with self._lock:
+            for peer in self.peers:
+                prev_up = self._state.get(peer.name, {}).get("up")
+                try:
+                    text = self._fetch(peer.metrics_url).decode("utf-8")
+                    parsed = parse(text)  # strict: garbage == unreachable
+                    try:
+                        payload = json.loads(self._fetch(peer.federation_url))
+                    except Exception:  # noqa: BLE001 - sidecar is optional
+                        payload = None
+                    self._state[peer.name] = {
+                        "up": True, "parsed": parsed, "payload": payload, "error": None,
+                    }
+                    if prev_up is False:
+                        flightrec.record("fleet.peer_recovered", peer=peer.name)
+                except Exception as err:  # noqa: BLE001 - degrade, never fail the scrape
+                    unhealthy += 1
+                    stale = self._state.get(peer.name, {})
+                    self._state[peer.name] = {
+                        "up": False,
+                        # keep the last good parse/payload: stale beats blind
+                        "parsed": stale.get("parsed"),
+                        "payload": stale.get("payload"),
+                        "error": repr(err),
+                    }
+                    if prev_up is not False:
+                        flightrec.record(
+                            "fleet.peer_unreachable", peer=peer.name, error=repr(err)
+                        )
+            self.registry.counter("fleet.polls").inc()
+            self.registry.gauge("fleet.peers_unhealthy").set(unhealthy)
+            self.registry.series("fleet.peers_unhealthy").record(float(unhealthy))
+            self._record_fleet_deltas()
+            n_incidents = len(self.active_incidents())
+            self.registry.gauge("fleet.active_incidents").set(n_incidents)
+        poll_ms = (time.perf_counter() - t0) * 1e3
+        self.registry.series("fleet.poll_ms").record(poll_ms)
+        self.monitor.evaluate()
+        return {
+            "peers": len(self.peers),
+            "unhealthy": unhealthy,
+            "poll_ms": round(poll_ms, 3),
+            "active_incidents": n_incidents,
+        }
+
+    def _record_fleet_deltas(self) -> None:
+        """Per-poll fleet shed ratio from summed peer series counts (caller holds lock)."""
+        sums = {"serve.sheds": 0.0, "serve.queue_depth": 0.0}
+        for st in self._state.values():
+            payload = st.get("payload")
+            if not payload:
+                continue
+            for name in sums:
+                for sp in (payload.get("series") or {}).get(name, ()):
+                    sums[name] += float(sp.get("count", 0))
+        shed_d = sums["serve.sheds"] - self._prev_counts.get("serve.sheds", 0.0)
+        offered_d = sums["serve.queue_depth"] - self._prev_counts.get("serve.queue_depth", 0.0)
+        self._prev_counts = sums
+        if offered_d > 0:  # no offered traffic this poll = no shed evidence either way
+            self.registry.series("fleet.shed_ratio").record(
+                max(0.0, shed_d) / offered_d
+            )
+
+    # ----------------------------------------------------------------- merged views
+    def peer_states(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {n: dict(st) for n, st in self._state.items()}
+
+    def active_incidents(self) -> List[Dict[str, Any]]:
+        """Union of incident gossip across peers (+ this process), deduped by id."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for peer in self.peers:
+            payload = (self._state.get(peer.name) or {}).get("payload")
+            for inc in (payload or {}).get("incidents", ()):
+                entry = dict(inc)
+                entry.setdefault("peer", peer.name)
+                out[entry["id"]] = entry
+        active = flightrec.current_incident()
+        for inc in flightrec.recent_incidents():
+            out.setdefault(inc["id"], {**inc, "peer": "self",
+                                       "active": inc["id"] == active})
+        return list(out.values())
+
+    def render(self) -> str:
+        """The merged, tier-labelled exposition over the LAST poll's peer states.
+
+        Per-peer samples are re-emitted under ``tier``/``pod``/``peer`` labels (an
+        existing ``tier`` label — a chained federator's aggregate — is preserved);
+        aggregates are computed from the ``/federation`` payloads so chaining never
+        double counts. Always parseable, whatever the peers' health.
+        """
+        w = _Writer()
+        with self._lock:
+            states = {n: st for n, st in self._state.items()}
+            # -- per-peer re-emission -------------------------------------------
+            for peer in self.peers:
+                parsed = (states.get(peer.name) or {}).get("parsed")
+                if not parsed:
+                    continue
+                for fam, fam_doc in parsed["families"].items():
+                    if not w.family(fam, fam_doc["type"]):
+                        continue
+                    for s in fam_doc["samples"]:
+                        labels = dict(s["labels"])
+                        labels.setdefault("tier", "host")
+                        labels.setdefault("pod", peer.pod)
+                        labels.setdefault("peer", peer.name)
+                        w.sample(fam, s["name"][len(fam):], labels, s["value"])
+            # -- fleet aggregates from the payloads ----------------------------
+            self._emit_aggregates(w, states)
+            # -- federation health --------------------------------------------
+            if w.family("tm_fleet_peers_unhealthy", "gauge",
+                        help="peers unreachable or serving an invalid scrape"):
+                w.sample("tm_fleet_peers_unhealthy", "",
+                         {"tier": self.tier},
+                         self.registry.gauge("fleet.peers_unhealthy").value)
+            if w.family("tm_fleet_peer_up", "gauge"):
+                for peer in self.peers:
+                    up = (states.get(peer.name) or {}).get("up")
+                    w.sample("tm_fleet_peer_up", "",
+                             {"tier": self.tier, "pod": peer.pod, "peer": peer.name},
+                             1 if up else 0)
+        for st in self.monitor.evaluate():
+            fam = metric_name(f"fleet.slo.{st.spec.name}.burn_rate")
+            if w.family(fam, "gauge"):
+                w.sample(fam, "", {"tier": self.tier}, st.worst_burn)
+        return w.text()
+
+    def _emit_aggregates(self, w: _Writer, states: Dict[str, Dict[str, Any]]) -> None:
+        from torchmetrics_tpu.obs.timeseries import merged_quantiles
+
+        agg = self._aggregate_payload(states)
+        lbl = {"tier": self.tier}
+        for name in sorted(agg["counters"]):
+            fam = metric_name(name)
+            if w.family(fam, "counter"):
+                w.sample(fam, "_total", lbl, agg["counters"][name])
+        for name in sorted(agg["gauges"]):
+            fam = metric_name(name)
+            if w.family(fam, "gauge"):
+                w.sample(fam, "", lbl, agg["gauges"][name])
+        for name in sorted(agg["series"]):
+            payloads = agg["series"][name]
+            fam = metric_name(name)
+            if not w.family(fam, "summary"):
+                continue
+            w.sample(fam, "_count", lbl, sum(p.get("count", 0) for p in payloads))
+            w.sample(fam, "_sum", lbl, sum(p.get("sum", 0.0) for p in payloads))
+            qs = (0.5, 0.9, 0.99)
+            vals = merged_quantiles(payloads, qs)
+            for q, v in zip(qs, vals):
+                if v is not None:
+                    w.sample(fam, "", {**lbl, "quantile": f"{q:g}"}, v)
+
+    def _aggregate_payload(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Sum counters/gauges, concatenate series sketch lists, across healthy-or-stale
+        peer payloads. A chained federator peer contributes its ALREADY-aggregated
+        payload, so values never double count."""
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        series: Dict[str, List[Dict[str, Any]]] = {}
+        for st in states.values():
+            payload = st.get("payload")
+            if not payload:
+                continue
+            for n, v in (payload.get("counters") or {}).items():
+                counters[n] = counters.get(n, 0.0) + float(v)
+            for n, v in (payload.get("gauges") or {}).items():
+                gauges[n] = gauges.get(n, 0.0) + float(v)
+            for n, plist in (payload.get("series") or {}).items():
+                series.setdefault(n, []).extend(plist)
+        return {"counters": counters, "gauges": gauges, "series": series}
+
+    def payload(self) -> Dict[str, Any]:
+        """This federator's OWN ``/federation`` payload — the chaining contract.
+
+        Counters/gauges arrive already summed, series as concatenated sketch lists,
+        incidents as the deduped union; ``tier`` is stamped so an outer federator's
+        text re-emission can show how many hops aggregated a sample.
+        """
+        with self._lock:
+            agg = self._aggregate_payload(self._state)
+        return {
+            "fingerprint": process_fingerprint(),
+            "rank": _rank(),
+            "tier": self.tier,
+            "counters": agg["counters"],
+            "gauges": agg["gauges"],
+            "series": agg["series"],
+            "incidents": self.active_incidents(),
+        }
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1",
+              poll_interval_s: float = 5.0) -> "FederationServer":
+        """Expose the merged view over HTTP (``/metrics`` + ``/federation``)."""
+        return FederationServer(self, host=host, port=port,
+                                poll_interval_s=poll_interval_s)
+
+
+# --------------------------------------------------------------------- the endpoint
+class FederationServer:
+    """HTTP endpoint for a :class:`Federator`: scrape-triggered polls, cached briefly.
+
+    A GET re-polls the peers unless the last poll is newer than ``poll_interval_s``
+    (a scrape storm against the federator must not multiply into a scrape storm
+    against every peer). Same lifecycle contract as
+    :class:`~torchmetrics_tpu.obs.openmetrics.ScrapeServer`: port known synchronously,
+    ``close()`` idempotent, atexit-closed.
+    """
+
+    def __init__(self, federator: Federator, host: str = "127.0.0.1", port: int = 0,
+                 poll_interval_s: float = 5.0) -> None:
+        import http.server
+
+        fed = federator
+        interval = float(poll_interval_s)
+        state = {"last_poll": float("-inf")}
+        poll_lock = threading.Lock()
+
+        def _maybe_poll() -> None:
+            with poll_lock:
+                now = time.monotonic()
+                if now - state["last_poll"] >= interval:
+                    fed.poll()
+                    state["last_poll"] = now
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                path = self.path.rstrip("/")
+                try:
+                    _maybe_poll()
+                    if path == "/federation":
+                        body = json.dumps(fed.payload()).encode("utf-8")
+                        ctype = "application/json; charset=utf-8"
+                    elif path in ("", "/metrics"):
+                        body = fed.render().encode("utf-8")
+                        ctype = CONTENT_TYPE
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as err:  # noqa: BLE001 - a scrape must not kill the server
+                    self.send_error(500, explain=repr(err))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="tm-tpu-federator"
+        )
+        self._thread.start()
+        import atexit
+
+        self._atexit = atexit.register(self.close)
+        telemetry.counter("obs.federation_servers").inc()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def bound_port(self) -> int:
+        """The OS-assigned listening port — valid the moment the constructor returns."""
+        return int(self.port)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:  # pragma: no cover - interpreter teardown order
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "FederationServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.close()
+        return False

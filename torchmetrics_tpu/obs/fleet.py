@@ -1,0 +1,207 @@
+"""Fleet status CLI: one screen answering "is the fleet healthy, and if not, where?".
+
+``python -m torchmetrics_tpu.obs.fleet status --peers peers.txt`` polls every peer once
+through a :class:`~torchmetrics_tpu.obs.federation.Federator` and renders a table —
+per-peer health, serving pressure (shed ratio, commit p99), HBM memory residency, sync
+consistency level and straggler index, open incidents — followed by the fleet-scoped
+SLO burn rates. ``--watch N`` repolls every N seconds (clear-screen terminal loop).
+``python -m torchmetrics_tpu.obs.fleet serve --peers peers.txt --port 9100`` runs the
+standalone federation endpoint any Prometheus-compatible collector (or an outer
+fleet-tier federator) can scrape.
+
+The table reads the ``/federation`` sidecar payloads, so it works against plain
+processes AND against chained pod-tier federators; a dead peer renders as ``DOWN``
+with its last error, never as a crash. See docs/observability.md "Fleet federation &
+incident correlation".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from torchmetrics_tpu.obs.federation import Federator, Peer, peers_from_file
+
+__all__ = ["fleet_status", "format_status", "main"]
+
+
+def _series_stat(payload: Optional[Dict[str, Any]], name: str, key: str) -> Optional[float]:
+    if not payload:
+        return None
+    plist = (payload.get("series") or {}).get(name)
+    if not plist:
+        return None
+    total = sum(float(p.get(key, 0) or 0) for p in plist)
+    return total
+
+
+def _peer_p99(payload: Optional[Dict[str, Any]], name: str) -> Optional[float]:
+    if not payload:
+        return None
+    plist = (payload.get("series") or {}).get(name)
+    if not plist:
+        return None
+    from torchmetrics_tpu.obs.timeseries import merged_quantiles
+
+    return merged_quantiles(plist, (0.99,))[0]
+
+
+def fleet_status(federator: Federator) -> Dict[str, Any]:
+    """One structured status document from the federator's last poll.
+
+    Call :meth:`~torchmetrics_tpu.obs.federation.Federator.poll` first; this only
+    reads. JSON-serialisable (``--json`` dumps it verbatim) so dashboards can consume
+    the same document the table renders.
+    """
+    states = federator.peer_states()
+    rows: List[Dict[str, Any]] = []
+    for peer in federator.peers:
+        st = states.get(peer.name) or {}
+        payload = st.get("payload")
+        fp = (payload or {}).get("fingerprint") or {}
+        sheds = _series_stat(payload, "serve.sheds", "count") or 0.0
+        offered = _series_stat(payload, "serve.queue_depth", "count") or 0.0
+        gauges = (payload or {}).get("gauges") or {}
+        sync_info = (payload or {}).get("sync") or {}
+        incidents = [i for i in (payload or {}).get("incidents", ()) if i.get("active")]
+        rows.append({
+            "peer": peer.name,
+            "pod": peer.pod,
+            "up": bool(st.get("up")),
+            "error": st.get("error"),
+            "rank": (payload or {}).get("rank"),
+            "fingerprint": fp.get("fingerprint"),
+            "shed_ratio": (sheds / offered) if offered else None,
+            "commit_p99_us": _peer_p99(payload, "serve.commit_latency_us"),
+            "memory_bytes": gauges.get("memory.resident_bytes"),
+            "sync_level": sync_info.get("last_level"),
+            "straggler_index": sync_info.get("straggler_index"),
+            "incidents": [i["id"] for i in incidents],
+        })
+    slo_rows = [st.as_dict() for st in federator.monitor.evaluate()]
+    return {
+        "tier": federator.tier,
+        "peers": rows,
+        "unhealthy": sum(1 for r in rows if not r["up"]),
+        "active_incidents": [i["id"] for i in federator.active_incidents()
+                             if i.get("active")],
+        "slo": slo_rows,
+    }
+
+
+def _fmt(v: Any, spec: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return format(v, spec or ".3g")
+    return str(v)
+
+
+def format_status(status: Dict[str, Any]) -> str:
+    """The one-screen terminal table for a :func:`fleet_status` document."""
+    cols = ("peer", "pod", "up", "rank", "fprint", "shed%", "p99_us", "mem_MB",
+            "sync", "straggler", "incidents")
+    rows: List[List[str]] = []
+    for r in status["peers"]:
+        shed = None if r["shed_ratio"] is None else 100.0 * r["shed_ratio"]
+        mem = None if r["memory_bytes"] is None else r["memory_bytes"] / 1e6
+        rows.append([
+            r["peer"], r["pod"], "UP" if r["up"] else "DOWN",
+            _fmt(r["rank"]), _fmt(r["fingerprint"]), _fmt(shed, ".2f"),
+            _fmt(r["commit_p99_us"], ".0f"), _fmt(mem, ".1f"),
+            _fmt(r["sync_level"]), _fmt(r["straggler_index"], ".2f"),
+            ",".join(r["incidents"]) or "-",
+        ])
+    widths = [max(len(c), *(len(row[i]) for row in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+    lines.append("")
+    lines.append(
+        f"tier={status['tier']}  peers_unhealthy={status['unhealthy']}"
+        f"  active_incidents={len(status['active_incidents'])}"
+    )
+    for s in status["slo"]:
+        flame = "BURNING" if s["burning"] else "ok"
+        lines.append(f"slo {s['name']}: {flame} (worst burn {s['worst_burn']}x)")
+    for inc in status["active_incidents"]:
+        lines.append(f"incident {inc}")
+    return "\n".join(lines)
+
+
+def _build_federator(args: argparse.Namespace) -> Federator:
+    if args.peers:
+        peers = peers_from_file(args.peers)
+    else:
+        peers = [Peer(name=f"peer{i}", url=u) for i, u in enumerate(args.peer or ())]
+    if not peers:
+        raise SystemExit("no peers: pass --peers FILE or --peer URL ...")
+    return Federator(peers, tier=args.tier, timeout_s=args.timeout)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.obs.fleet",
+        description="fleet federation endpoint and one-screen status table",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, hlp in (("status", "render the fleet table from one federated poll"),
+                      ("serve", "run the standalone federation scrape endpoint")):
+        p = sub.add_parser(name, help=hlp)
+        p.add_argument("--peers", help="peer list file (JSON array or 'name url [pod]' lines)")
+        p.add_argument("--peer", action="append",
+                       help="peer base URL (repeatable alternative to --peers)")
+        p.add_argument("--tier", default="fleet", choices=("pod", "fleet"))
+        p.add_argument("--timeout", type=float, default=2.0,
+                       help="per-peer HTTP timeout, seconds")
+    sub.choices["status"].add_argument("--watch", type=float, default=None, metavar="SEC",
+                                       help="repoll every SEC seconds until interrupted")
+    sub.choices["status"].add_argument("--json", action="store_true",
+                                       help="dump the status document as JSON")
+    sub.choices["serve"].add_argument("--port", type=int, default=0)
+    sub.choices["serve"].add_argument("--host", default="127.0.0.1")
+    sub.choices["serve"].add_argument("--interval", type=float, default=5.0,
+                                      help="minimum seconds between peer polls")
+    args = parser.parse_args(argv)
+    fed = _build_federator(args)
+
+    if args.cmd == "serve":
+        server = fed.serve(port=args.port, host=args.host, poll_interval_s=args.interval)
+        print(f"federation endpoint on {server.url} (tier={fed.tier},"
+              f" {len(fed.peers)} peers); Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.close()
+        return 0
+
+    # status
+    while True:
+        fed.poll()
+        status = fleet_status(fed)
+        if args.json:
+            out = json.dumps(status, indent=2)
+        else:
+            out = format_status(status)
+        if args.watch is not None:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear screen + home, terminal watch loop
+        print(out)
+        if args.watch is None:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,9 +56,20 @@ from typing import Any, Dict, List, Optional
 from torchmetrics_tpu.obs.telemetry import _env_int, telemetry
 
 ENV_FLIGHT_EVENTS = "TM_TPU_FLIGHT_EVENTS"
+#: seconds within which a new bundle-capturing seam JOINS the active incident instead of
+#: minting a fresh id — one failure cascading through several seams (drain death →
+#: apply failure → sync timeout) is ONE incident, not three
+ENV_INCIDENT_WINDOW = "TM_TPU_INCIDENT_WINDOW_S"
+_DEFAULT_INCIDENT_WINDOW_S = 300
+
+#: bound once — the record path budget (≤2µs) has no room for an attribute chain per
+#: event, and the global registry instance is never replaced (reset() mutates in place)
+_now_us = telemetry.now_us
 
 __all__ = [
     "FlightRecorder", "recorder", "record", "events", "clear", "snapshot", "last_seq",
+    "open_incident", "adopt_incident", "current_incident", "recent_incidents",
+    "clear_incidents",
 ]
 
 
@@ -84,7 +95,12 @@ class FlightRecorder:
     def record(self, kind: str, **fields: Any) -> int:
         """Append one event; returns its sequence number. Always-on, ~0.5µs."""
         seq = FlightRecorder._next_seq()
-        evt: Dict[str, Any] = {"seq": seq, "ts_us": round(telemetry.now_us(), 1), "kind": kind}
+        evt: Dict[str, Any] = {"seq": seq, "ts_us": round(_now_us(), 1), "kind": kind}
+        # while an incident is open, every flight event carries its id (one dict read
+        # on the ≤2µs record path) — the cross-rank merge keys its timeline on this
+        inc = _active_incident
+        if inc is not None and "incident" not in fields:
+            evt["incident"] = inc["id"]
         if fields:
             evt.update(fields)
         self._pushed += 1  # benign under the GIL (monotonic high-water mark)
@@ -135,9 +151,9 @@ class FlightRecorder:
 recorder = FlightRecorder()
 
 
-def record(kind: str, **fields: Any) -> int:
-    """Record one event into the process-global flight ring (always-on)."""
-    return recorder.record(kind, **fields)
+# the process-global record path IS the method, not a wrapper around it: the always-on
+# ≤2µs budget has no room for a second call frame per event (recorder is never rebound)
+record = recorder.record
 
 
 def events() -> List[Dict[str, Any]]:
@@ -155,3 +171,94 @@ def snapshot() -> Dict[str, Any]:
 def clear() -> None:
     """Drop recorded events (tests / fresh smoke runs)."""
     recorder.clear()
+
+
+# ---------------------------------------------------------------- incident correlation
+# One INCIDENT groups every bundle, flight event, and federated gossip sample that a
+# single failure produced: the first bundle-capturing seam mints a process-stable id,
+# later seams inside the dedup window JOIN it, and the federation scrape gossips the
+# open set so a fleet operator (and ``obs.bundle merge-fleet``) can assemble the
+# per-rank evidence into one cross-rank story (docs/observability.md "Fleet federation
+# & incident correlation").
+
+_incident_seq = itertools.count(1).__next__
+_active_incident: Optional[Dict[str, Any]] = None
+#: recently opened/adopted incidents, gossiped through the federation payload
+_recent_incidents: deque = deque(maxlen=16)
+
+
+def _incident_window_s() -> float:
+    return float(_env_int(ENV_INCIDENT_WINDOW, _DEFAULT_INCIDENT_WINDOW_S))
+
+
+def current_incident() -> Optional[str]:
+    """Id of the open incident (None when no failure seam fired inside the window)."""
+    inc = _active_incident
+    if inc is None:
+        return None
+    if (telemetry.now_us() - inc["opened_us"]) > _incident_window_s() * 1e6:
+        return None  # the incident aged out; the next seam mints a fresh id
+    return inc["id"]
+
+
+def open_incident(reason: str) -> str:
+    """Mint (or join) the process-stable incident id for a bundle-capturing seam.
+
+    Within ``TM_TPU_INCIDENT_WINDOW_S`` (default 300s) of the first seam, every later
+    seam returns the SAME id — a cascade is one incident. The id embeds the process
+    fingerprint (:func:`~torchmetrics_tpu.obs.telemetry.process_fingerprint`), so ids
+    from restarted processes never collide even at equal pids.
+    """
+    global _active_incident
+    existing = current_incident()
+    if existing is not None:
+        return existing
+    from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+    inc_id = f"inc-{process_fingerprint()['fingerprint']}-{_incident_seq():04d}"
+    inc = {
+        "id": inc_id,
+        "reason": str(reason),
+        "opened_us": round(telemetry.now_us(), 1),
+        "rank": None,
+    }
+    _active_incident = inc
+    _recent_incidents.append(dict(inc))
+    telemetry.counter("flight.incidents").inc()
+    # record AFTER _active_incident is set so the opening event itself carries the id
+    recorder.record("incident.opened", id=inc_id, reason=str(reason))
+    return inc_id
+
+
+def adopt_incident(incident_id: str, reason: str = "adopted") -> str:
+    """Join an incident another process opened (gossiped via the federation scrape).
+
+    Bundles captured here afterwards share the foreign id, so ``obs.bundle
+    merge-fleet`` groups this rank's evidence with the originator's.
+    """
+    global _active_incident
+    if current_incident() == incident_id:
+        return incident_id
+    inc = {
+        "id": str(incident_id),
+        "reason": str(reason),
+        "opened_us": round(telemetry.now_us(), 1),
+        "adopted": True,
+    }
+    _active_incident = inc
+    _recent_incidents.append(dict(inc))
+    telemetry.counter("flight.incidents_adopted").inc()
+    recorder.record("incident.adopted", id=str(incident_id), reason=str(reason))
+    return str(incident_id)
+
+
+def recent_incidents() -> List[Dict[str, Any]]:
+    """Recently opened/adopted incidents (newest last) — the federation gossip feed."""
+    return [dict(i) for i in _recent_incidents]
+
+
+def clear_incidents() -> None:
+    """Forget the active + recent incidents (tests / fresh smoke runs)."""
+    global _active_incident
+    _active_incident = None
+    _recent_incidents.clear()

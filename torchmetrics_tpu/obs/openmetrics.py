@@ -58,6 +58,8 @@ _TYPE_SUFFIXES = {
     "summary": ("", "_count", "_sum", "_created"),
     "histogram": ("_bucket", "_count", "_sum", "_created"),
     "unknown": ("",),
+    # info samples expose ONLY the _info suffix with value 1 (identity rides labels)
+    "info": ("_info",),
 }
 
 
@@ -88,15 +90,18 @@ class _Writer:
 
     def __init__(self) -> None:
         self.declared: Dict[str, str] = {}
+        self.helps: Dict[str, str] = {}
         self.samples: Dict[str, List[str]] = {}
 
-    def family(self, name: str, typ: str) -> bool:
+    def family(self, name: str, typ: str, help: Optional[str] = None) -> bool:
         """Declare a family; False (skipped) when the sanitized name already exists
         with a different type — dotted registry names may collide after sanitizing."""
         prev = self.declared.get(name)
         if prev is not None:
             return prev == typ
         self.declared[name] = typ
+        if help:
+            self.helps[name] = help
         self.samples[name] = []
         return True
 
@@ -108,6 +113,8 @@ class _Writer:
         lines: List[str] = []
         for name in sorted(self.declared):
             lines.append(f"# TYPE {name} {self.declared[name]}")
+            if name in self.helps:
+                lines.append(f"# HELP {name} {self.helps[name]}")
             lines.extend(self.samples[name])
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
@@ -152,6 +159,51 @@ def _emit_snapshot(w: _Writer, snap: Dict[str, Any], rank: int) -> None:
             fam_last = fam + "_last"
             if w.family(fam_last, "gauge"):
                 w.sample(fam_last, "", lbl, last)
+
+
+def _emit_process_info(w: _Writer) -> None:
+    """The stable-identity info sample: ``tm_process_info{host,pid,...} 1``.
+
+    A bare rank int cannot tell "rank 3" from "rank 3 after a restart"; this sample's
+    ``fingerprint`` label (from :func:`~torchmetrics_tpu.obs.telemetry.
+    process_fingerprint`) can, so federators and merged-trace consumers key on it.
+    """
+    from torchmetrics_tpu.obs.telemetry import process_fingerprint
+
+    fp = process_fingerprint()
+    if w.family(
+        "tm_process", "info",
+        help="stable process identity: host, pid, jax process_index, start time",
+    ):
+        w.sample("tm_process", "_info", {
+            "rank": _rank(),
+            "host": fp["host"],
+            "pid": fp["pid"],
+            "process_index": fp["process_index"],
+            "start_unix": fp["start_unix"],
+            "fingerprint": fp["fingerprint"],
+        }, 1)
+
+
+def _emit_incidents(w: _Writer) -> None:
+    """Open/recent incident ids as info samples — the federation gossip surface."""
+    from torchmetrics_tpu.obs import flightrec as _flightrec
+
+    recent = list({inc["id"]: inc for inc in _flightrec.recent_incidents()}.values())
+    if not recent:
+        return
+    active = _flightrec.current_incident()
+    if w.family(
+        "tm_fleet_active_incidents", "info",
+        help="incident ids minted/adopted by this process (active=1 while open)",
+    ):
+        for inc in recent:
+            w.sample("tm_fleet_active_incidents", "_info", {
+                "rank": _rank(),
+                "id": inc["id"],
+                "reason": inc.get("reason", ""),
+                "active": 1 if inc["id"] == active else 0,
+            }, 1)
 
 
 def _emit_skew(w: _Writer) -> None:
@@ -233,6 +285,8 @@ def render(
             _emit_snapshot(w, rsnap, rank)
     else:
         _emit_snapshot(w, snap, _rank())
+    _emit_process_info(w)
+    _emit_incidents(w)
     _emit_skew(w)
     return w.text()
 
@@ -342,7 +396,15 @@ def parse(text: str) -> Dict[str, Any]:
 
 # ------------------------------------------------------------------ scrape endpoint
 class ScrapeServer:
-    """Opt-in localhost ``/metrics`` endpoint (daemon thread; ``close()`` to stop)."""
+    """Opt-in localhost ``/metrics`` + ``/federation`` endpoint (daemon thread).
+
+    ``close()`` stops it; an atexit hook closes it automatically on interpreter exit
+    so the listening socket never outlives the process's ability to answer (a hung
+    scrape against a half-dead interpreter is worse than a refused connection). The
+    OS-assigned port is known synchronously at construction — read it from
+    :meth:`bound_port` (or ``.port``/``.url``); there is no race against the accept
+    thread, so tests and federators can bind ``port=0`` and discover safely.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Optional[Telemetry] = None, merged: bool = False) -> None:
@@ -352,16 +414,30 @@ class ScrapeServer:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - http.server API
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if path == "/federation":
+                    # peer-to-federator sidecar: sketch payloads + identity + incidents
+                    # (JSON — sketches don't fit the OpenMetrics text model losslessly)
+                    try:
+                        from torchmetrics_tpu.obs.federation import federation_payload
+
+                        body = json.dumps(federation_payload(reg)).encode("utf-8")
+                        ctype = "application/json; charset=utf-8"
+                    except Exception as err:  # noqa: BLE001
+                        self.send_error(500, explain=repr(err))
+                        return
+                elif path in ("", "/metrics"):
+                    try:
+                        body = render(reg, merged=mrg).encode("utf-8")
+                        ctype = CONTENT_TYPE
+                    except Exception as err:  # noqa: BLE001 - a scrape must not kill the server
+                        self.send_error(500, explain=repr(err))
+                        return
+                else:
                     self.send_error(404)
                     return
-                try:
-                    body = render(reg, merged=mrg).encode("utf-8")
-                except Exception as err:  # noqa: BLE001 - a scrape must not kill the server
-                    self.send_error(500, explain=repr(err))
-                    return
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -371,17 +447,39 @@ class ScrapeServer:
 
         self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
         self.host, self.port = self._httpd.server_address[:2]
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="tm-tpu-openmetrics"
         )
         self._thread.start()
+        import atexit
+
+        self._atexit = atexit.register(self.close)
         telemetry.counter("obs.scrape_servers").inc()
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/metrics"
 
+    @property
+    def federation_url(self) -> str:
+        return f"http://{self.host}:{self.port}/federation"
+
+    def bound_port(self) -> int:
+        """The OS-assigned listening port — valid the moment the constructor returns."""
+        return int(self.port)
+
     def close(self) -> None:
+        """Stop serving and release the socket; idempotent (atexit may call it again)."""
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:  # pragma: no cover - interpreter teardown order
+            pass
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)
@@ -396,5 +494,9 @@ class ScrapeServer:
 
 def serve_scrape(port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[Telemetry] = None, merged: bool = False) -> ScrapeServer:
-    """Start the opt-in localhost scrape endpoint; returns the running server."""
+    """Start the opt-in localhost scrape endpoint; returns the running server.
+
+    The bound port is available synchronously via ``.bound_port()`` (no port-0
+    discovery race) and the server is closed automatically at interpreter exit.
+    """
     return ScrapeServer(host=host, port=port, registry=registry, merged=merged)

@@ -47,7 +47,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from torchmetrics_tpu.obs.telemetry import Telemetry, telemetry
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["SloSpec", "SloStatus", "SloMonitor", "default_drift_specs", "default_serve_specs"]
+__all__ = [
+    "SloSpec", "SloStatus", "SloMonitor", "default_drift_specs", "default_serve_specs",
+    "default_fleet_specs",
+]
 
 #: default multi-window policy: sustained over 5 minutes AND still burning over the
 #: last 30 seconds, both at >= 2x budget pace
@@ -66,12 +69,18 @@ class SloSpec:
     ratio_of: Optional[str] = None      # event-ratio mode: total-events series
     windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS
     description: str = ""
+    #: "process" specs read this process's own series; "fleet" specs read the
+    #: federated series a :class:`~torchmetrics_tpu.obs.federation.Federator` records
+    #: into ITS registry each poll — pass that registry to the monitor
+    scope: str = "process"
 
     def __post_init__(self) -> None:
         if not (0.0 < self.objective < 1.0):
             raise ValueError(f"SloSpec(objective) needs (0, 1), got {self.objective}")
         if self.bad_when not in ("above", "below"):
             raise ValueError(f"SloSpec(bad_when) must be 'above'|'below', got {self.bad_when!r}")
+        if self.scope not in ("process", "fleet"):
+            raise ValueError(f"SloSpec(scope) must be 'process'|'fleet', got {self.scope!r}")
         if not self.windows:
             raise ValueError("SloSpec(windows) needs at least one (window_s, burn) pair")
         for w, b in self.windows:
@@ -256,6 +265,37 @@ def default_serve_specs(
             name="shed-ratio", series="serve.sheds", ratio_of="serve.queue_depth",
             objective=shed_objective, windows=windows,
             description="shed batches vs offered batches (on_full='shed' pressure)",
+        ),
+    ]
+
+
+def default_fleet_specs(
+    shed_budget: float = 0.001,
+    poll_objective: float = 0.99,
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS,
+) -> List[SloSpec]:
+    """Fleet-scoped stock SLOs over the series a ``Federator`` records per poll.
+
+    ``fleet-shed-storm``: each poll records the fleet-wide shed ratio (shed deltas vs
+    offered deltas summed ACROSS peers) into ``fleet.shed_ratio``; a poll whose ratio
+    exceeds ``shed_budget`` is bad — a shed storm on one pod burns the fleet budget
+    even while other pods are quiet. ``fleet-peers-healthy``: the unhealthy-peer
+    count stays at zero for all but ``1 - poll_objective`` of polls. Evaluate with a
+    monitor bound to the federator's registry: ``SloMonitor(default_fleet_specs(),
+    registry=federator.registry)`` (docs/observability.md "Fleet federation").
+    """
+    return [
+        SloSpec(
+            name="fleet-shed-storm", series="fleet.shed_ratio",
+            objective=poll_objective, threshold=shed_budget, bad_when="above",
+            windows=windows, scope="fleet",
+            description="fleet-wide shed batches vs offered batches (federated)",
+        ),
+        SloSpec(
+            name="fleet-peers-healthy", series="fleet.peers_unhealthy",
+            objective=poll_objective, threshold=0.0, bad_when="above",
+            windows=windows, scope="fleet",
+            description="federation polls finding unreachable/stale peers",
         ),
     ]
 

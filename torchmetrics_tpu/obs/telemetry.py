@@ -561,6 +561,50 @@ def instrument_trace(fn: Callable, owner: Any, kind: str) -> Callable:
     return wrapper
 
 
+# ------------------------------------------------------------------ process fingerprint
+#: wall-clock start of this interpreter, captured once at import — module level on
+#: purpose: reading it inside traced code would freeze it into the compiled program
+#: (jaxlint TPU020), reading it here cannot
+_START_UNIX = time.time()
+
+
+@functools.lru_cache(maxsize=1)
+def process_fingerprint() -> Dict[str, Any]:
+    """Stable identity of THIS interpreter: host, pid, jax process index, start time.
+
+    A bare rank int cannot distinguish "rank 3" from "rank 3 after a restart" — merged
+    traces, federated scrapes, and fleet bundles need to, so every identity surface
+    (env-fingerprint bundle section, Perfetto process metadata, the ``tm_process_info``
+    scrape sample, incident ids) carries this instead. The ``fingerprint`` field is an
+    8-hex digest of the tuple, unique across restarts even at equal pids.
+
+        >>> fp = process_fingerprint()
+        >>> sorted(fp) == ['fingerprint', 'host', 'pid', 'process_index', 'start_unix']
+        True
+        >>> len(fp['fingerprint'])
+        8
+    """
+    import hashlib
+    import socket
+
+    host = socket.gethostname()
+    pid = os.getpid()
+    try:
+        import jax
+
+        process_index = int(jax.process_index())
+    except Exception:  # pragma: no cover - jax always importable here
+        process_index = 0
+    raw = f"{host}|{pid}|{process_index}|{_START_UNIX:.6f}".encode()
+    return {
+        "host": host,
+        "pid": pid,
+        "process_index": process_index,
+        "start_unix": round(_START_UNIX, 3),
+        "fingerprint": hashlib.sha1(raw).hexdigest()[:8],
+    }
+
+
 # ----------------------------------------------------------------------------- helpers
 def tree_bytes(tree: Any) -> int:
     """Total byte size of every array-like leaf in a pytree (works on tracers: shape/dtype only)."""

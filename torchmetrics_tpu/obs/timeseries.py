@@ -35,7 +35,9 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
-__all__ = ["TimeSeries", "DEFAULT_POINTS", "DEFAULT_FOLD_EVERY"]
+__all__ = [
+    "TimeSeries", "DEFAULT_POINTS", "DEFAULT_FOLD_EVERY", "merged_quantiles",
+]
 
 #: point-ring length: enough for minutes of serving signals at typical record rates
 #: while keeping the windowed scans O(hundreds)
@@ -265,5 +267,99 @@ class TimeSeries:
             out.update({"p50": round(p50, 3), "p90": round(p90, 3), "p99": round(p99, 3)})
         return out
 
+    def sketch_payload(self) -> Dict[str, Any]:
+        """Wire-format view of the series for federation: sketch state + pending raw.
+
+        The sketch array ships as base64 float32 bytes with its ``(levels, capacity)``
+        geometry, pending (not-yet-folded) samples ship raw with unit weight — the
+        federator's :func:`merged_quantiles` reassembles both sides, so a fleet p99 is
+        a REAL ``kll_merge`` of per-peer sketches (the PR-10 mergeable contract), never
+        an average of per-peer quantiles.
+        """
+        import base64
+
+        import numpy as np
+
+        with self._fold_lock, self._lock:
+            sketch = self._sketch
+            pending = list(self._pending)
+            count, total, last = self._count, self._total, self._last
+        if sketch is not None:
+            state = np.asarray(sketch, np.float32)
+            encoded = base64.b64encode(state.tobytes()).decode("ascii")
+        else:
+            encoded = None
+        return {
+            "name": self.name,
+            "count": count,
+            "sum": round(total, 6),
+            "last": last,
+            "capacity": self._capacity,
+            "levels": self._levels,
+            "sketch": encoded,
+            "pending": [float(v) for v in pending],
+        }
+
     def __repr__(self) -> str:
         return f"TimeSeries({self.name!r}, count={self._count}, last={self._last})"
+
+
+# -------------------------------------------------------------------- fleet-side merge
+def merged_quantiles(payloads: Sequence[Dict[str, Any]], qs: Sequence[float]) -> List[Optional[float]]:
+    """True mergeable-sketch quantiles over per-peer :meth:`TimeSeries.sketch_payload`\\ s.
+
+    Payloads sharing a sketch geometry merge via ``kll_merge`` (weight-exact, the
+    documented rank-error bound holds for the POOLED stream); the merged supports plus
+    every peer's raw pending samples then answer one cumulative-weight rank query —
+    the same math :meth:`TimeSeries.quantiles` runs locally. Mixed geometries degrade
+    to weighted-point pooling, never to averaging quantiles. ``None``\\ s when no peer
+    has seen a sample.
+    """
+    import base64
+
+    import numpy as np
+
+    groups: Dict[tuple, Any] = {}  # (levels, capacity) -> merged jnp sketch
+    values = np.zeros((0,), np.float64)
+    weights = np.zeros((0,), np.float64)
+    pending_all: List[float] = []
+    for p in payloads:
+        pending_all.extend(float(v) for v in p.get("pending") or ())
+        encoded = p.get("sketch")
+        if not encoded:
+            continue
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.sketch.kll import kll_merge
+
+        levels, capacity = int(p["levels"]), int(p["capacity"])
+        state = np.frombuffer(base64.b64decode(encoded), np.float32).reshape(
+            levels, capacity + 2
+        )
+        sk = jnp.asarray(state)
+        key = (levels, capacity)
+        prev = groups.get(key)
+        groups[key] = sk if prev is None else kll_merge(prev, sk)
+    for sk in groups.values():
+        from torchmetrics_tpu.sketch.kll import kll_weighted_points
+
+        v, w = kll_weighted_points(sk)
+        values = np.concatenate([values, np.asarray(v, np.float64)])
+        weights = np.concatenate([weights, np.asarray(w, np.float64)])
+    if pending_all:
+        values = np.concatenate([values, np.asarray(pending_all, np.float64)])
+        weights = np.concatenate([weights, np.ones(len(pending_all), np.float64)])
+    finite = np.isfinite(values)
+    values, weights = values[finite], weights[finite]
+    order = np.argsort(values, kind="stable")
+    values, weights = values[order], weights[order]
+    cw = np.cumsum(weights)
+    n = cw[-1] if len(cw) else 0.0
+    if n <= 0:
+        return [None] * len(qs)
+    out: List[Optional[float]] = []
+    for q in qs:
+        target = min(max(float(q), 0.0), 1.0) * n
+        idx = min(int(np.searchsorted(cw, target, side="left")), len(values) - 1)
+        out.append(float(values[idx]))
+    return out

@@ -1089,6 +1089,7 @@ def process_sync(
                 if not opts.degraded_mode:
                     # the exception is about to propagate out of the sync layer: land
                     # the post-mortem bundle while this process still can
+                    obs.flightrec.open_incident("sync_timeout")
                     obs.flightrec.record("sync.timeout", state=name, world=world, sharded=True)
                     obs.capture_bundle("sync_timeout")
                     raise
@@ -1160,6 +1161,7 @@ def process_sync(
                 note_responders(name, partial.keys())
                 continue
             if not opts.degraded_mode:
+                obs.flightrec.open_incident("sync_timeout")
                 obs.flightrec.record(
                     "sync.timeout", state=name, world=world,
                     responded=sorted(int(r) for r in partial),

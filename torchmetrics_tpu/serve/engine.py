@@ -258,6 +258,9 @@ class IngestEngine:
             return
         if t is not None:  # a previous drain died (chaos DrainThreadDeath, or a crash)
             if not self.options.restart_drain:
+                # incident first: the drain-death flight events must carry the id the
+                # bundle (and the federation gossip) will advertise
+                _flightrec.open_incident("serve_drain_death")
                 _flightrec.record(
                     "serve.drain_restart", pending=len(self._queue), restarted=False
                 )
@@ -270,6 +273,7 @@ class IngestEngine:
             telemetry.counter("serve.drain_restarts").inc()
             # a drain death is a real failure seam even when the latch recovers it:
             # land the post-mortem bundle, then restart (docs/observability.md)
+            _flightrec.open_incident("serve_drain_death")
             _flightrec.record(
                 "serve.drain_restart", pending=len(self._queue),
                 restarts=self._stats["drain_restarts"],
@@ -483,6 +487,7 @@ class IngestEngine:
         if err is not None:
             # the deferred apply failure surfaces HERE (the drain already recorded the
             # apply_failure event); capture the bundle before the raise reaches user code
+            _flightrec.open_incident("serve_apply_failure")
             _bundle.capture_bundle("serve_apply_failure", metric=self.target)
             raise ServeError(
                 f"A batch enqueued via update_async failed to apply: {err!r}. The"
@@ -518,6 +523,7 @@ class IngestEngine:
         # the preemption seam: the dropped window only survives in the write-ahead
         # journal, and the bundle records its cursor — post-mortem replay from it is
         # bit-identical (docs/observability.md "Flight recorder & post-mortem bundles")
+        _flightrec.open_incident("serve_abandoned")
         _flightrec.record("serve.abandoned", dropped_in_window=dropped)
         _bundle.capture_bundle("serve_abandoned", metric=self.target)
         return dropped

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-fast jaxlint-race jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
+test: jaxlint jaxlint-race test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -102,13 +102,29 @@ sketch-smoke:
 	python bench.py --sketch --smoke > /tmp/tm_sketch_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_sketch_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['sketch_auc_abs_error'] <= ex['sketch_auc_error_bound'], ex; assert ex['quantile_rank_error'] <= ex['quantile_error_bound'], ex; assert ex['sketch_auroc_state_bytes'] == ex['sketch_auroc_state_bytes_short_stream'], ex; assert ex['sketch_auroc_state_bytes'] < ex['cat_auroc_state_bytes'], ex; assert ex['sketch_auroc_state_bytes'] <= 65536 and ex['sketch_quantile_state_bytes'] <= 65536, ex; assert ex['sketch_exact_mode_bit_identical'], ex; print('sketch-smoke ok: %dB sketch vs %dB cat state (%.0fx), AUC err %.2e <= %.2e' % (ex['sketch_auroc_state_bytes'], ex['cat_auroc_state_bytes'], ex['cat_auroc_state_bytes']/ex['sketch_auroc_state_bytes'], ex['sketch_auc_abs_error'], ex['sketch_auc_error_bound']))"
 
-# static JAX/TPU hazard analysis (rules TPU001-TPU014, docs/static-analysis.md): exits
+# static JAX/TPU hazard analysis (rules TPU000-TPU023, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`. Whole-program
 # pass over the package PLUS examples/ and bench.py, with the content-fingerprint
 # incremental cache (unchanged reruns skip rule execution entirely).
 jaxlint:
 	python -m torchmetrics_tpu._lint torchmetrics_tpu examples bench.py --strict-baseline --cache
+
+# pre-push inner loop: same whole-program analysis (cross-module rules stay sound), but
+# only findings in files changed vs. origin/main are REPORTED — with a warm cache this is
+# sub-second. Override the ref with `make jaxlint-fast REF=HEAD~1`.
+REF ?= origin/main
+jaxlint-fast:
+	python -m torchmetrics_tpu._lint torchmetrics_tpu examples bench.py --cache --changed-only $(REF)
+
+# deterministic schedule sanitizer (docs/static-analysis.md "Concurrency rules & the
+# schedule sanitizer"): replays the shipped concurrency suppressions' named scenarios —
+# engine enqueue-vs-quiesce, flight-ring append-vs-snapshot, federation
+# poll-vs-shutdown, health-ledger evict-vs-probe — under seeded interleaving
+# permutations; exits nonzero if ANY explored schedule breaks an invariant. The seed is
+# pinned so CI failures replay locally with the printed schedule trace.
+jaxlint-race:
+	JAX_PLATFORMS=cpu TM_TPU_CHAOS_SEED=1234 python -m torchmetrics_tpu._lint.racerun --seed 1234
 
 # SARIF artifact for CI code-scanning upload (same finding set as `make jaxlint`)
 jaxlint-sarif:

@@ -1,0 +1,285 @@
+"""TPU021/TPU022/TPU023: the tmrace concurrency rules (bad + clean fixture pairs).
+
+These rules are whole-program only (thread-root discovery needs the project call
+graph), so fixtures go through ``analyze_sources(..., project=True)`` rather than the
+per-module ``analyze_source`` the older rule tests use. A shipped-tree contract test
+rides along: every concurrency suppression in the package must name a scenario the
+schedule sanitizer actually runs.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+from torchmetrics_tpu._lint.core import analyze_sources, iter_python_files
+
+PATH = "torchmetrics_tpu/serve/fixture_engine.py"
+
+
+def _findings(source: str, rule: str, path: str = PATH):
+    return [f for f in analyze_sources([(path, source)], project=True) if f.rule == rule]
+
+
+# --------------------------------------------------------------------------- TPU021
+# drain thread writes the counter bare while the main thread writes it under the lock:
+# disjoint locksets on the same field from two concurrent roots
+RACY_COUNTER = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.count = self.count + 1
+
+    def bump(self):
+        with self._lock:
+            self.count = self.count + 1
+"""
+
+LOCKED_COUNTER = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        with self._lock:
+            self.count = self.count + 1
+
+    def bump(self):
+        with self._lock:
+            self.count = self.count + 1
+"""
+
+
+class TestTpu021:
+    def test_disjoint_locksets_flag(self):
+        findings = _findings(RACY_COUNTER, "TPU021")
+        assert len(findings) == 1, [f.render() for f in findings]
+        msg = findings[0].message
+        assert "count" in msg
+        assert "_loop" in msg  # the bare-write site is named...
+        assert "also written at" in msg and "disjoint locksets" in msg  # ...and the other
+
+    def test_common_lock_clean(self):
+        assert _findings(LOCKED_COUNTER, "TPU021") == []
+
+    def test_atomic_deque_append_sanctioned(self):
+        # GIL-atomic single-call mutators (ring appends) are sanctioned by design
+        src = RACY_COUNTER.replace("self.count = 0", "self.count = []").replace(
+            "self.count = self.count + 1", "self.count.append(1)"
+        )
+        assert _findings(src, "TPU021") == []
+
+    def test_single_mutator_marker_suppresses(self):
+        src = RACY_COUNTER.replace(
+            "self.count = self.count + 1\n\n    def bump",
+            "self.count = self.count + 1  # jaxlint: single-mutator (racerun: x)\n\n"
+            "    def bump",
+        )
+        assert "single-mutator" in src  # the replace really landed on the drain write
+        assert _findings(src, "TPU021") == []
+
+    def test_init_stores_do_not_count_as_writes(self):
+        # only __init__ assigns; the threads just read — nothing shared is mutated
+        src = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self.limit = 8
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        return self.limit
+
+    def peek(self):
+        return self.limit
+"""
+        assert _findings(src, "TPU021") == []
+
+
+# --------------------------------------------------------------------------- TPU022
+# engine-attachable class (assigns self._serve): a public entry point reads tensor
+# state without draining in-flight batches first
+UNQUIESCED_EXPORT = """
+class Metric:
+    def __init__(self, state):
+        self._state = state
+        self._serve = None
+
+    def attach_engine(self, engine):
+        self._serve = engine
+
+    def export(self):
+        return list(self._state.tensors)
+"""
+
+QUIESCED_EXPORT = """
+class Metric:
+    def __init__(self, state):
+        self._state = state
+        self._serve = None
+
+    def attach_engine(self, engine):
+        self._serve = engine
+
+    def export(self):
+        if self._serve is not None:
+            self._serve.quiesce()
+        return list(self._state.tensors)
+"""
+
+
+class TestTpu022:
+    def test_unquiesced_entry_point_flags(self):
+        findings = _findings(UNQUIESCED_EXPORT, "TPU022")
+        assert len(findings) == 1, [f.render() for f in findings]
+        assert "export" in findings[0].message
+        assert "quiesce" in findings[0].message
+
+    def test_quiesce_guard_clean(self):
+        assert _findings(QUIESCED_EXPORT, "TPU022") == []
+
+    def test_quiesce_via_helper_method_clean(self):
+        # the quiesce may live one same-class call down (the metric.py idiom)
+        src = QUIESCED_EXPORT.replace(
+            "    def export(self):\n        if self._serve is not None:\n"
+            "            self._serve.quiesce()\n        return list(self._state.tensors)",
+            "    def _drain(self):\n        if self._serve is not None:\n"
+            "            self._serve.quiesce()\n\n"
+            "    def export(self):\n        self._drain()\n"
+            "        return list(self._state.tensors)",
+        )
+        assert "_drain" in src
+        assert _findings(src, "TPU022") == []
+
+    def test_private_methods_exempt(self):
+        src = UNQUIESCED_EXPORT.replace("def export", "def _export")
+        assert _findings(src, "TPU022") == []
+
+
+# --------------------------------------------------------------------------- TPU023
+# check-then-act: the emptiness test runs outside the lock that every writer holds
+CHECK_THEN_ACT = """
+import threading
+
+
+class Outbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self._t = threading.Thread(target=self._feed, daemon=True)
+        self._t.start()
+
+    def _feed(self):
+        with self._lock:
+            self.items = self.items + [1]
+
+    def flush(self):
+        if self.items:
+            with self._lock:
+                self.items = []
+"""
+
+CHECK_UNDER_LOCK = """
+import threading
+
+
+class Outbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self._t = threading.Thread(target=self._feed, daemon=True)
+        self._t.start()
+
+    def _feed(self):
+        with self._lock:
+            self.items = self.items + [1]
+
+    def flush(self):
+        with self._lock:
+            if self.items:
+                self.items = []
+"""
+
+
+class TestTpu023:
+    def test_unlocked_test_read_flags(self):
+        findings = _findings(CHECK_THEN_ACT, "TPU023")
+        assert len(findings) == 1, [f.render() for f in findings]
+        assert "items" in findings[0].message
+
+    def test_check_under_lock_clean(self):
+        assert _findings(CHECK_UNDER_LOCK, "TPU023") == []
+
+    def test_no_concurrent_writer_no_finding(self):
+        # same check-then-act shape, but nothing else ever writes: single-threaded
+        src = CHECK_THEN_ACT.replace(
+            "        self._t = threading.Thread(target=self._feed, daemon=True)\n"
+            "        self._t.start()\n",
+            "",
+        )
+        assert _findings(src, "TPU023") == []
+
+
+# ------------------------------------------------------------- shipped-tree contracts
+import functools
+import types
+
+
+@functools.lru_cache(maxsize=1)
+def _package_pm():
+    # suppression_scenarios only tokenizes .path/.source off pm.entries, so the
+    # contract scan rides a lightweight source list — building the real ProjectModel
+    # (call graph, symbol tables) here would add ~10s of tier-1 wall clock for rows
+    # that come out identical
+    import torchmetrics_tpu
+
+    root = Path(torchmetrics_tpu.__file__).resolve().parent
+    entries = [
+        types.SimpleNamespace(path=display, source=fp.read_text(encoding="utf-8"))
+        for fp, display in iter_python_files([root])
+    ]
+    return types.SimpleNamespace(entries=entries)
+
+
+class TestSuppressionContract:
+    def test_every_suppression_names_a_real_scenario(self):
+        """A concurrency suppression without a passing schedule is just a comment.
+
+        Every ``single-mutator``/``disable=TPU021`` marker in the shipped package must
+        cite a scenario key of ``racerun.SCENARIOS`` — the thing ``make jaxlint-race``
+        actually replays. (That the cited schedules PASS is the jaxlint-race gate
+        itself; this test pins the linkage so a typo'd scenario name cannot rot.)
+        """
+        from torchmetrics_tpu._lint import racerun
+        from torchmetrics_tpu._lint.concurrency import suppression_scenarios
+
+        rows = suppression_scenarios(_package_pm())
+        assert rows, "the engine fence sanction should be visible here"
+        for row in rows:
+            assert row["scenario"], f"{row['path']}:{row['line']}: suppression has no" \
+                                    " (racerun: <scenario>) annotation"
+            assert row["scenario"] in racerun.SCENARIOS, (
+                f"{row['path']}:{row['line']} cites unknown scenario {row['scenario']!r}"
+            )
+
+    def test_engine_fence_sanction_present(self):
+        from torchmetrics_tpu._lint.concurrency import suppression_scenarios
+
+        rows = suppression_scenarios(_package_pm())
+        engine_rows = [r for r in rows if r["path"].endswith("serve/engine.py")]
+        assert any(r["scenario"] == "engine_enqueue_vs_quiesce" for r in engine_rows), rows

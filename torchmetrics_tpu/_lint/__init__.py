@@ -11,12 +11,15 @@ Usage::
     python -m torchmetrics_tpu._lint torchmetrics_tpu            # lint the package
     make jaxlint                                                 # CI gate (strict baseline)
 
-Rules TPU001–TPU013 are documented with bad/good examples in ``docs/static-analysis.md``
+Rules TPU000–TPU023 are documented with bad/good examples in ``docs/static-analysis.md``
 (the catalog table there is generated from ``rules.RULE_META``); per-line suppression is
 ``# jaxlint: disable=TPU00X``. The default run is whole-program (``_lint/project.py``):
-interprocedural jit/donation/hot-path marks propagate across module boundaries and
-findings carry a ``via:`` call path. The opt-in jaxpr IR backend (``--ir``,
-``_lint/irlint.py``) is the only component that imports jax.
+interprocedural jit/donation/hot-path marks propagate across module boundaries, findings
+carry a ``via:`` call path, and the concurrency pass (``_lint/concurrency.py``, rules
+TPU021–TPU023) runs thread-root discovery + lockset dataflow over the same call graph —
+its dynamic half is the seeded schedule explorer ``_lint/racerun.py``
+(``make jaxlint-race``). The opt-in jaxpr IR backend (``--ir``, ``_lint/irlint.py``) and
+the racerun harness scenarios are the only components that import jax.
 """
 from torchmetrics_tpu._lint.baseline import (
     DEFAULT_BASELINE_PATH,

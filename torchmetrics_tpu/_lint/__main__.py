@@ -35,6 +35,31 @@ from torchmetrics_tpu._lint.core import (
 from torchmetrics_tpu._lint.rules import RULE_META, RULES
 
 
+def _changed_paths(ref: str) -> Optional[List[str]]:
+    """Repo-relative ``.py`` paths changed vs. ``ref`` (None when git is unusable).
+
+    Finding display paths are rooted at the linted root's basename, which matches the
+    repo-relative paths ``git diff`` prints when jaxlint runs from the repo root — the
+    ``make jaxlint-fast`` layout. Untracked files count as changed (``--others``): a
+    brand-new module must not dodge the fast gate.
+    """
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            capture_output=True, text=True, timeout=30, check=True,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+            capture_output=True, text=True, timeout=30, check=True,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    return sorted({line.strip() for line in out if line.strip()})
+
+
 def _default_paths() -> List[str]:
     """Prefer a source checkout's ``torchmetrics_tpu/`` in cwd; else the installed package."""
     if Path("torchmetrics_tpu").is_dir():
@@ -45,7 +70,7 @@ def _default_paths() -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m torchmetrics_tpu._lint",
-        description="jaxlint: whole-program AST JAX/TPU hazard analyzer (rules TPU001-TPU013)",
+        description="jaxlint: whole-program AST JAX/TPU hazard analyzer (rules TPU000-TPU023)",
     )
     parser.add_argument("paths", nargs="*", help="files/directories to lint (default: the package)")
     parser.add_argument("--format", choices=("text", "json", "sarif", "github"), default="text")
@@ -62,7 +87,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run (default: all)")
     parser.add_argument("--no-project", action="store_true",
-                        help="per-module analysis only (no interprocedural propagation)")
+                        help="per-module analysis only (no interprocedural propagation;"
+                             " skips the TPU021-TPU023 concurrency pass)")
+    parser.add_argument("--changed-only", default=None, metavar="GIT_REF",
+                        help="report only findings in files changed vs. GIT_REF (the"
+                             " analysis still sees the whole program, so cross-module"
+                             " rules stay sound — only the REPORT is diff-scoped)")
     parser.add_argument("--cache", nargs="?", const=DEFAULT_CACHE_PATH, default=None,
                         metavar="PATH",
                         help="incremental cache file (default location when given bare:"
@@ -106,8 +136,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"jaxlint: path(s) not found: {', '.join(missing)}", file=sys.stderr)
         return 2
 
+    if args.changed_only and args.write_baseline:
+        print("jaxlint: --changed-only cannot combine with --write-baseline"
+              " (a diff-scoped finding set would silently drop baseline entries)",
+              file=sys.stderr)
+        return 2
+
     cache = LintCache(args.cache) if args.cache else None
     findings = analyze_paths(paths, select=select, project=not args.no_project, cache=cache)
+
+    if args.changed_only:
+        changed = _changed_paths(args.changed_only)
+        if changed is None:
+            print(f"jaxlint: --changed-only {args.changed_only}: git diff failed;"
+                  " reporting the full finding set", file=sys.stderr)
+        else:
+            changed_set = set(changed)
+            findings = [f for f in findings if f.path in changed_set]
+            print(f"jaxlint: --changed-only {args.changed_only}:"
+                  f" {len(changed_set)} changed .py file(s) in scope", file=sys.stderr)
 
     if args.write_baseline:
         target = DEFAULT_BASELINE_PATH if args.baseline == "none" else Path(args.baseline)

@@ -1,0 +1,880 @@
+"""tmrace: whole-program concurrency analysis for the serving/observability thread plane.
+
+The reference library is single-threaded by construction; this repro is not. The PR 11
+drain thread, the scrape/federation server threads (one per in-flight HTTP request —
+``ThreadingHTTPServer``), the bounded-gather worker, and ``atexit`` close hooks all
+mutate state the main thread also touches, governed so far by conventions (the engine's
+single-mutator contract, quiesce-on-every-host-access) that only example-based tests
+defend. This module gives those contracts the same treatment jaxlint gave the
+jit/donation contracts: a static pass over PR 9's project-wide call graph.
+
+Three layers, three rules:
+
+1. **Thread-root discovery.** A *root* is an entry point the Python runtime can drive
+   concurrently with the main thread: ``threading.Thread(target=f)`` targets,
+   ``ThreadingHTTPServer``/``HTTPServer`` handler-class methods (self-concurrent — the
+   server spawns one thread per request), ``atexit.register(f)`` hooks, and defs marked
+   ``# jaxlint: thread-root``. The implicit ``main`` root seeds every public function
+   (user code calls the API from the main thread); reachability per root is the closure
+   of the resolved call graph. ``main`` and ``atexit`` are the SAME OS thread (exit
+   hooks run on the main thread at interpreter shutdown), so they are never concurrent
+   with each other — only with real thread/handler roots.
+
+2. **Lockset dataflow.** ``with lock:`` regions and ``acquire()``–``release()`` spans
+   yield the set of locks held at every statement; a callee's *entry lockset* is the
+   meet (intersection) over all reachable call sites, iterated to fixpoint — so a
+   helper only ever invoked under ``self._cond`` analyzes as holding it
+   (``_ensure_drain_locked``), while a helper reachable both locked and unlocked
+   analyzes as holding nothing.
+
+3. **The rules.**
+
+   - **TPU021** — an attribute/global written from ≥2 mutually-concurrent roots with
+     disjoint locksets. GIL-atomic container ops (``append``/``appendleft``/``popleft``
+     /``pop``/``add``/``discard``) are sanctioned — the lock-light rings are a design,
+     not a race — as are fields whose write (or ``__init__`` default) line carries
+     ``# jaxlint: single-mutator`` (the engine's quiesce-barrier protocol: exactly one
+     mutator at a time, enforced dynamically, justified by a passing
+     ``racerun`` schedule).
+   - **TPU022** — a public host-access entry point of an engine-attachable class (one
+     that assigns ``self._serve``) touches tensor state without routing through the
+     quiesce seam. This is the docs/serving.md "every host access quiesces first"
+     table, checked structurally instead of by enumeration.
+   - **TPU023** — check-then-act: an ``if``/``while`` test (or a multi-step read —
+     iteration such as ``.items()``/``.values()``/``for``) of a shared field outside
+     the lock that consistently guards that field's writes on a concurrent root.
+     Single attribute loads are NOT flagged (a one-word read is GIL-atomic); the races
+     worth reporting are decisions taken on stale state (``if self._closed:`` vs a
+     concurrent ``close()``) and iterations a concurrent resize can explode.
+
+Per-module analysis (``analyze_source`` / ``--no-project``) cannot see thread roots in
+other files, so these rules run ONLY in the whole-program pass — mirroring how
+interprocedural marks already work. Under-reporting beats noise throughout: writes
+through unresolvable objects, fields of classes never reached from a non-main root,
+and ``__init__``-time stores (the object has not escaped yet) are all out of scope.
+
+The dynamic half lives in :mod:`torchmetrics_tpu._lint.racerun`: every TPU021 finding
+is either reproduced into a failing deterministic schedule or sanctioned by a marker
+whose named scenario passes all explored interleavings (``make jaxlint-race``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu._lint.core import Finding
+from torchmetrics_tpu._lint.rules import (
+    _dotted,
+    _final_name,
+    _finding,
+    _FuncInfo,
+    _scoped_walk,
+)
+
+#: def-line marker declaring a function a thread entry point the discovery cannot see
+#: (e.g. a callback handed to an external scheduler)
+_THREAD_ROOT_RE = re.compile(r"#\s*jaxlint:\s*thread-root\b")
+#: write-site / field-default marker: the field is protected by a single-mutator
+#: protocol (quiesce barrier / sole-writer thread), not a lock — every use must name
+#: the racerun scenario that justifies it, as a trailing comment of the form
+#: "jaxlint: single-mutator (racerun: engine_enqueue_vs_quiesce)"
+_SINGLE_MUTATOR_RE = re.compile(r"#\s*jaxlint:\s*single-mutator\b(?:\s*\(racerun:\s*(?P<scenario>[\w.-]+)\))?")
+
+#: constructors whose result is a lock object (``threading.`` prefix or bare import)
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"})
+#: with-target name heuristic: ``with self._poll_mutex:`` guards even if the ctor
+#: assignment lives outside the analyzed tree
+_LOCKISH_NAME_RE = re.compile(r"(?:^|_)(?:lock|cond|mutex|guard)$")
+
+#: container mutators that are a single bytecode-visible C call under the GIL — the
+#: sanctioned "deque/ring append" tier of the lock-light rings
+_ATOMIC_MUTATORS = frozenset({"append", "appendleft", "popleft", "pop", "add", "discard", "clear"})
+#: non-atomic (multi-step / resizing) mutating method names treated as writes
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "popleft", "pop", "clear",
+    "update", "add", "remove", "discard", "setdefault", "insert", "set",
+})
+#: read-side method names that take multiple steps over the container (iteration /
+#: snapshotting) — the TPU023 "multi-step read" tier
+_ITER_READS = frozenset({"items", "values", "keys", "copy"})
+
+#: server classes whose second positional argument is a per-request handler class
+_HANDLER_SERVERS = frozenset({"ThreadingHTTPServer", "HTTPServer", "TCPServer", "ThreadingTCPServer"})
+
+#: ``self._state`` sub-attributes that ARE tensor state (TPU022's "touches tensor
+#: state"); ``.generation`` deliberately absent — fence readers poll it lock-free
+_STATE_TENSOR_ATTRS = frozenset({"tensors", "lists", "snapshot", "restore", "values"})
+
+
+class _Root:
+    """One concurrent entry point. ``main`` and ``atexit`` share the main OS thread."""
+
+    __slots__ = ("kind", "label", "self_concurrent")
+
+    def __init__(self, kind: str, label: str, self_concurrent: bool = False) -> None:
+        self.kind = kind  # main | thread | handler | atexit | mark
+        self.label = label
+        self.self_concurrent = self_concurrent
+
+    def concurrent_with(self, other: "_Root") -> bool:
+        if self is other:
+            return self.self_concurrent
+        if self.kind in ("main", "atexit") and other.kind in ("main", "atexit"):
+            return False  # exit hooks run on the main thread
+        return True
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"_Root({self.kind}:{self.label})"
+
+
+class _Access:
+    """One shared-field access with its location, lockset, and root provenance."""
+
+    __slots__ = ("field", "kind", "path", "node", "lockset", "func", "atomic", "sanction", "in_test")
+
+    def __init__(self, field, kind, path, node, lockset, func, atomic=False, sanction=None, in_test=False):
+        self.field = field          # (path, class-or-scope, attr)
+        self.kind = kind            # "write" | "read"
+        self.path = path
+        self.node = node
+        self.lockset: FrozenSet[str] = lockset
+        self.func = func            # _FuncInfo
+        self.atomic = atomic        # GIL-atomic container op
+        self.sanction = sanction    # "single-mutator" | None
+        self.in_test = in_test      # read inside an if/while test (check-then-act)
+
+
+class ConcurrencyModel:
+    """Thread roots, per-root reachability, and entry locksets over a ProjectModel."""
+
+    def __init__(self, pm) -> None:
+        self.pm = pm
+        self.roots: List[_Root] = [_Root("main", "main thread")]
+        #: id(_FuncInfo) -> set of root indices that can reach it
+        self.roots_of: Dict[int, Set[int]] = {}
+        #: id(_FuncInfo) -> meet of locksets over reachable call sites (entry lockset)
+        self.entry_lockset: Dict[int, FrozenSet[str]] = {}
+        self._func_entry: Dict[int, Tuple] = {}  # id(info) -> (entry, info)
+        self._class_locks: Dict[Tuple[str, str], Set[str]] = {}  # (path, cls) -> attrs
+        self._module_locks: Dict[str, Set[str]] = {}             # path -> names
+        self._module_globals: Dict[str, Set[str]] = {}           # path -> module-scope names
+        self._instance_of: Dict[Tuple[str, str], str] = {}       # (path, name) -> class
+        self._bound_methods: Dict[Tuple[str, str], Tuple[str, str]] = {}  # (path, name) -> (cls, meth)
+        self._root_entries: Set[int] = set()  # id(info) for every non-main root entry
+        self._root_keys: Set[Tuple] = set()   # dedup: same call seen from two scans
+        #: (path, cls) whose instances can be reached from more than one thread: bound
+        #: to a module global, stored into another object's attribute, or spawning a
+        #: thread on their own method. Fields of UN-anchored classes (e.g. a per-request
+        #: render helper built and dropped inside one function) are thread-local.
+        self._shared_classes: Set[Tuple[str, str]] = set()
+        #: id(info) -> [(resolved target infos, LOCAL lockset at the call site)]:
+        #: call-edge structure is sweep-invariant, so the body walk + resolution run
+        #: once per function and the fixpoint only re-does the cheap set algebra
+        self._edges: Dict[int, List[Tuple[List, FrozenSet[str]]]] = {}
+        for entry in pm.entries:
+            for info in entry.model.functions:
+                self._func_entry[id(info)] = (entry, info)
+        self._collect_module_facts()
+        self._discover_roots()
+        self._seed_and_propagate()
+
+    # ------------------------------------------------------------------- module facts
+    def _collect_module_facts(self) -> None:
+        for entry in self.pm.entries:
+            mlocks: Set[str] = set()
+            mglobals: Set[str] = set()
+            for node in entry.tree.body:
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.target is not None:
+                    targets = [node.target]
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    mglobals.add(t.id)
+                    value = node.value
+                    if value is None:
+                        continue
+                    if self._is_lock_ctor(value):
+                        mlocks.add(t.id)
+                    elif isinstance(value, ast.Call):
+                        cname = _final_name(value.func)
+                        if cname in entry.model.class_nodes:
+                            self._instance_of[(entry.path, t.id)] = cname
+                    d = _dotted(value)
+                    if d is not None and len(d) == 2 and (entry.path, d[0]) in self._instance_of:
+                        # ``record = recorder.record`` — a module-level bound method
+                        cls = self._instance_of[(entry.path, d[0])]
+                        self._bound_methods[(entry.path, t.id)] = (cls, d[1])
+            self._module_locks[entry.path] = mlocks
+            self._module_globals[entry.path] = mglobals
+            for info in entry.model.functions:
+                if info.cls is None:
+                    continue
+                for node in _scoped_walk(info.node):
+                    if isinstance(node, ast.Assign) and self._is_lock_ctor(node.value):
+                        for t in node.targets:
+                            d = _dotted(t)
+                            if d and len(d) == 2 and d[0] == "self":
+                                self._class_locks.setdefault((entry.path, info.cls), set()).add(d[1])
+            # function-local locks (closure guards like federation's ``poll_lock``)
+            for info in entry.model.functions:
+                for node in _scoped_walk(info.node):
+                    if isinstance(node, ast.Assign) and self._is_lock_ctor(node.value):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self._module_locks[entry.path].add(t.id)
+            # shared-class anchors (see _shared_classes)
+            for name, cname in list(self._instance_of.items()):
+                if name[0] == entry.path:
+                    self._shared_classes.add((entry.path, cname))
+            for info in entry.model.functions:
+                fglobals = {
+                    n for node in _scoped_walk(info.node) if isinstance(node, ast.Global)
+                    for n in node.names
+                }
+                for node in _scoped_walk(info.node):
+                    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                        cname = _final_name(node.value.func)
+                        owners = []
+                        if cname in entry.model.class_nodes:
+                            owners.append((entry.path, cname))
+                        imp = entry.imports.get(cname or "")
+                        if imp is not None:
+                            towner = self.pm.by_module.get(imp[0])
+                            if towner is not None and imp[1] in towner.model.class_nodes:
+                                owners.append((towner.path, imp[1]))
+                        if not owners:
+                            continue
+                        for t in node.targets:
+                            d = _dotted(t)
+                            if (d and d[0] == "self" and len(d) == 2) or (
+                                isinstance(t, ast.Name) and t.id in fglobals
+                            ):
+                                self._shared_classes.update(owners)
+                    if (info.cls is not None and isinstance(node, ast.Call)
+                            and _final_name(node.func) == "Thread"):
+                        self._shared_classes.add((entry.path, info.cls))
+
+    @staticmethod
+    def _is_lock_ctor(value: Optional[ast.AST]) -> bool:
+        return isinstance(value, ast.Call) and _final_name(value.func) in _LOCK_CTORS
+
+    def _lock_key(self, entry, info: Optional[_FuncInfo], expr: ast.AST) -> Optional[str]:
+        """Lock identity of a ``with``-context / acquire-release expression, or None."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        name = d[-1]
+        if d[0] == "self" and info is not None and info.cls is not None and len(d) >= 2:
+            attr = d[1]
+            if attr in self._class_locks.get((entry.path, info.cls), ()) or _LOCKISH_NAME_RE.search(attr):
+                return f"{entry.path}::{info.cls}.{attr}"
+            return None
+        if len(d) == 1:
+            if d[0] in self._module_locks.get(entry.path, ()) or _LOCKISH_NAME_RE.search(d[0]):
+                return f"{entry.path}::{d[0]}"
+            return None
+        # dotted non-self chain (module-global lock via alias, etc.)
+        if _LOCKISH_NAME_RE.search(name) or name in self._module_locks.get(entry.path, ()):
+            return ".".join(d)
+        return None
+
+    # -------------------------------------------------------------------- thread roots
+    def _marked_thread_root(self, entry, info: _FuncInfo) -> bool:
+        dl = info.node.lineno
+        src = entry.lines[dl - 1] if 0 < dl <= len(entry.lines) else ""
+        return bool(_THREAD_ROOT_RE.search(src))
+
+    def _discover_roots(self) -> None:
+        for entry in self.pm.entries:
+            # per-function scan so Thread(target=self._x) resolves against the class
+            for info in entry.model.functions:
+                if self._marked_thread_root(entry, info):
+                    self._add_root("mark", entry, [info], f"marked thread-root {entry.path}::{info.qualname}")
+                for node in _scoped_walk(info.node):
+                    if isinstance(node, ast.Call):
+                        self._root_from_call(entry, info, node)
+            for node in ast.walk(entry.tree):  # module-scope Thread(...)/atexit hooks
+                if isinstance(node, ast.Call):
+                    self._root_from_call(entry, None, node)
+
+    def _root_from_call(self, entry, info: Optional[_FuncInfo], call: ast.Call) -> None:
+        fname = _final_name(call.func)
+        if fname == "Thread":
+            target = next((kw.value for kw in call.keywords if kw.arg == "target"), None)
+            if target is not None:
+                funcs = self._resolve_ref(entry, info, target)
+                if funcs:
+                    label = f"thread {entry.path}::{funcs[0][1].qualname}"
+                    for kw in call.keywords:
+                        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                            label = f"thread '{kw.value.value}'"
+                    self._add_root("thread", entry, [fi for _, fi in funcs], label)
+        elif fname in _HANDLER_SERVERS and len(call.args) >= 2:
+            hname = _final_name(call.args[1])
+            if hname and hname in entry.model.class_nodes:
+                methods = [fi for fi in entry.model.functions if fi.cls == hname]
+                if methods:
+                    self._add_root("handler", entry, methods,
+                                   f"HTTP handler {entry.path}::{hname}", self_concurrent=True)
+        elif fname == "register":
+            d = _dotted(call.func)
+            if d and d[0] == "atexit" and call.args:
+                funcs = self._resolve_ref(entry, info, call.args[0])
+                if funcs:
+                    self._add_root("atexit", entry, [fi for _, fi in funcs],
+                                   f"atexit hook {entry.path}::{funcs[0][1].qualname}")
+
+    def _resolve_ref(self, entry, info: Optional[_FuncInfo], expr: ast.AST) -> List[Tuple]:
+        """Resolve a function REFERENCE (not a call): ``self._m``, a bare name, ``mod.f``."""
+        d = _dotted(expr)
+        if d is None:
+            return []
+        if d[0] == "self" and len(d) == 2 and info is not None and info.cls is not None:
+            return [(entry, fi) for fi in entry.model.by_name.get(d[1], []) if fi.cls == info.cls]
+        if len(d) == 1:
+            tgt = entry.imports.get(d[0])
+            if tgt is not None:
+                return self.pm._lookup(*tgt)
+            return [(entry, fi) for fi in entry.model.by_name.get(d[0], [])]
+        head = entry.module_aliases.get(d[0])
+        if head is not None and len(d) == 2:
+            return self.pm._lookup(head, d[1])
+        return []
+
+    def _add_root(self, kind: str, entry, funcs: Sequence[_FuncInfo], label: str,
+                  self_concurrent: bool = False) -> None:
+        key = (kind, label, frozenset(id(fi) for fi in funcs))
+        if key in self._root_keys:
+            return  # the module-scope scan re-visits calls inside function bodies
+        self._root_keys.add(key)
+        idx = len(self.roots)
+        self.roots.append(_Root(kind, label, self_concurrent))
+        for fi in funcs:
+            self._root_entries.add(id(fi))
+            self.roots_of.setdefault(id(fi), set()).add(idx)
+            self._meet_entry(fi, frozenset())
+
+    # -------------------------------------------------------- reachability + locksets
+    def _meet_entry(self, info: _FuncInfo, lockset: FrozenSet[str]) -> bool:
+        have = self.entry_lockset.get(id(info))
+        new = lockset if have is None else (have & lockset)
+        if new != have:
+            self.entry_lockset[id(info)] = new
+            return True
+        return False
+
+    def _seed_and_propagate(self) -> None:
+        main = 0
+        for entry in self.pm.entries:
+            for info in entry.model.functions:
+                if id(info) in self._root_entries:
+                    continue
+                public = not info.name.startswith("_") or info.name in (
+                    "__init__", "__call__", "__enter__", "__exit__", "__len__",
+                )
+                if public:
+                    self.roots_of.setdefault(id(info), set()).add(main)
+                    self._meet_entry(info, frozenset())
+        # fixpoint: roots and entry locksets flow along resolved call edges
+        for _ in range(64):
+            if not self._sweep():
+                break
+
+    def _call_edges(self, entry, info: _FuncInfo) -> List[Tuple[List, FrozenSet[str]]]:
+        """Resolved call edges of one function with their call-site-local locksets.
+
+        Cached: the walk and the resolution are sweep-invariant. The cached lockset is
+        computed from an EMPTY base; the sweep unions the (shrinking) entry lockset
+        back in, which matches the walker exactly except for the degenerate case of a
+        function releasing a lock it never acquired — there the union over-approximates
+        and the meet stays conservative-by-locks, never inventing a new race.
+        """
+        edges = self._edges.get(id(info))
+        if edges is None:
+            edges = []
+            for node, lockset in self._walk_locked(entry, info, frozenset()):
+                if not isinstance(node, ast.Call):
+                    continue
+                targets = [
+                    tinfo for _te, tinfo in self._resolve_call(entry, info, node)
+                    if tinfo is not info
+                ]
+                if targets:
+                    edges.append((targets, lockset))
+            self._edges[id(info)] = edges
+        return edges
+
+    def _sweep(self) -> bool:
+        changed = False
+        for entry in self.pm.entries:
+            for info in entry.model.functions:
+                roots = self.roots_of.get(id(info))
+                if not roots:
+                    continue
+                base = self.entry_lockset.get(id(info), frozenset())
+                for targets, local in self._call_edges(entry, info):
+                    lockset = base | local
+                    for tinfo in targets:
+                        have = self.roots_of.setdefault(id(tinfo), set())
+                        if not roots <= have:
+                            have |= roots
+                            changed = True
+                        if self._meet_entry(tinfo, lockset):
+                            changed = True
+        return changed
+
+    def _resolve_call(self, entry, info: _FuncInfo, call: ast.Call) -> List[Tuple]:
+        targets = self.pm.resolve_call(entry, info, call)
+        if targets:
+            return targets
+        fn = call.func
+        d = _dotted(fn)
+        if isinstance(fn, ast.Name):
+            # closure / cross-class same-module fallback (resolve_call's class filter
+            # hides nested defs like a handler calling its server's local helper)
+            return [(entry, fi) for fi in entry.model.by_name.get(fn.id, [])]
+        if d is None:
+            return []
+        name = d[-1]
+        # module-level bound methods (``flightrec.record`` == ``recorder.record``) and
+        # module-level instances (``ring.push`` -> TraceRing.push)
+        if len(d) >= 2:
+            head_entry, sym = entry, d[0]
+            alias = entry.module_aliases.get(d[0])
+            if alias is not None and len(d) == 2:
+                tentry = self.pm.by_module.get(alias)
+                if tentry is not None:
+                    bm = self._bound_methods.get((tentry.path, d[1]))
+                    if bm is not None:
+                        cls, meth = bm
+                        return [(tentry, fi) for fi in tentry.model.by_name.get(meth, []) if fi.cls == cls]
+            if len(d) == 3 and alias is not None:
+                tentry = self.pm.by_module.get(alias)
+                if tentry is not None and (tentry.path, d[1]) in self._instance_of:
+                    cls = self._instance_of[(tentry.path, d[1])]
+                    return [(tentry, fi) for fi in tentry.model.by_name.get(name, []) if fi.cls == cls]
+            inst = self._instance_of.get((head_entry.path, sym))
+            if inst is not None and len(d) == 2:
+                return [(entry, fi) for fi in entry.model.by_name.get(name, []) if fi.cls == inst]
+            imp = entry.imports.get(sym)
+            if imp is not None and len(d) == 2:
+                tentry = self.pm.by_module.get(imp[0])
+                if tentry is not None:
+                    inst = self._instance_of.get((tentry.path, imp[1]))
+                    if inst is not None:
+                        return [(tentry, fi) for fi in tentry.model.by_name.get(name, []) if fi.cls == inst]
+        # duck-typed same-module fallback: ``fed.poll()`` links to Federator.poll when
+        # federation.py defines exactly that method — conservative, module-scoped
+        cands = [fi for fi in entry.model.by_name.get(name, []) if fi.cls is not None]
+        return [(entry, fi) for fi in cands]
+
+    # The lockset walker: yields (node, frozen lockset) for every node in the body,
+    # tracking ``with lock:`` scopes and acquire()/release() spans, skipping nested
+    # function/class scopes (they are analyzed as their own functions).
+    def _walk_locked(self, entry, info: _FuncInfo, base: FrozenSet[str]
+                     ) -> Iterator[Tuple[ast.AST, FrozenSet[str]]]:
+        body = getattr(info.node, "body", [])
+        yield from self._walk_stmts(entry, info, body, set(base))
+
+    def _acq_rel_key(self, entry, info, stmt: ast.AST, which: str) -> Optional[str]:
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+            return None
+        fn = stmt.value.func
+        if _final_name(fn) != which or not isinstance(fn, ast.Attribute):
+            return None
+        return self._lock_key(entry, info, fn.value)
+
+    def _walk_stmts(self, entry, info, body: Sequence[ast.stmt], held: Set[str]
+                    ) -> Iterator[Tuple[ast.AST, FrozenSet[str]]]:
+        for stmt in body:
+            ak = self._acq_rel_key(entry, info, stmt, "acquire")
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in stmt.items:
+                    yield from self._walk_expr(item.context_expr, held)
+                    k = self._lock_key(entry, info, item.context_expr)
+                    if k:
+                        inner.add(k)
+                yield (stmt, frozenset(held))
+                yield from self._walk_stmts(entry, info, stmt.body, inner)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                yield (stmt, frozenset(held))
+                yield from self._walk_expr(stmt.test, held)
+                yield from self._walk_stmts(entry, info, stmt.body, set(held))
+                yield from self._walk_stmts(entry, info, stmt.orelse, set(held))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield (stmt, frozenset(held))
+                yield from self._walk_expr(stmt.iter, held)
+                yield from self._walk_expr(stmt.target, held)
+                yield from self._walk_stmts(entry, info, stmt.body, set(held))
+                yield from self._walk_stmts(entry, info, stmt.orelse, set(held))
+            elif isinstance(stmt, ast.Try):
+                yield (stmt, frozenset(held))
+                yield from self._walk_stmts(entry, info, stmt.body, set(held))
+                for h in stmt.handlers:
+                    yield from self._walk_stmts(entry, info, h.body, set(held))
+                yield from self._walk_stmts(entry, info, stmt.orelse, set(held))
+                yield from self._walk_stmts(entry, info, stmt.finalbody, set(held))
+            else:
+                yield (stmt, frozenset(held))
+                for sub in ast.walk(stmt):
+                    if sub is not stmt and not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+                    ):
+                        yield (sub, frozenset(held))
+            if ak:
+                held.add(ak)
+            rk = self._acq_rel_key(entry, info, stmt, "release")
+            if rk:
+                held.discard(rk)
+
+    def _walk_expr(self, expr: ast.AST, held: Set[str]
+                   ) -> Iterator[Tuple[ast.AST, FrozenSet[str]]]:
+        fs = frozenset(held)
+        for sub in ast.walk(expr):
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                yield (sub, fs)
+
+    # -------------------------------------------------------------- access collection
+    def root_labels(self, idxs: Set[int]) -> str:
+        return " + ".join(sorted(self.roots[i].label for i in idxs))
+
+    def collect_accesses(self) -> List[_Access]:
+        out: List[_Access] = []
+        for entry in self.pm.entries:
+            globals_ = self._module_globals.get(entry.path, set())
+            for info in entry.model.functions:
+                roots = self.roots_of.get(id(info))
+                if not roots:
+                    continue
+                ctor = info.cls is not None and info.name in ("__init__", "__new__", "__post_init__")
+                base = self.entry_lockset.get(id(info), frozenset())
+                func_globals = {
+                    n for node in _scoped_walk(info.node) if isinstance(node, ast.Global)
+                    for n in node.names
+                }
+                test_spans = self._test_spans(info)
+                for node, lockset in self._walk_locked(entry, info, base):
+                    acc = self._classify(entry, info, node, lockset, globals_, func_globals, ctor)
+                    if acc is None:
+                        continue
+                    acc.in_test = any(lo <= getattr(node, "lineno", 0) <= hi and c0 <= getattr(node, "col_offset", -1)
+                                      for lo, hi, c0 in test_spans) if acc.kind == "read" else False
+                    out.append(acc)
+        return out
+
+    @staticmethod
+    def _test_spans(info: _FuncInfo) -> List[Tuple[int, int, int]]:
+        spans = []
+        for node in _scoped_walk(info.node):
+            if isinstance(node, (ast.If, ast.While)):
+                t = node.test
+                spans.append((t.lineno, getattr(t, "end_lineno", t.lineno), 0))
+        return spans
+
+    def _sanction(self, entry, node: ast.AST) -> Optional[str]:
+        line = getattr(node, "lineno", 0)
+        src = entry.lines[line - 1] if 0 < line <= len(entry.lines) else ""
+        m = _SINGLE_MUTATOR_RE.search(src)
+        return "single-mutator" if m else None
+
+    def _field_of(self, entry, info: _FuncInfo, expr: ast.AST,
+                  globals_: Set[str], func_globals: Set[str]) -> Optional[Tuple[str, str, str]]:
+        """Owning field of an attribute/name expression, or None when unattributable."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        if d[0] == "self" and len(d) >= 2 and info.cls is not None:
+            if (entry.path, info.cls) not in self._shared_classes:
+                return None  # instances never escape one thread (no shared anchor)
+            return (entry.path, info.cls, d[1])
+        if len(d) == 1:
+            name = d[0]
+            if name in func_globals or (name in globals_ and info.cls is None and info.parent is None):
+                if name in self._module_locks.get(entry.path, ()):
+                    return None
+                return (entry.path, "<module>", name)
+        return None
+
+    def _classify(self, entry, info, node, lockset, globals_, func_globals, ctor) -> Optional[_Access]:
+        # -- writes -------------------------------------------------------------
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                field = self._field_of(entry, info, base, globals_, func_globals)
+                if field is None:
+                    continue
+                if ctor and field[1] == info.cls:
+                    return None  # __init__-time store: the object has not escaped yet
+                if field[2] in self._class_locks.get((entry.path, info.cls or ""), ()):
+                    return None
+                return _Access(field, "write", entry.path, node, lockset, info,
+                               sanction=self._sanction(entry, node))
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            mname = node.func.attr
+            if mname in _MUTATORS:
+                field = self._field_of(entry, info, node.func.value, globals_, func_globals)
+                if field is not None and not (ctor and field[1] == info.cls):
+                    return _Access(field, "write", entry.path, node, lockset, info,
+                                   atomic=mname in _ATOMIC_MUTATORS,
+                                   sanction=self._sanction(entry, node))
+            elif mname in _ITER_READS:
+                field = self._field_of(entry, info, node.func.value, globals_, func_globals)
+                if field is not None:
+                    return _Access(field, "read", entry.path, node, lockset, info,
+                                   sanction=self._sanction(entry, node))
+        # -- reads (attribute loads only; filtered down to tests/iterations later) --
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            field = self._field_of(entry, info, node, globals_, func_globals)
+            if field is not None:
+                return _Access(field, "read", entry.path, node, lockset, info,
+                               sanction=self._sanction(entry, node))
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            field = self._field_of(entry, info, node.iter, globals_, func_globals)
+            if field is not None:
+                acc = _Access(field, "read", entry.path, node.iter, lockset, info,
+                              sanction=self._sanction(entry, node))
+                acc.in_test = True  # iterating the raw field is a multi-step read
+                return acc
+        return None
+
+
+# ===================================================================== rule drivers
+def _lines_of(pm, path: str) -> Sequence[str]:
+    for e in pm.entries:
+        if e.path == path:
+            return e.lines
+    return []
+
+
+def _lock_names(lockset: FrozenSet[str]) -> str:
+    if not lockset:
+        return "no lock"
+    return " + ".join(sorted(k.rsplit("::", 1)[-1] for k in lockset))
+
+
+def _rule_tpu021(cm: ConcurrencyModel) -> List[Finding]:
+    by_field: Dict[Tuple[str, str, str], List[_Access]] = {}
+    for acc in cm._accesses:
+        if acc.kind == "write":
+            by_field.setdefault(acc.field, []).append(acc)
+    out: List[Finding] = []
+    for field, writes in sorted(by_field.items()):
+        if any(w.sanction for w in writes):
+            continue  # a declared single-mutator field is sanctioned at every site
+        best: Optional[Tuple[_Access, _Access]] = None
+        for i, a in enumerate(writes):
+            if a.atomic:
+                continue
+            ra = cm.roots_of.get(id(a.func), set())
+            for b in writes[i:]:
+                rb = cm.roots_of.get(id(b.func), set())
+                if a.lockset & b.lockset:
+                    continue
+                pair_ok = any(
+                    cm.roots[x].concurrent_with(cm.roots[y])
+                    for x in ra for y in rb
+                )
+                if not pair_ok:
+                    continue
+                if b.atomic and b is not a:
+                    continue
+                cand = (a, b) if (len(a.lockset), a.node.lineno) <= (len(b.lockset), b.node.lineno) else (b, a)
+                if best is None or (cand[0].path, cand[0].node.lineno) < (best[0].path, best[0].node.lineno):
+                    best = cand
+        if best is None:
+            continue
+        a, b = best
+        ra = cm.roots_of.get(id(a.func), set())
+        rb = cm.roots_of.get(id(b.func), set())
+        other = "" if a.node is b.node else (
+            f"; also written at {b.path}:{b.node.lineno} under {_lock_names(b.lockset)}"
+            f" from {cm.root_labels(rb)}"
+        )
+        out.append(_finding(
+            "TPU021", a.path, a.node, _lines_of(cm.pm, a.path),
+            f"shared field {field[1]}.{field[2]!s} written under {_lock_names(a.lockset)}"
+            f" from {cm.root_labels(ra)}{other} — concurrent writers with disjoint"
+            " locksets lose updates. Guard both sites with one lock, or declare the"
+            " protocol with '# jaxlint: single-mutator (racerun: <scenario>)' backed by"
+            " a passing schedule (make jaxlint-race)",
+        ))
+    return out
+
+
+def _rule_tpu023(cm: ConcurrencyModel) -> List[Finding]:
+    writes: Dict[Tuple[str, str, str], List[_Access]] = {}
+    for acc in cm._accesses:
+        if acc.kind == "write":
+            writes.setdefault(acc.field, []).append(acc)
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int, Tuple[str, str, str]]] = set()
+    for acc in cm._accesses:
+        if acc.kind != "read" or not acc.in_test or acc.sanction:
+            continue
+        ws = writes.get(acc.field)
+        if not ws or any(w.sanction for w in ws):
+            continue
+        guard = None
+        for w in ws:
+            guard = w.lockset if guard is None else (guard & w.lockset)
+        if not guard or acc.lockset & guard:
+            continue  # writes unguarded (TPU021's domain) or the read holds the guard
+        ra = cm.roots_of.get(id(acc.func), set())
+        conc = [
+            w for w in ws
+            if any(cm.roots[x].concurrent_with(cm.roots[y])
+                   for x in ra for y in cm.roots_of.get(id(w.func), set()))
+        ]
+        if not conc:
+            continue
+        key = (acc.path, acc.node.lineno, acc.field)
+        if key in seen:
+            continue
+        seen.add(key)
+        w = conc[0]
+        shape = "check-then-act on" if isinstance(acc.node, ast.Attribute) else "multi-step read of"
+        out.append(_finding(
+            "TPU023", acc.path, acc.node, _lines_of(cm.pm, acc.path),
+            f"{shape} shared field {acc.field[1]}.{acc.field[2]} outside its guarding"
+            f" lock ({_lock_names(guard)}) — a concurrent writer"
+            f" ({cm.root_labels(cm.roots_of.get(id(w.func), set()))},"
+            f" {w.path}:{w.node.lineno}) can move the field between the read and the"
+            " action taken on it. Take the guard for the whole check-then-act region",
+        ))
+    return out
+
+
+def _rule_tpu022(cm: ConcurrencyModel) -> List[Finding]:
+    out: List[Finding] = []
+    for entry in cm.pm.entries:
+        serve_classes: Set[str] = set()
+        for info in entry.model.functions:
+            if info.cls is None:
+                continue
+            for node in _scoped_walk(info.node):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if d and d[:2] == ["self", "_serve"] and len(d) == 2:
+                            serve_classes.add(info.cls)
+        for cls in sorted(serve_classes):
+            methods = {fi.name: fi for fi in entry.model.functions if fi.cls == cls and fi.parent is None}
+            ctor_reach = _class_closure(methods, {"__init__", "__new__"})
+            for name, info in sorted(methods.items()):
+                if name.startswith("_") or name in ctor_reach:
+                    continue
+                if not _touches_tensor_state(info):
+                    continue
+                if _quiesces(info, methods, set()):
+                    continue
+                out.append(_finding(
+                    "TPU022", entry.path, info.node, entry.lines,
+                    f"public host-access entry point {cls}.{name} touches tensor state"
+                    " without routing through the quiesce seam — with an IngestEngine"
+                    " attached (update_async/serve()), this observes a mid-window"
+                    " state the drain is still mutating. Quiesce first"
+                    " (docs/serving.md 'Host access & the quiesce contract')",
+                ))
+    return out
+
+
+def _class_closure(methods: Dict[str, _FuncInfo], seeds: Set[str]) -> Set[str]:
+    """Names of methods reachable from ``seeds`` via ``self.m()`` calls."""
+    reach = set(s for s in seeds if s in methods)
+    work = list(reach)
+    while work:
+        info = methods.get(work.pop())
+        if info is None:
+            continue
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and len(d) == 2 and d[0] == "self" and d[1] in methods and d[1] not in reach:
+                    reach.add(d[1])
+                    work.append(d[1])
+    return reach
+
+
+def _touches_tensor_state(info: _FuncInfo) -> bool:
+    for node in _scoped_walk(info.node):
+        d = _dotted(node) if isinstance(node, ast.Attribute) else None
+        if d and len(d) >= 3 and d[0] == "self" and d[1] == "_state" and d[2] in _STATE_TENSOR_ATTRS:
+            return True
+    return False
+
+
+def _quiesces(info: _FuncInfo, methods: Dict[str, _FuncInfo], seen: Set[str]) -> bool:
+    if info.name in seen:
+        return False
+    seen.add(info.name)
+    for node in _scoped_walk(info.node):
+        if isinstance(node, ast.Call):
+            if _final_name(node.func) == "quiesce":
+                return True
+            d = _dotted(node.func)
+            if d and len(d) == 2 and d[0] == "self" and d[1] in methods:
+                if _quiesces(methods[d[1]], methods, seen):
+                    return True
+    return False
+
+
+def run_concurrency_rules(pm) -> List[Finding]:
+    """Run TPU021/TPU022/TPU023 over a built ProjectModel (whole-program pass only).
+
+    Computed fresh on every tree-cache miss — the per-module incremental cache never
+    stores these findings (they depend on every module at once), and the tree-level
+    cache key plus ``analyzer_fingerprint()`` (which hashes this file) keep cached
+    results sound.
+    """
+    cm = ConcurrencyModel(pm)
+    cm._accesses = cm.collect_accesses()
+    findings = _rule_tpu021(cm) + _rule_tpu022(cm) + _rule_tpu023(cm)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def suppression_scenarios(pm) -> List[Dict[str, str]]:
+    """Every ``single-mutator`` / ``disable=TPU021`` marker with its racerun scenario.
+
+    The suppression contract (docs/static-analysis.md): a concurrency sanction must
+    name the deterministic schedule that justifies it —
+    ``# jaxlint: single-mutator (racerun: engine_enqueue_vs_quiesce)``. The test suite
+    asserts every named scenario exists in :mod:`torchmetrics_tpu._lint.racerun` and
+    passes.
+    """
+    import io
+    import tokenize
+
+    rows: List[Dict[str, str]] = []
+    for entry in pm.entries:
+        # tokenize so only REAL comments count — this module's own docstring spells
+        # out the marker syntax and must not read as a shipped suppression
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(entry.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            continue
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            src, lineno = tok.string, tok.start[0]
+            m = _SINGLE_MUTATOR_RE.search(src)
+            if m:
+                rows.append({
+                    "path": entry.path, "line": str(lineno), "kind": "single-mutator",
+                    "scenario": m.group("scenario") or "",
+                })
+            if re.search(r"#\s*jaxlint:\s*disable=[A-Z0-9, ]*TPU021", src):
+                sm = re.search(r"racerun:\s*([\w.-]+)", src)
+                rows.append({
+                    "path": entry.path, "line": str(lineno), "kind": "disable",
+                    "scenario": sm.group(1) if sm else "",
+                })
+    return rows

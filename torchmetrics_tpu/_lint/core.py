@@ -235,6 +235,19 @@ def analyze_sources(
                     [f.to_dict() for f in module_findings],
                 )
             findings.extend(module_findings)
+        # Whole-program concurrency pass (TPU021-TPU023): depends on every module at
+        # once (thread roots in one file reach shared fields in another), so it is
+        # recomputed on every tree-cache miss and NEVER stored in the per-module cache
+        # — the tree-level entry above covers the all-files-unchanged fast path.
+        from torchmetrics_tpu._lint.concurrency import run_concurrency_rules
+
+        lines_by_path = {e.path: e.lines for e in pm.entries}
+        conc = run_concurrency_rules(pm)
+        by_path: Dict[str, List[Finding]] = {}
+        for f in conc:
+            by_path.setdefault(f.path, []).append(f)
+        for cpath, group in by_path.items():
+            findings.extend(_filter_findings(group, lines_by_path.get(cpath, []), select))
     else:
         for path, src in sources:
             findings.extend(analyze_source(src, path=path, select=select))

@@ -1,0 +1,650 @@
+"""racerun: deterministic-schedule race sanitizer for the tmrace concurrency rules.
+
+The static half (:mod:`torchmetrics_tpu._lint.concurrency`) proves where concurrent
+roots *can* collide; this module proves what actually happens there. It installs
+preemption points — via ``threading.settrace`` line tracing — at the shared-access
+sites the static pass identified, then drives small harness programs through SEEDED
+interleaving permutations: one thread runs at a time, every park/grant decision comes
+from a ``random.Random(seed)``, and the same seed replays the same schedule. That
+closes the TPU021 contract loop:
+
+- a *finding* is reproduced into a failing schedule (the synthetic lost-update fixture
+  below fails deterministically at line granularity — the read and the write of the
+  unlocked counter sit on separate lines, so a forced switch between them loses an
+  update), and
+- a *suppression* (``# jaxlint: single-mutator (racerun: <scenario>)``) carries a named
+  scenario in :data:`SCENARIOS` that survives every explored interleaving of the REAL
+  shipped code — engine enqueue-vs-quiesce, federation poll-vs-shutdown, flight-ring
+  append-vs-snapshot, health-ledger evict-vs-probe (``make jaxlint-race``).
+
+How the scheduler stays deterministic: every harness body parks at a start barrier
+before its first statement, so the initial parked set is fixed; after that exactly one
+thread holds a grant, runs to its next watched line, and parks again — the rng only
+ever chooses among a deterministic set. Two caveats, both deliberate: (1) a granted
+thread that blocks on a REAL lock held by a parked thread is detected by timeout and
+the scheduler moves on (the blocked thread finishes its region once the holder is
+granted — so lock-correct code may briefly overlap, which is exactly the situation
+locks make safe); (2) threads the harness code spawns itself (the engine's drain) join
+the schedule at their first watched line, so their arrival slot can vary — scenarios
+over such code assert INVARIANTS over every schedule rather than trace equality, while
+the fixed-body synthetic fixture is bit-deterministic and the unit tests pin that.
+
+Python ≥3.12 would allow per-opcode tracing (``frame.f_trace_opcodes``) to split even
+one-line ``x += 1`` races; line granularity plus the two-line fixture idiom covers the
+same ground on every interpreter this repo supports.
+
+Nothing here imports jax at module scope — scenarios lazy-import the subsystems they
+drive, so ``python -m torchmetrics_tpu._lint.racerun --list`` works on a lint-only box.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+#: cumulative per-process sanitizer counters, exported by ``obs.bench_extras()``
+LAST_RACE_STATS: Dict[str, int] = {"race_schedules_run": 0, "race_findings": 0}
+
+#: how long a granted thread may fail to re-park before the scheduler assumes it is
+#: blocked on a real primitive and moves on (wall-clock; only blocking pays it)
+_BLOCKED_TIMEOUT_S = 0.12
+#: hard cap on grants per schedule — a runaway harness ends, it does not hang CI
+_DEFAULT_SWITCH_BUDGET = 800
+
+
+class Watch:
+    """One preemption-point spec: a file suffix, optionally narrowed to funcs/lines."""
+
+    __slots__ = ("file_suffix", "funcs", "lines")
+
+    def __init__(self, file_suffix: str, funcs: Optional[FrozenSet[str]] = None,
+                 lines: Optional[FrozenSet[int]] = None) -> None:
+        self.file_suffix = file_suffix
+        self.funcs = funcs
+        self.lines = lines
+
+    def matches_file(self, filename: str) -> bool:
+        return filename.endswith(self.file_suffix)
+
+    def matches(self, filename: str, func: str, lineno: int) -> bool:
+        if not filename.endswith(self.file_suffix):
+            return False
+        if self.funcs is not None and func not in self.funcs:
+            return False
+        if self.lines is not None and lineno not in self.lines:
+            return False
+        return True
+
+
+class ScheduleResult:
+    """Outcome of one explored schedule."""
+
+    __slots__ = ("seed", "trace", "error", "switches")
+
+    def __init__(self, seed: int, trace: List[str], error: Optional[str], switches: int) -> None:
+        self.seed = seed
+        self.trace = trace
+        self.error = error
+        self.switches = switches
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
+
+
+class _Gate:
+    __slots__ = ("event",)
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+
+
+class ScheduleRunner:
+    """Run one seeded interleaving of ``bodies`` with parks at watched lines."""
+
+    def __init__(self, watch: Sequence[Watch], seed: int,
+                 switch_budget: int = _DEFAULT_SWITCH_BUDGET) -> None:
+        self.watch = list(watch)
+        self.rng = random.Random(seed)
+        self.switch_budget = switch_budget
+        self.trace: List[str] = []
+        self.switches = 0
+        self._arrival = threading.Condition()
+        self._gates: Dict[str, _Gate] = {}
+        self._parked: Dict[str, str] = {}  # name -> "file:line" it parked at
+        self._finished: set = set()
+        self._body_names: List[str] = []
+        self._errors: List[str] = []
+        self._free_run = False
+        self._scheduler_ident = threading.get_ident()
+
+    # ------------------------------------------------------------- trace machinery
+    def _tracefunc(self, frame, event, arg):
+        if event != "call":
+            return None
+        fn = frame.f_code.co_filename
+        for w in self.watch:
+            if w.matches_file(fn):
+                return self._linetrace
+        return None
+
+    def _linetrace(self, frame, event, arg):
+        if event == "line" and not self._free_run:
+            code = frame.f_code
+            for w in self.watch:
+                if w.matches(code.co_filename, code.co_name, frame.f_lineno):
+                    self._park(f"{code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno}")
+                    break
+        return self._linetrace
+
+    def _thread_name(self) -> str:
+        return threading.current_thread().name
+
+    def _park(self, where: str) -> None:
+        if threading.get_ident() == self._scheduler_ident or self._free_run:
+            return
+        name = self._thread_name()
+        gate = self._gates.get(name)
+        if gate is None:
+            with self._arrival:
+                gate = self._gates.setdefault(name, _Gate())
+        with self._arrival:
+            self._parked[name] = where
+            self._arrival.notify_all()
+        gate.event.wait()
+        gate.event.clear()
+
+    def _wrap(self, name: str, fn: Callable[[], None]) -> Callable[[], None]:
+        def body() -> None:
+            try:
+                self._park("<start>")  # start barrier: deterministic initial set
+                fn()
+            except Exception as err:  # noqa: BLE001 - surfaced as a schedule failure
+                self._errors.append(f"{name}: {err!r}")
+            finally:
+                with self._arrival:
+                    self._finished.add(name)
+                    self._parked.pop(name, None)
+                    self._arrival.notify_all()
+        return body
+
+    # ----------------------------------------------------------------- scheduling
+    def run(self, bodies: Sequence[Tuple[str, Callable[[], None]]],
+            join_timeout: float = 20.0) -> None:
+        self._body_names = [name for name, _ in bodies]
+        threads = [
+            threading.Thread(target=self._wrap(name, fn), name=name, daemon=True)
+            for name, fn in bodies
+        ]
+        old_trace = threading._trace_hook  # noqa: SLF001 - save to restore exactly
+        threading.settrace(self._tracefunc)
+        try:
+            for t in threads:
+                t.start()
+            self._schedule_loop()
+        finally:
+            threading.settrace(old_trace)
+            with self._arrival:
+                self._free_run = True  # stragglers (spawned threads) run free now
+                for gate in self._gates.values():
+                    gate.event.set()
+            for t in threads:
+                t.join(timeout=join_timeout)
+                if t.is_alive():
+                    self._errors.append(f"{t.name}: did not finish (possible deadlock)")
+
+    def _live_bodies(self) -> List[str]:
+        return [n for n in self._body_names if n not in self._finished]
+
+    def _schedule_loop(self) -> None:
+        granted: Optional[str] = None
+        while True:
+            with self._arrival:
+                # wait until the granted thread re-parks/finishes, or — before any
+                # grant — until every body has reached the start barrier
+                deadline = time.monotonic() + _BLOCKED_TIMEOUT_S
+                while True:
+                    live = self._live_bodies()
+                    if not live:
+                        return
+                    if granted is None:
+                        waiting_for = [n for n in live if n not in self._parked]
+                    else:
+                        waiting_for = [granted] if (
+                            granted not in self._parked and granted not in self._finished
+                        ) else []
+                    if not waiting_for:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break  # blocked on a real primitive: move on
+                    self._arrival.wait(remaining)
+                live = self._live_bodies()
+                if not live:
+                    return
+                choices = sorted(self._parked)
+                if not choices:
+                    continue  # everyone is running free or blocked; wait again
+                pick = choices[0] if len(choices) == 1 else self.rng.choice(choices)
+                where = self._parked.pop(pick)
+                self.trace.append(f"{pick}@{where}")
+                granted = pick
+                gate = self._gates[pick]
+            gate.event.set()
+            self.switches += 1
+            if self.switches >= self.switch_budget:
+                return
+
+
+def run_schedule(
+    build: Callable[[], Tuple[Sequence[Tuple[str, Callable[[], None]]], Callable[[], None]]],
+    watch: Sequence[Watch],
+    seed: int,
+    switch_budget: int = _DEFAULT_SWITCH_BUDGET,
+) -> ScheduleResult:
+    """Run ONE seeded interleaving: fresh state from ``build()``, then the check."""
+    bodies, check = build()
+    runner = ScheduleRunner(watch, seed=seed, switch_budget=switch_budget)
+    runner.run(bodies)
+    error: Optional[str] = "; ".join(runner._errors) or None
+    if error is None:
+        try:
+            check()
+        except Exception as err:  # noqa: BLE001 - invariant violation == race found
+            error = f"check: {err!r}"
+    return ScheduleResult(seed=seed, trace=runner.trace, error=error, switches=runner.switches)
+
+
+def explore(
+    build: Callable[[], Tuple[Sequence[Tuple[str, Callable[[], None]]], Callable[[], None]]],
+    watch: Sequence[Watch],
+    seed: int = 0,
+    schedules: int = 10,
+    switch_budget: int = _DEFAULT_SWITCH_BUDGET,
+) -> Dict[str, Any]:
+    """Explore ``schedules`` seeded interleavings; returns a summary dict.
+
+    Schedule k runs with seed ``seed * 10_000 + k`` — derived, not sequential, so two
+    scenarios sharing a base seed still explore different permutations. The result's
+    ``failures`` lists ``(schedule_seed, error, trace)`` for every failing schedule;
+    determinism means re-running with the same base seed reproduces the same list.
+    """
+    failures: List[Dict[str, Any]] = []
+    run = 0
+    for k in range(schedules):
+        res = run_schedule(build, watch, seed=seed * 10_000 + k, switch_budget=switch_budget)
+        run += 1
+        if res.failed:
+            failures.append({
+                "seed": res.seed,
+                "error": res.error,
+                "trace": res.trace[-24:],  # the decisive suffix; full trace is huge
+            })
+    LAST_RACE_STATS["race_schedules_run"] += run
+    LAST_RACE_STATS["race_findings"] += len(failures)
+    return {"schedules_run": run, "failures": failures, "passed": not failures}
+
+
+# ------------------------------------------------------------------ synthetic fixture
+class RacyCounter:
+    """The canonical TPU021 lost update, with the read/write split across lines so the
+    line-granularity scheduler can preempt between them (see the module docstring)."""
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self) -> None:
+        v = self.value
+        self.value = v + 1
+
+
+class LockedCounter:
+    """The fixed counterpart: the same read-modify-write under a lock."""
+
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self) -> None:
+        with self._lock:
+            v = self.value
+            self.value = v + 1
+
+
+def lost_update_fixture(locked: bool, increments: int = 3,
+                        threads: int = 2) -> Callable[[], Tuple[list, Callable[[], None]]]:
+    """Harness builder for the synthetic fixture (used by tests and ``--scenario demo``)."""
+    def build():
+        counter = LockedCounter() if locked else RacyCounter()
+
+        def worker():
+            for _ in range(increments):
+                counter.inc()
+
+        def check():
+            expect = increments * threads
+            assert counter.value == expect, (
+                f"lost update: counted {counter.value}, expected {expect}"
+            )
+        return [(f"T{i}", worker) for i in range(threads)], check
+    return build
+
+
+_FIXTURE_WATCH = (Watch("_lint/racerun.py", funcs=frozenset({"inc"})),)
+
+
+# ------------------------------------------------------- static-pass preemption sites
+_shared_lines_cache: Optional[Dict[str, FrozenSet[int]]] = None
+
+
+def shared_access_lines() -> Dict[str, FrozenSet[int]]:
+    """Preemption sites from the static pass: display path -> shared-access linenos.
+
+    This is the tmrace tie-in the scenarios run on: the scheduler only parks where the
+    concurrency analysis says a shared field is touched, which keeps a schedule to a
+    handful of decisive switch points instead of every line of the engine. Computed
+    once per process (one ProjectModel build over the installed tree).
+    """
+    global _shared_lines_cache
+    if _shared_lines_cache is not None:
+        return _shared_lines_cache
+    from pathlib import Path
+
+    import torchmetrics_tpu
+    from torchmetrics_tpu._lint.concurrency import ConcurrencyModel
+    from torchmetrics_tpu._lint.core import iter_python_files
+    from torchmetrics_tpu._lint.project import ProjectModel
+
+    root = Path(torchmetrics_tpu.__file__).resolve().parent
+    sources = []
+    for fp, display in iter_python_files([root]):
+        try:
+            sources.append((display, fp.read_text(encoding="utf-8")))
+        except (OSError, UnicodeDecodeError):
+            continue
+    pm = ProjectModel(sources)
+    cm = ConcurrencyModel(pm)
+    lines: Dict[str, set] = {}
+    for acc in cm.collect_accesses():
+        lines.setdefault(acc.path, set()).add(acc.node.lineno)
+    _shared_lines_cache = {p: frozenset(ls) for p, ls in lines.items()}
+    return _shared_lines_cache
+
+
+def _watch_for(path_suffix: str, funcs: Optional[FrozenSet[str]] = None) -> Watch:
+    """Watch a shipped file at its static-pass shared-access lines (fall back to all
+    lines of ``funcs`` when the analysis finds none — e.g. a freshly-sanctioned file)."""
+    for display, lines in shared_access_lines().items():
+        if display.endswith(path_suffix):
+            return Watch(path_suffix, funcs=None, lines=lines)
+    return Watch(path_suffix, funcs=funcs)
+
+
+# ------------------------------------------------------------------ shipped scenarios
+def scenario_engine_enqueue_vs_quiesce(seed: int = 0, schedules: int = 3) -> Dict[str, Any]:
+    """Producer enqueues against the real drain while a second thread quiesces.
+
+    Backs the ``single-mutator`` sanction on ``IngestEngine._fence``: the drain is the
+    sole fence writer while the window is non-empty, and quiesce only clears it after
+    proving the window empty under ``_cond`` — so every interleaving must end with
+    zero fence breaks and exact stats accounting.
+    """
+    from torchmetrics_tpu.serve.engine import IngestEngine
+    from torchmetrics_tpu.serve.options import ServeOptions
+
+    class _Store:
+        def __init__(self) -> None:
+            self.generation = 0
+
+    class _Target:
+        def __init__(self) -> None:
+            self._state = _Store()
+            self.applied = 0
+
+        def update(self, x):
+            self.applied += 1
+            self._state.generation += 1
+
+    def build():
+        target = _Target()
+        eng = IngestEngine(target, ServeOptions(max_inflight=8, coalesce=1,
+                                                queue_timeout_s=10.0))
+
+        def producer():
+            for i in range(3):
+                eng.enqueue((i,), {})
+
+        def quiescer():
+            eng.quiesce(timeout=10.0)
+
+        def check():
+            try:
+                eng.quiesce(timeout=10.0)
+                st = eng.stats()
+                assert st["fence_breaks"] == 0, f"fence broke: {st}"
+                assert st["committed"] == st["enqueued"] == 3, f"lost batches: {st}"
+                assert target.applied == 3, f"applied {target.applied} != 3"
+            finally:
+                eng.close()
+        return [("producer", producer), ("quiescer", quiescer)], check
+
+    watch = [_watch_for("serve/engine.py",
+                        funcs=frozenset({"enqueue", "_admit", "quiesce", "_apply_window"}))]
+    return explore(build, watch, seed=seed, schedules=schedules)
+
+
+def scenario_flight_ring_append_vs_snapshot(seed: int = 0, schedules: int = 6) -> Dict[str, Any]:
+    """Two recorders race a snapshotter on one FlightRecorder ring.
+
+    The PR 15 "snapshot orders by seq" claim, scheduled: under every interleaving the
+    raw ring order must equal sequence order, ``last_seq`` must never regress, and
+    every mid-race snapshot must be internally monotonic (the TPU021 fix locks the seq
+    draw + cursor + append into one region).
+    """
+    from torchmetrics_tpu.obs.flightrec import FlightRecorder
+
+    def build():
+        rec = FlightRecorder(maxlen=32)
+        snaps: List[Dict[str, Any]] = []
+
+        def writer_a():
+            for i in range(4):
+                rec.record("race.a", i=i)
+
+        def writer_b():
+            for i in range(4):
+                rec.record("race.b", i=i)
+
+        def reader():
+            snaps.append(rec.snapshot())
+            snaps.append(rec.snapshot())
+
+        def check():
+            ring = [e["seq"] for e in rec.events()]
+            assert ring == sorted(ring), f"ring order != seq order: {ring}"
+            assert rec.last_seq == ring[-1], (rec.last_seq, ring[-1])
+            final = rec.snapshot()
+            assert final["recorded"] == 8 and final["dropped"] == 0, final
+            for s in snaps:
+                seqs = [e["seq"] for e in s["events"]]
+                assert seqs == sorted(seqs), f"snapshot not monotonic: {seqs}"
+                assert not seqs or s["last_seq"] >= seqs[-1], s["last_seq"]
+        return [("writer-a", writer_a), ("writer-b", writer_b), ("reader", reader)], check
+
+    watch = [_watch_for("obs/flightrec.py", funcs=frozenset({"record", "snapshot"}))]
+    return explore(build, watch, seed=seed, schedules=schedules)
+
+
+def scenario_federation_poll_vs_shutdown(seed: int = 0, schedules: int = 4) -> Dict[str, Any]:
+    """Concurrent pollers race a payload reader and the close path's check-then-act.
+
+    Drives the last-good-parse stale cache: every peer fetch fails, so each poll
+    rewrites ``_state`` entries preserving the stale parse under ``_lock``, while a
+    reader pulls ``payload()``/``render()`` and a closer flips a ``_closed``-style
+    flag — the shapes TPU021/TPU023 police in federation code.
+    """
+    from torchmetrics_tpu.obs.federation import Federator, Peer
+
+    def build():
+        calls = {"n": 0}
+
+        def flaky_fetch(url: str) -> bytes:
+            calls["n"] += 1
+            if calls["n"] % 3 == 0:
+                raise OSError("peer unreachable (scheduled)")
+            return b"# TYPE tm_x counter\ntm_x_total{rank=\"0\"} 1.0\n# EOF\n"
+
+        fed = Federator([Peer("p0", "http://peer-0:9090"),
+                         Peer("p1", "http://peer-1:9090")], fetch_fn=flaky_fetch)
+        closed = {"flag": False}
+
+        def poller():
+            for _ in range(2):
+                if not closed["flag"]:
+                    fed.poll()
+
+        def reader():
+            fed.payload()
+            fed.render()
+
+        def closer():
+            closed["flag"] = True
+
+        def check():
+            summary = fed.poll()
+            assert summary["peers"] == 2, summary
+            payload = fed.payload()
+            assert payload["tier"] == "fleet", payload.get("tier")
+            states = fed.peer_states()
+            assert set(states) <= {"p0", "p1"}, set(states)
+            # the stale-beats-blind contract mid-race: a down peer that ever parsed
+            # cleanly must still carry that parse
+            for st in states.values():
+                if not st["up"] and st["error"] is None:
+                    raise AssertionError(f"down peer lost its error record: {st}")
+        return [("poller-a", poller), ("poller-b", poller), ("reader", reader),
+                ("closer", closer)], check
+
+    watch = [_watch_for("obs/federation.py",
+                        funcs=frozenset({"poll", "payload", "render", "active_incidents"}))]
+    return explore(build, watch, seed=seed, schedules=schedules)
+
+
+def scenario_health_ledger_evict_vs_probe(seed: int = 0, schedules: int = 5) -> Dict[str, Any]:
+    """Failure recorder races the gather-group prober over a fixed rank set.
+
+    The ledger is main-thread-only in the shipped tree (the static pass confirms no
+    concurrent writer), but ROADMAP item 5's per-tier ledgers will change that — this
+    schedule pins the contract they must keep: a fixed rank population never loses a
+    failure record, and eviction/probe partitions stay consistent mid-race.
+    """
+    from torchmetrics_tpu.parallel.sync import HealthLedger
+
+    def build():
+        led = HealthLedger(evict_after=2, probe_backoff_s=0.0)
+        for r in range(4):
+            led.record_success(r)
+
+        def failer():
+            led.record_failure(2)
+            led.record_failure(2)
+            led.record_failure(3)
+
+        def prober():
+            for _ in range(3):
+                led.gather_group(4)
+                led.evicted_ranks()
+
+        def check():
+            assert 2 in led.evicted_ranks(), led.report()
+            group, probes = led.gather_group(4)
+            assert set(group) | set(probes) == {0, 1, 2, 3}, (group, probes)
+            rep = led.report()
+            assert rep[2]["consecutive_failures"] == 2, rep[2]
+            assert rep[3]["total_failures"] == 1, rep[3]
+        return [("failer", failer), ("prober", prober)], check
+
+    watch = [_watch_for("parallel/sync.py",
+                        funcs=frozenset({"record_failure", "record_success",
+                                         "gather_group", "evicted_ranks"}))]
+    return explore(build, watch, seed=seed, schedules=schedules)
+
+
+#: every named scenario a concurrency suppression may cite (the contract checker in
+#: tests/unittests/lint asserts each shipped marker names a key of this dict)
+SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
+    "engine_enqueue_vs_quiesce": scenario_engine_enqueue_vs_quiesce,
+    "flight_ring_append_vs_snapshot": scenario_flight_ring_append_vs_snapshot,
+    "federation_poll_vs_shutdown": scenario_federation_poll_vs_shutdown,
+    "health_ledger_evict_vs_probe": scenario_health_ledger_evict_vs_probe,
+}
+
+
+def run_all(seed: int = 0, schedules: Optional[int] = None) -> Dict[str, Any]:
+    """Run every shipped scenario; the ``make jaxlint-race`` entry point."""
+    results: Dict[str, Any] = {}
+    ok = True
+    for name, fn in SCENARIOS.items():
+        res = fn(seed=seed, schedules=schedules) if schedules else fn(seed=seed)
+        results[name] = res
+        ok = ok and res["passed"]
+    return {"passed": ok, "scenarios": results,
+            "schedules_run": sum(r["schedules_run"] for r in results.values())}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu._lint.racerun",
+        description="Deterministic schedule explorer for the tmrace concurrency rules",
+    )
+    parser.add_argument("--scenario", help="run one scenario (or 'demo' for the synthetic"
+                                           " lost-update fixture); default: all")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--schedules", type=int, default=None,
+                        help="interleavings per scenario (default: per-scenario)")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--list", action="store_true", help="list scenarios and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIOS:
+            print(name)
+        return 0
+    if args.scenario == "demo":
+        racy = explore(lost_update_fixture(locked=False), _FIXTURE_WATCH,
+                       seed=args.seed, schedules=args.schedules or 12)
+        fixed = explore(lost_update_fixture(locked=True), _FIXTURE_WATCH,
+                        seed=args.seed, schedules=args.schedules or 12)
+        out = {"passed": bool(racy["failures"]) and fixed["passed"],
+               "racy_failures": len(racy["failures"]), "fixed": fixed["passed"]}
+    elif args.scenario:
+        if args.scenario not in SCENARIOS:
+            print(f"unknown scenario {args.scenario!r}; see --list", file=sys.stderr)
+            return 2
+        fn = SCENARIOS[args.scenario]
+        out = fn(seed=args.seed, schedules=args.schedules) if args.schedules \
+            else fn(seed=args.seed)
+    else:
+        out = run_all(seed=args.seed, schedules=args.schedules)
+
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        if "scenarios" in out:
+            for name, res in out["scenarios"].items():
+                status = "ok" if res["passed"] else "RACE"
+                print(f"{status:4s} {name}: {res['schedules_run']} schedule(s),"
+                      f" {len(res['failures'])} failure(s)")
+                for f in res["failures"]:
+                    print(f"     seed={f['seed']}: {f['error']}")
+                    print(f"     trace: {' -> '.join(f['trace'])}")
+        print(f"racerun: {'all scenarios passed' if out['passed'] else 'RACE FOUND'}")
+    return 0 if out["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -233,6 +233,38 @@ RULE_META: Dict[str, Dict[str, str]] = {
                " and attach it as labels/metadata outside the traced computation —"
                " never bake who-am-I into a compiled program",
     },
+    "TPU021": {
+        "severity": "error",
+        "summary": "shared attribute/global written from ≥2 concurrent thread roots"
+                   " with disjoint locksets (lost update); GIL-atomic ring appends and"
+                   " declared '# jaxlint: single-mutator' fields are sanctioned",
+        "example": "def _drain_loop(self):  # Thread(target=...) root\n"
+                   "    self._stats['failed'] += n  # main root writes under self._cond",
+        "fix": "take the same lock at every write site, or — when the design is a"
+               " single-mutator protocol (quiesce barrier, sole-writer thread) — mark"
+               " the site '# jaxlint: single-mutator (racerun: <scenario>)' and back it"
+               " with a passing deterministic schedule (make jaxlint-race)",
+    },
+    "TPU022": {
+        "severity": "error",
+        "summary": "public host-access entry point of an engine-attachable class"
+                   " (assigns self._serve) touches tensor state without routing through"
+                   " the quiesce seam — the docs/serving.md table, checked structurally",
+        "example": "def peek(self):\n    return dict(self._state.tensors)  # no quiesce",
+        "fix": "drain the async window first: call self._serve.quiesce() (directly or"
+               " via a same-class helper that does) before reading/writing tensor state,"
+               " exactly like compute()/sync()/state_dict() do",
+    },
+    "TPU023": {
+        "severity": "warning",
+        "summary": "check-then-act (if/while test) or multi-step read (iteration) of a"
+                   " shared field outside the lock that guards its concurrent writers",
+        "example": "if self._closed:  # close() flips _closed under self._lock\n"
+                   "    return",
+        "fix": "hold the writers' guard across the whole check-then-act region (or the"
+               " whole iteration); a decision taken on an unlocked read races the"
+               " concurrent writer even though the single load itself is GIL-atomic",
+    },
 }
 
 #: rule id -> one-line description (derived view of :data:`RULE_META`; kept for the CLI,

@@ -1338,6 +1338,9 @@ class Metric:
             raise TorchMetricsUserError("The Metric has already been un-synced.")
         if self._cache is None:
             raise TorchMetricsUserError("The internal cache should exist to unsync the Metric.")
+        if self._serve is not None:
+            # batches enqueued while synced would land mid-restore otherwise (TPU022)
+            self._serve.quiesce()
         self._state.restore(self._cache)
         self._is_synced = False
         self._cache = None
@@ -1461,6 +1464,8 @@ class Metric:
         """
         if self._nan_policy == "propagate":
             return 0
+        if self._serve is not None:
+            self._serve.quiesce()  # the accumulator is drain-mutated state (TPU022)
         self._state.guard_readable()
         return int(jax.device_get(self._state.tensors[_guardrails.POISON_STATE]))
 
@@ -1626,6 +1631,8 @@ class Metric:
 
     def state_dict(self, destination: Optional[dict] = None, prefix: str = "", keep_vars: bool = False) -> dict:
         """Checkpoint dict of persistent states (reference ``metric.py:831``)."""
+        if self._serve is not None:
+            self._serve.quiesce()  # the checkpoint must include every async batch (TPU022)
         destination = destination if destination is not None else {}
         for name, persistent in self._persistent.items():
             if not persistent:
@@ -1649,6 +1656,8 @@ class Metric:
         ``prefix`` mirrors the prefix passed to :meth:`state_dict`, so prefixed checkpoints
         round-trip the update count as well as the states.
         """
+        if self._serve is not None:
+            self._serve.quiesce()  # in-flight batches must not interleave with a restore (TPU022)
         restored_count = state_dict.get(prefix + "_update_count")
         if prefix:
             # only keys under this prefix belong to this metric — a shared destination dict may

@@ -420,4 +420,14 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         out["lint_cache_hits"] = status.get("cache_hits", 0)
     except Exception:  # pragma: no cover - defensive: bench extras are best-effort
         out["lint_findings"] = None
+    # schedule-sanitizer evidence (the dynamic half of the concurrency rules): how many
+    # seeded interleavings this process explored and how many found a race. Read from
+    # sys.modules only — bench extras must never IMPORT racerun (it would drag harness
+    # scenarios into every bench); zeros mean "no sweep ran in this process".
+    import sys as _sys
+
+    _racerun = _sys.modules.get("torchmetrics_tpu._lint.racerun")
+    stats = getattr(_racerun, "LAST_RACE_STATS", {}) if _racerun else {}
+    out["race_schedules_run"] = stats.get("race_schedules_run", 0)
+    out["race_findings"] = stats.get("race_findings", 0)
     return out

@@ -35,10 +35,12 @@ Event taxonomy (docs/observability.md "Flight recorder" has the full table):
 ``chaos.cell_failed``       a chaos-matrix cell errored instead of recovering
 ==========================  ==========================================================
 
-Cost model: :func:`record` builds one small dict, stamps a monotonic sequence number
-(``itertools.count`` — GIL-atomic) and a microsecond timestamp, appends to a bounded
-``deque`` (no lock), and bumps the always-on ``flight.events`` counter. Measured
-~0.5µs/event on the shared CI host; ``make bundle-smoke`` pins the ≤2µs bound.
+Cost model: :func:`record` builds one small dict, then — under one uncontended
+per-instance ``Lock`` acquire — stamps a monotonic sequence number and a microsecond
+timestamp and appends to a bounded ``deque``, and bumps the always-on
+``flight.events`` counter. Measured ~0.5µs/event on the shared CI host;
+``make bundle-smoke`` pins the ≤2µs bound. The lock is what makes ring order equal
+sequence order per recorder (the snapshot no longer has to repair interleavings).
 
     >>> import torchmetrics_tpu.obs.flightrec as flightrec
     >>> flightrec.clear()
@@ -50,6 +52,7 @@ Cost model: :func:`record` builds one small dict, stamps a monotonic sequence nu
 from __future__ import annotations
 
 import itertools
+import threading
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -76,13 +79,18 @@ __all__ = [
 class FlightRecorder:
     """Bounded always-on event ring with monotonic per-process sequence numbers.
 
-    Appends are GIL-atomic deque pushes (no lock on the record path); the sequence
-    counter is shared across instances of a process so bundle diffs can order events
-    from different captures. ``dropped`` counts events the bound overwrote — a bundle
-    whose ring wrapped says so instead of silently presenting a truncated history.
+    The record path takes a per-instance ``Lock`` around the seq draw, the high-water
+    cursor, and the append — one uncontended C-level acquire, still inside the ≤2µs
+    budget — so the ring order IS the sequence order and ``last_seq`` never regresses
+    when the drain, a scrape handler, and the main thread record concurrently
+    (TPU021; the ``flight_ring_append_vs_snapshot`` racerun schedule drives exactly
+    that interleaving). The sequence counter itself stays process-wide so bundle diffs
+    can order events from different captures. ``dropped`` counts events the bound
+    overwrote — a bundle whose ring wrapped says so instead of silently presenting a
+    truncated history.
     """
 
-    __slots__ = ("_events", "_pushed", "_seq")
+    __slots__ = ("_events", "_pushed", "_seq", "_lock")
 
     #: process-wide monotonic sequence (shared so merged views order correctly)
     _next_seq = itertools.count(1).__next__
@@ -91,11 +99,11 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=maxlen or _env_int(ENV_FLIGHT_EVENTS, 4096))
         self._pushed = 0
         self._seq = 0  # highest sequence this recorder has seen
+        self._lock = threading.Lock()
 
     def record(self, kind: str, **fields: Any) -> int:
         """Append one event; returns its sequence number. Always-on, ~0.5µs."""
-        seq = FlightRecorder._next_seq()
-        evt: Dict[str, Any] = {"seq": seq, "ts_us": round(_now_us(), 1), "kind": kind}
+        evt: Dict[str, Any] = {"kind": kind}
         # while an incident is open, every flight event carries its id (one dict read
         # on the ≤2µs record path) — the cross-rank merge keys its timeline on this
         inc = _active_incident
@@ -103,9 +111,13 @@ class FlightRecorder:
             evt["incident"] = inc["id"]
         if fields:
             evt.update(fields)
-        self._pushed += 1  # benign under the GIL (monotonic high-water mark)
-        self._seq = seq
-        self._events.append(evt)
+        with self._lock:
+            seq = FlightRecorder._next_seq()
+            evt["seq"] = seq
+            evt["ts_us"] = round(_now_us(), 1)
+            self._pushed += 1
+            self._seq = seq
+            self._events.append(evt)
         telemetry.counter("flight.events").inc()
         return seq
 
@@ -128,23 +140,29 @@ class FlightRecorder:
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serialisable view for bundles/merged gathers.
 
-        Events are ordered by sequence number: concurrent recorders draw their seq
-        BEFORE the (GIL-atomic) append, so raw ring order can interleave by one slot
-        under a thread race — the snapshot presents the true causal order, and bundle
-        validation holds it monotonic.
+        Events are ordered by sequence number. Within one recorder the locked record
+        path already guarantees ring order == seq order (the
+        ``flight_ring_append_vs_snapshot`` schedule asserts it); the sort is what keeps
+        MERGED views — events pulled from several recorders sharing the process-wide
+        counter — in true causal order, and bundle validation holds it monotonic.
         """
+        with self._lock:
+            events = list(self._events)
+            pushed = self._pushed
+            seq = self._seq
         return {
-            "events": sorted(self.events(), key=lambda e: e["seq"]),
-            "recorded": self._pushed,
-            "dropped": self.dropped,
-            "last_seq": self._seq,
+            "events": sorted(events, key=lambda e: e["seq"]),
+            "recorded": pushed,
+            "dropped": max(0, pushed - len(events)),
+            "last_seq": seq,
             "maxlen": self._events.maxlen,
         }
 
     def clear(self) -> None:
-        self._events.clear()
-        self._pushed = 0
-        self._seq = 0
+        with self._lock:
+            self._events.clear()
+            self._pushed = 0
+            self._seq = 0
 
 
 #: the process-global flight ring every seam records into

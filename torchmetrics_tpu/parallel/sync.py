@@ -305,6 +305,13 @@ class HealthLedger:
     its :class:`SyncTimeoutError`, or a ``ranks=...``-aware subgroup gather). The stock
     ``multihost_utils.process_allgather`` path is all-or-nothing, so with it the ledger
     simply never accumulates failures — behaviour is unchanged.
+
+    Threading contract: the ledger is main-thread-only today (the tmrace static pass
+    confirms no concurrent writer reaches it), and it carries no locks on that basis.
+    The ``health_ledger_evict_vs_probe`` racerun scenario (``make jaxlint-race``) pins
+    the invariants any future multi-threaded caller (per-tier ledgers, ROADMAP item 5)
+    must preserve: a fixed rank population never resizes ``ranks`` mid-iteration, and
+    the eviction/probe partition stays consistent under interleaved readers.
     """
 
     EWMA_ALPHA = 0.2

@@ -1542,6 +1542,45 @@ def scenario_online_window_preemption(
     return detail
 
 
+def scenario_schedule_race_sweep(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, cell_dir: str
+) -> Dict[str, Any]:
+    """Race-sanitizer cell: the schedule explorer must still CATCH a seeded race.
+
+    Chaos proper injects faults; this cell injects *interleavings*. Three contracts,
+    seeded from the cell rng so the sweep explores fresh permutations every matrix run
+    while staying replayable from ``TM_TPU_CHAOS_SEED``: (1) the synthetic unlocked
+    counter (the canonical TPU021 lost update) is REPRODUCED into at least one failing
+    schedule — a sanitizer that stops finding the planted race is broken, exactly like
+    a chaos injector that stops killing drains; (2) its locked twin survives every
+    schedule; (3) the shipped flight-ring append-vs-snapshot scenario (the TPU021 fix
+    this PR locks) survives a fresh seed outside the ``make jaxlint-race`` pin —
+    replayed on the ``update`` coordinate only, since one fresh-seed replay per metric
+    is the canary and the real-lock park timeouts dominate the cell's wall clock.
+    """
+    from torchmetrics_tpu._lint import racerun
+
+    seed = rng.randrange(1 << 16)
+    # schedule counts are trimmed (6/2/1) because this cell repeats per (metric, via)
+    # matrix coordinate — the deep sweep is `make jaxlint-race`, this is the canary
+    racy = racerun.explore(racerun.lost_update_fixture(locked=False),
+                           racerun._FIXTURE_WATCH, seed=seed, schedules=6)
+    locked = racerun.explore(racerun.lost_update_fixture(locked=True),
+                             racerun._FIXTURE_WATCH, seed=seed, schedules=2)
+    ring = (racerun.scenario_flight_ring_append_vs_snapshot(seed=seed, schedules=1)
+            if via == "update" else None)
+    return {
+        "passed": (bool(racy["failures"]) and locked["passed"]
+                   and (ring is None or ring["passed"])),
+        "race_seed": seed,
+        "racy_failures": len(racy["failures"]),
+        "locked_passed": locked["passed"],
+        "flight_ring_passed": None if ring is None else ring["passed"],
+        "schedules_run": (racy["schedules_run"] + locked["schedules_run"]
+                          + (ring["schedules_run"] if ring else 0)),
+    }
+
+
 class ChaosMatrix:
     """Seeded sweep of composite multi-fault scenarios (``make chaos-matrix``).
 
@@ -1566,6 +1605,7 @@ class ChaosMatrix:
         "serve_drain_death": scenario_serve_drain_death,
         "serve_queue_overflow": scenario_serve_queue_overflow,
         "online_window_preemption": scenario_online_window_preemption,
+        "schedule_race_sweep": scenario_schedule_race_sweep,
     }
 
     def __init__(

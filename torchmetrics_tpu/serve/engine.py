@@ -358,7 +358,6 @@ class IngestEngine:
                     self._staging.release(it[3])
                 return
             except Exception as err:  # noqa: BLE001 - a bad batch must not kill the drain
-                self._stats["failed"] += len(items)
                 telemetry.counter("serve.apply_failures").inc(len(items))
                 _flightrec.record(
                     "serve.apply_failure", batches=len(items), error=repr(err)[:200]
@@ -367,6 +366,10 @@ class IngestEngine:
                     it[0]._resolve(error=err)
                     _trace.failed_event(it[0].trace_id, repr(err))
                 with self._cond:
+                    # stats share _cond with the admission counters: the main thread
+                    # bumps "enqueued"/"shed" under it, so the drain's failure count
+                    # must too or the += load/store pair loses updates (TPU021)
+                    self._stats["failed"] += len(items)
                     if self._pending_error is None:
                         self._pending_error = err
                     self._applying_n = 0
@@ -411,7 +414,8 @@ class IngestEngine:
         """
         store = getattr(self.target, "_state", None)
         if store is not None and self._fence is not None and store.generation != self._fence:
-            self._stats["fence_breaks"] += 1
+            with self._cond:  # stats share _cond with the main thread's admission counters
+                self._stats["fence_breaks"] += 1
             telemetry.counter("serve.fence_breaks").inc()
             _flightrec.record(
                 "serve.fence_break", expected=self._fence, observed=store.generation
@@ -450,7 +454,11 @@ class IngestEngine:
                     self._stats["online_advances"] += advanced
                 telemetry.counter("serve.online_advances").inc(advanced)
         gen = store.generation if store is not None else None
-        self._fence = gen
+        # Sole-writer protocol, not a lock: while batches are in flight only the drain
+        # advances the fence, and quiesce() only clears it after the window is provably
+        # empty (it holds _cond and waited for _queue and _applying_n to hit zero) — so
+        # the two writers are separated by the quiesce barrier, never overlapped.
+        self._fence = gen  # jaxlint: single-mutator (racerun: engine_enqueue_vs_quiesce)
         for it in items:
             it[0]._resolve(generation=gen)
 

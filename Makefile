@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-fast jaxlint-race jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-fast jaxlint-race jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint jaxlint-race test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
+test: jaxlint jaxlint-race test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -55,6 +55,16 @@ serve-smoke:
 	python bench.py --serve --smoke > /tmp/tm_serve_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_serve_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; r=ex['serve_async_vs_sync_completion']; assert r >= 1.0, ('async completion fell below sync', ex); assert ex['serve_block_mode_sheds'] == 0 and ex['serve_block_mode_stalls'] == 0, ex; bits=[v for k,v in ex.items() if k.startswith('serve_bit_identical')]; assert bits and all(bits), ex; assert ex['serve_overload_sheds_exact'], ex; print('serve-smoke ok: async %.2fx sync, sustained %.2fx @1.2x offered, enqueue p99 %sus' % (r, ex['serve_sustained_vs_sync'], ex['serve_enqueue_p99_us']))"
 
+# adaptive-control lane (docs/serving.md "Control loop"): oscillating square-wave
+# offered load through the ServeController, asserting the acceptance bar — the adaptive
+# admission ladder sheds no more than the best static on_full config under the same
+# drive, actuator toggles stay under the min_hold_ticks decision-rate cap (zero thrash),
+# every decision lands as a flight-recorder event, and adaptive_recover() replays the
+# WAL minus the journaled sheds to a bit-identical state
+control-smoke:
+	python bench.py --serve --smoke > /tmp/tm_control_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_control_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['adaptive_shed_ratio'] <= 1.0, ('adaptive shed worse than static', ex['adaptive_shed_ratio']); assert ex['serve_adaptive_thrash_free'], ('actuator toggles exceeded the decision-rate cap', ex); assert ex['serve_adaptive_replay_identical'], ('adaptive replay not bit-identical', ex); assert ex['controller_decisions'] > 0, ex; print('control-smoke ok: shed ratio %.3f (adaptive %d vs static %d), %d decisions / %d transitions (%d escalations), thrash-free, replay bit-identical' % (ex['adaptive_shed_ratio'], ex['serve_adaptive_sheds'], ex['serve_static_sheds'], ex['controller_decisions'], ex['controller_transitions'], ex['controller_escalations']))"
+
 # serving-observability lane (docs/observability.md "Serving traces, live series &
 # SLOs"): traced serve burst -> exported Perfetto trace with VALID flow pairing (every
 # ph:"s" has its ph:"f", committed flows land on the drain track), OpenMetrics
@@ -102,7 +112,7 @@ sketch-smoke:
 	python bench.py --sketch --smoke > /tmp/tm_sketch_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_sketch_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['sketch_auc_abs_error'] <= ex['sketch_auc_error_bound'], ex; assert ex['quantile_rank_error'] <= ex['quantile_error_bound'], ex; assert ex['sketch_auroc_state_bytes'] == ex['sketch_auroc_state_bytes_short_stream'], ex; assert ex['sketch_auroc_state_bytes'] < ex['cat_auroc_state_bytes'], ex; assert ex['sketch_auroc_state_bytes'] <= 65536 and ex['sketch_quantile_state_bytes'] <= 65536, ex; assert ex['sketch_exact_mode_bit_identical'], ex; print('sketch-smoke ok: %dB sketch vs %dB cat state (%.0fx), AUC err %.2e <= %.2e' % (ex['sketch_auroc_state_bytes'], ex['cat_auroc_state_bytes'], ex['cat_auroc_state_bytes']/ex['sketch_auroc_state_bytes'], ex['sketch_auc_abs_error'], ex['sketch_auc_error_bound']))"
 
-# static JAX/TPU hazard analysis (rules TPU000-TPU023, docs/static-analysis.md): exits
+# static JAX/TPU hazard analysis (rules TPU000-TPU024, docs/static-analysis.md): exits
 # nonzero on any non-baselined finding OR stale baseline entry; regenerate the baseline
 # with `python -m torchmetrics_tpu._lint torchmetrics_tpu --write-baseline`. Whole-program
 # pass over the package PLUS examples/ and bench.py, with the content-fingerprint

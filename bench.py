@@ -887,6 +887,11 @@ def bench_serve(batch: int, n_batches: int, poisson_events: int) -> dict:
        ``snapshot + replay`` to the same bits.
     4. **overload shed lane** — unpaced enqueues against a held drain, ``on_full=
        "shed"``: graceful degradation with EXACT shed accounting, never OOM.
+    5. **adaptive control lane** (docs/serving.md "Control loop") — a seeded
+       calm/overload square wave drives a block-mode engine with the
+       :class:`ServeController` attached vs a static ``on_full="shed"`` twin:
+       ``adaptive_shed_ratio`` must stay ≤ 1.0, actuator toggles under the
+       decision-rate cap, and WAL-minus-journaled-sheds replay bit-identical.
     """
     import random as _random
     import tempfile
@@ -1053,6 +1058,51 @@ def bench_serve(batch: int, n_batches: int, poisson_events: int) -> dict:
     eng_o.quiesce()
     overload_sheds = sum(1 for t in overload_tickets if t.shed)
 
+    # --- lane 5: adaptive controller vs static shed under square-wave overload -----
+    # the control-loop gate (docs/serving.md "Control loop"): the same seeded
+    # calm/overload square wave drives an adaptive engine (block base + controller +
+    # WAL) and a static on_full='shed' twin. Adaptive must shed no more than static
+    # (adaptive_shed_ratio <= 1.0), keep actuator toggles under the decision-rate cap
+    # (zero thrash), and replay bit-identically from WAL minus the journaled sheds.
+    from torchmetrics_tpu.serve import ControlOptions, ServeController, adaptive_recover
+
+    osc_events = max(24, min(64, poisson_events // 2))
+    period = 5
+    osc_opts = dict(max_inflight=4, queue_timeout_s=0.05, coalesce=4)
+
+    def square_wave(metric, engine):
+        for i in range(osc_events):
+            if (i // period) % 2 == 1:
+                engine.pause()
+            else:
+                engine.resume()
+            metric.update_async(*_decode(*payloads[i % n_batches]))
+        engine.resume()
+        engine.quiesce()
+
+    ctrl = ServeController(ControlOptions(
+        decision_every=2, window_short=4, window_long=8, min_hold_ticks=4,
+        timed_block_timeout_s=0.01,
+    ))
+    adir = tempfile.mkdtemp(prefix="tm-serve-bench-ctrl-wal-")
+    m_a = make()
+    eng_a = m_a.serve(
+        ServeOptions(on_full="block", **osc_opts), journal=_journal.Journal(adir)
+    )
+    ctrl.attach(eng_a)
+    square_wave(m_a, eng_a)
+    m_s = make()
+    eng_s = m_s.serve(ServeOptions(on_full="shed", **osc_opts))
+    square_wave(m_s, eng_s)
+    adaptive_shed = eng_a.stats()["shed"]
+    static_shed = eng_s.stats()["shed"]
+    cstats = ctrl.stats()
+    m_rec_a = make()
+    adaptive_recover(m_rec_a, adir)
+    adaptive_replay_identical = bool(
+        np.array_equal(np.asarray(m_a.compute()), np.asarray(m_rec_a.compute()))
+    )
+
     return {
         "serve_sync_updates_per_sec": round(sync_rate, 2),
         "serve_async_updates_per_sec": round(async_rate, 2),
@@ -1070,6 +1120,16 @@ def bench_serve(batch: int, n_batches: int, poisson_events: int) -> dict:
         "serve_bit_identical_preempt_replay": replay_identical,
         "serve_overload_sheds_exact": overload_sheds == 24 - 8,
         "serve_overload_sheds": overload_sheds,
+        "controller_decisions": cstats["decisions"],
+        "controller_escalations": cstats["escalations"],
+        "controller_transitions": sum(
+            ctrl.channel_report(eng_a)["transitions"].values()
+        ),
+        "adaptive_shed_ratio": round(adaptive_shed / max(1, static_shed), 3),
+        "serve_adaptive_sheds": adaptive_shed,
+        "serve_static_sheds": static_shed,
+        "serve_adaptive_thrash_free": ctrl.toggle_rate_ok(eng_a),
+        "serve_adaptive_replay_identical": adaptive_replay_identical,
         "serve_batch": batch,
         "serve_n_batches": n_batches,
     }

@@ -1,0 +1,149 @@
+"""TPU024: actuator transitions in serve/robust seams must emit a flight event."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+PATH = "torchmetrics_tpu/serve/control.py"
+
+
+def _tpu024(source: str, path: str = PATH):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU024"]
+
+
+# the hazard: an admission-rung + dwell change with no flight-recorder emission —
+# the decision journal and adaptive replay silently run a different history
+SILENT = """
+class Controller:
+    def escalate(self, ch, occ):
+        ch.mode_idx += 1
+        ch.linger_ms = 0.0
+"""
+
+# the correct shape: the mutate-and-record seam (ServeController._transition)
+RECORDED = """
+from torchmetrics_tpu.obs import flightrec as _flightrec
+
+
+class Controller:
+    def escalate(self, ch, occ):
+        ch.mode_idx += 1
+        ch.linger_ms = 0.0
+        _flightrec.record("control.escalation", occupancy_short=occ)
+"""
+
+
+class TestSilentTransitions:
+    def test_silent_actuator_stores_flag(self):
+        findings = _tpu024(SILENT)
+        assert len(findings) == 2  # one per actuator store
+        msgs = "\n".join(f.message for f in findings)
+        assert "'mode_idx'" in msgs and "'linger_ms'" in msgs
+        assert "flight-recorder" in findings[0].message
+
+    def test_tuple_and_annotated_targets_flag(self):
+        src = """
+class C:
+    def move(self, ch):
+        ch.linger_ms, ch.coalesce = 0.0, 1
+
+    def rung(self, ch):
+        ch.mode: str = "shed"
+"""
+        findings = _tpu024(src)
+        assert len(findings) == 3
+        assert {"'linger_ms'", "'coalesce'", "'mode'"} <= {
+            m for f in findings for m in (f.message.split(" store")[0].split("(")[-1],)
+        }
+
+    def test_robust_seam_also_covered(self):
+        assert len(_tpu024(SILENT, path="torchmetrics_tpu/robust/chaos.py")) == 2
+
+    def test_underscored_attribute_flags(self):
+        src = """
+class C:
+    def degrade(self):
+        self._admission_mode = "shed"
+"""
+        assert len(_tpu024(src)) == 1
+
+
+class TestRecordedTransitionsClean:
+    def test_mutate_and_record_seam_is_clean(self):
+        assert _tpu024(RECORDED) == []
+
+    def test_open_incident_counts_as_emission(self):
+        src = """
+from torchmetrics_tpu.obs import flightrec
+
+
+class C:
+    def degrade(self, ch):
+        ch.mode_idx = 2
+        flightrec.open_incident("control.forced_shed")
+"""
+        assert _tpu024(src) == []
+
+    def test_bare_record_from_import_counts(self):
+        src = """
+from torchmetrics_tpu.obs.flightrec import record
+
+
+class C:
+    def degrade(self, ch):
+        ch.coalesce = 1
+        record("control.decision", coalesce=1)
+"""
+        assert _tpu024(src) == []
+
+    def test_chained_series_record_is_not_an_emission(self):
+        # telemetry.series(...).record(...) is a metrics write, not a flight event
+        src = """
+from torchmetrics_tpu.obs import telemetry
+
+
+class C:
+    def degrade(self, ch):
+        ch.mode_idx = 2
+        telemetry.series("control.mode").record(2.0)
+"""
+        assert len(_tpu024(src)) == 1
+
+    def test_constructors_exempt(self):
+        src = """
+class Channel:
+    def __init__(self, base):
+        self.mode_idx = 0
+        self.linger_ms = float(base.linger_ms)
+        self.coalesce = int(base.coalesce)
+"""
+        assert _tpu024(src) == []
+
+    def test_non_seam_module_is_clean(self):
+        assert _tpu024(SILENT, path="torchmetrics_tpu/aggregation.py") == []
+
+    def test_non_actuator_stores_are_clean(self):
+        src = """
+class C:
+    def bump(self, ch):
+        ch.tick += 1
+        ch.occupancy = 0.5
+"""
+        assert _tpu024(src) == []
+
+    def test_disable_comment_suppresses(self):
+        src = """
+class C:
+    def escalate(self, ch):
+        ch.mode_idx += 1  # jaxlint: disable=TPU024
+"""
+        assert _tpu024(src) == []
+
+
+class TestRegistration:
+    def test_rule_meta_registered(self):
+        meta = RULE_META["TPU024"]
+        assert meta["severity"] == "warning"
+        assert "actuator" in meta["summary"]
+        assert "flight-recorder" in meta["summary"]
+        assert "mutate" in meta["fix"] or "seam" in meta["fix"]

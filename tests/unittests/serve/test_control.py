@@ -1,0 +1,396 @@
+"""Adaptive control loop: escalation ladder, decision-rate cap, shared drain,
+drift auto-snapshot, and bit-identical adaptive replay (docs/serving.md "Control loop")."""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.online.drift import DriftDetector, DriftMonitor, DriftSpec
+from torchmetrics_tpu.parallel.sync import reset_backoff_rng
+from torchmetrics_tpu.robust import checkpoint as ckpt
+from torchmetrics_tpu.robust.journal import Journal
+from torchmetrics_tpu.serve import (
+    ControlOptions,
+    DriftSnapshotter,
+    ServeController,
+    ServeOptions,
+    SharedDrain,
+    adaptive_recover,
+    control_options_from_env,
+    shed_seqs,
+)
+from torchmetrics_tpu.serve.control import CONTROL_DIR_SUFFIX, MODES
+from torchmetrics_tpu.serve.engine import (
+    _BLOCK_WAIT_MAX_S,
+    _BLOCK_WAIT_MIN_S,
+    _jittered_wait,
+)
+from torchmetrics_tpu.utils.exceptions import BackpressureError, ServeError
+from torchmetrics_tpu.utils.prints import reset_warning_cache
+
+_CONTROL_KINDS = ("control.decision", "control.escalation", "control.deescalation")
+
+
+def _control_events():
+    return [e for e in obs.flightrec.events() if e["kind"] in _CONTROL_KINDS]
+
+
+class _StubEngine:
+    """The controller-facing engine surface: options + attach seam + depth fields."""
+
+    def __init__(self, max_inflight=4, on_full="block", queue_timeout_s=0.5):
+        self.options = ServeOptions(
+            max_inflight=max_inflight, on_full=on_full, queue_timeout_s=queue_timeout_s
+        )
+        self.journal = None
+        self._control = None
+        self._queue: list = []
+        self._applying_n = 0
+
+    def attach_controller(self, control):
+        self._control = control
+
+
+def _fast_opts(**over):
+    base = dict(
+        decision_every=2, window_short=2, window_long=4, min_hold_ticks=2,
+        timed_block_timeout_s=0.01,
+    )
+    base.update(over)
+    return ControlOptions(**base)
+
+
+class TestControlOptions:
+    def test_validation_raises(self):
+        with pytest.raises(ServeError):
+            ControlOptions(decision_every=0)
+        with pytest.raises(ServeError):
+            ControlOptions(window_short=8, window_long=4)
+        with pytest.raises(ServeError):
+            ControlOptions(min_hold_ticks=0)
+        with pytest.raises(ServeError):
+            ControlOptions(escalate_occupancy=0.3, deescalate_occupancy=0.5)
+        with pytest.raises(ServeError):
+            ControlOptions(dwell_raise_occupancy=0.1, dwell_lower_occupancy=0.2)
+        with pytest.raises(ServeError):
+            ControlOptions(coalesce_min=0)
+        with pytest.raises(ServeError):
+            ControlOptions(timed_block_timeout_s=-1.0)
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("TM_TPU_SERVE_CONTROL_DECISION_EVERY", "3")
+        monkeypatch.setenv("TM_TPU_SERVE_CONTROL_MIN_HOLD_TICKS", "9")
+        monkeypatch.setenv("TM_TPU_SERVE_CONTROL_TIMED_TIMEOUT_S", "0.125")
+        opts = control_options_from_env()
+        assert opts.decision_every == 3
+        assert opts.min_hold_ticks == 9
+        assert opts.timed_block_timeout_s == 0.125
+
+    def test_malformed_env_degrades_with_one_shot_warning(self, monkeypatch):
+        reset_warning_cache()
+        monkeypatch.setenv("TM_TPU_SERVE_CONTROL_DECISION_EVERY", "banana")
+        monkeypatch.setenv("TM_TPU_SERVE_CONTROL_WINDOW_SHORT", "-4")
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            opts = control_options_from_env()
+            control_options_from_env()  # second read: warning cache dedups
+        assert opts.decision_every == 8 and opts.window_short == 16  # defaults held
+        malformed = [w for w in rec if "malformed" in str(w.message)]
+        ranged = [w for w in rec if "out-of-range" in str(w.message)]
+        assert len(malformed) == 1 and len(ranged) == 1
+
+
+class TestEscalationLadder:
+    def test_sustained_saturation_walks_the_ladder(self):
+        ctrl = ServeController(_fast_opts())
+        eng = _StubEngine(max_inflight=4)
+        ctrl.attach(eng)
+        assert ctrl.admission(eng) == ("block", 0.5)
+        ev0 = len(_control_events())
+        for _ in range(8):  # every offer observes a full window
+            ctrl.note_offered(eng, depth=4)
+        report = ctrl.channel_report(eng)
+        assert report["mode"] == "shed"  # block -> timed -> shed
+        assert ctrl.admission(eng) == ("shed", 0.0)
+        assert ctrl.stats()["escalations"] == 2
+        # every transition is a flight event carrying the triggering signal values
+        escalations = [e for e in _control_events()[ev0:]
+                       if e["kind"] == "control.escalation"]
+        assert len(escalations) >= 2
+        for e in escalations:
+            assert 0.0 <= e["occupancy_short"] <= 1.0 and "tick" in e
+
+    def test_recovery_deescalates_symmetrically(self):
+        ctrl = ServeController(_fast_opts())
+        eng = _StubEngine(max_inflight=4)
+        ctrl.attach(eng)
+        for _ in range(8):
+            ctrl.note_offered(eng, depth=4)
+        assert ctrl.channel_report(eng)["mode"] == "shed"
+        for _ in range(16):  # quiet stream: both windows drain below the low band
+            ctrl.note_offered(eng, depth=0)
+        assert ctrl.channel_report(eng)["mode"] == "block"
+        assert ctrl.stats()["deescalations"] >= 2
+
+    def test_timed_rung_park_budget(self):
+        ctrl = ServeController(_fast_opts(timed_block_timeout_s=0.033))
+        eng = _StubEngine(max_inflight=4, queue_timeout_s=0.7)
+        ctrl.attach(eng)
+        for _ in range(2):  # exactly one decision: block -> timed
+            ctrl.note_offered(eng, depth=4)
+        assert ctrl.admission(eng) == ("timed", 0.033)
+
+    def test_ladder_only_governs_block_engines(self):
+        ctrl = ServeController(_fast_opts())
+        eng = _StubEngine(max_inflight=4, on_full="shed")
+        ctrl.attach(eng)
+        for _ in range(8):
+            ctrl.note_offered(eng, depth=4)
+        assert ctrl.channel_report(eng)["transitions"]["admission"] == 0
+
+    def test_unattached_engine_raises(self):
+        ctrl = ServeController()
+        with pytest.raises(ServeError, match="not attached"):
+            ctrl.admission(_StubEngine())
+
+    def test_decisions_recorded_with_signal_values(self):
+        ctrl = ServeController(_fast_opts())
+        eng = _StubEngine(max_inflight=4)
+        ctrl.attach(eng)
+        for _ in range(8):
+            ctrl.note_offered(eng, depth=4)
+        assert ctrl.decisions, "transitions must land in the in-memory decision log"
+        for d in ctrl.decisions:
+            assert {"kind", "actuator", "from", "to", "tick",
+                    "occupancy_short", "occupancy_long"} <= set(d)
+
+
+class TestDecisionRateCap:
+    def test_square_wave_toggles_stay_under_cap(self):
+        ctrl = ServeController(_fast_opts(min_hold_ticks=8, window_short=2, window_long=4))
+        eng = _StubEngine(max_inflight=4)
+        ctrl.attach(eng)
+        for i in range(256):  # seeded square wave: saturated <-> empty every 2 offers
+            ctrl.note_offered(eng, depth=4 if (i // 2) % 2 == 0 else 0)
+        assert ctrl.toggle_rate_ok(eng)
+        report = ctrl.channel_report(eng)
+        cap = report["tick"] / 8 + 1
+        assert all(t <= cap for t in report["transitions"].values())
+
+    def test_hold_blocks_immediate_reversal(self):
+        ctrl = ServeController(_fast_opts(min_hold_ticks=100))
+        eng = _StubEngine(max_inflight=4)
+        ctrl.attach(eng)
+        for _ in range(4):
+            ctrl.note_offered(eng, depth=4)
+        mode_after_first = ctrl.channel_report(eng)["mode"]
+        assert mode_after_first == "timed"  # one rung only
+        for _ in range(40):  # signals scream recovery, but the actuator is held
+            ctrl.note_offered(eng, depth=0)
+        assert ctrl.channel_report(eng)["mode"] == "timed"
+
+
+class TestDwellActuation:
+    def test_mid_band_raises_dwell_and_saturation_collapses_it(self):
+        ctrl = ServeController(
+            _fast_opts(min_hold_ticks=1, linger_max_ms=2.0, linger_step_ms=0.5)
+        )
+        eng = _StubEngine(max_inflight=8)
+        eng.options = ServeOptions(max_inflight=8, coalesce=8, linger_ms=0.0)
+        ctrl.attach(eng)
+        for _ in range(4):  # occupancy 0.5: backing up, latency budget healthy
+            ctrl.note_offered(eng, depth=4)
+        assert ctrl.linger_ms(eng) > 0.0
+        for _ in range(8):  # saturation band: the dwell collapses outright
+            ctrl.note_offered(eng, depth=8)
+        assert ctrl.linger_ms(eng) == 0.0
+        assert ctrl.coalesce(eng) == 8
+
+
+class TestAdaptiveEngine:
+    def test_park_budget_exhaustion_sheds_gracefully_and_replays_bit_identical(
+        self, tmp_path
+    ):
+        jdir = str(tmp_path / "wal")
+        m = SumMetric()
+        eng = m.serve(
+            ServeOptions(max_inflight=2, on_full="block", queue_timeout_s=0.02),
+            journal=Journal(jdir),
+        )
+        ctrl = ServeController(_fast_opts())
+        ctrl.attach(eng)
+        eng.pause()  # wedge the drain: the window fills and stays full
+        tickets = [m.update_async(np.asarray([float(i)], np.float32)) for i in range(8)]
+        eng.resume()
+        eng.quiesce()
+        shed = [t for t in tickets if t.shed]
+        assert shed, "an exhausted park budget must shed, not raise, under control"
+        assert all(t.done() for t in tickets)
+        # every shed is journaled beside the WAL with its WAL seq
+        skips = shed_seqs(jdir + CONTROL_DIR_SUFFIX)
+        assert len(skips) == len(shed)
+        # WAL minus the journaled sheds == the live adaptive state, byte for byte
+        twin = SumMetric()
+        out = adaptive_recover(twin, jdir)
+        assert out["shed_skipped"] == len(shed)
+        assert np.array_equal(np.asarray(m.compute()), np.asarray(twin.compute()))
+
+    def test_block_without_controller_still_raises(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=2, on_full="block", queue_timeout_s=0.02))
+        eng.pause()
+        try:
+            with pytest.raises(BackpressureError):
+                for i in range(5):
+                    m.update_async(np.asarray([float(i)], np.float32))
+        finally:
+            eng.resume()
+            eng.quiesce()
+
+    def test_serve_control_true_attaches_default_controller(self):
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=4), control=True)
+        assert isinstance(eng._control, ServeController)
+        m.update_async(np.asarray([2.0], np.float32))
+        assert float(m.compute()) == 2.0
+
+    def test_serve_control_instance_attaches(self):
+        ctrl = ServeController(_fast_opts())
+        m = SumMetric()
+        eng = m.serve(ServeOptions(max_inflight=4), control=ctrl)
+        assert eng._control is ctrl
+        m.update_async(np.asarray([3.0], np.float32))
+        assert float(m.compute()) == 3.0
+        assert ctrl.channel_report(eng)["tick"] == 1
+
+    def test_adaptive_recover_without_control_journal(self, tmp_path):
+        jdir = str(tmp_path / "plain-wal")
+        m = SumMetric()
+        m.serve(ServeOptions(max_inflight=8), journal=Journal(jdir))
+        for i in range(4):
+            m.update_async(np.asarray([float(i)], np.float32))
+        value = float(m.compute())
+        twin = SumMetric()
+        out = adaptive_recover(twin, jdir)  # no -control dir: zero skips
+        assert out["shed_skipped"] == 0
+        assert float(twin.compute()) == value
+
+
+class TestSharedDrain:
+    def test_two_engines_one_thread_bit_identical(self):
+        sd = SharedDrain()
+        ms, refs, engines = [SumMetric(), MeanMetric()], [SumMetric(), MeanMetric()], []
+        try:
+            for m in ms:
+                engines.append(sd.attach(m.serve(ServeOptions(max_inflight=8))))
+            rng = np.random.RandomState(7)
+            for _ in range(20):
+                b = rng.randint(0, 9, 4).astype(np.float32)
+                for m, r in zip(ms, refs):
+                    m.update_async(b)
+                    r.update(b)
+            for m, r, eng in zip(ms, refs, engines):
+                assert np.array_equal(np.asarray(m.compute()), np.asarray(r.compute()))
+                assert eng._thread is None, "own drain thread must never start"
+        finally:
+            sd.close()
+
+    def test_restart_latch_revives_closed_drain(self):
+        sd = SharedDrain()
+        m = SumMetric()
+        eng = sd.attach(m.serve(ServeOptions(max_inflight=8)))
+        try:
+            m.update_async(np.asarray([1.0], np.float32))
+            assert float(m.compute()) == 1.0
+            sd.close()
+            m.update_async(np.asarray([2.0], np.float32))  # enqueue revives the thread
+            assert float(m.compute()) == 3.0
+            assert sd.restarts >= 1
+        finally:
+            sd.close()
+
+    def test_detach_restores_self_draining(self):
+        sd = SharedDrain()
+        m = SumMetric()
+        eng = sd.attach(m.serve(ServeOptions(max_inflight=8)))
+        sd.detach(eng)
+        sd.close()
+        assert eng._drain_owner is None
+        m.update_async(np.asarray([5.0], np.float32))
+        assert float(m.compute()) == 5.0  # own drain thread serves it again
+
+
+class _StubDetector(DriftDetector):
+    def __init__(self):
+        self.value = 0.0
+
+    def score(self):
+        return self.value
+
+
+class TestDriftSnapshotter:
+    def test_firing_alarm_captures_pre_shift_and_at_alarm(self, tmp_path):
+        reset_warning_cache()
+        det = _StubDetector()
+        spec = DriftSpec(
+            name="ctl-snap", detector=det, threshold=0.5, objective=0.9,
+            windows=((5.0, 1.0),),
+        )
+        m = SumMetric()
+        m.update(np.asarray([1.0, 2.0, 3.0], np.float32))  # pre-shift state: 6.0
+        snap = DriftSnapshotter(m, DriftMonitor([spec]), str(tmp_path / "drift"))
+        now = 1000.0
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(10):  # quiet: the pre-shift blob keeps refreshing
+                snap.poll(now=now)
+                now += 1.0
+            assert snap.captured == []
+            det.value = 5.0  # the shift
+            m.update(np.asarray([10.0], np.float32))  # post-shift state: 16.0
+            for _ in range(30):
+                snap.poll(now=now)
+                now += 1.0
+        assert len(snap.captured) == 1, "one capture per transition, not per hot poll"
+        rec = snap.captured[0]
+        assert rec["name"] == "ctl-snap" and rec["incident"]
+        pre = ckpt.load_snapshot(rec["paths"]["pre_shift"])
+        alarm = ckpt.load_snapshot(rec["paths"]["at_alarm"])
+        before, after = SumMetric(), SumMetric()
+        ckpt.restore_metric(before, pre)
+        ckpt.restore_metric(after, alarm)
+        assert float(before.compute()) == 6.0  # the state BEFORE the shift survived
+        assert float(after.compute()) == 16.0
+        assert rec["bundle"] is None or os.path.exists(rec["bundle"])
+
+
+class TestJitteredWait:
+    def test_bounds_and_chaos_seeded_determinism(self, monkeypatch):
+        monkeypatch.setenv("TM_TPU_CHAOS_SEED", "1234")
+        reset_backoff_rng()
+        seq_a, prev = [], _BLOCK_WAIT_MIN_S
+        for _ in range(16):
+            prev = _jittered_wait(prev)
+            assert _BLOCK_WAIT_MIN_S <= prev <= _BLOCK_WAIT_MAX_S
+            seq_a.append(prev)
+        reset_backoff_rng()
+        seq_b, prev = [], _BLOCK_WAIT_MIN_S
+        for _ in range(16):
+            prev = _jittered_wait(prev)
+            seq_b.append(prev)
+        assert seq_a == seq_b  # chaos-seeded: replay walks the exact park sequence
+        reset_backoff_rng()  # leave no pinned RNG for other tests
+
+    def test_decorrelated_growth_is_capped(self, monkeypatch):
+        monkeypatch.setenv("TM_TPU_CHAOS_SEED", "7")
+        reset_backoff_rng()
+        w = _BLOCK_WAIT_MIN_S
+        for _ in range(64):
+            w = _jittered_wait(w)
+        assert w <= _BLOCK_WAIT_MAX_S
+        reset_backoff_rng()

@@ -265,6 +265,19 @@ RULE_META: Dict[str, Dict[str, str]] = {
                " whole iteration); a decision taken on an unlocked read races the"
                " concurrent writer even though the single load itself is GIL-atomic",
     },
+    "TPU024": {
+        "severity": "warning",
+        "summary": "actuator state transition (admission mode / linger / coalesce /"
+                   " dwell store) in a serve/robust seam function with no"
+                   " flight-recorder emission in the same function",
+        "example": "def _escalate(self, ch):\n"
+                   "    ch.mode_idx += 1  # no flightrec.record in this function",
+        "fix": "funnel every actuator mutation through one seam that both moves the"
+               " state AND records it (flightrec.record('control.decision', ...) or"
+               " open_incident) with the triggering signal values — the decision"
+               " journal, replay bit-identity, and post-mortem bundles all assume the"
+               " control event stream is complete (docs/serving.md 'Control loop')",
+    },
 }
 
 #: rule id -> one-line description (derived view of :data:`RULE_META`; kept for the CLI,
@@ -2580,11 +2593,96 @@ def _rule_tpu020(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+# ------------------------------------------------------------------------ TPU024 helpers
+#: attribute names (leading underscores stripped) whose stores ARE actuator
+#: transitions: the serve controller's admission rung and micro-batching dwell
+_TPU024_ACTUATORS = {"mode", "mode_idx", "admission_mode", "linger_ms", "coalesce", "dwell"}
+#: constructors build the INITIAL actuator position — that is configuration, not a
+#: transition, so no flight event is owed there
+_TPU024_EXEMPT = {"__init__", "__post_init__", "__new__"}
+
+
+def _tpu024_emits_flight_event(info: "_FuncInfo") -> bool:
+    """Does this function call the flight recorder (``record``/``open_incident``)?
+
+    Matches ``flightrec.record(...)`` / ``_flightrec.record(...)`` /
+    ``obs.flightrec.open_incident(...)`` and bare ``record(...)`` (the from-import
+    form). A chained ``telemetry.series(...).record(...)`` is NOT a match — the call
+    chain is not a pure name path, so ``_dotted`` already rejects it.
+    """
+    for node in _scoped_walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None or dotted[-1] not in ("record", "open_incident"):
+            continue
+        if len(dotted) == 1 or any(p in ("flightrec", "_flightrec") for p in dotted[:-1]):
+            return True
+    return False
+
+
+def _rule_tpu024(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """Actuator state transition without a flight-recorder emission in the function.
+
+    The adaptive serving loop's whole determinism/observability story
+    (docs/serving.md "Control loop") rests on one invariant: every actuator movement
+    — an admission-ladder rung change, a linger/coalesce dwell change — is visible,
+    both as a ``control.*`` flight event carrying the triggering signal values and as
+    a decision-journal record. A code path that mutates an actuator field without
+    recording breaks replay auditability silently: the journal says one history, the
+    live engine ran another, and the first place anyone notices is a bit-identity
+    failure in a post-mortem.
+
+    Structurally: on a seam module (``serve/``/``robust/``), any function that stores
+    to an actuator-named attribute (``mode``/``mode_idx``/``admission_mode``/
+    ``linger_ms``/``coalesce``/``dwell``, underscore-insensitive) must also call the
+    flight recorder (``flightrec.record``/``open_incident``) somewhere in the SAME
+    function — the mutate-and-record seam pattern ``ServeController._transition``
+    models. Constructors are exempt (the initial position is configuration, not a
+    transition).
+    """
+    if not _is_seam_file(path):
+        return []
+    out: List[Finding] = []
+    for info in model.functions:
+        if info.name in _TPU024_EXEMPT:
+            continue
+        stores: List[ast.Attribute] = []
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Assign):
+                targets: List[ast.AST] = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for target in targets:
+                elts = target.elts if isinstance(target, ast.Tuple) else [target]
+                for el in elts:
+                    if (
+                        isinstance(el, ast.Attribute)
+                        and el.attr.lstrip("_") in _TPU024_ACTUATORS
+                    ):
+                        stores.append(el)
+        if not stores or _tpu024_emits_flight_event(info):
+            continue
+        for el in stores:
+            out.append(_finding(
+                "TPU024", path, el, lines,
+                f"actuator transition ({el.attr!r} store) in {info.qualname!r} with no"
+                " flight-recorder emission in the same function: the control event"
+                " stream (and with it the decision journal and adaptive replay"
+                " bit-identity) goes silently incomplete. Route the mutation through"
+                " a seam that also calls flightrec.record('control.decision', ...)"
+                " with the triggering signal values.",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
     _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017, _rule_tpu018,
-    _rule_tpu019, _rule_tpu020,
+    _rule_tpu019, _rule_tpu020, _rule_tpu024,
 )
 
 

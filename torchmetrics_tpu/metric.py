@@ -1122,7 +1122,8 @@ class Metric:
         return Ema(self, decay=decay, **kwargs)
 
     # ------------------------------------------------------------- async ingestion
-    def serve(self, options: Optional[Any] = None, journal: Optional[Any] = None) -> "Any":
+    def serve(self, options: Optional[Any] = None, journal: Optional[Any] = None,
+              control: Optional[Any] = None) -> "Any":
         """Configure (or fetch) this metric's async ingestion engine (docs/serving.md).
 
         Idempotent: the first call builds the :class:`~torchmetrics_tpu.serve.engine.
@@ -1131,7 +1132,10 @@ class Metric:
         Journal` — appended at ENQUEUE time, so a preemption mid-overlap recovers via
         ``snapshot + replay``); later calls return the existing engine. Reconfiguring a
         live engine with different options is an error — quiesce and build a new metric
-        instead of mutating backpressure policy under load.
+        instead of mutating backpressure policy under load. ``control`` attaches a
+        :class:`~torchmetrics_tpu.serve.control.ServeController` (the adaptive loop —
+        docs/serving.md "Control loop"); pass ``True`` for a controller with the
+        ``TM_TPU_SERVE_CONTROL_*`` env policy.
         """
         from torchmetrics_tpu.serve import IngestEngine, serve_options_from_env
 
@@ -1140,6 +1144,12 @@ class Metric:
             eng = IngestEngine(self, options or serve_options_from_env(), journal=journal)
             object.__setattr__(self, "_serve", eng)
             obs.telemetry.counter("serve.engines").inc()
+            if control is not None and control is not False:
+                if control is True:
+                    from torchmetrics_tpu.serve import ServeController
+
+                    control = ServeController()
+                control.attach(eng)
             return eng
         if options is not None and options != eng.options:
             raise TorchMetricsUserError(
@@ -1148,6 +1158,12 @@ class Metric:
             )
         if journal is not None and eng.journal is None:
             eng.journal = journal
+        if control is not None and control is not False and eng._control is None:
+            if control is True:
+                from torchmetrics_tpu.serve import ServeController
+
+                control = ServeController()
+            control.attach(eng)
         return eng
 
     def update_async(self, *args: Any, **kwargs: Any) -> "Any":

@@ -33,6 +33,14 @@ Event taxonomy (docs/observability.md "Flight recorder" has the full table):
 ``slo.alarm``               an SLO/drift/memory burn alarm transitioned (both ways)
 ``chaos.injected``          a seeded fault injector fired
 ``chaos.cell_failed``       a chaos-matrix cell errored instead of recovering
+``control.decision``        the serve controller moved an actuator (dwell/coalesce),
+                            with the triggering tick-window occupancies
+``control.escalation``      admission ladder went up a rung (block→timed→shed);
+                            ``control.deescalation`` is the symmetric recovery
+``control.shed``            the controller shed an offered batch (WAL seq journaled so
+                            adaptive replay skips exactly the dropped records)
+``control.shared_drain_restart``  the shared drain thread died and was revived
+``drift.auto_snapshot``     a firing drift alarm landed pre-shift+at-alarm snapshots
 ==========================  ==========================================================
 
 Cost model: :func:`record` builds one small dict, then — under one uncontended

@@ -213,8 +213,14 @@ class SloMonitor:
 
         Reads the ``serve.*`` series the ingestion engine records always-on: queue
         depth (last + p50/p99), in-flight occupancy, commit/enqueue/shed rates over
-        ``window_s``, and the enqueue→commit latency quantiles. Missing series (no
-        serving traffic yet) simply yield None entries.
+        ``window_s``, the derived ``shed_ratio`` (shed_rate / enqueue_rate — the
+        admission ladder's burn fraction), and the enqueue→commit latency quantiles.
+        Missing series (no serving traffic yet) simply yield None entries.
+
+        Note the wall-clock caveat: these window rates feed *dashboards and alarms*.
+        The :class:`~torchmetrics_tpu.serve.control.ServeController` decision path
+        deliberately does NOT consume them — it derives its burn windows from offered-
+        batch ticks (TPU017), so adaptive runs replay bit-identically.
         """
         out: Dict[str, Any] = {"window_s": window_s}
         depth = self._tel.get_series("serve.queue_depth")
@@ -232,6 +238,10 @@ class SloMonitor:
                             ("shed_rate", "serve.sheds")):
             s = self._tel.get_series(series)
             out[key] = None if s is None else round(s.rate_over(window_s, now=now), 3)
+        if out.get("enqueue_rate") and out.get("shed_rate") is not None:
+            out["shed_ratio"] = round(out["shed_rate"] / out["enqueue_rate"], 4)
+        else:
+            out["shed_ratio"] = None
         lat = self._tel.get_series("serve.commit_latency_us")
         if lat is not None and lat.count:
             p50, p99 = lat.quantiles((0.5, 0.99))

@@ -340,6 +340,20 @@ class DriftMonitor:
         self.specs: List[DriftSpec] = list(specs)
         self._tel = registry if registry is not None else obs.telemetry
         self._slo = SloMonitor([s.as_slo_spec() for s in self.specs], registry=self._tel)
+        self._subscribers: List[Any] = []
+        self._was_firing: set = set()
+
+    def subscribe(self, fn: Any) -> "DriftMonitor":
+        """Register ``fn(status, firing)`` to run on every alarm *transition*.
+
+        Called from inside :meth:`evaluate` with the fresh :class:`DriftStatus` when a
+        spec transitions into (``firing=True``) or out of (``firing=False``) the
+        drifting state — the seam :class:`~torchmetrics_tpu.serve.control.
+        DriftSnapshotter` uses to land a pre-shift snapshot + bundle at the exact
+        evaluation that fires. Steady states (still firing / still quiet) do not call.
+        """
+        self._subscribers.append(fn)
+        return self
 
     def watch(self, spec: DriftSpec) -> "DriftMonitor":
         self.specs.append(spec)
@@ -364,6 +378,14 @@ class DriftMonitor:
                 self._tel.counter("drift.alarms").inc()
                 self._tel.counter(f"drift.alarms.{spec.name}").inc()
             out.append(DriftStatus(spec=spec, score=scores[spec.name], slo=st))
+        for status in out:
+            firing = status.drifting
+            was = status.spec.name in self._was_firing
+            if firing == was:
+                continue  # steady state: subscribers only see transitions
+            (self._was_firing.add if firing else self._was_firing.discard)(status.spec.name)
+            for fn in self._subscribers:
+                fn(status, firing)
         return out
 
     def drifting(self) -> List[str]:

@@ -1391,6 +1391,111 @@ def scenario_serve_queue_overflow(
     return detail
 
 
+def scenario_serve_oscillating_load(
+    factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
+) -> Dict[str, Any]:
+    """Seeded square-wave load under the adaptive controller → thrash-free + replayable.
+
+    A serving metric with the :class:`~torchmetrics_tpu.serve.control.ServeController`
+    attached is driven through alternating calm/overload phases (the drain held during
+    overload — a seeded square wave). The cell pins the PR-18 acceptance contract:
+    actuator toggles stay under the per-actuator decision-rate cap (no thrash on
+    oscillation), every controller transition lands a ``control.*`` flight event, the
+    adaptive run sheds no more than a static ``on_full='shed'`` config driven through
+    the SAME schedule, and recovery is bit-identical TWICE over — a fresh instance via
+    :func:`~torchmetrics_tpu.serve.control.adaptive_recover` (WAL minus the journaled
+    sheds), and a post-mortem twin replayed from the captured bundle's journal cursor
+    with the same shed skips (``bundle_replay_identical``). Plain + keyed + sharded.
+    """
+    del via
+    from torchmetrics_tpu.robust import journal as _journal
+    from torchmetrics_tpu.serve import (
+        ControlOptions,
+        ServeController,
+        ServeOptions,
+        adaptive_recover,
+        shed_seqs,
+    )
+    from torchmetrics_tpu.serve.control import CONTROL_DIR_SUFFIX
+
+    n_batches = max(24, n_batches * 4)
+    period = rng.randrange(3, 7)  # seeded square-wave half-period, in offered batches
+    sopts = ServeOptions(max_inflight=4, on_full="block", queue_timeout_s=0.05, coalesce=4)
+    copts = ControlOptions(
+        decision_every=2, window_short=4, window_long=8, min_hold_ticks=4,
+        timed_block_timeout_s=0.01,
+    )
+    variants = _serve_variants(factory, rng, n_batches)
+    detail: Dict[str, Any] = {"period": period, "n_batches": n_batches}
+    passed = True
+    for name, make, batches in variants:
+
+        def drive(metric: Any, engine: Any) -> None:
+            # phase index derives from the OFFER COUNT, so the adaptive engine and
+            # the static twin see the exact same square wave
+            for i, b in enumerate(batches):
+                if (i // period) % 2 == 1:
+                    engine.pause()  # overload phase: the drain is wedged
+                else:
+                    engine.resume()
+                metric.update_async(*b)
+            engine.resume()
+            engine.quiesce()
+
+        jdir = os.path.join(workdir, f"osc-{name}-wal")
+        ctrl = ServeController(copts)
+        m = make()
+        eng = m.serve(sopts, journal=_journal.Journal(jdir))
+        ctrl.attach(eng)
+        drive(m, eng)
+        report = ctrl.channel_report(eng)
+        n_transitions = sum(report["transitions"].values())
+        n_control_events = sum(
+            1 for e in obs.flightrec.events()
+            if e["kind"] in ("control.decision", "control.escalation", "control.deescalation")
+        )
+        ok_toggle = ctrl.toggle_rate_ok(eng)
+        ok_events = n_control_events >= n_transitions
+        # the static comparison: on_full='shed' through the SAME seeded schedule —
+        # graceful adaptation must not degrade below the best static answer
+        ms = make()
+        engs = ms.serve(ServeOptions(max_inflight=4, on_full="shed", queue_timeout_s=0.05, coalesce=4))
+        drive(ms, engs)
+        adaptive_shed, static_shed = eng.stats()["shed"], engs.stats()["shed"]
+        ok_shed = adaptive_shed <= static_shed
+        # bit-identity #1: fresh instance, WAL minus journaled sheds
+        twin = make()
+        adaptive_recover(twin, jdir)
+        ok_replay = _states_identical(m, twin)
+        # bit-identity #2: post-mortem twin from the bundle's journal cursor + skips
+        bundle_path = obs.capture_bundle(f"chaos_oscillating_load.{name}", metric=m)
+        ok_bundle = None
+        if bundle_path is not None:
+            twin2 = make()
+            _journal.recover(
+                twin2, jdir, cursor=bundle_path,
+                skip_seqs=shed_seqs(os.fspath(jdir) + CONTROL_DIR_SUFFIX),
+            )
+            ok_bundle = _states_identical(m, twin2)
+        ok = ok_toggle and ok_events and ok_shed and ok_replay and ok_bundle is not False
+        if ok:
+            obs.telemetry.counter("robust.recovered").inc()
+        passed = passed and ok
+        detail[name] = {
+            "toggles_under_cap": ok_toggle,
+            "transitions": n_transitions,
+            "decisions_as_flight_events": ok_events,
+            "adaptive_shed": adaptive_shed,
+            "static_shed": static_shed,
+            "adaptive_not_worse": ok_shed,
+            "adaptive_replay_identical": ok_replay,
+            "bundle_replay_identical": ok_bundle,
+            "escalations": ctrl.stats()["escalations"],
+        }
+    detail["passed"] = passed
+    return detail
+
+
 def scenario_online_window_preemption(
     factory: Callable[[], Any], rng: random.Random, n_batches: int, via: str, workdir: str
 ) -> Dict[str, Any]:
@@ -1604,6 +1709,7 @@ class ChaosMatrix:
         "serve_preempt_mid_overlap": scenario_serve_preempt_mid_overlap,
         "serve_drain_death": scenario_serve_drain_death,
         "serve_queue_overflow": scenario_serve_queue_overflow,
+        "serve_oscillating_load": scenario_serve_oscillating_load,
         "online_window_preemption": scenario_online_window_preemption,
         "schedule_race_sweep": scenario_schedule_race_sweep,
     }

@@ -417,6 +417,10 @@ def save_snapshot(blob: Dict[str, Any], path: Union[str, os.PathLike]) -> str:
     directory) so a preemption mid-write leaves either the previous snapshot or the new
     one, never garbage. The container adds an outer CRC over the serialised payload on
     top of the blob's own state CRC; :func:`load_snapshot` validates both layers.
+
+    Automated consumers: :class:`~torchmetrics_tpu.serve.control.DriftSnapshotter` saves
+    a ``*-pre.tmsnap``/``*-alarm.tmsnap`` pair through this path the instant a drift
+    alarm fires, preserving the state from *before* the distribution moved.
     """
     if not isinstance(blob, dict) or blob.get("format") not in (FORMAT, COLLECTION_FORMAT):
         raise SnapshotError(

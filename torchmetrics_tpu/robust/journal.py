@@ -41,7 +41,7 @@ import pickle
 import struct
 import uuid
 import zlib
-from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+from typing import Any, Collection, Dict, Iterator, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -354,6 +354,7 @@ def replay(
     journal: Union[Journal, str, os.PathLike],
     after_seq: int = -1,
     through_seq: Optional[int] = None,
+    skip_seqs: Optional[Collection[int]] = None,
 ) -> int:
     """Re-apply journaled batches through ``metric.update``; returns the batch count.
 
@@ -361,14 +362,25 @@ def replay(
     produced the records — the tier-equivalence suite is what makes that bit-identical.
     ``through_seq`` (a post-mortem bundle's journal cursor) stops replay AT that record,
     reconstructing the exact state of the captured instant rather than the journal tail.
+    ``skip_seqs`` omits specific records — the WAL journals the *offered* stream at
+    enqueue, so replaying an adaptive run bit-identically means skipping exactly the
+    sequence numbers the serve controller's decision journal records as shed
+    (:func:`torchmetrics_tpu.serve.control.adaptive_recover`).
     """
     jr = journal if isinstance(journal, Journal) else Journal(journal)
+    skips = frozenset(int(s) for s in skip_seqs) if skip_seqs else frozenset()
     n = 0
+    skipped = 0
     for seq, args, kwargs in jr.read(after_seq=after_seq):
         if through_seq is not None and seq > through_seq:
             break
+        if seq in skips:
+            skipped += 1
+            continue
         metric.update(*args, **kwargs)
         n += 1
+    if skipped:
+        obs.flightrec.record("journal.replay_skipped", skipped=skipped, path=jr.path)
     if n:
         obs.telemetry.counter("robust.journal_replays").inc(n)
         obs.telemetry.event("robust.journal_replay", cat="robust", args={"batches": n, "path": jr.path})
@@ -380,7 +392,8 @@ def replay(
 
 
 def recover(
-    metric: Any, path: Union[str, os.PathLike], cursor: Any = None
+    metric: Any, path: Union[str, os.PathLike], cursor: Any = None,
+    skip_seqs: Optional[Collection[int]] = None,
 ) -> Dict[str, Any]:
     """Restore ``snapshot + replay(journal)`` from a journal directory into ``metric``.
 
@@ -408,7 +421,7 @@ def recover(
         after = int(blob.pop("journal_seq", -1))
         metric.restore(blob)
         restored = True
-    replayed = replay(metric, jr, after_seq=after, through_seq=through)
+    replayed = replay(metric, jr, after_seq=after, through_seq=through, skip_seqs=skip_seqs)
     return {
         "snapshot_restored": restored, "replayed": replayed, "after_seq": after,
         "through_seq": through,

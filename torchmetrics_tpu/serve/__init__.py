@@ -3,9 +3,19 @@
 Opt-in per metric: ``metric.serve(ServeOptions(...), journal=...)`` configures the
 engine, ``metric.update_async(*batch)`` enqueues and returns an :class:`IngestTicket`.
 The disabled path costs one attribute check per update. See ``docs/serving.md`` for the
-window state machine, the on-full semantics table, the enqueue-time WAL contract, and
-the quiesce rules; ``docs/robustness.md`` for the chaos coverage.
+window state machine, the on-full semantics table, the enqueue-time WAL contract, the
+adaptive "Control loop" (``metric.serve(control=ServeController())``), and the quiesce
+rules; ``docs/robustness.md`` for the chaos coverage.
 """
+from torchmetrics_tpu.serve.control import (
+    ControlOptions,
+    DriftSnapshotter,
+    ServeController,
+    SharedDrain,
+    adaptive_recover,
+    control_options_from_env,
+    shed_seqs,
+)
 from torchmetrics_tpu.serve.engine import DrainKilled, IngestEngine, IngestTicket
 from torchmetrics_tpu.serve.options import (
     ENV_SERVE_MAX_INFLIGHT,
@@ -18,12 +28,19 @@ from torchmetrics_tpu.serve.options import (
 from torchmetrics_tpu.serve.staging import StagingPipeline
 
 __all__ = [
+    "ControlOptions",
     "DrainKilled",
+    "DriftSnapshotter",
     "IngestEngine",
     "IngestTicket",
+    "ServeController",
     "ServeOptions",
+    "SharedDrain",
     "StagingPipeline",
+    "adaptive_recover",
+    "control_options_from_env",
     "serve_options_from_env",
+    "shed_seqs",
     "ENV_SERVE_MAX_INFLIGHT",
     "ENV_SERVE_ON_FULL",
     "ENV_SERVE_QUEUE_TIMEOUT",

@@ -55,9 +55,26 @@ from torchmetrics_tpu.serve.staging import StagingPipeline
 from torchmetrics_tpu.utils.exceptions import BackpressureError, ServeError
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
-#: initial/backoff-capped park times for a blocking enqueue (exponential between them)
+#: initial/backoff-capped park times for a blocking enqueue (jittered between them)
 _BLOCK_WAIT_MIN_S = 0.001
 _BLOCK_WAIT_MAX_S = 0.25
+
+
+def _jittered_wait(prev: float) -> float:
+    """Next decorrelated-jitter park time for a blocked producer.
+
+    ``min(cap, uniform(base, prev * 3))`` — the AWS "decorrelated jitter" recurrence,
+    same shape as the cross-process sync backoff. Sharing the seam matters: the RNG is
+    the chaos-seeded one (``TM_TPU_CHAOS_SEED`` / ``reset_backoff_rng``), so chaos runs
+    replay the exact park sequence, and many producers blocked on one full window wake
+    scattered instead of retrying in lockstep.
+    """
+    from torchmetrics_tpu.parallel.sync import _backoff_rng
+
+    return min(
+        _BLOCK_WAIT_MAX_S,
+        _backoff_rng().uniform(_BLOCK_WAIT_MIN_S, max(_BLOCK_WAIT_MIN_S, prev * 3.0)),
+    )
 
 
 class DrainKilled(BaseException):
@@ -125,6 +142,10 @@ class IngestEngine:
         self.target = target
         self.options = options or ServeOptions()
         self.journal = journal
+        #: attached ServeController (the adaptive actuator tier) and/or SharedDrain
+        #: owner (one drain thread serving many engines); None = static/per-engine
+        self._control: Optional[Any] = None
+        self._drain_owner: Optional[Any] = None
         self._staging = StagingPipeline(self.options.staging_slots)
         self._cond = threading.Condition()
         self._queue: Deque[Tuple[IngestTicket, tuple, dict, Optional[int]]] = deque()
@@ -167,12 +188,46 @@ class IngestEngine:
         """
         if self._abandoned:
             raise ServeError("This IngestEngine was abandoned (chaos preemption); build a fresh metric")
-        if self.journal is not None:
-            self.journal.append(args, kwargs)
-        ticket = self._admit(args, kwargs)
+        wal_seq = self.journal.append(args, kwargs) if self.journal is not None else None
+        ticket = self._admit(args, kwargs, wal_seq)
+        owner = self._drain_owner
+        if owner is not None:
+            owner.kick()
         return ticket
 
-    def _admit(self, args: tuple, kwargs: dict) -> IngestTicket:
+    def attach_controller(self, control: Any) -> None:
+        """Bind a :class:`~torchmetrics_tpu.serve.control.ServeController` (its
+        :meth:`attach` calls this); the drain reads dwell/coalesce through it and the
+        admission path consults its block→timed→shed ladder."""
+        with self._cond:
+            self._control = control
+
+    def _resolve_shed_locked(self, ticket: IngestTicket, reason: str = "window_full") -> IngestTicket:
+        """Shed one offered batch (caller holds ``_cond``): resolve + count + events."""
+        opts = self.options
+        ticket.shed = True
+        ticket._resolve()
+        self._stats["shed"] += 1
+        telemetry.counter("serve.shed").inc()
+        telemetry.counter("robust.shed_batches").inc()
+        # always-on live series (docs/observability.md "Live time series"):
+        # queue_depth records one point per OFFERED batch (the shed-ratio
+        # denominator), serve.sheds the shed events themselves
+        telemetry.series("serve.queue_depth").record(opts.max_inflight)
+        telemetry.series("serve.sheds").record(1.0)
+        _flightrec.record(
+            "serve.shed", seq=ticket.seq, inflight=opts.max_inflight, reason=reason
+        )
+        _trace.shed_event(ticket.trace_id, ticket.seq)
+        rank_zero_warn(
+            f"Async ingestion window full ({opts.max_inflight} in flight):"
+            f" shedding batches ({reason}). Shed counts are exact in"
+            " serve.shed / IngestEngine.stats().",
+            UserWarning,
+        )
+        return ticket
+
+    def _admit(self, args: tuple, kwargs: dict, wal_seq: Optional[int] = None) -> IngestTicket:
         opts = self.options
         # one flag read on the tracing-disabled path (the <=2us bound obs-smoke pins)
         t0_us = telemetry.now_us() if telemetry.enabled else 0.0
@@ -180,52 +235,66 @@ class IngestEngine:
             self._ensure_drain_locked()
             ticket = IngestTicket(self._seq)
             self._seq += 1
+            ctrl = self._control
             if self._window_full_locked():
-                if opts.on_full == "shed":
-                    ticket.shed = True
-                    ticket._resolve()
-                    self._stats["shed"] += 1
-                    telemetry.counter("serve.shed").inc()
-                    telemetry.counter("robust.shed_batches").inc()
-                    # always-on live series (docs/observability.md "Live time series"):
-                    # queue_depth records one point per OFFERED batch (the shed-ratio
-                    # denominator), serve.sheds the shed events themselves
-                    telemetry.series("serve.queue_depth").record(opts.max_inflight)
-                    telemetry.series("serve.sheds").record(1.0)
-                    _flightrec.record("serve.shed", seq=ticket.seq, inflight=opts.max_inflight)
-                    _trace.shed_event(ticket.trace_id, ticket.seq)
-                    rank_zero_warn(
-                        f"Async ingestion window full ({opts.max_inflight} in flight):"
-                        " shedding batches (on_full='shed'). Shed counts are exact in"
-                        " serve.shed / IngestEngine.stats().",
-                        UserWarning,
-                    )
-                    return ticket
                 if opts.on_full == "raise":
                     raise BackpressureError(
                         f"Async ingestion window full ({opts.max_inflight} in flight)"
                         " and on_full='raise'"
                     )
-                # block: park with exponential-backoff waits against queue_timeout_s
+                if opts.on_full == "shed":
+                    mode, park_s = "shed", 0.0
+                elif ctrl is not None:
+                    # the escalating admission ladder: the controller may have moved a
+                    # block engine to timed-block (shorter park budget) or shed
+                    mode, park_s = ctrl.admission(self)
+                else:
+                    mode, park_s = "block", opts.queue_timeout_s
+                if mode == "shed":
+                    self._resolve_shed_locked(
+                        ticket,
+                        reason="on_full='shed'" if opts.on_full == "shed" else "admission=shed",
+                    )
+                    if ctrl is not None:
+                        ctrl.note_offered(self, opts.max_inflight, shed=True, wal_seq=wal_seq)
+                    return ticket
+                # block / timed-block: park with decorrelated-jitter waits against the
+                # rung's budget (chaos-seeded RNG — producers wake scattered, replayable)
                 self._stats["backpressure_stalls"] += 1
                 telemetry.counter("serve.backpressure_stalls").inc()
                 _flightrec.record(
-                    "serve.backpressure", seq=ticket.seq, inflight=opts.max_inflight
+                    "serve.backpressure", seq=ticket.seq, inflight=opts.max_inflight,
+                    mode=mode,
                 )
-                deadline = time.monotonic() + opts.queue_timeout_s
+                park_start = time.monotonic()
                 wait = _BLOCK_WAIT_MIN_S
                 while self._window_full_locked():
                     self._ensure_drain_locked()
-                    remaining = deadline - time.monotonic()
+                    if ctrl is not None:
+                        # re-read the rung each wakeup: an escalation to shed releases
+                        # every parked producer instead of letting them burn the budget
+                        mode, park_s = ctrl.admission(self)
+                        if mode == "shed":
+                            self._resolve_shed_locked(ticket, reason="admission=shed")
+                            ctrl.note_offered(self, opts.max_inflight, shed=True, wal_seq=wal_seq)
+                            return ticket
+                    remaining = park_start + park_s - time.monotonic()
                     if remaining <= 0:
                         telemetry.counter("serve.queue_timeouts").inc()
+                        if ctrl is not None:
+                            # with a controller attached an exhausted park budget sheds
+                            # (a journaled, replayable decision) instead of raising —
+                            # graceful degradation end to end
+                            self._resolve_shed_locked(ticket, reason=f"{mode}_budget_exhausted")
+                            ctrl.note_offered(self, opts.max_inflight, shed=True, wal_seq=wal_seq)
+                            return ticket
                         raise BackpressureError(
                             f"Async ingestion enqueue blocked past queue_timeout_s="
                             f"{opts.queue_timeout_s:g}s with {opts.max_inflight} in flight"
                             " (is the drain stalled?)"
                         )
+                    wait = _jittered_wait(wait)
                     self._cond.wait(min(wait, remaining))
-                    wait = min(wait * 2, _BLOCK_WAIT_MAX_S)
             s_args, s_kwargs, slot = self._staging.stage(args, kwargs)
             # the trace id must exist BEFORE the batch is visible to the drain: the
             # commit's flow-end reads it, possibly before this thread leaves the lock.
@@ -236,6 +305,9 @@ class IngestEngine:
             self._queue.append((ticket, s_args, s_kwargs, slot, time.monotonic()))
             self._stats["enqueued"] += 1
             depth = len(self._queue) + self._applying_n
+            if ctrl is not None:
+                # one controller tick per offered batch — the decision clock
+                ctrl.note_offered(self, depth, shed=False, wal_seq=wal_seq)
             self._cond.notify_all()
         telemetry.counter("serve.enqueued").inc()
         telemetry.histogram("serve.queue_depth").record(depth)
@@ -253,6 +325,13 @@ class IngestEngine:
     # ------------------------------------------------------------------------ drain
     def _ensure_drain_locked(self) -> None:
         """(Re)start the drain thread; the restart path is the thread-death latch."""
+        owner = self._drain_owner
+        if owner is not None:
+            # a SharedDrain owns this engine: its restart latch covers thread death
+            # for the whole fleet of attached engines; no per-engine thread exists
+            owner.ensure_alive()
+            owner.kick()
+            return
         t = self._thread
         if t is not None and t.is_alive():
             return
@@ -290,116 +369,164 @@ class IngestEngine:
         )
         self._thread.start()
 
+    def _effective_linger_s(self) -> float:
+        """Live micro-batching dwell: the controller's actuator position when one is
+        attached, else the static option — re-read every window, not once per loop."""
+        ctrl = self._control
+        if ctrl is not None:
+            return ctrl.linger_ms(self) / 1000.0
+        return self.options.linger_ms / 1000.0
+
+    def _effective_coalesce(self) -> int:
+        ctrl = self._control
+        if ctrl is not None:
+            return int(ctrl.coalesce(self))
+        return self.options.coalesce
+
+    def _is_drain_thread(self) -> bool:
+        """Is the current thread the one draining this engine (own or shared)?"""
+        if threading.current_thread() is self._thread:
+            return True
+        owner = self._drain_owner
+        return owner is not None and owner.is_drain_thread()
+
     def _drain_loop(self) -> None:
         _trace.note_thread("serve-drain")  # label this track in the exported trace
-        linger_s = self.options.linger_ms / 1000.0
         while True:
-            with self._cond:
+            if self._drain_once(wait=True) in ("stop", "killed"):
+                return
+
+    def _drain_once(self, wait: bool = True) -> str:
+        """Apply at most one coalesced window; returns the outcome.
+
+        ``"applied"`` — a window left the queue (committed or failed); ``"idle"`` —
+        nothing ready (empty/paused, or a non-blocking call found the linger dwell
+        still running); ``"stop"`` — the engine is stopping and the queue is empty;
+        ``"killed"`` — chaos :class:`DrainKilled` fired and the calling thread must
+        terminate. ``wait=True`` is the dedicated-drain mode (blocks for work and
+        dwells in-lock); ``wait=False`` is the :class:`SharedDrain` quantum — never
+        blocks, so one thread can round-robin many engines.
+        """
+        linger_s = self._effective_linger_s()
+        coalesce = self._effective_coalesce()
+        with self._cond:
+            if wait:
                 while (not self._queue or self._paused) and not self._stop:
                     self._cond.wait()
-                if self._stop and not self._queue:
-                    return
-                if self._paused and not self._stop:
-                    continue
-                if linger_s > 0 and not (self._flush or self._stop):
-                    # micro-batching dwell: give the enqueueing thread up to linger_ms
-                    # to fill a coalescible window before launching (bypassed the
-                    # moment a quiescer waits or the window is already full-width)
+            if self._stop and not self._queue:
+                return "stop"
+            if (self._paused and not self._stop) or not self._queue:
+                return "idle"
+            if linger_s > 0 and not (self._flush or self._stop):
+                # micro-batching dwell: give the enqueueing thread up to linger_ms
+                # to fill a coalescible window before launching (bypassed the
+                # moment a quiescer waits or the window is already full-width)
+                if wait:
                     while (
-                        0 < len(self._queue) < self.options.coalesce
+                        0 < len(self._queue) < coalesce
                         and not (self._flush or self._stop or self._paused)
                     ):
                         remaining = self._queue[0][4] + linger_s - time.monotonic()
                         if remaining <= 0:
                             break
                         self._cond.wait(remaining)
-                    if not self._queue or self._paused or (self._stop and not self._queue):
-                        continue
-                items = [self._queue.popleft()]
-                if self.options.coalesce > 1 and self._queue:
-                    # coalesce consecutive same-shape batches into one scan launch:
-                    # k dispatches become 1 (the update_batches tier), FIFO preserved.
-                    # Widths are quantized to powers of two so the compiled stacked-scan
-                    # signatures stay bounded at log2(coalesce) shapes — an arbitrary
-                    # width would AOT-compile a fresh scan per distinct burst size.
-                    key0 = _dispatch._batch_key(items[0][1], items[0][2])
-                    while self._queue and len(items) < self.options.coalesce:
-                        head = self._queue[0]
-                        if _dispatch._batch_key(head[1], head[2]) != key0:
-                            break
-                        items.append(self._queue.popleft())
-                    width = 1 << (len(items).bit_length() - 1)
-                    while len(items) > width:  # hand the overshoot back, order intact
-                        self._queue.appendleft(items.pop())
-                self._applying_n = len(items)
-                inflight_now = len(self._queue) + self._applying_n
-            width = len(items)
-            tier = "update" if width == 1 else "update_batches"
-            telemetry.series("serve.inflight").record(inflight_now)
-            t_apply0 = 0.0
+                    if not self._queue or self._paused:
+                        return "idle"
+                elif (
+                    0 < len(self._queue) < coalesce
+                    and self._queue[0][4] + linger_s - time.monotonic() > 0
+                ):
+                    return "idle"  # dwell unexpired; the shared drain comes back
+            items = [self._queue.popleft()]
+            if coalesce > 1 and self._queue:
+                # coalesce consecutive same-shape batches into one scan launch:
+                # k dispatches become 1 (the update_batches tier), FIFO preserved.
+                # Widths are quantized to powers of two so the compiled stacked-scan
+                # signatures stay bounded at log2(coalesce) shapes — an arbitrary
+                # width would AOT-compile a fresh scan per distinct burst size.
+                key0 = _dispatch._batch_key(items[0][1], items[0][2])
+                while self._queue and len(items) < coalesce:
+                    head = self._queue[0]
+                    if _dispatch._batch_key(head[1], head[2]) != key0:
+                        break
+                    items.append(self._queue.popleft())
+                width = 1 << (len(items).bit_length() - 1)
+                while len(items) > width:  # hand the overshoot back, order intact
+                    self._queue.appendleft(items.pop())
+            self._applying_n = len(items)
+            inflight_now = len(self._queue) + self._applying_n
+        width = len(items)
+        tier = "update" if width == 1 else "update_batches"
+        telemetry.series("serve.inflight").record(inflight_now)
+        t_apply0 = 0.0
+        if telemetry.enabled:
+            t_apply0 = telemetry.now_us()
+            for it in items:
+                if width > 1:
+                    _trace.coalesced_event(it[0].trace_id, width)
+                _trace.dispatched_event(it[0].trace_id, tier, width)
+        try:
+            self._apply_window(items)
+        except DrainKilled:
+            # the thread is dying between dequeue and apply: hand the window back
+            # (nothing was committed) so the restart latch re-applies it FIFO, then
+            # terminate without the default excepthook spew — the death is
+            # observable via the dead thread, exactly like an external kill
+            with self._cond:
+                self._queue.extendleft(reversed(items))
+                self._applying_n = 0
+                self._cond.notify_all()
+            for it in items:
+                self._staging.release(it[3])
+            return "killed"
+        except Exception as err:  # noqa: BLE001 - a bad batch must not kill the drain
+            telemetry.counter("serve.apply_failures").inc(len(items))
+            _flightrec.record(
+                "serve.apply_failure", batches=len(items), error=repr(err)[:200]
+            )
+            for it in items:
+                it[0]._resolve(error=err)
+                _trace.failed_event(it[0].trace_id, repr(err))
+            with self._cond:
+                # stats share _cond with the admission counters: the main thread
+                # bumps "enqueued"/"shed" under it, so the drain's failure count
+                # must too or the += load/store pair loses updates (TPU021)
+                self._stats["failed"] += len(items)
+                if self._pending_error is None:
+                    self._pending_error = err
+                self._applying_n = 0
+                self._cond.notify_all()
+        else:
+            telemetry.counter("serve.committed").inc(len(items))
+            if len(items) > 1:
+                telemetry.counter("serve.coalesced_launches").inc()
+            # always-on: commit-event + enqueue->commit latency series (the SLO
+            # commit-latency feed), then the trace closes each ticket's flow on
+            # THIS (drain) thread — the caller->drain link Perfetto draws
+            now_mono = time.monotonic()
+            lat_series = telemetry.series("serve.commit_latency_us")
+            commits = telemetry.series("serve.commits")
+            for it in items:
+                lat_series.record((now_mono - it[4]) * 1e6)
+                commits.record(1.0)
             if telemetry.enabled:
-                t_apply0 = telemetry.now_us()
+                _trace.apply_span(t_apply0, width, tier)
                 for it in items:
-                    if width > 1:
-                        _trace.coalesced_event(it[0].trace_id, width)
-                    _trace.dispatched_event(it[0].trace_id, tier, width)
-            try:
-                self._apply_window(items)
-            except DrainKilled:
-                # the thread is dying between dequeue and apply: hand the window back
-                # (nothing was committed) so the restart latch re-applies it FIFO, then
-                # terminate without the default excepthook spew — the death is
-                # observable via the dead thread, exactly like an external kill
-                with self._cond:
-                    self._queue.extendleft(reversed(items))
-                    self._applying_n = 0
-                    self._cond.notify_all()
-                for it in items:
-                    self._staging.release(it[3])
-                return
-            except Exception as err:  # noqa: BLE001 - a bad batch must not kill the drain
-                telemetry.counter("serve.apply_failures").inc(len(items))
-                _flightrec.record(
-                    "serve.apply_failure", batches=len(items), error=repr(err)[:200]
-                )
-                for it in items:
-                    it[0]._resolve(error=err)
-                    _trace.failed_event(it[0].trace_id, repr(err))
-                with self._cond:
-                    # stats share _cond with the admission counters: the main thread
-                    # bumps "enqueued"/"shed" under it, so the drain's failure count
-                    # must too or the += load/store pair loses updates (TPU021)
-                    self._stats["failed"] += len(items)
-                    if self._pending_error is None:
-                        self._pending_error = err
-                    self._applying_n = 0
-                    self._cond.notify_all()
-            else:
-                telemetry.counter("serve.committed").inc(len(items))
-                if len(items) > 1:
-                    telemetry.counter("serve.coalesced_launches").inc()
-                # always-on: commit-event + enqueue->commit latency series (the SLO
-                # commit-latency feed), then the trace closes each ticket's flow on
-                # THIS (drain) thread — the caller->drain link Perfetto draws
-                now_mono = time.monotonic()
-                lat_series = telemetry.series("serve.commit_latency_us")
-                commits = telemetry.series("serve.commits")
-                for it in items:
-                    lat_series.record((now_mono - it[4]) * 1e6)
-                    commits.record(1.0)
-                if telemetry.enabled:
-                    _trace.apply_span(t_apply0, width, tier)
-                    for it in items:
-                        _trace.committed_event(
-                            it[0].trace_id, (now_mono - it[4]) * 1e6, it[0].generation
-                        )
-                with self._cond:
-                    self._stats["committed"] += len(items)
-                    self._applying_n = 0
-                    self._cond.notify_all()
-            finally:
-                for it in items:
-                    self._staging.release(it[3])
+                    _trace.committed_event(
+                        it[0].trace_id, (now_mono - it[4]) * 1e6, it[0].generation
+                    )
+            with self._cond:
+                self._stats["committed"] += len(items)
+                self._applying_n = 0
+                if self._control is not None:
+                    # commits relieve pressure between offered ticks; let the next
+                    # decision see the drained depth, not the pre-commit burst
+                    self._control.note_committed(self, len(items))
+                self._cond.notify_all()
+        finally:
+            for it in items:
+                self._staging.release(it[3])
+        return "applied"
 
     def _apply_window(self, items: list) -> None:
         """Apply one FIFO window of batches through the target's synchronous tiers.
@@ -471,7 +598,7 @@ class IngestEngine:
         re-raises the first deferred apply error so a drained state is either exact or
         loudly incomplete — never silently short.
         """
-        if threading.current_thread() is self._thread:
+        if self._is_drain_thread():
             return
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
@@ -542,6 +669,10 @@ class IngestEngine:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
+        owner = self._drain_owner
+        if owner is not None:
+            # the shared thread keeps serving its other engines; just stop being one
+            owner.detach(self)
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0)

@@ -17,14 +17,19 @@ staging_slots)`` batches whatever the arrival rate does. ``on_full`` picks the c
 
 Env knobs (read by :func:`serve_options_from_env`, the default when ``update_async`` is
 called on an unconfigured metric): ``TM_TPU_SERVE_MAX_INFLIGHT``, ``TM_TPU_SERVE_ON_FULL``,
-``TM_TPU_SERVE_QUEUE_TIMEOUT_S``, ``TM_TPU_SERVE_STAGING_SLOTS``.
+``TM_TPU_SERVE_QUEUE_TIMEOUT_S``, ``TM_TPU_SERVE_STAGING_SLOTS``. A malformed or
+out-of-range env value degrades to the field default with a ONE-SHOT rank-zero warning
+(the warning cache dedups by message) — a typo'd deployment knob must not crash the
+service at its first enqueue.
 """
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Any, Callable, Optional, Type
 
 from torchmetrics_tpu.utils.exceptions import ServeError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 ENV_SERVE_MAX_INFLIGHT = "TM_TPU_SERVE_MAX_INFLIGHT"
 ENV_SERVE_ON_FULL = "TM_TPU_SERVE_ON_FULL"
@@ -86,23 +91,54 @@ class ServeOptions:
             raise ServeError(f"ServeOptions(staging_slots) needs >= 1, got {self.staging_slots}")
 
 
+def _env_num(name: str, default: Any, cast: Type,
+             valid: Optional[Callable[[Any], bool]] = None) -> Any:
+    """Read a numeric env knob; degrade to ``default`` on malformed/out-of-range values.
+
+    The degradation warns rank-zero exactly once per (knob, bad value) — the warning
+    cache dedups by message — so a typo'd ``TM_TPU_SERVE_*`` in a deployment manifest
+    is loud in the logs but never crashes the service at its first enqueue.
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = cast(float(raw)) if cast is int else cast(raw)
+    except (TypeError, ValueError):
+        rank_zero_warn(
+            f"Ignoring malformed env {name}={raw!r} (not a {cast.__name__});"
+            f" using the default {default!r}.",
+            UserWarning,
+        )
+        return default
+    if valid is not None and not valid(value):
+        rank_zero_warn(
+            f"Ignoring out-of-range env {name}={raw!r}; using the default {default!r}.",
+            UserWarning,
+        )
+        return default
+    return value
+
+
 def serve_options_from_env() -> ServeOptions:
-    """Build :class:`ServeOptions` from the ``TM_TPU_SERVE_*`` environment knobs."""
+    """Build :class:`ServeOptions` from the ``TM_TPU_SERVE_*`` environment knobs.
 
-    def _f(name: str, default: float) -> float:
-        try:
-            return float(os.environ.get(name, default))
-        except (TypeError, ValueError):
-            return default
-
+    Malformed or out-of-range values degrade to the field defaults with a one-shot
+    rank-zero warning per knob — they never raise.
+    """
     on_full = str(os.environ.get(ENV_SERVE_ON_FULL, "block")).strip().lower()
     if on_full not in _ON_FULL:
+        rank_zero_warn(
+            f"Ignoring unknown env {ENV_SERVE_ON_FULL}={on_full!r} (valid: {_ON_FULL});"
+            " using the default 'block'.",
+            UserWarning,
+        )
         on_full = "block"
     return ServeOptions(
-        max_inflight=int(_f(ENV_SERVE_MAX_INFLIGHT, 64)),
+        max_inflight=_env_num(ENV_SERVE_MAX_INFLIGHT, 64, int, lambda v: v >= 1),
         on_full=on_full,
-        queue_timeout_s=_f(ENV_SERVE_QUEUE_TIMEOUT, 30.0),
-        staging_slots=int(_f(ENV_SERVE_STAGING_SLOTS, 2)),
-        coalesce=int(_f(ENV_SERVE_COALESCE, 16)),
-        linger_ms=_f(ENV_SERVE_LINGER, 0.0),
+        queue_timeout_s=_env_num(ENV_SERVE_QUEUE_TIMEOUT, 30.0, float, lambda v: v >= 0),
+        staging_slots=_env_num(ENV_SERVE_STAGING_SLOTS, 2, int, lambda v: v >= 1),
+        coalesce=_env_num(ENV_SERVE_COALESCE, 16, int, lambda v: v >= 1),
+        linger_ms=_env_num(ENV_SERVE_LINGER, 0.0, float, lambda v: v >= 0),
     )

@@ -1,6 +1,6 @@
-.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke telemetry-smoke jaxlint jaxlint-fast jaxlint-race jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
+.PHONY: test test-unit test-integration doctest bench bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke explain-smoke telemetry-smoke jaxlint jaxlint-fast jaxlint-race jaxlint-sarif jaxlint-ir chaos chaos-matrix perf-gate perf-baseline clean
 
-test: jaxlint jaxlint-race test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke chaos chaos-matrix perf-gate
+test: jaxlint jaxlint-race test-unit test-integration bench-smoke keyed-smoke shard-smoke sketch-smoke compress-smoke serve-smoke control-smoke obs-smoke online-smoke bundle-smoke fleet-smoke explain-smoke chaos chaos-matrix perf-gate
 
 test-unit:
 	python -m pytest tests/unittests -q
@@ -102,6 +102,15 @@ bundle-smoke:
 fleet-smoke:
 	python bench.py --fleet --smoke > /tmp/tm_fleet_smoke.json
 	python -c "import json; p=json.loads([l for l in open('/tmp/tm_fleet_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['merged_scrape_parses'], ex; assert ex['fleet_counter_sum_ok'], ('fleet counter aggregate wrong', ex['fleet_counter_sum']); assert ex['fleet_p99_ok'], ('fleet p99 outside the pooled-quantile bound', ex['fleet_p99']); assert ex['incident_minted'] and ex['incident_in_federated_scrape'], ('incident id did not gossip into the scrape', ex); assert ex['fleet_bundle_validates'] and ex['fleet_bundle_incident_matches'], ('merge-fleet bundle invalid', ex); assert ex['degrade_ok'], ('peer death failed the scrape', ex); assert ex['fleet_unhealthy'] == 0, ex; print('fleet-smoke ok: %d peers polled in %.1fms, %dB merged scrape, pooled p99 %.0f, peer-death degrades cleanly' % (ex['fleet_peers'], ex['fleet_poll_ms'], ex['merged_scrape_bytes'], ex['fleet_p99']))"
+
+# compile-plane lane (docs/observability.md "Compile plane"): a burst across the jit and
+# AOT dispatch tiers must land ledger rows under BOTH tiers, the one forced dtype-flip
+# retrace must be attributed to its exact culprit leaf, the seam matrix must survive the
+# strict OpenMetrics parse and bundle validation, and the disabled-path decision note
+# must stay under 2us/dispatch
+explain-smoke:
+	python bench.py --explain --smoke > /tmp/tm_explain_smoke.json
+	python -c "import json; p=json.loads([l for l in open('/tmp/tm_explain_smoke.json').read().strip().splitlines() if l][-1]); ex=p['extras']; assert ex['compile_both_tiers'], ('ledger missing a dispatch tier', ex['compile_tiers_seen']); assert ex['retraces_attributed'] >= 1 and ex['retrace_culprits_exact'], ('retrace not attributed to the exact leaf', ex); assert ex['retrace_flight_events'] >= 1, ex; assert ex['seam_matrix_full_axis'] and ex['seam_matrix_openmetrics_ok'] and ex['seam_matrix_bundle_ok'], ('seam matrix failed validation', ex); assert ex['explain_decision_ok'], ('decision note above the 2us bound', ex['explain_decision_us_per_dispatch']); assert ex['explain_has_flags'] and ex['explain_has_tiers'] and ex['explain_has_decisions'] and ex['explain_has_compiles'], ex; print('explain-smoke ok: %d ledger rows across %s, %d retraces attributed (args[1] dtype), decision note %.2fus (<=2us)' % (ex['compile_ledger_rows'], '+'.join(ex['compile_tiers_seen']), ex['retraces_attributed'], ex['explain_decision_us_per_dispatch']))"
 
 # streaming-sketch lane (docs/sketches.md): tiny-N sketch-vs-cat bench asserting the
 # acceptance bar — sketch-mode AUROC/quantile state is FIXED-size (identical bytes after

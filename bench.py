@@ -1456,6 +1456,141 @@ def flight_main(smoke: bool) -> None:
     )
 
 
+def bench_explain(batch: int, n_batches: int) -> dict:
+    """``--explain`` scenario (docs/observability.md "Compile plane").
+
+    Four lanes:
+
+    1. **burst across tiers** — fresh metrics driven through the jit update/compute
+       tiers, the AOT fused forward, and the whole-stack scan, with ONE forced int32
+       dtype flip per class; acceptance: the compile ledger holds rows under BOTH
+       tiers and the retrace attributor named the exact culprit leaf (``args[1]``,
+       dtype) for every probe class.
+    2. **decision-path overhead** — ``note_decision`` is on the disabled/fallback
+       dispatch path, so its per-call cost is paid on every eager-tier dispatch;
+       acceptance bound: ≤ 2µs/dispatch (best-of-3).
+    3. **seam matrix validity** — the live matrix carries the full eight-seam axis on
+       every row, the OpenMetrics export strict-``parse()``\\ s with the
+       ``tm_seam_matrix`` info family present, and the post-mortem bundle section
+       round-trips through strict ``validate_bundle``.
+    4. **explain surface** — ``Metric.explain_dispatch()`` returns flags + tiers +
+       decisions + per-instance compile rows for a driven metric.
+    """
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.obs import xplane
+
+    n = max(16, batch)
+    del n_batches
+    out: dict = {}
+
+    # --- lane 1: compile-plane burst across tiers ----------------------------------
+    x = jnp.asarray(np.linspace(0.5, 2.0, n, dtype=np.float32))
+    x_i32 = jnp.asarray((np.arange(n) % 7).astype(np.int32))
+    stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * n, dtype=np.float32).reshape(4, n))
+    xplane.reset()
+    driven = []
+    for cls in (SumMetric, MeanMetric):
+        m = cls(nan_strategy="ignore")
+        m.update(x)
+        m.update(x)        # cache hit: must not append a ledger row
+        m.update(x_i32)    # the forced dtype-flip retrace
+        m(x)
+        m(x)
+        m.update_batches(stack)
+        m.compute()
+        driven.append(m)
+    recs = xplane.compile_records()
+    tiers_seen = {r["tier"] for r in recs}
+    attributed = [r for r in recs if r["attribution"]]
+    out["compile_ledger_rows"] = len(recs)
+    out["compile_tiers_seen"] = sorted(tiers_seen)
+    out["compile_both_tiers"] = tiers_seen >= {"jit", "aot"}
+    out["retraces_attributed"] = len(attributed)
+    out["retrace_culprits_exact"] = bool(attributed) and all(
+        r["attribution"]["path"] == "args[1]" and r["attribution"]["change"] == "dtype"
+        for r in attributed
+    )
+    out["retrace_flight_events"] = sum(
+        1 for e in obs.flightrec.events() if e["kind"] == "compile.retrace"
+    )
+    out["aot_fingerprints"] = sum(1 for r in recs if r["fingerprint"])
+
+    # --- lane 2: decision-path overhead (the disabled-dispatch tax) ----------------
+    reps = 20_000
+    probe = SumMetric(nan_strategy="ignore")
+    per_call_us = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _i in range(reps):
+            xplane.note_decision(probe, "update", "jit", "fast_update_class_off")
+        per_call_us = min(per_call_us, (time.perf_counter() - t0) / reps * 1e6)
+    out["explain_decision_us_per_dispatch"] = round(per_call_us, 3)
+    out["explain_decision_bound_us"] = 2.0
+    out["explain_decision_ok"] = per_call_us <= 2.0
+
+    # --- lane 3: seam matrix validity (live, OpenMetrics, bundle) ------------------
+    matrix = xplane.seam_matrix(driven)
+    out["seam_matrix_rows"] = matrix["count"]
+    out["seam_matrix_full_axis"] = all(
+        sorted(r["seams"]) == sorted(xplane.SEAMS) for r in matrix["metrics"]
+    )
+    try:
+        families = obs.openmetrics.parse(obs.openmetrics.render())["families"]
+        out["seam_matrix_openmetrics_ok"] = "tm_seam_matrix" in families
+    except Exception as err:
+        out["seam_matrix_openmetrics_ok"] = False
+        out["seam_matrix_openmetrics_error"] = repr(err)
+    bdir = tempfile.mkdtemp(prefix="tm-explain-bench-")
+    try:
+        path = obs.capture_bundle("bench-explain", directory=bdir)
+        verdict = obs.validate_bundle(path)
+        xp = obs.bundle.load_bundle(path)["sections"]["xplane"]
+        out["seam_matrix_bundle_ok"] = bool(verdict["valid"]) and xp["seam_matrix"]["count"] >= 0
+    except Exception as err:
+        out["seam_matrix_bundle_ok"] = False
+        out["seam_matrix_bundle_error"] = repr(err)
+
+    # --- lane 4: the explain surface -----------------------------------------------
+    info = driven[0].explain_dispatch()
+    out["explain_has_flags"] = set(info["flags"]) >= {"fast_update", "fast_dispatch_env"}
+    out["explain_has_tiers"] = bool(info["tiers"])
+    out["explain_has_decisions"] = bool(info["decisions"])
+    out["explain_has_compiles"] = bool(info["compiles"])
+    return out
+
+
+def explain_main(smoke: bool) -> None:
+    """``bench.py --explain [--smoke]``: one JSON line with the compile-plane proof."""
+    extras = bench_explain(*((64, 8) if smoke else (2048, 64)))
+    try:
+        from torchmetrics_tpu import obs
+
+        extras["telemetry"] = obs.bench_extras()
+    except Exception as err:  # pragma: no cover - extras are best-effort
+        extras["telemetry_error"] = repr(err)
+    print(
+        json.dumps(
+            {
+                "metric": "explain_decision_us_per_dispatch",
+                "value": extras["explain_decision_us_per_dispatch"],
+                "unit": ("[SMOKE tiny-N lane — not a recordable perf number] " if smoke else "") + (
+                    "per-dispatch cost of the tier-decision note on the fallback path"
+                    " (bound: 2us); compile-ledger burst coverage, retrace-attribution"
+                    " exactness, seam-matrix OpenMetrics/bundle validity, and the"
+                    " explain_dispatch surface in extras"
+                ),
+                "vs_baseline": None,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def bench_fleet(n_peers: int, points_per_peer: int) -> dict:
     """``--fleet`` scenario (docs/observability.md "Fleet federation & incident correlation").
 
@@ -2495,6 +2630,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv
         jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
         flight_main(smoke)
+    elif "--explain" in sys.argv:
+        # compile-plane lane (make explain-smoke / docs/observability.md "Compile
+        # plane"): smoke pins CPU like the other lanes
+        import jax
+
+        smoke = "--smoke" in sys.argv
+        jax.config.update("jax_platforms", "cpu" if smoke else _resolve_platform())
+        explain_main(smoke)
     elif "--fleet" in sys.argv:
         # fleet federation lane (make fleet-smoke / docs/observability.md "Fleet
         # federation & incident correlation"): smoke pins CPU like the other lanes

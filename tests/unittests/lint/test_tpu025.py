@@ -1,0 +1,254 @@
+"""TPU025: jit applied to a lambda / locally-def'd closure rebuilt on every call."""
+from __future__ import annotations
+
+from torchmetrics_tpu._lint.core import analyze_source
+from torchmetrics_tpu._lint.rules import RULE_META
+
+PATH = "torchmetrics_tpu/example.py"
+
+
+def _tpu025(source: str, path: str = PATH):
+    return [f for f in analyze_source(source, path=path) if f.rule == "TPU025"]
+
+
+# the hazard, both ways: the jit wrapper is constructed inside the per-call body, so its
+# compilation cache starts empty on EVERY invocation — the kernel retraces per step
+PER_CALL_LAMBDA = """
+import jax
+
+
+class Stepper:
+    def step(self, x):
+        return jax.jit(lambda s: s + x)(self.s)
+"""
+
+PER_CALL_CLOSURE = """
+import jax
+
+
+def fold(state, batch):
+    def kernel(s, b):
+        return s + b.sum()
+
+    return jax.jit(kernel)(state, batch)
+"""
+
+# the correct shape: the jitted function lives at module scope — one wrapper, one cache,
+# every later call a cache hit
+MODULE_SCOPE = """
+import jax
+
+
+def _kernel(s, b):
+    return s + b.sum()
+
+
+_fold = jax.jit(_kernel)
+
+
+def fold(state, batch):
+    return _fold(state, batch)
+"""
+
+
+class TestPerCallWrappersFlag:
+    def test_lambda_inside_method_flags(self):
+        findings = _tpu025(PER_CALL_LAMBDA)
+        assert len(findings) == 1
+        assert "a lambda" in findings[0].message
+        assert "'Stepper.step'" in findings[0].message
+        assert "retraces" in findings[0].message
+
+    def test_local_closure_flags(self):
+        findings = _tpu025(PER_CALL_CLOSURE)
+        assert len(findings) == 1
+        assert "'kernel'" in findings[0].message
+        assert "compile.count" in findings[0].message
+
+    def test_bare_jit_from_import_flags(self):
+        src = """
+from jax import jit
+
+
+def step(s, x):
+    return jit(lambda a: a + x)(s)
+"""
+        assert len(_tpu025(src)) == 1
+
+    def test_loop_body_rebuild_flags(self):
+        # not immediately invoked, but rebuilt per iteration — same churn, one
+        # fresh wrapper (and empty cache) per loop trip
+        src = """
+import jax
+
+
+def sweep(batches):
+    out = []
+    for b in batches:
+        fn = jax.jit(lambda v: v * 2)
+        out.append(fn(b))
+    return out
+"""
+        findings = _tpu025(src)
+        assert len(findings) == 1
+        assert "inside a loop body" in findings[0].message
+
+    def test_pjit_and_filter_jit_covered(self):
+        src = """
+import jax
+import equinox as eqx
+
+
+def a(s):
+    return jax.experimental.pjit.pjit(lambda v: v)(s)
+
+
+def b(s):
+    return eqx.filter_jit(lambda v: v)(s)
+"""
+        assert len(_tpu025(src)) == 2
+
+
+class TestStableWrappersClean:
+    def test_module_scope_jit_is_clean(self):
+        assert _tpu025(MODULE_SCOPE) == []
+
+    def test_module_scope_lambda_is_clean(self):
+        # built once at import: its cache lives as long as the module
+        src = """
+import jax
+
+_inc = jax.jit(lambda x: x + 1)
+"""
+        assert _tpu025(src) == []
+
+    def test_wrapped_callable_is_clean(self):
+        # the engine's _jit_cache pattern: jit(instrument_trace(fn, ...)) built once
+        src = """
+import jax
+from torchmetrics_tpu import obs
+
+
+class M:
+    def _jitted_update(self):
+        fn = self._jit_cache.get("update")
+        if fn is None:
+            def upd(state, x):
+                return {"s": state["s"] + x}
+
+            fn = jax.jit(obs.instrument_trace(upd, self, "update"))
+            self._jit_cache["update"] = fn
+        return fn
+"""
+        assert _tpu025(src) == []
+
+    def test_memoised_closure_is_clean(self):
+        # the retrieval-engine shape: the jit wrapper is built on cache miss only,
+        # stored under self._jit_cache, and every later call reuses it
+        src = """
+import jax
+
+
+class M:
+    def _grouped(self, x):
+        fn = self._jit_cache.get("grouped")
+        if fn is None:
+            def run(v):
+                return v * 2
+
+            fn = jax.jit(run, static_argnames=("q",))
+            self._jit_cache["grouped"] = fn
+        return fn(x)
+"""
+        assert _tpu025(src) == []
+
+    def test_directly_stored_wrapper_is_clean(self):
+        src = """
+import jax
+
+
+class M:
+    def _build(self):
+        def run(v):
+            return v * 2
+
+        self._jit_cache["k"] = jax.jit(run)
+"""
+        assert _tpu025(src) == []
+
+    def test_build_once_then_drive_is_clean(self):
+        # the benchmark idiom: one wrapper built per (one-shot) function call, then
+        # driven in a loop — the single trace amortises over every iteration
+        src = """
+import jax
+
+
+def bench(x, k):
+    def run(v):
+        return v * 2
+
+    run_j = jax.jit(run)
+    out = x
+    for _ in range(k):
+        out = run_j(out)
+    return out
+"""
+        assert _tpu025(src) == []
+
+    def test_memoised_store_inside_loop_is_clean(self):
+        # a per-key cache filled in a loop: each wrapper is built once and retained
+        src = """
+import jax
+
+
+class M:
+    def _warm(self, keys):
+        for k in keys:
+            self._jit_cache[k] = jax.jit(lambda v: v + 1)
+"""
+        assert _tpu025(src) == []
+
+    def test_nonlocal_function_reference_is_clean(self):
+        # jitting a name bound OUTSIDE the enclosing function is a stable identity
+        src = """
+import jax
+
+
+def _kernel(s):
+    return s * 2
+
+
+def fold(state):
+    return jax.jit(_kernel)(state)
+"""
+        assert _tpu025(src) == []
+
+    def test_other_trace_wrappers_not_covered(self):
+        # vmap/grad build no compilation cache of their own; out of scope here
+        src = """
+import jax
+
+
+def fold(state):
+    return jax.vmap(lambda s: s + 1)(state)
+"""
+        assert _tpu025(src) == []
+
+    def test_disable_comment_suppresses(self):
+        src = """
+import jax
+
+
+def probe():
+    return jax.jit(lambda x: x + 1.0)(0.0)  # jaxlint: disable=TPU025
+"""
+        assert _tpu025(src) == []
+
+
+class TestRegistration:
+    def test_rule_meta_registered(self):
+        meta = RULE_META["TPU025"]
+        assert meta["severity"] == "warning"
+        assert "lambda" in meta["summary"]
+        assert "rebuilt" in meta["summary"]
+        assert "_jit_cache" in meta["fix"] or "module" in meta["fix"]

@@ -1,0 +1,387 @@
+"""Compile plane: per-compile records, retrace attribution, decisions, seam matrix."""
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.keyed import KeyedMetric
+from torchmetrics_tpu.obs import bundle as bundle_mod
+from torchmetrics_tpu.obs import flightrec, xplane
+from torchmetrics_tpu.online import Windowed
+from torchmetrics_tpu.parallel.mesh import MeshContext
+from torchmetrics_tpu.sketch import StreamingQuantile
+from torchmetrics_tpu.utils.exceptions import BundleError
+
+X32 = jnp.asarray(np.linspace(0.5, 2.0, 64, dtype=np.float32))
+XI32 = jnp.asarray((np.arange(64) % 7).astype(np.int32))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_compile_plane():
+    xplane.reset()
+    flightrec.clear()
+    yield
+    xplane.reset()
+
+
+class _Owner:
+    """Bare mutable owner for driving note_trace without a real Metric."""
+
+
+def _key(*args, **kwargs):
+    return xplane.snapshot_key(args, kwargs)
+
+
+class TestKeySnapshots:
+    def test_paths_and_descriptions(self):
+        key = _key(X32, 3, mask=XI32)
+        paths = [p for p, _ in key]
+        assert paths == ["args[0]", "args[1]", "kwargs['mask']"]
+        assert key[0][1] == ("array", "float32", (64,), False)
+        assert key[1][1][0] == "static"  # a bare int is trace-static metadata
+        assert key[2][1][:2] == ("array", "int32")
+
+    def test_descriptions_never_hold_values(self):
+        # the ledger keeps metadata only — a (64,) array's description is 4 scalars
+        (_, desc), = _key(X32)
+        assert all(not hasattr(part, "shape") for part in desc)
+
+
+class TestAttribution:
+    def test_dtype_flip(self):
+        a = xplane.attribute(_key(X32), _key(XI32))
+        assert a == {
+            "path": "args[0]", "change": "dtype",
+            "before": "float32[64]", "after": "int32[64]",
+        }
+
+    def test_weak_to_strong(self):
+        weak = _key(jnp.asarray(2.0))         # python float: weak f32
+        strong = _key(jnp.asarray(np.float32(2.0)))
+        a = xplane.attribute(weak, strong)
+        assert a["change"] == "weak_type" and a["path"] == "args[0]"
+        assert "(weak)" in a["before"] and "(weak)" not in a["after"]
+
+    def test_shape_change(self):
+        a = xplane.attribute(_key(X32), _key(X32[:32]))
+        assert a["change"] == "shape"
+        assert a["before"] == "float32[64]" and a["after"] == "float32[32]"
+
+    def test_static_value_change_names_kwarg(self):
+        a = xplane.attribute(_key(X32, flag=True), _key(X32, flag=False))
+        assert a["change"] == "static_value" and a["path"] == "kwargs['flag']"
+        assert "True" in a["before"] and "False" in a["after"]
+
+    def test_kind_flip_array_to_static(self):
+        a = xplane.attribute(_key(X32), _key(64))
+        assert a["change"] == "kind"
+
+    def test_structure_change(self):
+        a = xplane.attribute(_key(X32), _key(X32, X32))
+        assert a["change"] == "structure" and a["path"] == "<pytree>"
+
+    def test_identical_keys_blame_nothing(self):
+        assert xplane.attribute(_key(X32, flag=True), _key(X32, flag=True)) is None
+
+    def test_first_differing_leaf_wins(self):
+        a = xplane.attribute(_key(X32, XI32), _key(X32[:32], XI32.astype(jnp.float32)))
+        assert a["path"] == "args[0]" and a["change"] == "shape"
+
+
+class TestNoteTrace:
+    def test_first_trace_records_without_blame(self):
+        o = _Owner()
+        assert xplane.note_trace(o, "update", (X32,), {}, "f32[64]") is None
+        (rec,) = xplane.compile_records()
+        assert rec["metric"] == "_Owner" and rec["kernel"] == "update"
+        assert rec["tier"] == "jit" and rec["attribution"] is None
+        assert rec["seq"] == 1 and rec["signature"] == "f32[64]"
+
+    def test_retrace_attributes_and_emits_flight_event(self):
+        o = _Owner()
+        xplane.note_trace(o, "update", (X32,), {}, "f32[64]")
+        a = xplane.note_trace(o, "update", (XI32,), {}, "i32[64]")
+        assert a["change"] == "dtype" and a["path"] == "args[0]"
+        evt = [e for e in flightrec.events() if e["kind"] == "compile.retrace"][-1]
+        assert evt["metric"] == "_Owner" and evt["kernel"] == "update"
+        assert evt["path"] == "args[0]" and evt["change"] == "dtype"
+        assert evt["before"] == "float32[64]" and evt["after"] == "int32[64]"
+        recs = xplane.compile_records(kernel="update")
+        assert len(recs) == 2 and recs[1]["attribution"]["change"] == "dtype"
+
+    def test_kernels_attribute_independently(self):
+        o = _Owner()
+        xplane.note_trace(o, "update", (X32,), {}, "s")
+        xplane.note_trace(o, "compute", (X32,), {}, "s")
+        # compute's key did not change; only update retraced
+        assert xplane.note_trace(o, "compute", (X32,), {}, "s") is None
+        assert xplane.note_trace(o, "update", (XI32,), {}, "s")["change"] == "dtype"
+
+    def test_aot_kind_keeps_keys_but_defers_record(self):
+        o = _Owner()
+        xplane.note_trace(o, "aot_update", (X32,), {}, "s")
+        assert xplane.compile_records() == []  # note_aot_compile owns the AOT record
+        a = xplane.note_trace(o, "aot_update", (XI32,), {}, "s")
+        assert a["change"] == "dtype"  # attribution still works across AOT entries
+
+    def test_counter_deltas(self):
+        before = xplane.counters()
+        o = _Owner()
+        xplane.note_trace(o, "update", (X32,), {}, "s")
+        xplane.note_trace(o, "update", (XI32,), {}, "s")
+        after = xplane.counters()
+        assert after["compile.count"] - before["compile.count"] == 2
+        assert after["compile.retraces"] - before["compile.retraces"] == 1
+        assert after["compile.retraces_attributed"] - before["compile.retraces_attributed"] == 1
+
+
+class TestEndToEndRetrace:
+    def test_dtype_flip_on_jit_update_names_culprit(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m.update(X32)  # cache hit: must not append a record
+        m.update(XI32)
+        recs = xplane.compile_records(metric="SumMetric", kernel="update")
+        assert len(recs) == 2
+        # the jitted kernel is called as fn(state_dict, *args): the user's first
+        # positional arg sits at args[1]
+        assert recs[1]["attribution"]["path"] == "args[1]"
+        assert recs[1]["attribution"]["change"] == "dtype"
+        assert recs[1]["attribution"]["before"] == "float32[64]"
+        evt = [e for e in flightrec.events() if e["kind"] == "compile.retrace"]
+        assert evt and evt[-1]["path"] == "args[1]"
+
+    def test_shape_change_attributed(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m.update(X32[:32])
+        recs = xplane.compile_records(metric="SumMetric", kernel="update")
+        assert recs[-1]["attribution"]["change"] == "shape"
+        assert recs[-1]["attribution"]["after"] == "float32[32]"
+
+    def test_churn_warning_cites_culprit_and_tpu004(self):
+        prior = obs.retrace_warn_threshold()
+        obs.set_retrace_warn_threshold(0)
+        try:
+            m = SumMetric(nan_strategy="ignore")
+            m.update(X32)
+            with pytest.warns(UserWarning, match="recompile churn") as rec:
+                m.update(XI32)
+            msg = str(rec[-1].message)
+            assert "Attributed culprit: args[1] (dtype: float32[64] -> int32[64])" in msg
+            assert "TPU004" in msg
+        finally:
+            obs.set_retrace_warn_threshold(prior)
+
+    def test_aot_record_carries_fingerprint_and_timing(self):
+        m = SumMetric(nan_strategy="ignore")
+        m(X32)
+        m(X32)  # AOT cache hit: one compile only
+        recs = [r for r in xplane.compile_records(metric="SumMetric") if r["tier"] == "aot"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["kernel"].startswith("aot_")
+        assert isinstance(rec["fingerprint"], str) and len(rec["fingerprint"]) == 16
+        assert rec["compile_us"] is not None and rec["compile_us"] > 0
+        assert rec["signature"]  # abstract signature captured at compile time
+
+
+class TestDecisionsAndExplain:
+    def test_fallback_reason_recorded(self):
+        m = SumMetric(nan_strategy="ignore")  # fast_update is False on SumMetric
+        m.update(X32)
+        m.update(X32)
+        dec = xplane.decisions(m)
+        assert {"op": "update", "tier": "jit", "reason": "fast_update_class_off",
+                "count": 2} in dec
+
+    def test_explain_dispatch_surface(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m(X32)
+        info = m.explain_dispatch()
+        assert info["metric"] == "SumMetric"
+        assert set(info["flags"]) >= {
+            "fast_update", "jit_update", "fast_dispatch", "fast_dispatch_env",
+            "donation_env", "state_shared", "list_state",
+        }
+        assert info["tiers"].get("update") is True
+        aot = [v for k, v in info["tiers"].items() if k.startswith("aot_")]
+        assert aot and set(aot[0]) == {"entries", "broken", "donate"}
+        assert any(d["reason"] == "fast_update_class_off" for d in info["decisions"])
+        assert all(r["instance"] == info["instance"] for r in info["compiles"])
+        assert info["compiles"]
+
+    def test_decision_book_bounded(self):
+        m = SumMetric(nan_strategy="ignore")
+        for i in range(xplane._DECISION_KINDS + 8):
+            xplane.note_decision(m, "op", "tier", f"reason-{i}")
+        assert len(xplane.decisions(m)) == xplane._DECISION_KINDS
+
+
+class TestSeamMatrix:
+    def test_truth_across_metric_kinds(self):
+        metrics = {
+            "plain": SumMetric(nan_strategy="ignore"),
+            "keyed": KeyedMetric(SumMetric(nan_strategy="ignore"), 16),
+            "windowed": Windowed(MeanMetric(nan_strategy="ignore"),
+                                 window=8, advance_every=8, emit=False),
+            "sketch": StreamingQuantile(q=0.5, capacity=64, levels=16),
+            "sharded": KeyedMetric(SumMetric(nan_strategy="ignore"), 16).shard(MeshContext()),
+        }
+        mat = xplane.seam_matrix(metrics.values())
+        assert mat["seams"] == list(xplane.SEAMS) and mat["count"] == 5
+        by_id = {r["instance"]: r for r in mat["metrics"]}
+        rows = {name: by_id[f"0x{id(m):x}"] for name, m in metrics.items()}
+        # every row carries the full seam axis, and exactly the true seams are lit
+        for row in rows.values():
+            assert sorted(row["seams"]) == sorted(xplane.SEAMS)
+        on = {name: {s for s, v in row["seams"].items() if v} for name, row in rows.items()}
+        assert on["plain"] == {"guardrails"}
+        assert on["keyed"] == {"keyed"}
+        assert on["windowed"] == {"window"}
+        assert on["sketch"] == {"sketch"}
+        assert on["sharded"] == {"keyed", "sharded"}
+
+    def test_tiers_reflect_compiled_programs(self):
+        m = SumMetric(nan_strategy="ignore")
+        (row,) = xplane.seam_matrix([m])["metrics"]
+        assert row["tiers"] == {}  # nothing compiled yet
+        m.update(X32)
+        (row,) = xplane.seam_matrix([m])["metrics"]
+        assert row["tiers"].get("update") is True
+
+    def test_rows_sorted_for_stable_export(self):
+        mats = xplane.seam_matrix([MeanMetric(), SumMetric(), MeanMetric()])["metrics"]
+        assert [r["metric"] for r in mats] == sorted(r["metric"] for r in mats)
+
+    def test_default_walks_tracked_registry(self):
+        m = SumMetric(nan_strategy="ignore")
+        mat = xplane.seam_matrix()
+        assert f"0x{id(m):x}" in {r["instance"] for r in mat["metrics"]}
+
+    def test_openmetrics_info_family_round_trips(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m(X32)         # multi-tier row: the joined label value must survive strict parse
+        m.compute()
+        text = obs.openmetrics.render()
+        families = obs.openmetrics.parse(text)["families"]  # strict parse validates
+        assert "tm_seam_matrix" in families
+        sample = [
+            s for s in families["tm_seam_matrix"]["samples"]
+            if s["labels"].get("instance") == f"0x{id(m):x}"
+        ]
+        assert sample and "guardrails" in sample[0]["labels"]["seams"]
+        tiers = sample[0]["labels"]["tiers"].split(";")
+        assert "update" in tiers and any(t.startswith("aot_") for t in tiers)
+
+
+class TestBundleSection:
+    def _repack(self, path, doc):
+        packed = {
+            name: {"crc": zlib.crc32(pickle.dumps(objv)) & 0xFFFFFFFF,
+                   "data": pickle.dumps(objv)}
+            for name, objv in doc["sections"].items()
+        }
+        payload = pickle.dumps(
+            {**{k: v for k, v in doc.items() if k != "sections"}, "sections": packed}
+        )
+        open(path, "wb").write(
+            bundle_mod.BUNDLE_MAGIC
+            + struct.Struct("<IQ").pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+            + payload
+        )
+
+    def test_seam_matrix_round_trips_through_bundle(self, tmp_path):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m.update(XI32)  # one attributed retrace rides into the bundle
+        path = obs.capture_bundle("xplane-test", directory=str(tmp_path))
+        doc = bundle_mod.load_bundle(path)
+        sec = doc["sections"]["xplane"]
+        assert sec["version"] == 1
+        live_row = [
+            r for r in sec["seam_matrix"]["metrics"] if r["instance"] == f"0x{id(m):x}"
+        ]
+        assert live_row and live_row[0]["seams"]["guardrails"]
+        recs = [r for r in sec["compiles"] if r["instance"] == f"0x{id(m):x}"]
+        assert any(r["attribution"] for r in recs)
+        assert sec["counters"]["compile.count"] >= 2
+        assert obs.validate_bundle(path)["valid"]
+
+    def test_malformed_compile_record_rejected(self, tmp_path):
+        path = obs.capture_bundle("xplane-bad-rec", directory=str(tmp_path))
+        doc = bundle_mod.load_bundle(path)
+        doc["sections"]["xplane"]["compiles"] = [{"seq": 1, "metric": "M"}]  # no kernel/tier
+        self._repack(path, doc)
+        with pytest.raises(BundleError, match="malformed xplane compile record"):
+            obs.validate_bundle(path)
+
+    def test_non_monotonic_sequence_rejected(self, tmp_path):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        m.update(XI32)
+        path = obs.capture_bundle("xplane-bad-seq", directory=str(tmp_path))
+        doc = bundle_mod.load_bundle(path)
+        doc["sections"]["xplane"]["compiles"].reverse()
+        self._repack(path, doc)
+        with pytest.raises(BundleError, match="not monotonic"):
+            obs.validate_bundle(path)
+
+    def test_seam_row_missing_axis_rejected(self, tmp_path):
+        m = SumMetric(nan_strategy="ignore")
+        path = obs.capture_bundle("xplane-bad-row", directory=str(tmp_path))
+        doc = bundle_mod.load_bundle(path)
+        row = [
+            r for r in doc["sections"]["xplane"]["seam_matrix"]["metrics"]
+            if r["instance"] == f"0x{id(m):x}"
+        ][0]
+        del row["seams"]["guardrails"]  # a row missing a seam column is torn data
+        self._repack(path, doc)
+        with pytest.raises(BundleError, match="malformed seam-matrix row"):
+            obs.validate_bundle(path)
+
+    def test_missing_matrix_rejected(self, tmp_path):
+        path = obs.capture_bundle("xplane-no-matrix", directory=str(tmp_path))
+        doc = bundle_mod.load_bundle(path)
+        del doc["sections"]["xplane"]["seam_matrix"]
+        self._repack(path, doc)
+        with pytest.raises(BundleError, match="no seam matrix"):
+            obs.validate_bundle(path)
+
+
+class TestExports:
+    def test_bench_extras_carry_compile_plane(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(X32)
+        extras = obs.bench_extras()
+        assert extras["compile_count"] >= 1
+        assert "retraces_attributed" in extras
+        assert "compile_time_us_p99" in extras
+
+    def test_summary_always_tabulates_compile_family(self):
+        text = obs.summary()
+        assert "compile.count" in text and "compile.retraces" in text
+
+    def test_obs_namespace_exports(self):
+        assert obs.compile_records is xplane.compile_records
+        assert obs.seam_matrix is xplane.seam_matrix
+        assert obs.explain_dispatch is xplane.explain_dispatch
+
+    def test_federation_payload_carries_matrix(self):
+        from torchmetrics_tpu.obs import federation
+
+        m = SumMetric(nan_strategy="ignore")
+        payload = federation.federation_payload()
+        assert payload["seam_matrix"] is not None
+        assert f"0x{id(m):x}" in {
+            r["instance"] for r in payload["seam_matrix"]["metrics"]
+        }

@@ -54,6 +54,9 @@ TPU018    lossy sync compression (``SyncOptions(compression="bf16"|"int8")``)
 TPU020    process-identity read (``os.getpid()``/``socket.gethostname()``/
           ``uuid``/``process_fingerprint``) inside jit-traced code — the
           identity is frozen at trace time, stale after restart/cache hit
+TPU025    ``jit`` applied to a lambda or a locally-def'd closure inside a
+          function body — a fresh wrapper per call defeats the compilation
+          cache (silent retrace-every-call; the compile plane flags the churn)
 ========  ======================================================================
 
 **Interprocedural marks** (set by :mod:`torchmetrics_tpu._lint.project`, never by the
@@ -277,6 +280,18 @@ RULE_META: Dict[str, Dict[str, str]] = {
                " open_incident) with the triggering signal values — the decision"
                " journal, replay bit-identity, and post-mortem bundles all assume the"
                " control event stream is complete (docs/serving.md 'Control loop')",
+    },
+    "TPU025": {
+        "severity": "warning",
+        "summary": "jit of a lambda or locally-def'd closure immediately invoked or"
+                   " rebuilt inside a loop — the wrapper (and its compilation cache) is"
+                   " rebuilt on every call, so the kernel silently retraces per"
+                   " invocation",
+        "example": "def step(self, x):\n    return jax.jit(lambda s: s + x)(self.s)",
+        "fix": "hoist the jitted function to module/class scope, or cache the wrapper"
+               " once (the engine's _jit_cache pattern) so repeat calls hit the same"
+               " compiled program — obs.xplane's compile ledger will show the churn"
+               " this rule catches statically",
     },
 }
 
@@ -2678,11 +2693,95 @@ def _rule_tpu024(model: _ModuleModel, lines: Sequence[str], path: str) -> List[F
     return out
 
 
+#: jit-wrapper constructors whose result carries a per-object compilation cache: a fresh
+#: call builds a fresh cache, so constructing one per invocation retraces per invocation
+_TPU025_JIT_WRAPPERS = {"jit", "pjit", "filter_jit"}
+
+
+def _rule_tpu025(model: _ModuleModel, lines: Sequence[str], path: str) -> List[Finding]:
+    """``jit`` applied to a lambda/locally-def'd closure rebuilt on every call.
+
+    ``jax.jit`` keys its compilation cache on the *wrapped callable's identity*: a
+    lambda or a ``def`` nested in the enclosing function is a NEW object each time the
+    enclosing function runs, so the jit wrapper built around it starts with an empty
+    cache and retraces — and XLA recompiles — on every single invocation. Nothing
+    crashes; the run is just quietly 10-1000x slower, and only the compile plane
+    (``compile.count`` climbing linearly with steps, no attributable culprit because
+    every trace IS a first trace) gives it away at runtime. This rule catches the
+    pattern statically, at the construction site.
+
+    Structurally: inside any function body, a call whose target's final name is
+    ``jit``/``pjit``/``filter_jit`` with a first argument that is a ``lambda``
+    expression or a bare name bound to a function def'd in the SAME enclosing scope,
+    in one of the two shapes where the per-call rebuild is unambiguous:
+
+    - **immediately invoked** — ``jax.jit(kernel)(state, batch)``: nothing retains
+      the wrapper, so every execution of the line rebuilds it from scratch;
+    - **constructed inside a loop body** — the wrapper is rebuilt per iteration.
+
+    A wrapper that is merely *assigned* and reused (``run_j = jax.jit(run)`` followed
+    by a timing loop over ``run_j`` — the build-once-then-drive benchmark idiom, or
+    the engine's memoised ``_jit_cache`` stores) amortises its one trace and is given
+    the benefit of the doubt; if such a site DOES churn at runtime the compile plane
+    names it anyway. Module-scope ``jit(lambda ...)`` is exempt — built once at
+    import, its cache lives as long as the module.
+    """
+    out: List[Finding] = []
+    for info in model.functions:
+        local_defs = {child.name for child in info.children}
+        # the two unambiguous shapes: jit(...) used as the callee of another call,
+        # and jit(...) constructed inside a loop body within this scope
+        invoked: Set[int] = set()
+        in_loop: Set[int] = set()
+        memoised: Set[int] = set()  # jit calls stored into a subscript/attribute
+        for node in _scoped_walk(info.node):
+            if isinstance(node, ast.Call):
+                invoked.add(id(node.func))
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop.update(id(sub) for sub in _scoped_walk(node))
+            elif isinstance(node, ast.Assign) and any(
+                isinstance(t, (ast.Subscript, ast.Attribute)) for t in node.targets
+            ):
+                memoised.add(id(node.value))
+        for node in _scoped_walk(info.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or dotted[-1] not in _TPU025_JIT_WRAPPERS:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                what = "a lambda"
+            elif isinstance(target, ast.Name) and target.id in local_defs:
+                what = f"locally-def'd closure {target.id!r}"
+            else:
+                continue
+            if id(node) in invoked:
+                shape = "immediately invoked"
+            elif id(node) in in_loop and id(node) not in memoised:
+                shape = "constructed inside a loop body"
+            else:
+                continue
+            wrapper = ".".join(dotted)
+            out.append(_finding(
+                "TPU025", path, node, lines,
+                f"{wrapper}(...) applied to {what} inside {info.qualname!r} and"
+                f" {shape}: the wrapped callable (and therefore the jit wrapper's"
+                " compilation cache) is rebuilt on every call, so the kernel"
+                " retraces — and XLA recompiles — per invocation. Hoist the"
+                " function to module/class scope or build the wrapper once and"
+                " cache it (the engine's _jit_cache pattern); obs.xplane's compile"
+                " ledger shows this churn at runtime as compile.count climbing"
+                " linearly with steps.",
+            ))
+    return out
+
+
 _RULE_FUNCS = (
     _rule_tpu001, _rule_tpu002, _rule_tpu003, _rule_tpu004, _rule_tpu005, _rule_tpu006,
     _rule_tpu007, _rule_tpu008, _rule_tpu009, _rule_tpu010, _rule_tpu011, _rule_tpu012,
     _rule_tpu013, _rule_tpu014, _rule_tpu015, _rule_tpu016, _rule_tpu017, _rule_tpu018,
-    _rule_tpu019, _rule_tpu020, _rule_tpu024,
+    _rule_tpu019, _rule_tpu020, _rule_tpu024, _rule_tpu025,
 )
 
 

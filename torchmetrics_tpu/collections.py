@@ -23,6 +23,7 @@ import numpy as np
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.metric import Metric, _MISS
 from torchmetrics_tpu.obs import profiler as _profiler
+from torchmetrics_tpu.obs import xplane as _xplane
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.utils.data import allclose
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -122,6 +123,7 @@ class MetricCollection:
             if not all(m._fusable_forward() for _, m in members) or any(
                 m.full_state_update for _, m in members
             ):
+                _xplane.note_decision(leader, "group_forward", "per_metric", "group_not_fusable")
                 for name, m in members:
                     result[name] = m(*args, **m._filter_kwargs(**kwargs))
                 continue
@@ -134,6 +136,10 @@ class MetricCollection:
                 if vals is not _MISS:
                     result.update(vals)
                     continue
+            elif not leader.fast_dispatch:
+                _xplane.note_decision(leader, "group_forward", "jit", "fast_dispatch_class_off")
+            else:
+                _xplane.note_decision(leader, "group_forward", "jit", "fast_dispatch_env_off")
             fn = leader._jit_cache.get("group_forward")
             if fn is None:
                 defaults = {k: leader._defaults[k] for k in leader._state.tensors}
@@ -230,9 +236,14 @@ class MetricCollection:
         donate_now = _dispatch.donation_enabled()
         cache = leader._jit_cache.get("aot_group_forward")
         if cache is None or cache.donate != donate_now:
+            if cache is not None:
+                _xplane.note_decision(leader, "group_forward", "aot", "donation_policy_flip")
+            elif not donate_now:
+                _xplane.note_decision(leader, "group_forward", "aot", "donation_disabled")
             cache = _dispatch.FastStepCache(donate_now)
             leader._jit_cache["aot_group_forward"] = cache
         if cache.broken:
+            _xplane.note_decision(leader, "group_forward", "jit", "aot_latch_broken")
             return _MISS
         tracing = obs.telemetry.enabled
         sampled = _profiler.sample_step("group")
@@ -273,6 +284,7 @@ class MetricCollection:
                     UserWarning,
                 )
             cache.mark_broken()
+            _xplane.note_decision(leader, "group_forward", "jit", "aot_step_failed")
             return _MISS
         n_int = leader._update_count + 1
         tensors = state.tensors

@@ -44,6 +44,7 @@ from jax import Array
 
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.obs import profiler as _profiler
+from torchmetrics_tpu.obs import xplane as _xplane
 from torchmetrics_tpu.ops import dispatch as _dispatch
 from torchmetrics_tpu.parallel import mesh as _mesh
 from torchmetrics_tpu.parallel.sync import (
@@ -314,6 +315,14 @@ class Metric:
                 out["sync"]["skew"] = skew
         return out
 
+    def explain_dispatch(self) -> Dict[str, Any]:
+        """The dispatch-decision trace for this instance (docs/observability.md
+        "Compile plane"): gate flags, which tiers hold compiled programs (with the AOT
+        caches' entry counts / broken latches / donation policy), which seams are
+        active, every recorded fallback decision with its reason and count, and this
+        instance's per-compile ledger rows. Read-only and dispatch-free."""
+        return _xplane.explain_dispatch(self)
+
     @property
     def cost_profile(self) -> List[Dict[str, Any]]:
         """XLA cost/memory ledger rows attributed to this metric CLASS.
@@ -505,6 +514,7 @@ class Metric:
                 and _dispatch.fast_dispatch_enabled()
                 and self._fast_update(args, kwargs)
             ):
+                self._note_tier_fallback("update")
                 obs.count_dispatch(self)
                 out = self._jitted_update()(dict(self._state.tensors), *args, **kwargs)
                 self._apply_update_result(out)
@@ -545,6 +555,10 @@ class Metric:
         if self._state.lists or not self.scan_update:
             # list/"cat" states would need dynamic shapes under scan, and host-computation
             # metrics (scan_update=False, e.g. PESQ/STOI/SRMR) cannot trace at all
+            _xplane.note_decision(
+                self, "update_batches", "eager_loop",
+                "list_state" if self._state.lists else "scan_update_off",
+            )
             for i in range(n_batches):
                 self.update(*(a[i] for a in args), **{k: v[i] for k, v in kwargs.items()})
             return
@@ -566,6 +580,7 @@ class Metric:
             self._computed = None
             self._note_sketch(args, kwargs)
             return
+        self._note_tier_fallback("update_batches", need_fast_update=False)
         scan_fn = self._jit_cache.get("update_scan")
         if scan_fn is None:
             upd = self._effective_update()
@@ -625,9 +640,11 @@ class Metric:
         donate_now = self._donation_ok()
         cache = self._jit_cache.get("aot_update_scan")
         if cache is None or cache.donate != donate_now:
+            self._note_aot_cache("update_batches", cache, donate_now)
             cache = _dispatch.FastStepCache(donate_now)
             self._jit_cache["aot_update_scan"] = cache
         if cache.broken:
+            _xplane.note_decision(self, "update_batches", "jit", "aot_latch_broken")
             return False
         state = self._state
         sampled = _profiler.sample_step("scan")
@@ -649,6 +666,7 @@ class Metric:
         except Exception:
             _dispatch.recover_failed_step(self, state, "update_batches")
             cache.mark_broken()
+            _xplane.note_decision(self, "update_batches", "jit", "aot_step_failed")
             return False
         return True
 
@@ -685,9 +703,11 @@ class Metric:
         donate_now = self._donation_ok()
         cache = self._jit_cache.get("aot_update")
         if cache is None or cache.donate != donate_now:
+            self._note_aot_cache("update", cache, donate_now)
             cache = _dispatch.FastStepCache(donate_now)
             self._jit_cache["aot_update"] = cache
         if cache.broken:
+            _xplane.note_decision(self, "update", "jit", "aot_latch_broken")
             return False
         state = self._state
         sampled = _profiler.sample_step("aot")
@@ -708,6 +728,7 @@ class Metric:
         except Exception:
             _dispatch.recover_failed_step(self, state, "update")
             cache.mark_broken()
+            _xplane.note_decision(self, "update", "jit", "aot_step_failed")
             return False
         return True
 
@@ -937,6 +958,35 @@ class Metric:
         return fn
 
     # ------------------------------------------------------------- fast dispatch (AOT)
+    def _note_tier_fallback(self, op: str, need_fast_update: bool = True) -> None:
+        """Name why this dispatch left the AOT fast tier (``explain_dispatch``); called
+        only on the fallback path — the hot path pays nothing. When every gate flag was
+        on, the AOT layer itself already recorded the specific miss (broken latch,
+        build failure), so there is nothing to add here."""
+        if need_fast_update and not self.fast_update:
+            reason = "fast_update_class_off"
+        elif not self.jit_update:
+            reason = "jit_update_off"
+        elif not self.fast_dispatch:
+            reason = "fast_dispatch_class_off"
+        elif need_fast_update and self._state.lists:
+            reason = "list_state"
+        elif not _dispatch.fast_dispatch_enabled():
+            reason = "fast_dispatch_env_off"
+        else:
+            return
+        _xplane.note_decision(self, op, "jit", reason)
+
+    def _note_aot_cache(self, op: str, cache: "Optional[_dispatch.FastStepCache]",
+                        donate_now: bool) -> None:
+        """Explain-notes for the AOT cache churn seams: a donation-policy flip drops
+        the cache, and a freshly undonated cache names why donation is off."""
+        if cache is not None:
+            _xplane.note_decision(self, op, "aot", "donation_policy_flip")
+        if not donate_now:
+            reason = "state_shared" if self._state_shared else "donation_disabled"
+            _xplane.note_decision(self, op, "aot", reason)
+
     def _donation_ok(self) -> bool:
         """Donation needs exclusively-owned state: compute-group members alias the leader's
         arrays, so a member-level donated step would delete buffers its siblings still hold."""
@@ -1019,9 +1069,11 @@ class Metric:
         if cache is None or cache.donate != donate_now:
             # policy flip (state became group-shared, or env toggled): entries built under
             # the old donation policy would donate buffers siblings still alias — drop them
+            self._note_aot_cache("forward", cache, donate_now)
             cache = _dispatch.FastStepCache(donate_now)
             self._jit_cache["aot_forward"] = cache
         if cache.broken:
+            _xplane.note_decision(self, "forward", "jit", "aot_latch_broken")
             return _MISS
         tracing = obs.telemetry.enabled
         sampled = _profiler.sample_step("aot")
@@ -1043,6 +1095,7 @@ class Metric:
         except Exception:
             _dispatch.recover_failed_step(self, state, "forward")
             cache.mark_broken()
+            _xplane.note_decision(self, "forward", "jit", "aot_step_failed")
             return _MISS
         self._update_count += 1
         self._update_called = True
@@ -1202,6 +1255,10 @@ class Metric:
                 if out is not _MISS:
                     self._note_sketch(args, kwargs)
                     return out
+            elif not self.fast_dispatch:
+                _xplane.note_decision(self, "forward", "jit", "fast_dispatch_class_off")
+            else:
+                _xplane.note_decision(self, "forward", "jit", "fast_dispatch_env_off")
             obs.count_dispatch(self)
             sampled = _profiler.sample_step("jit")
             ts0 = time.perf_counter() if sampled else 0.0
@@ -1220,6 +1277,7 @@ class Metric:
             self._state.tensors.update(merged)
             self._note_sketch(args, kwargs)
             return self._squeeze_if_scalar(batch_val)
+        _xplane.note_decision(self, "forward", "jit", "not_fusable")
         obs.count_dispatch(self, 2)  # update kernel + batch-local compute launch
         batch_out = self._jitted_update()(self._default_tensor_state(), *args, **kwargs)
         self._update_count += 1
@@ -1754,6 +1812,7 @@ class Metric:
             moved += len(entries)
         self._state.maybe_aliased = True  # same-placement device_put can return the input
         self._jit_cache = {}  # kernels rebuild with the sharding constraints baked in
+        _xplane.note_decision(self, "shard", "rebuild", "sharded_rebuild")
         self._lazy_sync_cache = None
         obs.telemetry.counter("shard.metrics_sharded").inc()
         obs.telemetry.counter("transfer.device_put").inc(moved)
@@ -1808,6 +1867,7 @@ class Metric:
             self._shard_specs = None
             self._lazy_sync_cache = None
             self._jit_cache = {}  # drop kernels carrying stale sharding constraints
+            _xplane.note_decision(self, "to", "rebuild", "sharded_rebuild")
         return self
 
     def set_dtype(self, dst_type) -> "Metric":
@@ -1823,6 +1883,7 @@ class Metric:
         self._state.maybe_aliased = True  # the cast is an identity for non-float states
         self._defaults = {k: (cast(v) if not isinstance(v, list) else v) for k, v in self._defaults.items()}
         self._jit_cache = {}
+        _xplane.note_decision(self, "set_dtype", "rebuild", "dtype_rebuild")
         specs = self.__dict__.get("_shard_specs")
         if specs:  # the cast may have moved float states off the mesh — re-place them
             for name, s in specs.items():

@@ -70,7 +70,7 @@ from torchmetrics_tpu.obs.profiler import (
     set_profiling,
     timing_summary,
 )
-from torchmetrics_tpu.obs import flightrec, openmetrics, slo, timeseries, trace  # noqa: F401
+from torchmetrics_tpu.obs import flightrec, openmetrics, slo, timeseries, trace, xplane  # noqa: F401
 from torchmetrics_tpu.obs import bundle, memory  # noqa: F401  (after flightrec: bundle reads it)
 from torchmetrics_tpu.obs import federation, fleet  # noqa: F401  (after openmetrics/bundle)
 from torchmetrics_tpu.obs.bundle import (
@@ -92,6 +92,7 @@ from torchmetrics_tpu.obs.slo import (
 )
 from torchmetrics_tpu.obs.telemetry import process_fingerprint
 from torchmetrics_tpu.obs.timeseries import TimeSeries
+from torchmetrics_tpu.obs.xplane import compile_records, explain_dispatch, seam_matrix
 
 __all__ = [
     "Federator",
@@ -140,8 +141,12 @@ __all__ = [
     "set_profiling",
     "timing_summary",
     "bump",
+    "compile_records",
     "count_dispatch",
     "describe_abstract",
+    "explain_dispatch",
+    "seam_matrix",
+    "xplane",
     "device_sync",
     "disable",
     "enable",

@@ -77,6 +77,7 @@ ENV_BUNDLE_KEEP = "TM_TPU_BUNDLE_KEEP"
 #: sections every bundle must carry (``validate`` enforces presence + per-section CRC)
 REQUIRED_SECTIONS = (
     "flight", "telemetry", "trace", "health", "sync", "journal", "memory", "env",
+    "xplane",
 )
 
 #: recent Perfetto events retained per source ring (telemetry log + serve-trace ring)
@@ -204,6 +205,18 @@ def _memory_section() -> Dict[str, Any]:
         return {"rows": [], "totals": {}}
 
 
+def _xplane_section() -> Dict[str, Any]:
+    """The compile plane (docs/observability.md "Compile plane"): per-compile ledger
+    rows, the seam-coverage matrix, and the always-on compile counters."""
+    try:
+        from torchmetrics_tpu.obs import xplane as _xplane
+
+        return _xplane.xplane_section()
+    except Exception:
+        return {"version": 1, "compiles": [], "seam_matrix": {"seams": [], "metrics": [], "count": 0},
+                "counters": {}}
+
+
 def _metric_section(metric: Any) -> Dict[str, Any]:
     """Per-metric context (shapes/dtypes/bytes, never payloads — bundles stay small)."""
     states: Dict[str, Any] = {}
@@ -293,6 +306,7 @@ def build_bundle(
         "journal": _journal_section(metric),
         "memory": _memory_section(),
         "env": _env_section(),
+        "xplane": _xplane_section(),
     }
     if metric is not None:
         sections["metric"] = _metric_section(metric)
@@ -424,6 +438,35 @@ def validate_bundle(path: Union[str, os.PathLike]) -> Dict[str, Any]:
             raise BundleError(f"{path}: fleet timeline is not ordered by (peer, seq)")
         if not fleet.get("bundles"):
             raise BundleError(f"{path}: fleet section names no source bundles")
+    # compile plane: ledger rows must be attributable (seq/metric/kernel/tier) and the
+    # seam matrix must carry the full seam axis per row (docs/observability.md)
+    xplane = doc["sections"]["xplane"]
+    if not isinstance(xplane, dict) or not isinstance(xplane.get("compiles"), list):
+        raise BundleError(f"{path}: xplane section carries no compile-record list")
+    for rec in xplane["compiles"]:
+        if not isinstance(rec, dict) or not all(
+            k in rec for k in ("seq", "metric", "kernel", "tier", "signature")
+        ):
+            raise BundleError(f"{path}: malformed xplane compile record {rec!r}")
+    xseqs = [r["seq"] for r in xplane["compiles"]]
+    if xseqs != sorted(xseqs):
+        raise BundleError(f"{path}: xplane compile sequence numbers are not monotonic")
+    matrix = xplane.get("seam_matrix")
+    if not isinstance(matrix, dict) or not isinstance(matrix.get("metrics"), list) or not isinstance(
+        matrix.get("seams"), list
+    ):
+        raise BundleError(f"{path}: xplane section carries no seam matrix")
+    for row in matrix["metrics"]:
+        if (
+            not isinstance(row, dict)
+            or not isinstance(row.get("seams"), dict)
+            or not isinstance(row.get("tiers"), dict)
+            or "metric" not in row
+            or sorted(row["seams"]) != sorted(matrix["seams"])
+        ):
+            raise BundleError(f"{path}: malformed seam-matrix row {row!r}")
+    if not isinstance(xplane.get("counters"), dict):
+        raise BundleError(f"{path}: xplane section carries no counters")
     return {
         "path": os.fspath(path),
         "reason": doc.get("reason"),

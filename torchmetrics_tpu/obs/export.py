@@ -169,6 +169,15 @@ _ALWAYS_TABULATED = (
     "flight.events",
     "flight.bundles_captured",
     "flight.bundle_capture_failures",
+    # compile plane (docs/observability.md "Compile plane"): per-compile ledger rows,
+    # retrace attributions, and tier-fallback decisions — a summary with zero compile
+    # rows must still SAY the run compiled nothing (and therefore retraced nothing)
+    "compile.count",
+    "compile.jit",
+    "compile.aot",
+    "compile.retraces",
+    "compile.retraces_attributed",
+    "compile.decisions",
 )
 
 #: gauge families ALWAYS tabulated by ``summary()`` even before first publication —
@@ -355,10 +364,17 @@ def bench_extras(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "profiler_rows_recorded": counters.get("profiler.rows_recorded", 0),
         "profiler_lazy_compiles": counters.get("profiler.lazy_compiles", 0),
         "profiler_sampled_steps": counters.get("profiler.sampled_steps", 0),
+        # compile plane (docs/observability.md "Compile plane"): every jit/AOT compile
+        # this run paid, and how many retraces the ledger could attribute to a culprit
+        "compile_count": counters.get("compile.count", 0),
+        "retraces_attributed": counters.get("compile.retraces_attributed", 0),
         "device_transfers": counters.get("transfer.device_put", 0)
         + counters.get("transfer.host_to_device", 0),
         "events_recorded": snap["events_recorded"],
     }
+    ct = tel.get_histogram("compile.time_us")
+    if ct is not None and ct.count:
+        out["compile_time_us_p99"] = round(ct.summary()["p99"], 1)
     hist = tel.get_histogram("sync.latency_us")
     if hist is not None and hist.count:
         s = hist.summary()

@@ -148,6 +148,14 @@ def federation_payload(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
             sync_info["straggler_index"] = skew.get("straggler_index")
     except Exception:  # pragma: no cover - payload must build regardless
         pass
+    # the seam-coverage matrix rides along: a fleet view of which seams×tiers are live
+    # per peer is exactly what the text exposition's info family cannot aggregate
+    try:
+        from torchmetrics_tpu.obs import xplane as _xplane
+
+        seam_matrix = _xplane.seam_matrix()
+    except Exception:  # pragma: no cover - payload must build regardless
+        seam_matrix = None
     return {
         "fingerprint": process_fingerprint(),
         "rank": _rank(),
@@ -156,6 +164,7 @@ def federation_payload(registry: Optional[Telemetry] = None) -> Dict[str, Any]:
         "gauges": {n: g.value for n, g in tel._gauges.items()},
         "series": snap_series,
         "sync": sync_info,
+        "seam_matrix": seam_matrix,
         "incidents": [
             {**inc, "active": inc["id"] == active} for inc in flightrec.recent_incidents()
         ],

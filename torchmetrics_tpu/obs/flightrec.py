@@ -29,6 +29,8 @@ Event taxonomy (docs/observability.md "Flight recorder" has the full table):
 ``journal.torn_tail``       crash-torn tail record skipped on read
 ``journal.corrupt``         mid-stream hole detected (bundle fires)
 ``jit.recompile_churn``     the one-shot retrace-churn warning fired
+``compile.retrace``         a jit cache miss with a prior key was attributed to its
+                            exact culprit leaf (arg path + what changed)
 ``nan.poison``              the in-graph guardrail surfaced non-finite values
 ``slo.alarm``               an SLO/drift/memory burn alarm transitioned (both ways)
 ``chaos.injected``          a seeded fault injector fired

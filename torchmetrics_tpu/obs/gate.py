@@ -44,7 +44,8 @@ def _probe_cost_analysis() -> bool:
     import jax.numpy as jnp
 
     try:
-        compiled = jax.jit(lambda x: x + 1.0).lower(jnp.zeros((4,), jnp.float32)).compile()
+        # one-shot capability probe, not a per-call path: the wrapper is built exactly once
+        compiled = jax.jit(lambda x: x + 1.0).lower(jnp.zeros((4,), jnp.float32)).compile()  # jaxlint: disable=TPU025
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else None
@@ -281,6 +282,54 @@ def run_memory_probe() -> Dict[str, Dict[str, Any]]:
     return rows
 
 
+#: pinned burst for the compile-plane probe (docs/observability.md "Compile plane"):
+#: fresh metrics (per-instance jit wrappers, so earlier workloads' warm XLA caches
+#: cannot hide a trace), pinned f32 shapes, and ONE forced int32 dtype flip — the
+#: retrace the attributor must name. int32 vs float32 deliberately: under default
+#: x64-disabled JAX a float64 array silently casts to f32 and would NOT retrace.
+_COMPILE_PROBE_CLASSES = ("SumMetric", "MeanMetric")
+
+
+def run_compile_probe() -> Dict[str, Dict[str, Any]]:
+    """Deterministic ``compile.count[<Metric>.<kernel>:<tier>]`` rows for the ledger.
+
+    Drives each probe class through every dispatch tier it owns (jit update/compute,
+    the AOT fused forward + whole-stack scan where the class supports them) and reads
+    the compile-plane ledger (:mod:`torchmetrics_tpu.obs.xplane`) back. Compile counts
+    for a pinned burst are exact integers — jit executes the traced program's Python
+    body only on a cache miss — so the gate holds them at zero tolerance: one extra
+    row or one extra count IS a recompile the burst didn't need before, and a retrace
+    the attributor can no longer explain (``attributed`` shrinking) is a lost diagnosis.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu import aggregation
+    from torchmetrics_tpu.obs import xplane
+
+    x = jnp.asarray(np.linspace(0.5, 2.0, _N, dtype=np.float32))
+    x_i32 = jnp.asarray((np.arange(_N) % 7).astype(np.int32))
+    stack = jnp.asarray(np.linspace(0.1, 1.0, 4 * _N, dtype=np.float32).reshape(4, _N))
+    xplane.reset()
+    for cls_name in _COMPILE_PROBE_CLASSES:
+        m = getattr(aggregation, cls_name)(nan_strategy="ignore")
+        m.update(x)
+        m.update(x)        # cache hit: must NOT add a count
+        m.update(x_i32)    # the forced dtype-flip retrace (attributed to args[1])
+        m(x)
+        m(x)
+        m.update_batches(stack)
+        m.compute()
+    rows: Dict[str, Dict[str, Any]] = {}
+    for rec in xplane.compile_records():
+        key = f"compile.count[{rec['metric']}.{rec['kernel']}:{rec['tier']}]"
+        row = rows.setdefault(key, {"count": 0, "attributed": 0})
+        row["count"] += 1
+        if rec.get("attribution"):
+            row["attributed"] += 1
+    return rows
+
+
 def run_gate(
     baseline_path: str = _ledger.DEFAULT_BASELINE,
     bench_dir: str = ".",
@@ -302,6 +351,7 @@ def run_gate(
     current = _ledger.rows_by_key(rows)
     sync_rows = run_sync_probe()
     memory_rows = run_memory_probe()
+    compile_rows = run_compile_probe()
 
     bench_file = _ledger.latest_bench_file(bench_dir)
     bench_numbers: Dict[str, Any] = {}
@@ -315,12 +365,13 @@ def run_gate(
     if update_baseline:
         doc = _ledger.build_document(
             rows, bench=bench_numbers, tolerances=tolerances, sync=sync_rows,
-            memory=memory_rows,
+            memory=memory_rows, compile=compile_rows,
         )
         _ledger.write_document(doc, baseline_path)
         print(
             f"perf-gate: wrote baseline {baseline_path} ({len(rows)} ledger rows,"
             f" {len(sync_rows)} sync probe rows, {len(memory_rows)} memory probe rows,"
+            f" {len(compile_rows)} compile probe rows,"
             f" bench source: {bench_numbers.get('file', 'none')})",
             file=out,
         )
@@ -352,12 +403,17 @@ def run_gate(
     base_memory = baseline.get("memory") or {}
     if base_memory:
         memory_deltas = _ledger.compare_memory(base_memory, memory_rows, tol)
+    compile_deltas: List[Dict[str, Any]] = []
+    base_compile = baseline.get("compile") or {}
+    if base_compile:
+        compile_deltas = _ledger.compare_compile(base_compile, compile_rows, tol)
 
     all_regressions = (
         _ledger.regressions(deltas)
         + _ledger.regressions(bench_deltas)
         + _ledger.regressions(sync_deltas)
         + _ledger.regressions(memory_deltas)
+        + _ledger.regressions(compile_deltas)
     )
     if as_json:
         print(json.dumps({
@@ -365,6 +421,7 @@ def run_gate(
             "bench_deltas": bench_deltas,
             "sync_deltas": sync_deltas,
             "memory_deltas": memory_deltas,
+            "compile_deltas": compile_deltas,
             "bench_file": bench_numbers.get("file"),
             "regressions": len(all_regressions),
             "tolerances": tol,
@@ -380,6 +437,8 @@ def run_gate(
             print(_ledger.render_deltas(sync_deltas, title="perf-gate sync probe"), file=out)
         if memory_deltas:
             print(_ledger.render_deltas(memory_deltas, title="perf-gate memory probe"), file=out)
+        if compile_deltas:
+            print(_ledger.render_deltas(compile_deltas, title="perf-gate compile probe"), file=out)
         verdict = "FAIL" if all_regressions else "PASS"
         print(f"perf-gate: {verdict} ({len(all_regressions)} regression(s))", file=out)
     return 1 if all_regressions else 0

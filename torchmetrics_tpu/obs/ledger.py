@@ -11,7 +11,9 @@ One format, three consumers: the committed ``PERF_LEDGER.json`` baseline, the CI
       "sync":   {"sync.bytes_saved[<mode>]": {"wire_bytes": ..., "raw_bytes": ...,
                  "bytes_saved": ...}},  # deterministic compressed-sync probe rows
       "memory": {"memory.resident_bytes[<Workload>]": {"resident_bytes": ...,
-                 "states": ...}}        # deterministic HBM memory-ledger probe rows
+                 "states": ...}},       # deterministic HBM memory-ledger probe rows
+      "compile": {"compile.count[<Metric>.<kernel>:<tier>]": {"count": ...,
+                 "attributed": ...}}    # deterministic compile-plane probe rows
     }
 
 Comparison semantics: compiler cost quantities (flops, bytes accessed, argument/temp/output
@@ -44,6 +46,8 @@ DEFAULT_TOLERANCES: Dict[str, float] = {
     # bench numbers come from a contended shared host (BASELINE.md window spreads); the
     # wide default catches collapse-class regressions (r02→r03 was 3.1x), not noise
     "bench_rtol": 0.50,
+    # compile counts for the pinned probe burst are exact integers — any drift is churn
+    "compile_rtol": 0.0,
 }
 
 #: BENCH extras keys the gate tracks (beyond the headline "value")
@@ -75,8 +79,9 @@ def build_document(
     tolerances: Optional[Dict[str, float]] = None,
     sync: Optional[Dict[str, Dict[str, Any]]] = None,
     memory: Optional[Dict[str, Dict[str, Any]]] = None,
+    compile: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
-    """Assemble a ledger document from profiler rows (+ optional bench/sync/memory)."""
+    """Assemble a ledger document from profiler rows (+ optional bench/sync/memory/compile)."""
     try:
         import jax
 
@@ -92,6 +97,7 @@ def build_document(
         "bench": bench or {},
         "sync": sync or {},
         "memory": memory or {},
+        "compile": compile or {},
     }
 
 
@@ -284,6 +290,51 @@ def compare_memory(
             "key": key, "field": "(row)", "baseline": None, "current": None,
             "rel": None, "rtol": None, "status": "new",
             "note": "memory probe row not in baseline (--update-baseline to adopt)",
+        })
+    return deltas
+
+
+#: compile probe fields the gate compares, with direction: the XLA compile count for a
+#: pinned burst must not grow (a new recompile = churn regression), and the retraces the
+#: attributor could explain must not shrink (losing attribution is losing the diagnosis)
+COMPILE_FIELDS: Tuple[Tuple[str, bool], ...] = (("count", False), ("attributed", True))
+
+
+def compare_compile(
+    baseline_rows: Dict[str, Dict[str, Any]],
+    current_rows: Dict[str, Dict[str, Any]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Compare the compile-plane probe rows (``compile.count[<Metric>.<kernel>:<tier>]``).
+
+    The probe drives a pinned burst (fixed shapes/dtypes, one forced dtype-flip retrace)
+    through each dispatch tier, so the per-kernel compile counts are exact integers:
+    a change that makes the same burst trace one extra program — or that stops the
+    retrace attributor from naming its culprit — regresses at zero tolerance
+    (``compile_rtol`` defaults to exact). Missing rows regress too: a tier that no
+    longer compiles under the probe is lost coverage, not a win.
+    """
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    rtol = tol.get("compile_rtol", DEFAULT_TOLERANCES["compile_rtol"])
+    deltas: List[Dict[str, Any]] = []
+    for key, base in sorted(baseline_rows.items()):
+        cur = current_rows.get(key)
+        if cur is None:
+            deltas.append({
+                "key": key, "field": "(row)", "baseline": None, "current": None,
+                "rel": None, "rtol": None, "status": "regression",
+                "note": "compile probe row missing from the current run (tier coverage lost)",
+            })
+            continue
+        for field, higher in COMPILE_FIELDS:
+            d = _delta(key, field, base.get(field), cur.get(field), rtol, higher)
+            if d is not None:
+                deltas.append(d)
+    for key in sorted(set(current_rows) - set(baseline_rows)):
+        deltas.append({
+            "key": key, "field": "(row)", "baseline": None, "current": None,
+            "rel": None, "rtol": None, "status": "new",
+            "note": "compile probe row not in baseline (--update-baseline to adopt)",
         })
     return deltas
 

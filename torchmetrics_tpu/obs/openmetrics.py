@@ -206,6 +206,37 @@ def _emit_incidents(w: _Writer) -> None:
             }, 1)
 
 
+def _emit_seam_matrix(w: _Writer) -> None:
+    """One info sample per live metric: active seams × tiers with compiled programs.
+
+    The seam-coverage matrix (docs/observability.md "Compile plane") as an info family:
+    identity lives in the labels (the metric class + instance), the active seam and
+    tier sets are semicolon-joined label values (a comma inside a label value would
+    defeat the strict parser's label splitting), and the value is the constant 1.
+    """
+    try:
+        from torchmetrics_tpu.obs import xplane as _xplane
+
+        matrix = _xplane.seam_matrix()
+    except Exception:  # pragma: no cover - exposition must render regardless
+        return
+    rows = matrix.get("metrics") or []
+    if not rows:
+        return
+    if w.family(
+        "tm_seam_matrix", "info",
+        help="per live metric: active dispatch seams x tiers holding compiled programs",
+    ):
+        for row in rows:
+            w.sample("tm_seam_matrix", "_info", {
+                "rank": _rank(),
+                "metric": row["metric"],
+                "instance": row["instance"],
+                "seams": ";".join(s for s in matrix["seams"] if row["seams"].get(s)),
+                "tiers": ";".join(sorted(row["tiers"])),
+            }, 1)
+
+
 def _emit_skew(w: _Writer) -> None:
     """Per-rank straggler gauges from the last cross-rank skew report, if any ran."""
     try:
@@ -287,6 +318,7 @@ def render(
         _emit_snapshot(w, snap, _rank())
     _emit_process_info(w)
     _emit_incidents(w)
+    _emit_seam_matrix(w)
     _emit_skew(w)
     return w.text()
 

@@ -524,6 +524,15 @@ def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict,
             _profiler.note_jit_trace(owner, kind, fn, args, kwargs, sig)
         except Exception:  # pragma: no cover - profiling must never break a trace
             pass
+    # compile-plane ledger + retrace attribution (docs/observability.md "Compile
+    # plane"): lazily imported — xplane sits above this module
+    attribution = None
+    try:
+        from torchmetrics_tpu.obs import xplane as _xplane
+
+        attribution = _xplane.note_trace(owner, kind, args, kwargs, sig)
+    except Exception:  # pragma: no cover - the ledger must never break a trace
+        attribution = None
     if telemetry.enabled:
         telemetry.event(
             f"jit.trace.{cls}.{kind}", ph="i", cat="jit",
@@ -539,13 +548,19 @@ def record_trace(owner: Any, kind: str, args: tuple, kwargs: dict,
         _flightrec.record(
             "jit.recompile_churn", metric=cls, kernel=kind, retraces=retraces, cache_key=sig
         )
+        culprit = (
+            f" Attributed culprit: {attribution['path']} ({attribution['change']}:"
+            f" {attribution['before']} -> {attribution['after']})."
+            if attribution else ""
+        )
         rank_zero_warn(
             f"Metric {cls} retraced its jitted {kind!r} kernel {retraces} times (threshold"
             f" {_retrace_warn_threshold}) — recompile churn, usually shape/dtype-polymorphic"
-            " inputs or non-static config arguments (the static twin of this warning is"
-            " jaxlint rule TPU004; see docs/static-analysis.md). Pad batches to a fixed"
-            " shape, declare config arguments in static_argnames, or raise the threshold via"
-            f" obs.set_retrace_warn_threshold / ${ENV_RETRACE_THRESHOLD}. Latest cache key: {sig}",
+            f" inputs or non-static config arguments.{culprit} The static twin of this"
+            " warning is jaxlint rule TPU004 (see docs/static-analysis.md). Pad batches to"
+            " a fixed shape, declare config arguments in static_argnames, or raise the"
+            f" threshold via obs.set_retrace_warn_threshold / ${ENV_RETRACE_THRESHOLD}."
+            f" Latest cache key: {sig}",
             UserWarning,
         )
 
@@ -555,8 +570,19 @@ def instrument_trace(fn: Callable, owner: Any, kind: str) -> Callable:
 
     @functools.wraps(fn)
     def wrapper(*args: Any, **kwargs: Any):
+        t0 = time.perf_counter()
         record_trace(owner, kind, args, kwargs, fn=fn)
-        return fn(*args, **kwargs)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            # the traced body's wall time is the honest host-side lower bound on this
+            # compilation's cost; attach it to the fresh compile record
+            try:
+                from torchmetrics_tpu.obs import xplane as _xplane
+
+                _xplane.note_trace_time(owner, kind, (time.perf_counter() - t0) * 1e6)
+            except Exception:  # pragma: no cover - timing must never break a trace
+                pass
 
     return wrapper
 

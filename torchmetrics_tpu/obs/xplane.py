@@ -1,0 +1,451 @@
+"""Compile-plane ledger: per-compile records, retrace attribution, tier decisions, seams.
+
+The dispatch stack (docs/performance.md "Dispatch tiers") multiplies five tiers by
+eight seams, and until now the only compile-plane signal was a counter and a one-shot
+"you recompiled" warning. This module makes the compile plane a first-class observed
+surface, mirroring the XLA-compilation-cache observability practice of the pjit/TPUv4
+scaling work:
+
+- **Per-compile records** — every jit trace and AOT compile appends one bounded-ledger
+  row: owner class, kernel kind, tier, abstract signature, a stable fingerprint of the
+  lowered StableHLO text (AOT tier), compile wall time (``compile.time_us`` histogram),
+  and cost-analysis deltas vs the previous program for the same kernel. Counters
+  (``compile.count`` / ``compile.jit`` / ``compile.aot``) are always-on.
+- **Retrace attribution** — a cache miss with a prior key for the same kernel diffs the
+  keys leaf-by-leaf and names the exact culprit (arg path, dtype / weak-type / shape
+  flip, new static value). The churn warning cites it and a ``compile.retrace`` flight
+  event carries it (docs/observability.md "Flight recorder").
+- **Tier decisions** — every dispatch that falls back (broken AOT latch,
+  ``fast_dispatch`` off, ragged buffered flush, donation disabled, sharded rebuild)
+  records its reason per instance; ``Metric.explain_dispatch()`` returns the trace.
+- **Seam matrix** — :func:`seam_matrix` reports, per live metric, which of the eight
+  seams are active × which tiers hold compiled programs. It is exported as an
+  OpenMetrics info family, folded into the ``/federation`` payload, and written as the
+  CRC'd ``xplane`` post-mortem bundle section.
+
+Everything here is metadata-only: leaf *descriptions* (shape/dtype strings) are kept,
+never arrays or tracers, so hooks are safe inside traced code and leak nothing.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from torchmetrics_tpu.obs.telemetry import telemetry as _tel
+
+ENV_MAX_RECORDS = "TM_TPU_XPLANE_RECORDS"
+
+#: the eight dispatch seams the matrix reports, in canonical column order
+SEAMS: Tuple[str, ...] = (
+    "guardrails", "sketch", "window", "keyed", "sharded", "compression", "serve", "control",
+)
+
+#: jit-tier ``_jit_cache`` keys (a stored callable = a built program wrapper)
+JIT_TIER_KEYS: Tuple[str, ...] = (
+    "update", "compute", "update_scan", "forward_step", "batch_value", "group_forward",
+)
+#: AOT-tier ``_jit_cache`` keys (a :class:`~torchmetrics_tpu.ops.dispatch.FastStepCache`)
+AOT_TIER_KEYS: Tuple[str, ...] = (
+    "aot_update", "aot_update_scan", "aot_forward", "aot_group_forward",
+)
+
+#: always-on compile-plane counters, in the order :func:`counters` reports them
+COUNTER_NAMES: Tuple[str, ...] = (
+    "compile.count", "compile.jit", "compile.aot", "compile.retraces",
+    "compile.retraces_attributed", "compile.decisions",
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+_LOCK = threading.Lock()
+_RECORDS: deque = deque(maxlen=_env_int(ENV_MAX_RECORDS, 4096))
+_SEQ = 0
+#: last cost numbers per (metric class, kernel) — the delta baseline
+_LAST_COST: Dict[Tuple[str, str], Dict[str, Optional[float]]] = {}
+
+_DECISION_KINDS = 64  # distinct (op, tier, reason) triples retained per instance
+
+
+# ------------------------------------------------------------------- key snapshots
+def _leaf_desc(leaf: Any) -> Tuple:
+    """Hashable metadata description of one cache-key leaf (never the value/tracer).
+
+    Arrays (and tracers) → ``("array", dtype, shape, weak_type)``; anything else is a
+    static value baked into the trace → ``("static", type, repr)``.
+    """
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("array", str(dtype), tuple(int(s) for s in shape),
+                bool(getattr(leaf, "weak_type", False)))
+    return ("static", type(leaf).__name__, repr(leaf)[:120])
+
+
+def _fmt_desc(desc: Tuple) -> str:
+    if desc[0] == "array":
+        _, dtype, shape, weak = desc
+        return f"{dtype}[{','.join(str(s) for s in shape)}]" + (" (weak)" if weak else "")
+    return f"{desc[1]}={desc[2]}"
+
+
+def _path_str(path: Tuple) -> str:
+    """Human arg path for one flattened-with-path key: ``args[0]``, ``kwargs['mask']``."""
+    from jax.tree_util import keystr
+
+    head = path[0] if path else None
+    idx = getattr(head, "idx", None)
+    if idx == 0:
+        root = "args"
+    elif idx == 1:
+        root = "kwargs"
+    else:  # pragma: no cover - the snapshot root is always an (args, kwargs) 2-tuple
+        root = keystr((head,)) if head is not None else ""
+    return root + keystr(tuple(path[1:]))
+
+
+def snapshot_key(args: tuple, kwargs: dict) -> List[Tuple[str, Tuple]]:
+    """Path-annotated leaf descriptions of one kernel call's cache key."""
+    from jax.tree_util import tree_flatten_with_path
+
+    flat, _ = tree_flatten_with_path((tuple(args), dict(kwargs)))
+    return [(_path_str(p), _leaf_desc(leaf)) for p, leaf in flat]
+
+
+def attribute(prev: List[Tuple[str, Tuple]], cur: List[Tuple[str, Tuple]]) -> Optional[Dict[str, str]]:
+    """Name the retrace culprit: the first leaf whose description changed.
+
+    Returns ``{"path", "change", "before", "after"}`` with ``change`` one of
+    ``dtype`` / ``weak_type`` / ``shape`` / ``static_value`` / ``kind`` /
+    ``structure``, or None when the keys are identical (a cold cache or an eviction —
+    nothing to blame).
+    """
+    if [p for p, _ in prev] != [p for p, _ in cur]:
+        return {
+            "path": "<pytree>", "change": "structure",
+            "before": f"{len(prev)} leaves", "after": f"{len(cur)} leaves",
+        }
+    for (path, b), (_, a) in zip(prev, cur):
+        if b == a:
+            continue
+        if b[0] != a[0]:
+            change = "kind"
+        elif b[0] == "array":
+            if b[1] != a[1]:
+                change = "dtype"
+            elif b[3] != a[3]:
+                change = "weak_type"
+            else:
+                change = "shape"
+        else:
+            change = "static_value"
+        return {"path": path, "change": change, "before": _fmt_desc(b), "after": _fmt_desc(a)}
+    return None
+
+
+# ------------------------------------------------------------------- compile records
+def _owner_names(owner: Any) -> Tuple[str, str]:
+    if owner is None:
+        return "<anon>", "<anon>"
+    return type(owner).__name__, f"0x{id(owner):x}"
+
+
+def record_compile(
+    owner: Any,
+    kind: str,
+    tier: str,
+    signature: str,
+    fingerprint: Optional[str] = None,
+    compile_us: Optional[float] = None,
+    cost: Optional[Dict[str, Optional[float]]] = None,
+    attribution: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Append one per-compile ledger record; returns it (callers may annotate later)."""
+    global _SEQ
+    cls, instance = _owner_names(owner)
+    with _LOCK:
+        _SEQ += 1
+        delta = None
+        if cost and cost.get("flops") is not None:
+            prior = _LAST_COST.get((cls, kind))
+            if prior:
+                delta = {
+                    f: cost[f] - prior[f]
+                    for f in ("flops", "bytes_accessed")
+                    if cost.get(f) is not None and prior.get(f) is not None
+                }
+            _LAST_COST[(cls, kind)] = dict(cost)
+        rec: Dict[str, Any] = {
+            "seq": _SEQ,
+            "ts_us": round(_tel.now_us(), 3),
+            "metric": cls,
+            "instance": instance,
+            "kernel": kind,
+            "tier": tier,
+            "signature": signature,
+            "fingerprint": fingerprint,
+            "compile_us": compile_us,
+            "flops": (cost or {}).get("flops"),
+            "bytes_accessed": (cost or {}).get("bytes_accessed"),
+            "cost_delta": delta,
+            "attribution": dict(attribution) if attribution else None,
+        }
+        _RECORDS.append(rec)
+    _tel.counter("compile.count").inc()
+    _tel.counter(f"compile.{tier}").inc()
+    if compile_us is not None:
+        _tel.histogram("compile.time_us").record(compile_us)
+    return rec
+
+
+def note_trace(owner: Any, kind: str, args: tuple, kwargs: dict,
+               signature: str) -> Optional[Dict[str, str]]:
+    """jit-trace hook (called from ``telemetry.record_trace`` inside the traced body).
+
+    Snapshots the cache key, attributes the retrace against the prior key for the same
+    (instance, kernel), emits the ``compile.retrace`` flight event, and appends the
+    jit-tier compile record. AOT kernels keep their key snapshots here (so signature
+    drift across AOT entries is attributable too) but their records come from
+    :func:`note_aot_compile`, which holds the timing/fingerprint/cost evidence.
+    Returns the attribution for the caller's churn warning, or None.
+    """
+    keys = owner.__dict__.get("_tm_compile_keys")
+    if keys is None:
+        keys = {}
+        object.__setattr__(owner, "_tm_compile_keys", keys)
+    try:
+        cur = snapshot_key(args, kwargs)
+    except Exception:  # pragma: no cover - exotic pytrees must never break a trace
+        cur = None
+    prev = keys.get(kind)
+    if cur is not None:
+        keys[kind] = cur
+    attribution = None
+    if prev is not None:
+        _tel.counter("compile.retraces").inc()
+        if cur is not None:
+            attribution = attribute(prev, cur)
+        if attribution is not None:
+            _tel.counter("compile.retraces_attributed").inc()
+            from torchmetrics_tpu.obs import flightrec as _flightrec
+
+            _flightrec.record(
+                "compile.retrace", metric=type(owner).__name__, kernel=kind,
+                signature=signature, **attribution,
+            )
+    if not kind.startswith("aot_"):
+        record_compile(owner, kind, "jit", signature, attribution=attribution)
+    return attribution
+
+
+def note_trace_time(owner: Any, kind: str, us: float) -> None:
+    """Attach the traced body's wall time to its fresh jit record (a lower bound on the
+    compile cost; XLA's own lowering happens after the body returns)."""
+    if kind.startswith("aot_"):
+        return  # the AOT record times the full lower+compile in note_aot_compile
+    cls, instance = _owner_names(owner)
+    with _LOCK:
+        for rec in reversed(_RECORDS):
+            if rec["instance"] == instance and rec["kernel"] == kind:
+                if rec["compile_us"] is None:
+                    rec["compile_us"] = round(us, 3)
+                break
+    _tel.histogram("compile.time_us").record(us)
+
+
+def note_aot_compile(owner: Any, kind: str, signature: str, lowered: Any,
+                     compiled: Any, compile_us: float) -> None:
+    """AOT-compile hook (called from ``ops.dispatch.aot_compile`` with both artifacts):
+    fingerprints the lowered StableHLO text and captures the executable's cost."""
+    fingerprint = None
+    try:
+        text = lowered.as_text()
+        fingerprint = hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:16]
+    except Exception:  # pragma: no cover - as_text availability varies by backend
+        pass
+    cost: Optional[Dict[str, Optional[float]]] = None
+    try:
+        from torchmetrics_tpu.obs import profiler as _profiler
+
+        flops, nbytes, _reason = _profiler.extract_cost(compiled)
+        cost = {"flops": flops, "bytes_accessed": nbytes}
+    except Exception:  # pragma: no cover - cost analysis must never break a compile
+        pass
+    record_compile(
+        owner, kind, "aot", signature,
+        fingerprint=fingerprint, compile_us=round(compile_us, 3), cost=cost,
+    )
+
+
+def compile_records(metric: Optional[str] = None, kernel: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The per-compile ledger (bounded, oldest-first), optionally filtered."""
+    with _LOCK:
+        recs = [dict(r) for r in _RECORDS]
+    if metric is not None:
+        recs = [r for r in recs if r["metric"] == metric]
+    if kernel is not None:
+        recs = [r for r in recs if r["kernel"] == kernel]
+    return recs
+
+
+def counters() -> Dict[str, int]:
+    """Current values of the always-on ``compile.*`` counters (zeros included)."""
+    out: Dict[str, int] = {}
+    for name in COUNTER_NAMES:
+        c = _tel._counters.get(name)  # read-only peek: must not create instruments
+        out[name] = int(c.value) if c is not None else 0
+    return out
+
+
+# ------------------------------------------------------------------- tier decisions
+def note_decision(owner: Any, op: str, tier: str, reason: str) -> None:
+    """Record one fallback/rebuild decision on ``owner``: the ``op`` dispatched through
+    ``tier`` because of ``reason``. Aggregated per (op, tier, reason) with counts —
+    O(1) per call (a dict increment), cheap enough for disabled-path dispatch loops."""
+    if owner is None:
+        return
+    book = owner.__dict__.get("_tm_decisions")
+    if book is None:
+        book = {}
+        object.__setattr__(owner, "_tm_decisions", book)
+    key = (op, tier, reason)
+    n = book.get(key)
+    if n is None and len(book) >= _DECISION_KINDS:
+        return  # pathological reason cardinality: keep the book bounded
+    book[key] = (n or 0) + 1
+    _tel.counter("compile.decisions").inc()
+
+
+def decisions(owner: Any) -> List[Dict[str, Any]]:
+    """The decision trace for one instance: first-seen order, with occurrence counts."""
+    book = owner.__dict__.get("_tm_decisions") or {}
+    return [
+        {"op": op, "tier": tier, "reason": reason, "count": count}
+        for (op, tier, reason), count in book.items()
+    ]
+
+
+def explain_dispatch(metric: Any) -> Dict[str, Any]:
+    """The full dispatch-decision picture for one metric (``Metric.explain_dispatch``)."""
+    from torchmetrics_tpu.ops import dispatch as _dispatch
+
+    cls, instance = _owner_names(metric)
+    store = metric.__dict__.get("_state")
+    return {
+        "metric": cls,
+        "instance": instance,
+        "flags": {
+            "fast_update": bool(getattr(metric, "fast_update", False)),
+            "jit_update": bool(getattr(metric, "jit_update", True)),
+            "fast_dispatch": bool(getattr(metric, "fast_dispatch", True)),
+            "fast_dispatch_env": _dispatch.fast_dispatch_enabled(),
+            "donation_env": _dispatch.donation_enabled(),
+            "state_shared": bool(metric.__dict__.get("_state_shared", False)),
+            "list_state": bool(getattr(store, "lists", None)),
+        },
+        "tiers": metric_tiers(metric),
+        "seams": metric_seams(metric),
+        "decisions": decisions(metric),
+        "compiles": [r for r in compile_records() if r["instance"] == instance],
+    }
+
+
+# --------------------------------------------------------------------- seam matrix
+def metric_seams(metric: Any) -> Dict[str, bool]:
+    """Which of the eight dispatch seams are active on this instance."""
+    d = metric.__dict__
+    serve = d.get("_serve")
+    desc = getattr(metric, "online_descriptor", None)
+    opts = getattr(metric, "sync_options", None)
+    try:
+        sharded = bool(getattr(metric, "sharded", False))
+    except Exception:  # pragma: no cover - duck-typed non-Metric trackables
+        sharded = False
+    return {
+        "guardrails": getattr(metric, "nan_strategy", None) is not None,
+        "sketch": bool(d.get("_sketch_specs")),
+        "window": isinstance(desc, dict),
+        "keyed": getattr(metric, "num_keys", None) is not None
+        and getattr(metric, "template", None) is not None,
+        "sharded": sharded,
+        "compression": opts is not None and getattr(opts, "compression", "none") != "none",
+        "serve": serve is not None,
+        "control": serve is not None and getattr(serve, "_control", None) is not None,
+    }
+
+
+def metric_tiers(metric: Any) -> Dict[str, Any]:
+    """Which dispatch tiers hold compiled programs for this instance.
+
+    jit keys map to True once the program wrapper is built; AOT keys map to the cache's
+    vitals (entry count, broken latch, donation policy). Absent keys are absent tiers.
+    """
+    cache = metric.__dict__.get("_jit_cache") or {}
+    tiers: Dict[str, Any] = {}
+    for key in JIT_TIER_KEYS:
+        if cache.get(key) is not None:
+            tiers[key] = True
+    for key in AOT_TIER_KEYS:
+        entry = cache.get(key)
+        if entry is not None and hasattr(entry, "entries"):
+            tiers[key] = {
+                "entries": len(entry.entries),
+                "broken": bool(entry.broken),
+                "donate": bool(entry.donate),
+            }
+    return tiers
+
+
+def seam_matrix(metrics: Optional[Iterable[Any]] = None) -> Dict[str, Any]:
+    """Per live metric: active seams × tiers holding compiled programs.
+
+    Defaults to every instance the memory ledger tracks (``obs.memory``'s weak
+    registry). Rows are JSON-serialisable and sorted for stable export; the same
+    structure lands in OpenMetrics (``tm_seam_matrix_info``), the federation payload,
+    and the post-mortem bundle's ``xplane`` section.
+    """
+    if metrics is None:
+        from torchmetrics_tpu.obs import memory as _memory
+
+        metrics = _memory.tracked_metrics()
+    rows: List[Dict[str, Any]] = []
+    for m in metrics:
+        try:
+            rows.append({
+                "metric": type(m).__name__,
+                "instance": f"0x{id(m):x}",
+                "seams": metric_seams(m),
+                "tiers": metric_tiers(m),
+            })
+        except Exception:  # pragma: no cover - one odd instance must not kill the walk
+            continue
+    rows.sort(key=lambda r: (r["metric"], r["instance"]))
+    return {"seams": list(SEAMS), "metrics": rows, "count": len(rows)}
+
+
+# ------------------------------------------------------------------ bundle section
+def xplane_section() -> Dict[str, Any]:
+    """The compile plane as a post-mortem bundle section (records + matrix + counters)."""
+    return {
+        "version": 1,
+        "compiles": compile_records(),
+        "seam_matrix": seam_matrix(),
+        "counters": counters(),
+    }
+
+
+def reset() -> None:
+    """Clear the process-global compile ledger (tests and probe runs)."""
+    global _SEQ
+    with _LOCK:
+        _RECORDS.clear()
+        _LAST_COST.clear()
+        _SEQ = 0

@@ -126,20 +126,32 @@ def aot_compile(
     process-global cost ledger (``obs.cost_ledger()``) — the AOT tier's profiler seam,
     paid once per compile and never on the step path.
     """
+    import time
+
     import jax
 
+    t0 = time.perf_counter()
     lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*example_args)
     compiled = lowered.compile()
+    compile_us = (time.perf_counter() - t0) * 1e6
     telemetry.counter("dispatch.aot_compiles").inc()
     if owner is not None and kind is not None:
         from torchmetrics_tpu.obs import profiler as _profiler
 
         try:
-            _profiler.record_compiled(
-                type(owner).__name__, kind, "aot",
-                _profiler.abstract_signature(example_args), compiled,
-            )
+            signature = _profiler.abstract_signature(example_args)
+            _profiler.record_compiled(type(owner).__name__, kind, "aot", signature, compiled)
         except Exception:  # pragma: no cover - profiling must never break a compile
+            signature = None
+        # compile-plane ledger row: wall time, StableHLO fingerprint, cost deltas
+        # (docs/observability.md "Compile plane")
+        try:
+            from torchmetrics_tpu.obs import xplane as _xplane
+
+            _xplane.note_aot_compile(
+                owner, kind, signature or "", lowered, compiled, compile_us
+            )
+        except Exception:  # pragma: no cover - the ledger must never break a compile
             pass
     return compiled
 
@@ -352,6 +364,15 @@ class BufferedUpdater:
             self._journal.append(args, kwargs)
         key = _batch_key(args, kwargs)
         if self._pending and key != self._pending_key:
+            # ragged tail: stacking requires uniform shapes, so the pending window is
+            # folded early — a tier decision worth explaining (it costs one extra launch)
+            try:
+                from torchmetrics_tpu.obs import xplane as _xplane
+
+                for m in self._metrics():
+                    _xplane.note_decision(m, "buffered", "update_scan", "ragged_buffered_flush")
+            except Exception:  # pragma: no cover - explain notes must never break a flush
+                pass
             self.flush()
         self._pending_key = key
         self._pending.append((args, kwargs))
